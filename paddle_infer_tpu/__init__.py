@@ -1,0 +1,94 @@
+"""paddle_infer_tpu — a TPU-native deep learning framework.
+
+Brand-new implementation of the capability surface of chao9527/Paddle_infer
+(a PaddlePaddle 2.4-era fork with LLM-inference additions), designed TPU-first:
+eager tensors execute as cached per-op XLA executables, training steps compile
+to single fused XLA programs over a `jax.sharding.Mesh`, hot serving ops are
+Pallas kernels, and distributed parallelism (DP/TP/PP/ZeRO/EP/SP) is expressed
+as mesh shardings + XLA collectives over ICI/DCN instead of NCCL process groups.
+
+Top-level namespace mirrors `import paddle` (reference python/paddle/__init__.py).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import dtype as _dtype_mod
+from .core.dtype import (bool_, uint8, int8, int16, int32, int64, float16,
+                         bfloat16, float32, float64, complex64, complex128,
+                         get_default_dtype, set_default_dtype)
+from .core.tensor import Tensor, Parameter
+from .core.autograd import no_grad, enable_grad, set_grad_enabled, grad
+from .core import random as _random
+from .core.random import seed
+
+# ops must import before anything touches Tensor methods
+from . import ops
+from .ops import *  # noqa: F401,F403
+from .ops import (t, mm, chunk, transpose, einsum)  # noqa: F401
+from .ops.creation import (  # noqa: F401
+    to_tensor, zeros, ones, full, zeros_like, ones_like, full_like, arange,
+    linspace, eye, diag, empty, empty_like, tril, triu, meshgrid, clone,
+    assign, rand, randn, uniform, normal, randint, randperm, bernoulli,
+    multinomial)
+
+from . import nn
+from . import optimizer
+from . import amp
+from . import io
+from . import metric
+from . import jit
+from . import static
+from . import inference
+from . import profiler
+from . import vision
+from . import device
+from .framework import save, load, set_flags, get_flags, flags
+from .framework.io import save_state_dict, load_state_dict
+
+import paddle_infer_tpu.distributed as distributed  # noqa: F401
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def set_device(device_str: str):
+    from .device import set_device as _sd
+
+    return _sd(device_str)
+
+
+def get_device():
+    from .device import get_device as _gd
+
+    return _gd()
+
+
+def in_dynamic_mode():
+    from .jit.trace import in_tracing
+
+    return not in_tracing()
+
+
+def disable_static():
+    pass
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_infer_tpu has no legacy static mode; use paddle_infer_tpu.jit.to_static "
+        "(trace-and-compile) which subsumes it.")
+
+
+def summary(layer, input_size=None):
+    n_params = sum(p.size for p in layer.parameters())
+    return {"total_params": n_params}

@@ -1,0 +1,151 @@
+"""AMP: autocast + GradScaler
+(reference: python/paddle/amp/auto_cast.py:21, grad_scaler.py:26,
+op lists paddle/fluid/imperative/amp_auto_cast.h:45).
+
+TPU note: the native 16-bit type is bfloat16 (MXU), whose dynamic range
+matches float32 — so loss scaling is a no-op by default (enable_loss_scaling
+stays available for float16).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core import dispatch as _dispatch
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+
+white_list = _dispatch.AMP_WHITE_OPS
+black_list = _dispatch.AMP_BLACK_OPS
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    target = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
+    added_w, added_b = set(), set()
+    if custom_white_list:
+        for op in custom_white_list:
+            if op not in _dispatch.AMP_WHITE_OPS:
+                _dispatch.AMP_WHITE_OPS.add(op)
+                added_w.add(op)
+    if custom_black_list:
+        for op in custom_black_list:
+            if op not in _dispatch.AMP_BLACK_OPS:
+                _dispatch.AMP_BLACK_OPS.add(op)
+                added_b.add(op)
+    prev = _dispatch.set_amp_state(enable, target, level)
+    try:
+        yield
+    finally:
+        _dispatch.set_amp_state(prev["enabled"], prev["dtype"], prev["level"])
+        _dispatch.AMP_WHITE_OPS.difference_update(added_w)
+        _dispatch.AMP_BLACK_OPS.difference_update(added_b)
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the low-precision dtype; optimizers keep
+    float32 master weights (multi_precision)."""
+    if level == "O1":
+        return (models, optimizers) if optimizers is not None else models
+    target = "bfloat16" if dtype == "bfloat16" else "float16"
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        m.to(dtype=target)
+    if optimizers is not None:
+        opt_single = not isinstance(optimizers, (list, tuple))
+        opt_list = [optimizers] if opt_single else list(optimizers)
+        for opt in opt_list:
+            opt._multi_precision = True
+        ret_opt = opt_list[0] if opt_single else opt_list
+        return (model_list[0] if single else model_list), ret_opt
+    return model_list[0] if single else model_list
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: amp/grad_scaler.py:26).  With
+    bfloat16 on TPU scaling is unnecessary; pass enable=False (default
+    behavior matches float16 semantics when enabled)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss: Tensor) -> Tensor:
+        if not self._enable:
+            return loss
+        from ..core.dispatch import dispatch as D
+
+        return D("scale", loss, scale=self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        self._found_inf = False
+        with no_grad():
+            for p in optimizer._parameters:
+                if p.grad is not None:
+                    g = p.grad._data.astype(jnp.float32) * inv
+                    if not bool(jnp.all(jnp.isfinite(g))):
+                        self._found_inf = True
+                    p.grad = Tensor(g)
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def update(self):
+        if not self._enable or not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+        self.update()
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good": self._good_steps,
+                "bad": self._bad_steps}
+
+    def set_state_dict(self, st):
+        self._scale = st.get("scale", self._scale)
+        self._good_steps = st.get("good", 0)
+        self._bad_steps = st.get("bad", 0)

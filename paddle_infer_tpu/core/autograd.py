@@ -1,0 +1,407 @@
+"""Eager autograd engine.
+
+A queue-based backward walk over a GradNode DAG with per-(node, slot) gradient
+accumulation and dependency counting — the same execution semantics as the
+reference's ``egr::RunBackward`` (paddle/fluid/eager/backward.cc:105) and
+``GradNodeBase`` / ``GradTensorHolder`` (paddle/fluid/eager/grad_node_info.h:168,
+grad_tensor_holder.h), re-built for XLA: every backward rule is a composition of
+registry ops, so each grad computation is itself a jitted XLA computation, and
+``create_graph=True`` simply re-enters the dispatcher to tape higher-order nodes.
+
+Also provides ``paddle.grad``-style selective gradients (reference
+``GeneralGrad``, paddle/fluid/eager/general_grad.h) via reachability pruning.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict, deque
+from typing import List, Optional, Sequence
+
+from .tensor import Tensor
+
+_state = threading.local()
+
+
+def grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def _set_grad_enabled(flag: bool):
+    _state.grad_enabled = flag
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording."""
+
+    def __enter__(self):
+        self._prev = grad_enabled()
+        _set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        _set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        self._prev = grad_enabled()
+        _set_grad_enabled(True)
+        return self
+
+
+class set_grad_enabled(no_grad):
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = grad_enabled()
+        _set_grad_enabled(self._mode)
+        return self
+
+
+class Edge:
+    """Connection from a consumer GradNode's input slot back to its producer.
+
+    ``node`` is the producer GradNode (None for leaves); ``slot`` is which
+    output of the producer the tensor was; ``leaf`` is the leaf Tensor to
+    accumulate into (None for interior edges).  ``tref`` weakly references the
+    forward tensor so hooks registered *after* the op was taped still fire
+    (hooks are read at backward time, not captured at dispatch time).
+    """
+
+    __slots__ = ("node", "slot", "leaf", "tref", "meta")
+
+    def __init__(self, node, slot, leaf, tref, meta):
+        self.node = node
+        self.slot = slot
+        self.leaf = leaf          # strong ref for .grad accumulation
+        self.tref = tref          # weakref.ref to the forward tensor (or None)
+        self.meta = meta          # (shape tuple, dtype) of the forward tensor
+
+
+class GradNode:
+    """One recorded op application.
+
+    ``grad_fn(ctx, *output_grads) -> tuple(input_grads)`` where input_grads
+    align 1:1 with the op's tensor inputs (None where no grad flows).
+    """
+
+    __slots__ = ("op_name", "grad_fn", "ctx", "input_edges", "out_metas",
+                 "out_tensors", "released")
+
+    def __init__(self, op_name, grad_fn, ctx, input_edges, out_metas):
+        self.op_name = op_name
+        self.grad_fn = grad_fn
+        self.ctx = ctx
+        self.input_edges: List[Edge] = input_edges
+        self.out_metas = out_metas            # [(shape, dtype)] per output slot
+        self.out_tensors = []                 # weakrefs for retain_grads
+        self.released = False
+
+    def __repr__(self):
+        return f"<GradNode {self.op_name}>"
+
+
+def _zeros_like_meta(meta):
+    import jax.numpy as jnp
+
+    shape, dt = meta
+    return Tensor(jnp.zeros(shape, dtype=dt))
+
+
+def _accumulate(holder, node, slot, grad: Tensor, create_graph=False):
+    key = (id(node), slot)
+    prev = holder.get(key)
+    if prev is None:
+        holder[key] = (node, slot, grad)
+    else:
+        from . import dispatch
+
+        with set_grad_enabled(create_graph):
+            summed = dispatch.dispatch("add", prev[2], grad)
+        holder[key] = (node, slot, summed)
+
+
+def _apply_hooks(edge: Edge, grad: Tensor) -> Tensor:
+    t = None
+    if edge.leaf is not None:
+        t = edge.leaf
+    elif edge.tref is not None:
+        t = edge.tref()
+    if t is not None and t._hooks:
+        for hook in t._hooks:
+            if hook is None:
+                continue
+            out = hook(grad)
+            if out is not None:
+                grad = out
+    return grad
+
+
+def _leaf_accumulate(leaf: Tensor, grad: Tensor, create_graph=False):
+    from . import dispatch
+
+    if leaf.grad is None:
+        leaf.grad = grad.detach() if grad._grad_node is None else grad
+    else:
+        with set_grad_enabled(create_graph):
+            leaf.grad = dispatch.dispatch("add", leaf.grad, grad)
+
+
+def _discover(roots: Sequence[GradNode], stop_nodes=None):
+    """BFS over the grad graph; returns per-node dependency (consumer) counts."""
+    dep = defaultdict(int)
+    seen = set()
+    queue = deque(roots)
+    seen.update(id(r) for r in roots)
+    nodes = {id(r): r for r in roots}
+    while queue:
+        node = queue.popleft()
+        if stop_nodes is not None and id(node) in stop_nodes:
+            continue
+        for edge in node.input_edges:
+            if edge.node is None:
+                continue
+            dep[id(edge.node)] += 1
+            if id(edge.node) not in seen:
+                seen.add(id(edge.node))
+                nodes[id(edge.node)] = edge.node
+                queue.append(edge.node)
+    return nodes, dep
+
+
+def _reachable_to(targets: Sequence[GradNode], all_nodes) -> set:
+    """IDs of nodes from which some target node is reachable (inverse walk)."""
+    # Build forward adjacency: producer -> consumers
+    consumers = defaultdict(list)
+    for node in all_nodes.values():
+        for edge in node.input_edges:
+            if edge.node is not None:
+                consumers[id(edge.node)].append(id(node))
+    # targets reachable: walk from targets along consumers (i.e. nodes "above")
+    reach = set()
+    queue = deque(id(t) for t in targets)
+    while queue:
+        nid = queue.popleft()
+        if nid in reach:
+            continue
+        reach.add(nid)
+        for c in consumers[nid]:
+            queue.append(c)
+    return reach
+
+
+def run_backward(tensors: Sequence[Tensor], grad_tensors: Sequence[Optional[Tensor]],
+                 retain_graph: bool = False, create_graph: bool = False,
+                 inputs: Optional[Sequence[Tensor]] = None,
+                 allow_unused: bool = False,
+                 accumulate_into_leaves: bool = True):
+    """Core engine. If ``inputs`` given, returns grads for exactly those tensors
+    (paddle.grad semantics); otherwise accumulates into all reachable leaves.
+    """
+    import jax.numpy as jnp
+    from . import dispatch
+
+    holder = {}
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._grad_node is None:
+            raise RuntimeError("backward() on a tensor that requires no grad")
+        if g is None:
+            g = Tensor(jnp.ones(tuple(t.shape), dtype=t.dtype))
+        elif not isinstance(g, Tensor):
+            g = Tensor(g)
+        node = t._grad_node
+        if node is None:
+            # Leaf: gradient flows straight into .grad / result.
+            if inputs is not None:
+                holder[("leaf", id(t))] = (None, 0, g)
+            else:
+                _leaf_accumulate(t, g)
+            continue
+        _accumulate(holder, node, t._out_slot, g, create_graph)
+        roots.append(node)
+
+    # Target bookkeeping for paddle.grad-style calls.
+    input_ids = None
+    input_results = None
+    input_slot_map = {}   # (id(producer_node), slot) -> input index
+    if inputs is not None:
+        input_ids = {id(t): i for i, t in enumerate(inputs)}
+        input_results = [None] * len(inputs)
+        for i, t in enumerate(inputs):
+            if t._grad_node is not None:
+                input_slot_map[(id(t._grad_node), t._out_slot)] = i
+
+    nodes, dep = _discover(roots)
+
+    prune = None
+    if inputs is not None:
+        # GeneralGrad pruning (reference general_grad.h): a node must run iff
+        # it (transitively) contributes gradient to one of `inputs`.  Direct
+        # contributors have an edge to an input leaf or to the producer slot
+        # of a non-leaf input; the property propagates to their consumers.
+        direct = []
+        for node in nodes.values():
+            for e in node.input_edges:
+                if e.leaf is not None and id(e.leaf) in input_ids:
+                    direct.append(node)
+                    break
+                if e.node is not None and (id(e.node), e.slot) in input_slot_map:
+                    direct.append(node)
+                    break
+        prune = _reachable_to(direct, nodes)
+
+    ready = deque()
+    for node in roots:
+        if dep[id(node)] == 0:
+            ready.append(node)
+    # dedupe (a node may appear twice in roots)
+    seen_ready = set()
+    queue = deque()
+    for n in ready:
+        if id(n) not in seen_ready:
+            seen_ready.add(id(n))
+            queue.append(n)
+
+    processed = set()
+    while queue:
+        node = queue.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        if node.released:
+            raise RuntimeError(
+                f"Trying to run backward through {node!r} a second time; "
+                "set retain_graph=True if you need to.")
+
+        # Gather this node's output grads (zero-fill missing slots lazily).
+        out_grads = []
+        for slot, meta in enumerate(node.out_metas):
+            entry = holder.pop((id(node), slot), None)
+            out_grads.append(entry[2] if entry is not None else None)
+
+        run_this = prune is None or id(node) in prune or any(
+            e.leaf is not None and input_ids and id(e.leaf) in input_ids
+            for e in node.input_edges)
+
+        if run_this:
+            filled = [g if g is not None else _zeros_like_meta(m)
+                      for g, m in zip(out_grads, node.out_metas)]
+            if create_graph:
+                with enable_grad():
+                    in_grads = node.grad_fn(node.ctx, *filled)
+            else:
+                with no_grad():
+                    in_grads = node.grad_fn(node.ctx, *filled)
+            if not isinstance(in_grads, (tuple, list)):
+                in_grads = (in_grads,)
+            if len(in_grads) != len(node.input_edges):
+                raise RuntimeError(
+                    f"grad rule for {node.op_name} returned {len(in_grads)} "
+                    f"grads for {len(node.input_edges)} inputs")
+
+            # retain_grads on interior tensors
+            for ref, slot_g in node.out_tensors:
+                t = ref()
+                if t is not None and t._retain_grads and slot_g < len(out_grads):
+                    g = out_grads[slot_g]
+                    if g is not None:
+                        _leaf_accumulate(t, g)
+
+            for edge, g in zip(node.input_edges, in_grads):
+                if g is None:
+                    continue
+                if not isinstance(g, Tensor):
+                    g = Tensor(g)
+                g = _apply_hooks(edge, g)
+                if edge.node is not None:
+                    key = (id(edge.node), edge.slot)
+                    if input_slot_map and key in input_slot_map:
+                        i = input_slot_map[key]
+                        if input_results[i] is None:
+                            input_results[i] = g
+                        else:
+                            with set_grad_enabled(create_graph):
+                                input_results[i] = dispatch.dispatch(
+                                    "add", input_results[i], g)
+                    _accumulate(holder, edge.node, edge.slot, g, create_graph)
+                elif edge.leaf is not None:
+                    leaf = edge.leaf
+                    if input_ids is not None and id(leaf) in input_ids:
+                        i = input_ids[id(leaf)]
+                        if input_results[i] is None:
+                            input_results[i] = g
+                        else:
+                            with set_grad_enabled(create_graph):
+                                input_results[i] = dispatch.dispatch(
+                                    "add", input_results[i], g)
+                        if not accumulate_into_leaves:
+                            continue
+                    if inputs is None or accumulate_into_leaves:
+                        if not leaf.stop_gradient:
+                            _leaf_accumulate(leaf, g, create_graph)
+
+        if not retain_graph and not create_graph:
+            node.ctx = None
+            node.released = True
+
+        for edge in node.input_edges:
+            if edge.node is None:
+                continue
+            dep[id(edge.node)] -= 1
+            if dep[id(edge.node)] == 0:
+                queue.append(edge.node)
+
+    if inputs is not None:
+        # leaf inputs that were also output roots
+        for t in inputs:
+            i = input_ids[id(t)]
+            entry = holder.pop(("leaf", id(t)), None)
+            if entry is not None:
+                g = entry[2]
+                if input_results[i] is None:
+                    input_results[i] = g
+                else:
+                    with set_grad_enabled(create_graph):
+                        input_results[i] = dispatch.dispatch(
+                            "add", input_results[i], g)
+        if not allow_unused:
+            for t, g in zip(inputs, input_results):
+                if g is None:
+                    raise RuntimeError(
+                        "One of the differentiated tensors appears to not have "
+                        "been used in the graph. Set allow_unused=True if this "
+                        "is the desired behavior.")
+        return input_results
+    return None
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """``paddle.grad`` equivalent (reference python/paddle/fluid/dygraph/base.py)."""
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+    return run_backward(outputs, grad_outputs, retain_graph=retain_graph,
+                        create_graph=create_graph, inputs=list(inputs),
+                        allow_unused=allow_unused,
+                        accumulate_into_leaves=False)

@@ -1,0 +1,366 @@
+"""Op registry + eager dispatcher.
+
+The registry plays the role of the reference's PHI kernel registry and yaml op
+specs (paddle/phi/core/kernel_registry.h:376, paddle/phi/api/yaml/ops.yaml):
+one ``OpDef`` per op with a pure-JAX ``impl`` (the "kernel" — always jitted, so
+eager ops execute as cached XLA executables) and an optional ``grad`` rule
+written in terms of registry ops on Tensors (the backward.yaml equivalent),
+which makes higher-order autograd work by re-entering the dispatcher.
+
+Dispatch path (the analog of reference §3.1 steps 2-5):
+  AMP autocast -> dtype promotion -> jitted impl -> wrap outputs -> tape GradNode.
+
+Per-op executables are cached by (op, static attrs) and then by input
+shape/dtype inside jax.jit — the XLA analog of KernelFactory's
+(backend, layout, dtype) KernelKey lookup.
+"""
+from __future__ import annotations
+
+import functools
+import weakref
+from typing import Callable, Dict, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .tensor import Tensor
+
+
+class OpDef(NamedTuple):
+    name: str
+    impl: Callable                  # (*jax_arrays, **attrs) -> array | tuple
+    grad: Optional[Callable]        # (ctx, *out_grad_tensors) -> tuple per input
+    save_inputs: bool               # whether grad rule needs forward inputs
+    save_outputs: bool              # whether grad rule needs forward outputs
+    jit: bool                       # jit the impl (disable for trivial/reshape)
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+_JIT_CACHE: Dict[tuple, Callable] = {}
+
+
+def register_op(name: str, *, save_inputs: bool = True, save_outputs: bool = False,
+                jit: bool = True):
+    """Register the forward impl (a pure jax function)."""
+
+    def deco(fn):
+        prev = _REGISTRY.get(name)
+        _REGISTRY[name] = OpDef(name, fn, prev.grad if prev else None,
+                                save_inputs, save_outputs, jit)
+        return fn
+
+    return deco
+
+
+def register_grad(name: str):
+    """Register the backward rule for an op.
+
+    Signature: ``grad_fn(ctx, *output_grads) -> grads`` where ``grads`` aligns
+    with the op's Tensor inputs (None allowed).  ``ctx`` exposes ``.inputs``
+    (saved forward input Tensors), ``.outputs`` (saved outputs), ``.attrs``.
+    """
+
+    def deco(fn):
+        op = _REGISTRY.get(name)
+        if op is None:
+            _REGISTRY[name] = OpDef(name, None, fn, True, False, True)
+        else:
+            _REGISTRY[name] = op._replace(grad=fn)
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    return _REGISTRY[name]
+
+
+def has_op(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def op_names():
+    return sorted(_REGISTRY)
+
+
+class GradCtx:
+    """Saved state for a backward rule (reference: TensorWrapper saves in
+    generated GradNode classes, eager/tensor_wrapper.h)."""
+
+    __slots__ = ("inputs", "outputs", "attrs", "saved")
+
+    def __init__(self, inputs, outputs, attrs):
+        self.inputs = inputs      # tuple of Tensors (detached-graph-safe refs)
+        self.outputs = outputs    # tuple of Tensors or None
+        self.attrs = attrs        # dict
+        self.saved = {}
+
+
+def _freeze_attrs(attrs: dict):
+    items = []
+    for k in sorted(attrs):
+        v = attrs[k]
+        if isinstance(v, list):
+            v = tuple(v)
+        items.append((k, v))
+    return tuple(items)
+
+
+def _get_jitted(op: OpDef, frozen_attrs):
+    key = (op.name, frozen_attrs)
+    fn = _JIT_CACHE.get(key)
+    if fn is None:
+        attrs = dict(frozen_attrs)
+        impl = functools.partial(op.impl, **attrs) if attrs else op.impl
+        fn = jax.jit(impl) if op.jit else impl
+        _JIT_CACHE[key] = fn
+    return fn
+
+
+# ---------------------------------------------------------------- AMP state
+# (reference: paddle/fluid/imperative/amp_auto_cast.h:45 AmpOperators lists)
+_amp_state = {"enabled": False, "dtype": None, "level": "O1"}
+
+AMP_WHITE_OPS = {
+    "matmul", "conv2d", "conv2d_transpose", "einsum", "bmm", "mm",
+    "flash_attention", "depthwise_conv2d", "addmm",
+}
+AMP_BLACK_OPS = {
+    "exp", "log", "softmax", "log_softmax", "cross_entropy",
+    "softmax_with_cross_entropy", "mean", "sum", "norm", "layer_norm",
+    "batch_norm", "cumsum", "pow", "rsqrt", "sigmoid_cross_entropy_with_logits",
+    "erf", "logsumexp",
+}
+
+
+def amp_enabled():
+    return _amp_state["enabled"]
+
+
+def amp_attrs():
+    return dict(_amp_state)
+
+
+def set_amp_state(enabled, dtype=None, level="O1"):
+    prev = dict(_amp_state)
+    _amp_state["enabled"] = enabled
+    _amp_state["dtype"] = dtype
+    _amp_state["level"] = level
+    return prev
+
+
+def _amp_cast_arrays(name, arrays):
+    if not _amp_state["enabled"]:
+        return arrays
+    target = _amp_state["dtype"] or jnp.bfloat16
+    level = _amp_state["level"]
+    floating = [a for a in arrays if jnp.issubdtype(a.dtype, jnp.floating)]
+    if not floating:
+        return arrays
+    if name in AMP_BLACK_OPS:
+        cast_to = jnp.float32
+    elif name in AMP_WHITE_OPS or level == "O2":
+        cast_to = target
+    else:
+        return arrays
+    return [a.astype(cast_to) if jnp.issubdtype(a.dtype, jnp.floating) else a
+            for a in arrays]
+
+
+# ------------------------------------------------------------------ dispatch
+
+def _shadow(t: Tensor, arr) -> Tensor:
+    """View of ``t`` with a different payload but the same tape linkage."""
+    s = Tensor(arr, stop_gradient=t.stop_gradient)
+    s._grad_node = t._grad_node
+    s._out_slot = t._out_slot
+    s._hooks = t._hooks
+    return s
+
+
+def dispatch(name: str, *inputs, **attrs):
+    """Run one eager op: Tensors in, Tensor(s) out, tape recorded."""
+    op = _REGISTRY[name]
+
+    tensors = []
+    arrays = []
+    for x in inputs:
+        if isinstance(x, Tensor):
+            tensors.append(x)
+            arrays.append(x._data)
+        elif x is None:
+            tensors.append(None)
+            arrays.append(None)
+        else:
+            t = Tensor(jnp.asarray(x))
+            tensors.append(t)
+            arrays.append(t._data)
+
+    cast_arrays = _amp_cast_arrays(name, arrays)
+    saved_tensors = tensors
+    if cast_arrays is not arrays:
+        # Keep grad rules dtype-consistent with the actual compute: save the
+        # cast payloads, preserving each tensor's tape linkage (shadow view).
+        # Edges still use the originals so leaf grads land on the real params.
+        saved_tensors = [
+            _shadow(t, a) if t is not None and a is not t._data else t
+            for t, a in zip(tensors, cast_arrays)]
+        arrays = cast_arrays
+
+    frozen = _freeze_attrs(attrs)
+    fn = _get_jitted(op, frozen)
+    out_arrays = fn(*arrays)
+
+    multi = isinstance(out_arrays, (tuple, list))
+    outs_raw = list(out_arrays) if multi else [out_arrays]
+
+    requires_grad = (
+        autograd.grad_enabled()
+        and op.grad is not None
+        and any(t is not None and (not t.stop_gradient or t._grad_node is not None)
+                for t in tensors)
+    )
+
+    outs = [Tensor(a, stop_gradient=not requires_grad) if a is not None else None
+            for a in outs_raw]
+
+    if requires_grad:
+        saved_in = (tuple(saved_tensors) if op.save_inputs
+                    else tuple([None] * len(tensors)))
+        saved_out = tuple(outs) if op.save_outputs else None
+        ctx = GradCtx(saved_in, saved_out, dict(attrs))
+
+        edges = []
+        for t in tensors:
+            if t is None or (t.stop_gradient and t._grad_node is None):
+                edges.append(autograd.Edge(None, 0, None, None, None))
+            elif t._grad_node is not None:
+                edges.append(autograd.Edge(t._grad_node, t._out_slot, None,
+                                           weakref.ref(t),
+                                           (tuple(t.shape), t.dtype)))
+            else:
+                edges.append(autograd.Edge(None, 0, t, None,
+                                           (tuple(t.shape), t.dtype)))
+
+        out_metas = [(tuple(o.shape), o.dtype) if o is not None else ((), jnp.float32)
+                     for o in outs]
+        node = autograd.GradNode(name, op.grad, ctx, edges, out_metas)
+        for slot, o in enumerate(outs):
+            if o is None:
+                continue
+            o._grad_node = node
+            o._out_slot = slot
+            node.out_tensors.append((weakref.ref(o), slot))
+
+    if multi:
+        return tuple(outs)
+    return outs[0]
+
+
+def raw(name: str, *arrays, **attrs):
+    """Call an op impl directly on jax arrays (no Tensor wrap, no tape).
+
+    This is the building block the jit/compile path uses.
+    """
+    op = _REGISTRY[name]
+    return op.impl(*arrays, **attrs)
+
+
+_VJP_CACHE: Dict[tuple, Callable] = {}
+
+
+def register_vjp_grad(name: str):
+    """Register an automatic backward rule derived with jax.vjp on the impl.
+
+    The analog of the reference's generated GradNodes for ops whose backward
+    is just "the transpose of the forward" — XLA derives and fuses it.  The
+    vjp recomputes the forward (rematerialisation), trading FLOPs for memory
+    exactly like ``jax.checkpoint``.  Note: rules registered this way don't
+    support create_graph (higher-order); hand-written rules do.
+    """
+    op = _REGISTRY[name]
+
+    def grad_fn(ctx, *gouts):
+        arrays = tuple(t._data if t is not None else None for t in ctx.inputs)
+        frozen = _freeze_attrs(ctx.attrs)
+        key = (name, frozen)
+        bwd = _VJP_CACHE.get(key)
+        if bwd is None:
+            impl = functools.partial(op.impl, **dict(frozen)) if frozen else op.impl
+
+            def bwd_fn(in_arrays, gout_arrays):
+                # Only differentiate w.r.t. inexact (float/complex) inputs;
+                # int/bool inputs get a None grad slot.
+                diff_idx = [i for i, a in enumerate(in_arrays)
+                            if a is not None
+                            and jnp.issubdtype(a.dtype, jnp.inexact)]
+
+                def closed(*diff_args):
+                    full = list(in_arrays)
+                    for i, a in zip(diff_idx, diff_args):
+                        full[i] = a
+                    return impl(*full)
+
+                out, vjp = jax.vjp(closed, *(in_arrays[i] for i in diff_idx))
+                if not isinstance(out, (tuple, list)):
+                    gout_arrays = gout_arrays[0].astype(out.dtype)
+                else:
+                    gout_arrays = tuple(
+                        g.astype(o.dtype) for g, o in zip(gout_arrays, out))
+                diff_grads = vjp(gout_arrays)
+                full_grads = [None] * len(in_arrays)
+                for i, g in zip(diff_idx, diff_grads):
+                    full_grads[i] = g
+                return full_grads
+
+            bwd = jax.jit(bwd_fn)
+            _VJP_CACHE[key] = bwd
+        gout_arrays = tuple(g._data for g in gouts)
+        gins = bwd(arrays, gout_arrays)
+        out = []
+        for g in gins:
+            # Integer/bool inputs get float0 grads from jax.vjp -> no grad.
+            if g is None or g.dtype == jax.dtypes.float0:
+                out.append(None)
+            else:
+                out.append(Tensor(g))
+        return tuple(out)
+
+    _REGISTRY[name] = _REGISTRY[name]._replace(grad=grad_fn)
+    return grad_fn
+
+
+def defop(name: str, *, vjp: bool = True, save_outputs: bool = False, jit: bool = True):
+    """One-stop registration: impl + auto-vjp backward."""
+
+    def deco(fn):
+        register_op(name, save_outputs=save_outputs, jit=jit)(fn)
+        if vjp:
+            register_vjp_grad(name)
+        return fn
+
+    return deco
+
+
+# --------------------------------------------------------- grad rule helpers
+
+def unbroadcast(grad: Tensor, shape) -> Tensor:
+    """Sum-reduce ``grad`` down to ``shape`` (inverse of numpy broadcasting).
+
+    Built from registry ops so it stays differentiable for create_graph.
+    """
+    shape = tuple(shape)
+    gshape = tuple(grad.shape)
+    if gshape == shape:
+        return grad
+    ndiff = len(gshape) - len(shape)
+    axes = list(range(ndiff))
+    for i, (gs, s) in enumerate(zip(gshape[ndiff:], shape)):
+        if s == 1 and gs != 1:
+            axes.append(i + ndiff)
+    if axes:
+        grad = dispatch("sum", grad, axis=tuple(axes), keepdim=False)
+    if tuple(grad.shape) != shape:
+        grad = dispatch("reshape", grad, shape=shape)
+    return grad

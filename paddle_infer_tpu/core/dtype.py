@@ -1,0 +1,84 @@
+"""Dtype registry for paddle_infer_tpu.
+
+TPU-first dtype policy: float32 is the default parameter dtype, bfloat16 is the
+compute dtype under AMP (the MXU-native 16-bit type).  Mirrors the dtype surface
+of the reference's ``phi/common/data_type.h`` but maps directly onto numpy/XLA
+dtypes instead of an enum.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical name -> numpy dtype
+_DTYPE_TABLE = {
+    "bool": np.dtype(np.bool_),
+    "uint8": np.dtype(np.uint8),
+    "int8": np.dtype(np.int8),
+    "int16": np.dtype(np.int16),
+    "int32": np.dtype(np.int32),
+    "int64": np.dtype(np.int64),
+    "float16": np.dtype(np.float16),
+    "bfloat16": jnp.bfloat16.dtype,
+    "float32": np.dtype(np.float32),
+    "float64": np.dtype(np.float64),
+    "complex64": np.dtype(np.complex64),
+    "complex128": np.dtype(np.complex128),
+}
+
+bool_ = _DTYPE_TABLE["bool"]
+uint8 = _DTYPE_TABLE["uint8"]
+int8 = _DTYPE_TABLE["int8"]
+int16 = _DTYPE_TABLE["int16"]
+int32 = _DTYPE_TABLE["int32"]
+int64 = _DTYPE_TABLE["int64"]
+float16 = _DTYPE_TABLE["float16"]
+bfloat16 = _DTYPE_TABLE["bfloat16"]
+float32 = _DTYPE_TABLE["float32"]
+float64 = _DTYPE_TABLE["float64"]
+complex64 = _DTYPE_TABLE["complex64"]
+complex128 = _DTYPE_TABLE["complex128"]
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGRAL = {uint8, int8, int16, int32, int64}
+
+_default_dtype = float32
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalise a user-provided dtype (str / np.dtype / jnp type) to np.dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _DTYPE_TABLE:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+        return _DTYPE_TABLE[dtype]
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    dtype = convert_dtype(dtype)
+    for name, d in _DTYPE_TABLE.items():
+        if d == dtype:
+            return name
+    return str(dtype)
+
+
+def is_floating(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOATING
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in _INTEGRAL
+
+
+def get_default_dtype() -> np.dtype:
+    return _default_dtype
+
+
+def set_default_dtype(dtype) -> None:
+    global _default_dtype
+    dtype = convert_dtype(dtype)
+    if dtype not in _FLOATING:
+        raise ValueError("default dtype must be a floating dtype")
+    _default_dtype = dtype

@@ -1,0 +1,69 @@
+"""Global RNG state.
+
+Eager mode keeps a global PRNG key that is split per draw (the analog of the
+reference's global generator, paddle/fluid/framework/generator.h).  Under
+trace/compile, callers push an explicit traced key (``trace_key_scope``) so
+randomness is functional and reproducible inside jit — the TPU-idiomatic
+version of paddle's per-op ``seed`` attributes.
+
+The distributed RNG tracker (reference fleet/layers/mpu/random.py
+``get_rng_state_tracker``) lives in distributed/random.py and builds on this.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+_state = threading.local()
+
+
+def _global():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.key(0)
+        _state.counter = 0
+    return _state
+
+
+def seed(n: int):
+    s = _global()
+    s.key = jax.random.key(int(n))
+    s.counter = 0
+    return n
+
+
+def next_key():
+    """Return a fresh PRNG key (from trace scope if active, else global)."""
+    s = _global()
+    stack = getattr(s, "trace_stack", None)
+    if stack:
+        base, counter = stack[-1]
+        stack[-1] = (base, counter + 1)
+        return jax.random.fold_in(base, counter)
+    s.key, sub = jax.random.split(s.key)
+    return sub
+
+
+@contextlib.contextmanager
+def trace_key_scope(key):
+    """Make ``next_key`` derive keys from ``key`` (a traced value) — used by
+    the compile path so dropout etc. stay functional under jit."""
+    s = _global()
+    if not hasattr(s, "trace_stack"):
+        s.trace_stack = []
+    s.trace_stack.append((key, 0))
+    try:
+        yield
+    finally:
+        s.trace_stack.pop()
+
+
+def get_state():
+    s = _global()
+    return jax.random.key_data(s.key)
+
+
+def set_state(data):
+    s = _global()
+    s.key = jax.random.wrap_key_data(data)

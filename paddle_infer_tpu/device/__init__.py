@@ -1,0 +1,49 @@
+"""Device API (reference: python/paddle/device/).
+
+On TPU there is one accelerator backend; 'tpu', 'cpu' map to jax platforms.
+"""
+from __future__ import annotations
+
+import jax
+
+_current = None
+
+
+def set_device(device: str):
+    global _current
+    dev = device.split(":")[0]
+    if dev in ("gpu", "cuda"):
+        raise RuntimeError("paddle_infer_tpu targets TPU; no CUDA backend")
+    _current = dev
+    return dev
+
+
+def get_device() -> str:
+    if _current is not None:
+        return _current
+    plat = jax.default_backend()
+    return "tpu" if plat not in ("cpu",) else "cpu"
+
+
+def get_all_devices():
+    return [str(d) for d in jax.devices()]
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def synchronize():
+    """Block until all dispatched work completes (reference:
+    paddle.device.synchronize)."""
+    for d in jax.live_arrays():
+        d.block_until_ready()
+        break
+
+
+def cuda_device_count() -> int:
+    return 0

@@ -1,0 +1,41 @@
+"""Distributed layer (reference: python/paddle/distributed/ + paddle/fluid/distributed/).
+
+TPU-native design: parallelism = named mesh axes + shardings; collectives =
+XLA ops over ICI/DCN (see collective.py); the fleet facade orchestrates
+hybrid DP/TP/PP/sharding/EP/SP over one `jax.sharding.Mesh`.
+"""
+from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env)
+
+__all__ = ["ParallelEnv", "get_rank", "get_world_size", "init_parallel_env"]
+
+
+def __getattr__(name):
+    # lazy imports to avoid import cycles at package init
+    if name in ("new_group", "all_reduce", "all_gather", "broadcast",
+                "reduce", "scatter", "alltoall", "reduce_scatter", "send",
+                "recv", "barrier", "ReduceOp", "ProcessGroup", "wait"):
+        from . import collective
+
+        return getattr(collective, name)
+    if name == "fleet":
+        from . import fleet
+
+        return fleet
+    if name == "DataParallel":
+        from .data_parallel import DataParallel
+
+        return DataParallel
+    if name in ("DeviceMesh", "ProcessMesh", "get_mesh", "set_mesh"):
+        from . import mesh
+
+        return getattr(mesh, name)
+    if name == "launch":
+        from . import launch
+
+        return launch
+    if name == "spawn":
+        from .launch import spawn
+
+        return spawn
+    raise AttributeError(f"module 'paddle_infer_tpu.distributed' has no "
+                         f"attribute '{name}'")

@@ -1,0 +1,78 @@
+"""Distributed environment bootstrap (reference:
+python/paddle/distributed/parallel.py:104 init_parallel_env).
+
+On TPU there are two distribution regimes:
+  * single-process SPMD: one process drives all local chips through a Mesh —
+    world_size == number of mesh data-parallel shards, rank is a mesh coord;
+  * multi-host: ``jax.distributed.initialize`` (the coordination-service
+    equivalent of the reference's TCPStore rendezvous, tcp_store.h).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None):
+    """Multi-host init (reference init_parallel_env + TCPStore master).
+    Single-host SPMD needs no init; call only when PADDLE_TRAINERS/env or
+    explicit args indicate a multi-process job."""
+    global _initialized
+    if _initialized:
+        return
+    addr = coordinator_address or os.environ.get("PTI_COORDINATOR_ADDR") \
+        or os.environ.get("PADDLE_MASTER")
+    nproc = num_processes or _int_env("PTI_NUM_PROCESSES",
+                                      _int_env("PADDLE_TRAINERS_NUM", None))
+    pid = process_id if process_id is not None else _int_env(
+        "PTI_PROCESS_ID", _int_env("PADDLE_TRAINER_ID", None))
+    if addr and nproc and nproc > 1:
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nproc, process_id=pid)
+    _initialized = True
+
+
+def _int_env(name, default):
+    v = os.environ.get(name)
+    return int(v) if v is not None else default
+
+
+def get_rank() -> int:
+    """Process index (multi-host) — for in-mesh data-parallel rank use
+    the topology helper (fleet.base.topology equivalent)."""
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    env = os.environ.get("PTI_DP_WORLD_SIZE")
+    if env is not None:
+        return int(env)
+    return jax.process_count()
+
+
+class ParallelEnv:
+    """reference: python/paddle/fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return get_rank()

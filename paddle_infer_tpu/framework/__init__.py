@@ -1,0 +1,7 @@
+"""Framework utilities: save/load, flags (reference:
+python/paddle/framework/io.py, paddle/fluid/platform/flags.cc)."""
+from .io import save, load, save_state_dict, load_state_dict
+from .flags import set_flags, get_flags, flags
+
+__all__ = ["save", "load", "save_state_dict", "load_state_dict",
+           "set_flags", "get_flags", "flags"]

@@ -1,0 +1,81 @@
+"""Exported-flags registry (reference: paddle/fluid/platform/flags.cc
+PADDLE_DEFINE_EXPORTED_* + GetMutableExportedFlagInfoMap; Python surface
+paddle.set_flags/get_flags).
+
+Flags are overridable via environment variables ``FLAGS_<name>``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "doc")
+
+    def __init__(self, name, default, doc):
+        self.name = name
+        self.default = default
+        self.doc = doc
+        env = os.environ.get(f"FLAGS_{name}")
+        if env is not None:
+            self.value = _parse(env, default)
+        else:
+            self.value = default
+
+
+def _parse(s: str, like: Any):
+    if isinstance(like, bool):
+        return s.lower() in ("1", "true", "yes")
+    if isinstance(like, int):
+        return int(s)
+    if isinstance(like, float):
+        return float(s)
+    return s
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default, doc: str = ""):
+    if name not in _REGISTRY:
+        _REGISTRY[name] = _Flag(name, default, doc)
+    return _REGISTRY[name]
+
+
+def set_flags(flags_dict: Dict[str, Any]):
+    for k, v in flags_dict.items():
+        k = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        if k not in _REGISTRY:
+            define_flag(k, v)
+        else:
+            _REGISTRY[k].value = v
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for k in names:
+        key = k[len("FLAGS_"):] if k.startswith("FLAGS_") else k
+        if key in _REGISTRY:
+            out[k] = _REGISTRY[key].value
+    return out
+
+
+def flags(name: str, default=None):
+    """Read a flag value (registering it on first use)."""
+    if name not in _REGISTRY:
+        define_flag(name, default)
+    return _REGISTRY[name].value
+
+
+# Core flags (counterparts of the reference's most-used ones)
+define_flag("check_nan_inf", False,
+            "check every op output for NaN/Inf (reference "
+            "framework/operator.cc:1465 FLAGS_check_nan_inf)")
+define_flag("benchmark", False, "sync after ops for timing")
+define_flag("eager_jit_ops", True,
+            "jit-compile per-op eager executions (XLA)")
+define_flag("use_pallas_attention", True,
+            "use the Pallas flash-attention kernel under jit on TPU")

@@ -1,0 +1,52 @@
+"""Checkpoint save/load (reference: python/paddle/framework/io.py:646,876
+paddle.save/paddle.load — pickled state dicts).
+
+Format: a pickle of {key: np.ndarray | scalar | nested dict/list}.  Tensors
+are converted to numpy on save and restored as numpy on load (callers pass
+them to ``set_state_dict`` / ``set_value`` which re-device them) — the same
+contract as paddle.save/load.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+
+def _to_saveable(obj: Any):
+    from ..core.tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._data)
+    if hasattr(obj, "dtype") and hasattr(obj, "shape") and not isinstance(
+            obj, np.ndarray):
+        return np.asarray(obj)  # jax array
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj) if type(obj) in (list, tuple) else list
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = True):
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def save_state_dict(state_dict, path):
+    save(state_dict, path)
+
+
+def load_state_dict(path):
+    return load(path)

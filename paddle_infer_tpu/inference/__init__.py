@@ -1,0 +1,6 @@
+"""Inference engine (reference: paddle/fluid/inference/ — AnalysisPredictor,
+AnalysisConfig).  See predictor.py / config.py."""
+from .config import Config
+from .predictor import Predictor, create_predictor
+
+__all__ = ["Config", "Predictor", "create_predictor"]

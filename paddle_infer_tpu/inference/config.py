@@ -1,0 +1,115 @@
+"""Inference Config (reference: paddle/fluid/inference/api/paddle_analysis_config.h
+AnalysisConfig — the 100+-option struct).  TPU-relevant options kept; CUDA/
+TRT/Lite toggles map to their XLA equivalents or are accepted no-ops for
+API compatibility."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Bfloat16 = "bfloat16"
+    Half = "float16"
+    Int8 = "int8"
+
+
+@dataclass
+class Config:
+    """Create with model path prefix (the jit.save export) or program+params
+    files, mirroring AnalysisConfig's constructors
+    (analysis_config.cc)."""
+
+    prog_file: Optional[str] = None
+    params_file: Optional[str] = None
+    model_dir: Optional[str] = None
+
+    # execution
+    _precision: str = PrecisionType.Float32
+    _memory_optim: bool = True
+    _enable_profile: bool = False
+    _glog_info: bool = False
+    _optim_cache_dir: Optional[str] = None
+
+    # decode/serving options (fork LLM feature bar)
+    _max_batch_size: int = 1
+    _kv_cache_block_size: int = 16
+    _weight_only_quant: Optional[str] = None  # None | "int8" | "int4"
+
+    _passes_disabled: set = field(default_factory=set)
+    _shape_range_info: dict = field(default_factory=dict)
+
+    def __init__(self, model=None, params=None):
+        if model is not None and params is None:
+            self.model_dir = model
+            self.prog_file = None
+            self.params_file = None
+        else:
+            self.model_dir = None
+            self.prog_file = model
+            self.params_file = params
+        self._precision = PrecisionType.Float32
+        self._memory_optim = True
+        self._enable_profile = False
+        self._glog_info = False
+        self._optim_cache_dir = None
+        self._max_batch_size = 1
+        self._kv_cache_block_size = 16
+        self._weight_only_quant = None
+        self._passes_disabled = set()
+        self._shape_range_info = {}
+
+    # --- paddle-compatible option surface ---------------------------------
+    def set_prog_file(self, path):
+        self.prog_file = path
+
+    def set_params_file(self, path):
+        self.params_file = path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        raise RuntimeError("paddle_infer_tpu runs on TPU; no GPU backend")
+
+    def enable_tpu(self, precision=PrecisionType.Bfloat16):
+        self._precision = precision
+
+    def disable_gpu(self):
+        pass
+
+    def enable_memory_optim(self):
+        self._memory_optim = True
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def set_optim_cache_dir(self, path):
+        self._optim_cache_dir = path
+
+    def delete_pass(self, name):
+        self._passes_disabled.add(name)
+
+    def enable_low_precision(self, precision=PrecisionType.Bfloat16):
+        self._precision = precision
+
+    def enable_weight_only_quant(self, algo="int8"):
+        self._weight_only_quant = algo
+
+    def set_max_batch_size(self, n):
+        self._max_batch_size = n
+
+    def precision(self):
+        return self._precision
+
+    def summary(self):
+        return (f"Config(model={self.model_dir or self.prog_file}, "
+                f"precision={self._precision}, "
+                f"weight_only={self._weight_only_quant})")

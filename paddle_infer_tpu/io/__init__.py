@@ -1,0 +1,21 @@
+"""Data pipeline (reference: python/paddle/io/, fluid/reader.py:275 DataLoader,
+fluid/dataloader/dataloader_iter.py multi-process workers).
+
+TPU-first: batches are assembled as numpy on host threads (keeping the Python
+GIL off the accelerator path) and transferred to device once per step;
+``prefetch`` pipelines host->HBM copies behind compute.  A native C++
+high-throughput feeder (native/datafeed) covers the reference's
+MultiSlotDataFeed role.
+"""
+from .dataset import Dataset, IterableDataset, TensorDataset, Subset, \
+    ComposeDataset, ChainDataset, random_split
+from .sampler import (Sampler, SequenceSampler, RandomSampler, BatchSampler,
+                      DistributedBatchSampler, WeightedRandomSampler)
+from .dataloader import DataLoader, default_collate_fn
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "Subset", "ComposeDataset",
+    "ChainDataset", "random_split", "Sampler", "SequenceSampler",
+    "RandomSampler", "BatchSampler", "DistributedBatchSampler",
+    "WeightedRandomSampler", "DataLoader", "default_collate_fn",
+]

@@ -1,0 +1,123 @@
+"""paddle_infer_tpu.jit — trace/compile + model export
+(reference: paddle.jit; save format analog of .pdmodel/.pdiparams:
+serialized StableHLO via jax.export + pickled weights).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .to_static import InputSpec, StaticFunction, not_to_static, to_static
+from . import trace  # noqa: F401
+
+__all__ = ["to_static", "not_to_static", "save", "load", "InputSpec",
+           "StaticFunction", "TranslatedLayer"]
+
+_MODEL_SUFFIX = ".ptimodel"      # serialized program (StableHLO)
+_PARAMS_SUFFIX = ".ptiparams"    # weights
+
+
+def save(layer, path, input_spec=None):
+    """Export a Layer (or StaticFunction) to the deployment format
+    (reference: paddle.jit.save, fluid/dygraph/jit.py:690 -> .pdmodel+.pdiparams).
+
+    Produces ``path + '.ptimodel'`` — a serialized, shape-specialized XLA
+    program (StableHLO via jax.export, loadable without the Python model
+    class) — and ``path + '.ptiparams'`` — pickled numpy weights.
+    """
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shapes to specialize)")
+    specs = [s if isinstance(s, InputSpec) else InputSpec(s.shape, str(s.dtype))
+             for s in input_spec]
+    shape_dtypes = [s.to_shape_dtype() for s in specs]
+
+    if isinstance(layer, Layer):
+        layer.eval()
+        fn = layer.forward if isinstance(layer.forward, StaticFunction) else None
+        params = {n: np.asarray(p._data) for n, p in layer.named_parameters()}
+        buffers = {n: np.asarray(b._data) for n, b in layer.named_buffers()}
+
+        def pure(params_in, buffers_in, *arrays):
+            named = dict(layer.named_parameters())
+            named_buf = dict(layer.named_buffers())
+            old = {n: p._data for n, p in named.items()}
+            old_buf = {n: b._data for n, b in named_buf.items()}
+            try:
+                for n, arr in params_in.items():
+                    named[n]._data = arr
+                for n, arr in buffers_in.items():
+                    named_buf[n]._data = arr
+                tensors = [Tensor(a) for a in arrays]
+                fwd = (layer.forward._fn if isinstance(layer.forward,
+                                                       StaticFunction)
+                       else layer.forward)
+                out = fwd(*tensors)
+            finally:
+                for n, arr in old.items():
+                    named[n]._data = arr
+                for n, arr in old_buf.items():
+                    named_buf[n]._data = arr
+            return jax.tree_util.tree_map(
+                lambda t: t._data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda x: isinstance(x, Tensor))
+    else:
+        raise TypeError("jit.save expects a Layer")
+
+    jitted = jax.jit(pure)
+    abstract_params = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                       for n, v in params.items()}
+    abstract_buffers = {n: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                        for n, v in buffers.items()}
+    exported = jax.export.export(jitted)(abstract_params, abstract_buffers,
+                                         *shape_dtypes)
+    blob = exported.serialize()
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + _MODEL_SUFFIX, "wb") as f:
+        f.write(blob)
+    with open(path + _PARAMS_SUFFIX, "wb") as f:
+        pickle.dump({"params": params, "buffers": buffers,
+                     "input_spec": [(s.shape, s.dtype) for s in specs]}, f,
+                    protocol=4)
+
+
+class TranslatedLayer(Layer):
+    """A loaded, compiled model (reference: paddle.jit.TranslatedLayer).
+    Holds the deserialized XLA program + weights; calling it runs the
+    program — no Python model code needed."""
+
+    def __init__(self, exported, params, buffers):
+        super().__init__()
+        self._exported = exported
+        self._params_np = params
+        self._buffers_np = buffers
+        self._device_params = None
+
+    def _materialize(self):
+        if self._device_params is None:
+            self._device_params = (
+                {n: jnp.asarray(v) for n, v in self._params_np.items()},
+                {n: jnp.asarray(v) for n, v in self._buffers_np.items()})
+        return self._device_params
+
+    def forward(self, *inputs):
+        params, buffers = self._materialize()
+        arrays = [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                  for x in inputs]
+        out = self._exported.call(params, buffers, *arrays)
+        return jax.tree_util.tree_map(
+            lambda a: Tensor(a) if hasattr(a, "shape") else a, out)
+
+
+def load(path) -> TranslatedLayer:
+    with open(path + _MODEL_SUFFIX, "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    with open(path + _PARAMS_SUFFIX, "rb") as f:
+        blob = pickle.load(f)
+    return TranslatedLayer(exported, blob["params"], blob["buffers"])

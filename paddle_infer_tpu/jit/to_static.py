@@ -1,0 +1,192 @@
+"""@to_static: trace-and-compile execution
+(replaces the reference's ProgramTranslator + InterpreterCore pipeline,
+python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py:1001 and
+paddle/fluid/framework/new_executor/interpretercore.h:39).
+
+A ``StaticFunction`` wraps a Layer method / function built from registry ops.
+On first call per input signature it traces the eager code under jax.jit into
+one XLA program (parameters + buffers become function inputs, buffer updates
+become extra outputs), then caches the compiled executable — the executable
+cache plays InterpreterCore's role; XLA's fusion pipeline plays the IR pass
+strategies' role.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as prandom
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from .trace import trace_scope
+
+
+class InputSpec:
+    """Shape/dtype spec (reference: python/paddle/static/input.py InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_shape_dtype(self):
+        from ..core.dtype import convert_dtype
+
+        shape = tuple(1 if s is None or s == -1 else s for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, convert_dtype(self.dtype))
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def _sig_of(x):
+    if isinstance(x, Tensor):
+        return ("T", tuple(x.shape), str(x.dtype))
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return ("A", tuple(x.shape), str(x.dtype))
+    return ("S", x)
+
+
+def _is_arraylike(x):
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+class StaticFunction:
+    """One compiled executable per input signature (the executable cache)."""
+
+    def __init__(self, fn: Callable, layer: Optional[Layer] = None,
+                 input_spec=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache = {}
+        try:
+            functools.update_wrapper(self, fn)
+        except AttributeError:
+            pass
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = StaticFunction(
+            self._fn.__get__(instance, owner),
+            layer=instance if isinstance(instance, Layer) else None,
+            input_spec=self._input_spec)
+        return bound
+
+    @property
+    def _detected_layer(self):
+        if self._layer is not None:
+            return self._layer
+        fn_self = getattr(self._fn, "__self__", None)
+        if isinstance(fn_self, Layer):
+            return fn_self
+        return None
+
+    def _build(self, static_kwargs):
+        layer = self._detected_layer
+        buffer_targets = []  # filled at trace time (identity of updated bufs)
+
+        def traced(params, buffers, key, arrays):
+            with trace_scope() as scope, prandom.trace_key_scope(key):
+                tensors = jax.tree_util.tree_map(
+                    lambda a: Tensor(a) if _is_arraylike(a) else a, arrays,
+                    is_leaf=_is_arraylike)
+                if layer is not None:
+                    named = dict(layer.named_parameters())
+                    named_buf = dict(layer.named_buffers())
+                    old = {n: p._data for n, p in named.items()}
+                    old_buf = {n: b._data for n, b in named_buf.items()}
+                    try:
+                        for n, arr in params.items():
+                            named[n]._data = arr
+                        for n, arr in buffers.items():
+                            if n in named_buf:
+                                named_buf[n]._data = arr
+                        out = self._fn(*tensors, **static_kwargs)
+                    finally:
+                        buffer_targets.clear()
+                        buffer_targets.extend(
+                            t for t, _ in scope.buffer_updates)
+                        update_arrays = [a for _, a in scope.buffer_updates]
+                        for n, arr in old.items():
+                            named[n]._data = arr
+                        for n, arr in old_buf.items():
+                            named_buf[n]._data = arr
+                else:
+                    out = self._fn(*tensors, **static_kwargs)
+                    buffer_targets.clear()
+                    buffer_targets.extend(t for t, _ in scope.buffer_updates)
+                    update_arrays = [a for _, a in scope.buffer_updates]
+                out_arrays = jax.tree_util.tree_map(
+                    lambda t: t._data if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda x: isinstance(x, Tensor))
+                return out_arrays, update_arrays
+
+        return jax.jit(traced), buffer_targets
+
+    def __call__(self, *args, **kwargs):
+        layer = self._detected_layer
+        arrays = []
+        for a in args:
+            if isinstance(a, Tensor):
+                arrays.append(a._data)
+            elif isinstance(a, (int, float, np.ndarray)) or _is_arraylike(a):
+                arrays.append(jnp.asarray(a))
+            else:
+                arrays.append(a)
+        training = layer.training if layer is not None else False
+        sig = (tuple(_sig_of(a) for a in args),
+               tuple(sorted((k, _sig_of(v)) for k, v in kwargs.items())),
+               training)
+        entry = self._cache.get(sig)
+        if entry is None:
+            entry = self._build(kwargs)
+            self._cache[sig] = entry
+        compiled, buffer_targets = entry
+
+        params = ({n: p._data for n, p in layer.named_parameters()}
+                  if layer else {})
+        buffers = ({n: b._data for n, b in layer.named_buffers()}
+                   if layer else {})
+        key = prandom.next_key()
+        out_arrays, update_arrays = compiled(params, buffers, key, arrays)
+
+        if update_arrays and len(buffer_targets) == len(update_arrays):
+            for t, arr in zip(buffer_targets, update_arrays):
+                t._data = arr
+
+        return jax.tree_util.tree_map(
+            lambda a: Tensor(a) if _is_arraylike(a) else a, out_arrays)
+
+    # introspection helpers (inference/export reuse these)
+    def get_concrete_program(self, *example_args, **kwargs):
+        """Trace and return (jitted_fn, params, buffers) for export."""
+        entry = self._build(kwargs)
+        return entry[0]
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True):
+    """Decorator/wrapper converting dygraph code to a compiled XLA program
+    (reference: paddle.jit.to_static, fluid/dygraph/jit.py)."""
+
+    def wrap(fn):
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(fn.forward, layer=fn,
+                                        input_spec=input_spec)
+            return fn
+        return StaticFunction(fn, input_spec=input_spec)
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn

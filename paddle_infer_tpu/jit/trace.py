@@ -1,0 +1,52 @@
+"""Trace state for the compile path.
+
+The reference separates dygraph from static graph with a program translator
+(python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py:1001).
+Here "static mode" is just: run the same eager Python under jax tracing and
+let jit cache the XLA executable.  This module tracks (a) whether we're
+inside a trace and (b) functional side-effects (buffer updates like BN
+running stats) so they become explicit outputs of the compiled program.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_state = threading.local()
+
+
+def in_tracing() -> bool:
+    return bool(getattr(_state, "stack", None))
+
+
+class TraceScope:
+    def __init__(self):
+        self.buffer_updates = []  # list of (Tensor, new_array)
+
+
+@contextlib.contextmanager
+def trace_scope():
+    if not hasattr(_state, "stack"):
+        _state.stack = []
+    scope = TraceScope()
+    _state.stack.append(scope)
+    try:
+        yield scope
+    finally:
+        _state.stack.pop()
+
+
+def current_scope():
+    stack = getattr(_state, "stack", None)
+    return stack[-1] if stack else None
+
+
+def update_buffer(tensor, new_array):
+    """Update a persistent buffer (e.g. BN running stats).  Eagerly this is
+    an in-place set_value; under trace it is recorded as a functional output
+    so the compiled program stays pure."""
+    scope = current_scope()
+    if scope is None:
+        tensor.set_value(new_array)
+    else:
+        scope.buffer_updates.append((tensor, new_array))

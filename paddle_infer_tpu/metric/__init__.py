@@ -1,0 +1,148 @@
+"""Metrics (reference: python/paddle/metric/metrics.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name="acc"):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label):
+        pred_np = np.asarray(pred)
+        label_np = np.asarray(label).reshape(-1)
+        maxk = max(self.topk)
+        top = np.argsort(-pred_np, axis=-1)[..., :maxk]
+        correct = (top == label_np[:, None])
+        return correct
+
+    def update(self, correct):
+        correct = np.asarray(correct)
+        for i, k in enumerate(self.topk):
+            self.total[i] += correct[..., :k].sum()
+            self.count[i] += correct.shape[0]
+        accs = self.total / np.maximum(self.count, 1)
+        return accs[0] if len(self.topk) == 1 else accs
+
+    def accumulate(self):
+        accs = self.total / np.maximum(self.count, 1)
+        return float(accs[0]) if len(self.topk) == 1 else accs.tolist()
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name="precision"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = (np.asarray(preds) > 0.5).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = (np.asarray(preds) > 0.5).astype(np.int64).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return float(self.tp) / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, num_thresholds=4095, name="auc"):
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds)
+        if preds.ndim == 2:
+            preds = preds[:, -1]
+        labels = np.asarray(labels).reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        for i, lbl in zip(idx, labels):
+            if lbl:
+                self._stat_pos[i] += 1
+            else:
+                self._stat_neg[i] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # trapezoid over thresholds, descending
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapezoid(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1):
+    """Functional accuracy (paddle.metric.accuracy)."""
+    import paddle_infer_tpu as pit
+
+    pred = np.asarray(input)
+    lbl = np.asarray(label).reshape(-1)
+    topk = np.argsort(-pred, axis=-1)[..., :k]
+    correct = (topk == lbl[:, None]).any(axis=-1).mean()
+    return pit.to_tensor(np.asarray(correct, dtype=np.float32))

@@ -1,0 +1,26 @@
+"""Model zoo (reference: python/paddle/vision/models/ + PaddleNLP model
+families the fork serves).  Flagship: ERNIE/BERT-base (bert.py)."""
+from .lenet import LeNet
+
+__all__ = ["LeNet"]
+
+
+def __getattr__(name):
+    if name in ("BertModel", "BertForSequenceClassification",
+                "BertForPretraining", "BertConfig", "ErnieModel"):
+        from . import bert
+
+        return getattr(bert, name)
+    if name in ("ResNet", "resnet18", "resnet50"):
+        from . import resnet
+
+        return getattr(resnet, name)
+    if name in ("LlamaModel", "LlamaForCausalLM", "LlamaConfig"):
+        from . import llama
+
+        return getattr(llama, name)
+    if name in ("GPTMoEModel", "MoEConfig"):
+        from . import gpt_moe
+
+        return getattr(gpt_moe, name)
+    raise AttributeError(name)

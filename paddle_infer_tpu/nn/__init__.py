@@ -1,0 +1,54 @@
+"""paddle_infer_tpu.nn — layers and functional API
+(reference: python/paddle/nn/)."""
+from .layer import Layer
+from . import functional
+from . import initializer
+from .layers_common import (  # noqa: F401
+    Linear, Conv1D, Conv2D, Conv2DTranspose, Embedding, Dropout, Dropout2D,
+    LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+    SyncBatchNorm, GroupNorm, InstanceNorm2D, MaxPool2D, AvgPool2D,
+    AdaptiveAvgPool2D, AdaptiveMaxPool2D, Flatten, Upsample, Pad2D,
+    Sequential, LayerList, ParameterList,
+    ReLU, ReLU6, GELU, Sigmoid, Tanh, Silu, Swish, Mish, LeakyReLU, ELU,
+    SELU, CELU, Softplus, Softsign, Hardswish, Hardsigmoid, Hardtanh,
+    Softmax, LogSoftmax, Hardshrink, Softshrink, Tanhshrink,
+    ThresholdedReLU, Maxout, GLU, PReLU,
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCEWithLogitsLoss, BCELoss,
+    SmoothL1Loss, KLDivLoss,
+)
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from ..core.tensor import Parameter  # noqa: F401
+
+
+class ParamAttr:
+    """Parameter attribute bundle (reference: python/paddle/fluid/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+def ClipGradByGlobalNorm(clip_norm):
+    from ..optimizer.clip import ClipGradByGlobalNorm as _C
+
+    return _C(clip_norm)
+
+
+def ClipGradByNorm(clip_norm):
+    from ..optimizer.clip import ClipGradByNorm as _C
+
+    return _C(clip_norm)
+
+
+def ClipGradByValue(max, min=None):
+    from ..optimizer.clip import ClipGradByValue as _C
+
+    return _C(max, min)

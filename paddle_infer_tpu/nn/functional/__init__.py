@@ -1,0 +1,553 @@
+"""Functional NN API (reference: python/paddle/nn/functional/).
+
+Norms, dropout and losses are *compositions* of taped primitive ops — eager
+autograd differentiates them for free and the compile path fuses them into
+single XLA computations (the TPU answer to the reference's hand-fused CUDA
+kernels like fused_bias_dropout_residual_layer_norm).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...core import random as prandom
+from ...core.dispatch import dispatch as D
+from ...core.tensor import Tensor
+
+# re-exported primitives ---------------------------------------------------
+
+
+def relu(x):
+    return D("relu", x)
+
+
+def relu6(x):
+    return D("relu6", x)
+
+
+def gelu(x, approximate=False):
+    return D("gelu", x, approximate=approximate)
+
+
+def sigmoid(x):
+    return D("sigmoid", x)
+
+
+def tanh(x):
+    return D("tanh", x)
+
+
+def silu(x):
+    return D("silu", x)
+
+
+def swish(x):
+    return D("swish", x)
+
+
+def mish(x):
+    return D("mish", x)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return D("leaky_relu", x, negative_slope=negative_slope)
+
+
+def elu(x, alpha=1.0):
+    return D("elu", x, alpha=alpha)
+
+
+def selu(x):
+    return D("selu", x)
+
+
+def celu(x, alpha=1.0):
+    return D("celu", x, alpha=alpha)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    return D("softplus", x, beta=beta, threshold=threshold)
+
+
+def softsign(x):
+    return D("softsign", x)
+
+
+def hardswish(x):
+    return D("hardswish", x)
+
+
+def hardsigmoid(x, slope=1 / 6, offset=0.5):
+    return D("hardsigmoid", x, slope=slope, offset=offset)
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return D("hardtanh", x, min=min, max=max)
+
+
+def hardshrink(x, threshold=0.5):
+    return D("hardshrink", x, threshold=threshold)
+
+
+def softshrink(x, threshold=0.5):
+    return D("softshrink", x, threshold=threshold)
+
+
+def tanhshrink(x):
+    return D("tanhshrink", x)
+
+
+def thresholded_relu(x, threshold=1.0):
+    return D("thresholded_relu", x, threshold=threshold)
+
+
+def maxout(x, groups, axis=1):
+    return D("maxout", x, groups=groups, axis=axis)
+
+
+def prelu(x, weight):
+    return D("prelu", x, weight)
+
+
+def glu(x, axis=-1):
+    return D("glu", x, axis=axis)
+
+
+def softmax(x, axis=-1):
+    return D("softmax", x, axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return D("log_softmax", x, axis=axis)
+
+
+def logit(x, eps=1e-8):
+    return D("logit", x, eps=eps)
+
+
+# linear / conv ------------------------------------------------------------
+
+
+def linear(x, weight, bias=None):
+    out = D("matmul", x, weight)
+    if bias is not None:
+        out = D("add", out, bias)
+    return out
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return D("conv2d", x, weight, bias,
+             stride=_t(stride), padding=_t(padding), dilation=_t(dilation),
+             groups=groups)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return D("conv1d", x, weight, bias,
+             stride=_t(stride), padding=_t(padding), dilation=_t(dilation),
+             groups=groups)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return D("conv3d", x, weight, bias,
+             stride=_t(stride), padding=_t(padding), dilation=_t(dilation),
+             groups=groups)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1):
+    return D("conv2d_transpose", x, weight, bias,
+             stride=_t(stride), padding=_t(padding),
+             output_padding=_t(output_padding), dilation=_t(dilation),
+             groups=groups)
+
+
+def _t(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else v
+
+
+# pooling ------------------------------------------------------------------
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    return D("max_pool2d", x, kernel_size=_t(kernel_size),
+             stride=_t(stride), padding=_t(padding), ceil_mode=ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               count_include_pad=True):
+    return D("avg_pool2d", x, kernel_size=_t(kernel_size), stride=_t(stride),
+             padding=_t(padding), ceil_mode=ceil_mode,
+             count_include_pad=count_include_pad)
+
+
+def adaptive_avg_pool2d(x, output_size):
+    return D("adaptive_avg_pool2d", x, output_size=_t(output_size))
+
+
+def adaptive_max_pool2d(x, output_size):
+    return D("adaptive_max_pool2d", x, output_size=_t(output_size))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    return D("unfold_im2col", x, kernel_sizes=_t(kernel_sizes),
+             strides=_t(strides), paddings=_t(paddings),
+             dilations=_t(dilations))
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False):
+    if (mode == "nearest" and scale_factor is not None
+            and float(_t(scale_factor)[0] if isinstance(_t(scale_factor), tuple)
+                      else scale_factor).is_integer()):
+        return D("interpolate_nearest", x, scale=_t(scale_factor))
+    if size is None:
+        h, w = x.shape[2], x.shape[3]
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else (
+            scale_factor, scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    return D("interpolate_resize", x, out_h=int(size[0]), out_w=int(size[1]),
+             method="nearest" if mode == "nearest" else "bilinear",
+             align_corners=align_corners)
+
+
+upsample = interpolate
+
+
+# embedding ----------------------------------------------------------------
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = D("gather", weight, x, axis=0)
+    if padding_idx is not None:
+        mask = D("cast", D("not_equal", x, padding_idx), dtype=str(out.dtype))
+        out = D("multiply", out, D("unsqueeze", mask, axis=-1))
+    return out
+
+
+def one_hot(x, num_classes):
+    return D("one_hot", x, num_classes=num_classes)
+
+
+# normalization ------------------------------------------------------------
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    mean = D("mean", x, axis=axes, keepdim=True)
+    diff = D("subtract", x, mean)
+    var = D("mean", D("multiply", diff, diff), axis=axes, keepdim=True)
+    inv = D("rsqrt", D("add", var, epsilon))
+    out = D("multiply", diff, inv)
+    if weight is not None:
+        out = D("multiply", out, weight)
+    if bias is not None:
+        out = D("add", out, bias)
+    return out
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    var = D("mean", D("multiply", x, x), axis=-1, keepdim=True)
+    out = D("multiply", x, D("rsqrt", D("add", var, epsilon)))
+    if weight is not None:
+        out = D("multiply", out, weight)
+    return out
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5):
+    """NCHW batch norm. In training mode returns (out, new_mean, new_var)
+    side-band via in-place update of the running stats tensors."""
+    reduce_axes = tuple(i for i in range(x.ndim) if i != 1)
+    bshape = tuple(1 if i != 1 else x.shape[1] for i in range(x.ndim))
+    if training:
+        mean = D("mean", x, axis=reduce_axes, keepdim=False)
+        diff = D("subtract", x, D("reshape", mean, shape=bshape))
+        var = D("mean", D("multiply", diff, diff), axis=reduce_axes,
+                keepdim=False)
+        if running_mean is not None:
+            from ...jit.trace import update_buffer
+
+            with _no_grad():
+                update_buffer(running_mean,
+                              momentum * running_mean._data
+                              + (1 - momentum) * mean._data)
+                update_buffer(running_var,
+                              momentum * running_var._data
+                              + (1 - momentum) * var._data)
+    else:
+        mean, var = running_mean, running_var
+        diff = D("subtract", x, D("reshape", mean, shape=bshape))
+    inv = D("rsqrt", D("add", D("reshape", var, shape=bshape), epsilon))
+    out = D("multiply", diff, inv)
+    if weight is not None:
+        out = D("multiply", out, D("reshape", weight, shape=bshape))
+    if bias is not None:
+        out = D("add", out, D("reshape", bias, shape=bshape))
+    return out
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5):
+    n, c = x.shape[0], x.shape[1]
+    spatial = tuple(x.shape[2:])
+    xg = D("reshape", x, shape=(n, num_groups, c // num_groups) + spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = D("mean", xg, axis=axes, keepdim=True)
+    diff = D("subtract", xg, mean)
+    var = D("mean", D("multiply", diff, diff), axis=axes, keepdim=True)
+    out = D("multiply", diff, D("rsqrt", D("add", var, epsilon)))
+    out = D("reshape", out, shape=tuple(x.shape))
+    bshape = (1, c) + (1,) * len(spatial)
+    if weight is not None:
+        out = D("multiply", out, D("reshape", weight, shape=bshape))
+    if bias is not None:
+        out = D("add", out, D("reshape", bias, shape=bshape))
+    return out
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = D("mean", x, axis=axes, keepdim=True)
+    diff = D("subtract", x, mean)
+    var = D("mean", D("multiply", diff, diff), axis=axes, keepdim=True)
+    out = D("multiply", diff, D("rsqrt", D("add", var, epsilon)))
+    bshape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = D("multiply", out, D("reshape", weight, shape=bshape))
+    if bias is not None:
+        out = D("add", out, D("reshape", bias, shape=bshape))
+    return out
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    nrm = D("norm", x, p=p, axis=axis, keepdim=True)
+    return D("divide", x, D("maximum", nrm, epsilon))
+
+
+def _no_grad():
+    from ...core.autograd import no_grad
+
+    return no_grad()
+
+
+# dropout ------------------------------------------------------------------
+
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", key=None):
+    if not training or p == 0.0:
+        return x
+    if p >= 1.0:
+        return D("multiply", x, 0.0)
+    if key is None:
+        key = prandom.next_key()
+    import jax
+
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(x.shape))
+    mask_t = Tensor(mask.astype(x._data.dtype if isinstance(x, Tensor)
+                                else jnp.float32))
+    if mode == "upscale_in_train":
+        return D("multiply", D("multiply", x, mask_t), 1.0 / keep)
+    return D("multiply", x, mask_t)
+
+
+def dropout2d(x, p=0.5, training=True, key=None):
+    if not training or p == 0.0:
+        return x
+    if key is None:
+        key = prandom.next_key()
+    import jax
+
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, (x.shape[0], x.shape[1], 1, 1))
+    mask_t = Tensor(mask.astype(x._data.dtype))
+    return D("multiply", D("multiply", x, mask_t), 1.0 / keep)
+
+
+# padding ------------------------------------------------------------------
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    if len(pad) == x.ndim * 2:
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # paddle convention: pad applies to last len(pad)//2 dims, given
+        # as (left, right, top, bottom) for NCHW
+        n = len(pad) // 2
+        pairs = [(0, 0)] * (x.ndim - n)
+        # reversed: last dim first in the flat list
+        trailing = [(pad[2 * i], pad[2 * i + 1]) for i in range(n)]
+        pairs.extend(reversed(trailing))
+    return D("pad", x, paddings=tuple(tuple(p) for p in pairs), mode=mode,
+             value=value)
+
+
+# losses -------------------------------------------------------------------
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True):
+    if use_softmax:
+        loss = D("softmax_with_cross_entropy", input, label,
+                 soft_label=soft_label, ignore_index=ignore_index, axis=axis)
+    else:
+        loss = D("nll_loss_op", D("log", input), label,
+                 ignore_index=ignore_index)
+        loss = D("unsqueeze", loss, axis=-1)
+    loss = D("squeeze", loss, axis=axis)
+    flat_label = label
+    if not soft_label and label.ndim == input.ndim:
+        flat_label = D("squeeze", label, axis=axis)
+    if weight is not None and not soft_label:
+        w = D("gather", weight, flat_label, axis=0)
+        loss = D("multiply", loss, w)
+    if reduction == "mean":
+        if ignore_index != -100 and not soft_label:
+            mask = D("cast", D("not_equal", flat_label,
+                               _full_like_int(flat_label, ignore_index)),
+                     dtype=str(loss.dtype))
+            denom = D("maximum", D("sum", mask), 1.0)
+            return D("divide", D("sum", loss), denom)
+        return D("mean", loss)
+    if reduction == "sum":
+        return D("sum", loss)
+    return loss
+
+
+def _full_like_int(t, v):
+    from ...ops.creation import full_like
+
+    return full_like(t, v)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, axis=-1,
+                               return_softmax=False):
+    loss = D("softmax_with_cross_entropy", logits, label,
+             soft_label=soft_label, ignore_index=ignore_index, axis=axis)
+    if return_softmax:
+        return loss, D("softmax", logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean"):
+    d = D("subtract", input, label)
+    loss = D("multiply", d, d)
+    return _reduce(loss, reduction)
+
+
+def l1_loss(input, label, reduction="mean"):
+    loss = D("abs", D("subtract", input, label))
+    return _reduce(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    loss = D("huber_loss", input, label, delta=delta)
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    loss = D("nll_loss_op", input, label, ignore_index=ignore_index)
+    if weight is not None:
+        w = D("gather", weight, label, axis=0)
+        loss = D("multiply", loss, w)
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    loss = D("sigmoid_cross_entropy_with_logits", logit, label)
+    if pos_weight is not None:
+        log_weight = D("add", D("multiply", label,
+                                D("subtract", pos_weight, 1.0)), 1.0)
+        loss = D("multiply", loss, log_weight)
+    if weight is not None:
+        loss = D("multiply", loss, weight)
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = D("neg", D("add",
+                      D("multiply", label, D("log", D("maximum", input, eps))),
+                      D("multiply", D("subtract", 1.0, label),
+                        D("log", D("maximum", D("subtract", 1.0, input), eps)))))
+    if weight is not None:
+        loss = D("multiply", loss, weight)
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean"):
+    loss = D("kldiv_loss", input, label)
+    return _reduce(loss, reduction)
+
+
+def label_smooth(label, epsilon=0.1):
+    return D("label_smooth", label, epsilon=epsilon)
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return D("mean", loss)
+    if reduction == "sum":
+        return D("sum", loss)
+    return loss
+
+
+# attention ----------------------------------------------------------------
+
+
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, scale=None):
+    """(batch, seq, heads, head_dim) layout, matching paddle's flash_attention
+    API surface (reference phi/api/yaml/ops.yaml:239 flash_attn).  Lowered to
+    one fused XLA computation eagerly; the Pallas flash kernel
+    (ops/pallas/flash_attention.py) takes over under jit on TPU for long seqs.
+    """
+    return D("sdpa", q, k, v, attn_mask,
+             dropout_p=dropout_p, is_causal=is_causal, scale=scale)
+
+
+def _register_sdpa():
+    import jax
+
+    from ...core.dispatch import register_op, register_vjp_grad
+
+    @register_op("sdpa")
+    def _sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+              scale=None):
+        import math as _math
+
+        # b s h d -> b h s d
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / _math.sqrt(d)
+        prec = (jax.lax.Precision.HIGHEST if qt.dtype == jnp.float32
+                else None)
+        scores = jnp.matmul(qt, jnp.swapaxes(kt, -1, -2),
+                            preferred_element_type=jnp.float32,
+                            precision=prec) * s
+        if is_causal:
+            sq, skv = scores.shape[-2], scores.shape[-1]
+            mask = jnp.tril(jnp.ones((sq, skv), dtype=bool), skv - sq)
+            scores = jnp.where(mask, scores, -jnp.inf)
+        if attn_mask is not None:
+            if attn_mask.dtype == jnp.bool_:
+                scores = jnp.where(attn_mask, scores, -jnp.inf)
+            else:
+                scores = scores + attn_mask.astype(scores.dtype)
+        probs = jax.nn.softmax(scores, axis=-1).astype(vt.dtype)
+        out = jnp.matmul(probs, vt, precision=prec)
+        return jnp.swapaxes(out, 1, 2)
+
+    register_vjp_grad("sdpa")
+
+
+_register_sdpa()
+
+flash_attention = scaled_dot_product_attention

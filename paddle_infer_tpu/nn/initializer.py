@@ -1,0 +1,138 @@
+"""Weight initializers (reference: python/paddle/nn/initializer/,
+fluid/initializer.py). Each initializer maps (shape, dtype) -> jax array."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+
+from ..core import dtype as dtypes
+from ..core import random as prandom
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype="float32"):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        import jax.numpy as jnp
+
+        return jnp.full(tuple(shape), self.value,
+                        dtype=dtypes.convert_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32"):
+        x = jax.random.normal(prandom.next_key(), tuple(shape),
+                              dtype=dtypes.convert_dtype(dtype))
+        return x * self.std + self.mean
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype="float32"):
+        x = jax.random.truncated_normal(prandom.next_key(), -2.0, 2.0,
+                                        tuple(shape),
+                                        dtype=dtypes.convert_dtype(dtype))
+        return x * self.std + self.mean
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype="float32"):
+        return jax.random.uniform(prandom.next_key(), tuple(shape),
+                                  dtype=dtypes.convert_dtype(dtype),
+                                  minval=self.low, maxval=self.high)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None):
+        self.fan_in, self.fan_out = fan_in, fan_out
+
+    def __call__(self, shape, dtype="float32"):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(prandom.next_key(), tuple(shape),
+                                  dtype=dtypes.convert_dtype(dtype),
+                                  minval=-limit, maxval=limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None):
+        self.fan_in, self.fan_out = fan_in, fan_out
+
+    def __call__(self, shape, dtype="float32"):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = math.sqrt(2.0 / (fi + fo))
+        return jax.random.normal(prandom.next_key(), tuple(shape),
+                                 dtype=dtypes.convert_dtype(dtype)) * std
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype="float32"):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(prandom.next_key(), tuple(shape),
+                                  dtype=dtypes.convert_dtype(dtype),
+                                  minval=-limit, maxval=limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def __call__(self, shape, dtype="float32"):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        std = gain / math.sqrt(fi)
+        return jax.random.normal(prandom.next_key(), tuple(shape),
+                                 dtype=dtypes.convert_dtype(dtype)) * std
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype="float32"):
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(np.asarray(self.value),
+                          dtype=dtypes.convert_dtype(dtype))
+        return arr.reshape(tuple(shape))

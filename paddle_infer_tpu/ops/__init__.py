@@ -1,0 +1,171 @@
+"""Op library: importing this package registers every op and injects the
+method surface onto Tensor (the role of the generated pybind methods in the
+reference, paddle/fluid/pybind/eager_method.cc)."""
+from __future__ import annotations
+
+import functools
+
+from ..core import dispatch as _dispatch
+from ..core.tensor import Tensor
+
+from . import math as math_ops          # noqa: F401
+from . import reduction                 # noqa: F401
+from . import manipulation              # noqa: F401
+from . import linalg                    # noqa: F401
+from . import activation                # noqa: F401
+from . import conv                      # noqa: F401
+from . import loss                      # noqa: F401
+from . import creation                  # noqa: F401
+
+from .creation import *                 # noqa: F401,F403
+from .linalg import einsum              # noqa: F401
+
+D = _dispatch.dispatch
+
+
+def _method(op_name, **fixed):
+    def fn(self, *args, **kwargs):
+        kwargs.update(fixed)
+        return D(op_name, self, *args, **kwargs)
+
+    fn.__name__ = op_name
+    return fn
+
+
+# unary / elementwise methods
+for _name in [
+    "exp", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "abs", "neg",
+    "square", "reciprocal", "sign", "floor", "ceil", "round", "trunc", "sin",
+    "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh", "erf",
+    "sigmoid", "relu", "gelu", "isnan", "isinf", "isfinite", "logical_not",
+    "cumsum", "cumprod",
+]:
+    setattr(Tensor, _name, _method(_name))
+
+# binary methods
+for _name in [
+    "add", "subtract", "multiply", "divide", "pow", "maximum", "minimum",
+    "mod", "floor_divide", "matmul", "bmm", "dot", "equal", "not_equal",
+    "greater_than", "greater_equal", "less_than", "less_equal", "logical_and",
+    "logical_or", "logical_xor",
+]:
+    setattr(Tensor, _name, _method(_name))
+
+# reductions / shape
+for _name in [
+    "sum", "mean", "max", "min", "prod", "all", "any", "argmax", "argmin",
+    "logsumexp", "std", "var", "reshape", "squeeze", "unsqueeze", "flatten",
+    "tile", "expand", "split", "gather", "topk", "sort", "argsort", "flip",
+    "roll", "clip", "norm", "take_along_axis", "put_along_axis", "tril",
+    "triu", "where", "scale",
+]:
+    setattr(Tensor, _name, _method(_name))
+
+
+def _transpose_method(self, perm=None):
+    if perm is None:
+        perm = list(range(self.ndim))[::-1]
+    return D("transpose", self, perm=tuple(perm))
+
+
+Tensor.transpose = _transpose_method
+Tensor.t = lambda self: D("transpose_last2", self)
+Tensor.mm = _method("matmul")
+Tensor.sub = _method("subtract")
+Tensor.mul = _method("multiply")
+Tensor.div = _method("divide")
+Tensor.cast = lambda self, dtype: D("cast", self, dtype=str(dtype))
+Tensor.astype = Tensor.cast
+Tensor.unbind = lambda self, axis=0: D("unstack", self, axis=axis)
+
+
+def _chunk(self, chunks, axis=0):
+    return D("split", self, num_or_sections=chunks, axis=axis)
+
+
+Tensor.chunk = _chunk
+
+
+# Python operators --------------------------------------------------------
+def _binop(op_name, reverse=False):
+    def fn(self, other):
+        if reverse:
+            return D(op_name, other, self)
+        return D(op_name, self, other)
+
+    return fn
+
+
+Tensor.__add__ = _binop("add")
+Tensor.__radd__ = _binop("add", True)
+Tensor.__sub__ = _binop("subtract")
+Tensor.__rsub__ = _binop("subtract", True)
+Tensor.__mul__ = _binop("multiply")
+Tensor.__rmul__ = _binop("multiply", True)
+Tensor.__truediv__ = _binop("divide")
+Tensor.__rtruediv__ = _binop("divide", True)
+Tensor.__floordiv__ = _binop("floor_divide")
+Tensor.__mod__ = _binop("mod")
+Tensor.__pow__ = _binop("pow")
+Tensor.__rpow__ = _binop("pow", True)
+Tensor.__matmul__ = _binop("matmul")
+Tensor.__neg__ = lambda self: D("neg", self)
+Tensor.__abs__ = lambda self: D("abs", self)
+Tensor.__eq__ = _binop("equal")
+Tensor.__ne__ = _binop("not_equal")
+Tensor.__gt__ = _binop("greater_than")
+Tensor.__ge__ = _binop("greater_equal")
+Tensor.__lt__ = _binop("less_than")
+Tensor.__le__ = _binop("less_equal")
+Tensor.__invert__ = lambda self: D("logical_not", self)
+
+
+# functional namespace exports -------------------------------------------
+
+def _fn(op_name):
+    @functools.wraps(_dispatch._REGISTRY[op_name].impl or (lambda: None))
+    def fn(*args, **kwargs):
+        return D(op_name, *args, **kwargs)
+
+    fn.__name__ = op_name
+    return fn
+
+
+_EXPORTS = [
+    "add", "subtract", "multiply", "divide", "pow", "maximum", "minimum",
+    "matmul", "bmm", "dot", "exp", "log", "sqrt", "rsqrt", "abs", "square",
+    "sin", "cos", "tan", "tanh", "erf", "floor", "ceil", "round", "sign",
+    "clip", "sum", "mean", "max", "min", "prod", "all", "any", "argmax",
+    "argmin", "logsumexp", "std", "var", "median", "reshape", "squeeze",
+    "unsqueeze", "flatten", "concat", "stack", "split", "gather", "gather_nd",
+    "scatter", "scatter_nd_add", "index_select", "take_along_axis",
+    "put_along_axis", "tile", "expand", "broadcast_to", "flip", "roll",
+    "topk", "sort", "argsort", "where", "cast", "one_hot", "cumsum",
+    "cumprod", "equal", "not_equal", "greater_than", "greater_equal",
+    "less_than", "less_equal", "logical_and", "logical_or", "logical_not",
+    "isnan", "isinf", "isfinite", "norm", "cross", "scale", "unstack",
+    "masked_fill", "repeat_interleave", "kron", "outer", "inverse", "det",
+    "solve", "mod", "floor_divide", "lerp", "nan_to_num", "addmm",
+]
+
+globals().update({name: _fn(name) for name in _EXPORTS})
+
+
+def transpose(x, perm):
+    return D("transpose", x, perm=tuple(perm))
+
+
+def chunk(x, chunks, axis=0):
+    return D("split", x, num_or_sections=chunks, axis=axis)
+
+
+def mm(x, y):
+    return D("matmul", x, y)
+
+
+def t(x):
+    return D("transpose_last2", x)
+
+
+def numel(x):
+    return x.size

@@ -1,0 +1,170 @@
+"""Activation ops (reference: python/paddle/nn/functional/activation.py,
+phi/kernels/activation_kernel.*). Hand grads on the hot ones for
+create_graph; XLA fuses these into surrounding matmuls on TPU anyway.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import defop, dispatch, register_grad, register_op
+
+
+@register_op("relu", save_inputs=False, save_outputs=True)
+def _relu(x):
+    return jnp.maximum(x, 0)
+
+
+@register_grad("relu")
+def _relu_grad(ctx, g):
+    (out,) = ctx.outputs
+    mask = dispatch("cast", dispatch("greater_than", out, 0.0), dtype=str(g.dtype))
+    return (dispatch("multiply", g, mask),)
+
+
+@register_op("sigmoid", save_inputs=False, save_outputs=True)
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@register_grad("sigmoid")
+def _sigmoid_grad(ctx, g):
+    (out,) = ctx.outputs
+    return (dispatch("multiply", g, dispatch("multiply", out,
+            dispatch("subtract", 1.0, out))),)
+
+
+@register_op("tanh", save_inputs=False, save_outputs=True)
+def _tanh(x):
+    return jnp.tanh(x)
+
+
+@register_grad("tanh")
+def _tanh_grad(ctx, g):
+    (out,) = ctx.outputs
+    return (dispatch("multiply", g, dispatch("subtract", 1.0,
+            dispatch("multiply", out, out))),)
+
+
+@register_op("softmax", save_inputs=False, save_outputs=True)
+def _softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_grad("softmax")
+def _softmax_grad(ctx, g):
+    (out,) = ctx.outputs
+    axis = ctx.attrs.get("axis", -1)
+    gy = dispatch("multiply", g, out)
+    s = dispatch("sum", gy, axis=axis, keepdim=True)
+    return (dispatch("subtract", gy, dispatch("multiply", out, s)),)
+
+
+@register_op("log_softmax")
+def _log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_grad("log_softmax")
+def _log_softmax_grad(ctx, g):
+    (x,) = ctx.inputs
+    axis = ctx.attrs.get("axis", -1)
+    sm = dispatch("softmax", x, axis=axis)
+    s = dispatch("sum", g, axis=axis, keepdim=True)
+    return (dispatch("subtract", g, dispatch("multiply", sm, s)),)
+
+
+@register_op("gelu")
+def _gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@register_grad("gelu")
+def _gelu_grad(ctx, g):
+    (x,) = ctx.inputs
+    approximate = ctx.attrs.get("approximate", False)
+    if approximate:
+        # tanh approximation derivative, composed from taped ops
+        c = 0.7978845608028654  # sqrt(2/pi)
+        x3 = dispatch("multiply", dispatch("multiply", x, x), x)
+        inner = dispatch("multiply",
+                         dispatch("add", x, dispatch("multiply", x3, 0.044715)), c)
+        t = dispatch("tanh", inner)
+        one_m_t2 = dispatch("subtract", 1.0, dispatch("multiply", t, t))
+        dinner = dispatch("multiply",
+                          dispatch("add", 1.0,
+                                   dispatch("multiply",
+                                            dispatch("multiply", x, x),
+                                            3 * 0.044715)), c)
+        dgelu = dispatch("add",
+                         dispatch("multiply", 0.5, dispatch("add", 1.0, t)),
+                         dispatch("multiply", 0.5,
+                                  dispatch("multiply", x,
+                                           dispatch("multiply", one_m_t2, dinner))))
+        return (dispatch("multiply", g, dgelu),)
+    # exact: d/dx = Phi(x) + x*phi(x)
+    phi = dispatch("multiply",
+                   dispatch("exp", dispatch("multiply",
+                                            dispatch("multiply", x, x), -0.5)),
+                   0.3989422804014327)
+    big_phi = dispatch("multiply",
+                       dispatch("add", 1.0, dispatch("erf",
+                                dispatch("multiply", x, 0.7071067811865475))), 0.5)
+    return (dispatch("multiply", g, dispatch("add", big_phi,
+             dispatch("multiply", x, phi))),)
+
+
+@register_op("silu", save_inputs=True)
+def _silu(x):
+    return jax.nn.silu(x)
+
+
+@register_grad("silu")
+def _silu_grad(ctx, g):
+    (x,) = ctx.inputs
+    s = dispatch("sigmoid", x)
+    # d silu = s * (1 + x * (1 - s))
+    return (dispatch("multiply", g, dispatch("multiply", s,
+            dispatch("add", 1.0, dispatch("multiply", x,
+            dispatch("subtract", 1.0, s))))),)
+
+
+defop("leaky_relu")(lambda x, negative_slope=0.01:
+                    jax.nn.leaky_relu(x, negative_slope))
+defop("elu")(lambda x, alpha=1.0: jax.nn.elu(x, alpha))
+defop("selu")(lambda x, scale=1.0507009873554805, alpha=1.6732632423543772:
+              scale * jnp.where(x > 0, x, alpha * jnp.expm1(x)))
+defop("celu")(lambda x, alpha=1.0: jax.nn.celu(x, alpha))
+defop("softplus")(lambda x, beta=1.0, threshold=20.0:
+                  jnp.where(x * beta > threshold, x,
+                            jnp.log1p(jnp.exp(beta * x)) / beta))
+defop("softsign")(lambda x: jax.nn.soft_sign(x))
+defop("hardswish")(lambda x: x * jnp.clip(x + 3, 0, 6) / 6)
+defop("hardsigmoid")(lambda x, slope=1 / 6, offset=0.5:
+                     jnp.clip(slope * x + offset, 0, 1))
+defop("hardtanh")(lambda x, min=-1.0, max=1.0: jnp.clip(x, min, max))
+defop("hardshrink")(lambda x, threshold=0.5:
+                    jnp.where(jnp.abs(x) > threshold, x, 0.0))
+defop("softshrink")(lambda x, threshold=0.5:
+                    jnp.where(x > threshold, x - threshold,
+                              jnp.where(x < -threshold, x + threshold, 0.0)))
+defop("tanhshrink")(lambda x: x - jnp.tanh(x))
+defop("thresholded_relu")(lambda x, threshold=1.0:
+                          jnp.where(x > threshold, x, 0.0))
+defop("relu6")(lambda x: jnp.clip(x, 0, 6))
+defop("mish")(lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+defop("swish")(lambda x: jax.nn.silu(x))
+defop("prelu")(lambda x, weight: jnp.where(x > 0, x, weight * x))
+defop("logit")(lambda x, eps=1e-8:
+               jnp.log(jnp.clip(x, eps, 1 - eps) / (1 - jnp.clip(x, eps, 1 - eps))))
+defop("maxout")(lambda x, groups, axis=1: _maxout_impl(x, groups, axis))
+
+
+def _maxout_impl(x, groups, axis):
+    shape = list(x.shape)
+    c = shape[axis]
+    shape[axis:axis + 1] = [c // groups, groups]
+    return jnp.max(jnp.reshape(x, shape), axis=axis + 1)
+
+
+defop("glu")(lambda x, axis=-1: jax.nn.glu(x, axis=axis))

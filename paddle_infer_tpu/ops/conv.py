@@ -1,0 +1,326 @@
+"""Convolution / pooling ops.
+
+Reference kernels: phi/kernels/gpu/conv_kernel.cu (cuDNN) — here a single
+``lax.conv_general_dilated`` that XLA tiles onto the MXU.  Layout is NCHW to
+match the paddle API surface; XLA relayouts internally for the TPU conv engine.
+Backward comes from the auto-vjp fallback (XLA derives transposed convs).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import register_op, register_vjp_grad
+
+
+def _prec(x):
+    return lax.Precision.HIGHEST if x.dtype == jnp.float32 else None
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+def _norm_padding(padding, n=2):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    return [tuple(p) for p in padding]
+
+
+@register_op("conv2d")
+def _conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, weight,
+        window_strides=_pair(stride),
+        padding=_norm_padding(padding),
+        rhs_dilation=_pair(dilation),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        precision=_prec(x),
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+register_vjp_grad("conv2d")
+
+
+@register_op("conv1d")
+def _conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCH", "OIH", "NCH"))
+    out = lax.conv_general_dilated(
+        x, weight,
+        window_strides=_pair(stride, 1),
+        padding=_norm_padding(padding, 1),
+        rhs_dilation=_pair(dilation, 1),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        precision=_prec(x),
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1)
+    return out
+
+
+register_vjp_grad("conv1d")
+
+
+@register_op("conv3d")
+def _conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        x, weight,
+        window_strides=_pair(stride, 3),
+        padding=_norm_padding(padding, 3),
+        rhs_dilation=_pair(dilation, 3),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        precision=_prec(x),
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+register_vjp_grad("conv3d")
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                      output_padding=0, dilation=1, groups=1):
+    # weight layout IOHW (paddle conv_transpose convention)
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad = _norm_padding(padding)
+    if isinstance(pad, str):
+        pad_cfg = pad
+    else:
+        # lax.conv_transpose padding semantics: amount of padding on the
+        # *output* of the equivalent forward conv
+        kh = (weight.shape[2] - 1) * dilation[0] + 1
+        kw = (weight.shape[3] - 1) * dilation[1] + 1
+        op_pad = _pair(output_padding)
+        pad_cfg = [(kh - 1 - pad[0][0], kh - 1 - pad[0][1] + op_pad[0]),
+                   (kw - 1 - pad[1][0], kw - 1 - pad[1][1] + op_pad[1])]
+    if groups != 1:
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(weight, groups, axis=0)
+        outs = [_deconv_single(xi, wi, stride, pad_cfg, dilation)
+                for xi, wi in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        out = _deconv_single(x, weight, stride, pad_cfg, dilation)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def _deconv_single(x, w, stride, pad_cfg, dilation):
+    # input-dilated conv with flipped kernel == gradient/transposed conv
+    w_flip = jnp.flip(w, axis=(2, 3))          # IOHW
+    w_t = jnp.swapaxes(w_flip, 0, 1)           # OIHW with O=out channels
+    dn = lax.conv_dimension_numbers(x.shape, w_t.shape, ("NCHW", "OIHW", "NCHW"))
+    return lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1), padding=pad_cfg,
+        lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+        precision=_prec(x))
+
+
+register_vjp_grad("conv2d_transpose")
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1):
+    c = x.shape[1]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=_pair(stride), padding=_norm_padding(padding),
+        rhs_dilation=_pair(dilation), dimension_numbers=dn,
+        feature_group_count=c, precision=_prec(x))
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+register_vjp_grad("depthwise_conv2d")
+
+
+# ------------------------------------------------------------------ pooling
+
+def _pool_padding(shape, ks, st, pad, ceil_mode):
+    """Resolve per-spatial-dim (lo, hi) padding, adding ceil_mode extra on the
+    high side so the last partial window is covered (paddle semantics)."""
+    pads = []
+    for i, (k, s) in enumerate(zip(ks, st)):
+        lo, hi = pad[i]
+        size = shape[2 + i] + lo + hi
+        if ceil_mode:
+            rem = (size - k) % s
+            if rem:
+                hi += s - rem
+        pads.append((lo, hi))
+    return pads
+
+
+@register_op("max_pool2d")
+def _max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+    ks = _pair(kernel_size)
+    st = _pair(stride if stride is not None else kernel_size)
+    pad = _norm_padding(padding)
+    if isinstance(pad, str):
+        pad_cfg = pad
+    else:
+        pad_cfg = [(0, 0), (0, 0)] + _pool_padding(x.shape, ks, st, pad,
+                                                   ceil_mode)
+    return lax.reduce_window(
+        x, -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        window_dimensions=(1, 1) + ks,
+        window_strides=(1, 1) + st,
+        padding=pad_cfg)
+
+
+register_vjp_grad("max_pool2d")
+
+
+@register_op("avg_pool2d")
+def _avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+                count_include_pad=True):
+    ks = _pair(kernel_size)
+    st = _pair(stride if stride is not None else kernel_size)
+    pad = _norm_padding(padding)
+    if isinstance(pad, str):
+        spatial = [(0, 0), (0, 0)]
+        pad_cfg = pad
+    else:
+        spatial = _pool_padding(x.shape, ks, st, pad, ceil_mode)
+        pad_cfg = [(0, 0), (0, 0)] + spatial
+    summed = lax.reduce_window(
+        x, 0.0, lax.add,
+        window_dimensions=(1, 1) + ks,
+        window_strides=(1, 1) + st,
+        padding=pad_cfg)
+    no_pad = (not isinstance(pad, str)
+              and all(p == (0, 0) for p in spatial))
+    if no_pad:
+        return summed / (ks[0] * ks[1])
+    if count_include_pad and not ceil_mode:
+        return summed / (ks[0] * ks[1])
+    # divide by the real per-window element count (base padding counted per
+    # count_include_pad; ceil_mode extra never counted — paddle semantics)
+    ones = jnp.ones_like(x)
+    if isinstance(pad, str):
+        counts = lax.reduce_window(
+            ones, 0.0, lax.add, window_dimensions=(1, 1) + ks,
+            window_strides=(1, 1) + st, padding=pad_cfg)
+        return summed / counts
+    if count_include_pad:
+        base = [(0, 0), (0, 0)] + [tuple(p) for p in pad]
+        ones = jnp.pad(ones, base, constant_values=1.0)
+        extra = [(0, 0), (0, 0)] + [
+            (sp[0] - bp[0], sp[1] - bp[1])
+            for sp, bp in zip(spatial, [tuple(p) for p in pad])]
+        counts_input = jnp.pad(ones, extra, constant_values=0.0)
+        x_for_counts_pad = [(0, 0)] * 4
+    else:
+        counts_input = ones
+        x_for_counts_pad = pad_cfg
+    counts = lax.reduce_window(
+        counts_input, 0.0, lax.add, window_dimensions=(1, 1) + ks,
+        window_strides=(1, 1) + st,
+        padding=x_for_counts_pad if not count_include_pad else [(0, 0)] * 4)
+    return summed / jnp.maximum(counts, 1.0)
+
+
+register_vjp_grad("avg_pool2d")
+
+
+@register_op("adaptive_avg_pool2d")
+def _adaptive_avg_pool2d(x, output_size):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    # split into near-equal windows (exact when divisible — the common case)
+    if h % oh == 0 and w % ow == 0:
+        return jnp.mean(x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
+    ys = np.linspace(0, h, oh + 1).astype(int)
+    xs = np.linspace(0, w, ow + 1).astype(int)
+    rows = []
+    for i in range(oh):
+        cols = []
+        for j in range(ow):
+            cols.append(jnp.mean(x[:, :, ys[i]:ys[i + 1], xs[j]:xs[j + 1]],
+                                 axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+register_vjp_grad("adaptive_avg_pool2d")
+
+
+@register_op("adaptive_max_pool2d")
+def _adaptive_max_pool2d(x, output_size):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        return jnp.max(x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
+    raise NotImplementedError("adaptive_max_pool2d requires divisible shapes")
+
+
+register_vjp_grad("adaptive_max_pool2d")
+
+
+@register_op("interpolate_nearest")
+def _interp_nearest(x, scale):
+    sh, sw = _pair(scale)
+    return jnp.repeat(jnp.repeat(x, int(sh), axis=2), int(sw), axis=3)
+
+
+register_vjp_grad("interpolate_nearest")
+
+
+@register_op("interpolate_resize")
+def _interp_resize(x, out_h, out_w, method="bilinear", align_corners=False):
+    n, c, h, w = x.shape
+    return jax.image.resize(x, (n, c, out_h, out_w), method=method)
+
+
+register_vjp_grad("interpolate_resize")
+
+
+@register_op("unfold_im2col")
+def _unfold_im2col(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """paddle.nn.functional.unfold (im2col)."""
+    kh, kw = _pair(kernel_sizes)
+    st = _pair(strides)
+    dl = _pair(dilations)
+    pad = _norm_padding(paddings)
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, [(0, 0), (0, 0), pad[0], pad[1]])
+    oh = (xp.shape[2] - (dl[0] * (kh - 1) + 1)) // st[0] + 1
+    ow = (xp.shape[3] - (dl[1] * (kw - 1) + 1)) // st[1] + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            di, dj = i * dl[0], j * dl[1]
+            patches.append(
+                xp[:, :, di:di + oh * st[0]:st[0], dj:dj + ow * st[1]:st[1]])
+    out = jnp.stack(patches, axis=2)  # n, c, kh*kw, oh, ow
+    return out.reshape(n, c * kh * kw, oh * ow)
+
+
+register_vjp_grad("unfold_im2col")

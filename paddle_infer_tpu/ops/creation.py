@@ -1,0 +1,181 @@
+"""Tensor creation APIs (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import random as prandom
+from ..core.tensor import Tensor
+
+
+def _dt(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    return d if d is not None else (default or dtypes.get_default_dtype())
+
+
+def to_tensor(data, dtype=None, stop_gradient=True) -> Tensor:
+    if isinstance(data, Tensor):
+        out = Tensor(data._data, stop_gradient=stop_gradient)
+    else:
+        arr = jnp.asarray(data)
+        if arr.dtype == jnp.float64:
+            arr = arr.astype(dtypes.get_default_dtype())
+        out = Tensor(arr, stop_gradient=stop_gradient)
+    if dtype is not None:
+        d = dtypes.convert_dtype(dtype)
+        if out.dtype != d:
+            out = Tensor(out._data.astype(d), stop_gradient=stop_gradient)
+    return out
+
+
+def zeros(shape, dtype=None) -> Tensor:
+    return Tensor(jnp.zeros(tuple(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None) -> Tensor:
+    return Tensor(jnp.ones(tuple(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(tuple(shape), fill_value, dtype=_dt(dtype)))
+
+
+def zeros_like(x, dtype=None) -> Tensor:
+    return Tensor(jnp.zeros_like(x._data if isinstance(x, Tensor) else x,
+                                 dtype=dtypes.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None) -> Tensor:
+    return Tensor(jnp.ones_like(x._data if isinstance(x, Tensor) else x,
+                                dtype=dtypes.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None) -> Tensor:
+    return Tensor(jnp.full_like(x._data if isinstance(x, Tensor) else x,
+                                fill_value, dtype=dtypes.convert_dtype(dtype)))
+
+
+def arange(start=0, end=None, step=1, dtype=None) -> Tensor:
+    if end is None:
+        start, end = 0, start
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        if all(isinstance(v, int) for v in (start, end, step)):
+            d = dtypes.int64
+        else:
+            d = dtypes.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None) -> Tensor:
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None) -> Tensor:
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0) -> Tensor:
+    return Tensor(jnp.diag(x._data if isinstance(x, Tensor) else jnp.asarray(x),
+                           k=offset))
+
+
+def empty(shape, dtype=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def tril(x, diagonal=0) -> Tensor:
+    from ..core import dispatch
+
+    return dispatch.dispatch("tril", x, diagonal=diagonal)
+
+
+def triu(x, diagonal=0) -> Tensor:
+    from ..core import dispatch
+
+    return dispatch.dispatch("triu", x, diagonal=diagonal)
+
+
+def meshgrid(*args):
+    arrays = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(g) for g in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+def clone(x) -> Tensor:
+    from ..core import dispatch
+
+    return dispatch.dispatch("assign", x)
+
+
+def assign(x, output=None) -> Tensor:
+    from ..core import dispatch
+
+    out = dispatch.dispatch("assign", x if isinstance(x, Tensor) else to_tensor(x))
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+# -------------------------------------------------------------------- random
+
+def rand(shape, dtype=None) -> Tensor:
+    return Tensor(jax.random.uniform(prandom.next_key(), tuple(shape),
+                                     dtype=_dt(dtype)))
+
+
+def randn(shape, dtype=None) -> Tensor:
+    return Tensor(jax.random.normal(prandom.next_key(), tuple(shape),
+                                    dtype=_dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=None) -> Tensor:
+    key = jax.random.key(seed) if seed else prandom.next_key()
+    return Tensor(jax.random.uniform(key, tuple(shape), dtype=_dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None) -> Tensor:
+    n = jax.random.normal(prandom.next_key(), tuple(shape or ()),
+                          dtype=dtypes.get_default_dtype())
+    return Tensor(n * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64") -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(prandom.next_key(), tuple(shape), low, high,
+                                     dtype=dtypes.convert_dtype(dtype)))
+
+
+def randperm(n, dtype="int64") -> Tensor:
+    return Tensor(jax.random.permutation(prandom.next_key(), n)
+                  .astype(dtypes.convert_dtype(dtype)))
+
+
+def bernoulli(x) -> Tensor:
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(prandom.next_key(), data)
+                  .astype(data.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False) -> Tensor:
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if replacement:
+        out = jax.random.categorical(prandom.next_key(), logits,
+                                     shape=data.shape[:-1] + (num_samples,))
+    else:
+        key = prandom.next_key()
+        # Gumbel top-k trick for sampling without replacement.
+        g = jax.random.gumbel(key, data.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))

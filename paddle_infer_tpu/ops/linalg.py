@@ -1,0 +1,172 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py; matmul kernel
+phi/kernels/gpu/matmul_kernel.cu:22 -> here a single jnp.matmul that XLA maps
+onto the MXU; bf16 inputs stay bf16 with f32 accumulation).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import (defop, dispatch, register_grad, register_op,
+                             register_vjp_grad, unbroadcast)
+
+
+def _prec(x, y):
+    """float32 operands get true-f32 matmul (paddle semantics); bf16 operands
+    use the MXU-native default (bf16 multiply, f32 accumulate)."""
+    if x.dtype == jnp.float32 and y.dtype == jnp.float32:
+        return jax.lax.Precision.HIGHEST
+    return None
+
+
+@register_op("matmul")
+def _matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x and x.ndim > 1:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y and y.ndim > 1:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y, precision=_prec(x, y))
+
+
+@register_grad("matmul")
+def _matmul_grad(ctx, g):
+    x, y = ctx.inputs
+    tx = ctx.attrs.get("transpose_x", False)
+    ty = ctx.attrs.get("transpose_y", False)
+
+    if x.ndim == 1 and y.ndim == 1:
+        gx = dispatch("multiply", g, y)
+        gy = dispatch("multiply", g, x)
+        return gx, gy
+    if x.ndim == 1:
+        # (k,) @ (..., k, n) -> (..., n)
+        gu = dispatch("unsqueeze", g, axis=-2)
+        gx_full = dispatch("matmul", gu, y, transpose_y=not ty)
+        gx = unbroadcast(dispatch("squeeze", gx_full, axis=-2), x.shape)
+        xu = dispatch("unsqueeze", x, axis=-1)
+        gy = dispatch("matmul", xu, gu) if not ty else dispatch(
+            "matmul", dispatch("unsqueeze", g, axis=-1),
+            dispatch("unsqueeze", x, axis=-2))
+        return gx, unbroadcast(gy, y.shape)
+    if y.ndim == 1:
+        gu = dispatch("unsqueeze", g, axis=-1)
+        yu = dispatch("unsqueeze", y, axis=-1)
+        gx = dispatch("matmul", gu, yu, transpose_y=True)
+        if tx:
+            gx = dispatch("transpose_last2", gx)
+        gy_full = dispatch("matmul", x, gu, transpose_x=not tx)
+        gy = unbroadcast(dispatch("squeeze", gy_full, axis=-1), y.shape)
+        return unbroadcast(gx, x.shape), gy
+
+    if not tx and not ty:
+        gx = dispatch("matmul", g, y, transpose_y=True)
+        gy = dispatch("matmul", x, g, transpose_x=True)
+    elif tx and not ty:
+        gx = dispatch("matmul", y, g, transpose_y=True)
+        gy = dispatch("matmul", x, g)
+    elif not tx and ty:
+        gx = dispatch("matmul", g, y)
+        gy = dispatch("matmul", g, x, transpose_x=True)
+    else:
+        gx = dispatch("matmul", y, g, transpose_x=True, transpose_y=True)
+        gy = dispatch("matmul", g, x, transpose_x=True, transpose_y=True)
+    return unbroadcast(gx, x.shape), unbroadcast(gy, y.shape)
+
+
+@register_op("transpose_last2")
+def _transpose_last2(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+@register_grad("transpose_last2")
+def _transpose_last2_grad(ctx, g):
+    return (dispatch("transpose_last2", g),)
+
+
+@register_op("bmm")
+def _bmm(x, y):
+    return jnp.matmul(x, y, precision=_prec(x, y))
+
+
+@register_grad("bmm")
+def _bmm_grad(ctx, g):
+    x, y = ctx.inputs
+    return (dispatch("matmul", g, y, transpose_y=True),
+            dispatch("matmul", x, g, transpose_x=True))
+
+
+@register_op("dot")
+def _dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+register_vjp_grad("dot")
+
+
+@register_op("addmm")
+def _addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y, precision=_prec(x, y))
+
+
+register_vjp_grad("addmm")
+
+
+@register_op("einsum_op")
+def _einsum(*operands, equation):
+    prec = _prec(operands[0], operands[-1]) if operands else None
+    return jnp.einsum(equation, *operands, precision=prec)
+
+
+register_vjp_grad("einsum_op")
+
+
+def einsum(equation, *operands):
+    return dispatch("einsum_op", *operands, equation=equation)
+
+
+@register_op("norm")
+def _norm(x, p=2, axis=None, keepdim=False):
+    if p in ("fro", 2):
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord=2 if isinstance(axis, int) else None,
+                               axis=axis if not isinstance(axis, list) else tuple(axis),
+                               keepdims=keepdim)
+    if p == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                             keepdims=keepdim), 1.0 / p)
+
+
+register_vjp_grad("norm")
+
+defop("cross")(lambda x, y, axis=-1: jnp.cross(x, y, axis=axis))
+defop("matrix_power")(lambda x, n: jnp.linalg.matrix_power(x, n))
+defop("inverse")(lambda x: jnp.linalg.inv(x))
+defop("cholesky")(lambda x, upper=False:
+                  jnp.linalg.cholesky(x).swapaxes(-1, -2).conj() if upper
+                  else jnp.linalg.cholesky(x))
+defop("solve")(lambda a, b: jnp.linalg.solve(a, b))
+defop("triangular_solve")(
+    lambda a, b, upper=True, transpose=False, unitriangular=False:
+    jax.scipy.linalg.solve_triangular(a, b, lower=not upper, trans=1 if transpose else 0,
+                                      unit_diagonal=unitriangular))
+defop("qr", vjp=False)(lambda x, mode="reduced": tuple(jnp.linalg.qr(x, mode=mode)))
+defop("svd", vjp=False)(
+    lambda x, full_matrices=False: tuple(jnp.linalg.svd(x, full_matrices=full_matrices)))
+defop("eigh", vjp=False)(lambda x, UPLO="L": tuple(jnp.linalg.eigh(x, UPLO=UPLO)))
+defop("det")(lambda x: jnp.linalg.det(x))
+defop("slogdet", vjp=False)(lambda x: tuple(jnp.linalg.slogdet(x)))
+defop("pinv")(lambda x, rcond=1e-15: jnp.linalg.pinv(x, rtol=rcond))
+defop("matrix_rank", vjp=False)(lambda x, tol=None: jnp.linalg.matrix_rank(x, rtol=tol))
+defop("lstsq", vjp=False)(lambda a, b: tuple(jnp.linalg.lstsq(a, b)[:2]))
+defop("trace_op")(lambda x, offset=0, axis1=0, axis2=1:
+                  jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2))
+defop("kron")(lambda x, y: jnp.kron(x, y))
+defop("outer")(lambda x, y: jnp.outer(x, y))
+defop("histogram", vjp=False)(
+    lambda x, bins=100, min=0, max=0:
+    jnp.histogram(x, bins=bins, range=None if min == 0 and max == 0 else (min, max))[0])
+defop("mv")(lambda x, vec: jnp.matmul(x, vec))

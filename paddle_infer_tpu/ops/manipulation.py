@@ -1,0 +1,379 @@
+"""Shape / layout manipulation ops
+(reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import (defop, dispatch, register_grad, register_op,
+                             register_vjp_grad)
+from ..core.tensor import Tensor, _thaw_index
+
+
+@register_op("reshape")
+def _reshape(x, shape):
+    shape = tuple(int(s) for s in shape)
+    return jnp.reshape(x, shape)
+
+
+@register_grad("reshape")
+def _reshape_grad(ctx, g):
+    (x,) = ctx.inputs
+    return (dispatch("reshape", g, shape=tuple(x.shape)),)
+
+
+@register_op("transpose")
+def _transpose(x, perm):
+    return jnp.transpose(x, tuple(perm))
+
+
+@register_grad("transpose")
+def _transpose_grad(ctx, g):
+    perm = list(ctx.attrs["perm"])
+    inv = [0] * len(perm)
+    for i, p in enumerate(perm):
+        inv[p] = i
+    return (dispatch("transpose", g, perm=tuple(inv)),)
+
+
+@register_op("expand")
+def _expand(x, shape):
+    shape = tuple(int(s) for s in shape)
+    # paddle allows -1 meaning "keep this dim"
+    xshape = x.shape
+    full = []
+    offset = len(shape) - len(xshape)
+    for i, s in enumerate(shape):
+        if s == -1:
+            full.append(xshape[i - offset])
+        else:
+            full.append(s)
+    return jnp.broadcast_to(x, tuple(full))
+
+
+@register_grad("expand")
+def _expand_grad(ctx, g):
+    from ..core.dispatch import unbroadcast
+
+    (x,) = ctx.inputs
+    return (unbroadcast(g, tuple(x.shape)),)
+
+
+@register_op("squeeze")
+def _squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+@register_grad("squeeze")
+def _squeeze_grad(ctx, g):
+    (x,) = ctx.inputs
+    return (dispatch("reshape", g, shape=tuple(x.shape)),)
+
+
+@register_op("unsqueeze")
+def _unsqueeze(x, axis):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.expand_dims(x, tuple(axis))
+
+
+@register_grad("unsqueeze")
+def _unsqueeze_grad(ctx, g):
+    (x,) = ctx.inputs
+    return (dispatch("reshape", g, shape=tuple(x.shape)),)
+
+
+@register_op("concat")
+def _concat(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+@register_grad("concat")
+def _concat_grad(ctx, g):
+    axis = ctx.attrs.get("axis", 0)
+    sizes = [t.shape[axis] for t in ctx.inputs]
+    pieces = dispatch("split", g, num_or_sections=tuple(sizes), axis=axis)
+    return tuple(pieces)
+
+
+@register_op("split")
+def _split(x, num_or_sections, axis=0):
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    # paddle allows one -1 section
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    idx = np.cumsum(sections)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+@register_grad("split")
+def _split_grad(ctx, *gs):
+    axis = ctx.attrs.get("axis", 0)
+    return (dispatch("concat", *gs, axis=axis),)
+
+
+@register_op("stack")
+def _stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+@register_grad("stack")
+def _stack_grad(ctx, g):
+    axis = ctx.attrs.get("axis", 0)
+    n = len(ctx.inputs)
+    pieces = dispatch("split", g, num_or_sections=n, axis=axis)
+    return tuple(dispatch("squeeze", p, axis=axis) for p in pieces)
+
+
+@register_op("unstack")
+def _unstack(x, axis=0):
+    n = x.shape[axis]
+    return tuple(jnp.squeeze(p, axis=axis) for p in jnp.split(x, n, axis=axis))
+
+
+register_vjp_grad("unstack")
+
+
+@register_op("getitem", jit=False)
+def _getitem(x, idx):
+    return x[_thaw_index(idx)]
+
+
+@register_grad("getitem")
+def _getitem_grad(ctx, g):
+    (x,) = ctx.inputs
+    return (dispatch("scatter_grad_fill", g, idx=ctx.attrs["idx"],
+                     shape=tuple(x.shape), dtype=str(x.dtype)),)
+
+
+@register_op("scatter_grad_fill")
+def _scatter_grad_fill(g, idx, shape, dtype):
+    zero = jnp.zeros(shape, dtype=np.dtype(dtype))
+    return zero.at[_thaw_index(idx)].add(g.astype(np.dtype(dtype)))
+
+
+register_vjp_grad("scatter_grad_fill")
+
+
+@register_op("slice")
+def _slice(x, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    return x[tuple(idx)]
+
+
+register_vjp_grad("slice")
+
+
+@register_op("gather")
+def _gather(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+register_vjp_grad("gather")
+
+
+@register_op("gather_nd")
+def _gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+register_vjp_grad("gather_nd")
+
+
+@register_op("index_select")
+def _index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+register_vjp_grad("index_select")
+
+
+@register_op("scatter")
+def _scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+register_vjp_grad("scatter")
+
+
+@register_op("scatter_nd_add")
+def _scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+register_vjp_grad("scatter_nd_add")
+
+
+@register_op("put_along_axis")
+def _put_along_axis(x, index, value, axis):
+    return jnp.put_along_axis(x, index, value, axis=axis, inplace=False)
+
+
+register_vjp_grad("put_along_axis")
+
+
+@register_op("take_along_axis")
+def _take_along_axis(x, index, axis):
+    return jnp.take_along_axis(x, index, axis=axis)
+
+
+register_vjp_grad("take_along_axis")
+
+
+@register_op("tile")
+def _tile(x, repeat_times):
+    return jnp.tile(x, tuple(repeat_times))
+
+
+register_vjp_grad("tile")
+
+
+@register_op("flip")
+def _flip(x, axis):
+    if isinstance(axis, int):
+        axis = (axis,)
+    return jnp.flip(x, axis=tuple(axis))
+
+
+@register_grad("flip")
+def _flip_grad(ctx, g):
+    return (dispatch("flip", g, axis=ctx.attrs["axis"]),)
+
+
+@register_op("roll")
+def _roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+register_vjp_grad("roll")
+
+
+@register_op("pad")
+def _pad(x, paddings, mode="constant", value=0.0):
+    pads = [tuple(p) for p in paddings]
+    if mode == "constant":
+        return jnp.pad(x, pads, constant_values=value)
+    return jnp.pad(x, pads, mode=mode)
+
+
+register_vjp_grad("pad")
+
+
+@register_op("tril")
+def _tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+register_vjp_grad("tril")
+
+
+@register_op("triu")
+def _triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+register_vjp_grad("triu")
+
+
+@register_op("assign")
+def _assign(x):
+    return x + 0 if jnp.issubdtype(x.dtype, jnp.number) else jnp.copy(x)
+
+
+@register_grad("assign")
+def _assign_grad(ctx, g):
+    return (g,)
+
+
+defop("one_hot", vjp=False)(
+    lambda x, num_classes, dtype="float32":
+    jax.nn.one_hot(x, num_classes, dtype=np.dtype(dtype)))
+
+
+@register_op("topk")
+def _topk(x, k, axis=-1, largest=True):
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+    else:
+        xm = x
+    if largest:
+        vals, idx = jax.lax.top_k(xm, k)
+    else:
+        vals, idx = jax.lax.top_k(-xm, k)
+        vals = -vals
+    if axis != -1 and axis != x.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+@register_grad("topk")
+def _topk_grad(ctx, gval, gidx):
+    (x,) = ctx.inputs
+    # re-run forward indices (cheap) and scatter the value grads back
+    axis = ctx.attrs.get("axis", -1)
+    _, idx = dispatch("topk", x.detach(), **ctx.attrs)
+    return (dispatch("put_along_axis",
+                     dispatch("multiply", x, 0.0).detach(), idx, gval,
+                     axis=axis if axis >= 0 else x.ndim - 1), None)
+
+
+defop("sort")(lambda x, axis=-1, descending=False:
+              -jnp.sort(-x, axis=axis) if descending else jnp.sort(x, axis=axis))
+defop("argsort", vjp=False)(
+    lambda x, axis=-1, descending=False:
+    jnp.argsort(-x if descending else x, axis=axis).astype(jnp.int64))
+
+
+@register_op("flatten")
+def _flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    stop = stop_axis % nd
+    start = start_axis % nd
+    shape = (x.shape[:start] + (int(np.prod(x.shape[start:stop + 1])),)
+             + x.shape[stop + 1:])
+    return jnp.reshape(x, shape)
+
+
+@register_grad("flatten")
+def _flatten_grad(ctx, g):
+    (x,) = ctx.inputs
+    return (dispatch("reshape", g, shape=tuple(x.shape)),)
+
+
+defop("repeat_interleave")(
+    lambda x, repeats, axis=None: jnp.repeat(x, repeats, axis=axis))
+defop("broadcast_to")(lambda x, shape: jnp.broadcast_to(x, tuple(shape)))
+defop("as_real", vjp=False)(lambda x: jnp.stack([x.real, x.imag], axis=-1))
+defop("diagonal")(lambda x, offset=0, axis1=0, axis2=1:
+                  jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2))
+defop("moveaxis")(lambda x, source, destination:
+                  jnp.moveaxis(x, source, destination))
+defop("masked_fill")(
+    lambda x, mask, value: jnp.where(mask, jnp.asarray(value, x.dtype), x))
+defop("unfold")(lambda x, axis, size, step:
+                _unfold_impl(x, axis, size, step))
+
+
+def _unfold_impl(x, axis, size, step):
+    n = (x.shape[axis] - size) // step + 1
+    idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved[idx]  # (n, size, ...)
+    return jnp.moveaxis(out, (0, 1), (axis, x.ndim if axis >= 0 else axis))

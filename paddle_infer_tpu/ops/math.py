@@ -1,0 +1,334 @@
+"""Elementwise math primitives with hand-written backward rules.
+
+Reference surface: python/paddle/tensor/math.py + phi/kernels/elementwise_*.
+Hand-written rules (expressed in registry ops on Tensors, like backward.yaml
+compositions) support create_graph / higher-order autograd; long-tail ops use
+the auto-vjp fallback (core/dispatch.py defop).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import (defop, dispatch, register_grad, register_op,
+                             unbroadcast)
+from ..core.tensor import Tensor
+
+# ----------------------------------------------------------------- binary
+
+
+@register_op("add", save_inputs=True)
+def _add(x, y):
+    return jnp.add(x, y)
+
+
+@register_grad("add")
+def _add_grad(ctx, g):
+    x, y = ctx.inputs
+    return unbroadcast(g, x.shape), unbroadcast(g, y.shape)
+
+
+@register_op("subtract")
+def _subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@register_grad("subtract")
+def _subtract_grad(ctx, g):
+    x, y = ctx.inputs
+    return unbroadcast(g, x.shape), unbroadcast(dispatch("neg", g), y.shape)
+
+
+@register_op("multiply")
+def _multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@register_grad("multiply")
+def _multiply_grad(ctx, g):
+    x, y = ctx.inputs
+    return (unbroadcast(dispatch("multiply", g, y), x.shape),
+            unbroadcast(dispatch("multiply", g, x), y.shape))
+
+
+@register_op("divide")
+def _divide(x, y):
+    return jnp.divide(x, y)
+
+
+@register_grad("divide")
+def _divide_grad(ctx, g):
+    x, y = ctx.inputs
+    gx = dispatch("divide", g, y)
+    gy = dispatch("neg", dispatch("divide", dispatch("multiply", g, x),
+                                  dispatch("multiply", y, y)))
+    return unbroadcast(gx, x.shape), unbroadcast(gy, y.shape)
+
+
+@register_op("pow")
+def _pow(x, y):
+    return jnp.power(x, y)
+
+
+@register_grad("pow")
+def _pow_grad(ctx, g):
+    x, y = ctx.inputs
+    # d/dx x^y = y * x^(y-1);  d/dy = x^y * ln(x)
+    gx = dispatch("multiply", g, dispatch("multiply", y,
+                  dispatch("pow", x, dispatch("subtract", y, 1.0))))
+    gy = dispatch("multiply", g, dispatch("multiply",
+                  dispatch("pow", x, y), dispatch("log", x)))
+    return unbroadcast(gx, x.shape), unbroadcast(gy, y.shape)
+
+
+@register_op("maximum")
+def _maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@register_grad("maximum")
+def _maximum_grad(ctx, g):
+    x, y = ctx.inputs
+    mask = dispatch("cast", dispatch("greater_equal", x, y), dtype="float32")
+    mask = dispatch("cast", mask, dtype=str(g.dtype))
+    gx = dispatch("multiply", g, mask)
+    gy = dispatch("subtract", g, gx)
+    return unbroadcast(gx, x.shape), unbroadcast(gy, y.shape)
+
+
+@register_op("minimum")
+def _minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@register_grad("minimum")
+def _minimum_grad(ctx, g):
+    x, y = ctx.inputs
+    mask = dispatch("cast", dispatch("less_equal", x, y), dtype=str(g.dtype))
+    gx = dispatch("multiply", g, mask)
+    gy = dispatch("subtract", g, gx)
+    return unbroadcast(gx, x.shape), unbroadcast(gy, y.shape)
+
+
+defop("floor_divide", vjp=False)(lambda x, y: jnp.floor_divide(x, y))
+defop("mod", vjp=False)(lambda x, y: jnp.mod(x, y))
+defop("remainder", vjp=False)(lambda x, y: jnp.remainder(x, y))
+defop("atan2")(lambda x, y: jnp.arctan2(x, y))
+defop("fmax")(lambda x, y: jnp.fmax(x, y))
+defop("fmin")(lambda x, y: jnp.fmin(x, y))
+defop("hypot")(lambda x, y: jnp.hypot(x, y))
+defop("logaddexp")(lambda x, y: jnp.logaddexp(x, y))
+
+# ------------------------------------------------------------------- unary
+
+
+@register_op("neg")
+def _neg(x):
+    return jnp.negative(x)
+
+
+@register_grad("neg")
+def _neg_grad(ctx, g):
+    return (dispatch("neg", g),)
+
+
+@register_op("exp", save_inputs=False, save_outputs=True)
+def _exp(x):
+    return jnp.exp(x)
+
+
+@register_grad("exp")
+def _exp_grad(ctx, g):
+    (out,) = ctx.outputs
+    return (dispatch("multiply", g, out),)
+
+
+@register_op("log")
+def _log(x):
+    return jnp.log(x)
+
+
+@register_grad("log")
+def _log_grad(ctx, g):
+    (x,) = ctx.inputs
+    return (dispatch("divide", g, x),)
+
+
+@register_op("sqrt", save_inputs=False, save_outputs=True)
+def _sqrt(x):
+    return jnp.sqrt(x)
+
+
+@register_grad("sqrt")
+def _sqrt_grad(ctx, g):
+    (out,) = ctx.outputs
+    return (dispatch("divide", g, dispatch("multiply", out, 2.0)),)
+
+
+@register_op("rsqrt", save_inputs=True)
+def _rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+@register_grad("rsqrt")
+def _rsqrt_grad(ctx, g):
+    (x,) = ctx.inputs
+    # d rsqrt = -0.5 * x^{-3/2}
+    return (dispatch("multiply", g, dispatch("multiply",
+            dispatch("pow", x, -1.5), -0.5)),)
+
+
+@register_op("abs")
+def _abs(x):
+    return jnp.abs(x)
+
+
+@register_grad("abs")
+def _abs_grad(ctx, g):
+    (x,) = ctx.inputs
+    return (dispatch("multiply", g, dispatch("sign", x)),)
+
+
+@register_op("square")
+def _square(x):
+    return jnp.square(x)
+
+
+@register_grad("square")
+def _square_grad(ctx, g):
+    (x,) = ctx.inputs
+    return (dispatch("multiply", g, dispatch("multiply", x, 2.0)),)
+
+
+@register_op("reciprocal", save_inputs=False, save_outputs=True)
+def _reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@register_grad("reciprocal")
+def _reciprocal_grad(ctx, g):
+    (out,) = ctx.outputs
+    return (dispatch("neg", dispatch("multiply", g,
+            dispatch("multiply", out, out))),)
+
+
+defop("sign", vjp=False)(lambda x: jnp.sign(x))
+defop("floor", vjp=False)(lambda x: jnp.floor(x))
+defop("ceil", vjp=False)(lambda x: jnp.ceil(x))
+defop("round", vjp=False)(lambda x: jnp.round(x))
+defop("trunc", vjp=False)(lambda x: jnp.trunc(x))
+defop("sin")(lambda x: jnp.sin(x))
+defop("cos")(lambda x: jnp.cos(x))
+defop("tan")(lambda x: jnp.tan(x))
+defop("asin")(lambda x: jnp.arcsin(x))
+defop("acos")(lambda x: jnp.arccos(x))
+defop("atan")(lambda x: jnp.arctan(x))
+defop("sinh")(lambda x: jnp.sinh(x))
+defop("cosh")(lambda x: jnp.cosh(x))
+defop("asinh")(lambda x: jnp.arcsinh(x))
+defop("acosh")(lambda x: jnp.arccosh(x))
+defop("atanh")(lambda x: jnp.arctanh(x))
+defop("erf")(lambda x: jax.scipy.special.erf(x))
+defop("erfinv")(lambda x: jax.scipy.special.erfinv(x))
+defop("expm1")(lambda x: jnp.expm1(x))
+defop("log1p")(lambda x: jnp.log1p(x))
+defop("log2")(lambda x: jnp.log2(x))
+defop("log10")(lambda x: jnp.log10(x))
+defop("digamma")(lambda x: jax.scipy.special.digamma(x))
+defop("lgamma")(lambda x: jax.scipy.special.gammaln(x))
+
+
+@register_op("clip")
+def _clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@register_grad("clip")
+def _clip_grad(ctx, g):
+    (x,) = ctx.inputs
+    lo = ctx.attrs.get("min")
+    hi = ctx.attrs.get("max")
+    mask = None
+    if lo is not None:
+        mask = dispatch("greater_equal", x, float(lo))
+    if hi is not None:
+        m2 = dispatch("less_equal", x, float(hi))
+        mask = m2 if mask is None else dispatch("logical_and", mask, m2)
+    if mask is None:
+        return (g,)
+    return (dispatch("multiply", g, dispatch("cast", mask, dtype=str(g.dtype))),)
+
+
+# -------------------------------------------------------------- comparisons
+
+defop("equal", vjp=False)(lambda x, y: jnp.equal(x, y))
+defop("not_equal", vjp=False)(lambda x, y: jnp.not_equal(x, y))
+defop("greater_than", vjp=False)(lambda x, y: jnp.greater(x, y))
+defop("greater_equal", vjp=False)(lambda x, y: jnp.greater_equal(x, y))
+defop("less_than", vjp=False)(lambda x, y: jnp.less(x, y))
+defop("less_equal", vjp=False)(lambda x, y: jnp.less_equal(x, y))
+defop("logical_and", vjp=False)(lambda x, y: jnp.logical_and(x, y))
+defop("logical_or", vjp=False)(lambda x, y: jnp.logical_or(x, y))
+defop("logical_xor", vjp=False)(lambda x, y: jnp.logical_xor(x, y))
+defop("logical_not", vjp=False)(lambda x: jnp.logical_not(x))
+defop("isnan", vjp=False)(lambda x: jnp.isnan(x))
+defop("isinf", vjp=False)(lambda x: jnp.isinf(x))
+defop("isfinite", vjp=False)(lambda x: jnp.isfinite(x))
+defop("bitwise_and", vjp=False)(lambda x, y: jnp.bitwise_and(x, y))
+defop("bitwise_or", vjp=False)(lambda x, y: jnp.bitwise_or(x, y))
+defop("bitwise_xor", vjp=False)(lambda x, y: jnp.bitwise_xor(x, y))
+defop("bitwise_not", vjp=False)(lambda x: jnp.bitwise_not(x))
+
+
+# ------------------------------------------------------------------- other
+
+@register_op("cast", jit=False)
+def _cast(x, dtype):
+    from ..core import dtype as dtypes
+
+    return x.astype(dtypes.convert_dtype(dtype))
+
+
+@register_grad("cast")
+def _cast_grad(ctx, g):
+    (x,) = ctx.inputs
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        return (None,)
+    return (dispatch("cast", g, dtype=str(x.dtype)),)
+
+
+@register_op("where")
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@register_grad("where")
+def _where_grad(ctx, g):
+    cond, x, y = ctx.inputs
+    zero = dispatch("multiply", g, 0.0)
+    gx = dispatch("where", cond, g, zero)
+    gy = dispatch("where", cond, zero, g)
+    return None, unbroadcast(gx, x.shape), unbroadcast(gy, y.shape)
+
+
+defop("cumsum")(lambda x, axis=None: jnp.cumsum(x, axis=axis))
+defop("cumprod")(lambda x, dim=None: jnp.cumprod(x, axis=dim))
+defop("nan_to_num")(
+    lambda x, nan=0.0, posinf=None, neginf=None:
+    jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf))
+
+
+@register_op("scale")
+def _scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@register_grad("scale")
+def _scale_grad(ctx, g):
+    return (dispatch("multiply", g, float(ctx.attrs.get("scale", 1.0))),)
+
+
+defop("lerp")(lambda x, y, w: x + w * (y - x))
+defop("stanh")(lambda x, scale_a=0.67, scale_b=1.7159: scale_b * jnp.tanh(scale_a * x))

@@ -1,0 +1,161 @@
+"""Reduction ops (reference: python/paddle/tensor/math.py sum/mean/...,
+phi/kernels/reduce_*)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import defop, dispatch, register_grad, register_op
+from ..core.tensor import Tensor
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return (int(axis),)
+
+
+def _expand_grad(ctx, g):
+    """Broadcast a reduced grad back to the input shape."""
+    (x,) = ctx.inputs
+    axis = _norm_axis(ctx.attrs.get("axis"))
+    keepdim = ctx.attrs.get("keepdim", False)
+    xshape = tuple(x.shape)
+    if axis is None:
+        mid_shape = (1,) * len(xshape)
+    else:
+        axis = tuple(a % len(xshape) for a in axis)
+        mid_shape = tuple(1 if i in axis else s for i, s in enumerate(xshape))
+    if not keepdim:
+        g = dispatch("reshape", g, shape=mid_shape)
+    return dispatch("expand", g, shape=xshape)
+
+
+@register_op("sum")
+def _sum(x, axis=None, keepdim=False, dtype=None):
+    out = jnp.sum(x, axis=_norm_axis(axis), keepdims=keepdim)
+    if dtype is not None:
+        out = out.astype(np.dtype(dtype))
+    return out
+
+
+@register_grad("sum")
+def _sum_grad(ctx, g):
+    return (_expand_grad(ctx, g),)
+
+
+@register_op("mean")
+def _mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@register_grad("mean")
+def _mean_grad(ctx, g):
+    (x,) = ctx.inputs
+    axis = _norm_axis(ctx.attrs.get("axis"))
+    xshape = tuple(x.shape)
+    if axis is None:
+        n = int(np.prod(xshape)) if xshape else 1
+    else:
+        n = int(np.prod([xshape[a % len(xshape)] for a in axis]))
+    g = dispatch("divide", g, float(n))
+    return (_expand_grad(ctx, g),)
+
+
+@register_op("max", save_inputs=True, save_outputs=True)
+def _max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@register_op("min", save_inputs=True, save_outputs=True)
+def _min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def _minmax_grad(ctx, g):
+    (x,) = ctx.inputs
+    (out,) = ctx.outputs
+    axis = _norm_axis(ctx.attrs.get("axis"))
+    keepdim = ctx.attrs.get("keepdim", False)
+    xshape = tuple(x.shape)
+    if axis is None:
+        mid_shape = (1,) * len(xshape)
+    else:
+        ax = tuple(a % len(xshape) for a in axis)
+        mid_shape = tuple(1 if i in ax else s for i, s in enumerate(xshape))
+    if not keepdim:
+        out = dispatch("reshape", out, shape=mid_shape)
+        g = dispatch("reshape", g, shape=mid_shape)
+    mask = dispatch("cast", dispatch("equal", x, out), dtype=str(g.dtype))
+    # split grad evenly among ties (matches paddle's reduce_max grad behavior
+    # of flowing to argmax positions; even split keeps it well-defined)
+    cnt = dispatch("sum", mask, axis=ctx.attrs.get("axis"), keepdim=True)
+    return (dispatch("multiply", dispatch("divide", mask, cnt), g),)
+
+
+register_grad("max")(_minmax_grad)
+register_grad("min")(_minmax_grad)
+
+
+@register_op("prod")
+def _prod(x, axis=None, keepdim=False):
+    return jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+from ..core.dispatch import register_vjp_grad  # noqa: E402
+
+register_vjp_grad("prod")
+
+defop("logsumexp")(
+    lambda x, axis=None, keepdim=False:
+    jax.scipy.special.logsumexp(x, axis=_norm_axis(axis), keepdims=keepdim))
+
+defop("all", vjp=False)(
+    lambda x, axis=None, keepdim=False:
+    jnp.all(x, axis=_norm_axis(axis), keepdims=keepdim))
+defop("any", vjp=False)(
+    lambda x, axis=None, keepdim=False:
+    jnp.any(x, axis=_norm_axis(axis), keepdims=keepdim))
+defop("argmax", vjp=False)(
+    lambda x, axis=None, keepdim=False:
+    jnp.argmax(x, axis=axis, keepdims=keepdim).astype(jnp.int64))
+defop("argmin", vjp=False)(
+    lambda x, axis=None, keepdim=False:
+    jnp.argmin(x, axis=axis, keepdims=keepdim).astype(jnp.int64))
+defop("count_nonzero", vjp=False)(
+    lambda x, axis=None, keepdim=False:
+    jnp.count_nonzero(x, axis=_norm_axis(axis), keepdims=keepdim))
+
+
+@register_op("amax")
+def _amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+@register_op("amin")
+def _amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+register_vjp_grad("amax")
+register_vjp_grad("amin")
+
+
+def _var_impl(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+defop("var")(_var_impl)
+defop("std")(lambda x, axis=None, unbiased=True, keepdim=False:
+             jnp.std(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0,
+                     keepdims=keepdim))
+
+
+defop("median")(lambda x, axis=None, keepdim=False:
+                jnp.median(x, axis=axis, keepdims=keepdim))
+defop("quantile")(lambda x, q, axis=None, keepdim=False:
+                  jnp.quantile(x, q, axis=axis, keepdims=keepdim))

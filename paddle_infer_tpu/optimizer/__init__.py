@@ -1,0 +1,11 @@
+"""paddle_infer_tpu.optimizer (reference: python/paddle/optimizer/)."""
+from . import lr
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from .optimizer import (Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
+                        Momentum, Optimizer, RMSProp, SGD)
+
+__all__ = [
+    "lr", "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+    "RMSProp", "Lamb", "Adadelta", "Adamax",
+    "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue",
+]

@@ -1,0 +1,88 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py
+ClipGradByGlobalNorm etc.)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+    def functional_clip(self, grads: dict) -> dict:
+        raise NotImplementedError
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm=1.0):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        grads = {str(i): g._data for i, (p, g) in enumerate(params_grads)
+                 if g is not None and getattr(p, "need_clip", True)}
+        if not grads:
+            return params_grads
+        clipped = self.functional_clip(grads)
+        out = []
+        for i, (p, g) in enumerate(params_grads):
+            if str(i) in clipped:
+                out.append((p, Tensor(clipped[str(i)])))
+            else:
+                out.append((p, g))
+        return out
+
+    def functional_clip(self, grads: dict) -> dict:
+        leaves = jax.tree_util.tree_leaves(grads)
+        global_norm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                   for g in leaves))
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(
+            global_norm, 1e-6))
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm=1.0):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            norm = jnp.linalg.norm(g._data.astype(jnp.float32))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-6))
+            out.append((p, Tensor((g._data.astype(jnp.float32) * scale)
+                                  .astype(g._data.dtype))))
+        return out
+
+    def functional_clip(self, grads: dict) -> dict:
+        def clip_one(g):
+            norm = jnp.linalg.norm(g.astype(jnp.float32))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-6))
+            return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+        return jax.tree_util.tree_map(clip_one, grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor(jnp.clip(g._data, self.min, self.max))))
+        return out
+
+    def functional_clip(self, grads: dict) -> dict:
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)

@@ -1,0 +1,390 @@
+"""Optimizers.
+
+Reference surface: python/paddle/optimizer/optimizer.py:120 (+ adam/sgd/...
+kernels phi/kernels/gpu/adam_kernel.cu).  TPU-first design: every optimizer is
+defined by a *pure update rule* ``_update(param, grad, state, lr) ->
+(new_param, new_state)``.  Eager ``step()`` runs the rule jitted per-param;
+the compile path (jit/fleet) calls ``functional_update`` on whole pytrees so
+the update fuses into the one XLA training-step program.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False):
+        self._lr = learning_rate
+        self._parameters: List[Tensor] = list(parameters) if parameters else []
+        self._weight_decay = weight_decay or 0.0
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._state: Dict[int, dict] = {}
+        self._step_count = 0
+        self._jit_update = jax.jit(self._update)
+
+    # rule ----------------------------------------------------------------
+    def _init_state(self, param) -> dict:
+        return {}
+
+    def _update(self, param, grad, state, lr, step, wd):
+        raise NotImplementedError
+
+    def _param_weight_decay(self, param) -> float:
+        """Per-param decoupled decay coefficient (0 when excluded)."""
+        return float(self._weight_decay or 0.0)
+
+    # lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, lr: float):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = lr
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # step ----------------------------------------------------------------
+    @no_grad()
+    def step(self):
+        self._step_count += 1
+        lr = self.get_lr()
+        grads_and_params = [(p, p.grad) for p in self._parameters
+                            if p.grad is not None and not p.stop_gradient]
+        if self._grad_clip is not None:
+            self._grad_clip_apply(grads_and_params)
+        for p, g in grads_and_params:
+            if g is None:
+                continue
+            st = self._state.get(id(p))
+            if st is None:
+                st = self._init_state(p)
+                self._state[id(p)] = st
+            garr = g._data.astype(p._data.dtype)
+            if self._weight_decay and self._decay_into_grad():
+                garr = garr + self._weight_decay * p._data
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0) \
+                if hasattr(p, "optimize_attr") else lr
+            wd = 0.0 if self._decay_into_grad() else \
+                self._param_weight_decay(p)
+            new_p, new_st = self._jit_update(
+                p._data, garr, st, jnp.asarray(plr, dtype=jnp.float32),
+                jnp.asarray(self._step_count, dtype=jnp.int32),
+                jnp.asarray(wd, dtype=jnp.float32))
+            p._data = new_p
+            self._state[id(p)] = new_st
+
+    def _decay_into_grad(self) -> bool:
+        """L2-style decay folded into the gradient (SGD/Momentum/Adam);
+        AdamW overrides to apply decoupled decay instead."""
+        return True
+
+    def _grad_clip_apply(self, grads_and_params):
+        clipped = self._grad_clip([(p, g) for p, g in grads_and_params])
+        for (p, _), (_, g_new) in zip(grads_and_params, clipped):
+            p.grad = g_new
+        for i, (p, _) in enumerate(grads_and_params):
+            grads_and_params[i] = (p, p.grad)
+
+    def clear_grad(self):
+        for p in self._parameters:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # state dict -----------------------------------------------------------
+    def state_dict(self):
+        out = {"step": self._step_count, "states": []}
+        for i, p in enumerate(self._parameters):
+            st = self._state.get(id(p))
+            if st is not None:
+                out["states"].append(
+                    (i, {k: jax.device_get(v) for k, v in st.items()}))
+        if isinstance(self._lr, LRScheduler):
+            out["lr"] = self._lr.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = state.get("step", 0)
+        for i, st in state.get("states", []):
+            p = self._parameters[i]
+            self._state[id(p)] = {k: jnp.asarray(v) for k, v in st.items()}
+        if "lr" in state and isinstance(self._lr, LRScheduler):
+            self._lr.set_state_dict(state["lr"])
+
+    # functional bridge (compile path) -------------------------------------
+    def functional_init(self, params: dict) -> dict:
+        """params: {name: array} -> state pytree {name: {slot: array}}."""
+        return {n: self._init_state_arr(a) for n, a in params.items()}
+
+    def _init_state_arr(self, arr) -> dict:
+        p = Tensor(arr)
+        return self._init_state(p)
+
+    def functional_update(self, params: dict, grads: dict, state: dict,
+                          lr=None, step=0):
+        """Pure pytree update — the piece pjit compiles into the train step."""
+        lr = jnp.asarray(lr if lr is not None else self.get_lr(),
+                         dtype=jnp.float32)
+        step = jnp.asarray(step, dtype=jnp.int32)
+        if self._grad_clip is not None:
+            grads = self._grad_clip.functional_clip(grads)
+        new_params, new_state = {}, {}
+        for n, p in params.items():
+            g = grads[n].astype(p.dtype)
+            if self._weight_decay and self._decay_into_grad():
+                g = g + self._weight_decay * p
+            wd = 0.0 if self._decay_into_grad() else \
+                self._named_weight_decay(n)
+            new_params[n], new_state[n] = self._update(
+                p, g, state[n], lr, step, jnp.asarray(wd, dtype=jnp.float32))
+        return new_params, new_state
+
+    def _named_weight_decay(self, name: str) -> float:
+        return float(self._weight_decay or 0.0)
+
+    @property
+    def parameters(self):
+        return self._parameters
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _update(self, param, grad, state, lr, step, wd):
+        return param - lr.astype(param.dtype) * grad, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None):
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._data)}
+
+    def _update(self, param, grad, state, lr, step, wd):
+        v = self._momentum * state["velocity"] + grad
+        if self._nesterov:
+            upd = grad + self._momentum * v
+        else:
+            upd = v
+        return param - lr.astype(param.dtype) * upd, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False):
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+
+    def _init_state(self, p):
+        dt = jnp.float32 if self._multi_precision else p._data.dtype
+        st = {"m": jnp.zeros(p._data.shape, dtype=dt),
+              "v": jnp.zeros(p._data.shape, dtype=dt)}
+        if self._multi_precision and p._data.dtype != jnp.float32:
+            st["master"] = p._data.astype(jnp.float32)
+        return st
+
+    def _update(self, param, grad, state, lr, step, wd):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        master = state.get("master")
+        work = master if master is not None else param
+        g = grad.astype(work.dtype)
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * (g * g)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        new_work = work - lr.astype(work.dtype) * mhat / (
+            jnp.sqrt(vhat) + eps)
+        new_state = {"m": m, "v": v}
+        if master is not None:
+            new_state["master"] = new_work
+            return new_work.astype(param.dtype), new_state
+        return new_work, new_state
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 grad_clip=None, lr_ratio=None, apply_decay_param_fun=None,
+                 multi_precision=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip,
+                         multi_precision=multi_precision)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decay_into_grad(self):
+        return False
+
+    def _param_weight_decay(self, param):
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(param.name or "")):
+            return 0.0
+        return float(self._weight_decay or 0.0)
+
+    def _named_weight_decay(self, name):
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(name)):
+            return 0.0
+        return float(self._weight_decay or 0.0)
+
+    def _update(self, param, grad, state, lr, step, wd):
+        # decoupled weight decay (skipped per-param via wd=0)
+        master = state.get("master")
+        work = master if master is not None else param
+        decayed = work * (1 - lr.astype(work.dtype) * wd.astype(work.dtype))
+        if master is not None:
+            state = dict(state, master=decayed)
+            return super()._update(param, grad, state, lr, step, wd)
+        return super()._update(decayed, grad, state, lr, step, wd)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0):
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p._data, self._init_acc)}
+
+    def _update(self, param, grad, state, lr, step, wd):
+        mom = state["moment"] + grad * grad
+        return (param - lr.astype(param.dtype) * grad /
+                (jnp.sqrt(mom) + self._eps), {"moment": mom})
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None):
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _init_state(self, p):
+        st = {"mean_square": jnp.zeros_like(p._data),
+              "momentum": jnp.zeros_like(p._data)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(p._data)
+        return st
+
+    def _update(self, param, grad, state, lr, step, wd):
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * grad * grad
+        new_state = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * grad
+            denom = jnp.sqrt(ms - mg * mg + self._eps)
+            new_state["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum"] + \
+            lr.astype(param.dtype) * grad / denom
+        new_state["momentum"] = mom
+        return param - mom, new_state
+
+
+class Lamb(Optimizer):
+    """LAMB (reference: python/paddle/optimizer/lamb.py, used by fleet's
+    lamb meta-optimizer for large-batch BERT training)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None):
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._lamb_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        super().__init__(learning_rate, parameters, None, grad_clip)
+
+    def _init_state(self, p):
+        return {"m": jnp.zeros_like(p._data, dtype=jnp.float32),
+                "v": jnp.zeros_like(p._data, dtype=jnp.float32)}
+
+    def _decay_into_grad(self):
+        return False
+
+    def _param_weight_decay(self, param):
+        if self._exclude_fn is not None and self._exclude_fn(param):
+            return 0.0
+        return float(self._lamb_decay)
+
+    def _named_weight_decay(self, name):
+        return float(self._lamb_decay)
+
+    def _update(self, param, grad, state, lr, step, wd):
+        b1, b2 = self._beta1, self._beta2
+        g = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * g * g
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p = p32 - lr * trust * r
+        return new_p.astype(param.dtype), {"m": m, "v": v}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None):
+        self._eps, self._rho = epsilon, rho
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _init_state(self, p):
+        return {"avg_sq_grad": jnp.zeros_like(p._data),
+                "avg_sq_update": jnp.zeros_like(p._data)}
+
+    def _update(self, param, grad, state, lr, step, wd):
+        asg = self._rho * state["avg_sq_grad"] + (1 - self._rho) * grad * grad
+        upd = (jnp.sqrt(state["avg_sq_update"] + self._eps) /
+               jnp.sqrt(asg + self._eps)) * grad
+        asu = self._rho * state["avg_sq_update"] + (1 - self._rho) * upd * upd
+        return param - lr.astype(param.dtype) * upd, \
+            {"avg_sq_grad": asg, "avg_sq_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None):
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+
+    def _init_state(self, p):
+        return {"m": jnp.zeros_like(p._data),
+                "u": jnp.zeros_like(p._data)}
+
+    def _update(self, param, grad, state, lr, step, wd):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["m"] + (1 - b1) * grad
+        u = jnp.maximum(b2 * state["u"], jnp.abs(grad))
+        t = step.astype(jnp.float32)
+        lr_t = (lr / (1 - b1 ** t)).astype(param.dtype)
+        return param - lr_t * m / (u + self._eps), {"m": m, "u": u}

@@ -1,0 +1,208 @@
+"""Profiler (reference: python/paddle/profiler/profiler.py:340).
+
+Host-side events use a RecordEvent ring like the reference's
+host_event_recorder; device-side tracing delegates to the XLA/TPU profiler
+(jax.profiler -> xplane, viewable in TensorBoard/XProf) instead of CUPTI.
+Chrome-trace export of host events matches the reference's
+chrometracing_logger.cc output shape.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import List, Optional
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    TPU = 1
+
+
+class _Event:
+    __slots__ = ("name", "start", "end", "tid", "args")
+
+    def __init__(self, name, start, end, tid, args=None):
+        self.name, self.start, self.end, self.tid = name, start, end, tid
+        self.args = args or {}
+
+
+_events: List[_Event] = []
+_events_lock = threading.Lock()
+_recording = False
+
+
+class RecordEvent:
+    """Scoped host event (reference: platform/profiler/event_tracing.h)."""
+
+    def __init__(self, name: str, args=None):
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def begin(self):
+        self._start = time.perf_counter_ns()
+
+    def end(self):
+        if _recording:
+            ev = _Event(self.name, self._start, time.perf_counter_ns(),
+                        threading.get_ident(), self.args)
+            with _events_lock:
+                _events.append(ev)
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0):
+    """reference: paddle.profiler.make_scheduler."""
+    period = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= period * repeat:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        fname = os.path.join(
+            dir_name, f"{worker_name or 'worker'}_{int(time.time())}.json")
+        prof._export_chrome(fname)
+
+    return handler
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, profile_memory=False, with_flops=False):
+        self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._timer_only = timer_only
+        self._xla_trace_dir = None
+        self._step_times = []
+        self._last_step_t = None
+
+    def start(self):
+        global _recording
+        _recording = True
+        self._last_step_t = time.perf_counter()
+        if not self._timer_only:
+            try:
+                import jax
+
+                self._xla_trace_dir = os.environ.get(
+                    "PTI_PROFILE_DIR", "/tmp/pti_profile")
+                jax.profiler.start_trace(self._xla_trace_dir)
+            except Exception:
+                self._xla_trace_dir = None
+
+    def stop(self):
+        global _recording
+        _recording = False
+        if self._xla_trace_dir is not None:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._xla_trace_dir = None
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._step += 1
+        if self._scheduler is not None:
+            self._state = self._scheduler(self._step)
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        import numpy as np
+
+        arr = np.asarray(self._step_times[-20:])
+        return (f"avg step {arr.mean()*1e3:.2f} ms, "
+                f"ips {1.0/max(arr.mean(), 1e-9):.2f} steps/s")
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _export_chrome(self, path):
+        with _events_lock:
+            events = list(_events)
+        trace = {"traceEvents": [
+            {"name": e.name, "ph": "X", "ts": e.start / 1e3,
+             "dur": (e.end - e.start) / 1e3, "pid": 0, "tid": e.tid,
+             "args": e.args} for e in events]}
+        with open(path, "w") as f:
+            json.dump(trace, f)
+
+    def export(self, path, format="json"):
+        self._export_chrome(path)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        with _events_lock:
+            events = list(_events)
+        agg = {}
+        for e in events:
+            name = e.name
+            dur = (e.end - e.start) / 1e6
+            tot, cnt = agg.get(name, (0.0, 0))
+            agg[name] = (tot + dur, cnt + 1)
+        lines = ["name\ttotal_ms\tcount\tavg_ms"]
+        for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
+            lines.append(f"{name}\t{tot:.3f}\t{cnt}\t{tot/cnt:.3f}")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profile(dir_name="/tmp/pti_profile"):
+    """Convenience: XLA device trace for TensorBoard/XProf."""
+    import jax
+
+    jax.profiler.start_trace(dir_name)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

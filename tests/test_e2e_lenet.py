@@ -1,0 +1,76 @@
+"""End-to-end slice: LeNet-MNIST dygraph training (SURVEY.md §7 milestone 4).
+DataLoader -> forward -> CE loss -> backward -> Adam -> accuracy improves."""
+import numpy as np
+
+import paddle_infer_tpu as pit
+import paddle_infer_tpu.nn.functional as F
+from paddle_infer_tpu.io import DataLoader
+from paddle_infer_tpu.models import LeNet
+from paddle_infer_tpu.vision.datasets import MNIST
+
+
+def _accuracy(model, loader):
+    correct = total = 0
+    with pit.no_grad():
+        for img, lbl in loader:
+            logits = model(img)
+            pred = np.argmax(logits.numpy(), axis=-1)
+            correct += int((pred == lbl.numpy().reshape(-1)).sum())
+            total += len(pred)
+    return correct / total
+
+
+def test_lenet_mnist_end_to_end():
+    pit.seed(0)
+    train = MNIST(mode="train", synthetic_size=512)
+    test = MNIST(mode="test", synthetic_size=512)
+    train_loader = DataLoader(train, batch_size=64, shuffle=True,
+                              drop_last=True)
+    test_loader = DataLoader(test, batch_size=64)
+
+    model = LeNet(num_classes=10)
+    opt = pit.optimizer.Adam(learning_rate=2e-3,
+                             parameters=model.parameters())
+
+    acc0 = _accuracy(model, test_loader)
+    losses = []
+    for epoch in range(4):
+        for img, lbl in train_loader:
+            logits = model(img)
+            loss = F.cross_entropy(logits, lbl)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.item()))
+    acc1 = _accuracy(model, test_loader)
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+    assert acc1 > max(acc0, 0.35), (acc0, acc1)
+
+
+def test_lenet_multiworker_loader():
+    train = MNIST(mode="train", synthetic_size=128)
+    loader = DataLoader(train, batch_size=32, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 4
+    assert tuple(batches[0][0].shape) == (32, 1, 28, 28)
+
+
+def test_lenet_checkpoint_resume(tmp_path):
+    pit.seed(0)
+    model = LeNet()
+    opt = pit.optimizer.Adam(parameters=model.parameters())
+    x = pit.randn((2, 1, 28, 28))
+    loss = F.cross_entropy(model(x), pit.to_tensor(np.array([1, 2])))
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    pit.save(model.state_dict(), str(tmp_path / "m.pdparams"))
+    pit.save(opt.state_dict(), str(tmp_path / "m.pdopt"))
+
+    model2 = LeNet()
+    opt2 = pit.optimizer.Adam(parameters=model2.parameters())
+    model2.set_state_dict(pit.load(str(tmp_path / "m.pdparams")))
+    opt2.set_state_dict(pit.load(str(tmp_path / "m.pdopt")))
+    out1 = model(x).numpy()
+    out2 = model2(x).numpy()
+    np.testing.assert_allclose(out1, out2, atol=1e-6)

@@ -1,0 +1,313 @@
+"""Layer/optimizer/amp behavior (reference analog: unittests/test_layers.py)."""
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+import paddle_infer_tpu.nn as nn
+import paddle_infer_tpu.nn.functional as F
+
+
+class TestLayerBase:
+    def test_parameters_and_state_dict(self):
+        m = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+        names = [n for n, _ in m.named_parameters()]
+        assert names == ["0.weight", "0.bias", "2.weight", "2.bias"]
+        sd = m.state_dict()
+        assert set(sd) == set(names)
+        # round trip with modification
+        new_w = np.zeros((3, 4), np.float32)
+        sd["0.weight"] = pit.to_tensor(new_w)
+        m.set_state_dict(sd)
+        np.testing.assert_allclose(m[0].weight.numpy(), new_w)
+
+    def test_train_eval_propagates(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        m.train()
+        assert m[1].training
+
+    def test_buffers(self):
+        bn = nn.BatchNorm2D(3)
+        assert "“_mean”".strip("“”") in dict(bn.named_buffers()) or \
+            "_mean" in dict(bn.named_buffers())
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+    def test_apply_and_sublayers(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
+        assert len(m.sublayers()) == 3
+        seen = []
+        m.apply(lambda l: seen.append(type(l).__name__))
+        assert "Sequential" in seen and "Linear" in seen
+
+
+class TestLayers:
+    def test_linear(self):
+        layer = nn.Linear(4, 3)
+        x = np.random.rand(2, 4).astype(np.float32)
+        out = layer(pit.to_tensor(x))
+        ref = x @ layer.weight.numpy() + layer.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        idx = pit.to_tensor(np.array([[1, 2], [3, 4]]))
+        out = emb(idx)
+        assert tuple(out.shape) == (2, 2, 4)
+        np.testing.assert_allclose(out.numpy()[0, 0],
+                                   emb.weight.numpy()[1])
+
+    def test_layer_norm(self):
+        ln = nn.LayerNorm(8)
+        x = np.random.rand(4, 8).astype(np.float32) * 5
+        out = ln(pit.to_tensor(x)).numpy()
+        ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+            x.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    def test_batch_norm_train_eval(self):
+        bn = nn.BatchNorm2D(3, momentum=0.5)
+        x = np.random.rand(4, 3, 5, 5).astype(np.float32) + 2.0
+        out = bn(pit.to_tensor(x))
+        # running stats moved toward batch stats
+        assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+        bn.eval()
+        out_eval = bn(pit.to_tensor(x))
+        assert out_eval.shape == out.shape
+
+    def test_dropout(self):
+        do = nn.Dropout(0.5)
+        x = pit.ones((1000,))
+        out = do(x)
+        kept = float((out.numpy() != 0).mean())
+        assert 0.3 < kept < 0.7
+        do.eval()
+        np.testing.assert_allclose(do(x).numpy(), x.numpy())
+
+    def test_multi_head_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = pit.randn((2, 5, 16))
+        out = mha(x)
+        assert tuple(out.shape) == (2, 5, 16)
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = pit.randn((2, 5, 16))
+        out = enc(x)
+        assert tuple(out.shape) == (2, 5, 16)
+
+    def test_sdpa_causal(self):
+        q = pit.randn((1, 4, 2, 8))
+        out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        assert tuple(out.shape) == (1, 4, 2, 8)
+
+
+class TestOptimizers:
+    def _fit(self, opt_cls, **kw):
+        pit.seed(42)
+        m = nn.Linear(3, 1)
+        opt = opt_cls(parameters=m.parameters(), **kw)
+        X = np.random.rand(32, 3).astype(np.float32)
+        Y = (X @ np.array([[1.], [2.], [-1.]], np.float32))
+        first = None
+        for _ in range(60):
+            loss = F.mse_loss(m(pit.to_tensor(X)), pit.to_tensor(Y))
+            if first is None:
+                first = float(loss.item())
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.item()) < first * 0.7, \
+            f"{opt_cls.__name__}: {first} -> {float(loss.item())}"
+
+    def test_sgd(self):
+        self._fit(pit.optimizer.SGD, learning_rate=0.1)
+
+    def test_momentum(self):
+        self._fit(pit.optimizer.Momentum, learning_rate=0.05, momentum=0.9)
+
+    def test_adam(self):
+        self._fit(pit.optimizer.Adam, learning_rate=0.05)
+
+    def test_adamw(self):
+        self._fit(pit.optimizer.AdamW, learning_rate=0.05, weight_decay=0.01)
+
+    def test_lamb(self):
+        self._fit(pit.optimizer.Lamb, learning_rate=0.05)
+
+    def test_rmsprop(self):
+        self._fit(pit.optimizer.RMSProp, learning_rate=0.02)
+
+    def test_grad_clip_global_norm(self):
+        m = nn.Linear(3, 1)
+        clip = pit.optimizer.ClipGradByGlobalNorm(0.001)
+        opt = pit.optimizer.SGD(learning_rate=1.0, parameters=m.parameters(),
+                                grad_clip=clip)
+        before = m.weight.numpy().copy()
+        loss = (m(pit.ones((4, 3))) * 100).sum()
+        loss.backward()
+        opt.step()
+        moved = np.abs(m.weight.numpy() - before).sum()
+        assert moved < 0.01  # clipped to tiny norm
+
+    def test_lr_scheduler(self):
+        sched = pit.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.1)
+        m = nn.Linear(2, 1)
+        opt = pit.optimizer.SGD(learning_rate=sched,
+                                parameters=m.parameters())
+        assert abs(opt.get_lr() - 0.1) < 1e-9
+        sched.step()
+        sched.step()
+        assert abs(opt.get_lr() - 0.01) < 1e-9
+
+    def test_optimizer_state_dict(self):
+        m = nn.Linear(2, 2)
+        opt = pit.optimizer.Adam(parameters=m.parameters())
+        loss = m(pit.ones((1, 2))).sum()
+        loss.backward()
+        opt.step()
+        st = opt.state_dict()
+        opt2 = pit.optimizer.Adam(parameters=m.parameters())
+        opt2.set_state_dict(st)
+        assert opt2._step_count == 1
+
+
+class TestAMP:
+    def test_autocast_bf16_matmul(self):
+        import jax.numpy as jnp
+
+        a = pit.randn((4, 4))
+        with pit.amp.auto_cast():
+            out = pit.matmul(a, a)
+        assert out.dtype == jnp.bfloat16
+
+    def test_grad_scaler_disabled_path(self):
+        m = nn.Linear(2, 1)
+        opt = pit.optimizer.SGD(learning_rate=0.1,
+                                parameters=m.parameters())
+        scaler = pit.amp.GradScaler(enable=False)
+        loss = m(pit.ones((1, 2))).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+
+    def test_grad_scaler_enabled(self):
+        m = nn.Linear(2, 1)
+        opt = pit.optimizer.SGD(learning_rate=0.01,
+                                parameters=m.parameters())
+        scaler = pit.amp.GradScaler(enable=True, init_loss_scaling=8.0)
+        before = m.weight.numpy().copy()
+        loss = m(pit.ones((1, 2))).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        assert not np.allclose(m.weight.numpy(), before)
+
+
+class TestSaveLoad:
+    def test_save_load_state(self, tmp_path):
+        m = nn.Sequential(nn.Linear(3, 3), nn.Linear(3, 3))
+        path = str(tmp_path / "model.pdparams")
+        pit.save(m.state_dict(), path)
+        m2 = nn.Sequential(nn.Linear(3, 3), nn.Linear(3, 3))
+        m2.set_state_dict(pit.load(path))
+        for (n1, p1), (n2, p2) in zip(m.named_parameters(),
+                                      m2.named_parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy())
+
+
+class TestToStatic:
+    def test_matches_eager(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.LayerNorm(8))
+        x = pit.randn((2, 4))
+        eager = m(x).numpy()
+        sm = pit.jit.to_static(m)
+        static = sm(x).numpy()
+        np.testing.assert_allclose(eager, static, atol=1e-5)
+
+    def test_function_wrap(self):
+        @pit.jit.to_static
+        def fn(a, b):
+            return a * b + a
+
+        x = pit.randn((3,))
+        y = pit.randn((3,))
+        np.testing.assert_allclose(fn(x, y).numpy(),
+                                   (x * y + x).numpy(), atol=1e-6)
+
+    def test_bn_buffer_update_through_static(self):
+        bn = nn.BatchNorm2D(2, momentum=0.5)
+        sm = pit.jit.to_static(bn)
+        x = pit.randn((4, 2, 3, 3)) + 3.0
+        sm(x)
+        assert not np.allclose(bn._mean.numpy(), np.zeros(2))
+
+
+class TestReviewRegressions:
+    """Regression coverage for the pre-commit review findings."""
+
+    def test_hook_registered_after_op(self):
+        x = pit.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        y = (x * 3).sum()
+        fired = []
+        x.register_hook(lambda g: fired.append(1) or g * 2)
+        y.backward()
+        assert fired, "hook registered after taping must still fire"
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+    def test_max_pool_ceil_mode(self):
+        x = pit.to_tensor(np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5))
+        out = F.max_pool2d(x, 2, stride=2, ceil_mode=True)
+        assert tuple(out.shape) == (1, 1, 3, 3)
+        assert out.numpy()[0, 0, 2, 2] == 24.0
+        out_floor = F.max_pool2d(x, 2, stride=2, ceil_mode=False)
+        assert tuple(out_floor.shape) == (1, 1, 2, 2)
+
+    def test_avg_pool_ceil_mode_counts(self):
+        x = pit.ones((1, 1, 5, 5))
+        out = F.avg_pool2d(x, 2, stride=2, ceil_mode=True)
+        # partial windows hold only real ones -> average stays 1.0
+        np.testing.assert_allclose(out.numpy(), np.ones((1, 1, 3, 3)),
+                                   atol=1e-6)
+
+    def test_adamw_decay_exclusion(self):
+        m = nn.Linear(4, 4)
+        opt = pit.optimizer.AdamW(
+            learning_rate=0.1, parameters=m.parameters(), weight_decay=0.5,
+            apply_decay_param_fun=lambda n: "bias" not in n)
+        b_before = m.bias.numpy().copy()
+        w_before = m.weight.numpy().copy()
+        # zero gradient -> pure decay effect
+        m.bias.grad = pit.zeros((4,))
+        m.weight.grad = pit.zeros((4, 4))
+        opt.step()
+        np.testing.assert_allclose(m.bias.numpy(), b_before, atol=1e-7)
+        assert not np.allclose(m.weight.numpy(), w_before)
+
+    def test_dropout_p1(self):
+        out = F.dropout(pit.ones((8,)), p=1.0, training=True)
+        np.testing.assert_allclose(out.numpy(), np.zeros(8))
+
+    def test_cross_entropy_weighted_2d_label(self):
+        logits = pit.randn((4, 3))
+        label = pit.to_tensor(np.array([[0], [1], [2], [1]]))
+        w = pit.to_tensor(np.array([1.0, 2.0, 0.5], np.float32))
+        loss = F.cross_entropy(logits, label, weight=w)
+        assert loss.size == 1
+
+    def test_interpolate_nearest_size(self):
+        x = pit.to_tensor(np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2))
+        out = F.interpolate(x, size=(4, 4), mode="nearest")
+        vals = set(np.unique(out.numpy()).tolist())
+        assert vals <= {0.0, 1.0, 2.0, 3.0}
+
+    def test_conv_transpose_output_padding(self):
+        x = pit.randn((1, 2, 4, 4))
+        w = pit.nn.Conv2DTranspose(2, 3, 3, stride=2, padding=1,
+                                   output_padding=1)
+        out = w(x)
+        assert tuple(out.shape) == (1, 3, 8, 8)
+        # the appended border must carry real contributions, not zeros
+        assert np.abs(out.numpy()[:, :, -1, :]).sum() > 0

@@ -1,0 +1,343 @@
+"""Op correctness vs numpy (reference analog: unittests/test_*_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from op_test import check_output, check_grad
+
+
+class TestElementwise:
+    def test_add(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        check_output("add", lambda a, b: a + b, [x, y])
+        check_grad("add", [x, y])
+
+    def test_add_broadcast(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(4).astype(np.float32)
+        check_output("add", lambda a, b: a + b, [x, y])
+        check_grad("add", [x, y])
+
+    def test_mul_div_sub(self):
+        x = np.random.rand(2, 5).astype(np.float32) + 0.5
+        y = np.random.rand(2, 5).astype(np.float32) + 0.5
+        check_output("multiply", lambda a, b: a * b, [x, y])
+        check_output("divide", lambda a, b: a / b, [x, y])
+        check_output("subtract", lambda a, b: a - b, [x, y])
+        check_grad("multiply", [x, y])
+        check_grad("divide", [x, y])
+
+    def test_pow(self):
+        x = np.random.rand(3, 3).astype(np.float32) + 0.5
+        y = np.full((3, 3), 2.0, dtype=np.float32)
+        check_output("pow", lambda a, b: a ** b, [x, y])
+        check_grad("pow", [x, y])
+
+    def test_unary(self):
+        x = np.random.rand(4, 4).astype(np.float32) + 0.1
+        check_output("exp", np.exp, [x])
+        check_output("log", np.log, [x])
+        check_output("sqrt", np.sqrt, [x])
+        check_output("abs", np.abs, [x])
+        check_output("tanh", np.tanh, [x])
+        check_grad("exp", [x])
+        check_grad("log", [x])
+        check_grad("sqrt", [x])
+        check_grad("tanh", [x])
+
+    def test_maximum_minimum(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        check_output("maximum", np.maximum, [x, y])
+        check_output("minimum", np.minimum, [x, y])
+        check_grad("maximum", [x, y])
+
+    def test_clip(self):
+        x = np.random.randn(5, 5).astype(np.float32)
+        check_output("clip", lambda a, min, max: np.clip(a, min, max), [x],
+                     {"min": -0.5, "max": 0.5})
+
+
+class TestReduction:
+    def test_sum(self):
+        x = np.random.rand(3, 4, 5).astype(np.float32)
+        check_output("sum", lambda a: np.sum(a), [x])
+        check_output("sum", lambda a, axis, keepdim: np.sum(a, axis=axis,
+                                                            keepdims=keepdim),
+                     [x], {"axis": 1, "keepdim": False})
+        check_grad("sum", [x], {"axis": (0, 2), "keepdim": True})
+
+    def test_mean(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        check_output("mean", lambda a: np.mean(a), [x])
+        check_grad("mean", [x])
+        check_grad("mean", [x], {"axis": 1, "keepdim": False})
+
+    def test_max_min(self):
+        x = np.random.rand(3, 7).astype(np.float32)
+        check_output("max", lambda a, axis, keepdim: np.max(a, axis=axis,
+                                                            keepdims=keepdim),
+                     [x], {"axis": 1, "keepdim": False})
+        check_grad("max", [x], {"axis": 1, "keepdim": False})
+
+    def test_prod_logsumexp(self):
+        x = np.random.rand(3, 4).astype(np.float32) + 0.5
+        check_output("prod", lambda a: np.prod(a), [x], atol=1e-4)
+        from scipy.special import logsumexp as sp_lse  # noqa
+
+    def test_argmax(self):
+        x = np.random.rand(3, 7).astype(np.float32)
+        out = pit.argmax(pit.to_tensor(x), axis=1)
+        np.testing.assert_array_equal(out.numpy(), np.argmax(x, axis=1))
+
+
+class TestMatmul:
+    def test_matmul_2d(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(4, 5).astype(np.float32)
+        check_output("matmul", lambda a, b: a @ b, [x, y])
+        check_grad("matmul", [x, y])
+
+    def test_matmul_transpose(self):
+        x = np.random.rand(4, 3).astype(np.float32)
+        y = np.random.rand(5, 4).astype(np.float32)
+        check_output("matmul",
+                     lambda a, b, transpose_x, transpose_y: a.T @ b.T,
+                     [x, y], {"transpose_x": True, "transpose_y": True})
+        check_grad("matmul", [x, y],
+                   {"transpose_x": True, "transpose_y": True})
+
+    def test_matmul_batched(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(2, 4, 5).astype(np.float32)
+        check_output("matmul", lambda a, b: a @ b, [x, y])
+        check_grad("matmul", [x, y])
+
+    def test_matmul_broadcast_batch(self):
+        x = np.random.rand(2, 2, 3, 4).astype(np.float32)
+        y = np.random.rand(4, 5).astype(np.float32)
+        check_output("matmul", lambda a, b: a @ b, [x, y])
+        check_grad("matmul", [x, y])
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        check_output("reshape", lambda a, shape: a.reshape(shape), [x],
+                     {"shape": (6, 4)})
+        check_output("transpose", lambda a, perm: a.transpose(perm), [x],
+                     {"perm": (2, 0, 1)})
+        check_grad("reshape", [x], {"shape": (4, 6)})
+        check_grad("transpose", [x], {"perm": (1, 0, 2)})
+
+    def test_concat_split_stack(self):
+        x = np.random.rand(2, 3).astype(np.float32)
+        y = np.random.rand(2, 3).astype(np.float32)
+        out = pit.concat([pit.to_tensor(x), pit.to_tensor(y)], axis=0) \
+            if False else None
+        t = pit.ops.concat(pit.to_tensor(x), pit.to_tensor(y), axis=0)
+        np.testing.assert_allclose(t.numpy(), np.concatenate([x, y], axis=0))
+        check_grad("concat", [x, y], {"axis": 1})
+        check_grad("stack", [x, y], {"axis": 0})
+
+    def test_getitem_grad(self):
+        x = np.random.rand(4, 5).astype(np.float32)
+        t = pit.to_tensor(x, stop_gradient=False)
+        y = t[1:3]
+        y.sum().backward()
+        expect = np.zeros_like(x)
+        expect[1:3] = 1.0
+        np.testing.assert_allclose(t.grad.numpy(), expect)
+
+    def test_gather(self):
+        x = np.random.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        t = pit.to_tensor(x, stop_gradient=False)
+        out = pit.gather(t, pit.to_tensor(idx), axis=0)
+        np.testing.assert_allclose(out.numpy(), x[idx])
+        out.sum().backward()
+        expect = np.zeros_like(x)
+        expect[idx] = 1.0
+        np.testing.assert_allclose(t.grad.numpy(), expect)
+
+    def test_topk_where(self):
+        x = np.random.rand(3, 8).astype(np.float32)
+        vals, idx = pit.topk(pit.to_tensor(x), k=3, axis=-1)
+        np.testing.assert_allclose(vals.numpy(),
+                                   -np.sort(-x, axis=-1)[:, :3])
+        cond = x > 0.5
+        out = pit.where(pit.to_tensor(cond), pit.to_tensor(x),
+                        pit.to_tensor(-x))
+        np.testing.assert_allclose(out.numpy(), np.where(cond, x, -x))
+
+
+class TestActivations:
+    def test_softmax(self):
+        x = np.random.randn(3, 5).astype(np.float32)
+
+        def np_softmax(a, axis):
+            e = np.exp(a - a.max(axis=axis, keepdims=True))
+            return e / e.sum(axis=axis, keepdims=True)
+
+        check_output("softmax", np_softmax, [x], {"axis": -1})
+        check_grad("softmax", [x], {"axis": -1})
+
+    def test_relu_gelu_sigmoid(self):
+        x = np.random.randn(4, 4).astype(np.float32)
+        check_output("relu", lambda a: np.maximum(a, 0), [x])
+        check_grad("sigmoid", [x])
+        check_grad("gelu", [x])
+        check_grad("silu", [x])
+
+    def test_log_softmax(self):
+        x = np.random.randn(3, 5).astype(np.float32)
+        check_grad("log_softmax", [x], {"axis": -1})
+
+
+class TestAutogradEngine:
+    def test_chain(self):
+        x = pit.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                          stop_gradient=False)
+        y = (x * x + x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy() + 1)
+
+    def test_shared_subgraph(self):
+        x = pit.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        a = x * 3.0
+        y = a * a
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [36.0])
+
+    def test_accumulate_multiple_backward(self):
+        x = pit.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0, 5.0])
+
+    def test_retain_graph(self):
+        x = pit.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 4.0])
+
+    def test_no_retain_raises(self):
+        x = pit.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_no_grad(self):
+        x = pit.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        with pit.no_grad():
+            y = x * 2
+        assert y._grad_node is None
+
+    def test_grad_api(self):
+        x = pit.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        y = pit.to_tensor(np.array([4.0], np.float32), stop_gradient=False)
+        z = x * x * y
+        gx, = pit.grad(z, [x])
+        np.testing.assert_allclose(gx.numpy(), [24.0])
+        assert x.grad is None  # paddle.grad doesn't write .grad
+
+    def test_grad_create_graph_double_backward(self):
+        x = pit.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = x * x * x
+        gx, = pit.grad(y, [x], create_graph=True)
+        np.testing.assert_allclose(gx.numpy(), [12.0])
+        gx2, = pit.grad(gx, [x])
+        np.testing.assert_allclose(gx2.numpy(), [12.0])  # d2/dx2 x^3 = 6x
+
+    def test_hook(self):
+        x = pit.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(g.numpy().copy())
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 3).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+    def test_unused_input_allow(self):
+        x = pit.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        y = pit.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        z = (x * 2).sum()
+        gx, gy = pit.grad(z, [x, y], allow_unused=True)
+        assert gy is None
+        np.testing.assert_allclose(gx.numpy(), [2.0, 2.0])
+
+
+class TestLoss:
+    def test_softmax_ce(self):
+        logits = np.random.randn(4, 10).astype(np.float32)
+        labels = np.random.randint(0, 10, (4,))
+
+        t = pit.to_tensor(logits, stop_gradient=False)
+        loss = pit.nn.functional.cross_entropy(t, pit.to_tensor(labels))
+        # numpy reference
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        ref = -np.log(sm[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+        loss.backward()
+        grad_ref = sm.copy()
+        grad_ref[np.arange(4), labels] -= 1
+        grad_ref /= 4
+        np.testing.assert_allclose(t.grad.numpy(), grad_ref, atol=1e-5)
+
+    def test_mse(self):
+        x = np.random.rand(3, 3).astype(np.float32)
+        y = np.random.rand(3, 3).astype(np.float32)
+        out = pit.nn.functional.mse_loss(pit.to_tensor(x), pit.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), ((x - y) ** 2).mean(),
+                                   rtol=1e-6)
+
+
+class TestConv:
+    def test_conv2d_shape_and_grad(self):
+        x = np.random.rand(2, 3, 8, 8).astype(np.float32)
+        w = np.random.rand(4, 3, 3, 3).astype(np.float32)
+        out = check_output(
+            "conv2d",
+            lambda a, b, stride, padding, dilation, groups:
+            _np_conv2d(a, b, stride, padding),
+            [x, w], {"stride": 1, "padding": 1, "dilation": 1, "groups": 1},
+            atol=1e-4)
+        assert tuple(out.shape) == (2, 4, 8, 8)
+        tx = pit.to_tensor(x, stop_gradient=False)
+        tw = pit.to_tensor(w, stop_gradient=False)
+        y = pit.nn.functional.conv2d(tx, tw, padding=1)
+        y.sum().backward()
+        assert tx.grad is not None and tw.grad is not None
+        assert tuple(tx.grad.shape) == x.shape
+
+    def test_pool(self):
+        x = np.random.rand(1, 2, 4, 4).astype(np.float32)
+        out = pit.nn.functional.max_pool2d(pit.to_tensor(x), 2)
+        ref = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+        np.testing.assert_allclose(out.numpy(), ref)
+        out = pit.nn.functional.avg_pool2d(pit.to_tensor(x), 2)
+        ref = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+
+def _np_conv2d(x, w, stride, padding):
+    n, c, h, wd = x.shape
+    oc, ic, kh, kw = w.shape
+    xp = np.pad(x, [(0, 0), (0, 0), (padding, padding), (padding, padding)])
+    oh = (h + 2 * padding - kh) // stride + 1
+    ow = (wd + 2 * padding - kw) // stride + 1
+    out = np.zeros((n, oc, oh, ow), dtype=np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("ncij,ocij->no", patch, w)
+    return out
