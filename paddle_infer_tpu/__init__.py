@@ -47,6 +47,7 @@ from .framework import save, load, set_flags, get_flags, flags
 from .framework.io import save_state_dict, load_state_dict
 
 import paddle_infer_tpu.distributed as distributed  # noqa: F401
+from . import parallel  # noqa: F401
 
 
 def is_compiled_with_cuda():

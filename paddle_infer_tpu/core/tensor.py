@@ -230,7 +230,8 @@ def _thaw_index(idx):
 class Parameter(Tensor):
     """Trainable tensor: ``stop_gradient=False`` by default, persistable."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
+                 "dist_attr")
 
     def __init__(self, data, name=None, trainable=True):
         super().__init__(data, stop_gradient=not trainable, name=name)
@@ -239,3 +240,8 @@ class Parameter(Tensor):
         self.optimize_attr = {"learning_rate": 1.0}
         self.regularizer = None
         self.need_clip = True
+        # Per-dim mesh-axis names (PartitionSpec entries) or None; consumed by
+        # the fleet train-step builder to shard this parameter over the mesh
+        # (the analog of the reference's per-layer is_mp_parameter split
+        # attrs, fleet/layers/mpu/mp_layers.py).
+        self.dist_attr = None

@@ -1,0 +1,63 @@
+"""paddle.distributed.* collective API surface (reference:
+python/paddle/distributed/collective.py) — thin veneer over
+parallel/collective.py's mesh-axis collectives."""
+from __future__ import annotations
+
+from ..parallel.collective import (Group, ReduceOp, all_gather, all_reduce,
+                                   alltoall, barrier, broadcast, new_group,
+                                   ppermute, reduce, reduce_scatter)
+
+ProcessGroup = Group
+
+
+def scatter(tensor, src: int = 0, group=None):
+    """Rank ``src``'s dim-0 chunks distributed one per rank
+    (reference: collective.py scatter) — broadcast + local slice under SPMD:
+    the sharded layout itself IS the scatter, so this is broadcast."""
+    return broadcast(tensor, src=src, group=group)
+
+
+def _current_group_rank(group):
+    from ..parallel import topology
+
+    hcg = topology.get_hybrid_communicate_group()
+    if hcg is None:
+        return 0
+    axis = group.axis[0] if group is not None else "dp"
+    getters = {"dp": hcg.get_data_parallel_rank,
+               "mp": hcg.get_model_parallel_rank,
+               "pp": hcg.get_stage_id,
+               "sharding": hcg.get_sharding_parallel_rank,
+               "sep": hcg.get_sep_parallel_rank}
+    return getters.get(axis, lambda: 0)()
+
+
+def send(tensor, dst: int, group=None, src: int = None):
+    """P2P send (reference: collective.py:1440).  Under single-controller
+    SPMD a send is the src half of one compiled src→dst transfer; ``src``
+    defaults to this process's rank on the group axis."""
+    from ..parallel.collective import _default_group, p2p_transfer
+
+    g = group or _default_group()
+    src = _current_group_rank(g) if src is None else src
+    return p2p_transfer(tensor, src=src, dst=dst, group=g)
+
+
+def recv(tensor, src: int, group=None, dst: int = None):
+    """P2P recv — the dst half of the same compiled transfer
+    (reference: collective.py:1518)."""
+    from ..parallel.collective import _default_group, p2p_transfer
+
+    g = group or _default_group()
+    dst = _current_group_rank(g) if dst is None else dst
+    return p2p_transfer(tensor, src=src, dst=dst, group=g)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """No-op: XLA programs are stream-ordered; jax.block_until_ready for
+    host-side sync (reference: collective.py wait)."""
+    import jax
+
+    if hasattr(tensor, "_data"):
+        jax.block_until_ready(tensor._data)
+    return tensor

@@ -1,0 +1,65 @@
+"""DataParallel wrapper (reference: python/paddle/fluid/dygraph/parallel.py:437
+``class DataParallel`` + the C++ EagerReducer, collective/reducer.h:88).
+
+The reference hooks leaf-grad accumulation to bucket gradients and launch
+fused NCCL all-reduces.  Under single-controller SPMD the gradient reduction
+is compiled into the train-step program (fleet.FleetTrainStep over the "dp"
+axis), so this wrapper's job reduces to (a) API parity and (b) *eager-mode*
+grad averaging for code that calls loss.backward() outside a compiled step:
+after backward, ``apply_collective_grads`` all-reduces every parameter grad
+over the dp axis — semantically EagerReducer's fused allreduce, with XLA
+collective-combining doing the bucketing.
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from ..parallel import collective, topology
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size: int = 25,
+                 last_comm_buffer_size: int = 1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self._group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def _dp_group(self):
+        if self._group is not None:
+            return self._group
+        hcg = topology.get_hybrid_communicate_group()
+        if hcg is not None:
+            return hcg.get_data_parallel_group()
+        mesh = topology.get_current_mesh()
+        if mesh is not None and "dp" in mesh.axis_names:
+            return collective.Group(mesh, "dp")
+        return None
+
+    def apply_collective_grads(self):
+        """Average grads over the dp axis (EagerReducer semantics)."""
+        group = self._dp_group()
+        if group is None or group.nranks == 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                collective.all_reduce(p.grad, op=collective.ReduceOp.AVG,
+                                      group=group)
+
+    # pass-throughs so the wrapper is transparent
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    scale_loss = staticmethod(lambda loss: loss)

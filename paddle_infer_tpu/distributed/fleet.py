@@ -1,0 +1,44 @@
+"""paddle.distributed.fleet facade (reference: fleet/fleet.py:107).
+
+Re-exports the mesh-native implementation in parallel/fleet.py plus the
+meta-parallel layer zoo, so user code reads like the reference:
+
+    from paddle_infer_tpu.distributed import fleet
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(opt)
+"""
+from __future__ import annotations
+
+from ..parallel.fleet import (DistributedStrategy, FleetTrainStep,
+                              distributed_model, distributed_optimizer,
+                              fleet_strategy, get_hybrid_communicate_group,
+                              init)
+from ..parallel.topology import (CommunicateTopology,
+                                 HybridCommunicateGroup)
+from ..parallel.mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                                  RowParallelLinear, VocabParallelEmbedding)
+from ..parallel.random import get_rng_state_tracker
+
+# namespace parity with fleet.meta_parallel
+class meta_parallel:
+    ColumnParallelLinear = ColumnParallelLinear
+    RowParallelLinear = RowParallelLinear
+    VocabParallelEmbedding = VocabParallelEmbedding
+    ParallelCrossEntropy = ParallelCrossEntropy
+
+    @staticmethod
+    def get_rng_state_tracker():
+        return get_rng_state_tracker()
+
+
+def worker_num():
+    import jax
+
+    return jax.process_count()
+
+
+def worker_index():
+    import jax
+
+    return jax.process_index()

@@ -1,0 +1,64 @@
+"""Launch utilities (reference: python/paddle/distributed/launch/main.py:18
+``python -m paddle.distributed.launch`` and distributed/spawn.py).
+
+On TPU pods the launcher's job is thinner than the reference's (no pod/rank
+env fabrication per GPU — one process per host, chips auto-discovered):
+``spawn`` forks worker processes with the coordination-service env the
+jax.distributed bootstrap (distributed/env.py) consumes; ``main`` is the
+module CLI: ``python -m paddle_infer_tpu.distributed.launch train.py``.
+"""
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+import multiprocessing as mp
+
+
+def _worker(fn, args, env, idx):
+    os.environ.update(env)
+    os.environ["PTI_PROCESS_ID"] = str(idx)
+    fn(*args)
+
+
+def spawn(func, args=(), nprocs: int = 1, join: bool = True,
+          coordinator_port: int = 12355, **options):
+    """Run ``func`` in ``nprocs`` processes (reference: distributed/spawn.py).
+    Sets the coordination-service env so each process can
+    ``init_parallel_env()``."""
+    if nprocs == 1:
+        func(*args)
+        return None
+    ctx = mp.get_context("spawn")
+    env = {
+        "PTI_COORDINATOR_ADDR": f"127.0.0.1:{coordinator_port}",
+        "PTI_NUM_PROCESSES": str(nprocs),
+    }
+    procs = []
+    for i in range(nprocs):
+        p = ctx.Process(target=_worker, args=(func, args, env, i))
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode]
+        if bad:
+            raise RuntimeError(f"spawned workers failed: exit codes {bad}")
+    return procs
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print("usage: python -m paddle_infer_tpu.distributed.launch "
+              "script.py [args...]")
+        return 1
+    script, *rest = argv
+    sys.argv = [script] + rest
+    runpy.run_path(script, run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

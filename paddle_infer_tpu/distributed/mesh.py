@@ -1,0 +1,43 @@
+"""ProcessMesh / DeviceMesh (reference: python/paddle/distributed/
+auto_parallel/process_mesh.py) — thin aliases over jax.sharding.Mesh so
+auto-parallel-style user code has a home."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from ..parallel import topology
+
+
+class ProcessMesh:
+    """An n-D logical processor grid with named dims."""
+
+    def __init__(self, mesh: Sequence, dim_names: Optional[Sequence[str]] = None,
+                 process_ids=None):
+        arr = np.asarray(mesh)
+        self.shape = list(arr.shape)
+        self.ndim = arr.ndim
+        self.dim_names = list(dim_names) if dim_names else [
+            f"d{i}" for i in range(arr.ndim)]
+        self.process_ids = arr.ravel().tolist()
+
+    def to_jax_mesh(self) -> Mesh:
+        devices = np.asarray(jax.devices())[
+            np.asarray(self.process_ids)].reshape(self.shape)
+        return Mesh(devices, tuple(self.dim_names))
+
+
+DeviceMesh = ProcessMesh
+
+def set_mesh(mesh):
+    if isinstance(mesh, ProcessMesh):
+        mesh = mesh.to_jax_mesh()
+    topology.set_current_mesh(mesh)
+    return mesh
+
+
+def get_mesh():
+    return topology.get_current_mesh()

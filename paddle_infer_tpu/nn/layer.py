@@ -307,6 +307,15 @@ class Layer:
                 else:
                     named[n]._data = arr
 
+    def functional_caller(self, params, buffers=None):
+        """A callable standing in for this layer with ``params`` payloads —
+        what fleet's compiled train step passes to user loss functions.
+        Sublayer access returns a caller scoped to that sublayer (params
+        filtered by prefix), so loss functions may call ``m.decoder(x)``
+        etc. without bypassing the traced parameters."""
+        return _FunctionalCaller(self, dict(params),
+                                 dict(buffers) if buffers else None)
+
     def clear_gradients(self):
         for p in self.parameters():
             p.clear_grad()
@@ -324,6 +333,40 @@ class Layer:
             lines.append(f"  ({name}): {sub}")
         lines.append(")")
         return "\n".join(lines) if len(lines) > 2 else "".join(lines)
+
+
+class _FunctionalCaller:
+    """Proxy over a Layer bound to a params pytree (see functional_caller)."""
+
+    def __init__(self, layer, params, buffers):
+        object.__setattr__(self, "_layer", layer)
+        object.__setattr__(self, "_params", params)
+        object.__setattr__(self, "_buffers", buffers)
+
+    def __call__(self, *inputs, **kwargs):
+        return self._layer.functional_call(self._params, *inputs,
+                                           buffers=self._buffers, **kwargs)
+
+    def __getattr__(self, name):
+        layer = self._layer
+        sub = layer.__dict__.get("_sub_layers", {})
+        if name in sub and sub[name] is not None:
+            pfx = name + "."
+            sub_params = {k[len(pfx):]: v for k, v in self._params.items()
+                          if k.startswith(pfx)}
+            sub_buffers = None
+            if self._buffers:
+                sub_buffers = {k[len(pfx):]: v
+                               for k, v in self._buffers.items()
+                               if k.startswith(pfx)}
+            return _FunctionalCaller(sub[name], sub_params, sub_buffers)
+        own = layer.__dict__.get("_parameters", {})
+        if name in own and own[name] is not None:
+            if name in self._params:
+                from ..core.tensor import Tensor
+
+                return Tensor(self._params[name], stop_gradient=False)
+        return getattr(layer, name)
 
 
 class _HookHandle:

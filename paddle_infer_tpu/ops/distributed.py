@@ -1,0 +1,56 @@
+"""Distributed annotation ops.
+
+``sharding_constraint`` is the op the TP/SP layers use to pin activation
+layouts; under a mesh it lowers to ``jax.lax.with_sharding_constraint`` and
+GSPMD inserts the actual collectives — the role the reference's explicit
+``mp_ops`` autograd collectives play (python/paddle/distributed/fleet/layers/
+mpu/mp_ops.py: _c_identity/_mp_allreduce/_c_split/_c_concat).  Without a mesh
+it is the identity, so the same model code runs single-chip.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.dispatch import register_grad, register_op
+from ..parallel import topology
+
+
+def _constrain(x, spec):
+    mesh = topology.get_current_mesh()
+    if mesh is None or x is None:
+        return x
+    ndim = getattr(x, "ndim", None)
+    if ndim is not None and len(spec) > ndim:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    names = set(mesh.axis_names)
+    clean = tuple(
+        s if (s is None or (s if not isinstance(s, tuple) else s[0]) in names
+              and _axes_present(s, names)) else None
+        for s in spec)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, PartitionSpec(*clean)))
+
+
+def _axes_present(s, names):
+    if s is None:
+        return True
+    if isinstance(s, tuple):
+        return all(a in names for a in s)
+    return s in names
+
+
+# jit=False: the impl must run inline (eagerly or inside an enclosing trace)
+# so it can see the *current* mesh instead of freezing one into a jit cache.
+@register_op("sharding_constraint", save_inputs=False, jit=False)
+def _sharding_constraint(x, spec=()):
+    return _constrain(x, tuple(spec))
+
+
+@register_grad("sharding_constraint")
+def _sharding_constraint_grad(ctx, g):
+    from ..core.tensor import Tensor
+
+    spec = tuple(ctx.attrs.get("spec", ()))
+    return (Tensor(_constrain(g._data, spec)),)

@@ -1,0 +1,32 @@
+"""paddle_infer_tpu.parallel — the hybrid-parallel layer.
+
+Reference: python/paddle/distributed/ + paddle/fluid/distributed/ (survey
+§2.7/§2.8).  The whole stack is mesh-native: topology = named Mesh, groups =
+mesh axes, collectives = shard_map'd lax collectives, parallel "wrappers" =
+partition specs consumed by one compiled pjit train step (fleet.py).
+"""
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       create_hybrid_mesh, get_current_mesh,
+                       get_hybrid_communicate_group, named_sharding,
+                       set_current_mesh, set_hybrid_communicate_group)
+from .collective import (Group, ReduceOp, all_gather, all_reduce, alltoall,
+                         barrier, broadcast, new_group, ppermute, reduce,
+                         reduce_scatter)
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from . import fleet
+from .fleet import DistributedStrategy, FleetTrainStep
+from .sharding import group_sharded_parallel
+from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed
+
+__all__ = [
+    "CommunicateTopology", "HybridCommunicateGroup", "create_hybrid_mesh",
+    "get_current_mesh", "set_current_mesh", "named_sharding",
+    "get_hybrid_communicate_group", "set_hybrid_communicate_group",
+    "Group", "ReduceOp", "all_reduce", "all_gather", "reduce_scatter",
+    "broadcast", "reduce", "alltoall", "ppermute", "barrier", "new_group",
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelCrossEntropy", "fleet", "DistributedStrategy", "FleetTrainStep",
+    "group_sharded_parallel", "get_rng_state_tracker", "RNGStatesTracker",
+    "model_parallel_random_seed",
+]

@@ -1,0 +1,137 @@
+"""Tensor-parallel (Megatron-style) layers.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/mp_layers.py —
+``VocabParallelEmbedding`` (:39), ``ColumnParallelLinear`` (:155),
+``RowParallelLinear`` (:293), ``ParallelCrossEntropy`` (:438) — which hold
+*per-rank weight shards* and issue explicit NCCL collectives via mp_ops.
+
+TPU-first redesign: each layer holds the FULL logical weight and stamps a
+``dist_attr`` partition spec on it (column → shard output dim on "mp", row →
+shard reduction dim on "mp", vocab embedding → shard vocab rows).  The fleet
+train-step builder places parameters by these specs; activation
+``sharding_constraint`` ops pin the intermediate layouts so GSPMD inserts
+exactly the Megatron collectives (identity fwd/allreduce bwd for column,
+allreduce fwd for row) compiled into the step program over ICI.  Single-chip
+eager execution is numerically identical because the specs are dormant
+without a mesh.
+"""
+from __future__ import annotations
+
+from ..core.dispatch import dispatch as D
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn import initializer as I
+from ..core.tensor import Parameter
+
+
+def _mark(param: Parameter, spec):
+    param.dist_attr = tuple(spec)
+    return param
+
+
+class ColumnParallelLinear(Layer):
+    """y = x @ W + b with W's output dim sharded over "mp"
+    (reference: mp_layers.py:155).  gather_output=True adds an all-gather
+    (as a replication constraint) on the output."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        init = getattr(weight_attr, "initializer", None) if weight_attr \
+            else None
+        self.weight = _mark(
+            Parameter((init or I.XavierUniform())((in_features, out_features),
+                                                  "float32"), name=name),
+            (None, "mp"))
+        if has_bias:
+            self.bias = _mark(Parameter(I.Constant(0.0)((out_features,),
+                                                        "float32")), ("mp",))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        y = D("matmul", x, self.weight)
+        if self.bias is not None:
+            y = D("add", y, self.bias)
+        spec = (None,) * (y.ndim - 1) + (None if self.gather_output else "mp",)
+        return D("sharding_constraint", y, spec=spec)
+
+
+class RowParallelLinear(Layer):
+    """y = x @ W + b with W's input (reduction) dim sharded over "mp"
+    (reference: mp_layers.py:293).  The partial products are summed by an
+    allreduce GSPMD inserts when the output is constrained replicated;
+    input_is_parallel means x arrives already sharded on its last dim
+    (the layout ColumnParallelLinear(gather_output=False) produces)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        init = getattr(weight_attr, "initializer", None) if weight_attr \
+            else None
+        self.weight = _mark(
+            Parameter((init or I.XavierUniform())((in_features, out_features),
+                                                  "float32"), name=name),
+            ("mp", None))
+        # bias added AFTER the reduction → replicated (ref keeps it unsharded)
+        self.bias = Parameter(I.Constant(0.0)((out_features,), "float32")) \
+            if has_bias else None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            spec = (None,) * (x.ndim - 1) + ("mp",)
+            x = D("sharding_constraint", x, spec=spec)
+        y = D("matmul", x, self.weight)
+        y = D("sharding_constraint", y, spec=(None,) * y.ndim)
+        if self.bias is not None:
+            y = D("add", y, self.bias)
+        return y
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over "mp"
+    (reference: mp_layers.py:39 — per-rank vocab range + allreduce of the
+    masked lookups; here the table rows are sharded and GSPMD turns the
+    gather into on-shard lookups + combine)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        init = getattr(weight_attr, "initializer", None) if weight_attr \
+            else None
+        self.weight = _mark(
+            Parameter((init or I.XavierNormal())((num_embeddings,
+                                                  embedding_dim), "float32"),
+                      name=name),
+            ("mp", None))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax cross entropy on vocab-sharded logits
+    (reference: mp_layers.py:438 → c_softmax_with_cross_entropy op, which
+    computes the softmax over mp ranks with two allreduces).  Here: constrain
+    logits sharded on the class dim; XLA's reduction over the sharded dim
+    generates the same pair of collectives inside the fused softmax-CE."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        spec = (None,) * (input.ndim - 1) + ("mp",)
+        logits = D("sharding_constraint", input, spec=spec)
+        return F.cross_entropy(logits, label, reduction="none",
+                               ignore_index=self.ignore_index)

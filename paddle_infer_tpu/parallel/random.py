@@ -1,0 +1,71 @@
+"""Distributed RNG state tracker.
+
+Reference: python/paddle/distributed/fleet/layers/mpu/random.py
+``get_rng_state_tracker`` — named RNG states so tensor-parallel regions can
+choose dropout masks that are identical across mp ranks (global state) or
+distinct per rank (local state).
+
+TPU-first: states are named PRNG keys; "local" keys are folded with the mesh
+coordinate so a traced program draws per-shard-distinct randomness while the
+"global" key stays identical everywhere.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+
+from ..core import random as prandom
+from . import topology
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+
+    def reset(self):
+        self.states_.clear()
+
+    def add(self, name: str, seed: int):
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        key = jax.random.key(int(seed))
+        hcg = topology.get_hybrid_communicate_group()
+        if hcg is not None:
+            # fold in the mp coordinate → per-rank-distinct draws
+            key = jax.random.fold_in(key, hcg.get_model_parallel_rank())
+        self.states_[name] = key
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        key = self.states_[name]
+        new_key, use_key = jax.random.split(key)
+        self.states_[name] = new_key
+        with prandom.trace_key_scope(use_key):
+            yield
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed: int = 2023):
+    """(reference random.py model_parallel_random_seed: seeds global +
+    per-mp-rank local states)"""
+    _TRACKER.reset()
+    prandom.seed(seed)
+    _TRACKER.add(MODEL_PARALLEL_RNG, seed + 1024)

@@ -1,0 +1,38 @@
+"""Group-sharded (ZeRO) public API.
+
+Reference: python/paddle/distributed/sharding/group_sharded.py:56
+``group_sharded_parallel(model, optimizer, level)`` wrapping the model in
+GroupShardedStage2/3 containers (meta_parallel/sharding/group_sharded_stage2.py:49,
+group_sharded_stage3.py:60) that hook backward to reduce-scatter grads and
+gather/release params around each layer.
+
+TPU-first: ZeRO is a *placement policy*, not a wrapper — the levels map to a
+DistributedStrategy sharding stage that FleetTrainStep compiles into the step
+program's shardings (os → stage 1, os_g → stage 2, p_g_os → stage 3/FSDP).
+This returns the model/optimizer annotated with that strategy.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .fleet import DistributedStrategy, _state
+
+_LEVELS = {"os": 1, "os_g": 2, "p_g_os": 3}
+
+
+def group_sharded_parallel(model, optimizer, level: str = "os",
+                           scaler=None, group=None, offload: bool = False,
+                           sync_buffers: bool = False, buffer_max_size=None,
+                           segment_size=None, sync_comm: bool = False):
+    if level not in _LEVELS:
+        raise ValueError(f"level must be one of {sorted(_LEVELS)}; got {level}")
+    strategy = getattr(optimizer, "_fleet_strategy", None) \
+        or _state.strategy or DistributedStrategy()
+    strategy.sharding = True
+    strategy.sharding_configs = dict(strategy.sharding_configs or {},
+                                     stage=_LEVELS[level], offload=offload)
+    model._fleet_distributed = True
+    optimizer._fleet_strategy = strategy
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer
