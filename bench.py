@@ -1,0 +1,110 @@
+"""Headline benchmark: ERNIE-3.0-base training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md); the recorded target is the
+north star "≥35% MFU training ERNIE-3.0-base", so ``vs_baseline`` reports
+achieved-MFU / 0.35 (≥1.0 beats the bar).  Peak bf16 FLOPs per chip is taken
+from the detected TPU generation.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+PEAK_BF16_FLOPS = {
+    # per-chip dense bf16 peak
+    "v4": 275e12,
+    "v5lite": 197e12,   # v5e
+    "v5": 459e12,       # v5p
+    "v6lite": 918e12,   # v6e (trillium)
+    "cpu": 1e12,        # nominal, so the script stays meaningful off-TPU
+}
+
+
+def _peak_flops() -> float:
+    import jax
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower().replace(" ", "")
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return val
+    return PEAK_BF16_FLOPS["v5lite" if dev.platform == "tpu" else "cpu"]
+
+
+def main():
+    import jax
+
+    import paddle_infer_tpu as pit
+    from paddle_infer_tpu.models import (ErnieConfig, ErnieForPretraining,
+                                         ernie_pretrain_loss)
+    from paddle_infer_tpu.parallel import (DistributedStrategy,
+                                           FleetTrainStep, fleet)
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    batch, seq = (32, 512) if on_tpu else (4, 128)
+
+    cfg = ErnieConfig.from_preset(
+        "ernie-3.0-base", vocab_size=40000, max_position_embeddings=seq,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0) \
+        if on_tpu else ErnieConfig(
+            vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=512,
+            max_position_embeddings=seq, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0)
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1}
+    strategy.amp = True
+    strategy.amp_configs = {"level": "O2", "dtype": "bfloat16"}
+    fleet.init(is_collective=True, strategy=strategy,
+               devices=jax.devices()[:1])
+
+    model = ErnieForPretraining(cfg)
+    opt = pit.optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters())
+
+    def loss_fn(m, ids, labels, nsp_labels):
+        mlm, nsp = m(ids)
+        return ernie_pretrain_loss(mlm, nsp, labels, nsp_labels)
+
+    step = FleetTrainStep(model, loss_fn, opt, strategy=strategy)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    nsp = rng.randint(0, 2, (batch,)).astype(np.int32)
+
+    # warmup (compile)
+    step(ids, labels, nsp)
+    step(ids, labels, nsp).numpy()
+
+    iters = 20 if on_tpu else 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels, nsp)
+    loss.numpy()   # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    n_params = sum(int(p.size) for p in model.parameters())
+    # 6ND for fwd+bwd FLOPs + attention term 12*L*H*S^2... keep the standard
+    # 6*N*T estimate (attention adds ~10% at seq 512 for base).
+    model_flops_per_tok = 6 * n_params
+    mfu = tokens_per_sec * model_flops_per_tok / _peak_flops()
+
+    print(json.dumps({
+        "metric": "ernie3.0-base train tokens/sec/chip (bf16, bs%d seq%d)"
+                  % (batch, seq),
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.35, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

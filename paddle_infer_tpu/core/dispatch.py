@@ -155,7 +155,8 @@ def _amp_cast_arrays(name, arrays):
         return arrays
     target = _amp_state["dtype"] or jnp.bfloat16
     level = _amp_state["level"]
-    floating = [a for a in arrays if jnp.issubdtype(a.dtype, jnp.floating)]
+    floating = [a for a in arrays
+                if a is not None and jnp.issubdtype(a.dtype, jnp.floating)]
     if not floating:
         return arrays
     if name in AMP_BLACK_OPS:
@@ -164,7 +165,8 @@ def _amp_cast_arrays(name, arrays):
         cast_to = target
     else:
         return arrays
-    return [a.astype(cast_to) if jnp.issubdtype(a.dtype, jnp.floating) else a
+    return [a.astype(cast_to)
+            if a is not None and jnp.issubdtype(a.dtype, jnp.floating) else a
             for a in arrays]
 
 

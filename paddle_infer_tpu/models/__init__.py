@@ -1,8 +1,21 @@
 """Model zoo (reference: python/paddle/vision/models/ + PaddleNLP model
 families the fork serves).  Flagship: ERNIE/BERT-base (bert.py)."""
 from .lenet import LeNet
+from .transformer_block import (ParallelMLP, ParallelSelfAttention,
+                                ParallelTransformerLayer)
+from .ernie import (ERNIE_PRESETS, ErnieConfig, ErnieForMaskedLM,
+                    ErnieForPretraining, ErnieForSequenceClassification,
+                    ErnieModel, ernie_pretrain_loss)
+from .gpt import (GPT_PRESETS, GPTConfig, GPTForCausalLM, GPTModel,
+                  gpt_lm_loss)
 
-__all__ = ["LeNet"]
+__all__ = [
+    "LeNet", "ParallelMLP", "ParallelSelfAttention",
+    "ParallelTransformerLayer", "ERNIE_PRESETS", "ErnieConfig",
+    "ErnieForMaskedLM", "ErnieForPretraining",
+    "ErnieForSequenceClassification", "ErnieModel", "ernie_pretrain_loss",
+    "GPT_PRESETS", "GPTConfig", "GPTForCausalLM", "GPTModel", "gpt_lm_loss",
+]
 
 
 def __getattr__(name):

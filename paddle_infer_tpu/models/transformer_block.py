@@ -1,0 +1,124 @@
+"""Tensor-parallel transformer building blocks shared by the model zoo.
+
+Reference: the fused-multi-transformer decoder layer
+(paddle/fluid/operators/fused/fused_multi_transformer_op.cc — attention +
+FFN + layernorms in one op, cache-KV aware) and the Megatron TP layers
+(fleet/layers/mpu/mp_layers.py).
+
+TPU-first: blocks are built from Column/RowParallelLinear so the mp sharding
+is carried by parameter partition specs; the attention core is the fused
+``sdpa`` op (MXU-friendly single XLA computation / Pallas flash kernel).
+Everything traces into one program under fleet/jit — the XLA analog of the
+reference's fused op.
+"""
+from __future__ import annotations
+
+from ..core.dispatch import dispatch as D
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.layers_common import Dropout, LayerNorm
+from ..parallel.mp_layers import ColumnParallelLinear, RowParallelLinear
+
+
+class ParallelSelfAttention(Layer):
+    """Self-attention with heads sharded over "mp"; optional KV cache for
+    decode (cache layout [b, s, h, d] — the reference CacheKV is
+    [2, b, h, max_seq, d], fused_multi_transformer_op.cc:103)."""
+
+    def __init__(self, hidden, num_heads, dropout=0.0, causal=False):
+        super().__init__()
+        assert hidden % num_heads == 0
+        self.hidden = hidden
+        self.num_heads = num_heads
+        self.head_dim = hidden // num_heads
+        self.dropout = dropout
+        self.causal = causal
+        self.qkv_proj = ColumnParallelLinear(hidden, 3 * hidden,
+                                             gather_output=False)
+        self.out_proj = RowParallelLinear(hidden, hidden,
+                                          input_is_parallel=True)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)
+        qkv = D("reshape", qkv, shape=(b, s, 3, self.num_heads,
+                                       self.head_dim))
+        q, k, v = D("unstack", qkv, axis=2)
+        if cache is not None:
+            k = D("concat", cache[0], k, axis=1)
+            v = D("concat", cache[1], v, axis=1)
+        # pin head sharding so GSPMD keeps attention fully local per mp shard
+        hspec = ("data", None, "mp", None)
+        q = D("sharding_constraint", q, spec=hspec)
+        k = D("sharding_constraint", k, spec=hspec)
+        v = D("sharding_constraint", v, spec=hspec)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.dropout if self.training else 0.0,
+            is_causal=self.causal and cache is None)
+        out = D("reshape", out, shape=(b, s, self.hidden))
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, (k, v)
+        return out
+
+
+class ParallelMLP(Layer):
+    """Column→activation→Row FFN (Megatron split: no comm inside)."""
+
+    def __init__(self, hidden, ffn_hidden, activation="gelu", dropout=0.0):
+        super().__init__()
+        self.fc1 = ColumnParallelLinear(hidden, ffn_hidden,
+                                        gather_output=False)
+        self.fc2 = RowParallelLinear(ffn_hidden, hidden,
+                                     input_is_parallel=True)
+        self.activation = getattr(F, activation)
+        self.dropout = Dropout(dropout)
+
+    def forward(self, x):
+        # act-dropout sits between the two matmuls (reference
+        # TransformerEncoderLayer: linear2(dropout(act(linear1(x)))))
+        return self.fc2(self.dropout(self.activation(self.fc1(x))))
+
+
+class ParallelTransformerLayer(Layer):
+    """One encoder/decoder block (post-LN default, matching ERNIE/BERT;
+    pre-LN via normalize_before for GPT)."""
+
+    def __init__(self, hidden, num_heads, ffn_hidden, dropout=0.1,
+                 attn_dropout=None, activation="gelu",
+                 normalize_before=False, causal=False,
+                 layer_norm_eps=1e-12):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.self_attn = ParallelSelfAttention(
+            hidden, num_heads,
+            dropout=attn_dropout if attn_dropout is not None else dropout,
+            causal=causal)
+        self.mlp = ParallelMLP(hidden, ffn_hidden, activation, dropout)
+        self.norm1 = LayerNorm(hidden, epsilon=layer_norm_eps)
+        self.norm2 = LayerNorm(hidden, epsilon=layer_norm_eps)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+
+    def forward(self, x, attn_mask=None, cache=None):
+        residual = x
+        if self.normalize_before:
+            x = self.norm1(x)
+        if cache is not None:
+            attn_out, new_cache = self.self_attn(x, attn_mask, cache)
+        else:
+            attn_out = self.self_attn(x, attn_mask)
+            new_cache = None
+        x = residual + self.dropout1(attn_out)
+        if not self.normalize_before:
+            x = self.norm1(x)
+        residual = x
+        if self.normalize_before:
+            x = self.norm2(x)
+        x = residual + self.dropout2(self.mlp(x))
+        if not self.normalize_before:
+            x = self.norm2(x)
+        if cache is not None:
+            return x, new_cache
+        return x

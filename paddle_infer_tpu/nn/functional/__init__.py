@@ -507,47 +507,25 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     one fused XLA computation eagerly; the Pallas flash kernel
     (ops/pallas/flash_attention.py) takes over under jit on TPU for long seqs.
     """
-    return D("sdpa", q, k, v, attn_mask,
+    key = None
+    if dropout_p and training:
+        from ...core.tensor import Tensor as _T
+
+        key = _T(prandom.next_key())
+    else:
+        dropout_p = 0.0
+    return D("sdpa", q, k, v, attn_mask, key,
              dropout_p=dropout_p, is_causal=is_causal, scale=scale)
 
 
-def _register_sdpa():
-    import jax
-
-    from ...core.dispatch import register_op, register_vjp_grad
-
-    @register_op("sdpa")
-    def _sdpa(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
-              scale=None):
-        import math as _math
-
-        # b s h d -> b h s d
-        qt = jnp.swapaxes(q, 1, 2)
-        kt = jnp.swapaxes(k, 1, 2)
-        vt = jnp.swapaxes(v, 1, 2)
-        d = q.shape[-1]
-        s = scale if scale is not None else 1.0 / _math.sqrt(d)
-        prec = (jax.lax.Precision.HIGHEST if qt.dtype == jnp.float32
-                else None)
-        scores = jnp.matmul(qt, jnp.swapaxes(kt, -1, -2),
-                            preferred_element_type=jnp.float32,
-                            precision=prec) * s
-        if is_causal:
-            sq, skv = scores.shape[-2], scores.shape[-1]
-            mask = jnp.tril(jnp.ones((sq, skv), dtype=bool), skv - sq)
-            scores = jnp.where(mask, scores, -jnp.inf)
-        if attn_mask is not None:
-            if attn_mask.dtype == jnp.bool_:
-                scores = jnp.where(attn_mask, scores, -jnp.inf)
-            else:
-                scores = scores + attn_mask.astype(scores.dtype)
-        probs = jax.nn.softmax(scores, axis=-1).astype(vt.dtype)
-        out = jnp.matmul(probs, vt, precision=prec)
-        return jnp.swapaxes(out, 1, 2)
-
-    register_vjp_grad("sdpa")
+def flash_attention(q, k, v, dropout=0.0, causal=False, training=True,
+                    fixed_seed_offset=None, return_softmax=False):
+    """paddle.nn.functional.flash_attention parity (reference ops.yaml:239)."""
+    out = scaled_dot_product_attention(q, k, v, dropout_p=dropout,
+                                       is_causal=causal, training=training)
+    if return_softmax:
+        return out, None
+    return out
 
 
-_register_sdpa()
-
-flash_attention = scaled_dot_product_attention
+# the fused "sdpa" op itself is registered in ops/attention.py

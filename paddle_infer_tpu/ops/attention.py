@@ -1,0 +1,86 @@
+"""Fused attention ops.
+
+Reference: the fork's FlashAttention kernels (phi/kernels/gpu/flash_attn_kernel.cu,
+yaml phi/api/yaml/ops.yaml:239 flash_attn / :252 flash_attn_unpadded) and the
+CUTLASS memory-efficient attention (phi/kernels/fusion/cutlass/).
+
+TPU-first: one fused op in (batch, seq, heads, head_dim) layout — the whole
+softmax(QKᵀ)V contraction is a single XLA computation so both matmuls land on
+the MXU with the softmax fused between them.  On TPU under jit the Pallas
+flash kernel (ops/pallas/flash_attention.py) takes over for long sequences;
+this XLA path is the reference implementation and the CPU/interpret fallback.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op, register_vjp_grad
+
+
+def _use_pallas(q):
+    """Pallas flash kernel is profitable for long seqs on real TPU."""
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
+    b, s, h, d = q.shape
+    return s >= 1024 and d in (64, 128, 256) and s % 128 == 0
+
+
+def _xla_sdpa(q, k, v, mask, key, dropout_p, is_causal, scale):
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # contract in [b, h, sq, sk]; logits in fp32 for stable softmax
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        m = mask
+        if m.dtype == jnp.bool_:
+            m = jnp.where(m, 0.0, -1e9).astype(jnp.float32)
+        else:
+            m = m.astype(jnp.float32)
+        logits = logits + m     # broadcast [b, 1|h, sq, sk] / [sq, sk]
+    if is_causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), jnp.bool_), sk - sq)
+        logits = jnp.where(causal, logits, -1e9)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p and key is not None:
+        keep = 1.0 - dropout_p
+        dm = jax.random.bernoulli(key, keep, probs.shape)
+        probs = jnp.where(dm, probs / keep, 0.0)
+    probs = probs.astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@register_op("sdpa")
+def _sdpa(q, k, v, mask=None, key=None, dropout_p=0.0, is_causal=False,
+          scale=None):
+    if dropout_p == 0.0 and _use_pallas(q):
+        from .pallas.flash_attention import flash_attention as _flash
+
+        try:
+            return _flash(q, k, v, mask=mask, is_causal=is_causal,
+                          scale=scale)
+        except Exception:
+            pass
+    return _xla_sdpa(q, k, v, mask, key, dropout_p, is_causal, scale)
+
+
+register_vjp_grad("sdpa")
+
+
+@register_op("flash_attention")
+def _flash_attn(q, k, v, mask=None, key=None, dropout_p=0.0,
+                is_causal=False, scale=None):
+    """API-parity alias of sdpa (reference flash_attn, ops.yaml:239 —
+    same (b, s, h, d) layout)."""
+    return _sdpa(q, k, v, mask, key, dropout_p=dropout_p,
+                 is_causal=is_causal, scale=scale)
+
+
+register_vjp_grad("flash_attention")

@@ -25,10 +25,19 @@ def _constrain(x, spec):
     from jax.sharding import NamedSharding, PartitionSpec
 
     names = set(mesh.axis_names)
-    clean = tuple(
-        s if (s is None or (s if not isinstance(s, tuple) else s[0]) in names
-              and _axes_present(s, names)) else None
-        for s in spec)
+
+    def resolve(s):
+        if s == "data":
+            # batch dim: follow whatever data axes are active so activation
+            # constraints don't fight the dp/fsdp batch sharding
+            axes = tuple(a for a in ("dp", "sharding")
+                         if dict(mesh.shape).get(a, 1) > 1)
+            return axes if axes else None
+        if s is None or not _axes_present(s, names):
+            return None
+        return s
+
+    clean = tuple(resolve(s) for s in spec)
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, PartitionSpec(*clean)))
 

@@ -1,0 +1,5 @@
+"""Hand-written Pallas TPU kernels for the hot ops (the role the reference's
+CUDA kernels play: flash attention phi/kernels/gpu/flash_attn_kernel.cu,
+paged decode attention fused_multi_transformer_op.cu, weight-only GEMM
+funcs/weight_only_gemv.cu).  Everything here has an XLA fallback in ops/ so
+the framework runs identically off-TPU."""

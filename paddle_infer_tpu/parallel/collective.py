@@ -129,7 +129,8 @@ def _build(mesh: Mesh, axis, kind: str, **kw):
             if op == ReduceOp.AVG:
                 return jax.lax.pmean(x, axis)
             if op == ReduceOp.PROD:
-                return jnp.exp(jax.lax.psum(jnp.log(x), axis))
+                gathered = jax.lax.all_gather(x, axis)
+                return jnp.prod(gathered, axis=0)
             raise ValueError(op)
 
         return smap(body, (rep,), rep)

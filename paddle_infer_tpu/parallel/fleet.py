@@ -114,7 +114,9 @@ def init(role_maker=None, is_collective: bool = True,
 
 
 def get_hybrid_communicate_group():
-    return _state.hcg
+    # topology holds the single source of truth (set by fleet.init or by
+    # topology.set_hybrid_communicate_group directly)
+    return topology.get_hybrid_communicate_group()
 
 
 def fleet_strategy() -> Optional[DistributedStrategy]:
@@ -311,19 +313,21 @@ class FleetTrainStep:
 
         def step_fn(params, opt_state, key, lr, step, batch):
             if k_steps > 1:
-                def micro(carry, mb):
+                def micro(carry, idx_mb):
+                    i, mb = idx_mb
                     acc = carry
                     loss, grads = jax.value_and_grad(pure_loss)(
-                        params, key, mb)
+                        params, jax.random.fold_in(key, i), mb)
                     return jax.tree_util.tree_map(jnp.add, acc,
                                                   grads), loss
 
                 zero = jax.tree_util.tree_map(jnp.zeros_like, params)
                 grads, losses = jax.lax.scan(
                     micro, zero,
-                    jax.tree_util.tree_map(
-                        lambda b: b.reshape((k_steps, b.shape[0] // k_steps)
-                                            + b.shape[1:]), batch))
+                    (jnp.arange(k_steps),
+                     jax.tree_util.tree_map(
+                         lambda b: b.reshape((k_steps, b.shape[0] // k_steps)
+                                             + b.shape[1:]), batch)))
                 grads = jax.tree_util.tree_map(lambda g: g / k_steps, grads)
                 loss = losses.mean()
             else:

@@ -57,7 +57,8 @@ class ColumnParallelLinear(Layer):
         y = D("matmul", x, self.weight)
         if self.bias is not None:
             y = D("add", y, self.bias)
-        spec = (None,) * (y.ndim - 1) + (None if self.gather_output else "mp",)
+        spec = ("data",) + (None,) * (y.ndim - 2) + \
+            (None if self.gather_output else "mp",)
         return D("sharding_constraint", y, spec=spec)
 
 
@@ -87,10 +88,11 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         if self.input_is_parallel:
-            spec = (None,) * (x.ndim - 1) + ("mp",)
+            spec = ("data",) + (None,) * (x.ndim - 2) + ("mp",)
             x = D("sharding_constraint", x, spec=spec)
         y = D("matmul", x, self.weight)
-        y = D("sharding_constraint", y, spec=(None,) * y.ndim)
+        y = D("sharding_constraint", y,
+              spec=("data",) + (None,) * (y.ndim - 1))
         if self.bias is not None:
             y = D("add", y, self.bias)
         return y
@@ -131,7 +133,7 @@ class ParallelCrossEntropy(Layer):
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        spec = (None,) * (input.ndim - 1) + ("mp",)
+        spec = ("data",) + (None,) * (input.ndim - 2) + ("mp",)
         logits = D("sharding_constraint", input, spec=spec)
         return F.cross_entropy(logits, label, reduction="none",
                                ignore_index=self.ignore_index)
