@@ -124,7 +124,7 @@ _amp_state = {"enabled": False, "dtype": None, "level": "O1"}
 
 AMP_WHITE_OPS = {
     "matmul", "conv2d", "conv2d_transpose", "einsum", "bmm", "mm",
-    "flash_attention", "depthwise_conv2d", "addmm",
+    "flash_attention", "sdpa", "depthwise_conv2d", "addmm",
 }
 AMP_BLACK_OPS = {
     "exp", "log", "softmax", "log_softmax", "cross_entropy",

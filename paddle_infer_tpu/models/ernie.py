@@ -232,13 +232,9 @@ class ErnieForSequenceClassification(Layer):
 def ernie_pretrain_loss(mlm_logits, nsp_logits, mlm_labels, nsp_labels,
                         ignore_index=-100):
     """Summed MLM + NSP loss with label masking (mean over valid tokens)."""
-    vocab = mlm_logits.shape[-1]
-    flat_logits = D("reshape", mlm_logits, shape=(-1, vocab))
-    flat_labels = D("reshape", mlm_labels, shape=(-1,))
-    mlm = F.cross_entropy(flat_logits, flat_labels, reduction="none",
-                          ignore_index=ignore_index)
-    valid = D("cast", D("not_equal", flat_labels, ignore_index),
-              dtype="float32")
-    mlm_loss = (mlm * valid).sum() / (valid.sum() + 1e-6)
+    from .losses import masked_lm_loss
+
+    mlm_loss = masked_lm_loss(mlm_logits, mlm_labels,
+                              ignore_index=ignore_index)
     nsp_loss = F.cross_entropy(nsp_logits, nsp_labels, reduction="mean")
     return mlm_loss + nsp_loss

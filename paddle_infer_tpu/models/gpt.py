@@ -99,7 +99,12 @@ class GPTModel(Layer):
         if position_ids is None:
             import jax.numpy as jnp
 
-            position_ids = Tensor(jnp.arange(s, dtype=jnp.int32))
+            # incremental decode: positions continue after the cached
+            # prefix (cache layout [b, s_past, h, d], shape is static
+            # under trace)
+            past = caches[0][0].shape[1] if caches else 0
+            position_ids = Tensor(
+                jnp.arange(past, past + s, dtype=jnp.int32))
             pos = D("unsqueeze", self.position_embeddings(position_ids),
                     axis=0)
         else:
@@ -144,14 +149,10 @@ class GPTForCausalLM(Layer):
 
 def gpt_lm_loss(logits, labels, ignore_index=-100):
     """Shifted causal-LM loss: predict token t+1 from prefix ≤ t."""
-    vocab = logits.shape[-1]
+    from .losses import masked_lm_loss
+
     s = logits.shape[1]
     shift_logits = D("slice", logits, axes=(1,), starts=(0,), ends=(s - 1,))
     shift_labels = D("slice", labels, axes=(1,), starts=(1,), ends=(s,))
-    flat_logits = D("reshape", shift_logits, shape=(-1, vocab))
-    flat_labels = D("reshape", shift_labels, shape=(-1,))
-    loss = F.cross_entropy(flat_logits, flat_labels, reduction="none",
-                           ignore_index=ignore_index)
-    valid = D("cast", D("not_equal", flat_labels, ignore_index),
-              dtype="float32")
-    return (loss * valid).sum() / (valid.sum() + 1e-6)
+    return masked_lm_loss(shift_logits, shift_labels,
+                          ignore_index=ignore_index)

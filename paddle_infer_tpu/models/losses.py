@@ -1,0 +1,22 @@
+"""Shared loss utilities for the model zoo."""
+from __future__ import annotations
+
+from ..core.dispatch import dispatch as D
+from ..nn import functional as F
+
+
+def masked_lm_loss(logits, labels, ignore_index=-100):
+    """Mean cross-entropy over tokens whose label != ignore_index.
+
+    The shared recipe behind MLM and causal-LM losses (reference:
+    ernie/gpt pretrain losses mask padded/unmasked positions before the
+    mean; epsilon keeps the all-masked batch finite).
+    """
+    vocab = logits.shape[-1]
+    flat_logits = D("reshape", logits, shape=(-1, vocab))
+    flat_labels = D("reshape", labels, shape=(-1,))
+    loss = F.cross_entropy(flat_logits, flat_labels, reduction="none",
+                           ignore_index=ignore_index)
+    valid = D("cast", D("not_equal", flat_labels, ignore_index),
+              dtype="float32")
+    return (loss * valid).sum() / (valid.sum() + 1e-6)

@@ -52,10 +52,13 @@ class ParallelSelfAttention(Layer):
         q = D("sharding_constraint", q, spec=hspec)
         k = D("sharding_constraint", k, spec=hspec)
         v = D("sharding_constraint", v, spec=hspec)
+        # causal stays on with a cache: the sdpa mask is offset by
+        # (len_k - len_q), so cached prefill/decode attends to the full
+        # past but never to future tokens of the current chunk.
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask,
             dropout_p=self.dropout if self.training else 0.0,
-            is_causal=self.causal and cache is None)
+            is_causal=self.causal)
         out = D("reshape", out, shape=(b, s, self.hidden))
         out = self.out_proj(out)
         if cache is not None:

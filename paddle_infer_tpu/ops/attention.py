@@ -20,23 +20,32 @@ import jax.numpy as jnp
 from ..core.dispatch import register_op, register_vjp_grad
 
 
-def _use_pallas(q):
+def _use_pallas(q, k, mask):
     """Pallas flash kernel is profitable for long seqs on real TPU."""
+    if mask is not None:          # arbitrary masks stay on the XLA path
+        return False
     try:
         if jax.default_backend() != "tpu":
             return False
     except Exception:
         return False
     b, s, h, d = q.shape
-    return s >= 1024 and d in (64, 128, 256) and s % 128 == 0
+    sk = k.shape[1]
+    return (s >= 1024 and d in (64, 128, 256) and s % 128 == 0
+            and sk % 128 == 0)
 
 
 def _xla_sdpa(q, k, v, mask, key, dropout_p, is_causal, scale):
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # fp32 inputs keep full precision on the MXU (three bf16 passes);
+    # bf16/fp16 inputs use the fast path.
+    prec = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
     # contract in [b, h, sq, sk]; logits in fp32 for stable softmax
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
+                        preferred_element_type=jnp.float32,
+                        precision=prec) * scale
     if mask is not None:
         m = mask
         if m.dtype == jnp.bool_:
@@ -54,20 +63,31 @@ def _xla_sdpa(q, k, v, mask, key, dropout_p, is_causal, scale):
         dm = jax.random.bernoulli(key, keep, probs.shape)
         probs = jnp.where(dm, probs / keep, 0.0)
     probs = probs.astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v, precision=prec)
+
+
+_pallas_fallback_warned = False
 
 
 @register_op("sdpa")
 def _sdpa(q, k, v, mask=None, key=None, dropout_p=0.0, is_causal=False,
           scale=None):
-    if dropout_p == 0.0 and _use_pallas(q):
+    if dropout_p == 0.0 and _use_pallas(q, k, mask):
         from .pallas.flash_attention import flash_attention as _flash
 
         try:
             return _flash(q, k, v, mask=mask, is_causal=is_causal,
                           scale=scale)
-        except Exception:
-            pass
+        except Exception as e:   # pragma: no cover - TPU-only path
+            global _pallas_fallback_warned
+            if not _pallas_fallback_warned:
+                _pallas_fallback_warned = True
+                import warnings
+
+                warnings.warn(
+                    f"pallas flash attention failed ({e!r}); falling back "
+                    "to the O(s^2) XLA path — perf/memory cliff at long "
+                    "seq", RuntimeWarning)
     return _xla_sdpa(q, k, v, mask, key, dropout_p, is_causal, scale)
 
 

@@ -1,0 +1,321 @@
+"""Pallas TPU flash attention (forward + backward).
+
+The role of the reference's FlashAttention CUDA kernels
+(phi/kernels/gpu/flash_attn_kernel.cu, flash_attn_grad_kernel.cu; yaml
+phi/api/yaml/ops.yaml:239) — but designed for the TPU memory hierarchy:
+blocks of Q stay resident in VMEM while K/V blocks stream in, both matmuls
+of each tile land on the MXU, and the online-softmax state (m, l, acc)
+lives in VMEM scratch that persists across the innermost grid dimension.
+
+Layout: (batch, seq, heads, head_dim) — same as the reference flash_attn op —
+folded to (batch*heads, seq, head_dim) for the kernel.
+
+Backward is FlashAttention-2 style: save only the LSE from forward, then two
+kernels — dKdV (grid over k-blocks, streaming q) and dQ (grid over q-blocks,
+streaming k) — recompute P = exp(S - lse) per tile.  No O(s^2) tensor is ever
+materialised.
+
+The per-row statistics (lse, delta) are stored lane-broadcast as
+(bh, seq, 128) so both grids read them in (rows=q, lanes) orientation
+without sublane/lane transposes.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, offset, block_q, block_k, num_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    needed = True
+    if causal:
+        # block (qi, ki) contributes iff some k index <= some q index
+        needed = ki * block_k <= qi * block_q + block_q - 1 + offset
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0]                                      # (bq, d)
+        k = k_ref[0]                                      # (bk, d)
+        v = v_ref[0]                                      # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + offset >= cols, s, NEG_INF)
+        m_prev = m_ref[:, :1]                             # (bq, 1)
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                            # (bq, bk)
+        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(safe_l)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             offset=sk - sq, block_q=block_q,
+                             block_k=block_k, num_k=nk)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# --------------------------------------------------------------- backward
+
+def _dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                 dk_ref, dv_ref, dk_acc, dv_acc,
+                 *, scale, causal, offset, block_q, block_k, num_q):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    needed = True
+    if causal:
+        needed = ki * block_k <= qi * block_q + block_q - 1 + offset
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0]                                      # (bq, d)
+        k = k_ref[0]                                      # (bk, d)
+        v = v_ref[0]
+        do = do_ref[0]                                    # (bq, d)
+        lse = lse_ref[0][:, :1]                           # (bq, 1)
+        delta = delta_ref[0][:, :1]                       # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + offset >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                              # (bq, bk)
+        # dv += p^T @ do   (contract over q rows)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dp = do @ v^T
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq, bk)
+        ds = p * (dp - delta) * scale
+        # dk += ds^T @ q
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == num_q - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_acc,
+               *, scale, causal, offset, block_q, block_k, num_k):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    needed = True
+    if causal:
+        needed = ki * block_k <= qi * block_q + block_q - 1 + offset
+
+    @pl.when(needed)
+    def _():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows + offset >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                     # (bq, bk)
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == num_k - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+              interpret):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)               # (bh, sq, 1)
+    delta = jnp.broadcast_to(delta, (bh, sq, LANES))
+
+    q_spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    stat_spec_q = pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, j, 0))
+    kv_spec_k = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkdv_kernel, scale=scale, causal=causal,
+                          offset=sk - sq, block_q=block_q,
+                          block_k=block_k, num_q=nq),
+        grid=(bh, nk, nq),
+        in_specs=[q_spec_q, kv_spec_k, kv_spec_k, q_spec_q, stat_spec_q,
+                  stat_spec_q],
+        out_specs=[kv_spec_k, kv_spec_k],
+        out_shape=[jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sk, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    stat_spec = pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          offset=sk - sq, block_q=block_q,
+                          block_k=block_k, num_k=nk),
+        grid=(bh, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------- custom-vjp assembly
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash3(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash3_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash3_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _bwd_impl(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                     interpret)
+
+
+_flash3.defvjp(_flash3_fwd, _flash3_bwd)
+
+
+def flash_attention(q, k, v, mask=None, is_causal=False, scale=None,
+                    block_q=128, block_k=128, interpret=None):
+    """Flash attention in (batch, seq, heads, head_dim) layout.
+
+    ``mask`` is not supported by the kernel (the XLA sdpa path in
+    ops/attention.py handles arbitrary masks); seq lengths must divide the
+    block sizes.
+    """
+    if mask is not None:
+        raise NotImplementedError("pallas flash kernel: mask unsupported")
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq ({sq},{sk}) must divide blocks "
+                         f"({block_q},{block_k})")
+    if interpret is None:
+        interpret = _interpret()
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    o = _flash3(fold(q), fold(k), fold(v), bool(is_causal), float(scale),
+                int(block_q), int(block_k), bool(interpret))
+    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
