@@ -20,19 +20,29 @@ from ..nn.layers_common import Dropout, LayerNorm
 from ..parallel.mp_layers import ColumnParallelLinear, RowParallelLinear
 
 
+def _sep_active() -> bool:
+    from ..parallel import topology
+
+    mesh = topology.get_current_mesh()
+    return mesh is not None and dict(mesh.shape).get("sep", 1) > 1
+
+
 class ParallelSelfAttention(Layer):
     """Self-attention with heads sharded over "mp"; optional KV cache for
     decode (cache layout [b, s, h, d] — the reference CacheKV is
     [2, b, h, max_seq, d], fused_multi_transformer_op.cc:103)."""
 
-    def __init__(self, hidden, num_heads, dropout=0.0, causal=False):
+    def __init__(self, hidden, num_heads, dropout=0.0, causal=False,
+                 seq_parallel=None):
         super().__init__()
         assert hidden % num_heads == 0
+        assert seq_parallel in (None, "ring", "ulysses")
         self.hidden = hidden
         self.num_heads = num_heads
         self.head_dim = hidden // num_heads
         self.dropout = dropout
         self.causal = causal
+        self.seq_parallel = seq_parallel
         self.qkv_proj = ColumnParallelLinear(hidden, 3 * hidden,
                                              gather_output=False)
         self.out_proj = RowParallelLinear(hidden, hidden,
@@ -47,18 +57,27 @@ class ParallelSelfAttention(Layer):
         if cache is not None:
             k = D("concat", cache[0], k, axis=1)
             v = D("concat", cache[1], v, axis=1)
-        # pin head sharding so GSPMD keeps attention fully local per mp shard
-        hspec = ("data", None, "mp", None)
+        # pin head (and, under sequence parallelism, seq) sharding so GSPMD
+        # keeps attention local per mp shard / per sep seq-shard
+        hspec = (("data", "sep", "mp", None) if self.seq_parallel
+                 else ("data", None, "mp", None))
         q = D("sharding_constraint", q, spec=hspec)
         k = D("sharding_constraint", k, spec=hspec)
         v = D("sharding_constraint", v, spec=hspec)
-        # causal stays on with a cache: the sdpa mask is offset by
-        # (len_k - len_q), so cached prefill/decode attends to the full
-        # past but never to future tokens of the current chunk.
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask,
-            dropout_p=self.dropout if self.training else 0.0,
-            is_causal=self.causal)
+        if self.seq_parallel and _sep_active():
+            assert cache is None, \
+                "seq_parallel is a training feature (no KV cache)"
+            op = ("ring_attention" if self.seq_parallel == "ring"
+                  else "ulysses_attention")
+            out = D(op, q, k, v, is_causal=self.causal)
+        else:
+            # causal stays on with a cache: the sdpa mask is offset by
+            # (len_k - len_q), so cached prefill/decode attends to the full
+            # past but never to future tokens of the current chunk.
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                dropout_p=self.dropout if self.training else 0.0,
+                is_causal=self.causal)
         out = D("reshape", out, shape=(b, s, self.hidden))
         out = self.out_proj(out)
         if cache is not None:
@@ -91,13 +110,13 @@ class ParallelTransformerLayer(Layer):
     def __init__(self, hidden, num_heads, ffn_hidden, dropout=0.1,
                  attn_dropout=None, activation="gelu",
                  normalize_before=False, causal=False,
-                 layer_norm_eps=1e-12):
+                 layer_norm_eps=1e-12, seq_parallel=None):
         super().__init__()
         self.normalize_before = normalize_before
         self.self_attn = ParallelSelfAttention(
             hidden, num_heads,
             dropout=attn_dropout if attn_dropout is not None else dropout,
-            causal=causal)
+            causal=causal, seq_parallel=seq_parallel)
         self.mlp = ParallelMLP(hidden, ffn_hidden, activation, dropout)
         self.norm1 = LayerNorm(hidden, epsilon=layer_norm_eps)
         self.norm2 = LayerNorm(hidden, epsilon=layer_norm_eps)

@@ -63,3 +63,47 @@ def _sharding_constraint_grad(ctx, g):
 
     spec = tuple(ctx.attrs.get("spec", ()))
     return (Tensor(_constrain(g._data, spec)),)
+
+
+# -------------------------------------------------- sequence parallelism
+# (new design — absent from the reference, SURVEY.md §5.7)
+
+def _seq_parallel_grad(name):
+    """Backward via jax.vjp run inline (no jit cache: the impl reads the
+    current mesh, which must not be frozen into a cache entry)."""
+
+    def grad_fn(ctx, gout):
+        from ..core.dispatch import get_op
+        from ..core.tensor import Tensor
+        import functools
+
+        op = get_op(name)
+        impl = functools.partial(op.impl, **ctx.attrs)
+        arrays = tuple(t._data for t in ctx.inputs[:3])
+        _, vjp = jax.vjp(impl, *arrays)
+        gq, gk, gv = vjp(gout._data.astype(arrays[0].dtype))
+        return (Tensor(gq), Tensor(gk), Tensor(gv))
+
+    register_grad(name)(grad_fn)
+
+
+@register_op("ring_attention", save_inputs=True, jit=False)
+def _ring_attention_op(q, k, v, is_causal=False, scale=None,
+                       axis_name="sep"):
+    from ..parallel.sequence_parallel import ring_attention
+
+    return ring_attention(q, k, v, axis_name=axis_name,
+                          is_causal=is_causal, scale=scale)
+
+
+@register_op("ulysses_attention", save_inputs=True, jit=False)
+def _ulysses_attention_op(q, k, v, is_causal=False, scale=None,
+                          axis_name="sep"):
+    from ..parallel.sequence_parallel import ulysses_attention
+
+    return ulysses_attention(q, k, v, axis_name=axis_name,
+                             is_causal=is_causal, scale=scale)
+
+
+_seq_parallel_grad("ring_attention")
+_seq_parallel_grad("ulysses_attention")

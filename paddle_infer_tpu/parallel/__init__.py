@@ -17,6 +17,7 @@ from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
 from . import fleet
 from .fleet import DistributedStrategy, FleetTrainStep
 from .sharding import group_sharded_parallel
+from .sequence_parallel import ring_attention, ulysses_attention
 from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed
 
 __all__ = [
@@ -28,5 +29,5 @@ __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "fleet", "DistributedStrategy", "FleetTrainStep",
     "group_sharded_parallel", "get_rng_state_tracker", "RNGStatesTracker",
-    "model_parallel_random_seed",
+    "model_parallel_random_seed", "ring_attention", "ulysses_attention",
 ]

@@ -1,0 +1,159 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has NO sequence parallelism (SURVEY.md §5.7: repo-wide grep for
+ring_attention/context_parallel/ulysses = zero hits) — long sequences there
+rely on FlashAttention kernels only.  This module designs it fresh for TPU:
+
+* **Ring attention** (`ring_attention`): every device holds a sequence shard
+  of Q/K/V; K/V blocks rotate around the "sep" mesh axis via
+  ``jax.lax.ppermute`` (XLA lowers this onto the ICI ring) while each device
+  accumulates flash-style online softmax state for its resident Q shard.
+  Peak memory is O(s_local^2) per step instead of O(s^2); comm is fully
+  overlappable neighbour traffic.  Differentiable (the scan/ppermute graph
+  transposes to the reverse ring).
+
+* **Ulysses** (`ulysses_attention`): all-to-all on the "sep" axis re-shards
+  (seq-sharded, all heads) -> (full seq, head-sharded), runs dense local
+  attention (the Pallas flash kernel path), and all-to-alls back.  Cheaper
+  compute-wise when heads >= sep degree; comm is 2 all-to-alls of activation
+  size.
+
+Both operate in the framework's (batch, seq, heads, head_dim) layout and are
+exposed as registered ops and through ``ParallelSelfAttention``'s
+``seq_parallel`` mode.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from . import topology
+
+NEG_INF = -1e30
+
+
+# ----------------------------------------------------------- local kernels
+
+def _ring_attention_local(q, k, v, axis_name, causal, scale):
+    """Per-shard ring attention body (runs inside shard_map).
+
+    q/k/v: (b, s_loc, h, d) — this device's sequence shard.
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, d = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    qpos = idx * s_loc + jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+    kiota = jax.lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 1)
+
+    m0 = jnp.full((b, h, s_loc, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(carry, i):
+        k_cur, v_cur, m, l, acc = carry
+        src = (idx - i) % n      # rank that produced the resident K/V block
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cur,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            kpos = src * s_loc + kiota
+            mask = qpos >= kpos                       # (s_loc, s_loc)
+            logits = jnp.where(mask, logits, NEG_INF)
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new)
+        l_new = corr * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v_cur.dtype), v_cur,
+                        preferred_element_type=jnp.float32)
+        acc_new = acc * corr + pv
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, acc_new), None
+
+    (_, _, m, l, acc), _ = jax.lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(n))
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / safe_l).astype(q.dtype)              # (b, h, s_loc, d)
+    return out.transpose(0, 2, 1, 3)                  # (b, s_loc, h, d)
+
+
+def _ulysses_local(q, k, v, axis_name, causal, scale):
+    """Per-shard Ulysses body: seq-shard -> head-shard -> dense local
+    attention -> back.  Heads must divide the sep degree."""
+    from ..ops.attention import _sdpa
+
+    def scatter(x):      # (b, s_loc, h, d) -> (b, s, h/n, d)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def gather(x):       # (b, s, h/n, d) -> (b, s_loc, h, d)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    out = _sdpa(scatter(q), scatter(k), scatter(v), None, None, 0.0,
+                causal, scale)
+    return gather(out)
+
+
+# ------------------------------------------------------------- public API
+
+def _resolve_specs(mesh, axis_name):
+    """Default in/out specs on the hybrid mesh: batch over dp+sharding,
+    seq over the sep axis, heads over mp (when present)."""
+    names = set(mesh.axis_names)
+    batch = tuple(a for a in ("dp", "sharding") if a in names) or None
+    heads = "mp" if "mp" in names else None
+    return P(batch, axis_name, heads, None)
+
+
+def _seq_parallel_call(local_fn, q, k, v, mesh, axis_name, causal, scale,
+                       spec):
+    mesh = mesh or topology.get_current_mesh()
+    if mesh is None or axis_name not in mesh.axis_names:
+        raise ValueError(f"mesh with a '{axis_name}' axis is required "
+                         "(fleet.init with sep_degree, or pass mesh=)")
+    if mesh.shape[axis_name] == 1:
+        from ..ops.attention import _sdpa
+
+        return _sdpa(q, k, v, None, None, 0.0, causal, scale)
+    spec = spec if spec is not None else _resolve_specs(mesh, axis_name)
+    fn = jax.shard_map(
+        partial(local_fn, axis_name=axis_name, causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def ring_attention(q, k, v, mesh=None, axis_name: str = "sep",
+                   is_causal: bool = False, scale: Optional[float] = None,
+                   spec=None):
+    """Ring (context-parallel) attention over the ``axis_name`` mesh axis.
+
+    Inputs are (b, s, h, d) with the seq dim sharded over ``axis_name``
+    (global view — shard_map slices them).  Returns the same layout.
+    """
+    return _seq_parallel_call(_ring_attention_local, q, k, v, mesh,
+                              axis_name, bool(is_causal), scale, spec)
+
+
+def ulysses_attention(q, k, v, mesh=None, axis_name: str = "sep",
+                      is_causal: bool = False,
+                      scale: Optional[float] = None, spec=None):
+    """Ulysses (all-to-all head-scatter) attention over ``axis_name``.
+
+    num_heads must be divisible by the axis degree.
+    """
+    n = (mesh or topology.get_current_mesh()).shape[axis_name]
+    if q.shape[2] % n:
+        raise ValueError(f"num_heads {q.shape[2]} not divisible by "
+                         f"sep degree {n}")
+    return _seq_parallel_call(_ulysses_local, q, k, v, mesh, axis_name,
+                              bool(is_causal), scale, spec)
