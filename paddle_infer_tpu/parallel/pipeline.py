@@ -1,0 +1,199 @@
+"""Pipeline parallelism as a collective SPMD program.
+
+Reference: fleet/meta_parallel/parallel_layers/pp_layers.py:211
+(``PipelineLayer`` stage partitioning over ``LayerDesc``s, shared
+embeddings), fleet/meta_parallel/pipeline_parallel.py:34,120 (1F1B
+micro-batch scheduler over NCCL p2p sends), C++ ``PipelineTrainer``
+(framework/trainer.h:307).
+
+TPU-first redesign — no per-rank scheduler process, no p2p runtime: the
+whole pipeline is ONE jitted SPMD program.
+
+* A homogeneous stack of N identical blocks keeps every parameter leaf
+  **layer-stacked**: shape (N, ...) with dist_attr ("pp", ...), so the
+  leading layer axis shards across pipeline stages (each stage holds
+  N/pp layers resident — the reference's stage partitioning, expressed as
+  a sharding).
+* The schedule is a ``shard_map`` manual only over the "pp" mesh axis
+  (other axes — dp/mp/sep/sharding — stay under GSPMD): micro-batches are
+  injected at stage 0, each tick every stage applies its resident layers
+  (``lax.scan``) and hands its activation to the next stage with
+  ``ppermute`` (ICI neighbour hop).  After M + pp - 1 ticks the last
+  stage holds all outputs, broadcast back with a masked ``psum``.
+* Differentiating the program transposes the scan + ppermute graph into
+  the reverse pipeline — the backward schedule the reference hand-codes
+  in ``forward_backward_pipeline``, here derived by AD and interleaved by
+  the XLA scheduler (fill-drain/GPipe order; ``recompute=True`` adds
+  per-layer rematerialisation like the reference's recompute
+  meta-optimizer).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.dispatch import dispatch as D, get_op, register_grad, register_op
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer import Layer
+from . import topology
+
+
+class LayerDesc:
+    """Deferred layer construction (reference pp_layers.py:59) so the
+    pipeline can instantiate one template + N parameter sets."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+def _sanitize(name: str) -> str:
+    return name.replace(".", "__")
+
+
+# ------------------------------------------------------------------ the op
+
+def _apply_template(template, names, layer_arrays, h):
+    params = dict(zip(names, layer_arrays))
+    out = template.functional_call(params, Tensor(h))
+    return out._data if isinstance(out, Tensor) else out
+
+
+@register_op("pipeline_apply", save_inputs=True, jit=False)
+def _pipeline_apply(x, *stacked, template=None, names=(),
+                    micro_batches=1, recompute=False):
+    """Run ``x`` through the layer-stacked block stack, pipelined over the
+    "pp" mesh axis when one is active."""
+    names = list(names)
+    mesh = topology.get_current_mesh()
+    pp = dict(mesh.shape).get("pp", 1) if mesh is not None else 1
+
+    apply_one = functools.partial(_apply_template, template, names)
+    if recompute:
+        apply_one = jax.checkpoint(apply_one)
+
+    def run_layers(layer_stack, h):
+        def body(hh, lp):
+            return apply_one(lp, hh), None
+
+        hh, _ = jax.lax.scan(body, h, layer_stack)
+        return hh
+
+    params = tuple(stacked)
+    if pp <= 1:
+        return run_layers(params, x)
+
+    L = stacked[0].shape[0]
+    if L % pp:
+        raise ValueError(f"num_layers {L} not divisible by pp degree {pp}")
+    M = int(micro_batches)
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by micro_batches {M}")
+
+    def local_fn(x_full, *params_loc):
+        stage = jax.lax.axis_index("pp")
+        mbs = x_full.reshape((M, B // M) + x_full.shape[1:])
+        # carries become pp-varying inside the loop; mark them so upfront
+        state0 = jax.lax.pcast(jnp.zeros_like(mbs[0]), ("pp",),
+                               to="varying")
+        out0 = jax.lax.pcast(jnp.zeros_like(mbs), ("pp",), to="varying")
+
+        def tick(carry, t):
+            state, out = carry
+            mb_idx = jnp.clip(t, 0, M - 1)
+            x_next = jax.lax.dynamic_index_in_dim(mbs, mb_idx, 0,
+                                                  keepdims=False)
+            x_in = jnp.where(jnp.equal(stage, 0), x_next, state)
+            y = run_layers(params_loc, x_in)
+            # last stage banks micro-batch t-(pp-1) once it's valid
+            out_idx = jnp.clip(t - (pp - 1), 0, M - 1)
+            is_out = jnp.logical_and(jnp.equal(stage, pp - 1),
+                                     t - (pp - 1) >= 0)
+            prev = jax.lax.dynamic_index_in_dim(out, out_idx, 0,
+                                                keepdims=False)
+            out = jax.lax.dynamic_update_index_in_dim(
+                out, jnp.where(is_out, y, prev), out_idx, 0)
+            # hand activation to the next stage (no wraparound)
+            y_send = jax.lax.ppermute(
+                y, "pp", [(i, i + 1) for i in range(pp - 1)])
+            return (y_send, out), None
+
+        (_, out), _ = jax.lax.scan(tick, (state0, out0),
+                                   jnp.arange(M + pp - 1))
+        # only the last stage's buffer is real (others stayed zero)
+        out = jax.lax.psum(out, "pp")
+        return out.reshape(x_full.shape)
+
+    pspec = tuple(P("pp") for _ in params)
+    # manual over "pp" only; dp/mp/sep/sharding stay under GSPMD inside the
+    # body.  check_vma=True: the trailing psum proves the output replicated.
+    fn = jax.shard_map(local_fn, mesh=mesh,
+                       in_specs=(P(),) + pspec, out_specs=P(),
+                       axis_names=frozenset({"pp"}), check_vma=True)
+    return fn(x, *params)
+
+
+@register_grad("pipeline_apply")
+def _pipeline_apply_grad(ctx, gout):
+    op = get_op("pipeline_apply")
+    impl = functools.partial(op.impl, **ctx.attrs)
+    arrays = tuple(t._data for t in ctx.inputs)
+    _, vjp = jax.vjp(impl, *arrays)
+    grads = vjp(gout._data.astype(arrays[0].dtype))
+    return tuple(Tensor(g) for g in grads)
+
+
+# ------------------------------------------------------------------ layer
+
+class PipelineStack(Layer):
+    """N identical blocks, parameters layer-stacked and pp-sharded.
+
+    The TPU-native core of the reference's ``PipelineLayer``: embeddings /
+    heads stay outside (replicated over pp); the homogeneous transformer
+    middle is what pipelines.  ``micro_batches`` is the reference's
+    ``accumulate_steps`` (pipeline_configs).
+    """
+
+    def __init__(self, desc: LayerDesc, num_layers: int,
+                 micro_batches: int = 1, recompute: bool = False):
+        super().__init__()
+        self.num_layers = int(num_layers)
+        self.micro_batches = int(micro_batches)
+        self.recompute = bool(recompute)
+        template = desc.build()
+        object.__setattr__(self, "_template", template)
+        instances = [desc.build() for _ in range(num_layers)]
+        self._pnames = [n for n, _ in template.named_parameters()]
+        for n, tp in template.named_parameters():
+            stacked = jnp.stack(
+                [dict(inst.named_parameters())[n]._data
+                 for inst in instances])
+            p = Parameter(stacked, name=f"pipeline.{n}")
+            da = tuple(tp.dist_attr) if tp.dist_attr else ()
+            p.dist_attr = ("pp",) + da + (None,) * (
+                stacked.ndim - 1 - len(da))
+            setattr(self, _sanitize(n), p)
+
+    def train(self):
+        self._template.train()
+        return super().train()
+
+    def eval(self):
+        self._template.eval()
+        return super().eval()
+
+    def forward(self, x):
+        stacked = [self._parameters[_sanitize(n)] for n in self._pnames]
+        return D("pipeline_apply", x, *stacked, template=self._template,
+                 names=tuple(self._pnames),
+                 micro_batches=self.micro_batches,
+                 recompute=self.recompute)
