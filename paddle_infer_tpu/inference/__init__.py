@@ -1,6 +1,9 @@
 """Inference engine (reference: paddle/fluid/inference/ — AnalysisPredictor,
-AnalysisConfig).  See predictor.py / config.py."""
-from .config import Config
+AnalysisConfig; the fork's fused_multi_transformer serving stack).  See
+predictor.py / config.py / generation.py."""
+from .config import Config, PrecisionType
+from .generation import GenerationConfig, GenerationEngine
 from .predictor import Predictor, create_predictor
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+__all__ = ["Config", "PrecisionType", "Predictor", "create_predictor",
+           "GenerationConfig", "GenerationEngine"]

@@ -1,0 +1,362 @@
+"""Autoregressive generation engine — the serving loop the fork builds its
+fused_multi_transformer stack for.
+
+Reference behavior covered here:
+  - KV-cache decode: fused_multi_transformer_op.cu appends K/V into a
+    max-seq CacheKV tensor and attends over the prefix
+    (fused_multi_transformer_op.cc:103 cache shape checks).
+  - beam_search_softmax (phi/kernels/fusion/gpu/beam_search_softmax.cu):
+    fused softmax + beam top-k + finished-beam handling.
+  - sampling decode (PaddleNLP top-k/top-p serving path).
+
+TPU-first design: generation is ONE compiled XLA program per
+(batch, prompt-bucket, cache-bucket, config) — prefill, then a
+``lax.while_loop`` decode in which every step updates the static-shape KV
+buffers via ``dynamic_update_slice`` and samples on-device.  No per-token
+Python, no host↔device traffic until the loop exits, early-exit when every
+row hit EOS.  Executables are cached by bucket key (the analog of the
+reference predictor's shape-keyed TRT engine cache).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.autograd import no_grad
+from ..core.tensor import Tensor
+from . import sampling
+
+
+@dataclass
+class GenerationConfig:
+    """Decode-time knobs (reference: PaddleNLP GenerationConfig + the
+    sampling attrs of beam_search_softmax)."""
+
+    max_new_tokens: int = 64
+    min_length: int = 0
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    num_beams: int = 1
+    length_penalty: float = 1.0
+    repetition_penalty: float = 1.0
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+    seed: int = 0
+
+    def cache_key(self):
+        return (self.max_new_tokens, self.min_length, self.do_sample,
+                self.temperature, self.top_k, self.top_p, self.num_beams,
+                self.length_penalty, self.repetition_penalty,
+                self.eos_token_id, self.pad_token_id)
+
+
+def _round_up(n, mult):
+    return ((n + mult - 1) // mult) * mult
+
+
+class GenerationEngine:
+    """Compiled generator over a causal-LM Layer (GPTForCausalLM-shaped:
+    ``forward(input_ids, position_ids, attention_mask, caches)`` returning
+    ``(logits, new_caches)`` when caches are given)."""
+
+    def __init__(self, model, cache_bucket: int = 128,
+                 prompt_bucket: int = 64, cache_dtype=None):
+        model.eval()
+        self._model = model
+        cfg = model.config
+        self._num_layers = cfg.num_hidden_layers
+        self._num_heads = cfg.num_attention_heads
+        self._head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self._max_positions = cfg.max_position_embeddings
+        self._cache_bucket = cache_bucket
+        self._prompt_bucket = prompt_bucket
+        self._params = {n: p._data for n, p in model.named_parameters()}
+        self._cache_dtype = cache_dtype or next(
+            iter(self._params.values())).dtype
+        self._compiled = {}
+
+    # ------------------------------------------------------------ plumbing
+    def _empty_caches(self, batch, cache_len):
+        shape = (batch, cache_len, self._num_heads, self._head_dim)
+        zero_idx = jnp.zeros((), jnp.int32)
+        return [(jnp.zeros(shape, self._cache_dtype),
+                 jnp.zeros(shape, self._cache_dtype), zero_idx)
+                for _ in range(self._num_layers)]
+
+    def _model_step(self, params, ids, position_ids, pad_mask_add, caches):
+        """One forward over the Layer with traced arrays; returns raw
+        logits + cache arrays.  The Layer runs under no_grad so dispatch
+        skips tape recording inside the trace."""
+        tcaches = [tuple(Tensor(a) for a in c) for c in caches]
+        mask_t = Tensor(pad_mask_add) if pad_mask_add is not None else None
+        with no_grad():
+            logits, new = self._model.functional_call(
+                params, Tensor(ids),
+                position_ids=Tensor(position_ids),
+                attention_mask=mask_t, caches=tcaches)
+        return logits._data, [tuple(x._data for x in c) for c in new]
+
+    def _pad_mask_add(self, prompt_mask, cache_len):
+        """[b, plen] 0/1 prompt mask → additive [b, 1, 1, cache_len] over
+        the KV buffer (pad slots -inf; slots past the prompt are ruled by
+        kv_cache_mask, so 0 here)."""
+        b, plen = prompt_mask.shape
+        pad = jnp.zeros((b, cache_len - plen), prompt_mask.dtype)
+        full = jnp.concatenate([prompt_mask, 1 + pad], axis=1)
+        add = jnp.where(full == 0, sampling.NEG_INF, 0.0).astype(jnp.float32)
+        return add[:, None, None, :]
+
+    # ----------------------------------------------------------- sampling
+    def _build_sample(self, batch, plen, cache_len, g: GenerationConfig):
+        """Build the fused prefill+decode program for greedy/sampling."""
+        max_new = g.max_new_tokens
+
+        def run(params, ids, prompt_mask, rng):
+            lengths = jnp.sum(prompt_mask, axis=1).astype(jnp.int32)  # [b]
+            pad_add = self._pad_mask_add(prompt_mask, cache_len)
+            # prefill: positions = cumsum(mask)-1 (left/right padding safe)
+            pos = jnp.clip(jnp.cumsum(prompt_mask, axis=1) - 1, 0, None)
+            caches = self._empty_caches(batch, cache_len)
+            logits, caches = self._model_step(
+                params, ids, pos.astype(jnp.int32), pad_add, caches)
+            # prompts are left-padded, so the last real token is the last
+            # slot in every row
+            last = logits[:, -1]
+
+            out_buf = jnp.full((batch, max_new), g.pad_token_id, jnp.int32)
+            finished = jnp.zeros((batch,), jnp.bool_)
+            hist0 = jnp.concatenate(
+                [jnp.where(prompt_mask > 0, ids, -1),
+                 jnp.full((batch, max_new), -1, jnp.int32)], axis=1)
+
+            def pick(logits_row, hist, step, key):
+                proc = sampling.process_logits(
+                    logits_row, temperature=g.temperature, top_k=g.top_k,
+                    top_p=g.top_p, token_history=hist,
+                    repetition_penalty=g.repetition_penalty,
+                    eos_token_id=g.eos_token_id, cur_len=step,
+                    min_length=g.min_length)
+                tok = sampling.sample_token(proc, key, g.do_sample)
+                logp = jax.nn.log_softmax(proc, axis=-1)
+                tok_logp = jnp.take_along_axis(
+                    logp, tok[:, None], axis=-1)[:, 0]
+                return tok, tok_logp
+
+            k0, rng = jax.random.split(rng)
+            tok, tok_logp = pick(last, hist0, 0, k0)
+            if g.eos_token_id is not None:
+                finished = tok == g.eos_token_id
+            out_buf = out_buf.at[:, 0].set(tok)
+            hist0 = hist0.at[:, plen].set(tok)
+            cum = tok_logp
+
+            def cond(state):
+                step = state[0]
+                fin = state[3]
+                return jnp.logical_and(step < max_new,
+                                       jnp.logical_not(jnp.all(fin)))
+
+            def body(state):
+                step, tok, out, fin, hist, cum, caches, rng = state
+                p = (lengths + step - 1)[:, None]
+                logits, caches = self._model_step(
+                    params, tok[:, None], p, pad_add, caches)
+                key, rng = jax.random.split(rng)
+                nxt, tok_logp = pick(logits[:, -1], hist, step, key)
+                if g.eos_token_id is not None:
+                    nxt = jnp.where(fin, g.pad_token_id, nxt)
+                    cum = jnp.where(fin, cum, cum + tok_logp)
+                    new_fin = jnp.logical_or(fin, nxt == g.eos_token_id)
+                else:
+                    cum = cum + tok_logp
+                    new_fin = fin
+                out = jax.lax.dynamic_update_slice(
+                    out, nxt[:, None], (jnp.zeros((), jnp.int32), step))
+                hist = jax.lax.dynamic_update_slice(
+                    hist, nxt[:, None], (jnp.zeros((), jnp.int32),
+                                         plen + step))
+                return (step + 1, nxt, out, new_fin, hist, cum, caches, rng)
+
+            state = (jnp.asarray(1, jnp.int32), tok, out_buf, finished,
+                     hist0, cum, caches, rng)
+            state = jax.lax.while_loop(cond, body, state)
+            return state[2], state[5]
+
+        return jax.jit(run)
+
+    # -------------------------------------------------------- beam search
+    def _build_beam(self, batch, plen, cache_len, g: GenerationConfig):
+        """Fused beam search (reference beam_search_softmax semantics:
+        per-step fused log-softmax + top-k over W·V with finished beams
+        pinned to pad at unchanged score; length penalty applied at
+        finalization)."""
+        W = g.num_beams
+        max_new = g.max_new_tokens
+        pad = g.pad_token_id
+
+        def run(params, ids, prompt_mask, rng):
+            del rng
+            b = batch
+            lengths = jnp.sum(prompt_mask, axis=1).astype(jnp.int32)
+            # expand to beam batch [b*W, ...]
+            ids_w = jnp.repeat(ids, W, axis=0)
+            mask_w = jnp.repeat(prompt_mask, W, axis=0)
+            lengths_w = jnp.repeat(lengths, W, axis=0)
+            pad_add = self._pad_mask_add(mask_w, cache_len)
+            pos = jnp.clip(jnp.cumsum(mask_w, axis=1) - 1, 0, None)
+            caches = self._empty_caches(b * W, cache_len)
+            logits, caches = self._model_step(
+                params, ids_w, pos.astype(jnp.int32), pad_add, caches)
+            # left-padded prompts: last slot is the last real token
+            last = logits[:, -1]
+            logp = jax.nn.log_softmax(last.astype(jnp.float32), axis=-1)
+            if g.eos_token_id is not None and g.min_length > 0:
+                logp = logp.at[:, g.eos_token_id].set(sampling.NEG_INF)
+            vocab = logp.shape[-1]
+            # first step: only beam 0 is live (identical prefixes)
+            init_bias = jnp.where(jnp.arange(W) == 0, 0.0, sampling.NEG_INF)
+            scores = logp.reshape(b, W, vocab) + init_bias[None, :, None]
+            flat = scores.reshape(b, W * vocab)
+            top_s, top_i = jax.lax.top_k(flat, W)        # [b, W]
+            beam_src = top_i // vocab
+            tok = (top_i % vocab).astype(jnp.int32)
+            cum = top_s
+            finished = (tok == g.eos_token_id) if g.eos_token_id is not None \
+                else jnp.zeros((b, W), jnp.bool_)
+            gen_len = jnp.ones((b, W), jnp.int32)
+            out = jnp.full((b, W, max_new), pad, jnp.int32)
+            out = out.at[:, :, 0].set(tok)
+
+            def reorder(arr, src):
+                """Gather beam-major [b*W, ...] rows by per-batch source
+                beam indices [b, W]."""
+                a = arr.reshape((b, W) + arr.shape[1:])
+                a = jnp.take_along_axis(
+                    a, src.reshape((b, W) + (1,) * (a.ndim - 2)), axis=1)
+                return a.reshape((b * W,) + arr.shape[1:])
+
+            def reorder_caches(caches, src):
+                return [(reorder(k, src), reorder(v, src), i)
+                        for k, v, i in caches]
+
+            # tok/out are already target-ordered; only the caches (still in
+            # source-beam order) need the gather
+            caches = reorder_caches(caches, beam_src)
+
+            def cond(state):
+                step, fin = state[0], state[4]
+                return jnp.logical_and(step < max_new,
+                                       jnp.logical_not(jnp.all(fin)))
+
+            def body(state):
+                step, tok, out, cum, fin, gen_len, caches = state
+                p = (lengths_w + step - 1)[:, None]
+                logits, caches = self._model_step(
+                    params, tok.reshape(b * W, 1), p, pad_add, caches)
+                logp = jax.nn.log_softmax(
+                    logits[:, -1].astype(jnp.float32), axis=-1)
+                logp = logp.reshape(b, W, vocab)
+                if g.eos_token_id is not None and g.min_length > 0:
+                    logp = jnp.where(step < g.min_length,
+                                     logp.at[:, :, g.eos_token_id].set(
+                                         sampling.NEG_INF), logp)
+                # finished beams: only pad continues, at unchanged score
+                pad_row = jnp.full((vocab,), sampling.NEG_INF,
+                                   jnp.float32).at[pad].set(0.0)
+                logp = jnp.where(fin[:, :, None], pad_row[None, None, :],
+                                 logp)
+                flat = (cum[:, :, None] + logp).reshape(b, W * vocab)
+                top_s, top_i = jax.lax.top_k(flat, W)
+                src = top_i // vocab
+                nxt = (top_i % vocab).astype(jnp.int32)
+                caches = reorder_caches(caches, src)
+                out = jnp.take_along_axis(out, src[:, :, None], axis=1)
+                fin = jnp.take_along_axis(fin, src, axis=1)
+                gen_len = jnp.take_along_axis(gen_len, src, axis=1)
+                gen_len = gen_len + jnp.logical_not(fin)
+                if g.eos_token_id is not None:
+                    fin = jnp.logical_or(fin, nxt == g.eos_token_id)
+                out = jax.lax.dynamic_update_slice(
+                    out, nxt[:, :, None],
+                    (jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+                     step))
+                return (step + 1, nxt, out, top_s, fin, gen_len, caches)
+
+            state = (jnp.asarray(1, jnp.int32), tok, out, cum, finished,
+                     gen_len, caches)
+            state = jax.lax.while_loop(cond, body, state)
+            _, _, out, cum, _, gen_len, _ = state
+            # finalize: length-penalized best beam per batch row
+            norm = cum / (gen_len.astype(jnp.float32) ** g.length_penalty)
+            best = jnp.argmax(norm, axis=1)
+            seq = jnp.take_along_axis(out, best[:, None, None], axis=1)[:, 0]
+            score = jnp.take_along_axis(norm, best[:, None], axis=1)[:, 0]
+            return seq, score
+
+        return jax.jit(run)
+
+    # ------------------------------------------------------------- public
+    def generate(self, input_ids, generation_config: GenerationConfig = None,
+                 attention_mask=None, return_scores: bool = False):
+        """Generate continuations.  ``input_ids`` [b, plen] (np/jax/Tensor),
+        optional 0/1 ``attention_mask`` marking real prompt tokens.
+        Returns np.ndarray [b, <=max_new_tokens] of generated ids (padded
+        with pad_token_id after EOS)."""
+        g = generation_config or GenerationConfig()
+        if g.num_beams > 1 and (g.do_sample or g.temperature != 1.0
+                                or g.top_k or g.top_p < 1.0
+                                or g.repetition_penalty != 1.0):
+            import warnings
+
+            warnings.warn(
+                "beam search ignores do_sample/temperature/top_k/top_p/"
+                "repetition_penalty (reference beam_search_softmax is "
+                "deterministic)", UserWarning)
+        # re-snapshot parameters so set_state_dict / dtype casts after
+        # engine construction are honored
+        self._params = {n: p._data
+                        for n, p in self._model.named_parameters()}
+        ids = np.asarray(input_ids._data if isinstance(input_ids, Tensor)
+                         else input_ids).astype(np.int32)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        b, plen_raw = ids.shape
+        mask = (np.ones_like(ids) if attention_mask is None
+                else np.asarray(attention_mask).astype(np.int32))
+        # bucket the prompt so executables are reused across nearby lengths,
+        # clamped so prompt + max_new still fits the position table
+        assert plen_raw + g.max_new_tokens <= self._max_positions, (
+            f"prompt {plen_raw} + max_new {g.max_new_tokens} exceeds "
+            f"max_position_embeddings {self._max_positions}")
+        plen = _round_up(max(plen_raw, 1), self._prompt_bucket)
+        plen = max(plen_raw, min(plen,
+                                 self._max_positions - g.max_new_tokens))
+        if plen > plen_raw:  # left-pad to the bucket
+            padw = plen - plen_raw
+            ids = np.pad(ids, ((0, 0), (padw, 0)),
+                         constant_values=g.pad_token_id)
+            mask = np.pad(mask, ((0, 0), (padw, 0)), constant_values=0)
+        cache_len = min(_round_up(plen + g.max_new_tokens,
+                                  self._cache_bucket), self._max_positions)
+        cache_len = max(cache_len, plen + g.max_new_tokens)
+
+        beam = g.num_beams > 1
+        key = ("beam" if beam else "sample", b, plen, cache_len,
+               g.cache_key())
+        fn = self._compiled.get(key)
+        if fn is None:
+            builder = self._build_beam if beam else self._build_sample
+            fn = builder(b, plen, cache_len, g)
+            self._compiled[key] = fn
+        rng = jax.random.PRNGKey(g.seed)
+        out = fn(self._params, jnp.asarray(ids), jnp.asarray(mask), rng)
+        seq, score = out
+        seq = np.asarray(seq)
+        return (seq, np.asarray(score)) if return_scores else seq

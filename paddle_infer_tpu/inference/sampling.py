@@ -1,0 +1,98 @@
+"""Token-sampling primitives for the generation engine.
+
+Reference: the fork serves decoding through fused sampling/beam ops
+(paddle/phi/kernels/fusion/gpu/beam_search_softmax.cu; PaddleNLP-style
+top-k/top-p sampling feeding fused_multi_transformer decode).  TPU-first:
+every transform below is a pure jnp function over the full [batch, vocab]
+logits row — sorts/cumsums vectorize on the VPU and the whole
+process→sample chain fuses into the compiled decode step, no host round
+trip per token.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e9
+
+
+def apply_temperature(logits, temperature):
+    """Scale logits by 1/T; T==1 is a no-op (guarded for T→0: callers use
+    greedy instead of dividing by zero)."""
+    t = jnp.maximum(jnp.asarray(temperature, logits.dtype), 1e-6)
+    return logits / t
+
+
+def apply_top_k(logits, k):
+    """Keep the k highest logits per row, mask the rest to -inf."""
+    vocab = logits.shape[-1]
+    k = max(1, min(int(k), vocab))
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def apply_top_p(logits, p):
+    """Nucleus filter: keep the smallest prefix of the sorted distribution
+    whose cumulative probability exceeds ``p`` (the top token always
+    survives)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # token ranked r is kept iff the mass strictly before it is < p
+    keep_sorted = (cum - probs) < p
+    keep_sorted = keep_sorted.at[..., 0].set(True)
+    # threshold = smallest kept logit
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+        keepdims=True)
+    return jnp.where(logits < thresh, NEG_INF, logits)
+
+
+def apply_min_length(logits, eos_token_id, cur_len, min_length):
+    """Forbid EOS until ``min_length`` tokens exist."""
+    if eos_token_id is None or min_length <= 0:
+        return logits
+    banned = cur_len < min_length
+    return jnp.where(
+        banned, logits.at[..., eos_token_id].set(NEG_INF), logits)
+
+
+def apply_repetition_penalty(logits, token_history, penalty):
+    """CTRL-style repetition penalty over the (padded) token history
+    [batch, hist]: seen tokens' logits are divided (if >0) or multiplied
+    (if <0) by ``penalty``.  History uses -1 for empty slots."""
+    if penalty == 1.0:
+        return logits
+    vocab = logits.shape[-1]
+    hist = jnp.where(token_history < 0, vocab, token_history)
+    zero = jnp.zeros((vocab + 1,), jnp.bool_)
+    seen = jax.vmap(lambda h: zero.at[h].set(True))(hist)[..., :vocab]
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, penalized, logits)
+
+
+def process_logits(logits, temperature=1.0, top_k=0, top_p=1.0,
+                   token_history=None, repetition_penalty=1.0,
+                   eos_token_id=None, cur_len=None, min_length=0):
+    """The logits-processor chain (order matches HF/PaddleNLP convention:
+    penalty → temperature → top-k → top-p)."""
+    logits = logits.astype(jnp.float32)
+    if token_history is not None and repetition_penalty != 1.0:
+        logits = apply_repetition_penalty(logits, token_history,
+                                          repetition_penalty)
+    if cur_len is not None:
+        logits = apply_min_length(logits, eos_token_id, cur_len, min_length)
+    if temperature != 1.0:
+        logits = apply_temperature(logits, temperature)
+    if top_k and top_k > 0:
+        logits = apply_top_k(logits, top_k)
+    if top_p < 1.0:
+        logits = apply_top_p(logits, top_p)
+    return logits
+
+
+def sample_token(logits, rng, do_sample):
+    """Greedy argmax or categorical draw from processed logits."""
+    if do_sample:
+        return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)

@@ -99,12 +99,18 @@ class GPTModel(Layer):
         if position_ids is None:
             import jax.numpy as jnp
 
-            # incremental decode: positions continue after the cached
-            # prefix (cache layout [b, s_past, h, d], shape is static
-            # under trace)
-            past = caches[0][0].shape[1] if caches else 0
-            position_ids = Tensor(
-                jnp.arange(past, past + s, dtype=jnp.int32))
+            if caches and len(caches[0]) == 3:
+                # static-cache decode: positions continue after the traced
+                # write index (inference/generation.py loop)
+                past = caches[0][2]
+                arange = Tensor(jnp.arange(s, dtype=jnp.int32))
+                position_ids = arange + past
+            else:
+                # growing cache: positions continue after the cached prefix
+                # (cache layout [b, s_past, h, d], static under trace)
+                past = caches[0][0].shape[1] if caches else 0
+                position_ids = Tensor(
+                    jnp.arange(past, past + s, dtype=jnp.int32))
             pos = D("unsqueeze", self.position_embeddings(position_ids),
                     axis=0)
         else:
@@ -130,6 +136,19 @@ class GPTForCausalLM(Layer):
         super().__init__()
         self.gpt = GPTModel(config)
         self.config = config
+
+    def generate(self, input_ids, generation_config=None, attention_mask=None,
+                 **kwargs):
+        """Compiled KV-cache generation (inference/generation.py); the
+        engine is built once and cached on the model."""
+        from ..inference.generation import GenerationConfig, GenerationEngine
+
+        if getattr(self, "_gen_engine", None) is None:
+            self._gen_engine = GenerationEngine(self)
+        if generation_config is None and kwargs:
+            generation_config = GenerationConfig(**kwargs)
+        return self._gen_engine.generate(input_ids, generation_config,
+                                         attention_mask=attention_mask)
 
     def forward(self, input_ids, position_ids=None, attention_mask=None,
                 caches=None):

@@ -54,7 +54,16 @@ class ParallelSelfAttention(Layer):
         qkv = D("reshape", qkv, shape=(b, s, 3, self.num_heads,
                                        self.head_dim))
         q, k, v = D("unstack", qkv, axis=2)
-        if cache is not None:
+        static_cache = cache is not None and len(cache) == 3
+        if static_cache:
+            # decode path: fixed-length buffers [b, max_len, h, d] + traced
+            # write index — one static shape for the whole generation loop
+            # (reference CacheKV append, fused_multi_transformer_op.cu; here
+            # dynamic_update_slice so XLA keeps a single executable).
+            k_buf, v_buf, index = cache
+            k = D("dynamic_update_slice", k_buf, k, index, axis=1)
+            v = D("dynamic_update_slice", v_buf, v, index, axis=1)
+        elif cache is not None:
             k = D("concat", cache[0], k, axis=1)
             v = D("concat", cache[1], v, axis=1)
         # pin head (and, under sequence parallelism, seq) sharding so GSPMD
@@ -70,6 +79,14 @@ class ParallelSelfAttention(Layer):
             op = ("ring_attention" if self.seq_parallel == "ring"
                   else "ulysses_attention")
             out = D(op, q, k, v, is_causal=self.causal)
+        elif static_cache:
+            # only slots < index + s hold real keys; the mask also carries
+            # causality within the current chunk, so is_causal is off.
+            mask = D("kv_cache_mask", index, q_len=s, kv_len=k.shape[1])
+            if attn_mask is not None:
+                mask = attn_mask + mask
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask, dropout_p=0.0, is_causal=False)
         else:
             # causal stays on with a cache: the sdpa mask is offset by
             # (len_k - len_q), so cached prefill/decode attends to the full
@@ -80,6 +97,8 @@ class ParallelSelfAttention(Layer):
                 is_causal=self.causal)
         out = D("reshape", out, shape=(b, s, self.hidden))
         out = self.out_proj(out)
+        if static_cache:
+            return out, (k, v, index + s)
         if cache is not None:
             return out, (k, v)
         return out

@@ -104,3 +104,14 @@ def _flash_attn(q, k, v, mask=None, key=None, dropout_p=0.0,
 
 
 register_vjp_grad("flash_attention")
+
+
+@register_op("kv_cache_mask", save_inputs=False)
+def _kv_cache_mask(index, q_len, kv_len):
+    """Additive decode mask over a static KV buffer: query i (at absolute
+    position index+i) may attend to buffer slot j iff j <= index + i.
+    Carries both the valid-slot bound and within-chunk causality."""
+    i = jnp.arange(q_len, dtype=jnp.int32)[:, None]
+    j = jnp.arange(kv_len, dtype=jnp.int32)[None, :]
+    valid = j <= (index.astype(jnp.int32).reshape(()) + i)
+    return jnp.where(valid, 0.0, -1e9).astype(jnp.float32)

@@ -165,6 +165,21 @@ def _scatter_grad_fill(g, idx, shape, dtype):
 register_vjp_grad("scatter_grad_fill")
 
 
+@register_op("dynamic_update_slice")
+def _dynamic_update_slice(x, update, index, axis=0):
+    """Write ``update`` into ``x`` starting at traced offset ``index`` along
+    ``axis`` (zeros elsewhere) — the static-shape KV-cache append used by the
+    decode path (reference: in-kernel CacheKV append,
+    fused_multi_transformer_op.cu; here a lax.dynamic_update_slice so the
+    buffer keeps one static shape across the whole generation loop)."""
+    starts = [jnp.zeros((), jnp.int32)] * x.ndim
+    starts[axis] = index.astype(jnp.int32).reshape(())
+    return jax.lax.dynamic_update_slice(x, update.astype(x.dtype), starts)
+
+
+register_vjp_grad("dynamic_update_slice")
+
+
 @register_op("slice")
 def _slice(x, axes, starts, ends):
     idx = [slice(None)] * x.ndim
