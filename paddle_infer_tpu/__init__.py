@@ -40,6 +40,7 @@ from . import metric
 from . import jit
 from . import static
 from . import inference
+from . import quantization
 from . import profiler
 from . import vision
 from . import device

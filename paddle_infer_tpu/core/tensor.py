@@ -34,6 +34,8 @@ class Tensor:
         "_hooks",
         "name",
         "persistable",
+        "dist_attr",   # optional mesh partition spec (set on params AND
+                       # non-trainable payloads, e.g. quantized weights)
         "__weakref__",
     )
 
@@ -51,6 +53,7 @@ class Tensor:
         self._hooks = None
         self.name = name
         self.persistable = False
+        self.dist_attr = None
 
     # ------------------------------------------------------------------ meta
     @property
@@ -230,8 +233,7 @@ def _thaw_index(idx):
 class Parameter(Tensor):
     """Trainable tensor: ``stop_gradient=False`` by default, persistable."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip",
-                 "dist_attr")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
 
     def __init__(self, data, name=None, trainable=True):
         super().__init__(data, stop_gradient=not trainable, name=name)

@@ -330,6 +330,18 @@ class GenerationEngine:
         b, plen_raw = ids.shape
         mask = (np.ones_like(ids) if attention_mask is None
                 else np.asarray(attention_mask).astype(np.int32))
+        # canonicalize to left padding: the compiled programs read the
+        # next-token logits from the final slot, so any row whose real
+        # tokens don't already end at the last column is shifted right
+        for i in range(b):
+            real = np.flatnonzero(mask[i])
+            if len(real) and real[-1] != plen_raw - 1:
+                n = len(real)
+                row = ids[i, real]
+                ids[i] = g.pad_token_id
+                mask[i] = 0
+                ids[i, plen_raw - n:] = row
+                mask[i, plen_raw - n:] = 1
         # bucket the prompt so executables are reused across nearby lengths,
         # clamped so prompt + max_new still fits the position table
         assert plen_raw + g.max_new_tokens <= self._max_positions, (

@@ -145,8 +145,14 @@ class GPTForCausalLM(Layer):
 
         if getattr(self, "_gen_engine", None) is None:
             self._gen_engine = GenerationEngine(self)
-        if generation_config is None and kwargs:
-            generation_config = GenerationConfig(**kwargs)
+        if generation_config is None:
+            generation_config = GenerationConfig(**kwargs) if kwargs \
+                else None
+        elif kwargs:
+            import dataclasses
+
+            generation_config = dataclasses.replace(generation_config,
+                                                    **kwargs)
         return self._gen_engine.generate(input_ids, generation_config,
                                          attention_mask=attention_mask)
 

@@ -1,0 +1,9 @@
+"""Quantization stack (reference: the fork's weight-only LLM ops —
+phi/kernels/gpu/weight_quantize_kernel.cu, weight_only_linear_kernel.cu —
+plus the slim QAT/PTQ toolchain,
+python/paddle/fluid/contrib/slim/quantization/)."""
+from .slim import PTQ, QAT, MovingAverageObserver, QuantedLayer
+from .weight_only import (WeightOnlyLinear, quantize_model)
+
+__all__ = ["WeightOnlyLinear", "quantize_model", "QAT", "PTQ",
+           "MovingAverageObserver", "QuantedLayer"]

@@ -1,0 +1,207 @@
+"""Quantization-aware training (QAT) and post-training quantization (PTQ).
+
+Reference: paddle's slim stack — imperative QAT
+(python/paddle/fluid/contrib/slim/quantization/imperative/qat.py
+``ImperativeQuantAware``: wraps Linear/Conv2D with fake-quant observers)
+and ``PostTrainingQuantization`` (post_training_quantization.py: feed
+calibration batches, collect activation ranges, emit scales).
+
+TPU-first: fake-quant is a registry op with a straight-through-estimator
+grad, so QAT training steps stay one fused XLA program; observers are
+plain running-absmax state updated outside jit (calibration is
+throughput-insensitive).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch as D, register_grad, register_op
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+# ------------------------------------------------------------ fake quant
+@register_op("fake_quantize_dequantize")
+def _fake_qdq(x, scale, bits=8):
+    """Simulated symmetric quantization: round(x/s)·s clipped to the int
+    range (reference: fake_quantize_dequantize_moving_average_abs_max)."""
+    bound = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale.astype(jnp.float32), 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / s), -bound, bound)
+    return (q * s).astype(x.dtype)
+
+
+@register_grad("fake_quantize_dequantize")
+def _fake_qdq_grad(ctx, g):
+    """Straight-through estimator: pass grads where x fell inside the
+    clip range, zero outside."""
+    x, scale = ctx.inputs
+    bits = ctx.attrs.get("bits", 8)
+    bound = float(2 ** (bits - 1) - 1)
+    lim = scale.detach() * bound
+    inside = D("less_equal", D("abs", x.detach()), lim)
+    return (D("multiply", g, D("cast", inside, dtype=g.dtype)), None)
+
+
+class MovingAverageObserver:
+    """Running absmax → scale (reference: moving_average_abs_max state).
+    ``momentum=None`` accumulates the true max over every batch seen — the
+    PTQ calibration mode (reference abs_max accumulation)."""
+
+    def __init__(self, bits=8, momentum=0.9):
+        self.bits = bits
+        self.momentum = momentum
+        self.absmax = None
+
+    def observe(self, arr):
+        arr = np.asarray(arr)
+        m = float(np.max(np.abs(arr))) if arr.size else 0.0
+        if self.absmax is None:
+            self.absmax = m
+        elif self.momentum is None:
+            self.absmax = max(self.absmax, m)
+        else:
+            self.absmax = self.momentum * self.absmax \
+                + (1 - self.momentum) * m
+
+    @property
+    def scale(self):
+        bound = 2 ** (self.bits - 1) - 1
+        return max(self.absmax or 0.0, 1e-8) / bound
+
+
+class QuantedLayer(Layer):
+    """Wrapper inserting weight + activation fake-quant around a
+    linear-like or conv layer (reference: QuantizedLinear/QuantizedConv2D
+    in slim's imperative quant_layers.py)."""
+
+    def __init__(self, inner, weight_bits=8, activation_bits=8,
+                 momentum=0.9):
+        super().__init__()
+        self.inner = inner
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self._act_observer = MovingAverageObserver(activation_bits, momentum)
+        self.register_buffer("act_scale", Tensor(
+            jnp.asarray(1e-8, jnp.float32)))
+        self._calibrating = True
+
+    def forward(self, x):
+        import jax
+
+        payload = getattr(x, "_data", x)
+        traced = isinstance(payload, jax.core.Tracer)
+        if (self.training or self._calibrating) and not traced:
+            # observers run eager-side only; under a jit trace (compiled
+            # train step) the last observed scale is baked in as a constant
+            self._act_observer.observe(np.asarray(payload))
+            self.act_scale.set_value(
+                np.asarray(self._act_observer.scale, np.float32))
+        xq = D("fake_quantize_dequantize", x, self.act_scale,
+               bits=self.activation_bits)
+        w = self.inner.weight
+        bound = float(2 ** (self.weight_bits - 1) - 1)
+        if w.ndim == 2:
+            # per-output-channel [1, out] (broadcasts over [in, out])
+            wscale = D("scale", D("max", D("abs", w), axis=0, keepdim=True),
+                       scale=1.0 / bound)
+        else:
+            # conv: per-tensor scalar scale
+            wscale = D("scale", D("max", D("abs", w)), scale=1.0 / bound)
+        wq = D("fake_quantize_dequantize", w, wscale,
+               bits=self.weight_bits)
+        # swap the registry entry (not the payload) so the inner forward
+        # consumes wq and STE grads flow through the tape to the Parameter
+        params = self.inner._parameters
+        orig = params["weight"]
+        params["weight"] = wq
+        try:
+            out = self.inner(xq)
+        finally:
+            params["weight"] = orig
+        return out
+
+
+def _swap(model, kinds, make, skip=None):
+    def visit(layer, prefix):
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            if isinstance(sub, kinds):
+                if skip is not None and skip(full, sub):
+                    continue
+                setattr(layer, name, make(sub))
+            else:
+                visit(sub, full)
+
+    visit(model, "")
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (reference ImperativeQuantAware:
+    ``quantize`` wraps layers in-place; train as usual; ``convert``/
+    ``save_quantized_model`` emits the deploy model)."""
+
+    def __init__(self, weight_bits=8, activation_bits=8, momentum=0.9,
+                 skip=None):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.momentum = momentum
+        self.skip = skip
+
+    def quantize(self, model):
+        from ..nn.layers_common import Conv2D, Linear
+
+        return _swap(
+            model, (Linear, Conv2D),
+            lambda sub: QuantedLayer(sub, self.weight_bits,
+                                     self.activation_bits, self.momentum),
+            self.skip)
+
+    def convert(self, model):
+        """Freeze for deployment: weight-only-quantize the wrapped linears
+        (activations stay float on TPU — bf16 matmul with int8 weights is
+        the serving sweet spot; scales are exported on the layer)."""
+        from .weight_only import WeightOnlyLinear
+        from ..nn.layers_common import Linear
+
+        def make(q):
+            inner = q.inner
+            if isinstance(inner, Linear):
+                lay = WeightOnlyLinear.from_linear(inner)
+                lay.act_scale_value = float(np.asarray(q.act_scale.numpy()))
+                return lay
+            return inner  # convs deploy as float (XLA fuses bf16 convs)
+
+        return _swap(model, (QuantedLayer,), make)
+
+
+class PTQ:
+    """Post-training quantization: run calibration batches through the
+    observer-wrapped model, then convert (reference
+    PostTrainingQuantization.quantize: sample_generator loop → scales →
+    save)."""
+
+    def __init__(self, weight_bits=8, activation_bits=8, skip=None):
+        # momentum=None → true-max accumulation over all calibration batches
+        self._qat = QAT(weight_bits, activation_bits, momentum=None,
+                        skip=skip)
+
+    def quantize(self, model, calibration_loader, max_batches=16):
+        model = self._qat.quantize(model)
+        model.eval()
+        for lay in model.sublayers():
+            if isinstance(lay, QuantedLayer):
+                lay._calibrating = True
+        n = 0
+        for batch in calibration_loader:
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            model(x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)))
+            n += 1
+            if n >= max_batches:
+                break
+        for lay in model.sublayers():
+            if isinstance(lay, QuantedLayer):
+                lay._calibrating = False
+        return self._qat.convert(model)

@@ -1,0 +1,199 @@
+"""Weight-only quantization for LLM serving.
+
+Reference: the fork's weight-only-quant GEMM path —
+``weight_quantize`` / ``weight_dequantize`` / ``weight_only_linear`` ops
+(paddle/phi/kernels/gpu/weight_quantize_kernel.cu,
+weight_only_linear_kernel.cu; yaml phi/api/yaml/ops.yaml:265-300) and the
+CUTLASS/gemv kernels (phi/kernels/funcs/weight_only_gemv.cu).
+
+TPU-first: weights are stored int8 (or int4 packed two-per-byte) with
+per-output-channel or grouped scales; the matmul dequantizes inline —
+XLA fuses the int8→bf16 convert+scale into the MXU feed, so HBM traffic
+for weights halves (quarters for int4), which is what bounds bs=1 decode.
+No hand-scheduled GEMV needed: the fused convert is the Pallas-free fast
+path, and the layout ([in, out], scales broadcast over in) matches the
+framework's Linear/TP-linear weights so one swap covers all of them.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch as D, register_grad, register_op
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+ALGOS = ("weight_only_int8", "weight_only_int4")
+
+
+def _bits(algo: str) -> int:
+    if algo not in ALGOS:
+        raise ValueError(f"algo must be one of {ALGOS}, got {algo!r}")
+    return 8 if algo.endswith("int8") else 4
+
+
+# ------------------------------------------------------------------- ops
+@register_op("weight_quantize", save_inputs=False)
+def _weight_quantize(w, algo="weight_only_int8", group_size=-1):
+    """[in, out] float → (int8 payload, float32 scales).
+
+    int8: symmetric absmax per scale-group, range ±127.
+    int4: range ±7, two nibbles packed per int8 byte along the in dim
+    (even rows in the low nibble).  group_size=-1 → one scale per output
+    channel; otherwise one scale per (group of in rows × output channel).
+    """
+    bits = _bits(algo)
+    n_in, n_out = w.shape
+    gs = n_in if group_size in (-1, None) else int(group_size)
+    assert n_in % gs == 0, f"in dim {n_in} not divisible by group {gs}"
+    wg = w.reshape(n_in // gs, gs, n_out).astype(jnp.float32)
+    bound = 127.0 if bits == 8 else 7.0
+    absmax = jnp.max(jnp.abs(wg), axis=1, keepdims=True)
+    scale = jnp.maximum(absmax / bound, 1e-8)
+    q = jnp.clip(jnp.round(wg / scale), -bound, bound).astype(jnp.int8)
+    q = q.reshape(n_in, n_out)
+    scale = scale[:, 0, :]                        # [n_groups, out]
+    if bits == 4:
+        assert n_in % 2 == 0, "int4 needs even in dim"
+        lo = q[0::2].astype(jnp.uint8) & 0xF
+        hi = (q[1::2].astype(jnp.uint8) & 0xF) << 4
+        q = (lo | hi).astype(jnp.int8)            # [in//2, out]
+    return q, scale
+
+
+@register_op("weight_dequantize", save_inputs=False)
+def _weight_dequantize(qw, scale, algo="weight_only_int8", group_size=-1,
+                       out_dtype="float32"):
+    """Invert weight_quantize → [in, out] float."""
+    bits = _bits(algo)
+    if bits == 4:
+        u = qw.astype(jnp.uint8)
+        lo = (u & 0xF).astype(jnp.int8)
+        hi = ((u >> 4) & 0xF).astype(jnp.int8)
+        # sign-extend 4-bit two's complement
+        lo = jnp.where(lo > 7, lo - 16, lo)
+        hi = jnp.where(hi > 7, hi - 16, hi)
+        q = jnp.stack([lo, hi], axis=1).reshape(qw.shape[0] * 2, qw.shape[1])
+    else:
+        q = qw
+    n_in, n_out = q.shape
+    n_groups = scale.shape[0]
+    gs = n_in // n_groups
+    dq = q.reshape(n_groups, gs, n_out).astype(jnp.float32) \
+        * scale[:, None, :]
+    return dq.reshape(n_in, n_out).astype(jnp.dtype(out_dtype))
+
+
+@register_op("weight_only_linear")
+def _weight_only_linear(x, qw, scale, bias=None, algo="weight_only_int8",
+                        group_size=-1):
+    """y = x @ dequant(qw) + b.  The dequant is expressed inline so XLA
+    fuses convert+scale into the matmul operand read (the TPU analog of
+    the reference's fused dequant-GEMM, weight_only_linear_kernel.cu)."""
+    w = _weight_dequantize(qw, scale, algo=algo, group_size=group_size,
+                           out_dtype=x.dtype)
+    y = jnp.matmul(x, w)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+@register_grad("weight_only_linear")
+def _weight_only_linear_grad(ctx, g):
+    """Inference-oriented: grads flow to the activation (and bias) only —
+    the quantized payload is frozen."""
+    x, qw, scale = ctx.inputs[0], ctx.inputs[1], ctx.inputs[2]
+    bias = ctx.inputs[3] if len(ctx.inputs) > 3 else None
+    algo = ctx.attrs.get("algo", "weight_only_int8")
+    gs = ctx.attrs.get("group_size", -1)
+    w = D("weight_dequantize", qw, scale, algo=algo, group_size=gs,
+          out_dtype="float32")
+    dx = D("matmul", g, w, transpose_y=True)
+    db = None
+    if bias is not None:
+        axes = tuple(range(g.ndim - 1))
+        db = D("sum", g, axis=axes) if axes else g
+    return (dx, None, None, db)[:len(ctx.inputs)]
+
+
+# ---------------------------------------------------------------- layers
+class WeightOnlyLinear(Layer):
+    """Drop-in for Linear/ColumnParallelLinear/RowParallelLinear with an
+    int8/int4 weight payload (reference: paddle.nn.quant weight_only_linear
+    layer over the fork's op)."""
+
+    def __init__(self, in_features, out_features, algo="weight_only_int8",
+                 group_size=-1, has_bias=True):
+        super().__init__()
+        bits = _bits(algo)
+        self.in_features = in_features
+        self.out_features = out_features
+        self.algo = algo
+        self.group_size = group_size
+        rows = in_features if bits == 8 else in_features // 2
+        n_groups = 1 if group_size in (-1, None) \
+            else in_features // group_size
+        self.register_buffer("qweight", Tensor(
+            jnp.zeros((rows, out_features), jnp.int8)))
+        self.register_buffer("scale", Tensor(
+            jnp.ones((n_groups, out_features), jnp.float32)))
+        if has_bias:
+            self.register_buffer("bias", Tensor(
+                jnp.zeros((out_features,), jnp.float32)))
+        else:
+            self.bias = None
+        self._out_spec = None      # inherited TP sharding of the output
+
+    @classmethod
+    def from_linear(cls, linear, algo="weight_only_int8", group_size=-1):
+        """Quantize an existing linear-like layer (weight [in, out])."""
+        w = linear.weight
+        lay = cls(w.shape[0], w.shape[1], algo=algo, group_size=group_size,
+                  has_bias=linear.bias is not None)
+        qw, scale = D("weight_quantize", w.detach(), algo=algo,
+                      group_size=group_size)
+        lay.qweight.set_value(qw.numpy())
+        lay.scale.set_value(scale.numpy())
+        if linear.bias is not None:
+            lay.bias.set_value(linear.bias.numpy())
+        # preserve a ColumnParallelLinear(gather_output=False) output
+        # constraint; weight payloads stay replicated for now (sharded
+        # int8 buffers need buffer-aware placement in fleet — TODO)
+        if getattr(linear, "gather_output", None) is False:
+            lay._out_spec = "mp"
+        return lay
+
+    def forward(self, x):
+        y = D("weight_only_linear", x, self.qweight, self.scale, self.bias,
+              algo=self.algo, group_size=self.group_size)
+        if self._out_spec is not None:
+            spec = ("data",) + (None,) * (y.ndim - 2) + (self._out_spec,)
+            y = D("sharding_constraint", y, spec=spec)
+        return y
+
+    def extra_repr(self):
+        return (f"in={self.in_features}, out={self.out_features}, "
+                f"algo={self.algo}, group={self.group_size}")
+
+
+def quantize_model(model, algo="weight_only_int8", group_size=-1,
+                   skip=None):
+    """In-place weight-only quantization pass: swap every linear-like
+    sublayer (weight [in, out]) for WeightOnlyLinear (reference: the
+    predictor's enable_weight_only_quant applying weight_only_linear2
+    rewrites).  ``skip(full_name, layer) -> bool`` exempts layers (e.g.
+    lm_head / embeddings).  Returns the model."""
+    from ..nn.layers_common import Linear
+    from ..parallel.mp_layers import (ColumnParallelLinear,
+                                      RowParallelLinear)
+    from .slim import _swap
+
+    def make(sub):
+        gs = group_size
+        if gs not in (-1, None) and sub.weight.shape[0] % gs != 0:
+            gs = -1      # fall back to per-channel
+        return WeightOnlyLinear.from_linear(sub, algo=algo, group_size=gs)
+
+    return _swap(model, (Linear, ColumnParallelLinear, RowParallelLinear),
+                 make, skip)

@@ -71,6 +71,19 @@ class TestGreedyDecode:
         assert list(got[0]) == w1
         assert list(got[1]) == w2
 
+    def test_right_padded_batch_canonicalized(self):
+        """Right-padded prompts (tokenizer default) must decode identically
+        to left-padded ones — the engine canonicalizes layout."""
+        model = _make(seed=1)
+        p1 = np.array([5, 9, 33], np.int32)
+        w1 = _eager_greedy(model, p1, 4)
+        eng = GenerationEngine(model, cache_bucket=16, prompt_bucket=8)
+        ids = np.pad(p1, (0, 3))[None, :]          # right padding
+        mask = np.pad(np.ones_like(p1), (0, 3))[None, :]
+        got = eng.generate(ids, GenerationConfig(max_new_tokens=4),
+                           attention_mask=mask)
+        assert list(got[0]) == w1
+
     def test_eos_early_stop_pads(self):
         model = _make(seed=2)
         ids = np.array([[3, 1, 4]], np.int32)

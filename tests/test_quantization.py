@@ -1,0 +1,199 @@
+"""Quantization tests: weight-only int8/int4 round trip + fused linear
+(reference weight_quantize/weight_only_linear ops), model-level quant pass,
+QAT fake-quant STE training, PTQ calibration."""
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.core.dispatch import dispatch as D
+from paddle_infer_tpu.core.tensor import Tensor
+from paddle_infer_tpu.quantization import (PTQ, QAT, QuantedLayer,
+                                           WeightOnlyLinear, quantize_model)
+
+
+class TestWeightOnlyOps:
+    @pytest.mark.parametrize("algo,tol", [("weight_only_int8", 0.01),
+                                          ("weight_only_int4", 0.12)])
+    def test_quant_dequant_roundtrip(self, algo, tol):
+        rng = np.random.RandomState(0)
+        w = rng.randn(64, 32).astype(np.float32)
+        qw, scale = D("weight_quantize", Tensor(w), algo=algo)
+        back = D("weight_dequantize", qw, scale, algo=algo).numpy()
+        assert back.shape == w.shape
+        # error bounded by half a quant step per channel
+        err = np.abs(back - w).max()
+        assert err < tol * np.abs(w).max(), err
+
+    @pytest.mark.parametrize("algo", ["weight_only_int8", "weight_only_int4"])
+    def test_grouped_scales(self, algo):
+        rng = np.random.RandomState(1)
+        w = rng.randn(64, 16).astype(np.float32)
+        # one row block has much larger magnitude: grouped quant must keep
+        # the small block precise
+        w[:16] *= 50.0
+        qw, scale = D("weight_quantize", Tensor(w), algo=algo, group_size=16)
+        assert tuple(scale.shape) == (4, 16)
+        back = D("weight_dequantize", qw, scale, algo=algo,
+                 group_size=16).numpy()
+        small_err = np.abs(back[16:] - w[16:]).max()
+        qw2, scale2 = D("weight_quantize", Tensor(w), algo=algo)
+        back2 = D("weight_dequantize", qw2, scale2, algo=algo).numpy()
+        assert small_err < np.abs(back2[16:] - w[16:]).max() + 1e-6
+
+    def test_weight_only_linear_matches_float(self):
+        rng = np.random.RandomState(2)
+        w = rng.randn(32, 24).astype(np.float32)
+        x = rng.randn(4, 32).astype(np.float32)
+        b = rng.randn(24).astype(np.float32)
+        qw, scale = D("weight_quantize", Tensor(w), algo="weight_only_int8")
+        y = D("weight_only_linear", Tensor(x), qw, scale, Tensor(b),
+              algo="weight_only_int8").numpy()
+        ref = x @ w + b
+        np.testing.assert_allclose(y, ref, rtol=0.05, atol=0.05)
+
+    def test_weight_only_linear_grad_to_x(self):
+        rng = np.random.RandomState(3)
+        w = rng.randn(16, 8).astype(np.float32)
+        x = Tensor(rng.randn(2, 16).astype(np.float32),
+                   stop_gradient=False)
+        qw, scale = D("weight_quantize", Tensor(w), algo="weight_only_int8")
+        y = D("weight_only_linear", x, qw, scale, None,
+              algo="weight_only_int8")
+        y.backward(Tensor(np.ones((2, 8), np.float32)))
+        wdq = D("weight_dequantize", qw, scale,
+                algo="weight_only_int8").numpy()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.ones((2, 8)) @ wdq.T, rtol=1e-5)
+
+
+class TestQuantizeModel:
+    def test_layer_swap_and_accuracy(self):
+        pit.seed(0)
+        from paddle_infer_tpu.nn.layers_common import Linear
+
+        class MLP(pit.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = Linear(32, 64)
+                self.fc2 = Linear(64, 8)
+
+            def forward(self, x):
+                return self.fc2(pit.nn.functional.relu(self.fc1(x)))
+
+        m = MLP()
+        m.eval()
+        x = Tensor(np.random.RandomState(4).randn(8, 32).astype(np.float32))
+        ref = m(x).numpy()
+        quantize_model(m, algo="weight_only_int8")
+        assert isinstance(m.fc1, WeightOnlyLinear)
+        assert isinstance(m.fc2, WeightOnlyLinear)
+        got = m(x).numpy()
+        np.testing.assert_allclose(got, ref, rtol=0.1, atol=0.1)
+
+    def test_skip_predicate(self):
+        from paddle_infer_tpu.nn.layers_common import Linear
+
+        class M(pit.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.head = Linear(8, 4)
+                self.body = Linear(8, 8)
+
+            def forward(self, x):
+                return self.head(self.body(x))
+
+        m = M()
+        quantize_model(m, skip=lambda name, l: "head" in name)
+        assert isinstance(m.head, Linear)
+        assert isinstance(m.body, WeightOnlyLinear)
+
+    def test_quantized_gpt_generates_close(self):
+        """End-to-end: weight-only-quantized GPT decodes like the float
+        model (greedy tokens usually identical on an untrained net)."""
+        from paddle_infer_tpu.inference import (GenerationConfig,
+                                                GenerationEngine)
+        from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+
+        pit.seed(5)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                        num_attention_heads=4, intermediate_size=64,
+                        max_position_embeddings=32, hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        ids = np.array([[1, 2, 3]], np.int32)
+        x = Tensor(ids)
+        ref_logits = model(x).numpy()
+        quantize_model(model, algo="weight_only_int8",
+                       skip=lambda n, l: "embed" in n)
+        got_logits = model(x).numpy()
+        # logits stay close in max-abs terms
+        scale = np.abs(ref_logits).max()
+        assert np.abs(got_logits - ref_logits).max() < 0.15 * scale
+        eng = GenerationEngine(model, cache_bucket=16, prompt_bucket=8)
+        out = eng.generate(ids, GenerationConfig(max_new_tokens=4))
+        assert out.shape == (1, 4)
+
+
+class TestQATPTQ:
+    def _data(self, n=64):
+        rng = np.random.RandomState(6)
+        x = rng.randn(n, 16).astype(np.float32)
+        w_true = rng.randn(16, 4).astype(np.float32)
+        y = np.argmax(x @ w_true, axis=1).astype(np.int64)
+        return x, y
+
+    def test_qat_trains(self):
+        pit.seed(7)
+        from paddle_infer_tpu.nn.layers_common import Linear
+
+        class M(pit.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = Linear(16, 4)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        m = QAT().quantize(M())
+        assert isinstance(m.fc, QuantedLayer)
+        opt = pit.optimizer.AdamW(learning_rate=5e-2,
+                                  parameters=m.parameters())
+        x, y = self._data()
+        losses = []
+        for _ in range(30):
+            logits = m(Tensor(x))
+            loss = pit.nn.functional.cross_entropy(logits, Tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5, losses[::10]
+        # convert → deployable weight-only model
+        m2 = QAT().convert(m)
+        assert isinstance(m2.fc, WeightOnlyLinear)
+        out = m2(Tensor(x[:4]))
+        assert tuple(out.shape) == (4, 4)
+
+    def test_ptq_calibrates(self):
+        pit.seed(8)
+        from paddle_infer_tpu.nn.layers_common import Linear
+
+        class M(pit.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = Linear(16, 32)
+                self.fc2 = Linear(32, 4)
+
+            def forward(self, x):
+                return self.fc2(pit.nn.functional.relu(self.fc1(x)))
+
+        m = M()
+        m.eval()
+        x, _ = self._data(32)
+        ref = m(Tensor(x)).numpy()
+        loader = [(x[i:i + 8],) for i in range(0, 32, 8)]
+        m = PTQ().quantize(m, loader)
+        assert isinstance(m.fc1, WeightOnlyLinear)
+        got = m(Tensor(x)).numpy()
+        assert np.abs(got - ref).max() < 0.2 * np.abs(ref).max()
