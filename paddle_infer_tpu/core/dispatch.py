@@ -272,7 +272,7 @@ def raw(name: str, *arrays, **attrs):
 _VJP_CACHE: Dict[tuple, Callable] = {}
 
 
-def register_vjp_grad(name: str):
+def register_vjp_grad(name: str, cache: bool = True):
     """Register an automatic backward rule derived with jax.vjp on the impl.
 
     The analog of the reference's generated GradNodes for ops whose backward
@@ -280,6 +280,10 @@ def register_vjp_grad(name: str):
     vjp recomputes the forward (rematerialisation), trading FLOPs for memory
     exactly like ``jax.checkpoint``.  Note: rules registered this way don't
     support create_graph (higher-order); hand-written rules do.
+
+    ``cache=False`` skips the per-attrs jit cache — required for ops whose
+    impl reads ambient state (the current mesh) that must not be frozen
+    into a cached executable.
     """
     op = _REGISTRY[name]
 
@@ -287,7 +291,7 @@ def register_vjp_grad(name: str):
         arrays = tuple(t._data if t is not None else None for t in ctx.inputs)
         frozen = _freeze_attrs(ctx.attrs)
         key = (name, frozen)
-        bwd = _VJP_CACHE.get(key)
+        bwd = _VJP_CACHE.get(key) if cache else None
         if bwd is None:
             impl = functools.partial(op.impl, **dict(frozen)) if frozen else op.impl
 
@@ -316,8 +320,11 @@ def register_vjp_grad(name: str):
                     full_grads[i] = g
                 return full_grads
 
-            bwd = jax.jit(bwd_fn)
-            _VJP_CACHE[key] = bwd
+            if cache:
+                bwd = jax.jit(bwd_fn)
+                _VJP_CACHE[key] = bwd
+            else:
+                bwd = bwd_fn
         gout_arrays = tuple(g._data for g in gouts)
         gins = bwd(arrays, gout_arrays)
         out = []

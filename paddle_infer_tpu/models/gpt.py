@@ -80,6 +80,7 @@ class GPTModel(Layer):
         self.position_embeddings = Embedding(
             config.max_position_embeddings, config.hidden_size)
         self.dropout = Dropout(config.hidden_dropout_prob)
+        num_experts = getattr(config, "num_experts", 1)
         self.layers = LayerList([
             ParallelTransformerLayer(
                 config.hidden_size, config.num_attention_heads,
@@ -87,10 +88,41 @@ class GPTModel(Layer):
                 dropout=config.hidden_dropout_prob,
                 attn_dropout=config.attention_probs_dropout_prob,
                 activation=config.hidden_act, normalize_before=True,
-                causal=True, layer_norm_eps=config.layer_norm_eps)
+                causal=True, layer_norm_eps=config.layer_norm_eps,
+                num_experts=num_experts,
+                moe_gate=getattr(config, "moe_gate", "gshard"),
+                moe_top_k=getattr(config, "moe_top_k", 2),
+                moe_capacity_factor=getattr(config, "moe_capacity_factor",
+                                            2.0))
             for _ in range(config.num_hidden_layers)])
         self.final_norm = LayerNorm(config.hidden_size,
                                     epsilon=config.layer_norm_eps)
+
+    def moe_aux_loss(self):
+        """Sum of the per-layer MoE load-balance losses from the last
+        forward (0 for dense models).  Valid in the same step that produced
+        it — read it while building the loss; aux values left over from an
+        earlier compiled program (e.g. a generate() call) are stale tracers
+        and are skipped."""
+        import jax
+
+        from ..parallel.moe import MoELayer
+
+        total = None
+        for layer in self.layers:
+            mlp = layer.mlp
+            if isinstance(mlp, MoELayer) and mlp.l_aux is not None:
+                try:
+                    val = mlp.l_aux + 0.0   # touch: raises if stale
+                except jax.errors.UnexpectedTracerError:
+                    continue
+                total = val if total is None else total + val
+        if total is None:
+            from ..core.tensor import Tensor
+            import jax.numpy as jnp
+
+            total = Tensor(jnp.zeros((), jnp.float32))
+        return total
 
     def forward(self, input_ids, position_ids=None, attention_mask=None,
                 caches=None):

@@ -129,14 +129,26 @@ class ParallelTransformerLayer(Layer):
     def __init__(self, hidden, num_heads, ffn_hidden, dropout=0.1,
                  attn_dropout=None, activation="gelu",
                  normalize_before=False, causal=False,
-                 layer_norm_eps=1e-12, seq_parallel=None):
+                 layer_norm_eps=1e-12, seq_parallel=None,
+                 num_experts=1, moe_gate="gshard", moe_top_k=2,
+                 moe_capacity_factor=2.0):
         super().__init__()
         self.normalize_before = normalize_before
         self.self_attn = ParallelSelfAttention(
             hidden, num_heads,
             dropout=attn_dropout if attn_dropout is not None else dropout,
             causal=causal, seq_parallel=seq_parallel)
-        self.mlp = ParallelMLP(hidden, ffn_hidden, activation, dropout)
+        if num_experts > 1:
+            # MoE FFN (reference fused_multi_transformer_moe_op: per-layer
+            # expert FFNs behind a gate; here parallel/moe.py fused path)
+            from ..parallel.moe import MoELayer
+
+            self.mlp = MoELayer(hidden, ffn_hidden, num_experts,
+                                gate=moe_gate, top_k=moe_top_k,
+                                capacity_factor=moe_capacity_factor,
+                                activation=activation)
+        else:
+            self.mlp = ParallelMLP(hidden, ffn_hidden, activation, dropout)
         self.norm1 = LayerNorm(hidden, epsilon=layer_norm_eps)
         self.norm2 = LayerNorm(hidden, epsilon=layer_norm_eps)
         self.dropout1 = Dropout(dropout)

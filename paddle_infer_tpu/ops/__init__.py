@@ -56,8 +56,8 @@ for _name in [
 # reductions / shape
 for _name in [
     "sum", "mean", "max", "min", "prod", "all", "any", "argmax", "argmin",
-    "logsumexp", "std", "var", "reshape", "squeeze", "unsqueeze", "flatten",
-    "tile", "expand", "split", "gather", "topk", "sort", "argsort", "flip",
+    "logsumexp", "std", "var", "squeeze", "unsqueeze", "flatten",
+    "split", "gather", "topk", "sort", "argsort", "flip",
     "roll", "clip", "norm", "take_along_axis", "put_along_axis", "tril",
     "triu", "where", "scale",
 ]:
@@ -70,6 +70,37 @@ def _transpose_method(self, perm=None):
     return D("transpose", self, perm=tuple(perm))
 
 
+def _attr_first_method(op_name, attr):
+    """Ops whose first positional is a static attribute, not a tensor
+    (paddle surface: t.reshape([2, 3]), t.expand([4, -1]), ...)."""
+
+    def fn(self, arg=None, *args, **kwargs):
+        # NB: builtins.all — module-level `all` is the reduction op export
+        import builtins
+
+        if args and isinstance(arg, int) \
+                and builtins.all(isinstance(a, int) for a in args):
+            arg, args = (arg,) + tuple(args), ()   # varargs form t.reshape(2, 3)
+        if arg is not None:
+            if isinstance(arg, (list, tuple)):
+                arg = tuple(int(s) for s in arg)
+            elif isinstance(arg, int):
+                arg = (arg,)
+            else:       # Tensor / ndarray shape
+                import numpy as _np
+
+                arg = tuple(int(s)
+                            for s in _np.asarray(arg).reshape(-1))
+            kwargs[attr] = arg
+        return D(op_name, self, *args, **kwargs)
+
+    fn.__name__ = op_name
+    return fn
+
+
+Tensor.reshape = _attr_first_method("reshape", "shape")
+Tensor.expand = _attr_first_method("expand", "shape")
+Tensor.tile = _attr_first_method("tile", "repeat_times")
 Tensor.transpose = _transpose_method
 Tensor.t = lambda self: D("transpose_last2", self)
 Tensor.mm = _method("matmul")

@@ -18,6 +18,7 @@ from . import fleet
 from .fleet import DistributedStrategy, FleetTrainStep
 from .sharding import group_sharded_parallel
 from .sequence_parallel import ring_attention, ulysses_attention
+from .moe import MoELayer, gshard_gate, naive_gate, switch_gate
 from .pipeline import LayerDesc, PipelineStack
 from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed
 
@@ -32,4 +33,5 @@ __all__ = [
     "group_sharded_parallel", "get_rng_state_tracker", "RNGStatesTracker",
     "model_parallel_random_seed", "ring_attention", "ulysses_attention",
     "LayerDesc", "PipelineStack",
+    "MoELayer", "switch_gate", "gshard_gate", "naive_gate",
 ]

@@ -53,7 +53,7 @@ class DistributedStrategy:
     def __init__(self, **kw):
         self.hybrid_configs: Dict[str, int] = {
             "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
-            "sharding_degree": 1, "sep_degree": 1}
+            "sharding_degree": 1, "sep_degree": 1, "ep_degree": 1}
         self.sharding = False
         self.sharding_configs: Dict[str, Any] = {"stage": 1}
         self.amp = False
@@ -92,7 +92,7 @@ def init(role_maker=None, is_collective: bool = True,
     n_dev = len(devices) if devices is not None else len(jax.devices())
     degrees = {k: int(hc.get(k, 1)) for k in
                ("dp_degree", "mp_degree", "pp_degree", "sharding_degree",
-                "sep_degree")}
+                "sep_degree", "ep_degree")}
     others = int(np.prod([v for k, v in degrees.items()
                           if k != "dp_degree"]))
     if degrees["dp_degree"] <= 0:   # -1 → infer dp from device count
@@ -105,7 +105,8 @@ def init(role_maker=None, is_collective: bool = True,
         dp_degree=degrees["dp_degree"], mp_degree=degrees["mp_degree"],
         pp_degree=degrees["pp_degree"],
         sharding_degree=degrees["sharding_degree"],
-        sep_degree=degrees["sep_degree"], devices=devices)
+        sep_degree=degrees["sep_degree"],
+        ep_degree=degrees["ep_degree"], devices=devices)
     _state.strategy = strategy
     _state.hcg = hcg
     _state.initialized = True

@@ -1,0 +1,273 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference surface covered:
+  - ``MoELayer`` (python/paddle/incubate/distributed/models/moe/moe_layer.py:244)
+    dispatching tokens to experts over an expert-parallel process group with
+    ``global_scatter``/``global_gather`` all-to-all ops (moe_layer.py:106,151;
+    paddle/fluid/operators/collective/global_scatter_op.cu.cc).
+  - Gates: naive top-k, Switch (top-1), GShard (top-2) —
+    moe/gate/{naive,switch,gshard}_gate.py.
+  - The fork's fused single-kernel MoE
+    (phi/kernels/gpu/fused_moe_kernel.cu, ops.yaml:230).
+
+TPU-first design: no explicit scatter/gather RPCs.  Experts live stacked in
+one [E, ...] parameter sharded over the mesh "ep" axis; token→expert routing
+is the GShard einsum formulation (dispatch/combine tensors against a
+capacity-bounded buffer), and a ``sharding_constraint`` pins the expert dim
+to "ep" — GSPMD then emits the all-to-all over ICI.  The whole layer traces
+into the surrounding jit, which *is* the fused-MoE kernel on TPU: gating,
+dispatch, expert FFN (one big [E,C,d]×[E,d,f] batched matmul on the MXU) and
+combine fuse into the step program.  ``global_scatter``/``global_gather``
+are still provided (shard_map + lax.all_to_all) for API parity.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch as D, register_grad, register_op
+from ..core.tensor import Parameter, Tensor
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from . import topology
+
+
+# ------------------------------------------------------------------ gates
+def _capacity(n_tokens, num_experts, capacity_factor, top_k):
+    c = int(math.ceil(top_k * n_tokens * capacity_factor / num_experts))
+    return max(4, c)
+
+
+def _one_hot(x, n):
+    return jax.nn.one_hot(x, n, dtype=jnp.float32)
+
+
+def switch_gate(logits, capacity):
+    """Switch Transformer top-1 gate with capacity + load-balancing loss
+    (reference moe/gate/switch_gate.py).  logits [N, E] →
+    (combine [N, E, C], dispatch bool [N, E, C], aux scalar)."""
+    n, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)                       # [N]
+    gate = jnp.take_along_axis(probs, idx[:, None], axis=1)[:, 0]
+    mask = _one_hot(idx, e)                                # [N, E]
+    # position of each token within its expert's buffer
+    pos = jnp.cumsum(mask, axis=0) * mask - mask           # [N, E] 0-based
+    pos_tok = jnp.sum(pos, axis=1).astype(jnp.int32)       # [N]
+    keep = pos_tok < capacity
+    # aux: E * Σ_e fraction_tokens_e · mean_prob_e (Switch eq. 4)
+    frac = jnp.mean(mask, axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac * mean_prob)
+    dispatch = (mask * keep[:, None].astype(mask.dtype))[:, :, None] \
+        * _one_hot(pos_tok, capacity)[:, None, :]          # [N, E, C]
+    combine = gate[:, None, None] * dispatch
+    return combine, dispatch > 0, aux
+
+
+def gshard_gate(logits, capacity):
+    """GShard top-2 gate (reference moe/gate/gshard_gate.py): second expert
+    weighted by its renormalized prob, same capacity bookkeeping, aux on
+    the top-1 assignment."""
+    n, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx1 = jnp.argmax(probs, axis=-1)
+    mask1 = _one_hot(idx1, e)
+    probs2 = probs * (1.0 - mask1)
+    idx2 = jnp.argmax(probs2, axis=-1)
+    mask2 = _one_hot(idx2, e)
+    g1 = jnp.take_along_axis(probs, idx1[:, None], axis=1)[:, 0]
+    g2 = jnp.take_along_axis(probs, idx2[:, None], axis=1)[:, 0]
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    g1, g2 = g1 / denom, g2 / denom
+    # capacity: expert-1 tokens first, expert-2 fills what remains
+    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1
+    used1 = jnp.sum(mask1, axis=0, keepdims=True)          # [1, E]
+    pos2 = (jnp.cumsum(mask2, axis=0) * mask2 - mask2) + used1 * mask2
+    p1 = jnp.sum(pos1, axis=1).astype(jnp.int32)
+    p2 = jnp.sum(pos2, axis=1).astype(jnp.int32)
+    keep1 = p1 < capacity
+    keep2 = p2 < capacity
+    frac = jnp.mean(mask1, axis=0)
+    aux = e * jnp.sum(frac * jnp.mean(probs, axis=0))
+    d1 = (mask1 * keep1[:, None])[:, :, None] \
+        * _one_hot(p1, capacity)[:, None, :]
+    d2 = (mask2 * keep2[:, None])[:, :, None] \
+        * _one_hot(p2, capacity)[:, None, :]
+    combine = g1[:, None, None] * d1 + g2[:, None, None] * d2
+    dispatch = (d1 + d2) > 0
+    return combine, dispatch, aux
+
+
+def naive_gate(logits, capacity, top_k=2):
+    """Plain top-k softmax gate, no dropping beyond capacity bound
+    (reference moe/gate/naive_gate.py)."""
+    n, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    vals, idxs = jax.lax.top_k(probs, top_k)               # [N, k]
+    vals = vals / jnp.maximum(jnp.sum(vals, axis=-1, keepdims=True), 1e-9)
+    combine = jnp.zeros((n, e, capacity), jnp.float32)
+    dispatch = jnp.zeros((n, e, capacity), jnp.bool_)
+    occupancy = jnp.zeros((e,), jnp.int32)
+    for j in range(top_k):
+        mask = _one_hot(idxs[:, j], e)
+        pos = jnp.cumsum(mask, axis=0) * mask - mask + occupancy[None, :]
+        p = jnp.sum(pos * mask, axis=1).astype(jnp.int32)
+        keep = p < capacity
+        dj = (mask * keep[:, None])[:, :, None] \
+            * _one_hot(p, capacity)[:, None, :]
+        combine = combine + vals[:, j][:, None, None] * dj
+        dispatch = jnp.logical_or(dispatch, dj > 0)
+        occupancy = occupancy + jnp.sum(mask, axis=0).astype(jnp.int32)
+    return combine, dispatch, jnp.asarray(0.0, jnp.float32)
+
+
+_GATES = {"switch": switch_gate, "gshard": gshard_gate, "naive": naive_gate}
+
+
+# ------------------------------------------------------------- fused op
+@register_op("fused_moe", jit=False)  # reads mesh state: no frozen cache
+def _fused_moe(x, gate_w, w1, b1, w2, b2, gate="gshard", top_k=2,
+               capacity_factor=2.0, activation="gelu"):
+    """One-shot MoE (reference fused_moe_kernel, ops.yaml:230): gating +
+    capacity dispatch + expert FFN + combine as a single XLA computation.
+
+    x [b, s, d]; gate_w [d, E]; w1 [E, d, f]; b1 [E, f]; w2 [E, f, d];
+    b2 [E, d].  Returns (out [b, s, d], aux_loss scalar).
+    """
+    b, s, d = x.shape
+    e = gate_w.shape[1]
+    n = b * s
+    xt = x.reshape(n, d)
+    logits = xt.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    cap = _capacity(n, e, capacity_factor, top_k)
+    if gate == "naive":
+        combine, dispatch, aux = naive_gate(logits, cap, top_k=top_k)
+    else:
+        combine, dispatch, aux = _GATES[gate](logits, cap)
+    # dispatch tokens → per-expert buffers [E, C, d]; pin expert dim to
+    # "ep" so GSPMD all-to-alls tokens onto expert shards
+    expert_in = jnp.einsum("nec,nd->ecd",
+                           dispatch.astype(x.dtype), xt)
+    expert_in = _pin_ep(expert_in)
+    act = getattr(jax.nn, activation)
+    h = jnp.einsum("ecd,edf->ecf", expert_in, w1.astype(x.dtype))
+    h = act(h + b1[:, None, :].astype(h.dtype))
+    out_e = jnp.einsum("ecf,efd->ecd", h, w2.astype(x.dtype))
+    out_e = out_e + b2[:, None, :].astype(out_e.dtype)
+    out_e = _pin_ep(out_e)
+    out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), out_e)
+    return out.reshape(b, s, d), aux.astype(jnp.float32)
+
+
+def _pin_ep(arr):
+    mesh = topology.get_current_mesh()
+    if mesh is None or dict(mesh.shape).get("ep", 1) <= 1:
+        return arr
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.lax.with_sharding_constraint(
+        arr, NamedSharding(mesh, P("ep", None, None)))
+
+
+# backward derived by vjp; uncached because the impl reads the live mesh
+from ..core.dispatch import register_vjp_grad  # noqa: E402
+
+register_vjp_grad("fused_moe", cache=False)
+
+
+# ---------------------------------------------- reference-parity alltoall
+@register_op("global_scatter", save_inputs=True, jit=False)
+def _global_scatter(x, axis_name="ep"):
+    """Token→expert all-to-all (reference global_scatter op,
+    operators/collective/global_scatter_op.cu.cc).  x is the expert-major
+    buffer [E, C, d]: token-sharded on C coming in, expert-sharded on E
+    going out.  Expressed as a sharding reshard — GSPMD lowers the
+    transition to the ICI all-to-all the reference issues explicitly."""
+    return _reshard_ep(x, axis_name, to_expert=True)
+
+
+@register_op("global_gather", save_inputs=True, jit=False)
+def _global_gather(x, axis_name="ep"):
+    """Inverse of global_scatter (reference global_gather op): expert-
+    sharded [E, C, d] back to token-sharded."""
+    return _reshard_ep(x, axis_name, to_expert=False)
+
+
+def _reshard_ep(x, axis_name, to_expert):
+    mesh = topology.get_current_mesh()
+    if mesh is None or dict(mesh.shape).get(axis_name, 1) <= 1:
+        return x
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rest = (None,) * (x.ndim - 2)
+    spec = P(axis_name, None, *rest) if to_expert \
+        else P(None, axis_name, *rest)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def _a2a_grad(fwd_name, bwd_name):
+    def grad_fn(ctx, g):
+        out = D(bwd_name, g.detach(),
+                axis_name=ctx.attrs.get("axis_name", "ep"))
+        return (out,)
+
+    register_grad(fwd_name)(grad_fn)
+
+
+_a2a_grad("global_scatter", "global_gather")
+_a2a_grad("global_gather", "global_scatter")
+
+
+# ------------------------------------------------------------- the layer
+class MoELayer(Layer):
+    """Expert-parallel MoE FFN block (reference MoELayer,
+    moe_layer.py:244): gate → dispatch → E expert MLPs → combine.
+
+    Experts are ONE stacked parameter pair sharded over "ep"; see module
+    docstring.  ``l_aux`` holds the last load-balancing loss — add
+    ``layer.l_aux`` to the training objective (reference does the same via
+    its gate's loss collection).
+    """
+
+    def __init__(self, d_model, d_hidden, num_experts, gate="gshard",
+                 top_k=2, capacity_factor=2.0, activation="gelu"):
+        super().__init__()
+        if gate not in _GATES:
+            raise ValueError(f"gate must be one of {sorted(_GATES)}")
+        self.num_experts = num_experts
+        self.gate_kind = gate
+        # capacity must be sized for what the gate actually routes:
+        # switch is top-1, gshard is top-2, only naive honors top_k
+        self.top_k = {"switch": 1, "gshard": 2}.get(gate, top_k)
+        self.capacity_factor = capacity_factor
+        self.activation = activation
+        self.gate_weight = Parameter(
+            I.XavierUniform()((d_model, num_experts), "float32"))
+        w1 = I.XavierUniform()((num_experts, d_model, d_hidden), "float32")
+        w2 = I.XavierUniform()((num_experts, d_hidden, d_model), "float32")
+        self.w1 = Parameter(w1)
+        self.b1 = Parameter(I.Constant(0.0)((num_experts, d_hidden),
+                                            "float32"))
+        self.w2 = Parameter(w2)
+        self.b2 = Parameter(I.Constant(0.0)((num_experts, d_model),
+                                            "float32"))
+        for p in (self.w1, self.b1, self.w2, self.b2):
+            p.dist_attr = ("ep",) + (None,) * (p._data.ndim - 1)
+        self.l_aux: Optional[Tensor] = None
+
+    def forward(self, x):
+        out, aux = D("fused_moe", x, self.gate_weight, self.w1, self.b1,
+                     self.w2, self.b2, gate=self.gate_kind,
+                     top_k=self.top_k,
+                     capacity_factor=self.capacity_factor,
+                     activation=self.activation)
+        self.l_aux = aux
+        return out
+
+    def extra_repr(self):
+        return (f"experts={self.num_experts}, gate={self.gate_kind}, "
+                f"top_k={self.top_k}, cap={self.capacity_factor}")

@@ -23,22 +23,23 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Canonical axis names, outermost → innermost.
-HYBRID_AXES = ("pp", "dp", "sharding", "sep", "mp")
+HYBRID_AXES = ("pp", "dp", "sharding", "sep", "ep", "mp")
 
 _CURRENT_HCG: Optional["HybridCommunicateGroup"] = None
 _CURRENT_MESH: Optional[Mesh] = None
 
 
 def create_hybrid_mesh(dp: int = 1, mp: int = 1, pp: int = 1,
-                       sharding: int = 1, sep: int = 1,
+                       sharding: int = 1, sep: int = 1, ep: int = 1,
                        devices: Optional[Sequence] = None) -> Mesh:
-    """Build the hybrid mesh [pp, dp, sharding, sep, mp] over the devices.
+    """Build the hybrid mesh [pp, dp, sharding, sep, ep, mp] over the devices.
 
     ``sep`` is the sequence-parallel ("sep"/context-parallel) degree — absent
     from the reference (SURVEY.md §5.7) and designed fresh here.
     """
     devices = list(devices if devices is not None else jax.devices())
-    degrees = {"pp": pp, "dp": dp, "sharding": sharding, "sep": sep, "mp": mp}
+    degrees = {"pp": pp, "dp": dp, "sharding": sharding, "sep": sep,
+                "ep": ep, "mp": mp}
     total = int(np.prod(list(degrees.values())))
     if total < len(devices):
         devices = devices[:total]   # smaller job than the slice: use a subset
@@ -122,14 +123,16 @@ class HybridCommunicateGroup:
 
     def __init__(self, dp_degree: int = 1, mp_degree: int = 1,
                  pp_degree: int = 1, sharding_degree: int = 1,
-                 sep_degree: int = 1, devices: Optional[Sequence] = None):
+                 sep_degree: int = 1, ep_degree: int = 1,
+                 devices: Optional[Sequence] = None):
         self.mesh = create_hybrid_mesh(dp=dp_degree, mp=mp_degree,
                                        pp=pp_degree,
                                        sharding=sharding_degree,
-                                       sep=sep_degree, devices=devices)
+                                       sep=sep_degree, ep=ep_degree,
+                                       devices=devices)
         self._degrees: Dict[str, int] = {
             "pp": pp_degree, "dp": dp_degree, "sharding": sharding_degree,
-            "sep": sep_degree, "mp": mp_degree}
+            "sep": sep_degree, "ep": ep_degree, "mp": mp_degree}
         self._topo = CommunicateTopology(list(HYBRID_AXES),
                                          [self._degrees[a] for a in HYBRID_AXES])
         self.global_rank = self._infer_global_rank()
