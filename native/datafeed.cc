@@ -1,0 +1,255 @@
+// High-throughput multi-slot data feed.
+//
+// Reference analog: paddle/fluid/framework/data_feed.cc —
+// MultiSlotDataFeed/InMemoryDataFeed (data_feed.h:1180,1572): N reader
+// threads parse slot-encoded text records into an in-memory channel, with
+// shuffle and batch assembly off the training thread.
+//
+// Record format (the reference's MultiSlot text format): per line,
+// whitespace-separated groups `<n> v1 ... vn` — one group per slot, in the
+// slot order given at creation.  Slots are dense float or sparse int64 id
+// lists.  Batches come out as contiguous arrays + per-example offsets (the
+// LoD analog), ready to wrap as numpy without copies.
+#include <atomic>
+#include <cctype>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <algorithm>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Record {
+  // per slot: exactly one of f/i populated, per the slot kind — int ids
+  // parse as true int64 (sparse feature ids exceed double's 2^53 mantissa)
+  std::vector<std::vector<float>> f;
+  std::vector<std::vector<int64_t>> i;
+};
+
+struct Feed {
+  int32_t num_slots;
+  std::vector<uint8_t> slot_is_float;
+  int32_t batch_size;
+  uint64_t shuffle_seed;
+  bool shuffle;
+
+  std::vector<Record> records;
+  std::vector<size_t> order;
+  size_t cursor = 0;
+
+  // assembled batch buffers (per slot): values + lod offsets
+  std::vector<std::vector<float>> out_f;
+  std::vector<std::vector<int64_t>> out_i;
+  std::vector<std::vector<int64_t>> out_lod;
+
+  std::string error;
+};
+
+bool parse_line(const char* line, const uint8_t* slot_is_float,
+                int32_t num_slots, Record* rec) {
+  const char* p = line;
+  rec->f.assign(num_slots, {});
+  rec->i.assign(num_slots, {});
+  for (int32_t s = 0; s < num_slots; ++s) {
+    while (*p && std::isspace(static_cast<unsigned char>(*p))) ++p;
+    if (!*p) return false;
+    char* end = nullptr;
+    long n = std::strtol(p, &end, 10);
+    if (end == p || n < 0) return false;
+    p = end;
+    bool is_f = slot_is_float[s] != 0;
+    auto& fv = rec->f[s];
+    auto& iv = rec->i[s];
+    if (is_f) fv.reserve(n); else iv.reserve(n);
+    for (long k = 0; k < n; ++k) {
+      while (*p && std::isspace(static_cast<unsigned char>(*p))) ++p;
+      if (!*p) return false;
+      if (is_f) {
+        double v = std::strtod(p, &end);
+        if (end == p) return false;
+        fv.push_back(static_cast<float>(v));
+      } else {
+        int64_t v = std::strtoll(p, &end, 10);
+        if (end == p) return false;
+        iv.push_back(v);
+      }
+      p = end;
+    }
+  }
+  return true;
+}
+
+// Each worker fills per_file[idx]; results concatenate in FILE ORDER after
+// the join, so record order (and therefore any seeded shuffle) is
+// reproducible regardless of thread completion order.
+void load_file_worker(const std::vector<std::string>* files,
+                      std::atomic<size_t>* next_file,
+                      const uint8_t* slot_is_float, int32_t num_slots,
+                      std::vector<std::vector<Record>>* per_file,
+                      std::atomic<bool>* ok) {
+  for (;;) {
+    size_t idx = next_file->fetch_add(1);
+    if (idx >= files->size()) break;
+    FILE* f = std::fopen((*files)[idx].c_str(), "r");
+    if (!f) {
+      ok->store(false);
+      return;
+    }
+    std::vector<Record>& local = (*per_file)[idx];
+    char* line = nullptr;
+    size_t cap = 0;
+    ssize_t len;
+    while ((len = getline(&line, &cap, f)) > 0) {
+      bool blank = true;
+      for (ssize_t i = 0; i < len; ++i)
+        if (!std::isspace(static_cast<unsigned char>(line[i]))) {
+          blank = false;
+          break;
+        }
+      if (blank) continue;
+      Record r;
+      if (!parse_line(line, slot_is_float, num_slots, &r)) {
+        ok->store(false);
+        std::free(line);
+        std::fclose(f);
+        return;
+      }
+      local.push_back(std::move(r));
+    }
+    std::free(line);
+    std::fclose(f);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// slot_is_float: per-slot flag (1 = dense float slot, 0 = sparse int64 ids).
+void* datafeed_create(const char** files, int32_t num_files,
+                      const uint8_t* slot_is_float, int32_t num_slots,
+                      int32_t batch_size, int32_t num_threads,
+                      int32_t shuffle, uint64_t seed) {
+  auto* feed = new Feed();
+  feed->num_slots = num_slots;
+  feed->slot_is_float.assign(slot_is_float, slot_is_float + num_slots);
+  feed->batch_size = batch_size;
+  feed->shuffle = shuffle != 0;
+  feed->shuffle_seed = seed;
+
+  std::vector<std::string> fs;
+  for (int32_t i = 0; i < num_files; ++i) fs.emplace_back(files[i]);
+  std::atomic<size_t> next_file{0};
+  std::atomic<bool> ok{true};
+  std::vector<std::vector<Record>> per_file(fs.size());
+  int32_t nt = num_threads > 0 ? num_threads : 1;
+  std::vector<std::thread> threads;
+  for (int32_t t = 0; t < nt; ++t)
+    threads.emplace_back(load_file_worker, &fs, &next_file,
+                         feed->slot_is_float.data(), num_slots, &per_file,
+                         &ok);
+  for (auto& t : threads) t.join();
+  if (!ok.load()) {
+    delete feed;
+    return nullptr;
+  }
+  for (auto& chunk : per_file)
+    for (auto& r : chunk) feed->records.push_back(std::move(r));
+  feed->order.resize(feed->records.size());
+  for (size_t i = 0; i < feed->order.size(); ++i) feed->order[i] = i;
+  if (feed->shuffle) {
+    std::mt19937_64 rng(feed->shuffle_seed);
+    std::shuffle(feed->order.begin(), feed->order.end(), rng);
+  }
+  feed->out_f.resize(num_slots);
+  feed->out_i.resize(num_slots);
+  feed->out_lod.resize(num_slots);
+  return feed;
+}
+
+void datafeed_destroy(void* h) { delete static_cast<Feed*>(h); }
+
+int64_t datafeed_size(void* h) {
+  return static_cast<int64_t>(static_cast<Feed*>(h)->records.size());
+}
+
+// Re-shuffle (new epoch) and rewind.
+void datafeed_reset(void* h, uint64_t seed) {
+  auto* feed = static_cast<Feed*>(h);
+  feed->cursor = 0;
+  if (feed->shuffle) {
+    std::mt19937_64 rng(seed);
+    std::shuffle(feed->order.begin(), feed->order.end(), rng);
+  }
+}
+
+// Assemble the next batch.  Returns the number of examples (0 = epoch end).
+// After the call, per-slot buffers are reachable via datafeed_slot_*.
+int32_t datafeed_next(void* h) {
+  auto* feed = static_cast<Feed*>(h);
+  size_t n = feed->records.size();
+  if (feed->cursor >= n) return 0;
+  size_t take = feed->batch_size;
+  if (feed->cursor + take > n) take = n - feed->cursor;
+  for (int32_t s = 0; s < feed->num_slots; ++s) {
+    feed->out_f[s].clear();
+    feed->out_i[s].clear();
+    feed->out_lod[s].assign(1, 0);
+  }
+  for (size_t i = 0; i < take; ++i) {
+    const Record& r = feed->records[feed->order[feed->cursor + i]];
+    for (int32_t s = 0; s < feed->num_slots; ++s) {
+      size_t count;
+      if (feed->slot_is_float[s]) {
+        const auto& vals = r.f[s];
+        feed->out_f[s].insert(feed->out_f[s].end(), vals.begin(),
+                              vals.end());
+        count = vals.size();
+      } else {
+        const auto& vals = r.i[s];
+        feed->out_i[s].insert(feed->out_i[s].end(), vals.begin(),
+                              vals.end());
+        count = vals.size();
+      }
+      feed->out_lod[s].push_back(
+          feed->out_lod[s].back() + static_cast<int64_t>(count));
+    }
+  }
+  feed->cursor += take;
+  return static_cast<int32_t>(take);
+}
+
+int64_t datafeed_slot_len(void* h, int32_t slot) {
+  auto* feed = static_cast<Feed*>(h);
+  return feed->slot_is_float[slot]
+             ? static_cast<int64_t>(feed->out_f[slot].size())
+             : static_cast<int64_t>(feed->out_i[slot].size());
+}
+
+const float* datafeed_slot_float(void* h, int32_t slot) {
+  return static_cast<Feed*>(h)->out_f[slot].data();
+}
+
+const int64_t* datafeed_slot_int(void* h, int32_t slot) {
+  return static_cast<Feed*>(h)->out_i[slot].data();
+}
+
+// Per-example offsets (LoD): batch+1 entries.
+const int64_t* datafeed_slot_lod(void* h, int32_t slot) {
+  return static_cast<Feed*>(h)->out_lod[slot].data();
+}
+
+int64_t datafeed_slot_lod_len(void* h, int32_t slot) {
+  return static_cast<int64_t>(
+      static_cast<Feed*>(h)->out_lod[slot].size());
+}
+
+}  // extern "C"

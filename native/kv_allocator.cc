@@ -1,0 +1,159 @@
+// Paged KV-cache block allocator / page-table manager.
+//
+// Reference analog: the fused_multi_transformer CacheKV max-seq buffers
+// (paddle/fluid/operators/fused/fused_multi_transformer_op.cc:103) plus the
+// reference's allocator stack (paddle/fluid/memory/allocation/ — strategy
+// allocators over fixed device pools).  For TPU serving, the device holds one
+// static [num_blocks, block_size, heads, head_dim] pool per layer; this
+// native-side manager owns which blocks belong to which sequence (the page
+// table) so the Python serving loop never does per-token bookkeeping.
+//
+// Design: free-list allocator over a fixed block pool, per-sequence block
+// vectors, copy-on-write forks for beam search (block refcounts).  All calls
+// O(1) amortized; thread-safe via a single mutex (allocation happens once per
+// block_size tokens per sequence, never per token).
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Pool {
+  int32_t num_blocks;
+  int32_t block_size;  // tokens per block
+  std::vector<int32_t> free_list;
+  std::vector<int32_t> refcount;          // per block
+  std::unordered_map<int64_t, std::vector<int32_t>> tables;  // seq -> blocks
+  std::unordered_map<int64_t, int32_t> lengths;              // seq -> tokens
+  std::mutex mu;
+
+  explicit Pool(int32_t nb, int32_t bs) : num_blocks(nb), block_size(bs) {
+    refcount.assign(nb, 0);
+    free_list.reserve(nb);
+    for (int32_t i = nb - 1; i >= 0; --i) free_list.push_back(i);
+  }
+
+  int32_t pop_free() {
+    if (free_list.empty()) return -1;
+    int32_t b = free_list.back();
+    free_list.pop_back();
+    refcount[b] = 1;
+    return b;
+  }
+
+  void unref(int32_t b) {
+    if (--refcount[b] == 0) free_list.push_back(b);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Create a pool of `num_blocks` blocks of `block_size` tokens.
+void* kv_pool_create(int32_t num_blocks, int32_t block_size) {
+  if (num_blocks <= 0 || block_size <= 0) return nullptr;
+  return new Pool(num_blocks, block_size);
+}
+
+void kv_pool_destroy(void* pool) { delete static_cast<Pool*>(pool); }
+
+int32_t kv_pool_free_blocks(void* pool) {
+  auto* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lock(p->mu);
+  return static_cast<int32_t>(p->free_list.size());
+}
+
+// Ensure `seq` can hold `num_tokens` tokens, allocating blocks as needed.
+// Returns the sequence's block count, or -1 on out-of-blocks (caller should
+// evict/queue — the vLLM-style admission decision stays in the scheduler).
+int32_t kv_seq_reserve(void* pool, int64_t seq, int32_t num_tokens) {
+  auto* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lock(p->mu);
+  auto& table = p->tables[seq];
+  int32_t need =
+      (num_tokens + p->block_size - 1) / p->block_size;
+  while (static_cast<int32_t>(table.size()) < need) {
+    int32_t b = p->pop_free();
+    if (b < 0) return -1;
+    table.push_back(b);
+  }
+  auto& len = p->lengths[seq];
+  if (num_tokens > len) len = num_tokens;
+  return static_cast<int32_t>(table.size());
+}
+
+// Copy the sequence's block ids into out (capacity `cap`); returns count.
+int32_t kv_seq_table(void* pool, int64_t seq, int32_t* out, int32_t cap) {
+  auto* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lock(p->mu);
+  auto it = p->tables.find(seq);
+  if (it == p->tables.end()) return 0;
+  int32_t n = static_cast<int32_t>(it->second.size());
+  if (n > cap) n = cap;
+  std::memcpy(out, it->second.data(), sizeof(int32_t) * n);
+  return n;
+}
+
+int32_t kv_seq_length(void* pool, int64_t seq) {
+  auto* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lock(p->mu);
+  auto it = p->lengths.find(seq);
+  return it == p->lengths.end() ? 0 : it->second;
+}
+
+// Copy-on-write fork (beam search): `child` shares all of `parent`'s blocks;
+// refcounts bumped.  Returns block count or -1 if parent missing.
+int32_t kv_seq_fork(void* pool, int64_t parent, int64_t child) {
+  auto* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lock(p->mu);
+  auto it = p->tables.find(parent);
+  if (it == p->tables.end()) return -1;
+  if (child == parent) return static_cast<int32_t>(it->second.size());
+  // reusing a live child id: release its blocks first (leak guard)
+  auto old = p->tables.find(child);
+  if (old != p->tables.end()) {
+    for (int32_t b : old->second) p->unref(b);
+    p->tables.erase(old);
+    p->lengths.erase(child);
+  }
+  for (int32_t b : it->second) ++p->refcount[b];
+  p->tables[child] = it->second;
+  p->lengths[child] = p->lengths[parent];
+  return static_cast<int32_t>(it->second.size());
+}
+
+// Make the last block of `seq` writable (copy-on-write): if it is shared,
+// allocate a fresh block and report the (src, dst) pair so the device copy
+// can be issued.  Returns 1 if a copy is needed (src/dst filled), 0 if the
+// block was already exclusive, -1 on error/out-of-blocks.
+int32_t kv_seq_cow_last(void* pool, int64_t seq, int32_t* src, int32_t* dst) {
+  auto* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lock(p->mu);
+  auto it = p->tables.find(seq);
+  if (it == p->tables.end() || it->second.empty()) return -1;
+  int32_t last = it->second.back();
+  if (p->refcount[last] == 1) return 0;
+  int32_t fresh = p->pop_free();
+  if (fresh < 0) return -1;
+  p->unref(last);
+  it->second.back() = fresh;
+  *src = last;
+  *dst = fresh;
+  return 1;
+}
+
+// Release a sequence's blocks.
+void kv_seq_free(void* pool, int64_t seq) {
+  auto* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lock(p->mu);
+  auto it = p->tables.find(seq);
+  if (it == p->tables.end()) return;
+  for (int32_t b : it->second) p->unref(b);
+  p->tables.erase(it);
+  p->lengths.erase(seq);
+}
+
+}  // extern "C"

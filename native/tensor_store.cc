@@ -1,0 +1,194 @@
+// Binary tensor store: the `.pdiparams` analog (reference:
+// paddle/fluid/framework/io — raw tensor serialization loaded by
+// inference/io.cc).  Format (little-endian):
+//   magic "PITS" | uint32 version | uint32 count
+//   per tensor: uint32 name_len | name | uint32 dtype_code |
+//               uint32 ndim | int64 dims[ndim] | uint64 nbytes | data
+// Writes are streamed; reads mmap the file so tensor payloads are zero-copy
+// (numpy frombuffer over the mapping) — the load path a predictor uses to
+// bring up weights without a Python-pickle pass.
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr char kMagic[4] = {'P', 'I', 'T', 'S'};
+constexpr uint32_t kVersion = 1;
+
+struct Writer {
+  FILE* f = nullptr;
+  uint32_t count = 0;
+  long count_pos = 0;
+};
+
+struct Entry {
+  std::string name;
+  uint32_t dtype;
+  std::vector<int64_t> dims;
+  uint64_t nbytes;
+  uint64_t offset;  // into the mapping
+};
+
+struct Reader {
+  int fd = -1;
+  uint8_t* map = nullptr;
+  size_t map_len = 0;
+  std::vector<Entry> entries;
+};
+
+template <typename T>
+bool write_pod(FILE* f, const T& v) {
+  return std::fwrite(&v, sizeof(T), 1, f) == 1;
+}
+
+template <typename T>
+bool read_pod(const uint8_t* base, size_t len, size_t* off, T* v) {
+  if (*off + sizeof(T) > len) return false;
+  std::memcpy(v, base + *off, sizeof(T));
+  *off += sizeof(T);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tstore_writer_open(const char* path) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return nullptr;
+  auto* w = new Writer();
+  w->f = f;
+  std::fwrite(kMagic, 1, 4, f);
+  write_pod(f, kVersion);
+  w->count_pos = std::ftell(f);
+  write_pod(f, w->count);  // patched on close
+  return w;
+}
+
+// dtype_code is caller-defined (the Python side maps numpy dtypes).
+int32_t tstore_writer_add(void* h, const char* name, uint32_t dtype_code,
+                          const int64_t* dims, uint32_t ndim,
+                          const void* data, uint64_t nbytes) {
+  auto* w = static_cast<Writer*>(h);
+  uint32_t name_len = static_cast<uint32_t>(std::strlen(name));
+  if (!write_pod(w->f, name_len)) return -1;
+  if (std::fwrite(name, 1, name_len, w->f) != name_len) return -1;
+  if (!write_pod(w->f, dtype_code)) return -1;
+  if (!write_pod(w->f, ndim)) return -1;
+  if (ndim && std::fwrite(dims, sizeof(int64_t), ndim, w->f) != ndim)
+    return -1;
+  if (!write_pod(w->f, nbytes)) return -1;
+  if (nbytes && std::fwrite(data, 1, nbytes, w->f) != nbytes) return -1;
+  ++w->count;
+  return 0;
+}
+
+int32_t tstore_writer_close(void* h) {
+  auto* w = static_cast<Writer*>(h);
+  int32_t rc = 0;
+  if (std::fseek(w->f, w->count_pos, SEEK_SET) != 0 ||
+      !write_pod(w->f, w->count))
+    rc = -1;
+  if (std::fclose(w->f) != 0) rc = -1;
+  delete w;
+  return rc;
+}
+
+void* tstore_reader_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 12) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  if (map == MAP_FAILED) {
+    ::close(fd);
+    return nullptr;
+  }
+  auto* r = new Reader();
+  r->fd = fd;
+  r->map = static_cast<uint8_t*>(map);
+  r->map_len = static_cast<size_t>(st.st_size);
+
+  size_t off = 0;
+  if (std::memcmp(r->map, kMagic, 4) != 0) goto fail;
+  off = 4;
+  uint32_t version, count;
+  if (!read_pod(r->map, r->map_len, &off, &version) || version != kVersion)
+    goto fail;
+  if (!read_pod(r->map, r->map_len, &off, &count)) goto fail;
+  for (uint32_t i = 0; i < count; ++i) {
+    Entry e;
+    uint32_t name_len, ndim;
+    if (!read_pod(r->map, r->map_len, &off, &name_len)) goto fail;
+    if (name_len > r->map_len - off) goto fail;  // overflow-safe bound
+    e.name.assign(reinterpret_cast<const char*>(r->map + off), name_len);
+    off += name_len;
+    if (!read_pod(r->map, r->map_len, &off, &e.dtype)) goto fail;
+    if (!read_pod(r->map, r->map_len, &off, &ndim)) goto fail;
+    e.dims.resize(ndim);
+    for (uint32_t d = 0; d < ndim; ++d)
+      if (!read_pod(r->map, r->map_len, &off, &e.dims[d])) goto fail;
+    if (!read_pod(r->map, r->map_len, &off, &e.nbytes)) goto fail;
+    if (e.nbytes > r->map_len - off) goto fail;  // overflow-safe bound
+    e.offset = off;
+    off += e.nbytes;
+    r->entries.push_back(std::move(e));
+  }
+  return r;
+fail:
+  munmap(r->map, r->map_len);
+  ::close(fd);
+  delete r;
+  return nullptr;
+}
+
+void tstore_reader_close(void* h) {
+  auto* r = static_cast<Reader*>(h);
+  munmap(r->map, r->map_len);
+  ::close(r->fd);
+  delete r;
+}
+
+int32_t tstore_reader_count(void* h) {
+  return static_cast<int32_t>(static_cast<Reader*>(h)->entries.size());
+}
+
+const char* tstore_entry_name(void* h, int32_t i) {
+  return static_cast<Reader*>(h)->entries[i].name.c_str();
+}
+
+uint32_t tstore_entry_dtype(void* h, int32_t i) {
+  return static_cast<Reader*>(h)->entries[i].dtype;
+}
+
+uint32_t tstore_entry_ndim(void* h, int32_t i) {
+  return static_cast<uint32_t>(
+      static_cast<Reader*>(h)->entries[i].dims.size());
+}
+
+const int64_t* tstore_entry_dims(void* h, int32_t i) {
+  return static_cast<Reader*>(h)->entries[i].dims.data();
+}
+
+uint64_t tstore_entry_nbytes(void* h, int32_t i) {
+  return static_cast<Reader*>(h)->entries[i].nbytes;
+}
+
+// Zero-copy view into the mapping.
+const void* tstore_entry_data(void* h, int32_t i) {
+  auto* r = static_cast<Reader*>(h);
+  return r->map + r->entries[i].offset;
+}
+
+}  // extern "C"

@@ -283,14 +283,20 @@ def register_vjp_grad(name: str, cache: bool = True):
 
     ``cache=False`` skips the per-attrs jit cache — required for ops whose
     impl reads ambient state (the current mesh) that must not be frozen
-    into a cached executable.
+    into a cached executable.  ``cache="mesh"`` keys the cache by the
+    current mesh as well, keeping jit speed for mesh-reading ops.
     """
     op = _REGISTRY[name]
 
     def grad_fn(ctx, *gouts):
         arrays = tuple(t._data if t is not None else None for t in ctx.inputs)
         frozen = _freeze_attrs(ctx.attrs)
-        key = (name, frozen)
+        if cache == "mesh":
+            from ..parallel import topology as _topo  # lazy: import cycle
+
+            key = (name, frozen, _topo.get_current_mesh())
+        else:
+            key = (name, frozen)
         bwd = _VJP_CACHE.get(key) if cache else None
         if bwd is None:
             impl = functools.partial(op.impl, **dict(frozen)) if frozen else op.impl

@@ -31,15 +31,36 @@ def _to_saveable(obj: Any):
     return obj
 
 
+_NATIVE_SUFFIX = ".pits"
+
+
 def save(obj: Any, path: str, protocol: int = 4):
+    """``.pits`` paths use the native mmap tensor store (flat str->array
+    state dicts only — the fast zero-copy serving format, reference
+    .pdiparams); anything else pickles (reference paddle.save)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
+    if path.endswith(_NATIVE_SUFFIX):
+        from .. import native
+
+        flat = _to_saveable(obj)
+        if not (isinstance(flat, dict)
+                and all(isinstance(v, np.ndarray) for v in flat.values())):
+            raise TypeError(
+                f"{_NATIVE_SUFFIX} format stores flat name->tensor dicts; "
+                "use a .pdparams pickle path for nested objects")
+        native.save_tensors(path, flat)
+        return
     with open(path, "wb") as f:
         pickle.dump(_to_saveable(obj), f, protocol=protocol)
 
 
 def load(path: str, return_numpy: bool = True):
+    if path.endswith(_NATIVE_SUFFIX):
+        from .. import native
+
+        return native.load_tensors(path)
     with open(path, "rb") as f:
         return pickle.load(f)
 

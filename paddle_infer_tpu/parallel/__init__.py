@@ -16,7 +16,9 @@ from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
                         RowParallelLinear, VocabParallelEmbedding)
 from . import fleet
 from .fleet import DistributedStrategy, FleetTrainStep
-from .sharding import group_sharded_parallel
+from .sharding import (DygraphShardingOptimizer, GroupShardedOptimizerStage2,
+                       GroupShardedStage2, GroupShardedStage3,
+                       group_sharded_parallel)
 from .sequence_parallel import ring_attention, ulysses_attention
 from .moe import MoELayer, gshard_gate, naive_gate, switch_gate
 from .pipeline import LayerDesc, PipelineStack
@@ -34,4 +36,6 @@ __all__ = [
     "model_parallel_random_seed", "ring_attention", "ulysses_attention",
     "LayerDesc", "PipelineStack",
     "MoELayer", "switch_gate", "gshard_gate", "naive_gate",
+    "GroupShardedStage2", "GroupShardedStage3",
+    "GroupShardedOptimizerStage2", "DygraphShardingOptimizer",
 ]

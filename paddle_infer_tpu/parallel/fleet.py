@@ -247,10 +247,27 @@ class FleetTrainStep:
             n: {k: slot_spec(n, a) for k, a in slots.items()}
             for n, slots in state.items()}
         self.opt_state = {
-            n: {k: jax.device_put(a, _named_sharding(
-                self.mesh, self._opt_specs[n][k]))
+            n: {k: jax.device_put(a, self._opt_sharding(
+                self._opt_specs[n][k]))
                 for k, a in slots.items()}
             for n, slots in state.items()}
+
+    def _offload_active(self) -> bool:
+        """Optimizer-state host offload (reference GroupSharded offload
+        variants): TPU only — XLA streams the slots HBM↔host around the
+        update; on CPU meshes the flag quietly no-ops."""
+        return bool(self.strategy.sharding
+                    and self.strategy.sharding_configs.get("offload")
+                    and jax.devices()[0].platform == "tpu")
+
+    def _opt_sharding(self, pspec):
+        sh = _named_sharding(self.mesh, pspec)
+        if self._offload_active():
+            try:
+                sh = sh.with_memory_kind("pinned_host")
+            except Exception:
+                pass
+        return sh
 
     # ------------------------------------------------------------- building
     def _pure_loss(self, static_kwargs):
@@ -345,7 +362,9 @@ class FleetTrainStep:
             return new_params, new_state, loss
 
         param_sh = _tree_shardings(mesh, param_specs)
-        opt_sh = _tree_shardings(mesh, self._opt_specs)
+        opt_sh = jax.tree_util.tree_map(
+            lambda s: self._opt_sharding(s), self._opt_specs,
+            is_leaf=lambda x: isinstance(x, P))
         batch_sh = self._batch_shardings(batch_sig)
         rep = _named_sharding(mesh, P())
         donate = (0, 1) if self.donate else ()

@@ -129,7 +129,10 @@ _GATES = {"switch": switch_gate, "gshard": gshard_gate, "naive": naive_gate}
 
 
 # ------------------------------------------------------------- fused op
-@register_op("fused_moe", jit=False)  # reads mesh state: no frozen cache
+_FUSED_JIT_CACHE = {}
+
+
+@register_op("fused_moe", jit=False)  # jitted internally, keyed by mesh
 def _fused_moe(x, gate_w, w1, b1, w2, b2, gate="gshard", top_k=2,
                capacity_factor=2.0, activation="gelu"):
     """One-shot MoE (reference fused_moe_kernel, ops.yaml:230): gating +
@@ -137,7 +140,26 @@ def _fused_moe(x, gate_w, w1, b1, w2, b2, gate="gshard", top_k=2,
 
     x [b, s, d]; gate_w [d, E]; w1 [E, d, f]; b1 [E, f]; w2 [E, f, d];
     b2 [E, d].  Returns (out [b, s, d], aux_loss scalar).
+
+    The impl reads the current mesh (the "ep" pin), so the eager jit cache
+    is keyed by (mesh, attrs) here instead of the dispatcher's attrs-only
+    cache.
     """
+    import functools
+
+    key = (topology.get_current_mesh(), gate, top_k, capacity_factor,
+           activation)
+    fn = _FUSED_JIT_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(functools.partial(
+            _fused_moe_impl, gate=gate, top_k=top_k,
+            capacity_factor=capacity_factor, activation=activation))
+        _FUSED_JIT_CACHE[key] = fn
+    return fn(x, gate_w, w1, b1, w2, b2)
+
+
+def _fused_moe_impl(x, gate_w, w1, b1, w2, b2, gate="gshard", top_k=2,
+                    capacity_factor=2.0, activation="gelu"):
     b, s, d = x.shape
     e = gate_w.shape[1]
     n = b * s
@@ -173,10 +195,11 @@ def _pin_ep(arr):
         arr, NamedSharding(mesh, P("ep", None, None)))
 
 
-# backward derived by vjp; uncached because the impl reads the live mesh
+# backward derived by vjp; cache keyed by the live mesh (the impl pins
+# shardings against it)
 from ..core.dispatch import register_vjp_grad  # noqa: E402
 
-register_vjp_grad("fused_moe", cache=False)
+register_vjp_grad("fused_moe", cache="mesh")
 
 
 # ---------------------------------------------- reference-parity alltoall

@@ -36,3 +36,93 @@ def group_sharded_parallel(model, optimizer, level: str = "os",
     if scaler is not None:
         return model, optimizer, scaler
     return model, optimizer
+
+
+class _GroupShardedStage:
+    """Base for the reference's wrapper-class surface
+    (meta_parallel/sharding/group_sharded_stage2.py:49 /
+    group_sharded_stage3.py:60).  On TPU the wrapper only records the
+    placement policy; FleetTrainStep compiles it into the step program.
+    Forward delegates to the inner layer, so the wrapper is usable exactly
+    like the reference's."""
+
+    _stage = 2
+
+    def __init__(self, layer, optimizer=None, group=None, offload=False,
+                 **kwargs):
+        self._layer = layer
+        self._optimizer = optimizer
+        strategy = _state.strategy or DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs = dict(strategy.sharding_configs or {},
+                                         stage=self._stage, offload=offload)
+        layer._fleet_distributed = True
+        if optimizer is not None:
+            optimizer._fleet_strategy = strategy
+        self._strategy = strategy
+
+    def __call__(self, *args, **kwargs):
+        return self._layer(*args, **kwargs)
+
+    def __getattr__(self, name):
+        if name == "_layer":      # not yet set (e.g. mid-unpickle)
+            raise AttributeError(name)
+        return getattr(self._layer, name)
+
+
+class GroupShardedStage2(_GroupShardedStage):
+    """ZeRO-2: grads + optimizer state sharded (reference
+    GroupShardedStage2)."""
+
+    _stage = 2
+
+
+class GroupShardedStage3(_GroupShardedStage):
+    """ZeRO-3/FSDP: params + grads + optimizer state sharded (reference
+    GroupShardedStage3)."""
+
+    _stage = 3
+
+
+class GroupShardedOptimizerStage2:
+    """Optimizer-state-sharding wrapper (reference
+    group_sharded_optimizer_stage2.py:51).  Delegates everything to the
+    inner optimizer; the stage-2 slot sharding itself is applied by
+    FleetTrainStep's optimizer-state placement."""
+
+    def __init__(self, params=None, optim=None, group=None, offload=False,
+                 **kwargs):
+        self._inner = optim
+        strategy = getattr(optim, "_fleet_strategy", None) \
+            or _state.strategy or DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs = dict(strategy.sharding_configs or {},
+                                         stage=max(2, int(
+                                             strategy.sharding_configs.get(
+                                                 "stage", 2))),
+                                         offload=offload)
+        optim._fleet_strategy = strategy
+
+    def __getattr__(self, name):
+        if name == "_inner":      # not yet set (e.g. mid-unpickle)
+            raise AttributeError(name)
+        return getattr(self._inner, name)
+
+
+class DygraphShardingOptimizer(GroupShardedOptimizerStage2):
+    """Stage-1 (optimizer-state only) sharding facade (reference
+    dygraph_sharding_optimizer.py:28)."""
+
+    def __init__(self, hcg=None, user_defined_strategy=None,
+                 params=None, inner_optimizer_class=None, optim=None,
+                 **kw):
+        inner = optim
+        if inner is None and inner_optimizer_class is not None:
+            inner = inner_optimizer_class(parameters=params, **kw)
+        self._inner = inner
+        strategy = user_defined_strategy or _state.strategy \
+            or DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs = dict(strategy.sharding_configs or {},
+                                         stage=1)
+        inner._fleet_strategy = strategy

@@ -169,6 +169,55 @@ class TestTensorParallelTraining:
             np.testing.assert_allclose(got, p.numpy(), rtol=2e-4, atol=2e-5,
                                        err_msg=n)
 
+    def test_group_sharded_wrappers(self):
+        """Reference wrapper-class surface: GroupShardedStage2/3 +
+        GroupShardedOptimizerStage2 mark the strategy and stay usable as
+        the layer/optimizer."""
+        from paddle_infer_tpu.parallel import (GroupShardedOptimizerStage2,
+                                               GroupShardedStage2,
+                                               GroupShardedStage3)
+
+        pit.seed(0)
+        m = pit.nn.Linear(8, 4)
+        opt = pit.optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=m.parameters())
+        w2 = GroupShardedStage2(m, opt)
+        assert w2._strategy.sharding_configs["stage"] == 2
+        x = Tensor(np.ones((2, 8), np.float32))
+        assert tuple(w2(x).shape) == (2, 4)
+        m3 = pit.nn.Linear(8, 4)
+        opt3 = pit.optimizer.AdamW(learning_rate=1e-3,
+                                   parameters=m3.parameters())
+        w3 = GroupShardedStage3(m3, opt3, offload=True)
+        assert w3._strategy.sharding_configs["stage"] == 3
+        assert w3._strategy.sharding_configs["offload"] is True
+        wo = GroupShardedOptimizerStage2(optim=opt3)
+        assert wo._fleet_strategy.sharding_configs["stage"] >= 2
+
+    def test_offload_flag_trains_on_cpu(self):
+        """offload=True quietly no-ops on CPU meshes but training works."""
+        pit.seed(1)
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "sharding_degree": 4}
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 2, "offload": True}
+        fleet.init(strategy=strategy)
+        m = pit.nn.Linear(16, 4)
+        opt = pit.optimizer.AdamW(learning_rate=1e-2,
+                                  parameters=m.parameters())
+
+        def loss_fn(model, x, y):
+            return pit.nn.functional.cross_entropy(model(x), y)
+
+        step = FleetTrainStep(m, loss_fn, opt, strategy=strategy)
+        rng = np.random.RandomState(2)
+        x = rng.randn(8, 16).astype(np.float32)
+        y = rng.randint(0, 4, (8,)).astype(np.int64)
+        l0 = float(step(x, y).numpy())
+        for _ in range(5):
+            l = float(step(x, y).numpy())
+        assert l < l0
+
     @pytest.mark.parametrize("level,stage", [("os", 1), ("os_g", 2),
                                              ("p_g_os", 3)])
     def test_zero_stages_match_baseline(self, level, stage):
