@@ -50,7 +50,6 @@ struct Feed {
   std::vector<std::vector<int64_t>> out_i;
   std::vector<std::vector<int64_t>> out_lod;
 
-  std::string error;
 };
 
 bool parse_line(const char* line, const uint8_t* slot_is_float,
@@ -90,17 +89,18 @@ bool parse_line(const char* line, const uint8_t* slot_is_float,
 // Each worker fills per_file[idx]; results concatenate in FILE ORDER after
 // the join, so record order (and therefore any seeded shuffle) is
 // reproducible regardless of thread completion order.
+// failure codes reported through `err`: 1 = file open failed, 2 = bad record
 void load_file_worker(const std::vector<std::string>* files,
                       std::atomic<size_t>* next_file,
                       const uint8_t* slot_is_float, int32_t num_slots,
                       std::vector<std::vector<Record>>* per_file,
-                      std::atomic<bool>* ok) {
+                      std::atomic<int>* err) {
   for (;;) {
     size_t idx = next_file->fetch_add(1);
     if (idx >= files->size()) break;
     FILE* f = std::fopen((*files)[idx].c_str(), "r");
     if (!f) {
-      ok->store(false);
+      err->store(1);
       return;
     }
     std::vector<Record>& local = (*per_file)[idx];
@@ -117,7 +117,7 @@ void load_file_worker(const std::vector<std::string>* files,
       if (blank) continue;
       Record r;
       if (!parse_line(line, slot_is_float, num_slots, &r)) {
-        ok->store(false);
+        err->store(2);
         std::free(line);
         std::fclose(f);
         return;
@@ -134,10 +134,11 @@ void load_file_worker(const std::vector<std::string>* files,
 extern "C" {
 
 // slot_is_float: per-slot flag (1 = dense float slot, 0 = sparse int64 ids).
+// err_out (optional): 0 ok, 1 file open failed, 2 bad record.
 void* datafeed_create(const char** files, int32_t num_files,
                       const uint8_t* slot_is_float, int32_t num_slots,
                       int32_t batch_size, int32_t num_threads,
-                      int32_t shuffle, uint64_t seed) {
+                      int32_t shuffle, uint64_t seed, int32_t* err_out) {
   auto* feed = new Feed();
   feed->num_slots = num_slots;
   feed->slot_is_float.assign(slot_is_float, slot_is_float + num_slots);
@@ -148,19 +149,21 @@ void* datafeed_create(const char** files, int32_t num_files,
   std::vector<std::string> fs;
   for (int32_t i = 0; i < num_files; ++i) fs.emplace_back(files[i]);
   std::atomic<size_t> next_file{0};
-  std::atomic<bool> ok{true};
+  std::atomic<int> err{0};
   std::vector<std::vector<Record>> per_file(fs.size());
   int32_t nt = num_threads > 0 ? num_threads : 1;
   std::vector<std::thread> threads;
   for (int32_t t = 0; t < nt; ++t)
     threads.emplace_back(load_file_worker, &fs, &next_file,
                          feed->slot_is_float.data(), num_slots, &per_file,
-                         &ok);
+                         &err);
   for (auto& t : threads) t.join();
-  if (!ok.load()) {
+  if (err.load() != 0) {
+    if (err_out) *err_out = err.load();
     delete feed;
     return nullptr;
   }
+  if (err_out) *err_out = 0;
   for (auto& chunk : per_file)
     for (auto& r : chunk) feed->records.push_back(std::move(r));
   feed->order.resize(feed->records.size());

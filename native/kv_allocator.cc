@@ -119,10 +119,13 @@ int32_t kv_seq_fork(void* pool, int64_t parent, int64_t child) {
     p->tables.erase(old);
     p->lengths.erase(child);
   }
-  for (int32_t b : it->second) ++p->refcount[b];
-  p->tables[child] = it->second;
-  p->lengths[child] = p->lengths[parent];
-  return static_cast<int32_t>(it->second.size());
+  // copy before inserting: the insertion may rehash and invalidate `it`
+  std::vector<int32_t> blocks = it->second;
+  int32_t parent_len = p->lengths[parent];
+  for (int32_t b : blocks) ++p->refcount[b];
+  p->tables[child] = std::move(blocks);
+  p->lengths[child] = parent_len;
+  return static_cast<int32_t>(p->tables[child].size());
 }
 
 // Make the last block of `seq` writable (copy-on-write): if it is shared,

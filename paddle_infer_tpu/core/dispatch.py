@@ -294,7 +294,13 @@ def register_vjp_grad(name: str, cache: bool = True):
         if cache == "mesh":
             from ..parallel import topology as _topo  # lazy: import cycle
 
-            key = (name, frozen, _topo.get_current_mesh())
+            mesh = _topo.get_current_mesh()
+            key = (name, frozen, mesh)
+            # evict entries compiled for meshes that are no longer current
+            for k in list(_VJP_CACHE):
+                if len(k) == 3 and k[0] == name and k[2] is not None \
+                        and k[2] is not mesh:
+                    del _VJP_CACHE[k]
         else:
             key = (name, frozen)
         bwd = _VJP_CACHE.get(key) if cache else None

@@ -30,6 +30,7 @@ _LIB_PATH = os.path.join(_NATIVE_DIR, "libpitnative.so")
 
 _lib = None
 _load_error: Optional[str] = None
+_build_attempted = False
 
 # numpy dtype <-> stable wire codes for TensorStore
 _DTYPE_CODES = {
@@ -59,12 +60,18 @@ def _build() -> bool:
 
 
 def _load():
-    global _lib, _load_error
+    global _lib, _load_error, _build_attempted
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH) and not _build():
-        _load_error = f"native library missing and build failed ({_LIB_PATH})"
-        return None
+    if _load_error is not None:
+        return None            # failure latched: don't re-spawn make
+    if not os.path.exists(_LIB_PATH):
+        if _build_attempted or not _build():
+            _build_attempted = True
+            _load_error = (
+                f"native library missing and build failed ({_LIB_PATH})")
+            return None
+        _build_attempted = True
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError as e:  # pragma: no cover
@@ -75,7 +82,8 @@ def _load():
         # datafeed
         "datafeed_create": ([c.POINTER(c.c_char_p), c.c_int32,
                              c.POINTER(c.c_uint8), c.c_int32, c.c_int32,
-                             c.c_int32, c.c_int32, c.c_uint64], c.c_void_p),
+                             c.c_int32, c.c_int32, c.c_uint64,
+                             c.POINTER(c.c_int32)], c.c_void_p),
         "datafeed_destroy": ([c.c_void_p], None),
         "datafeed_size": ([c.c_void_p], c.c_int64),
         "datafeed_reset": ([c.c_void_p, c.c_uint64], None),
@@ -153,11 +161,16 @@ class MultiSlotDataFeed:
             *[os.fsencode(f) for f in files])
         flags = (ctypes.c_uint8 * len(slots))(
             *[1 if kind == "float" else 0 for _, kind in slots])
+        err = ctypes.c_int32(0)
         self._h = lib.datafeed_create(arr, len(files), flags, len(slots),
                                       batch_size, num_threads,
-                                      1 if shuffle else 0, seed)
+                                      1 if shuffle else 0, seed,
+                                      ctypes.byref(err))
         if not self._h:
-            raise ValueError("datafeed_create failed (bad file or record)")
+            if err.value == 1:
+                raise FileNotFoundError(
+                    f"datafeed: cannot open one of {list(files)}")
+            raise ValueError("datafeed: malformed slot record")
 
     def __len__(self):
         return int(self._lib.datafeed_size(self._h))

@@ -1,0 +1,265 @@
+"""Pallas TPU paged-attention decode kernel + paged KV cache.
+
+The TPU answer to the reference's decode-attention path: the
+fused_multi_transformer masked-multihead-attention reads a dense
+[2, b, h, max_seq, d] CacheKV (fused_multi_transformer_op.cc:103) — dense
+max-seq buffers waste HBM when sequence lengths vary.  Here KV lives in a
+block pool ([num_pages, h, page_size, d], head-major so the kernel never
+relayouts) indexed by per-sequence page tables (cf. PAPERS.md "Ragged Paged Attention ... for TPU"); the native-side
+allocator (native/kv_allocator.cc) owns the tables.
+
+Kernel design: grid (batch, max_pages_per_seq) with the page dimension
+innermost; the page table and sequence lengths ride in scalar-prefetch SMEM
+so each grid step's index_map picks the right physical page — the K/V DMA
+streams exactly the pages the sequence owns, no gather materialisation.
+Online-softmax state (m, l, acc) persists in VMEM scratch across the page
+walk; heads are the row dimension of the in-kernel matmuls.  Decode is
+HBM-bandwidth-bound, so the win is reading only ceil(len/page) pages per
+sequence instead of max_seq rows.
+
+CPU fallback/interpret mode runs the same kernel through the Pallas
+interpreter for tests.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+# ------------------------------------------------------------------ kernel
+
+def _decode_kernel(lengths_ref, tables_ref,      # scalar prefetch (SMEM)
+                   q_ref, k_ref, v_ref,          # blocks (VMEM)
+                   o_ref,                        # output block
+                   m_ref, l_ref, acc_ref,        # VMEM scratch
+                   *, scale, page_size, max_pages):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = lengths_ref[b]
+
+    @pl.when(j * page_size < length)
+    def _():
+        # Decode attention is HBM-bound, not FLOP-bound, so scores/weights
+        # are broadcast-multiply + reductions (VPU).  The head-major page
+        # layout keeps every intermediate in [H, page|D] orientation — no
+        # cross-lane relayouts, which Mosaic can't lower for these shapes.
+        q = q_ref[0].astype(jnp.float32)            # [H, D]
+        k = k_ref[0].astype(jnp.float32)            # [H, page, D]
+        v = v_ref[0].astype(jnp.float32)            # [H, page, D]
+        # scores over this page's slots: [H, page]
+        s = jnp.sum(q[:, None, :] * k, axis=2) * scale
+        # mask slots beyond the sequence length
+        slot = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(slot < length, s, NEG_INF)
+
+        m_prev = m_ref[:]                            # [H, 1]
+        l_prev = l_ref[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                       # [H, page]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        # weighted values: [H, D]
+        pv = jnp.sum(p[:, :, None] * v, axis=1)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = m_new
+        l_ref[:] = l_new
+
+    @pl.when(j == max_pages - 1)
+    def _():
+        l = jnp.maximum(l_ref[:], 1e-20)             # [H, 1]
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def paged_attention_decode(q, k_pages, v_pages, block_tables, lengths,
+                           scale=None, interpret=None):
+    """One decode step of attention over paged KV.
+
+    q            [B, H, D]      — the new token's queries
+    k_pages      [P, H, page, D] — the shared physical pool (head-major)
+    v_pages      [P, H, page, D]
+    block_tables [B, max_pages] int32 — per-sequence page ids (pad 0)
+    lengths      [B] int32      — tokens already in cache (incl. current)
+    → [B, H, D]
+    """
+    interpret = _interpret() if interpret is None else interpret
+    b, h, d = q.shape
+    num_pages, kh, page_size, kd = k_pages.shape
+    assert (kh, kd) == (h, d), (k_pages.shape, q.shape)
+    max_pages = block_tables.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    lengths = lengths.astype(jnp.int32)
+    block_tables = block_tables.astype(jnp.int32)
+
+    def q_map(b_, j_, lengths_s, tables_s):
+        return (b_, 0, 0)
+
+    def kv_map(b_, j_, lengths_s, tables_s):
+        return (tables_s[b_, j_], 0, 0, 0)
+
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, page_size=page_size,
+        max_pages=max_pages)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, h, d), q_map),
+            pl.BlockSpec((1, h, page_size, d), kv_map),
+            pl.BlockSpec((1, h, page_size, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, d), jnp.float32),
+        ],
+    )
+    fn = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )
+    return fn(lengths, block_tables, q, k_pages, v_pages)
+
+
+# --------------------------------------------------------- page utilities
+# Pure-XLA writes: scatters into the pool compile to dynamic-update fusions;
+# the per-token bookkeeping (which page/slot) is the native allocator's job.
+
+def write_prompt_pages(pages, block_tables, kv):
+    """Scatter prompt K or V [B, S, H, D] into the head-major pool
+    [P, H, page, D].  S must be a multiple of page_size; slots past a
+    sequence's true length hold garbage — the decode kernel masks by
+    length at read time."""
+    b, s, h, d = kv.shape
+    page = pages.shape[2]
+    assert s % page == 0, (s, page)
+    n = s // page
+    chunks = kv.reshape(b, n, page, h, d).transpose(0, 1, 3, 2, 4)
+    idx = block_tables[:, :n].reshape(-1)
+    flat = chunks.reshape(b * n, h, page, d)
+    return pages.at[idx].set(flat.astype(pages.dtype))
+
+
+def write_token_page(pages, block_tables, kv, positions):
+    """Write one new token's K or V [B, H, D] at its (page, slot):
+    positions [B] is the 0-based token index in each sequence."""
+    page_size = pages.shape[2]
+    page_idx = jnp.take_along_axis(
+        block_tables, (positions // page_size)[:, None], axis=1)[:, 0]
+    slot = positions % page_size
+    # advanced indices (page_idx, slot) around the head slice: result dims
+    # [B, H, D] match kv
+    return pages.at[page_idx, :, slot].set(kv.astype(pages.dtype))
+
+
+class PagedKVCache:
+    """Per-layer paged KV pool + the native page-table allocator
+    (native/kv_allocator.cc).  The serving loop asks for reservations and
+    hands the resulting tables to the kernel — the device arrays stay put.
+    """
+
+    def __init__(self, num_pages, page_size, num_heads, head_dim,
+                 num_layers=1, dtype=jnp.bfloat16, pool=None):
+        from ... import native
+
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.pool = pool or native.KVBlockPool(num_pages, page_size)
+        shape = (num_pages, num_heads, page_size, head_dim)
+        self.k_pages = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.v_pages = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.num_layers = num_layers
+
+    def reserve(self, seq_id, num_tokens):
+        return self.pool.reserve(seq_id, num_tokens)
+
+    def tables_for(self, seq_ids, max_pages=None):
+        """Padded [B, max_pages] table + [B] lengths for a batch."""
+        import numpy as np
+
+        tables = [self.pool.block_table(s) for s in seq_ids]
+        lengths = np.asarray([self.pool.length(s) for s in seq_ids],
+                             np.int32)
+        width = max_pages or max(len(t) for t in tables)
+        out = np.zeros((len(seq_ids), width), np.int32)
+        for i, t in enumerate(tables):
+            t = t[:width]        # a reused/forked seq may own more pages
+            out[i, :len(t)] = t
+        return jnp.asarray(out), jnp.asarray(lengths)
+
+    def prefill(self, layer, seq_ids, k, v):
+        """Write prompt KV (padded to a page multiple) for new sequences."""
+        import numpy as np
+
+        b, s, _, _ = k.shape
+        for i, sid in enumerate(seq_ids):
+            self.reserve(sid, int(s))
+        tables, _ = self.tables_for(seq_ids,
+                                    max_pages=s // self.page_size)
+        self.k_pages[layer] = write_prompt_pages(
+            self.k_pages[layer], tables, k)
+        self.v_pages[layer] = write_prompt_pages(
+            self.v_pages[layer], tables, v)
+        self._tables_cache = None
+
+    def append(self, layer, seq_ids, k, v, positions):
+        """Write one decode token per sequence at `positions` (0-based).
+        Page tables are refreshed once per decode step (at layer 0, where
+        reservations can grow them) and reused for the other layers —
+        no per-layer native traffic."""
+        if layer == 0:
+            for i, sid in enumerate(seq_ids):
+                self.reserve(sid, int(positions[i]) + 1)
+            self._tables_cache = (tuple(seq_ids),
+                                  self.tables_for(seq_ids))
+        tables, _ = self._cached_tables(seq_ids)
+        pos = jnp.asarray(positions, jnp.int32)
+        self.k_pages[layer] = write_token_page(
+            self.k_pages[layer], tables, k, pos)
+        self.v_pages[layer] = write_token_page(
+            self.v_pages[layer], tables, v, pos)
+
+    def _cached_tables(self, seq_ids):
+        cached = getattr(self, "_tables_cache", None)
+        if cached is not None and cached[0] == tuple(seq_ids):
+            return cached[1]
+        result = self.tables_for(seq_ids)
+        self._tables_cache = (tuple(seq_ids), result)
+        return result
+
+    def attend(self, layer, seq_ids, q, interpret=None):
+        tables, lengths = self._cached_tables(seq_ids)
+        return paged_attention_decode(
+            q, self.k_pages[layer], self.v_pages[layer], tables, lengths,
+            interpret=interpret)
+
+    def free(self, seq_ids):
+        for s in seq_ids:
+            self.pool.free(s)
+        self._tables_cache = None

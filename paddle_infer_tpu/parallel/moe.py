@@ -151,6 +151,10 @@ def _fused_moe(x, gate_w, w1, b1, w2, b2, gate="gshard", top_k=2,
            activation)
     fn = _FUSED_JIT_CACHE.get(key)
     if fn is None:
+        # evict executables compiled for meshes that are no longer current
+        for k in list(_FUSED_JIT_CACHE):
+            if k[0] is not None and k[0] is not key[0]:
+                del _FUSED_JIT_CACHE[k]
         fn = jax.jit(functools.partial(
             _fused_moe_impl, gate=gate, top_k=top_k,
             capacity_factor=capacity_factor, activation=activation))

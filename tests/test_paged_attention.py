@@ -1,0 +1,161 @@
+"""Paged-attention tests (interpret mode on CPU): kernel vs dense reference
+over ragged lengths, page write utilities, PagedKVCache end-to-end decode
+equivalence with the dense static-cache path."""
+import numpy as np
+import pytest
+
+from paddle_infer_tpu import native
+from paddle_infer_tpu.ops.pallas.paged_attention import (
+    PagedKVCache, paged_attention_decode, write_prompt_pages,
+    write_token_page)
+
+
+def _dense_ref(q, k, v, length):
+    """Single-seq dense decode attention: q [H,D], k/v [L,H,D]."""
+    d = q.shape[-1]
+    s = np.einsum("hd,thd->ht", q, k[:length]) / np.sqrt(d)
+    p = np.exp(s - s.max(axis=1, keepdims=True))
+    p = p / p.sum(axis=1, keepdims=True)
+    return np.einsum("ht,thd->hd", p, v[:length])
+
+
+class TestKernel:
+    @pytest.mark.parametrize("lengths", [[5], [13, 4], [16, 9, 1]])
+    def test_matches_dense(self, lengths):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        b = len(lengths)
+        h, d, page = 4, 8, 8
+        max_len = max(lengths)
+        max_pages = (max_len + page - 1) // page
+        num_pages = b * max_pages + 1
+        q = rng.randn(b, h, d).astype(np.float32)
+        kd = [rng.randn(max_len, h, d).astype(np.float32) for _ in range(b)]
+        vd = [rng.randn(max_len, h, d).astype(np.float32) for _ in range(b)]
+
+        # lay out pages (head-major [P, H, page, D]): seq i gets pages
+        # [1 + i*max_pages, ...]
+        k_pages = np.zeros((num_pages, h, page, d), np.float32)
+        v_pages = np.zeros((num_pages, h, page, d), np.float32)
+        tables = np.zeros((b, max_pages), np.int32)
+        for i, L in enumerate(lengths):
+            n = (L + page - 1) // page
+            for j in range(n):
+                pid = 1 + i * max_pages + j
+                tables[i, j] = pid
+                chunk = kd[i][j * page:(j + 1) * page]   # [t, h, d]
+                k_pages[pid, :, :len(chunk)] = chunk.transpose(1, 0, 2)
+                chunk = vd[i][j * page:(j + 1) * page]
+                v_pages[pid, :, :len(chunk)] = chunk.transpose(1, 0, 2)
+
+        out = np.asarray(paged_attention_decode(
+            jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+            jnp.asarray(tables), jnp.asarray(lengths, np.int32),
+            interpret=True))
+        for i, L in enumerate(lengths):
+            want = _dense_ref(q[i], kd[i], vd[i], L)
+            np.testing.assert_allclose(out[i], want, rtol=2e-5, atol=2e-5)
+
+    def test_garbage_in_padded_pages_ignored(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(1)
+        h, d, page = 2, 4, 4
+        q = rng.randn(1, h, d).astype(np.float32)
+        k_pages = rng.randn(4, h, page, d).astype(np.float32) * 100
+        v_pages = rng.randn(4, h, page, d).astype(np.float32) * 100
+        # seq uses page 2 only, 3 tokens; table padded with page 0 (garbage)
+        tables = np.array([[2, 0]], np.int32)
+        out = np.asarray(paged_attention_decode(
+            jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+            jnp.asarray(tables), jnp.asarray([3], np.int32),
+            interpret=True))
+        want = _dense_ref(q[0], k_pages[2].transpose(1, 0, 2),
+                          v_pages[2].transpose(1, 0, 2), 3)
+        np.testing.assert_allclose(out[0], want, rtol=2e-5, atol=2e-5)
+
+
+class TestPageWrites:
+    def test_prompt_and_token_writes(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(2)
+        page, h, d = 4, 2, 4
+        pages = jnp.zeros((6, h, page, d), jnp.float32)
+        kv = rng.randn(2, 8, h, d).astype(np.float32)   # 2 seqs × 8 toks
+        tables = jnp.asarray([[1, 2], [3, 5]], jnp.int32)
+        pages = write_prompt_pages(pages, tables, jnp.asarray(kv))
+
+        def hp(x):      # [t, h, d] -> head-major [h, t, d]
+            return x.transpose(1, 0, 2)
+
+        np.testing.assert_allclose(np.asarray(pages)[1], hp(kv[0, :4]))
+        np.testing.assert_allclose(np.asarray(pages)[2], hp(kv[0, 4:]))
+        np.testing.assert_allclose(np.asarray(pages)[3], hp(kv[1, :4]))
+        np.testing.assert_allclose(np.asarray(pages)[5], hp(kv[1, 4:]))
+        tok = rng.randn(2, h, d).astype(np.float32)
+        pages = write_token_page(pages, tables, jnp.asarray(tok),
+                                 jnp.asarray([4, 7], jnp.int32))
+        np.testing.assert_allclose(np.asarray(pages)[2, :, 0], tok[0])
+        np.testing.assert_allclose(np.asarray(pages)[5, :, 3], tok[1])
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native library not built")
+class TestPagedKVCache:
+    def test_prefill_decode_matches_dense(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(3)
+        h, d, page = 4, 8, 8
+        cache = PagedKVCache(num_pages=16, page_size=page, num_heads=h,
+                             head_dim=d, num_layers=1, dtype=jnp.float32)
+        # two sequences, prompt length 8 (one page each)
+        k0 = rng.randn(2, 8, h, d).astype(np.float32)
+        v0 = rng.randn(2, 8, h, d).astype(np.float32)
+        cache.prefill(0, [101, 202], jnp.asarray(k0), jnp.asarray(v0))
+
+        dense_k = [list(k0[0]), list(k0[1])]
+        dense_v = [list(v0[0]), list(v0[1])]
+        # 5 decode steps
+        for t in range(5):
+            kt = rng.randn(2, h, d).astype(np.float32)
+            vt = rng.randn(2, h, d).astype(np.float32)
+            qt = rng.randn(2, h, d).astype(np.float32)
+            pos = np.array([8 + t, 8 + t])
+            cache.append(0, [101, 202], jnp.asarray(kt), jnp.asarray(vt),
+                         pos)
+            for i in range(2):
+                dense_k[i].append(kt[i])
+                dense_v[i].append(vt[i])
+            out = np.asarray(cache.attend(0, [101, 202], jnp.asarray(qt),
+                                          interpret=True))
+            for i in range(2):
+                want = _dense_ref(qt[i], np.stack(dense_k[i]),
+                                  np.stack(dense_v[i]), 9 + t)
+                np.testing.assert_allclose(out[i], want, rtol=2e-5,
+                                           atol=2e-5)
+        cache.free([101, 202])
+        assert cache.pool.free_blocks == 16
+
+    def test_ragged_batch(self):
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(4)
+        h, d, page = 2, 4, 4
+        cache = PagedKVCache(num_pages=8, page_size=page, num_heads=h,
+                             head_dim=d, dtype=jnp.float32)
+        k1 = rng.randn(1, 4, h, d).astype(np.float32)
+        v1 = rng.randn(1, 4, h, d).astype(np.float32)
+        k2 = rng.randn(1, 8, h, d).astype(np.float32)
+        v2 = rng.randn(1, 8, h, d).astype(np.float32)
+        cache.prefill(0, [1], jnp.asarray(k1), jnp.asarray(v1))
+        cache.prefill(0, [2], jnp.asarray(k2), jnp.asarray(v2))
+        q = rng.randn(2, h, d).astype(np.float32)
+        out = np.asarray(cache.attend(0, [1, 2], jnp.asarray(q),
+                                      interpret=True))
+        np.testing.assert_allclose(
+            out[0], _dense_ref(q[0], k1[0], v1[0], 4), rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(
+            out[1], _dense_ref(q[1], k2[0], v2[0], 8), rtol=2e-5, atol=2e-5)
