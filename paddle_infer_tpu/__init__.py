@@ -43,6 +43,8 @@ from . import inference
 from . import quantization
 from . import profiler
 from . import vision
+from . import hapi
+from .hapi import Model
 from . import device
 from .framework import save, load, set_flags, get_flags, flags
 from .framework.io import save_state_dict, load_state_dict

@@ -181,6 +181,36 @@ def _shadow(t: Tensor, arr) -> Tensor:
     return s
 
 
+def _nan_check_enabled() -> bool:
+    """Debug-mode numerical sanitizer (reference FLAGS_check_nan_inf,
+    framework/operator.cc:1465 + nan_inf_utils_detail.cc): when the flag is
+    on, every eager op's outputs are checked for non-finite values."""
+    try:
+        # NB: framework/__init__ re-exports a flags *function*; import the
+        # submodule's getter explicitly
+        from ..framework.flags import flags as _get_flag
+
+        return bool(_get_flag("check_nan_inf"))
+    except Exception:
+        return False
+
+
+def _check_nan_inf(name, outs_raw):
+    for i, a in enumerate(outs_raw):
+        if a is None or not hasattr(a, "dtype") \
+                or not jnp.issubdtype(a.dtype, jnp.inexact):
+            continue
+        if isinstance(a, jax.core.Tracer):
+            continue               # only eager values are checkable
+        if not bool(jnp.all(jnp.isfinite(a))):
+            n_nan = int(jnp.sum(jnp.isnan(a)))
+            n_inf = int(jnp.sum(jnp.isinf(a)))
+            raise FloatingPointError(
+                f"Operator {name} output {i} contains NaN/Inf "
+                f"(nan={n_nan}, inf={n_inf}, shape={tuple(a.shape)}) — "
+                f"FLAGS_check_nan_inf is on")
+
+
 def dispatch(name: str, *inputs, **attrs):
     """Run one eager op: Tensors in, Tensor(s) out, tape recorded."""
     op = _REGISTRY[name]
@@ -216,6 +246,9 @@ def dispatch(name: str, *inputs, **attrs):
 
     multi = isinstance(out_arrays, (tuple, list))
     outs_raw = list(out_arrays) if multi else [out_arrays]
+
+    if _nan_check_enabled():
+        _check_nan_inf(name, outs_raw)
 
     requires_grad = (
         autograd.grad_enabled()

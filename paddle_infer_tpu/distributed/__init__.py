@@ -37,5 +37,13 @@ def __getattr__(name):
         from .launch import spawn
 
         return spawn
+    if name == "auto_parallel":
+        from . import auto_parallel
+
+        return auto_parallel
+    if name in ("shard_tensor", "shard_op", "Engine"):
+        from . import auto_parallel
+
+        return getattr(auto_parallel, name)
     raise AttributeError(f"module 'paddle_infer_tpu.distributed' has no "
                          f"attribute '{name}'")

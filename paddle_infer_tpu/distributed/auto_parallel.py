@@ -1,0 +1,201 @@
+"""Semi-automatic SPMD ("auto parallel").
+
+Reference: python/paddle/distributed/auto_parallel/ — ``ProcessMesh``
+(process_mesh.py), ``shard_tensor``/``shard_op`` annotations
+(dist_attribute.py + interface), the ``Completer`` that propagates
+shardings (completion.py), the ``Partitioner``/``Resharder`` that split
+the program per rank and insert communication (partitioner.py,
+reshard.py), and the high-level ``Engine`` (engine.py:61).
+
+TPU-first mapping: annotations become jax.sharding placements.  The
+Completer/Partitioner/Resharder trio IS the XLA GSPMD partitioner —
+user annotations seed shardings, propagation happens inside the
+compiler, and collectives are inserted where layouts change.  What this
+module owns is the annotation surface, the mesh bookkeeping, and the
+Engine facade that compiles one SPMD train/eval/predict program.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from .mesh import ProcessMesh
+
+
+def _jax_mesh(process_mesh: ProcessMesh) -> Mesh:
+    m = getattr(process_mesh, "_jax_mesh_cache", None)
+    if m is None:
+        m = process_mesh.to_jax_mesh()
+        process_mesh._jax_mesh_cache = m
+    return m
+
+
+def _spec_from(shard_spec, mesh: ProcessMesh) -> P:
+    """[None, "mp", ...] per-dim axis names → PartitionSpec (validated)."""
+    clean = []
+    for s in shard_spec:
+        if s is None:
+            clean.append(None)
+        else:
+            assert s in mesh.dim_names, (
+                f"unknown mesh dim {s!r}; mesh has {mesh.dim_names}")
+            clean.append(s)
+    return P(*clean)
+
+
+def shard_tensor(x, process_mesh: ProcessMesh, shard_spec: Sequence):
+    """Place a tensor on the mesh with the given per-dim sharding
+    (reference shard_tensor: attaches dist_attr; here the placement is
+    physical via device_put and the spec is recorded as dist_attr)."""
+    spec = _spec_from(shard_spec, process_mesh)
+    sh = NamedSharding(_jax_mesh(process_mesh), spec)
+    if isinstance(x, Tensor):
+        x._data = jax.device_put(x._data, sh)
+        x.dist_attr = tuple(shard_spec)
+        return x
+    t = Tensor(jax.device_put(jax.numpy.asarray(x), sh))
+    t.dist_attr = tuple(shard_spec)
+    return t
+
+
+def shard_op(op_fn, process_mesh: ProcessMesh,
+             in_shard_specs: Optional[List] = None,
+             out_shard_specs: Optional[List] = None):
+    """Annotate one call's operand/result layouts (reference shard_op).
+    Constraints are applied with with_sharding_constraint so GSPMD
+    propagates through the surrounding program."""
+
+    def wrapped(*args, **kwargs):
+        mesh = _jax_mesh(process_mesh)
+
+        def pin(t, spec):
+            if spec is None:
+                return t
+            sh = NamedSharding(mesh, _spec_from(spec, process_mesh))
+            if isinstance(t, Tensor):
+                return Tensor(jax.lax.with_sharding_constraint(t._data, sh))
+            return jax.lax.with_sharding_constraint(t, sh)
+
+        if in_shard_specs is not None:
+            args = tuple(pin(a, s)
+                         for a, s in zip(args, in_shard_specs))
+        out = op_fn(*args, **kwargs)
+        if out_shard_specs is not None:
+            if isinstance(out, (tuple, list)):
+                out = type(out)(pin(o, s)
+                                for o, s in zip(out, out_shard_specs))
+            else:
+                out = pin(out, out_shard_specs[0])
+        return out
+
+    return wrapped
+
+
+class Strategy:
+    """Engine knobs (reference auto_parallel.Strategy): amp/recompute/
+    sharding toggles forwarded to the fleet strategy."""
+
+    def __init__(self, amp=False, recompute=False, sharding=False,
+                 sharding_stage=1):
+        self.amp = amp
+        self.recompute = recompute
+        self.sharding = sharding
+        self.sharding_stage = sharding_stage
+
+
+class Engine:
+    """High-level auto-parallel driver (reference engine.py:61 —
+    prepare/fit/evaluate/predict over an annotated model).
+
+    The model's parameter ``dist_attr`` annotations (from shard_tensor or
+    the TP layers) seed the placement; everything unannotated is
+    completed by GSPMD.  One compiled step per batch signature.
+    """
+
+    def __init__(self, model, loss_fn=None, optimizer=None, metrics=None,
+                 strategy: Optional[Strategy] = None,
+                 process_mesh: Optional[ProcessMesh] = None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.strategy = strategy or Strategy()
+        self.process_mesh = process_mesh
+        self._step = None
+
+    def _ensure_step(self):
+        if self._step is not None:
+            return
+        from ..parallel import (DistributedStrategy, FleetTrainStep, fleet,
+                                topology)
+
+        mesh = _jax_mesh(self.process_mesh) if self.process_mesh \
+            else None
+        if mesh is not None:
+            topology.set_current_mesh(mesh)
+        st = DistributedStrategy()
+        if self.strategy.amp:
+            st.amp = True
+        if self.strategy.recompute:
+            st.recompute = True
+        if self.strategy.sharding:
+            st.sharding = True
+            st.sharding_configs = {"stage": self.strategy.sharding_stage}
+        if fleet._state.hcg is None:
+            fleet.init(strategy=st)
+        def loss_adapter(m, *batch):
+            return self.loss_fn(m, *batch)
+
+        self._step = FleetTrainStep(self.model, loss_adapter,
+                                    self.optimizer, strategy=st)
+
+    def fit(self, train_data, epochs=1, verbose=0):
+        """train_data: iterable of tuples of arrays."""
+        self._ensure_step()
+        history = []
+        for epoch in range(epochs):
+            losses = []
+            for batch in train_data:
+                loss = self._step(*batch)
+                losses.append(float(loss.numpy()))
+            history.append(float(np.mean(losses)))
+            if verbose:
+                print(f"epoch {epoch}: loss={history[-1]:.4f}")
+        return {"loss": history}
+
+    def predict(self, data):
+        from ..core.autograd import no_grad
+
+        if self._step is not None:
+            # eager predict needs the trained (and undeleted — step buffers
+            # are donated) parameters back in the Layer
+            self._step.sync_params_to_model()
+        self.model.eval()
+        outs = []
+        for batch in data:
+            ins = batch if isinstance(batch, (tuple, list)) else (batch,)
+            with no_grad():
+                out = self.model(*[Tensor(np.asarray(b)) for b in ins])
+            outs.append(out.numpy())
+        self.model.train()
+        return outs
+
+    def evaluate(self, data):
+        self._ensure_step()
+        self._step.sync_params_to_model()
+        losses = []
+        for batch in data:
+            arrays = [np.asarray(b) for b in batch]
+            from ..core.autograd import no_grad
+
+            with no_grad():
+                loss = self.loss_fn(self.model, *[Tensor(a)
+                                                  for a in arrays])
+            losses.append(float(loss.numpy()))
+        return {"loss": float(np.mean(losses))}
+
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine", "Strategy"]

@@ -1,0 +1,134 @@
+"""Elastic fault tolerance (reference: python/paddle/distributed/fleet/
+elastic/manager.py:127 ``ElasticManager`` — etcd-registered node set,
+level 1 = fault tolerance (restart failed workers), level 2 = elastic
+resize within [min_np, max_np]; the launch watcher relaunches local
+processes when membership changes).
+
+TPU-native redesign: no etcd in the loop.  Membership rides a pluggable
+``Store`` — the default ``FileStore`` uses a shared directory (GCS-fuse /
+NFS on a pod) with per-node heartbeat files; a TCP KV store can slot in
+for DCN setups.  The manager watches heartbeats, computes the live node
+set, and drives a restart callback (in production: re-exec the launcher
+with the new ranks; in tests: any callable).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+ELASTIC_TIMEOUT = 30.0
+ELASTIC_LEVEL_FAULT_TOLERANCE = 1
+ELASTIC_LEVEL_ELASTIC = 2
+
+# reference manager.py ELASTIC_AUTO_PARALLEL_EXIT_CODE — a worker exiting
+# with this code requests a relaunch rather than a job failure
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 101
+
+
+class FileStore:
+    """Heartbeat registry over a shared directory."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def heartbeat(self, node_id: str, info: Optional[dict] = None):
+        p = os.path.join(self.path, f"{node_id}.hb")
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.time(), "info": info or {}}, f)
+        os.replace(tmp, p)
+
+    def nodes(self, timeout: float) -> Dict[str, dict]:
+        """Live nodes: heartbeat newer than ``timeout`` seconds."""
+        now = time.time()
+        out = {}
+        for fn in os.listdir(self.path):
+            if not fn.endswith(".hb"):
+                continue
+            try:
+                with open(os.path.join(self.path, fn)) as f:
+                    rec = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            if now - rec.get("ts", 0) <= timeout:
+                out[fn[:-3]] = rec
+        return out
+
+    def leave(self, node_id: str):
+        try:
+            os.remove(os.path.join(self.path, f"{node_id}.hb"))
+        except FileNotFoundError:
+            pass
+
+
+class ElasticManager:
+    """Watches membership; decides healthy/restart/resize (reference
+    manager.py: levels at :173-184, watch loop relaunching at :100-115).
+
+    ``np`` spec "min:max" (or int) sets the elastic range; the manager is
+    level 2 (elastic) when min != max, level 1 otherwise.
+    """
+
+    def __init__(self, node_id: str, np_spec, store: FileStore,
+                 timeout: float = ELASTIC_TIMEOUT,
+                 on_change: Optional[Callable[[List[str]], None]] = None):
+        if isinstance(np_spec, int):
+            self.min_np = self.max_np = np_spec
+        else:
+            parts = str(np_spec).split(":")
+            self.min_np = int(parts[0])
+            self.max_np = int(parts[-1])
+        self.level = (ELASTIC_LEVEL_ELASTIC
+                      if self.min_np != self.max_np
+                      else ELASTIC_LEVEL_FAULT_TOLERANCE)
+        self.node_id = node_id
+        self.store = store
+        self.timeout = timeout
+        self.on_change = on_change
+        self._last_set: Optional[List[str]] = None
+
+    # -------------------------------------------------------------- state
+    def register(self, info: Optional[dict] = None):
+        self.store.heartbeat(self.node_id, info)
+
+    def exit(self):
+        self.store.leave(self.node_id)
+
+    def current_nodes(self) -> List[str]:
+        return sorted(self.store.nodes(self.timeout))
+
+    def healthy(self) -> bool:
+        """Enough live nodes to run (reference: np within [min, max])."""
+        n = len(self.current_nodes())
+        return self.min_np <= n <= self.max_np
+
+    # -------------------------------------------------------------- watch
+    def poll(self) -> Optional[List[str]]:
+        """One watch step: heartbeat self, detect membership change.
+        Returns the new node list when it changed (and fires on_change),
+        else None."""
+        self.register()
+        nodes = self.current_nodes()
+        if self._last_set is None:
+            self._last_set = nodes
+            return None
+        if nodes != self._last_set:
+            self._last_set = nodes
+            if self.on_change is not None:
+                self.on_change(nodes)
+            return nodes
+        return None
+
+    def should_restart(self, exit_code: int) -> bool:
+        """Reference watcher semantics: nonzero exits restart under fault
+        tolerance; the auto-parallel exit code always requests relaunch."""
+        if exit_code == ELASTIC_AUTO_PARALLEL_EXIT_CODE:
+            return True
+        return exit_code != 0 and self.healthy()
+
+
+__all__ = ["ElasticManager", "FileStore", "ELASTIC_AUTO_PARALLEL_EXIT_CODE",
+           "ELASTIC_LEVEL_FAULT_TOLERANCE", "ELASTIC_LEVEL_ELASTIC"]

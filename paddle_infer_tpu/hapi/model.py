@@ -1,0 +1,218 @@
+"""High-level Model API (reference: python/paddle/hapi/model.py:1016
+``paddle.Model`` — prepare/fit/evaluate/predict/save/load over a Layer).
+
+TPU-first: the train loop is the plain eager loop (each op is a cached
+XLA executable); heavy multi-chip training belongs to FleetTrainStep —
+Model covers the reference's high-level single-program surface, including
+its callback protocol and metric accumulation.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..metric import Metric
+from .callbacks import CallbackList, ModelCheckpoint, ProgBarLogger
+
+
+def _to_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+def _as_batch(data):
+    """Normalize a loader item to (inputs_list, labels_list)."""
+    if isinstance(data, (list, tuple)):
+        if len(data) == 1:
+            ins, labs = data[0], None
+        elif len(data) == 2:
+            ins, labs = data
+        else:
+            ins, labs = data[:-1], data[-1]
+    else:
+        ins, labs = data, None
+    ins = list(ins) if isinstance(ins, (list, tuple)) else [ins]
+    if labs is None:
+        labs = []
+    labs = list(labs) if isinstance(labs, (list, tuple)) else [labs]
+    return [_to_tensor(x) for x in ins], [_to_tensor(y) for y in labs]
+
+
+class Model:
+    """reference hapi.Model: wrap a Layer, ``prepare`` the optimizer/loss/
+    metrics, then fit/evaluate/predict with callbacks."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # ------------------------------------------------------------ prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        else:
+            self._metrics = list(metrics) if isinstance(
+                metrics, (list, tuple)) else [metrics]
+        for m in self._metrics:
+            assert isinstance(m, Metric), f"not a Metric: {m}"
+        return self
+
+    # ------------------------------------------------------------- steps
+    def train_batch(self, inputs, labels=None):
+        assert self._optimizer is not None and self._loss is not None, \
+            "call prepare(optimizer, loss) first"
+        self.network.train()
+        ins, labs = _as_batch((inputs, labels) if labels is not None
+                              else inputs)
+        out = self.network(*ins)
+        loss = self._loss(out, *labs)
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        metrics = self._update_metrics(out, labs)
+        return float(loss.numpy()), metrics
+
+    def eval_batch(self, inputs, labels=None):
+        from ..core.autograd import no_grad
+
+        self.network.eval()
+        ins, labs = _as_batch((inputs, labels) if labels is not None
+                              else inputs)
+        with no_grad():
+            out = self.network(*ins)
+            loss = self._loss(out, *labs) if self._loss and labs else None
+        metrics = self._update_metrics(out, labs)
+        return (float(loss.numpy()) if loss is not None else None), metrics
+
+    def predict_batch(self, inputs):
+        from ..core.autograd import no_grad
+
+        self.network.eval()
+        ins, _ = _as_batch(inputs)
+        with no_grad():
+            out = self.network(*ins)
+        return out.numpy() if isinstance(out, Tensor) else \
+            [o.numpy() for o in out]
+
+    def _update_metrics(self, out, labs):
+        logs = {}
+        for m in self._metrics:
+            if isinstance(m, Metric) and labs:
+                corr = m.compute(out, labs[0]) if hasattr(m, "compute") \
+                    else (out, labs[0])
+                m.update(*[np.asarray(c.numpy() if isinstance(c, Tensor)
+                                      else c) for c in (
+                    corr if isinstance(corr, (list, tuple)) else (corr,))])
+                acc = m.accumulate()
+                if isinstance(acc, (list, tuple, np.ndarray)):
+                    for name, v in zip(
+                            m.name() if isinstance(m.name(), (list, tuple))
+                            else [m.name()], np.atleast_1d(acc)):
+                        logs[name] = float(v)
+                else:
+                    logs[m.name() if isinstance(m.name(), str)
+                         else m.name()[0]] = float(acc)
+        return logs
+
+    # ---------------------------------------------------------------- fit
+    def fit(self, train_data=None, eval_data=None, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=1, callbacks: Optional[Sequence] = None, **kw):
+        """reference hapi Model.fit (model.py:1708): epoch/batch loops with
+        the callback protocol; eval every ``eval_freq`` epochs."""
+        cbs = list(callbacks or [])
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
+            cbs.insert(0, ProgBarLogger(log_freq, verbose))
+        if save_dir and not any(isinstance(c, ModelCheckpoint)
+                                for c in cbs):
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        cblist = CallbackList(cbs, self, {"epochs": epochs,
+                                          "verbose": verbose})
+        self.stop_training = False
+        history = {"loss": []}
+        cblist.on_train_begin()
+        for epoch in range(epochs):
+            cblist.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            losses = []
+            for step, batch in enumerate(train_data):
+                cblist.on_train_batch_begin(step)
+                loss, mlogs = self.train_batch(batch)
+                losses.append(loss)
+                cblist.on_train_batch_end(step, {"loss": loss, **mlogs})
+            logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+            logs.update(mlogs if losses else {})
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                elogs = self.evaluate(eval_data, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in elogs.items()})
+            history["loss"].append(logs["loss"])
+            cblist.on_epoch_end(epoch, logs)
+            if self.stop_training:
+                break
+        cblist.on_train_end({"history": history})
+        return history
+
+    def evaluate(self, eval_data, verbose=0, **kw):
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        mlogs = {}
+        for batch in eval_data:
+            loss, mlogs = self.eval_batch(batch)
+            if loss is not None:
+                losses.append(loss)
+        logs = dict(mlogs)
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        if verbose:
+            print("Eval:", logs)
+        return logs
+
+    def predict(self, test_data, **kw):
+        outs = []
+        for batch in test_data:
+            ins = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch([ins]))
+        return outs
+
+    # ---------------------------------------------------------- save/load
+    def save(self, path, training=True):
+        """reference Model.save: <path>.pdparams (+ .pdopt when training)."""
+        from .. import save as pit_save
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        pit_save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None and hasattr(
+                self._optimizer, "state_dict"):
+            pit_save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from .. import load as pit_load
+
+        self.network.set_state_dict(pit_load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(opt_path) and hasattr(
+                    self._optimizer, "set_state_dict"):
+            self._optimizer.set_state_dict(pit_load(opt_path))
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None):
+        n_params = sum(int(np.prod(p.shape))
+                       for p in self.network.parameters())
+        lines = [repr(self.network), f"Total params: {n_params:,}"]
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": n_params}

@@ -1,0 +1,140 @@
+"""Vision model zoo (reference: python/paddle/vision/models/ — resnet.py,
+vgg.py).  ResNet v1.5 family (18/34/50/101/152) built from the framework's
+nn layers; NCHW layout, BatchNorm2D + ReLU, the standard
+conv7-pool-4stages-avgpool-fc topology."""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from ..nn.layers_common import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D,
+                                Flatten, Linear, MaxPool2D, Sequential)
+from ..nn import functional as F
+
+
+class BasicBlock(Layer):
+    """Two 3x3 convs (reference resnet.py BasicBlock); expansion 1."""
+
+    expansion = 1
+
+    def __init__(self, in_ch, ch, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = Conv2D(in_ch, ch, 3, stride=stride, padding=1,
+                            bias_attr=False)
+        self.bn1 = BatchNorm2D(ch)
+        self.conv2 = Conv2D(ch, ch, 3, padding=1, bias_attr=False)
+        self.bn2 = BatchNorm2D(ch)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        return F.relu(out + identity)
+
+
+class BottleneckBlock(Layer):
+    """1x1 → 3x3 → 1x1 (reference resnet.py BottleneckBlock); expansion 4;
+    stride on the 3x3 (v1.5)."""
+
+    expansion = 4
+
+    def __init__(self, in_ch, ch, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = Conv2D(in_ch, ch, 1, bias_attr=False)
+        self.bn1 = BatchNorm2D(ch)
+        self.conv2 = Conv2D(ch, ch, 3, stride=stride, padding=1,
+                            bias_attr=False)
+        self.bn2 = BatchNorm2D(ch)
+        self.conv3 = Conv2D(ch, ch * 4, 1, bias_attr=False)
+        self.bn3 = BatchNorm2D(ch * 4)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x if self.downsample is None else self.downsample(x)
+        out = F.relu(self.bn1(self.conv1(x)))
+        out = F.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        return F.relu(out + identity)
+
+
+class ResNet(Layer):
+    """reference: python/paddle/vision/models/resnet.py class ResNet."""
+
+    def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True,
+                 in_channels=3):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.inplanes = 64
+        self.conv1 = Conv2D(in_channels, 64, 7, stride=2, padding=3,
+                            bias_attr=False)
+        self.bn1 = BatchNorm2D(64)
+        self.maxpool = MaxPool2D(kernel_size=3, stride=2, padding=1)
+        self.layer1 = self._make_layer(block, 64, depth_cfg[0])
+        self.layer2 = self._make_layer(block, 128, depth_cfg[1], stride=2)
+        self.layer3 = self._make_layer(block, 256, depth_cfg[2], stride=2)
+        self.layer4 = self._make_layer(block, 512, depth_cfg[3], stride=2)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.flatten = Flatten()
+            self.fc = Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, ch, blocks, stride=1):
+        downsample = None
+        if stride != 1 or self.inplanes != ch * block.expansion:
+            downsample = Sequential(
+                Conv2D(self.inplanes, ch * block.expansion, 1,
+                       stride=stride, bias_attr=False),
+                BatchNorm2D(ch * block.expansion))
+        layers = [block(self.inplanes, ch, stride, downsample)]
+        self.inplanes = ch * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.inplanes, ch))
+        return Sequential(*layers)
+
+    def forward(self, x):
+        x = self.maxpool(F.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.flatten(x))
+        return x
+
+
+_CONFIGS = {
+    18: (BasicBlock, (2, 2, 2, 2)),
+    34: (BasicBlock, (3, 4, 6, 3)),
+    50: (BottleneckBlock, (3, 4, 6, 3)),
+    101: (BottleneckBlock, (3, 4, 23, 3)),
+    152: (BottleneckBlock, (3, 8, 36, 3)),
+}
+
+
+def _resnet(depth, **kwargs):
+    block, cfg = _CONFIGS[depth]
+    return ResNet(block, cfg, **kwargs)
+
+
+def resnet18(**kw):
+    return _resnet(18, **kw)
+
+
+def resnet34(**kw):
+    return _resnet(34, **kw)
+
+
+def resnet50(**kw):
+    return _resnet(50, **kw)
+
+
+def resnet101(**kw):
+    return _resnet(101, **kw)
+
+
+def resnet152(**kw):
+    return _resnet(152, **kw)
+
+
+__all__ = ["ResNet", "BasicBlock", "BottleneckBlock", "resnet18",
+           "resnet34", "resnet50", "resnet101", "resnet152"]

@@ -1,0 +1,128 @@
+"""High-level API tests: Model.prepare/fit/evaluate/predict/save/load,
+callbacks (early stopping, checkpoint), ResNet family (reference:
+hapi/model.py, hapi/callbacks.py, vision/models/resnet.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.core.tensor import Tensor
+from paddle_infer_tpu.hapi import EarlyStopping, Model
+from paddle_infer_tpu.metric import Accuracy
+
+
+def _toy_loader(n=64, batch=16, seed=0, dim=8, classes=3):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim, classes)
+    x = rng.randn(n, dim).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * rng.randn(n, classes), axis=1)
+    return [(x[i:i + batch], y[i:i + batch].astype(np.int64))
+            for i in range(0, n, batch)]
+
+
+def _mlp(dim=8, classes=3):
+    return pit.nn.Sequential(pit.nn.Linear(dim, 32), pit.nn.ReLU(),
+                             pit.nn.Linear(32, classes))
+
+
+class TestModelFit:
+    def test_fit_evaluate_predict(self, capsys):
+        pit.seed(0)
+        net = _mlp()
+        model = Model(net)
+        model.prepare(
+            optimizer=pit.optimizer.AdamW(learning_rate=5e-2,
+                                          parameters=net.parameters()),
+            loss=pit.nn.CrossEntropyLoss(),
+            metrics=Accuracy())
+        data = _toy_loader()
+        hist = model.fit(data, eval_data=data, epochs=6, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0] * 0.7
+        logs = model.evaluate(data)
+        assert logs["acc"] > 0.7
+        assert "loss" in logs
+        preds = model.predict(data)
+        assert len(preds) == len(data)
+        assert preds[0].shape == (16, 3)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        pit.seed(1)
+        net = _mlp()
+        model = Model(net)
+        model.prepare(
+            optimizer=pit.optimizer.AdamW(learning_rate=1e-2,
+                                          parameters=net.parameters()),
+            loss=pit.nn.CrossEntropyLoss())
+        data = _toy_loader(32, 16, seed=2)
+        model.fit(data, epochs=1, verbose=0)
+        path = str(tmp_path / "ckpt" / "model")
+        model.save(path)
+        assert os.path.exists(path + ".pdparams")
+        assert os.path.exists(path + ".pdopt")
+        x = data[0][0]
+        want = model.predict_batch([x])
+        net2 = _mlp()
+        m2 = Model(net2)
+        m2.prepare(loss=pit.nn.CrossEntropyLoss())
+        m2.load(path, reset_optimizer=True)
+        np.testing.assert_allclose(m2.predict_batch([x]), want, rtol=1e-5)
+
+    def test_fit_checkpoint_dir(self, tmp_path):
+        pit.seed(2)
+        net = _mlp()
+        model = Model(net)
+        model.prepare(
+            optimizer=pit.optimizer.SGD(learning_rate=1e-2,
+                                        parameters=net.parameters()),
+            loss=pit.nn.CrossEntropyLoss())
+        model.fit(_toy_loader(32), epochs=2, verbose=0,
+                  save_dir=str(tmp_path))
+        assert os.path.exists(str(tmp_path / "0.pdparams"))
+        assert os.path.exists(str(tmp_path / "final.pdparams"))
+
+    def test_early_stopping(self):
+        pit.seed(3)
+        net = _mlp()
+        model = Model(net)
+        model.prepare(
+            optimizer=pit.optimizer.SGD(learning_rate=0.0,  # no progress
+                                        parameters=net.parameters()),
+            loss=pit.nn.CrossEntropyLoss())
+        es = EarlyStopping(monitor="loss", patience=1, min_delta=1e-9)
+        model.fit(_toy_loader(32), epochs=10, verbose=0, callbacks=[es])
+        assert es.stopped_epoch is not None and es.stopped_epoch < 9
+
+
+class TestResNet:
+    @pytest.mark.parametrize("ctor,blocks", [("resnet18", 8),
+                                             ("resnet50", 16)])
+    def test_forward_shapes(self, ctor, blocks):
+        from paddle_infer_tpu.vision import models as M
+
+        pit.seed(4)
+        net = getattr(M, ctor)(num_classes=10)
+        net.eval()
+        x = Tensor(np.random.RandomState(5).randn(2, 3, 32, 32)
+                   .astype(np.float32))
+        out = net(x)
+        assert tuple(out.shape) == (2, 10)
+
+    def test_resnet_trains_one_step(self):
+        from paddle_infer_tpu.vision.models import resnet18
+
+        pit.seed(6)
+        net = resnet18(num_classes=4, in_channels=1)
+        opt = pit.optimizer.SGD(learning_rate=1e-2,
+                                parameters=net.parameters())
+        x = Tensor(np.random.RandomState(7).randn(2, 1, 32, 32)
+                   .astype(np.float32))
+        y = Tensor(np.array([0, 3], np.int64))
+        net.train()
+        loss = pit.nn.functional.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        loss2 = pit.nn.functional.cross_entropy(net(x), y)
+        assert float(loss2.numpy()) != float(loss.numpy())
+        assert np.isfinite(float(loss2.numpy()))
