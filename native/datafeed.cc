@@ -55,6 +55,7 @@ struct Feed {
 bool parse_line(const char* line, const uint8_t* slot_is_float,
                 int32_t num_slots, Record* rec) {
   const char* p = line;
+  const char* line_end = line + std::strlen(line);
   rec->f.assign(num_slots, {});
   rec->i.assign(num_slots, {});
   for (int32_t s = 0; s < num_slots; ++s) {
@@ -67,6 +68,11 @@ bool parse_line(const char* line, const uint8_t* slot_is_float,
     bool is_f = slot_is_float[s] != 0;
     auto& fv = rec->f[s];
     auto& iv = rec->i[s];
+    // a claimed count larger than what the rest of the line could possibly
+    // hold (>= 2 chars per value incl. separator, last may be 1) is a bad
+    // record, not an allocation request — without this bound a malformed
+    // count like 1e11 turns into std::bad_alloc across the C boundary
+    if (n > (line_end - p + 1) / 2) return false;
     if (is_f) fv.reserve(n); else iv.reserve(n);
     for (long k = 0; k < n; ++k) {
       while (*p && std::isspace(static_cast<unsigned char>(*p))) ++p;

@@ -57,9 +57,18 @@ bool read_pod(const uint8_t* base, size_t len, size_t* off, T* v) {
   return true;
 }
 
+// Why the caller failed to open: lets the Python binding distinguish a
+// missing file (FileNotFoundError) from a corrupt one (ValueError).
+thread_local int32_t g_tstore_err = 0;
+constexpr int32_t kErrOpen = 1;
+constexpr int32_t kErrCorrupt = 2;
+
 }  // namespace
 
 extern "C" {
+
+// 0 = no error, 1 = open/stat/mmap failed, 2 = corrupt/truncated file.
+int32_t tstore_last_error() { return g_tstore_err; }
 
 void* tstore_writer_open(const char* path) {
   FILE* f = std::fopen(path, "wb");
@@ -103,15 +112,26 @@ int32_t tstore_writer_close(void* h) {
 }
 
 void* tstore_reader_open(const char* path) {
+  g_tstore_err = 0;
   int fd = ::open(path, O_RDONLY);
-  if (fd < 0) return nullptr;
+  if (fd < 0) {
+    g_tstore_err = kErrOpen;
+    return nullptr;
+  }
   struct stat st;
-  if (fstat(fd, &st) != 0 || st.st_size < 12) {
+  if (fstat(fd, &st) != 0) {
+    g_tstore_err = kErrOpen;
+    ::close(fd);
+    return nullptr;
+  }
+  if (st.st_size < 12) {
+    g_tstore_err = kErrCorrupt;
     ::close(fd);
     return nullptr;
   }
   void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
   if (map == MAP_FAILED) {
+    g_tstore_err = kErrOpen;
     ::close(fd);
     return nullptr;
   }
@@ -127,6 +147,9 @@ void* tstore_reader_open(const char* path) {
   if (!read_pod(r->map, r->map_len, &off, &version) || version != kVersion)
     goto fail;
   if (!read_pod(r->map, r->map_len, &off, &count)) goto fail;
+  // every entry needs >= 20 header bytes (name_len+dtype+ndim+nbytes); a
+  // count that cannot fit in the file is corruption, not an alloc request
+  if (count > (r->map_len - off) / 20) goto fail;
   for (uint32_t i = 0; i < count; ++i) {
     Entry e;
     uint32_t name_len, ndim;
@@ -136,6 +159,9 @@ void* tstore_reader_open(const char* path) {
     off += name_len;
     if (!read_pod(r->map, r->map_len, &off, &e.dtype)) goto fail;
     if (!read_pod(r->map, r->map_len, &off, &ndim)) goto fail;
+    // dims are 8 bytes each; bound ndim by the remaining mapped bytes so a
+    // corrupt header can't trigger a multi-GB zero-filled resize
+    if (ndim > (r->map_len - off) / sizeof(int64_t)) goto fail;
     e.dims.resize(ndim);
     for (uint32_t d = 0; d < ndim; ++d)
       if (!read_pod(r->map, r->map_len, &off, &e.dims[d])) goto fail;
@@ -147,6 +173,7 @@ void* tstore_reader_open(const char* path) {
   }
   return r;
 fail:
+  g_tstore_err = kErrCorrupt;
   munmap(r->map, r->map_len);
   ::close(fd);
   delete r;

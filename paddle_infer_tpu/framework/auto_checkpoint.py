@@ -45,15 +45,19 @@ class AutoCheckpoint:
 
     # --------------------------------------------------------------- save
     def _save(self, epoch: int):
-        from .io import save
+        from .io import atomic_save
 
+        # params/opt go through tmp + os.replace like the meta: a
+        # preemption mid-write (the exact scenario this feature exists
+        # for) must never leave a truncated file that a committed meta
+        # still references
         if self.model is not None:
-            save(self.model.state_dict(),
-                 os.path.join(self.dir, "model.pdparams"))
+            atomic_save(self.model.state_dict(),
+                        os.path.join(self.dir, "model.pdparams"))
         if self.optimizer is not None and hasattr(self.optimizer,
                                                   "state_dict"):
-            save(self.optimizer.state_dict(),
-                 os.path.join(self.dir, "opt.pdopt"))
+            atomic_save(self.optimizer.state_dict(),
+                        os.path.join(self.dir, "opt.pdopt"))
         tmp = self._meta_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"epoch": epoch, "job_id": self.job_id}, f)

@@ -56,6 +56,14 @@ def save(obj: Any, path: str, protocol: int = 4):
         pickle.dump(_to_saveable(obj), f, protocol=protocol)
 
 
+def atomic_save(obj: Any, path: str, protocol: int = 4):
+    """``save`` through a tmp file + ``os.replace`` so readers never see a
+    partially written file (checkpoint/preemption safety)."""
+    tmp = path + ".tmp"
+    save(obj, tmp, protocol=protocol)
+    os.replace(tmp, path)
+
+
 def load(path: str, return_numpy: bool = True):
     if path.endswith(_NATIVE_SUFFIX):
         from .. import native

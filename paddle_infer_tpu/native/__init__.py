@@ -124,11 +124,32 @@ def _load():
                               c.POINTER(c.c_int64)),
         "tstore_entry_nbytes": ([c.c_void_p, c.c_int32], c.c_uint64),
         "tstore_entry_data": ([c.c_void_p, c.c_int32], c.c_void_p),
+        "tstore_last_error": ([], c.c_int32),
     }
-    for name, (argtypes, restype) in sigs.items():
-        fn = getattr(lib, name)
-        fn.argtypes = argtypes
-        fn.restype = restype
+    try:
+        for name, (argtypes, restype) in sigs.items():
+            fn = getattr(lib, name)
+            fn.argtypes = argtypes
+            fn.restype = restype
+    except AttributeError:
+        # stale prebuilt .so missing a newer symbol: rebuild once, else
+        # latch the failure so available() keeps its returns-bool contract
+        if not _build_attempted and _build():
+            _build_attempted = True
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+                for name, (argtypes, restype) in sigs.items():
+                    fn = getattr(lib, name)
+                    fn.argtypes = argtypes
+                    fn.restype = restype
+            except (OSError, AttributeError) as e:
+                _load_error = f"stale native library: {e}"
+                return None
+        else:
+            _build_attempted = True
+            _load_error = ("native library is stale (missing symbol) and "
+                           "rebuild failed")
+            return None
     _lib = lib
     return lib
 
@@ -157,6 +178,7 @@ class MultiSlotDataFeed:
         self._slots = list(slots)
         self._epoch = 0
         self._seed = seed
+        self._iterating = False
         arr = (ctypes.c_char_p * len(files))(
             *[os.fsencode(f) for f in files])
         flags = (ctypes.c_uint8 * len(slots))(
@@ -176,28 +198,40 @@ class MultiSlotDataFeed:
         return int(self._lib.datafeed_size(self._h))
 
     def __iter__(self):
-        self._lib.datafeed_reset(self._h, self._seed + self._epoch)
-        self._epoch += 1
-        while True:
-            n = self._lib.datafeed_next(self._h)
-            if n <= 0:
-                return
-            out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
-            for i, (name, kind) in enumerate(self._slots):
-                ln = self._lib.datafeed_slot_len(self._h, i)
-                if kind == "float":
-                    ptr = self._lib.datafeed_slot_float(self._h, i)
-                    vals = np.ctypeslib.as_array(ptr, (ln,)).copy() \
-                        if ln else np.empty((0,), np.float32)
-                else:
-                    ptr = self._lib.datafeed_slot_int(self._h, i)
-                    vals = np.ctypeslib.as_array(ptr, (ln,)).copy() \
-                        if ln else np.empty((0,), np.int64)
-                lod_len = self._lib.datafeed_slot_lod_len(self._h, i)
-                lod_ptr = self._lib.datafeed_slot_lod(self._h, i)
-                lod = np.ctypeslib.as_array(lod_ptr, (lod_len,)).copy()
-                out[name] = (vals, lod)
-            yield out
+        # the native cursor and batch buffers are shared per feed: two live
+        # iterators would silently interleave and corrupt each other's
+        # batch stream (e.g. zip(feed, feed), or an eval pass inside an
+        # epoch) — refuse instead
+        if self._iterating:
+            raise RuntimeError(
+                "MultiSlotDataFeed supports one live iterator at a time; "
+                "finish (or discard) the previous epoch's iterator first")
+        self._iterating = True
+        try:
+            self._lib.datafeed_reset(self._h, self._seed + self._epoch)
+            self._epoch += 1
+            while True:
+                n = self._lib.datafeed_next(self._h)
+                if n <= 0:
+                    return
+                out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+                for i, (name, kind) in enumerate(self._slots):
+                    ln = self._lib.datafeed_slot_len(self._h, i)
+                    if kind == "float":
+                        ptr = self._lib.datafeed_slot_float(self._h, i)
+                        vals = np.ctypeslib.as_array(ptr, (ln,)).copy() \
+                            if ln else np.empty((0,), np.float32)
+                    else:
+                        ptr = self._lib.datafeed_slot_int(self._h, i)
+                        vals = np.ctypeslib.as_array(ptr, (ln,)).copy() \
+                            if ln else np.empty((0,), np.int64)
+                    lod_len = self._lib.datafeed_slot_lod_len(self._h, i)
+                    lod_ptr = self._lib.datafeed_slot_lod(self._h, i)
+                    lod = np.ctypeslib.as_array(lod_ptr, (lod_len,)).copy()
+                    out[name] = (vals, lod)
+                yield out
+        finally:
+            self._iterating = False
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -312,6 +346,11 @@ def load_tensors(path: str) -> Dict[str, np.ndarray]:
         raise RuntimeError(f"native runtime unavailable: {_load_error}")
     h = lib.tstore_reader_open(os.fsencode(path))
     if not h:
+        # corrupt-but-present must not masquerade as missing: the auto
+        # checkpoint restore path treats FileNotFoundError as "no
+        # checkpoint yet" and would silently start from scratch
+        if lib.tstore_last_error() == 2:
+            raise ValueError(f"corrupt/truncated tensor store {path}")
         raise FileNotFoundError(f"cannot open tensor store {path}")
     out: Dict[str, np.ndarray] = {}
     try:

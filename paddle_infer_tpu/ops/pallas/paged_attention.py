@@ -236,6 +236,18 @@ class PagedKVCache:
         if layer == 0:
             for i, sid in enumerate(seq_ids):
                 self.reserve(sid, int(positions[i]) + 1)
+                # after pool.fork (beam search) the last page may be shared
+                # with the parent; writing into it would corrupt the
+                # parent's cache — copy-on-write it first, mirroring the
+                # page across every layer's pools
+                cow = self.pool.cow_last_block(sid)
+                if cow is not None:
+                    src, dst = cow
+                    for lyr in range(self.num_layers):
+                        self.k_pages[lyr] = self.k_pages[lyr].at[dst].set(
+                            self.k_pages[lyr][src])
+                        self.v_pages[lyr] = self.v_pages[lyr].at[dst].set(
+                            self.v_pages[lyr][src])
             self._tables_cache = (tuple(seq_ids),
                                   self.tables_for(seq_ids))
         tables, _ = self._cached_tables(seq_ids)

@@ -154,13 +154,14 @@ def param_partition_spec(name: str, arr, dist_attr, strategy,
     ("sharding" axis) on the first free divisible dim when stage 3."""
     ndim = arr.ndim
     spec = list(_pad_spec(dist_attr, ndim))
-    if strategy and strategy.sharding_stage >= 3:
+    # rank-1 params (biases, LN scales) stay replicated: their memory is
+    # negligible and forcing "sharding" onto them makes GSPMD propagate a
+    # transposed tile assignment up the grad-reduce chain (involuntary full
+    # rematerialization of the activation grads)
+    if strategy and strategy.sharding_stage >= 3 and ndim >= 2:
         size = mesh.shape.get("sharding", 1)
-        if size > 1:
-            for d in range(ndim):
-                if spec[d] is None and arr.shape[d] % size == 0:
-                    spec[d] = "sharding"
-                    break
+        if size > 1 and spec[0] is None and arr.shape[0] % size == 0:
+            spec[0] = "sharding"      # dim-0 only, like the grad pin
     return P(*spec)
 
 
@@ -234,12 +235,11 @@ class FleetTrainStep:
                 return P()
             if slot_arr.shape == self.params[pname].shape:
                 spec = list(_pad_spec(tuple(pspec), slot_arr.ndim))
-                if stage >= 1 and stage < 3 and shard_size > 1:
-                    for d in range(slot_arr.ndim):
-                        if spec[d] is None and \
-                                slot_arr.shape[d] % shard_size == 0:
-                            spec[d] = "sharding"
-                            break
+                # rank>=2, dim-0 only — see param_partition_spec
+                if stage >= 1 and stage < 3 and shard_size > 1 \
+                        and slot_arr.ndim >= 2 and spec[0] is None \
+                        and slot_arr.shape[0] % shard_size == 0:
+                    spec[0] = "sharding"
                 return P(*spec)
             return P()
 
@@ -318,12 +318,19 @@ class FleetTrainStep:
                 return grads
 
             def pin(g, pspec):
+                # Constrain only rank>=2 grads, and only on dim 0: rank-1
+                # grads and inner-dim pins (e.g. the hidden dim of a
+                # vocab-parallel embedding grad) save ~no memory but force
+                # GSPMD to reshard the full activation-grad feeding the
+                # reduce/scatter — the "involuntary full rematerialization"
+                # path.  Dim-0 reduce-scatter is the layout XLA can emit
+                # directly from the grad dot/scatter.
                 spec = list(_pad_spec(tuple(pspec), g.ndim))
                 if "sharding" not in spec:
-                    for d in range(g.ndim):
-                        if spec[d] is None and g.shape[d] % shard_size == 0:
-                            spec[d] = "sharding"
-                            break
+                    if g.ndim < 2 or spec[0] is not None \
+                            or g.shape[0] % shard_size != 0:
+                        return g
+                    spec[0] = "sharding"
                 return jax.lax.with_sharding_constraint(
                     g, _named_sharding(mesh, P(*spec)))
 

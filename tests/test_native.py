@@ -99,6 +99,25 @@ class TestDataFeed:
         with pytest.raises(ValueError):
             native.MultiSlotDataFeed([str(bad)], [("ids", "int")])
 
+    def test_absurd_count_rejected_not_bad_alloc(self, tmp_path):
+        # a record claiming ~1e11 values must hit the bad-record error
+        # path, not throw std::bad_alloc across the C boundary (SIGABRT)
+        bad = tmp_path / "absurd.txt"
+        bad.write_text("99999999999 1\n")
+        with pytest.raises(ValueError):
+            native.MultiSlotDataFeed([str(bad)], [("ids", "int")])
+
+    def test_single_live_iterator_enforced(self, slot_files):
+        files, _ = slot_files
+        feed = native.MultiSlotDataFeed(
+            files, [("ids", "int"), ("feat", "float")], batch_size=8)
+        it1 = iter(feed)
+        next(it1)
+        with pytest.raises(RuntimeError):
+            next(iter(feed))          # second live iterator: refused
+        it1.close()
+        assert next(iter(feed))       # released: iteration works again
+
 
 class TestKVBlockPool:
     def test_reserve_and_table(self):
@@ -213,7 +232,31 @@ class TestTensorStore:
             native.load_tensors("/nonexistent/x.pits")
 
     def test_corrupt_file(self, tmp_path):
+        # corruption must NOT look like a missing file (a resume path
+        # treats FileNotFoundError as "no checkpoint yet")
         p = tmp_path / "junk.pits"
         p.write_bytes(b"NOTAPITSFILE" + b"\x00" * 64)
-        with pytest.raises(FileNotFoundError):
+        with pytest.raises(ValueError):
+            native.load_tensors(str(p))
+
+    def test_corrupt_huge_ndim_fails_fast(self, tmp_path):
+        # a truncated header claiming ndim ~2^31 must hit the corrupt
+        # path immediately, not attempt a multi-GB allocation
+        import struct
+
+        p = tmp_path / "huge.pits"
+        p.write_bytes(b"PITS" + struct.pack("<II", 1, 1)
+                      + struct.pack("<I", 1) + b"x"        # name "x"
+                      + struct.pack("<I", 0)               # dtype
+                      + struct.pack("<I", 2**31 - 1))      # absurd ndim
+        with pytest.raises(ValueError):
+            native.load_tensors(str(p))
+
+    def test_corrupt_huge_count_fails_fast(self, tmp_path):
+        import struct
+
+        p = tmp_path / "hugecount.pits"
+        p.write_bytes(b"PITS" + struct.pack("<II", 1, 2**31 - 1)
+                      + b"\x00" * 16)
+        with pytest.raises(ValueError):
             native.load_tensors(str(p))

@@ -159,3 +159,49 @@ class TestPagedKVCache:
             out[0], _dense_ref(q[0], k1[0], v1[0], 4), rtol=2e-5, atol=2e-5)
         np.testing.assert_allclose(
             out[1], _dense_ref(q[1], k2[0], v2[0], 8), rtol=2e-5, atol=2e-5)
+
+    def test_fork_append_cow_preserves_parent(self):
+        """Appending to a forked child must copy-on-write the shared last
+        page, leaving the parent's cached KV intact (beam search)."""
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(7)
+        h, d, page = 2, 4, 4
+        cache = PagedKVCache(num_pages=8, page_size=page, num_heads=h,
+                             head_dim=d, dtype=jnp.float32)
+        # parent: 8-token prompt + 1 decode token -> last page half-full,
+        # then fork so that page is SHARED with the child
+        k0 = rng.randn(1, 8, h, d).astype(np.float32)
+        v0 = rng.randn(1, 8, h, d).astype(np.float32)
+        cache.prefill(0, [1], jnp.asarray(k0), jnp.asarray(v0))
+        k8 = rng.randn(1, h, d).astype(np.float32)
+        v8 = rng.randn(1, h, d).astype(np.float32)
+        cache.append(0, [1], jnp.asarray(k8), jnp.asarray(v8),
+                     np.array([8]))
+        cache.pool.fork(1, 2)
+
+        parent_k = np.asarray(cache.k_pages[0]).copy()
+        parent_tbl = cache.pool.block_table(1).tolist()
+        assert cache.pool.block_table(2).tolist() == parent_tbl  # shared
+
+        kt = rng.randn(1, h, d).astype(np.float32)
+        vt = rng.randn(1, h, d).astype(np.float32)
+        cache.append(0, [2], jnp.asarray(kt), jnp.asarray(vt),
+                     np.array([9]))
+        # CoW must have given the child a private last page
+        child_tbl = cache.pool.block_table(2).tolist()
+        assert child_tbl[:-1] == parent_tbl[:-1]
+        assert child_tbl[-1] != parent_tbl[-1]
+        # the parent's pages must be byte-identical after the child write
+        for p in parent_tbl:
+            np.testing.assert_array_equal(np.asarray(cache.k_pages[0])[p],
+                                          parent_k[p])
+        # and parent attention still sees only its own KV
+        q = rng.randn(1, h, d).astype(np.float32)
+        out = np.asarray(cache.attend(0, [1], jnp.asarray(q),
+                                      interpret=True))
+        dense_k = np.concatenate([k0[0], k8], axis=0)
+        dense_v = np.concatenate([v0[0], v8], axis=0)
+        np.testing.assert_allclose(
+            out[0], _dense_ref(q[0], dense_k, dense_v, 9), rtol=2e-5,
+            atol=2e-5)
