@@ -127,8 +127,14 @@ AMP_WHITE_OPS = {
     "flash_attention", "sdpa", "depthwise_conv2d", "addmm",
 }
 AMP_BLACK_OPS = {
+    # NB: softmax_with_cross_entropy is NOT here — its impl/grad do their
+    # own fp32 math internally while keeping the [N, V] tensors in the
+    # compute dtype (blacklisting it would force a full fp32 copy of the
+    # vocab-sized logits every step)
+    # layer_norm/rms_norm likewise do fp32 stats internally with dtype-
+    # preserving IO, so they are not blacklisted either
     "exp", "log", "softmax", "log_softmax", "cross_entropy",
-    "softmax_with_cross_entropy", "mean", "sum", "norm", "layer_norm",
+    "mean", "sum", "norm",
     "batch_norm", "cumsum", "pow", "rsqrt", "sigmoid_cross_entropy_with_logits",
     "erf", "logsumexp",
 }

@@ -2,8 +2,9 @@
 AnalysisConfig; the fork's fused_multi_transformer serving stack).  See
 predictor.py / config.py / generation.py."""
 from .config import Config, PrecisionType
-from .generation import GenerationConfig, GenerationEngine
+from .generation import (GenerationConfig, GenerationEngine,
+                         PagedGenerationEngine)
 from .predictor import Predictor, create_predictor
 
 __all__ = ["Config", "PrecisionType", "Predictor", "create_predictor",
-           "GenerationConfig", "GenerationEngine"]
+           "GenerationConfig", "GenerationEngine", "PagedGenerationEngine"]

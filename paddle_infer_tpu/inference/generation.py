@@ -136,18 +136,7 @@ class GenerationEngine:
                 [jnp.where(prompt_mask > 0, ids, -1),
                  jnp.full((batch, max_new), -1, jnp.int32)], axis=1)
 
-            def pick(logits_row, hist, step, key):
-                proc = sampling.process_logits(
-                    logits_row, temperature=g.temperature, top_k=g.top_k,
-                    top_p=g.top_p, token_history=hist,
-                    repetition_penalty=g.repetition_penalty,
-                    eos_token_id=g.eos_token_id, cur_len=step,
-                    min_length=g.min_length)
-                tok = sampling.sample_token(proc, key, g.do_sample)
-                logp = jax.nn.log_softmax(proc, axis=-1)
-                tok_logp = jnp.take_along_axis(
-                    logp, tok[:, None], axis=-1)[:, 0]
-                return tok, tok_logp
+            pick = self._logits_picker(g)
 
             k0, rng = jax.random.split(rng)
             tok, tok_logp = pick(last, hist0, 0, k0)
@@ -302,6 +291,26 @@ class GenerationEngine:
 
         return jax.jit(run)
 
+    # ---------------------------------------------------- shared sampling
+    def _logits_picker(self, g: GenerationConfig):
+        """process-logits + sample closure shared by the dense and paged
+        decode loops."""
+
+        def pick(logits_row, hist, step, key):
+            proc = sampling.process_logits(
+                logits_row, temperature=g.temperature, top_k=g.top_k,
+                top_p=g.top_p, token_history=hist,
+                repetition_penalty=g.repetition_penalty,
+                eos_token_id=g.eos_token_id, cur_len=step,
+                min_length=g.min_length)
+            tok = sampling.sample_token(proc, key, g.do_sample)
+            logp = jax.nn.log_softmax(proc, axis=-1)
+            tok_logp = jnp.take_along_axis(
+                logp, tok[:, None], axis=-1)[:, 0]
+            return tok, tok_logp
+
+        return pick
+
     # ------------------------------------------------------------- public
     def generate(self, input_ids, generation_config: GenerationConfig = None,
                  attention_mask=None, return_scores: bool = False):
@@ -370,5 +379,212 @@ class GenerationEngine:
         rng = jax.random.PRNGKey(g.seed)
         out = fn(self._params, jnp.asarray(ids), jnp.asarray(mask), rng)
         seq, score = out
+        seq = np.asarray(seq)
+        return (seq, np.asarray(score)) if return_scores else seq
+
+
+class PagedGenerationEngine(GenerationEngine):
+    """Generation over a PAGED KV cache — the serving design the dense
+    engine's docstring argues against static CacheKV buffers for.
+
+    Reference semantics: fused_multi_transformer's CacheKV append + MMHA
+    decode (fused_multi_transformer_op.cc:103-119), re-designed as a
+    shared physical page pool [P, h, page, d] whose per-sequence page
+    tables come from the native block allocator (native/kv_allocator.cc)
+    and whose decode step is the Pallas paged-attention kernel
+    (ops/pallas/paged_attention.py) — PAPERS.md ragged-paged-attention.
+
+    Differences from the dense engine:
+      * prompts are RIGHT-padded: real tokens sit at positions 0..len-1 so
+        causal prefill never attends to pads and the decode kernel masks
+        by true per-row length — no additive pad mask at all;
+      * KV memory is allocated in pages by the native pool, so memory
+        scales with actual tokens (rounded to a page), not with the
+        bucketed max length, and sequences can share/CoW pages (beam
+        forks use KVBlockPool.fork).
+    Beam search currently falls back to the dense-cache path.
+    """
+
+    def __init__(self, model, page_size: int = 16,
+                 num_pages: Optional[int] = None, prompt_bucket: int = 64,
+                 cache_dtype=None):
+        super().__init__(model, cache_bucket=page_size,
+                         prompt_bucket=prompt_bucket,
+                         cache_dtype=cache_dtype)
+        self.page_size = page_size
+        self._requested_pages = num_pages
+        self._pool = None
+        # persistent per-layer device pools [P, h, page, d]; donated into
+        # every compiled call and rebound from its outputs, so the arrays
+        # genuinely stay put in HBM across requests
+        self._k_pages = None
+        self._v_pages = None
+
+    # ----------------------------------------------------------- plumbing
+    def _ensure_pool(self, need_pages: int):
+        from .. import native
+
+        want = max(need_pages, self._requested_pages or 0)
+        if self._pool is None or self._pool.num_blocks < want:
+            self._pool = native.KVBlockPool(want, self.page_size)
+            self._k_pages = self._v_pages = None     # resize device pools
+        return self._pool
+
+    def _ensure_pages(self):
+        pshape = (self._pool.num_blocks, self._num_heads, self.page_size,
+                  self._head_dim)
+        if self._k_pages is None or self._k_pages[0].shape != pshape:
+            self._k_pages = [jnp.zeros(pshape, self._cache_dtype)
+                             for _ in range(self._num_layers)]
+            self._v_pages = [jnp.zeros(pshape, self._cache_dtype)
+                             for _ in range(self._num_layers)]
+        return self._k_pages, self._v_pages
+
+    def _build_paged(self, batch, plen, g: GenerationConfig):
+        max_new = g.max_new_tokens
+        L = self._num_layers
+
+        def run(params, ids, lengths, tables, k_pages, v_pages, rng):
+            zero_pos = jnp.zeros((batch,), jnp.int32)
+            caches = [(k_pages[i], v_pages[i], tables, zero_pos)
+                      for i in range(L)]
+            pos2d = jnp.broadcast_to(
+                jnp.arange(plen, dtype=jnp.int32)[None], (batch, plen))
+            logits, caches = self._model_step(params, ids, pos2d, None,
+                                              caches)
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+
+            out_buf = jnp.full((batch, max_new), g.pad_token_id, jnp.int32)
+            finished = jnp.zeros((batch,), jnp.bool_)
+            col = jnp.arange(plen, dtype=jnp.int32)[None]
+            hist0 = jnp.concatenate(
+                [jnp.where(col < lengths[:, None], ids, -1),
+                 jnp.full((batch, max_new), -1, jnp.int32)], axis=1)
+            pick = self._logits_picker(g)
+
+            k0, rng = jax.random.split(rng)
+            tok, tok_logp = pick(last, hist0, 0, k0)
+            if g.eos_token_id is not None:
+                finished = tok == g.eos_token_id
+            out_buf = out_buf.at[:, 0].set(tok)
+            hist0 = hist0.at[:, plen].set(tok)
+            cum = tok_logp
+
+            def set_positions(caches, pos):
+                return [(kp, vp, tb, pos) for kp, vp, tb, _ in caches]
+
+            def cond(state):
+                step, fin = state[0], state[3]
+                return jnp.logical_and(step < max_new,
+                                       jnp.logical_not(jnp.all(fin)))
+
+            def body(state):
+                step, tok, out, fin, hist, cum, caches, rng = state
+                # this step's token was sampled at per-row position
+                # lengths + step - 1; it lands in that page slot
+                pos = lengths + step - 1
+                caches = set_positions(caches, pos)
+                logits, caches = self._model_step(
+                    params, tok[:, None], pos[:, None], None, caches)
+                key, rng = jax.random.split(rng)
+                nxt, tok_logp = pick(logits[:, -1], hist, step, key)
+                if g.eos_token_id is not None:
+                    nxt = jnp.where(fin, g.pad_token_id, nxt)
+                    cum = jnp.where(fin, cum, cum + tok_logp)
+                    new_fin = jnp.logical_or(fin, nxt == g.eos_token_id)
+                else:
+                    cum = cum + tok_logp
+                    new_fin = fin
+                out = jax.lax.dynamic_update_slice(
+                    out, nxt[:, None], (jnp.zeros((), jnp.int32), step))
+                hist = jax.lax.dynamic_update_slice(
+                    hist, nxt[:, None],
+                    (jnp.zeros((), jnp.int32), plen + step))
+                return (step + 1, nxt, out, new_fin, hist, cum, caches, rng)
+
+            state = (jnp.asarray(1, jnp.int32), tok, out_buf, finished,
+                     hist0, cum, caches, rng)
+            state = jax.lax.while_loop(cond, body, state)
+            final_caches = state[6]
+            return (state[2], state[5],
+                    [c[0] for c in final_caches],
+                    [c[1] for c in final_caches])
+
+        # the page pools are donated: XLA updates them in place and the
+        # engine rebinds the returned arrays
+        return jax.jit(run, donate_argnums=(4, 5))
+
+    # ------------------------------------------------------------- public
+    def generate(self, input_ids, generation_config: GenerationConfig = None,
+                 attention_mask=None, return_scores: bool = False):
+        g = generation_config or GenerationConfig()
+        if g.num_beams > 1:
+            import warnings
+
+            warnings.warn(
+                "PagedGenerationEngine: beam search uses the dense-cache "
+                "path (paged beam fork is pool-level, KVBlockPool.fork)",
+                UserWarning)
+            return super().generate(input_ids, g, attention_mask,
+                                    return_scores)
+        self._params = {n: p._data
+                        for n, p in self._model.named_parameters()}
+        ids = np.asarray(input_ids._data if isinstance(input_ids, Tensor)
+                         else input_ids).astype(np.int32)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        b, plen_raw = ids.shape
+        mask = (np.ones_like(ids) if attention_mask is None
+                else np.asarray(attention_mask).astype(np.int32))
+        # canonicalize to RIGHT padding (see class docstring)
+        for i in range(b):
+            real = np.flatnonzero(mask[i])
+            row = ids[i, real]
+            ids[i] = g.pad_token_id
+            mask[i] = 0
+            ids[i, :len(real)] = row
+            mask[i, :len(real)] = 1
+        lengths = np.maximum(mask.sum(axis=1), 1).astype(np.int32)
+        assert plen_raw + g.max_new_tokens <= self._max_positions, (
+            f"prompt {plen_raw} + max_new {g.max_new_tokens} exceeds "
+            f"max_position_embeddings {self._max_positions}")
+        # prompt padded to a bucket AND a page multiple
+        plen = _round_up(max(plen_raw, 1), self._prompt_bucket)
+        plen = _round_up(min(plen, self._max_positions), self.page_size)
+        plen = max(plen, _round_up(plen_raw, self.page_size))
+        if plen > plen_raw:
+            ids = np.pad(ids, ((0, 0), (0, plen - plen_raw)),
+                         constant_values=g.pad_token_id)
+
+        pages_per_seq = -(-(plen + g.max_new_tokens) // self.page_size)
+        pool = self._ensure_pool(pages_per_seq * b)
+        seq_ids = list(range(b))
+        for s in seq_ids:
+            pool.free(s)
+            pool.reserve(s, plen + g.max_new_tokens)
+        tables = np.zeros((b, pages_per_seq), np.int32)
+        for i, s in enumerate(seq_ids):
+            t = pool.block_table(s)[:pages_per_seq]
+            tables[i, :len(t)] = t
+
+        k_pages, v_pages = self._ensure_pages()
+
+        key = ("paged", b, plen, pages_per_seq, pool.num_blocks,
+               g.cache_key())
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._build_paged(b, plen, g)
+            self._compiled[key] = fn
+        rng = jax.random.PRNGKey(g.seed)
+        # donated arrays are consumed even if the call fails — drop our
+        # references first and rebind from the outputs on success
+        self._k_pages = self._v_pages = None
+        seq, score, k_pages, v_pages = fn(
+            self._params, jnp.asarray(ids), jnp.asarray(lengths),
+            jnp.asarray(tables), k_pages, v_pages, rng)
+        self._k_pages, self._v_pages = k_pages, v_pages
+        for s in seq_ids:
+            pool.free(s)
         seq = np.asarray(seq)
         return (seq, np.asarray(score)) if return_scores else seq

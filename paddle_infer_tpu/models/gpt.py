@@ -131,20 +131,27 @@ class GPTModel(Layer):
         if position_ids is None:
             import jax.numpy as jnp
 
-            if caches and len(caches[0]) == 3:
-                # static-cache decode: positions continue after the traced
-                # write index (inference/generation.py loop)
-                past = caches[0][2]
+            if caches and len(caches[0]) == 4:
+                # paged cache: per-row positions [b] from the page cursor
+                pos_rows = caches[0][3]
                 arange = Tensor(jnp.arange(s, dtype=jnp.int32))
-                position_ids = arange + past
+                position_ids = D("unsqueeze", pos_rows, axis=1) + arange
+                pos = self.position_embeddings(position_ids)  # [b, s, H]
             else:
-                # growing cache: positions continue after the cached prefix
-                # (cache layout [b, s_past, h, d], static under trace)
-                past = caches[0][0].shape[1] if caches else 0
-                position_ids = Tensor(
-                    jnp.arange(past, past + s, dtype=jnp.int32))
-            pos = D("unsqueeze", self.position_embeddings(position_ids),
-                    axis=0)
+                if caches and len(caches[0]) == 3:
+                    # static-cache decode: positions continue after the
+                    # traced write index (inference/generation.py loop)
+                    past = caches[0][2]
+                    arange = Tensor(jnp.arange(s, dtype=jnp.int32))
+                    position_ids = arange + past
+                else:
+                    # growing cache: positions continue after the cached
+                    # prefix (cache [b, s_past, h, d], static under trace)
+                    past = caches[0][0].shape[1] if caches else 0
+                    position_ids = Tensor(
+                        jnp.arange(past, past + s, dtype=jnp.int32))
+                pos = D("unsqueeze", self.position_embeddings(position_ids),
+                        axis=0)
         else:
             pos = self.position_embeddings(position_ids)
         x = self.dropout(x + pos)

@@ -12,11 +12,11 @@ def masked_lm_loss(logits, labels, ignore_index=-100):
     ernie/gpt pretrain losses mask padded/unmasked positions before the
     mean; epsilon keeps the all-masked batch finite).
     """
-    vocab = logits.shape[-1]
-    flat_logits = D("reshape", logits, shape=(-1, vocab))
-    flat_labels = D("reshape", labels, shape=(-1,))
-    loss = F.cross_entropy(flat_logits, flat_labels, reduction="none",
+    # CE directly on [b, s, V] — flattening to [b*s, V] first forces a
+    # whole-logits layout copy (the head matmul emits a vocab-major layout
+    # that the 2-D reshape cannot alias)
+    loss = F.cross_entropy(logits, labels, reduction="none",
                            ignore_index=ignore_index)
-    valid = D("cast", D("not_equal", flat_labels, ignore_index),
+    valid = D("cast", D("not_equal", labels, ignore_index),
               dtype="float32")
     return (loss * valid).sum() / (valid.sum() + 1e-6)

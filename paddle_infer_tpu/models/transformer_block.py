@@ -54,6 +54,8 @@ class ParallelSelfAttention(Layer):
         qkv = D("reshape", qkv, shape=(b, s, 3, self.num_heads,
                                        self.head_dim))
         q, k, v = D("unstack", qkv, axis=2)
+        if cache is not None and len(cache) == 4:
+            return self._forward_paged(x, q, k, v, cache, attn_mask)
         static_cache = cache is not None and len(cache) == 3
         if static_cache:
             # decode path: fixed-length buffers [b, max_len, h, d] + traced
@@ -102,6 +104,45 @@ class ParallelSelfAttention(Layer):
         if cache is not None:
             return out, (k, v)
         return out
+
+    def _forward_paged(self, x, q, k, v, cache, attn_mask):
+        """Paged-KV serving path (reference CacheKV semantics re-designed
+        as a shared page pool, fused_multi_transformer_op.cc:103-119 +
+        native/kv_allocator.cc): ``cache`` is
+        ``(k_pages [P,h,page,d], v_pages, block_tables [b,max_pages],
+        positions [b])`` where ``positions`` counts tokens already cached
+        per row.  Prompt chunks (s > 1) scatter into pages and attend
+        causally over themselves (right-padded batches: real tokens never
+        see pads under causality); decode steps (s == 1) append one token
+        at its per-row position and walk the page table with the Pallas
+        decode kernel."""
+        from ..core.tensor import Tensor
+        from ..ops.pallas import paged_attention as PA
+
+        b, s = x.shape[0], x.shape[1]
+        k_pages, v_pages, tables, positions = (c._data for c in cache)
+        if s > 1:
+            # prefill: pages for slots 0..s-1 (s % page_size == 0, padded
+            # by the engine); garbage in pad slots is masked by `lengths`
+            # at every later read
+            k_pages = PA.write_prompt_pages(k_pages, tables, k._data)
+            v_pages = PA.write_prompt_pages(v_pages, tables, v._data)
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, dropout_p=0.0, is_causal=True)
+            new_pos = positions + s
+        else:
+            k_pages = PA.write_token_page(k_pages, tables, k._data[:, 0],
+                                          positions)
+            v_pages = PA.write_token_page(v_pages, tables, v._data[:, 0],
+                                          positions)
+            o = PA.paged_attention_decode(q._data[:, 0], k_pages, v_pages,
+                                          tables, positions + 1)
+            out = Tensor(o[:, None])         # [b, 1, h, d]
+            new_pos = positions + 1
+        out = D("reshape", out, shape=(b, s, self.hidden))
+        out = self.out_proj(out)
+        return out, (Tensor(k_pages), Tensor(v_pages), Tensor(tables),
+                     Tensor(new_pos))
 
 
 class ParallelMLP(Layer):

@@ -236,24 +236,11 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
-    mean = D("mean", x, axis=axes, keepdim=True)
-    diff = D("subtract", x, mean)
-    var = D("mean", D("multiply", diff, diff), axis=axes, keepdim=True)
-    inv = D("rsqrt", D("add", var, epsilon))
-    out = D("multiply", diff, inv)
-    if weight is not None:
-        out = D("multiply", out, weight)
-    if bias is not None:
-        out = D("add", out, bias)
-    return out
+    return D("layer_norm", x, weight, bias, epsilon=epsilon, axes=axes)
 
 
 def rms_norm(x, weight=None, epsilon=1e-6):
-    var = D("mean", D("multiply", x, x), axis=-1, keepdim=True)
-    out = D("multiply", x, D("rsqrt", D("add", var, epsilon)))
-    if weight is not None:
-        out = D("multiply", out, weight)
-    return out
+    return D("rms_norm", x, weight, epsilon=epsilon)
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,

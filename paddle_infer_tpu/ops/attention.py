@@ -20,19 +20,35 @@ import jax.numpy as jnp
 from ..core.dispatch import register_op, register_vjp_grad
 
 
-def _use_pallas(q, k, mask):
-    """Pallas flash kernel is profitable for long seqs on real TPU."""
+def _attn_impl_choice(q, k, mask):
+    """Pick the attention implementation for this shape.
+
+    Measured on v5e at transformer-base shapes (see
+    ops/pallas/flash_attention.py): the fused XLA computation wins the
+    forward below ~4k seq, the Pallas backward always beats XLA's
+    transpose, and beyond ~4k the pure-Pallas kernel must take over
+    because the XLA forward's O(s^2) logits dominate HBM.
+
+      "xla"    — short seqs / arbitrary masks / non-TPU
+      "hybrid" — XLA fwd + Pallas bwd (training sweet spot, >= 512)
+      "flash"  — pure Pallas fwd+bwd (long seqs, >= 4096)
+    """
     if mask is not None:          # arbitrary masks stay on the XLA path
-        return False
+        return "xla"
     try:
         if jax.default_backend() != "tpu":
-            return False
+            return "xla"
     except Exception:
-        return False
+        return "xla"
     b, s, h, d = q.shape
     sk = k.shape[1]
-    return (s >= 1024 and d in (64, 128, 256) and s % 128 == 0
-            and sk % 128 == 0)
+    if d not in (64, 128, 256) or s % 128 or sk % 128:
+        return "xla"
+    if s >= 4096:
+        return "flash"
+    if s >= 512:
+        return "hybrid"
+    return "xla"
 
 
 def _xla_sdpa(q, k, v, mask, key, dropout_p, is_causal, scale):
@@ -72,12 +88,17 @@ _pallas_fallback_warned = False
 @register_op("sdpa")
 def _sdpa(q, k, v, mask=None, key=None, dropout_p=0.0, is_causal=False,
           scale=None):
-    if dropout_p == 0.0 and _use_pallas(q, k, mask):
-        from .pallas.flash_attention import flash_attention as _flash
+    impl = "xla" if dropout_p != 0.0 else _attn_impl_choice(q, k, mask)
+    if impl != "xla":
+        from .pallas.flash_attention import (flash_attention,
+                                             hybrid_attention)
 
+        fn = flash_attention if impl == "flash" else hybrid_attention
         try:
-            return _flash(q, k, v, mask=mask, is_causal=is_causal,
+            if impl == "flash":
+                return fn(q, k, v, mask=mask, is_causal=is_causal,
                           scale=scale)
+            return fn(q, k, v, is_causal=is_causal, scale=scale)
         except Exception as e:   # pragma: no cover - TPU-only path
             global _pallas_fallback_warned
             if not _pallas_fallback_warned:
@@ -85,9 +106,9 @@ def _sdpa(q, k, v, mask=None, key=None, dropout_p=0.0, is_causal=False,
                 import warnings
 
                 warnings.warn(
-                    f"pallas flash attention failed ({e!r}); falling back "
-                    "to the O(s^2) XLA path — perf/memory cliff at long "
-                    "seq", RuntimeWarning)
+                    f"pallas attention ({impl}) failed ({e!r}); falling "
+                    "back to the O(s^2) XLA path — perf/memory cliff at "
+                    "long seq", RuntimeWarning)
     return _xla_sdpa(q, k, v, mask, key, dropout_p, is_causal, scale)
 
 

@@ -16,44 +16,60 @@ from ..core.dispatch import (defop, dispatch, register_grad, register_op,
 
 @register_op("softmax_with_cross_entropy")
 def _softmax_ce(logits, label, soft_label=False, ignore_index=-100, axis=-1):
-    lse = jax.scipy.special.logsumexp(logits, axis=axis, keepdims=True)
-    log_probs = logits - lse
+    # loss = lse - logit[label]: gather BEFORE the subtract so the full
+    # [N, V] log-prob tensor is never materialised (at ERNIE's 40k vocab
+    # that intermediate alone is GBs of HBM traffic per step); lse reduces
+    # in fp32 for stability while the logits stay in their compute dtype
+    lse = jax.scipy.special.logsumexp(
+        logits.astype(jnp.float32), axis=axis, keepdims=True)
     if soft_label:
-        loss = -jnp.sum(label * log_probs, axis=axis, keepdims=True)
-    else:
-        lbl = label
-        if lbl.ndim == logits.ndim:
-            lbl = jnp.squeeze(lbl, axis=axis)
-        picked = jnp.take_along_axis(log_probs, lbl[..., None].astype(jnp.int32),
-                                     axis=axis)
-        loss = -picked
-        mask = (lbl[..., None] != ignore_index)
-        loss = jnp.where(mask, loss, 0.0)
-    return loss
+        # soft labels need the full weighted sum; single fused pass
+        picked = jnp.sum(label.astype(jnp.float32)
+                         * logits.astype(jnp.float32), axis=axis,
+                         keepdims=True)
+        return lse - picked
+    lbl = label
+    if lbl.ndim == logits.ndim:
+        lbl = jnp.squeeze(lbl, axis=axis)
+    picked = jnp.take_along_axis(
+        logits, lbl[..., None].astype(jnp.int32), axis=axis)
+    loss = lse - picked.astype(jnp.float32)
+    mask = (lbl[..., None] != ignore_index)
+    return jnp.where(mask, loss, 0.0)
 
 
 @register_grad("softmax_with_cross_entropy")
 def _softmax_ce_grad(ctx, g):
+    """softmax − onehot, computed in fp32 on the fly but EMITTED in the
+    logits dtype: the [N, V] softmax is never stored in fp32 (XLA fuses the
+    exp/normalize into the output pass) and, critically, the huge
+    vocab-projection backward matmuls downstream consume a bf16 dlogits
+    instead of an accidentally-promoted fp32 one.  Uses raw jnp (no
+    higher-order grad through this rule — same contract as vjp-registered
+    ops)."""
+    from ..core.tensor import Tensor
+
     logits, label = ctx.inputs
     axis = ctx.attrs.get("axis", -1)
     soft_label = ctx.attrs.get("soft_label", False)
     ignore_index = ctx.attrs.get("ignore_index", -100)
-    sm = dispatch("softmax", logits, axis=axis)
+    x = logits._data
+    xf = x.astype(jnp.float32)
+    m = jnp.max(xf, axis=axis, keepdims=True)
+    e = jnp.exp(xf - m)
+    sm = e / jnp.sum(e, axis=axis, keepdims=True)
+    garr = g._data.astype(jnp.float32)
     if soft_label:
-        grad_logits = dispatch("subtract", sm, label)
+        grad = (sm - label._data.astype(jnp.float32)) * garr
     else:
-        lbl = label
-        if lbl.ndim == logits.ndim:
-            lbl = dispatch("squeeze", lbl, axis=axis)
-        onehot = dispatch("one_hot", lbl, num_classes=logits.shape[axis],
-                          dtype=str(sm.dtype))
-        grad_logits = dispatch("subtract", sm, onehot)
-        mask = dispatch("cast",
-                        dispatch("not_equal", lbl, _const_like(lbl, ignore_index)),
-                        dtype=str(sm.dtype))
-        grad_logits = dispatch("multiply", grad_logits,
-                               dispatch("unsqueeze", mask, axis=axis))
-    return dispatch("multiply", grad_logits, g), None
+        lbl = label._data
+        if lbl.ndim == x.ndim:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        onehot = jax.nn.one_hot(lbl, x.shape[axis], axis=axis,
+                                dtype=jnp.float32)
+        valid = jnp.expand_dims(lbl != ignore_index, axis=axis)
+        grad = jnp.where(valid, (sm - onehot) * garr, 0.0)
+    return Tensor(grad.astype(x.dtype)), None
 
 
 def _const_like(t, v):

@@ -30,6 +30,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 LANES = 128
+# HBM-stored per-row stats (lse, delta) only need a narrow lane tile; 128
+# lanes would write/read 16x the bytes for the same information
+STAT_LANES = 8
 NEG_INF = -1e30
 
 
@@ -38,6 +41,18 @@ def _interpret() -> bool:
         return jax.default_backend() != "tpu"
     except Exception:
         return True
+
+
+
+
+def _fit_block(requested: int, seq: int) -> int:
+    """Largest tile-aligned block <= requested that divides seq (so e.g.
+    seq 4224 = 33*128 gets block 128 instead of a ValueError + silent XLA
+    fallback). Steps by 128 down to 128, then by 8 (sublane tile)."""
+    b = min(requested, seq)
+    while b > 8 and seq % b:
+        b -= 128 if b > 128 else 8
+    return max(b, 1)
 
 
 # ---------------------------------------------------------------- forward
@@ -111,11 +126,12 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, STAT_LANES),
+                         lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, STAT_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -234,10 +250,11 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     nq, nk = sq // block_q, sk // block_k
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)               # (bh, sq, 1)
-    delta = jnp.broadcast_to(delta, (bh, sq, LANES))
+    delta = jnp.broadcast_to(delta, (bh, sq, STAT_LANES))
 
     q_spec_q = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
-    stat_spec_q = pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, j, 0))
+    stat_spec_q = pl.BlockSpec((1, block_q, STAT_LANES),
+                               lambda b, i, j: (b, j, 0))
     kv_spec_k = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
 
     dk, dv = pl.pallas_call(
@@ -256,7 +273,8 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_q, block_k,
     )(q, k, v, do, lse, delta)
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    stat_spec = pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0))
+    stat_spec = pl.BlockSpec((1, block_q, STAT_LANES),
+                             lambda b, i, j: (b, i, 0))
     kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -295,17 +313,19 @@ _flash3.defvjp(_flash3_fwd, _flash3_bwd)
 
 
 def flash_attention(q, k, v, mask=None, is_causal=False, scale=None,
-                    block_q=128, block_k=128, interpret=None):
+                    block_q=512, block_k=512, interpret=None):
     """Flash attention in (batch, seq, heads, head_dim) layout.
 
     ``mask`` is not supported by the kernel (the XLA sdpa path in
     ops/attention.py handles arbitrary masks); seq lengths must divide the
-    block sizes.
+    block sizes (block sizes are clamped to the seq lengths first).
     """
     if mask is not None:
         raise NotImplementedError("pallas flash kernel: mask unsupported")
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
     if sq % block_q or sk % block_k:
         raise ValueError(f"seq ({sq},{sk}) must divide blocks "
                          f"({block_q},{block_k})")
@@ -317,5 +337,82 @@ def flash_attention(q, k, v, mask=None, is_causal=False, scale=None,
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
 
     o = _flash3(fold(q), fold(k), fold(v), bool(is_causal), float(scale),
+                int(block_q), int(block_k), bool(interpret))
+    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+# --------------------------------------------- hybrid: XLA fwd + Pallas bwd
+#
+# Measured on v5e at ERNIE-base shapes (b=32, h=12, d=64, s=512, bf16): the
+# fused XLA forward (one HBM round-trip of the [s, s] logits) beats this
+# kernel's forward (1.71ms vs 2.19ms), while the Pallas backward beats XLA's
+# transpose (which materialises several [s, s] tensors).  So the fastest
+# full training step pairs them: XLA forward that also emits the LSE, Pallas
+# dKdV/dQ backward that recomputes P per tile from that LSE.
+
+def _xla_fwd_with_lse(q, k, v, causal, scale):
+    """Fused XLA attention forward returning (o, lse) in folded
+    (bh, s, d) / (bh, sq) layout; lse is broadcast to LANES like _fwd's."""
+    logits = jax.lax.dot_general(
+        q, k, (((2,), (2,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32) * scale        # (bh, sq, sk)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        logits = jnp.where(rows + (sk - sq) >= cols, logits, NEG_INF)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(
+        (p / l).astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.float32).astype(q.dtype)
+    lse = (m + jnp.log(l))[..., 0]                          # (bh, sq)
+    return o, jnp.broadcast_to(lse[..., None], lse.shape + (STAT_LANES,))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _hybrid(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _xla_fwd_with_lse(q, k, v, causal, scale)
+    return o
+
+
+def _hybrid_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _xla_fwd_with_lse(q, k, v, causal, scale)
+    return o, (q, k, v, o, lse)
+
+
+def _hybrid_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _bwd_impl(q, k, v, o, lse, do, causal, scale, block_q, block_k,
+                     interpret)
+
+
+_hybrid.defvjp(_hybrid_fwd, _hybrid_bwd)
+
+
+def hybrid_attention(q, k, v, is_causal=False, scale=None,
+                     block_q=512, block_k=512, interpret=None):
+    """XLA-forward / Pallas-backward attention, (b, s, h, d) layout.
+
+    The training-path default on TPU for moderate sequence lengths (the
+    pure-Pallas ``flash_attention`` takes over where the O(s^2) logits of
+    the forward would blow HBM).
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    block_q = _fit_block(block_q, sq)
+    block_k = _fit_block(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq ({sq},{sk}) must divide blocks "
+                         f"({block_q},{block_k})")
+    if interpret is None:
+        interpret = _interpret()
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    o = _hybrid(fold(q), fold(k), fold(v), bool(is_causal), float(scale),
                 int(block_q), int(block_k), bool(interpret))
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

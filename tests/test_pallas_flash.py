@@ -85,3 +85,61 @@ def test_sdpa_op_integration():
     ref = flash_attention(q, k, v, is_causal=True, interpret=True)
     np.testing.assert_allclose(np.asarray(out.numpy()), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_hybrid_forward_matches_xla(causal):
+    from paddle_infer_tpu.ops.pallas.flash_attention import hybrid_attention
+
+    q, k, v = _make(2, 256, 4, 64, jnp.float32)
+    out = hybrid_attention(q, k, v, is_causal=causal, interpret=True)
+    ref = _xla_sdpa(q, k, v, None, None, 0.0, causal, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_hybrid_grads_match_xla(causal):
+    from paddle_infer_tpu.ops.pallas.flash_attention import hybrid_attention
+
+    q, k, v = _make(1, 128, 2, 64, jnp.float32, seed=3)
+    co = jnp.asarray(np.random.RandomState(5)
+                     .randn(*q.shape).astype(np.float32))
+
+    def loss_h(q, k, v):
+        return jnp.sum(hybrid_attention(q, k, v, is_causal=causal,
+                                        interpret=True) * co)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_xla_sdpa(q, k, v, None, None, 0.0, causal, None)
+                       * co)
+
+    gh = jax.grad(loss_h, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gh, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5, err_msg=name)
+
+
+def test_hybrid_cross_attention_causal_offset():
+    """sq != sk (decode-style): causal offset must match the XLA path."""
+    from paddle_infer_tpu.ops.pallas.flash_attention import hybrid_attention
+
+    q, _, _ = _make(1, 128, 2, 64, jnp.float32, seed=7)
+    _, k, v = _make(1, 256, 2, 64, jnp.float32, seed=8)
+    out = hybrid_attention(q, k, v, is_causal=True, interpret=True)
+    ref = _xla_sdpa(q, k, v, None, None, 0.0, True, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_fit_block_divides_odd_multiples_of_128():
+    from paddle_infer_tpu.ops.pallas.flash_attention import _fit_block
+
+    # 4224 = 33*128: 512 does not divide it — must not raise downstream
+    for req, s in [(512, 4224), (512, 1024), (512, 384), (512, 136),
+                   (128, 64), (512, 1152), (512, 4864)]:
+        b = _fit_block(req, s)
+        assert b <= max(req, 1) and s % b == 0, (req, s, b)
+        assert b % 8 == 0 or b == s, (req, s, b)   # tile-aligned
+    assert _fit_block(512, 1024) == 512
