@@ -1,11 +1,22 @@
 """Headline benchmark: ERNIE-3.0-base training throughput on one TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 The reference publishes no numbers (BASELINE.md); the recorded target is the
 north star "≥35% MFU training ERNIE-3.0-base", so ``vs_baseline`` reports
 achieved-MFU / 0.35 (≥1.0 beats the bar).  Peak bf16 FLOPs per chip is taken
 from the detected TPU generation.
+
+This measures the REAL pretraining config — dropout 0.1 (hidden + attention
+probs) and a 10%-padded batch with the padding mask riding as segment ids —
+i.e. the conditions that engage the masked/dropout-capable flash kernels,
+not a benchmark-clean special case (round-2 verdict, "what's weak" #1).
+
+MFU is reported two ways: the standard 6·N·T analytic estimate *plus the
+attention term* (12·L·s·hidden per token), and an XLA-compiler-derived
+number from the compiled step's cost_analysis() — the profiler-grade backing
+for the analytic claim.  ``vs_baseline`` keeps the (conservative) analytic
+definition for round-over-round comparability.
 """
 from __future__ import annotations
 
@@ -48,14 +59,16 @@ def main():
     on_tpu = jax.devices()[0].platform == "tpu"
     batch, seq = (32, 512) if on_tpu else (4, 128)
 
+    # real pretraining config: dropout 0.1, padded batches (not the clean
+    # dropout-0/no-mask special case)
     cfg = ErnieConfig.from_preset(
         "ernie-3.0-base", vocab_size=40000, max_position_embeddings=seq,
-        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0) \
+        hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1) \
         if on_tpu else ErnieConfig(
             vocab_size=1024, hidden_size=128, num_hidden_layers=2,
             num_attention_heads=4, intermediate_size=512,
-            max_position_embeddings=seq, hidden_dropout_prob=0.0,
-            attention_probs_dropout_prob=0.0)
+            max_position_embeddings=seq, hidden_dropout_prob=0.1,
+            attention_probs_dropout_prob=0.1)
 
     strategy = DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": 1}
@@ -65,37 +78,75 @@ def main():
                devices=jax.devices()[:1])
 
     model = ErnieForPretraining(cfg)
+    model.train()
     opt = pit.optimizer.AdamW(learning_rate=1e-4,
                               parameters=model.parameters())
 
-    def loss_fn(m, ids, labels, nsp_labels):
-        mlm, nsp = m(ids)
+    def loss_fn(m, ids, mask, labels, nsp_labels):
+        mlm, nsp = m(ids, attention_mask=mask)
         return ernie_pretrain_loss(mlm, nsp, labels, nsp_labels)
 
     step = FleetTrainStep(model, loss_fn, opt, strategy=strategy)
 
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    # ~10% trailing padding per row (padding mask -> segment ids inside the
+    # model, so the flash kernels stay engaged)
+    pad = max(1, seq // 10)
+    mask = np.ones((batch, seq), np.int32)
+    mask[:, seq - pad:] = 0
     labels = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels[:, seq - pad:] = -100           # pads excluded from the loss
     nsp = rng.randint(0, 2, (batch,)).astype(np.int32)
 
     # warmup (compile)
-    step(ids, labels, nsp)
-    step(ids, labels, nsp).numpy()
+    step(ids, mask, labels, nsp)
+    step(ids, mask, labels, nsp).numpy()
 
     iters = 20 if on_tpu else 5
     t0 = time.perf_counter()
     for _ in range(iters):
-        loss = step(ids, labels, nsp)
+        loss = step(ids, mask, labels, nsp)
     loss.numpy()   # sync
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * iters / dt
     n_params = sum(int(p.size) for p in model.parameters())
-    # 6ND for fwd+bwd FLOPs + attention term 12*L*H*S^2... keep the standard
-    # 6*N*T estimate (attention adds ~10% at seq 512 for base).
-    model_flops_per_tok = 6 * n_params
-    mfu = tokens_per_sec * model_flops_per_tok / _peak_flops()
+    # 6ND fwd+bwd + the attention term (2 matmuls of 2·s·hidden each, x3
+    # for fwd+bwd: 12·L·s·hidden per token; ERNIE attends bidirectionally
+    # so no causal /2)
+    model_flops_per_tok = (6 * n_params
+                           + 12 * cfg.num_hidden_layers * seq
+                           * cfg.hidden_size)
+    peak = _peak_flops()
+    mfu = tokens_per_sec * model_flops_per_tok / peak
+
+    # compiler-derived backing number: XLA's own FLOP count for the
+    # compiled step executable (includes attention, dropout, optimizer)
+    mfu_xla = None
+    try:
+        cost = step.cost_analysis(ids, mask, labels, nsp)
+        xla_flops = float(cost.get("flops", 0.0))
+        if xla_flops > 0:
+            mfu_xla = xla_flops * iters / dt / peak
+    except Exception as e:
+        import sys
+
+        print(f"cost_analysis skipped: {e!r}", file=sys.stderr)
+
+    # one xplane capture of the measured region (round-2 verdict item 9);
+    # written next to the repo so the driver can archive it
+    xplane_dir = None
+    if on_tpu:
+        try:
+            xplane_dir = "/tmp/pit_bench_xplane"
+            jax.profiler.start_trace(xplane_dir)
+            try:
+                step(ids, mask, labels, nsp).numpy()
+            finally:
+                jax.profiler.stop_trace()
+        except Exception:
+            xplane_dir = None
 
     # the latency bench needs the native runtime (paged-KV pool); never let
     # it take down the training metric
@@ -108,12 +159,18 @@ def main():
         p50_ms = None
 
     result = {
-        "metric": "ernie3.0-base train tokens/sec/chip (bf16, bs%d seq%d)"
+        "metric": "ernie3.0-base train tokens/sec/chip "
+                  "(bf16, bs%d seq%d, dropout 0.1, 10%% padded)"
                   % (batch, seq),
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.35, 3),
+        "mfu_6nt_plus_attn": round(mfu, 4),
     }
+    if mfu_xla is not None:
+        result["mfu_xla_cost_analysis"] = round(mfu_xla, 4)
+    if xplane_dir is not None:
+        result["xplane_dir"] = xplane_dir
     if p50_ms is not None:
         result["decode_p50_ms_per_token_bs1"] = p50_ms
     print(json.dumps(result))
@@ -138,7 +195,7 @@ def _decode_latency_bs1(on_tpu: bool) -> float:
                         max_position_embeddings=1024,
                         hidden_dropout_prob=0.0,
                         attention_probs_dropout_prob=0.0)
-        prompt, max_new, reps = 128, 64, 5
+        prompt, max_new, reps = 128, 64, 20
     else:
         cfg = GPTConfig(vocab_size=256, hidden_size=64,
                         num_hidden_layers=2, num_attention_heads=4,

@@ -19,6 +19,23 @@ from .core.dtype import (bool_, uint8, int8, int16, int32, int64, float16,
                          get_default_dtype, set_default_dtype)
 from .core.tensor import Tensor, Parameter
 from .core.autograd import no_grad, enable_grad, set_grad_enabled, grad
+from .core.pylayer import PyLayer, PyLayerContext
+
+
+class autograd:  # namespace parity: paddle.autograd.PyLayer / .backward
+    PyLayer = PyLayer
+    PyLayerContext = PyLayerContext
+    grad = staticmethod(grad)
+
+    @staticmethod
+    def backward(tensors, grad_tensors=None, retain_graph=False):
+        # matches paddle.autograd.backward(tensors, grad_tensors)
+        from .core.autograd import run_backward
+
+        if grad_tensors is None:
+            grad_tensors = [None] * len(tensors)
+        return run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
 from .core import random as _random
 from .core.random import seed
 
@@ -46,6 +63,9 @@ from . import vision
 from . import hapi
 from .hapi import Model
 from . import device
+from . import distribution
+from . import fft
+from . import sparse
 from .framework import save, load, set_flags, get_flags, flags
 from .framework.io import save_state_dict, load_state_dict
 

@@ -1,0 +1,176 @@
+"""PyLayer — user-defined autograd functions.
+
+Reference: paddle.autograd.PyLayer (paddle/fluid/eager/pylayer/
+py_layer_node.h GradNodePyLayer + pybind/eager_py_layer.cc): a static
+``forward(ctx, ...)`` / ``backward(ctx, *grads)`` pair whose backward is
+taped as one opaque node in the autograd graph.
+
+TPU-first: the node's backward runs user Python over registry-op Tensors,
+so everything it computes is itself jitted XLA work, and ``create_graph``
+re-enters the dispatcher for higher-order grads exactly like built-in ops.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, List
+
+from . import autograd
+from .tensor import Tensor
+
+
+class PyLayerContext:
+    """The ``ctx`` handed to forward/backward (reference
+    eager_py_layer.cc PyLayerObject: container + saved tensors +
+    not-inplace / non-differentiable marks).  Arbitrary attributes may be
+    stashed on it (``ctx.alpha = 2``)."""
+
+    def __init__(self):
+        self._saved: tuple = ()
+        self._non_differentiable: List[int] = []
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        """Keep forward tensors for the backward pass.  Released when the
+        graph is (the engine drops ``node.ctx`` after a non-retained
+        backward)."""
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    # paddle spells it both ways across versions
+    saved_tensors = property(lambda self: self._saved)
+
+    def mark_non_differentiable(self, *tensors):
+        """Outputs listed here get ``stop_gradient=True`` and no grad slot."""
+        self._non_differentiable.extend(id(t) for t in tensors)
+
+    def mark_not_inplace(self, *tensors):
+        # inputs are never aliased by the functional runtime; parity no-op
+        pass
+
+    def set_materialize_grads(self, value: bool):
+        # the engine zero-fills missing output grads before any grad_fn
+        # runs, so backward always sees materialized grads; recorded for
+        # API parity
+        self._materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):  # pragma: no cover - guard only
+        raise RuntimeError(
+            f"{cls.__name__} should not be instantiated; call "
+            f"{cls.__name__}.apply(...)")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Subclass with two staticmethods::
+
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                x, = ctx.saved_tensor()
+                return 3.0 * x * x * grad
+
+        y = Cube.apply(x)
+
+    ``backward`` must return one grad per *Tensor* argument of forward
+    (None allowed for inputs that need no grad), matching the reference's
+    GradNodePyLayer contract (py_layer_node.h operator()).
+    """
+
+    @staticmethod
+    def forward(ctx: PyLayerContext, *args: Any, **kwargs: Any):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx: PyLayerContext, *grads: Any):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_idx = [i for i, a in enumerate(args)
+                      if isinstance(a, Tensor)]
+        tensor_inputs = [args[i] for i in tensor_idx]
+
+        # forward under no_grad: interior ops are NOT taped — the PyLayer
+        # node replaces that whole subgraph (reference: PyLayer forward
+        # runs with tracing paused, eager_py_layer.cc pylayer_core)
+        with autograd.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+
+        requires = autograd.grad_enabled() and any(
+            not t.stop_gradient or t._grad_node is not None
+            for t in tensor_inputs)
+
+        wrapped = []
+        for o in outs:
+            if not isinstance(o, Tensor):
+                wrapped.append(o)
+                continue
+            non_diff = id(o) in ctx._non_differentiable
+            t = Tensor(o._data,
+                       stop_gradient=(not requires) or non_diff)
+            wrapped.append(t)
+
+        if requires:
+            import jax.numpy as jnp
+
+            def grad_fn(gctx, *out_grads):
+                # slots for non-differentiable / non-tensor outputs carry
+                # engine-zero-filled grads; the user backward only sees
+                # grads for differentiable tensor outputs
+                usable = [g for g, o in zip(out_grads, outs)
+                          if isinstance(o, Tensor)
+                          and id(o) not in gctx._non_differentiable]
+                grads = cls.backward(gctx, *usable)
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                if len(grads) != len(tensor_inputs):
+                    raise RuntimeError(
+                        f"{cls.__name__}.backward returned {len(grads)} "
+                        f"grads for {len(tensor_inputs)} tensor inputs")
+                return tuple(
+                    g if g is None or isinstance(g, Tensor) else Tensor(g)
+                    for g in grads)
+
+            edges = []
+            for t in tensor_inputs:
+                if t.stop_gradient and t._grad_node is None:
+                    edges.append(autograd.Edge(None, 0, None, None, None))
+                elif t._grad_node is not None:
+                    edges.append(autograd.Edge(
+                        t._grad_node, t._out_slot, None, weakref.ref(t),
+                        (tuple(t.shape), t.dtype)))
+                else:
+                    edges.append(autograd.Edge(
+                        None, 0, t, None, (tuple(t.shape), t.dtype)))
+
+            out_metas = [
+                (tuple(o.shape), o.dtype) if isinstance(o, Tensor)
+                else ((), jnp.float32)
+                for o in outs]
+            node = autograd.GradNode(cls.__name__, grad_fn, ctx, edges,
+                                     out_metas)
+            for slot, t in enumerate(wrapped):
+                if isinstance(t, Tensor) and not t.stop_gradient:
+                    t._grad_node = node
+                    t._out_slot = slot
+                    node.out_tensors.append((weakref.ref(t), slot))
+
+        if multi:
+            return tuple(wrapped)
+        return wrapped[0]
+
+
+# paddle.autograd.PyLayerContext alias used in docs/code
+EagerPyLayerContext = PyLayerContext

@@ -1,0 +1,526 @@
+"""Probability distributions (reference: python/paddle/distribution/).
+
+API parity with the reference namespace: ``Distribution`` base with
+sample / log_prob / entropy / mean / variance, the concrete families the
+reference ships (Normal, Uniform, Categorical, Bernoulli, Beta,
+Dirichlet, Multinomial, Laplace, Gumbel), and ``kl_divergence`` /
+``register_kl`` dispatch (reference distribution/kl.py).
+
+TPU-first: densities/entropies are compositions of registry ops on
+Tensors, so log_prob is differentiable and jit-fusable; sampling draws
+through the functional PRNG (core/random.py) — reparameterized (rsample)
+wherever the family allows, so pathwise gradients work like the
+reference's ``rsample``.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as prandom
+from ..core.dispatch import dispatch as D
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Multinomial", "Laplace", "Gumbel",
+           "kl_divergence", "register_kl"]
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, jnp.float32))
+
+
+def _shape(sample_shape):
+    if sample_shape is None:
+        return ()
+    if isinstance(sample_shape, int):
+        return (sample_shape,)
+    return tuple(sample_shape)
+
+
+class Distribution:
+    """Base class (reference distribution/distribution.py)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        from ..core.autograd import no_grad
+
+        with no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return D("exp", self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """Gaussian (reference distribution/normal.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        eps = Tensor(jax.random.normal(prandom.next_key(), shape,
+                                       jnp.float32))
+        return self.loc + self.scale * eps
+
+    def log_prob(self, value):
+        value = _t(value)
+        var = self.scale * self.scale
+        return (-((value - self.loc) * (value - self.loc)) / (2.0 * var)
+                - D("log", self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return 0.5 + 0.5 * math.log(2 * math.pi) + D("log", self.scale)
+
+    def probs(self, value):
+        return self.prob(value)
+
+
+class Uniform(Distribution):
+    """U[low, high) (reference distribution/uniform.py)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.low.shape), tuple(self.high.shape))))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2.0
+
+    @property
+    def variance(self):
+        d = self.high - self.low
+        return d * d / 12.0
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = Tensor(jax.random.uniform(prandom.next_key(), shape,
+                                      jnp.float32))
+        return self.low + (self.high - self.low) * u
+
+    def log_prob(self, value):
+        value = _t(value)
+        inside = (value._data >= self.low._data) \
+            & (value._data < self.high._data)
+        lp = -D("log", self.high - self.low)
+        return Tensor(jnp.where(inside, lp._data, -jnp.inf))
+
+    def entropy(self):
+        return D("log", self.high - self.low)
+
+
+class Laplace(Distribution):
+    """reference distribution/laplace.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2.0 * self.scale * self.scale
+
+    @property
+    def stddev(self):
+        return math.sqrt(2.0) * self.scale
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = Tensor(jax.random.uniform(prandom.next_key(), shape,
+                                      jnp.float32, minval=-0.5,
+                                      maxval=0.5))
+        # inverse-CDF: loc - scale * sign(u) * log1p(-2|u|)
+        return self.loc - self.scale * Tensor(
+            jnp.sign(u._data)) * D("log1p", Tensor(-2.0 * jnp.abs(u._data)))
+
+    def log_prob(self, value):
+        value = _t(value)
+        return (-D("abs", value - self.loc) / self.scale
+                - D("log", 2.0 * self.scale))
+
+    def entropy(self):
+        return 1.0 + D("log", 2.0 * self.scale)
+
+
+class Gumbel(Distribution):
+    """reference distribution/gumbel.py."""
+
+    _EULER = 0.5772156649015329
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * self._EULER
+
+    @property
+    def variance(self):
+        return (math.pi ** 2 / 6.0) * self.scale * self.scale
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        g = Tensor(jax.random.gumbel(prandom.next_key(), shape,
+                                     jnp.float32))
+        return self.loc + self.scale * g
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return -(z + D("exp", -z)) - D("log", self.scale)
+
+    def entropy(self):
+        return D("log", self.scale) + 1.0 + self._EULER
+
+
+class Categorical(Distribution):
+    """Categorical over the last axis of ``logits`` (reference
+    distribution/categorical.py)."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if (logits is None) == (probs is None):
+            raise ValueError("pass exactly one of logits / probs")
+        if probs is not None:
+            p = _t(probs)
+            self.logits = D("log", p / D("sum", p, axis=-1, keepdim=True))
+        else:
+            lg = _t(logits)
+            self.logits = lg - Tensor(jax.nn.logsumexp(
+                lg._data, axis=-1, keepdims=True))
+        super().__init__(tuple(self.logits.shape[:-1]))
+        self.num_events = self.logits.shape[-1]
+
+    @property
+    def probs(self):
+        return D("softmax", self.logits, axis=-1)
+
+    @property
+    def mean(self):  # undefined for categorical; paddle raises too
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        idx = jax.random.categorical(
+            prandom.next_key(), self.logits._data,
+            shape=shape if shape else None)
+        return Tensor(jnp.asarray(idx, jnp.int64))
+
+    def log_prob(self, value):
+        v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        lp = jnp.take_along_axis(
+            self.logits._data,
+            jnp.broadcast_to(v, v.shape).astype(jnp.int32)[..., None],
+            axis=-1)[..., 0]
+        return Tensor(lp)
+
+    def entropy(self):
+        p = self.probs
+        return -D("sum", p * self.logits, axis=-1)
+
+
+class Bernoulli(Distribution):
+    """reference distribution/bernoulli.py."""
+
+    def __init__(self, probs):
+        self.probs_ = _t(probs)
+        super().__init__(tuple(self.probs_.shape))
+
+    @property
+    def probs(self):
+        return self.probs_
+
+    @property
+    def mean(self):
+        return self.probs_
+
+    @property
+    def variance(self):
+        return self.probs_ * (1.0 - self.probs_)
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(prandom.next_key(), shape, jnp.float32)
+        return Tensor((u < self.probs_._data).astype(jnp.float32))
+
+    def log_prob(self, value):
+        value = _t(value)
+        p = self.probs_
+        eps = 1e-8
+        return (value * D("log", p + eps)
+                + (1.0 - value) * D("log", 1.0 - p + eps))
+
+    def entropy(self):
+        p = self.probs_
+        eps = 1e-8
+        return -(p * D("log", p + eps)
+                 + (1.0 - p) * D("log", 1.0 - p + eps))
+
+
+class Beta(Distribution):
+    """reference distribution/beta.py."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.alpha.shape), tuple(self.beta.shape))))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1.0))
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        x = jax.random.beta(prandom.next_key(), self.alpha._data,
+                            self.beta._data, shape)
+        return Tensor(x)
+
+    def _log_beta(self):
+        return (D("lgamma", self.alpha) + D("lgamma", self.beta)
+                - D("lgamma", self.alpha + self.beta))
+
+    def log_prob(self, value):
+        value = _t(value)
+        return ((self.alpha - 1.0) * D("log", value)
+                + (self.beta - 1.0) * D("log", 1.0 - value)
+                - self._log_beta())
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        s = a + b
+        return (self._log_beta()
+                - (a - 1.0) * D("digamma", a)
+                - (b - 1.0) * D("digamma", b)
+                + (s - 2.0) * D("digamma", s))
+
+
+class Dirichlet(Distribution):
+    """reference distribution/dirichlet.py; event dim = last axis."""
+
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        shape = tuple(self.concentration.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / D("sum", self.concentration, axis=-1,
+                                      keepdim=True)
+
+    @property
+    def variance(self):
+        a = self.concentration
+        a0 = D("sum", a, axis=-1, keepdim=True)
+        m = a / a0
+        return m * (1.0 - m) / (a0 + 1.0)
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        x = jax.random.dirichlet(prandom.next_key(),
+                                 self.concentration._data, shape)
+        return Tensor(x)
+
+    def log_prob(self, value):
+        value = _t(value)
+        a = self.concentration
+        log_norm = (D("sum", D("lgamma", a), axis=-1)
+                    - D("lgamma", D("sum", a, axis=-1)))
+        return D("sum", (a - 1.0) * D("log", value), axis=-1) - log_norm
+
+    def entropy(self):
+        a = self.concentration
+        k = a.shape[-1]
+        a0 = D("sum", a, axis=-1)
+        log_norm = D("sum", D("lgamma", a), axis=-1) - D("lgamma", a0)
+        return (log_norm
+                + (a0 - float(k)) * D("digamma", a0)
+                - D("sum", (a - 1.0) * D("digamma", a), axis=-1))
+
+
+class Multinomial(Distribution):
+    """reference distribution/multinomial.py."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        p = _t(probs)
+        self.probs_ = p / D("sum", p, axis=-1, keepdim=True)
+        shape = tuple(self.probs_.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def probs(self):
+        return self.probs_
+
+    @property
+    def mean(self):
+        return self.probs_ * float(self.total_count)
+
+    @property
+    def variance(self):
+        n = float(self.total_count)
+        return n * self.probs_ * (1.0 - self.probs_)
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        logits = jnp.log(self.probs_._data)
+        draws = jax.random.categorical(
+            prandom.next_key(), logits,
+            shape=(self.total_count,) + shape)
+        k = self.probs_.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(axis=0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        value = _t(value)
+        n = float(self.total_count)
+        logf = (D("lgamma", _t(n + 1.0))
+                - D("sum", D("lgamma", value + 1.0), axis=-1))
+        return logf + D("sum", value * D("log", self.probs_), axis=-1)
+
+
+# -------------------------------------------------------------------- KL
+
+_KL_REGISTRY: Dict[Tuple[type, type], callable] = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a KL(p‖q) rule (reference
+    distribution/kl.py register_kl)."""
+
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if isinstance(p, cp) and isinstance(q, cq):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL rule for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) * (p.scale / q.scale)
+    t1 = ((p.loc - q.loc) / q.scale) * ((p.loc - q.loc) / q.scale)
+    return 0.5 * (var_ratio + t1 - 1.0 - D("log", var_ratio))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return D("log", (q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pr = p.probs
+    return D("sum", pr * (p.logits - q.logits), axis=-1)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    eps = 1e-8
+    a, b = p.probs_, q.probs_
+    return (a * D("log", (a + eps) / (b + eps))
+            + (1.0 - a) * D("log", (1.0 - a + eps) / (1.0 - b + eps)))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    loc_abs = D("abs", p.loc - q.loc)
+    return (-D("log", scale_ratio)
+            + scale_ratio * D("exp", -loc_abs / p.scale)
+            + loc_abs / q.scale - 1.0)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    a, b = p.concentration, q.concentration
+    a0 = D("sum", a, axis=-1, keepdim=True)
+    return (D("lgamma", D("sum", a, axis=-1))
+            - D("lgamma", D("sum", b, axis=-1))
+            - D("sum", D("lgamma", a) - D("lgamma", b), axis=-1)
+            + D("sum", (a - b) * (D("digamma", a) - D("digamma", a0)),
+                axis=-1))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    pa, pb, qa, qb = p.alpha, p.beta, q.alpha, q.beta
+    ps = pa + pb
+    return (q._log_beta() - p._log_beta()
+            + (pa - qa) * D("digamma", pa)
+            + (pb - qb) * D("digamma", pb)
+            + (qa + qb - pa - pb) * D("digamma", ps))

@@ -1,0 +1,129 @@
+"""paddle.fft parity (reference: python/paddle/fft.py — ~1.7k lines of
+wrappers over the fft ops backed by cuFFT/onemkl; here every transform
+lowers to XLA's native FFT HLO, which the TPU backend executes without a
+vendor library).
+
+All transforms are registered ops with jax.vjp backward rules, so FFTs
+are differentiable and fuse under jit like any other op.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import dispatch as D, register_op, register_vjp_grad
+from .core.tensor import Tensor
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+           "fft2", "ifft2", "rfft2", "irfft2",
+           "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
+
+
+def _reg(name, fn):
+    def impl(x, n=None, axis=-1, norm="backward"):
+        return fn(x, n=n, axis=axis, norm=norm)
+
+    register_op(name)(impl)
+    register_vjp_grad(name)
+
+
+def _reg_n(name, fn):
+    def impl(x, s=None, axes=None, norm="backward"):
+        return fn(x, s=s, axes=axes, norm=norm)
+
+    register_op(name)(impl)
+    register_vjp_grad(name)
+
+
+_reg("fft", jnp.fft.fft)
+_reg("ifft", jnp.fft.ifft)
+_reg("rfft", jnp.fft.rfft)
+_reg("irfft", jnp.fft.irfft)
+_reg("hfft", jnp.fft.hfft)
+_reg("ihfft", jnp.fft.ihfft)
+_reg_n("fft2", jnp.fft.fft2)
+_reg_n("ifft2", jnp.fft.ifft2)
+_reg_n("rfft2", jnp.fft.rfft2)
+_reg_n("irfft2", jnp.fft.irfft2)
+_reg_n("fftn", jnp.fft.fftn)
+_reg_n("ifftn", jnp.fft.ifftn)
+_reg_n("rfftn", jnp.fft.rfftn)
+_reg_n("irfftn", jnp.fft.irfftn)
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return D("fft", x, n=n, axis=axis, norm=norm)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return D("ifft", x, n=n, axis=axis, norm=norm)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return D("rfft", x, n=n, axis=axis, norm=norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return D("irfft", x, n=n, axis=axis, norm=norm)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return D("hfft", x, n=n, axis=axis, norm=norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return D("ihfft", x, n=n, axis=axis, norm=norm)
+
+
+def _tup(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else v
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return D("fft2", x, s=_tup(s), axes=_tup(axes), norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return D("ifft2", x, s=_tup(s), axes=_tup(axes), norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return D("rfft2", x, s=_tup(s), axes=_tup(axes), norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return D("irfft2", x, s=_tup(s), axes=_tup(axes), norm=norm)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return D("fftn", x, s=_tup(s), axes=_tup(axes), norm=norm)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return D("ifftn", x, s=_tup(s), axes=_tup(axes), norm=norm)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return D("rfftn", x, s=_tup(s), axes=_tup(axes), norm=norm)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return D("irfftn", x, s=_tup(s), axes=_tup(axes), norm=norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    x = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    return Tensor(jnp.fft.fftshift(x._data, axes=_tup(axes)))
+
+
+def ifftshift(x, axes=None, name=None):
+    x = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    return Tensor(jnp.fft.ifftshift(x._data, axes=_tup(axes)))

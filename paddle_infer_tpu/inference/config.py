@@ -42,6 +42,16 @@ class Config:
 
     def __init__(self, model=None, params=None):
         if model is not None and params is None:
+            import os
+
+            # fail fast on a bad model path (AnalysisPredictor::Init loads
+            # eagerly, analysis_predictor.cc:245 — a missing model is a
+            # constructor-time error, not a first-run surprise)
+            if not (os.path.isdir(model)
+                    or os.path.exists(model + ".ptimodel")
+                    or os.path.exists(model)):
+                raise FileNotFoundError(
+                    f"no model at '{model}' (.ptimodel prefix or dir)")
             self.model_dir = model
             self.prog_file = None
             self.params_file = None

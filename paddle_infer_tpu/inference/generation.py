@@ -400,9 +400,15 @@ class PagedGenerationEngine(GenerationEngine):
         by true per-row length — no additive pad mask at all;
       * KV memory is allocated in pages by the native pool, so memory
         scales with actual tokens (rounded to a page), not with the
-        bucketed max length, and sequences can share/CoW pages (beam
-        forks use KVBlockPool.fork).
-    Beam search currently falls back to the dense-cache path.
+        bucketed max length, and sequences can share/CoW pages;
+      * beam search forks pages (KVBlockPool.fork): all W beams of a row
+        SHARE the row's prompt pages (prefill runs once per row, not once
+        per beam like the dense engine), each beam owns
+        ceil(max_new/page)+1 private decode pages, the partially-filled
+        boundary page is copied-on-write into each beam's first private
+        page at fork time, and the per-step beam reorder permutes only the
+        private decode pages — the prompt (usually the bulk of the cache)
+        is never gathered, unlike the dense engine's full-cache reorder.
     """
 
     def __init__(self, model, page_size: int = 16,
@@ -515,19 +521,214 @@ class PagedGenerationEngine(GenerationEngine):
         # engine rebinds the returned arrays
         return jax.jit(run, donate_argnums=(4, 5))
 
+    # --------------------------------------------------- paged beam search
+    def _build_paged_beam(self, batch, plen, n_priv, g: GenerationConfig):
+        """Beam search over forked pages (reference beam_search_softmax +
+        CacheKV beam reorder, fused_multi_transformer_op.cc — re-designed
+        for paged KV): prefill once per row into SHARED prompt pages, give
+        each beam ``n_priv`` private decode pages, copy the partial
+        boundary page per beam at fork, and reorder beams by permuting
+        only the private pages' contents."""
+        W = g.num_beams
+        max_new = g.max_new_tokens
+        pad = g.pad_token_id
+        L = self._num_layers
+        page = self.page_size
+
+        def run(params, ids, lengths, prompt_tables, priv_ids, k_pages,
+                v_pages, rng):
+            del rng                       # beam search is deterministic
+            b = batch
+            max_pages = prompt_tables.shape[1]
+
+            # ---- prefill once over the b prompt rows (shared pages)
+            zero_pos = jnp.zeros((b,), jnp.int32)
+            caches = [(k_pages[i], v_pages[i], prompt_tables, zero_pos)
+                      for i in range(L)]
+            pos2d = jnp.broadcast_to(
+                jnp.arange(plen, dtype=jnp.int32)[None], (b, plen))
+            logits, caches = self._model_step(params, ids, pos2d, None,
+                                              caches)
+            k_pages = [c[0] for c in caches]
+            v_pages = [c[1] for c in caches]
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+
+            # ---- fork: each beam's first private page gets a copy of the
+            # row's partially-filled boundary page (decode tokens land
+            # mid-page when the true length isn't page-aligned)
+            boundary = lengths // page                       # [b]
+            bsrc = jnp.take_along_axis(
+                prompt_tables, jnp.minimum(boundary, max_pages - 1)[:, None],
+                axis=1)[:, 0]                                # [b]
+            first_priv = priv_ids[:, :, 0].reshape(-1)       # [b*W]
+            for i in range(L):
+                k_pages[i] = k_pages[i].at[first_priv].set(
+                    jnp.repeat(k_pages[i][bsrc], W, axis=0))
+                v_pages[i] = v_pages[i].at[first_priv].set(
+                    jnp.repeat(v_pages[i][bsrc], W, axis=0))
+
+            # ---- per-beam tables: shared below the boundary page,
+            # private from it on (never permuted — contents move instead)
+            p_idx = jnp.arange(max_pages, dtype=jnp.int32)[None, None]
+            rel = jnp.clip(p_idx - boundary[:, None, None], 0, n_priv - 1)
+            priv_full = jnp.take_along_axis(
+                priv_ids, jnp.broadcast_to(rel, (b, W, max_pages)), axis=2)
+            shared_full = jnp.broadcast_to(prompt_tables[:, None],
+                                           (b, W, max_pages))
+            beam_tables = jnp.where(p_idx < boundary[:, None, None],
+                                    shared_full, priv_full)
+            beam_tables = beam_tables.reshape(b * W, max_pages)
+            lengths_w = jnp.repeat(lengths, W, axis=0)       # [b*W]
+
+            # ---- first beam step from the prompt logits (all beams of a
+            # row share the prefix, so only beam 0 is live)
+            logp = jax.nn.log_softmax(last.astype(jnp.float32), axis=-1)
+            if g.eos_token_id is not None and g.min_length > 0:
+                logp = logp.at[:, g.eos_token_id].set(sampling.NEG_INF)
+            vocab = logp.shape[-1]
+            init_bias = jnp.where(jnp.arange(W) == 0, 0.0, sampling.NEG_INF)
+            flat = (logp[:, None, :] + init_bias[None, :, None]) \
+                .reshape(b, W * vocab)
+            top_s, top_i = jax.lax.top_k(flat, W)            # [b, W]
+            tok = (top_i % vocab).astype(jnp.int32)
+            cum = top_s
+            finished = (tok == g.eos_token_id) \
+                if g.eos_token_id is not None \
+                else jnp.zeros((b, W), jnp.bool_)
+            gen_len = jnp.ones((b, W), jnp.int32)
+            out = jnp.full((b, W, max_new), pad, jnp.int32)
+            out = out.at[:, :, 0].set(tok)
+
+            def permute_priv(pages, src):
+                """Target beam w adopts source beam src[i, w]'s decode
+                pages — a gather+scatter over n_priv pages per beam, NOT
+                the dense engine's whole-cache reorder."""
+                src_ids = jnp.take_along_axis(priv_ids, src[:, :, None],
+                                              axis=1)       # [b, W, n_priv]
+                return pages.at[priv_ids.reshape(-1)].set(
+                    pages[src_ids.reshape(-1)])
+
+            def cond(state):
+                step, fin = state[0], state[4]
+                return jnp.logical_and(step < max_new,
+                                       jnp.logical_not(jnp.all(fin)))
+
+            def body(state):
+                step, tok, out, cum, fin, gen_len, k_pages, v_pages = state
+                pos = lengths_w + step - 1                   # [b*W]
+                caches = [(k_pages[i], v_pages[i], beam_tables, pos)
+                          for i in range(L)]
+                logits, caches = self._model_step(
+                    params, tok.reshape(b * W, 1), pos[:, None], None,
+                    caches)
+                k_pages = [c[0] for c in caches]
+                v_pages = [c[1] for c in caches]
+                logp = jax.nn.log_softmax(
+                    logits[:, -1].astype(jnp.float32), axis=-1)
+                logp = logp.reshape(b, W, vocab)
+                if g.eos_token_id is not None and g.min_length > 0:
+                    logp = jnp.where(step < g.min_length,
+                                     logp.at[:, :, g.eos_token_id].set(
+                                         sampling.NEG_INF), logp)
+                pad_row = jnp.full((vocab,), sampling.NEG_INF,
+                                   jnp.float32).at[pad].set(0.0)
+                logp = jnp.where(fin[:, :, None], pad_row[None, None, :],
+                                 logp)
+                flat = (cum[:, :, None] + logp).reshape(b, W * vocab)
+                top_s, top_i = jax.lax.top_k(flat, W)
+                src = top_i // vocab
+                nxt = (top_i % vocab).astype(jnp.int32)
+                k_pages = [permute_priv(kp, src) for kp in k_pages]
+                v_pages = [permute_priv(vp, src) for vp in v_pages]
+                out = jnp.take_along_axis(out, src[:, :, None], axis=1)
+                fin = jnp.take_along_axis(fin, src, axis=1)
+                gen_len = jnp.take_along_axis(gen_len, src, axis=1)
+                gen_len = gen_len + jnp.logical_not(fin)
+                if g.eos_token_id is not None:
+                    fin = jnp.logical_or(fin, nxt == g.eos_token_id)
+                out = jax.lax.dynamic_update_slice(
+                    out, nxt[:, :, None],
+                    (jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+                     step))
+                return (step + 1, nxt, out, top_s, fin, gen_len, k_pages,
+                        v_pages)
+
+            state = (jnp.asarray(1, jnp.int32), tok, out, cum, finished,
+                     gen_len, k_pages, v_pages)
+            state = jax.lax.while_loop(cond, body, state)
+            _, _, out, cum, _, gen_len, k_pages, v_pages = state
+            norm = cum / (gen_len.astype(jnp.float32) ** g.length_penalty)
+            best = jnp.argmax(norm, axis=1)
+            seq = jnp.take_along_axis(out, best[:, None, None],
+                                      axis=1)[:, 0]
+            score = jnp.take_along_axis(norm, best[:, None], axis=1)[:, 0]
+            return seq, score, k_pages, v_pages
+
+        return jax.jit(run, donate_argnums=(5, 6))
+
+    def _generate_paged_beam(self, ids, lengths, plen, g, return_scores):
+        """Pool choreography for the paged beam program: prompt rows own
+        the shared pages; every beam is a KVBlockPool.fork of its row plus
+        a reservation that appends its private decode pages."""
+        b = ids.shape[0]
+        W = g.num_beams
+        page = self.page_size
+        n_prompt = plen // page
+        n_priv = -(-g.max_new_tokens // page) + 1
+        max_pages = -(-(plen + g.max_new_tokens) // page)
+        max_pages = max(max_pages, n_prompt + 1)
+
+        pool = self._ensure_pool(b * (n_prompt + W * n_priv))
+        prompt_sids = list(range(b))
+        beam_sids = [b + i * W + w for i in range(b) for w in range(W)]
+        for s in prompt_sids + beam_sids:
+            pool.free(s)
+        tables = np.zeros((b, max_pages), np.int32)
+        priv_ids = np.zeros((b, W, n_priv), np.int32)
+        for i in prompt_sids:
+            pool.reserve(i, plen)
+            t = pool.block_table(i)
+            tables[i, :len(t)] = t
+        for i in range(b):
+            for w in range(W):
+                sid = b + i * W + w
+                pool.fork(i, sid)                  # share the prompt pages
+                pool.reserve(sid, plen + (n_priv * page))
+                t = pool.block_table(sid)
+                priv_ids[i, w] = t[n_prompt:n_prompt + n_priv]
+
+        k_pages, v_pages = self._ensure_pages()
+        # sharing accounting (tested): W beams re-use each row's n_prompt
+        # prompt pages; a fork-less design would copy them per beam
+        self.last_beam_pool_stats = {
+            "used_pages": pool.num_blocks - pool.free_blocks,
+            "prompt_pages_shared": b * n_prompt,
+            "private_pages": b * W * n_priv,
+            "unshared_equivalent": b * W * (n_prompt + n_priv),
+        }
+        key = ("paged-beam", b, plen, max_pages, n_priv, pool.num_blocks,
+               g.cache_key())
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._build_paged_beam(b, plen, n_priv, g)
+            self._compiled[key] = fn
+        rng = jax.random.PRNGKey(g.seed)
+        self._k_pages = self._v_pages = None
+        seq, score, k_pages, v_pages = fn(
+            self._params, jnp.asarray(ids), jnp.asarray(lengths),
+            jnp.asarray(tables), jnp.asarray(priv_ids), k_pages, v_pages,
+            rng)
+        self._k_pages, self._v_pages = k_pages, v_pages
+        for s in prompt_sids + beam_sids:
+            pool.free(s)
+        seq = np.asarray(seq)
+        return (seq, np.asarray(score)) if return_scores else seq
+
     # ------------------------------------------------------------- public
     def generate(self, input_ids, generation_config: GenerationConfig = None,
                  attention_mask=None, return_scores: bool = False):
         g = generation_config or GenerationConfig()
-        if g.num_beams > 1:
-            import warnings
-
-            warnings.warn(
-                "PagedGenerationEngine: beam search uses the dense-cache "
-                "path (paged beam fork is pool-level, KVBlockPool.fork)",
-                UserWarning)
-            return super().generate(input_ids, g, attention_mask,
-                                    return_scores)
         self._params = {n: p._data
                         for n, p in self._model.named_parameters()}
         ids = np.asarray(input_ids._data if isinstance(input_ids, Tensor)
@@ -556,6 +757,10 @@ class PagedGenerationEngine(GenerationEngine):
         if plen > plen_raw:
             ids = np.pad(ids, ((0, 0), (0, plen - plen_raw)),
                          constant_values=g.pad_token_id)
+
+        if g.num_beams > 1:
+            return self._generate_paged_beam(ids, lengths, plen, g,
+                                             return_scores)
 
         pages_per_seq = -(-(plen + g.max_new_tokens) // self.page_size)
         pool = self._ensure_pool(pages_per_seq * b)

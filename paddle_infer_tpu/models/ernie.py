@@ -146,14 +146,16 @@ class ErnieModel(Layer):
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):
+        segment_ids = None
         if attention_mask is not None and attention_mask.ndim == 2:
-            # [b, s] padding mask → additive [b, 1, 1, s]
-            m = D("cast", attention_mask, dtype="float32")
-            m = (1.0 - m) * -1e9
-            attention_mask = D("unsqueeze", m, axis=(1, 2))
+            # [b, s] padding mask → segment ids (1 = real, 0 = pad; attend
+            # iff equal), which keeps the Pallas flash kernels engaged —
+            # a dense additive mask would force the O(s^2) XLA path
+            segment_ids = D("cast", attention_mask, dtype="int32")
+            attention_mask = None
         x = self.embeddings(input_ids, token_type_ids, position_ids)
         for layer in self.layers:
-            x = layer(x, attn_mask=attention_mask)
+            x = layer(x, attn_mask=attention_mask, segment_ids=segment_ids)
         pooled = self.pooler(x)
         return x, pooled
 

@@ -48,7 +48,7 @@ class ParallelSelfAttention(Layer):
         self.out_proj = RowParallelLinear(hidden, hidden,
                                           input_is_parallel=True)
 
-    def forward(self, x, attn_mask=None, cache=None):
+    def forward(self, x, attn_mask=None, cache=None, segment_ids=None):
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)
         qkv = D("reshape", qkv, shape=(b, s, 3, self.num_heads,
@@ -88,15 +88,20 @@ class ParallelSelfAttention(Layer):
             if attn_mask is not None:
                 mask = attn_mask + mask
             out = F.scaled_dot_product_attention(
-                q, k, v, attn_mask=mask, dropout_p=0.0, is_causal=False)
+                q, k, v, attn_mask=mask, dropout_p=0.0, is_causal=False,
+                internal_mask=True)
         else:
             # causal stays on with a cache: the sdpa mask is offset by
             # (len_k - len_q), so cached prefill/decode attends to the full
             # past but never to future tokens of the current chunk.
+            # Padding masks ride as segment ids (self-attention: same ids on
+            # both sides) so the Pallas kernels stay engaged under real
+            # padded-batch training configs.
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask,
                 dropout_p=self.dropout if self.training else 0.0,
-                is_causal=self.causal)
+                is_causal=self.causal,
+                q_segment_ids=segment_ids, kv_segment_ids=segment_ids)
         out = D("reshape", out, shape=(b, s, self.hidden))
         out = self.out_proj(out)
         if static_cache:
@@ -195,14 +200,16 @@ class ParallelTransformerLayer(Layer):
         self.dropout1 = Dropout(dropout)
         self.dropout2 = Dropout(dropout)
 
-    def forward(self, x, attn_mask=None, cache=None):
+    def forward(self, x, attn_mask=None, cache=None, segment_ids=None):
         residual = x
         if self.normalize_before:
             x = self.norm1(x)
         if cache is not None:
-            attn_out, new_cache = self.self_attn(x, attn_mask, cache)
+            attn_out, new_cache = self.self_attn(x, attn_mask, cache,
+                                                 segment_ids=segment_ids)
         else:
-            attn_out = self.self_attn(x, attn_mask)
+            attn_out = self.self_attn(x, attn_mask,
+                                      segment_ids=segment_ids)
             new_cache = None
         x = residual + self.dropout1(attn_out)
         if not self.normalize_before:

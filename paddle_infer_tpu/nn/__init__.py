@@ -16,6 +16,8 @@ from .layers_common import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCEWithLogitsLoss, BCELoss,
     SmoothL1Loss, KLDivLoss,
 )
+from .rnn import (  # noqa: F401
+    GRU, GRUCell, LSTM, LSTMCell, RNN, SimpleRNN, SimpleRNNCell)
 from .transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,

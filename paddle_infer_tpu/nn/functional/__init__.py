@@ -323,21 +323,17 @@ def _no_grad():
 
 
 def dropout(x, p=0.5, training=True, mode="upscale_in_train", key=None):
+    """Hash-RNG dropout (one fused where, no threefry mask tensor — see
+    ops/activation.py _dropout)."""
     if not training or p == 0.0:
         return x
     if p >= 1.0:
         return D("multiply", x, 0.0)
     if key is None:
         key = prandom.next_key()
-    import jax
-
-    keep = 1.0 - p
-    mask = jax.random.bernoulli(key, keep, tuple(x.shape))
-    mask_t = Tensor(mask.astype(x._data.dtype if isinstance(x, Tensor)
-                                else jnp.float32))
-    if mode == "upscale_in_train":
-        return D("multiply", D("multiply", x, mask_t), 1.0 / keep)
-    return D("multiply", x, mask_t)
+    key_t = key if isinstance(key, Tensor) else Tensor(key)
+    return D("dropout", x, key_t, p=float(p),
+             upscale=(mode == "upscale_in_train"))
 
 
 def dropout2d(x, p=0.5, training=True, key=None):
@@ -345,12 +341,10 @@ def dropout2d(x, p=0.5, training=True, key=None):
         return x
     if key is None:
         key = prandom.next_key()
-    import jax
-
-    keep = 1.0 - p
-    mask = jax.random.bernoulli(key, keep, (x.shape[0], x.shape[1], 1, 1))
-    mask_t = Tensor(mask.astype(x._data.dtype))
-    return D("multiply", D("multiply", x, mask_t), 1.0 / keep)
+    key_t = key if isinstance(key, Tensor) else Tensor(key)
+    # whole-channel dropout: mask broadcasts over the spatial dims
+    return D("dropout", x, key_t, p=float(p), upscale=True,
+             bcast_dims=(2, 3))
 
 
 # padding ------------------------------------------------------------------
@@ -488,11 +482,16 @@ def _reduce(loss, reduction):
 
 
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
-                                 is_causal=False, training=True, scale=None):
+                                 is_causal=False, training=True, scale=None,
+                                 q_segment_ids=None, kv_segment_ids=None,
+                                 internal_mask=False):
     """(batch, seq, heads, head_dim) layout, matching paddle's flash_attention
     API surface (reference phi/api/yaml/ops.yaml:239 flash_attn).  Lowered to
-    one fused XLA computation eagerly; the Pallas flash kernel
-    (ops/pallas/flash_attention.py) takes over under jit on TPU for long seqs.
+    one fused XLA computation eagerly; the Pallas flash kernels
+    (ops/pallas/flash_attention.py) take over under jit on TPU.  Padding /
+    packed-sequence masks should ride as int32 ``{q,kv}_segment_ids``
+    (attend iff equal) — those stay on the fast kernels, while an arbitrary
+    dense ``attn_mask`` forces the O(s^2) XLA path.
     """
     key = None
     if dropout_p and training:
@@ -501,8 +500,9 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
         key = _T(prandom.next_key())
     else:
         dropout_p = 0.0
-    return D("sdpa", q, k, v, attn_mask, key,
-             dropout_p=dropout_p, is_causal=is_causal, scale=scale)
+    return D("sdpa", q, k, v, attn_mask, key, q_segment_ids, kv_segment_ids,
+             dropout_p=dropout_p, is_causal=is_causal, scale=scale,
+             internal_mask=internal_mask)
 
 
 def flash_attention(q, k, v, dropout=0.0, causal=False, training=True,
@@ -510,6 +510,29 @@ def flash_attention(q, k, v, dropout=0.0, causal=False, training=True,
     """paddle.nn.functional.flash_attention parity (reference ops.yaml:239)."""
     out = scaled_dot_product_attention(q, k, v, dropout_p=dropout,
                                        is_causal=causal, training=training)
+    if return_softmax:
+        return out, None
+    return out
+
+
+def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k=None,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, training=True,
+                        return_softmax=False):
+    """paddle.nn.functional.flash_attn_unpadded parity (reference
+    ops.yaml:252): packed (total_tokens, heads, head_dim) inputs with
+    cu_seqlens prefix sums; per-sequence isolation via segment ids inside
+    the flash kernel (max_seqlen args accepted for API parity — the TPU
+    kernel does not need them)."""
+    key = None
+    if dropout and training:
+        from ...core.tensor import Tensor as _T
+
+        key = _T(prandom.next_key())
+    else:
+        dropout = 0.0
+    out = D("flash_attn_varlen", q, k, v, cu_seqlens_q, cu_seqlens_k, key,
+            dropout_p=dropout, is_causal=causal, scale=scale)
     if return_softmax:
         return out, None
     return out

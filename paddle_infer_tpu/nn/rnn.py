@@ -1,0 +1,292 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU (cells, scan wrapper, stacks).
+
+Reference: python/paddle/nn/layer/rnn.py — SimpleRNNCell (:~200),
+LSTMCell, GRUCell, the generic ``RNN`` scan wrapper, and the multi-layer
+bidirectional SimpleRNN/LSTM/GRU stacks; gate orders LSTM [i, f, g, o] /
+GRU [r, z, c] as in the reference cells.
+
+TPU-first: the standard stacks call the fused full-sequence scan ops
+(ops/rnn.py — one lax.scan per (layer, direction), input projection
+hoisted onto the MXU).  The generic ``RNN(cell)`` wrapper runs the cell
+step-by-step eagerly so arbitrary user cells work, same as the
+reference's non-cudnn path.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..core.dispatch import dispatch as D
+from . import functional as F
+from .layer import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+           "LSTM", "GRU"]
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, n_gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        from .initializer import Uniform
+
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (n_gates * hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (n_gates * hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = (None if bias_ih_attr is False else
+                        self.create_parameter((n_gates * hidden_size,),
+                                              attr=bias_ih_attr,
+                                              is_bias=True,
+                                              default_initializer=init))
+        self.bias_hh = (None if bias_hh_attr is False else
+                        self.create_parameter((n_gates * hidden_size,),
+                                              attr=bias_hh_attr,
+                                              is_bias=True,
+                                              default_initializer=init))
+
+    def _zero_state(self, x, n):
+        b = x.shape[0]
+        zeros = D("zeros", shape=(b, self.hidden_size),
+                  dtype=str(x.dtype)) if False else None
+        from ..ops.creation import _  # pragma: no cover
+
+    def get_initial_states(self, x):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        b = x.shape[0]
+        return Tensor(jnp.zeros((b, self.hidden_size), x._data.dtype))
+
+
+class SimpleRNNCell(_RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh) (reference SimpleRNNCell)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, 1, **kw)
+        assert activation in ("tanh", "relu")
+        self.activation = activation
+
+    def forward(self, x, states=None):
+        h = states if states is not None else self.get_initial_states(x)
+        z = F.linear(x, D("transpose", self.weight_ih, perm=(1, 0)),
+                     self.bias_ih) \
+            + F.linear(h, D("transpose", self.weight_hh, perm=(1, 0)),
+                       self.bias_hh)
+        h = F.tanh(z) if self.activation == "tanh" else F.relu(z)
+        return h, h
+
+
+class LSTMCell(_RNNCellBase):
+    """Gate order [i, f, g, o] (reference LSTMCell.forward)."""
+
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 4, **kw)
+
+    def forward(self, x, states=None):
+        if states is None:
+            states = (self.get_initial_states(x),
+                      self.get_initial_states(x))
+        h, c = states
+        gates = F.linear(x, D("transpose", self.weight_ih, perm=(1, 0)),
+                         self.bias_ih) \
+            + F.linear(h, D("transpose", self.weight_hh, perm=(1, 0)),
+                       self.bias_hh)
+        hs = self.hidden_size
+        i = F.sigmoid(gates[:, 0:hs])
+        f = F.sigmoid(gates[:, hs:2 * hs])
+        g = F.tanh(gates[:, 2 * hs:3 * hs])
+        o = F.sigmoid(gates[:, 3 * hs:])
+        c_new = f * c + i * g
+        h_new = o * F.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(_RNNCellBase):
+    """Gate order [r, z, c]; h' = (h - c)·z + c (reference GRUCell)."""
+
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 3, **kw)
+
+    def forward(self, x, states=None):
+        h = states if states is not None else self.get_initial_states(x)
+        gx = F.linear(x, D("transpose", self.weight_ih, perm=(1, 0)),
+                      self.bias_ih)
+        gh = F.linear(h, D("transpose", self.weight_hh, perm=(1, 0)),
+                      self.bias_hh)
+        hs = self.hidden_size
+        r = F.sigmoid(gx[:, :hs] + gh[:, :hs])
+        z = F.sigmoid(gx[:, hs:2 * hs] + gh[:, hs:2 * hs])
+        c = F.tanh(gx[:, 2 * hs:] + r * gh[:, 2 * hs:])
+        h_new = (h - c) * z + c
+        return h_new, h_new
+
+
+class RNN(Layer):
+    """Generic scan wrapper over any cell (reference rnn.py class RNN):
+    eager per-step loop, so custom cells with arbitrary Python work."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        x = inputs
+        if self.time_major:
+            x = D("transpose", x, perm=(1, 0) + tuple(range(2, x.ndim)))
+        steps = range(x.shape[1])
+        if self.is_reverse:
+            steps = reversed(list(steps))
+        states = initial_states
+        outs = [None] * x.shape[1]
+        for t in steps:
+            out, states = self.cell(x[:, t], states)
+            outs[t] = out
+        out = D("stack", *outs, axis=1)
+        if self.time_major:
+            out = D("transpose", out,
+                    perm=(1, 0) + tuple(range(2, out.ndim)))
+        return out, states
+
+
+class _RNNStack(Layer):
+    """Shared multi-layer bidirectional driver over the fused scan ops."""
+
+    N_GATES = {"simple_rnn_seq": 1, "lstm_seq": 4, "gru_seq": 3}
+
+    def __init__(self, op, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        assert direction in ("forward", "bidirect", "bidirectional")
+        self.op = op
+        self.num_layers = num_layers
+        self.bidirect = direction != "forward"
+        self.num_directions = 2 if self.bidirect else 1
+        self.hidden_size = hidden_size
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        n_gates = self.N_GATES[op]
+        std = 1.0 / math.sqrt(hidden_size)
+        from .initializer import Uniform
+
+        init = Uniform(-std, std)
+        self._weights = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 \
+                else hidden_size * self.num_directions
+            for d in range(self.num_directions):
+                sfx = f"l{layer}" + ("_reverse" if d else "")
+                w_ih = self.create_parameter(
+                    (n_gates * hidden_size, in_sz), attr=weight_ih_attr,
+                    default_initializer=init)
+                w_hh = self.create_parameter(
+                    (n_gates * hidden_size, hidden_size),
+                    attr=weight_hh_attr, default_initializer=init)
+                b_ih = self.create_parameter(
+                    (n_gates * hidden_size,), attr=bias_ih_attr,
+                    is_bias=True, default_initializer=init)
+                b_hh = self.create_parameter(
+                    (n_gates * hidden_size,), attr=bias_hh_attr,
+                    is_bias=True, default_initializer=init)
+                setattr(self, f"weight_ih_{sfx}", w_ih)
+                setattr(self, f"weight_hh_{sfx}", w_hh)
+                setattr(self, f"bias_ih_{sfx}", b_ih)
+                setattr(self, f"bias_hh_{sfx}", b_hh)
+                self._weights.append((w_ih, w_hh, b_ih, b_hh))
+
+    def _run_dir(self, x, h0, c0, weights, reverse, seq_lens):
+        w_ih, w_hh, b_ih, b_hh = weights
+        kw = dict(reverse=reverse)
+        if self.op == "simple_rnn_seq":
+            kw["activation"] = self.activation or "tanh"
+        if self.op == "lstm_seq":
+            out, h_n, c_n = D(self.op, x, h0, c0, w_ih, w_hh, b_ih, b_hh,
+                              seq_lens, **kw)
+            return out, h_n, c_n
+        out, h_n = D(self.op, x, h0, w_ih, w_hh, b_ih, b_hh, seq_lens,
+                     **kw)
+        return out, h_n, None
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        """inputs [b, s, in] ([s, b, in] if time_major).  States are
+        [num_layers*num_directions, b, hidden] (paddle layout).  Returns
+        (outputs, states) — LSTM states are an (h, c) tuple."""
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        x = inputs
+        if self.time_major:
+            x = D("transpose", x, perm=(1, 0, 2))
+        b = x.shape[0]
+        n_state = self.num_layers * self.num_directions
+        is_lstm = self.op == "lstm_seq"
+        if initial_states is None:
+            zeros = Tensor(jnp.zeros((n_state, b, self.hidden_size),
+                                     x._data.dtype))
+            h0s, c0s = zeros, (zeros if is_lstm else None)
+        elif is_lstm:
+            h0s, c0s = initial_states
+        else:
+            h0s, c0s = initial_states, None
+
+        h_n, c_n = [], []
+        out = x
+        for layer in range(self.num_layers):
+            outs_dir = []
+            for d in range(self.num_directions):
+                idx = layer * self.num_directions + d
+                o, h, c = self._run_dir(
+                    out, h0s[idx], c0s[idx] if is_lstm else None,
+                    self._weights[idx], reverse=bool(d),
+                    seq_lens=sequence_length)
+                outs_dir.append(o)
+                h_n.append(h)
+                if is_lstm:
+                    c_n.append(c)
+            out = outs_dir[0] if len(outs_dir) == 1 \
+                else D("concat", outs_dir[0], outs_dir[1], axis=-1)
+            if self.dropout and layer < self.num_layers - 1 \
+                    and self.training:
+                out = F.dropout(out, p=self.dropout)
+        h_n = D("stack", *h_n, axis=0)
+        states = (h_n, D("stack", *c_n, axis=0)) if is_lstm else h_n
+        if self.time_major:
+            out = D("transpose", out, perm=(1, 0, 2))
+        return out, states
+
+
+class SimpleRNN(_RNNStack):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__("simple_rnn_seq", input_size, hidden_size,
+                         num_layers, direction, time_major, dropout,
+                         activation=activation, **kw)
+
+
+class LSTM(_RNNStack):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("lstm_seq", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_RNNStack):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("gru_seq", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)

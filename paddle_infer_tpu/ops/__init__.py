@@ -18,6 +18,7 @@ from . import loss                      # noqa: F401
 from . import creation                  # noqa: F401
 from . import distributed as _dist_ops  # noqa: F401
 from . import attention as _attention   # noqa: F401
+from . import rnn as _rnn_ops            # noqa: F401
 
 from .creation import *                 # noqa: F401,F403
 from .linalg import einsum              # noqa: F401

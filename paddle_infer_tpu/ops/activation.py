@@ -168,3 +168,42 @@ def _maxout_impl(x, groups, axis):
 
 
 defop("glu")(lambda x, axis=-1: jax.nn.glu(x, axis=axis))
+
+
+# dropout -------------------------------------------------------------------
+
+@defop("dropout")
+def _dropout(x, key, p=0.5, upscale=True, bcast_dims=()):
+    """Dropout with a counter-hash keep mask (splitmix32 over the linear
+    element index — see ops/pallas/flash_attention.dropout_keep): the mask
+    fuses into the surrounding elementwise ops on the VPU, where a
+    threefry ``jax.random.bernoulli`` mask materialisation measured a 33%
+    ERNIE-base step-time regression (round-3 sweep).  Reference:
+    phi dropout kernel + fused residual-dropout in
+    fused_multi_transformer_op.cu (cuRAND philox — the same
+    counter-based-RNG design point).
+
+    ``bcast_dims`` drop whole slices (dropout2d-style channel dropout).
+    """
+    from .pallas.flash_attention import _mix32
+
+    if key is None:
+        seed = jnp.uint32(0)
+    else:
+        seed = jax.random.bits(key, dtype=jnp.uint32)
+    shape = tuple(x.shape)
+    mshape = tuple(1 if i in bcast_dims else s for i, s in enumerate(shape))
+    lin = jnp.zeros(mshape, jnp.uint32)
+    stride = 1
+    for i in range(len(shape) - 1, -1, -1):
+        if mshape[i] > 1:
+            lin = lin + jax.lax.broadcasted_iota(
+                jnp.uint32, mshape, i) * jnp.uint32(stride)
+            stride *= mshape[i]
+    bits = _mix32(lin * jnp.uint32(0x9E3779B1) ^ seed)
+    thresh = jnp.uint32(min(int(round(float(p) * 4294967296.0)),
+                            4294967295))
+    keep = bits >= thresh
+    scale = (1.0 / (1.0 - float(p))) if upscale else 1.0
+    return jnp.where(keep, x * jnp.asarray(scale, x.dtype),
+                     jnp.zeros((), x.dtype))

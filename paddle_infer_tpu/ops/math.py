@@ -186,6 +186,14 @@ def _abs(x):
 @register_grad("abs")
 def _abs_grad(ctx, g):
     (x,) = ctx.inputs
+    if jnp.issubdtype(x._data.dtype, jnp.complexfloating):
+        # |z| cotangent under jax's CR convention: g · conj(z)/|z| (g is
+        # real); the real-sign rule would silently drop the phase
+        from ..core.tensor import Tensor
+
+        z = x._data
+        mag = jnp.maximum(jnp.abs(z), 1e-30)
+        return (Tensor(g._data * jnp.conj(z) / mag),)
     return (dispatch("multiply", g, dispatch("sign", x)),)
 
 

@@ -1,0 +1,136 @@
+"""Recurrent ops: SimpleRNN / LSTM / GRU full-sequence kernels.
+
+Reference: python/paddle/nn/layer/rnn.py (cells + RNN scan wrapper) and the
+cudnn-fused rnn op (paddle/phi/kernels/gpu/rnn_kernel.cu).  Paddle gate
+orders are kept: LSTM chunks [i, f, g, o], GRU chunks [r, z, c]
+(rnn.py LSTMCell.forward / GRUCell.forward).
+
+TPU-first: the whole sequence is one ``lax.scan`` — XLA compiles the loop
+once, no per-step dispatch — and the input projection for ALL timesteps is
+hoisted out of the scan into a single [s·b, in]×[in, gates] matmul (big
+MXU work up front; only the [b, h]×[h, gates] recurrent matmul stays in
+the loop).  Gradients come from jax.vjp through the scan
+(register_vjp_grad), which XLA reverses into the standard BPTT program.
+
+Layouts: x [batch, seq, input]; states [batch, hidden]; weights
+w_ih [gates·h, input], w_hh [gates·h, h]; biases [gates·h] — the paddle
+parameter shapes.  ``seq_lens`` (optional [batch] int32) freezes the carry
+and zeroes outputs at t >= len (paddle sequence_length semantics);
+``reverse`` runs time backwards (within the valid prefix).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import register_op, register_vjp_grad
+
+
+def _prep(x, w_ih, b_ih, reverse):
+    """[b, s, in] -> time-major input gates [s, b, gates·h]."""
+    xt = jnp.swapaxes(x, 0, 1)                       # [s, b, in]
+    if reverse:
+        xt = xt[::-1]
+    gx = jnp.einsum("sbi,gi->sbg", xt, w_ih)
+    if b_ih is not None:
+        gx = gx + b_ih
+    return gx
+
+
+def _mask_step(t, s, seq_lens, reverse, new, prev):
+    """Freeze the carry outside the valid prefix (t is scan index)."""
+    if seq_lens is None:
+        return new, new
+    real_t = (s - 1 - t) if reverse else t
+    live = (real_t < seq_lens)[:, None]
+    kept = jnp.where(live, new, prev)
+    out = jnp.where(live, new, jnp.zeros_like(new))
+    return kept, out
+
+
+def _unprep(out, reverse):
+    if reverse:
+        out = out[::-1]
+    return jnp.swapaxes(out, 0, 1)                   # [b, s, h]
+
+
+@register_op("lstm_seq")
+def _lstm_seq(x, h0, c0, w_ih, w_hh, b_ih, b_hh, seq_lens=None,
+              reverse=False):
+    s = x.shape[1]
+    hsz = h0.shape[-1]
+    gx = _prep(x, w_ih, b_ih, reverse)               # [s, b, 4h]
+    w_hh_t = w_hh.T
+    bh = 0 if b_hh is None else b_hh
+
+    def step(carry, inp):
+        h, c = carry
+        t, g_x = inp
+        gates = g_x + h @ w_hh_t + bh
+        i, f, g, o = (gates[:, 0:hsz], gates[:, hsz:2 * hsz],
+                      gates[:, 2 * hsz:3 * hsz], gates[:, 3 * hsz:])
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(g)
+        h_new = o * jnp.tanh(c_new)
+        h_kept, h_out = _mask_step(t, s, seq_lens, reverse, h_new, h)
+        c_kept, _ = _mask_step(t, s, seq_lens, reverse, c_new, c)
+        return (h_kept, c_kept), h_out
+
+    (h_n, c_n), out = jax.lax.scan(
+        step, (h0, c0), (jnp.arange(s), gx))
+    return _unprep(out, reverse), h_n, c_n
+
+
+register_vjp_grad("lstm_seq")
+
+
+@register_op("gru_seq")
+def _gru_seq(x, h0, w_ih, w_hh, b_ih, b_hh, seq_lens=None, reverse=False):
+    s = x.shape[1]
+    hsz = h0.shape[-1]
+    gx = _prep(x, w_ih, b_ih, reverse)               # [s, b, 3h]
+    w_hh_t = w_hh.T
+    bh = 0 if b_hh is None else b_hh
+
+    def step(carry, inp):
+        h = carry
+        t, g_x = inp
+        gh = h @ w_hh_t + bh
+        x_r, x_z, x_c = (g_x[:, :hsz], g_x[:, hsz:2 * hsz], g_x[:, 2 * hsz:])
+        h_r, h_z, h_c = (gh[:, :hsz], gh[:, hsz:2 * hsz], gh[:, 2 * hsz:])
+        r = jax.nn.sigmoid(x_r + h_r)
+        z = jax.nn.sigmoid(x_z + h_z)
+        c = jnp.tanh(x_c + r * h_c)
+        # paddle GRUCell: h = (pre_h - c) * z + c
+        h_new = (h - c) * z + c
+        h_kept, h_out = _mask_step(t, s, seq_lens, reverse, h_new, h)
+        return h_kept, h_out
+
+    h_n, out = jax.lax.scan(step, h0, (jnp.arange(s), gx))
+    return _unprep(out, reverse), h_n
+
+
+register_vjp_grad("gru_seq")
+
+
+@register_op("simple_rnn_seq")
+def _simple_rnn_seq(x, h0, w_ih, w_hh, b_ih, b_hh, seq_lens=None,
+                    reverse=False, activation="tanh"):
+    s = x.shape[1]
+    gx = _prep(x, w_ih, b_ih, reverse)               # [s, b, h]
+    w_hh_t = w_hh.T
+    bh = 0 if b_hh is None else b_hh
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def step(carry, inp):
+        h = carry
+        t, g_x = inp
+        h_new = act(g_x + h @ w_hh_t + bh)
+        h_kept, h_out = _mask_step(t, s, seq_lens, reverse, h_new, h)
+        return h_kept, h_out
+
+    h_n, out = jax.lax.scan(step, h0, (jnp.arange(s), gx))
+    return _unprep(out, reverse), h_n
+
+
+register_vjp_grad("simple_rnn_seq")

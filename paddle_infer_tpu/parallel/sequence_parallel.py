@@ -98,8 +98,8 @@ def _ulysses_local(q, k, v, axis_name, causal, scale):
         return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                                   tiled=True)
 
-    out = _sdpa(scatter(q), scatter(k), scatter(v), None, None, 0.0,
-                causal, scale)
+    out = _sdpa(scatter(q), scatter(k), scatter(v), None, None,
+                dropout_p=0.0, is_causal=causal, scale=scale)
     return gather(out)
 
 
@@ -123,7 +123,8 @@ def _seq_parallel_call(local_fn, q, k, v, mesh, axis_name, causal, scale,
     if mesh.shape[axis_name] == 1:
         from ..ops.attention import _sdpa
 
-        return _sdpa(q, k, v, None, None, 0.0, causal, scale)
+        return _sdpa(q, k, v, None, None, dropout_p=0.0, is_causal=causal,
+                     scale=scale)
     spec = spec if spec is not None else _resolve_specs(mesh, axis_name)
     fn = jax.shard_map(
         partial(local_fn, axis_name=axis_name, causal=causal, scale=scale),

@@ -1,0 +1,237 @@
+"""Sparse COO/CSR tensors + ops (reference: paddle/phi/core/
+sparse_coo_tensor.h, sparse_csr_tensor.h and the kernels under
+paddle/phi/kernels/sparse/ — unary ops, elementwise, matmul, conversions;
+Python surface python/paddle/sparse/).
+
+TPU-first: storage rides jax.experimental.sparse (BCOO/BCSR), whose ops
+lower to XLA gather/scatter/segment-sum programs — there is no
+vendor-sparse library on TPU, and for MXU-heavy work (spmm) BCOO's
+dense-output matmul is the idiomatic lowering.  Dense bridges
+(``to_dense``) make every framework op available as a fallback, mirroring
+the reference's coalesce + dense-kernel bridges.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "add", "subtract", "multiply", "matmul",
+           "masked_matmul", "relu", "tanh", "sin", "sqrt", "pow",
+           "transpose", "sum", "is_same_shape"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference sparse_coo_tensor.h): ``indices``
+    [sparse_dim, nnz] + ``values`` [nnz, ...]."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # ---- reference API surface
+    @property
+    def shape(self):
+        return tuple(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)          # [sparse_dim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        if len(self.shape) != 2:
+            raise ValueError("CSR requires a 2-D tensor")
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(
+            self._bcoo.sum_duplicates(nse=self._bcoo.nse)))
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates(
+            nse=self._bcoo.nse))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+    def _map_values(self, fn):
+        return SparseCooTensor(jsparse.BCOO(
+            (fn(self._bcoo.data), self._bcoo.indices),
+            shape=self._bcoo.shape))
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (reference sparse_csr_tensor.h): crows/cols/
+    values."""
+
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._bcsr = bcsr
+
+    @property
+    def shape(self):
+        return tuple(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        return self._bcsr.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcsr.nse)
+
+    def crows(self):
+        return Tensor(self._bcsr.indptr)
+
+    def cols(self):
+        return Tensor(self._bcsr.indices)
+
+    def values(self):
+        return Tensor(self._bcsr.data)
+
+    def to_dense(self):
+        return Tensor(self._bcsr.todense())
+
+    def to_sparse_coo(self, sparse_dim=2):
+        return SparseCooTensor(self._bcsr.to_bcoo())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """Build a COO tensor from [sparse_dim, nnz] indices (reference
+    python/paddle/sparse/creation.py)."""
+    idx = _arr(indices).astype(jnp.int32)
+    val = _arr(values)
+    if dtype is not None:
+        val = val.astype(dtype)
+    if shape is None:
+        shape = tuple(int(i) for i in np.asarray(idx.max(axis=1)) + 1)
+    return SparseCooTensor(jsparse.BCOO((val, idx.T), shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    val = _arr(values)
+    if dtype is not None:
+        val = val.astype(dtype)
+    return SparseCsrTensor(jsparse.BCSR(
+        (val, _arr(cols).astype(jnp.int32),
+         _arr(crows).astype(jnp.int32)), shape=tuple(shape)))
+
+
+def _coo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x._bcsr.to_bcoo()
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+# ------------------------------------------------------------- arithmetic
+
+def add(x, y):
+    out = _coo(x) + _coo(y)
+    return SparseCooTensor(out.sum_duplicates(nse=out.nse))
+
+
+def subtract(x, y):
+    yb = _coo(y)
+    neg = jsparse.BCOO((-yb.data, yb.indices), shape=yb.shape)
+    out = _coo(x) + neg
+    return SparseCooTensor(out.sum_duplicates(nse=out.nse))
+
+
+def multiply(x, y):
+    """Elementwise multiply; ``y`` sparse (same pattern) or dense."""
+    xb = _coo(x)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        dense = _coo(y).todense()
+    else:
+        dense = _arr(y)
+    gathered = dense[tuple(xb.indices[:, i]
+                           for i in range(xb.indices.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((xb.data * gathered, xb.indices),
+                                        shape=xb.shape))
+
+
+def matmul(x, y):
+    """spmm: sparse @ dense -> dense Tensor (reference
+    phi/kernels/sparse/matmul_kernel.h)."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return Tensor(_coo(x) @ _arr(y))
+    return Tensor(_arr(x) @ _coo(y).todense())
+
+
+def masked_matmul(x, y, mask):
+    """(x @ y) sampled at ``mask``'s sparsity pattern (reference SDDMM,
+    sparse/matmul_kernel.h masked_matmul)."""
+    mb = _coo(mask)
+    xa, ya = _arr(x), _arr(y)
+    rows, cols = mb.indices[:, 0], mb.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xa[rows], ya[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, mb.indices), shape=mb.shape))
+
+
+# ------------------------------------------------------------------ unary
+
+def _unary(fn):
+    def op(x):
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(jsparse.BCSR(
+                (fn(x._bcsr.data), x._bcsr.indices, x._bcsr.indptr),
+                shape=x._bcsr.shape))
+        return x._map_values(fn)
+
+    return op
+
+
+relu = _unary(lambda v: jnp.maximum(v, 0))
+tanh = _unary(jnp.tanh)
+sin = _unary(jnp.sin)
+sqrt = _unary(jnp.sqrt)
+
+
+def pow(x, factor):
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def transpose(x, perm):
+    xb = _coo(x)
+    return SparseCooTensor(jsparse.BCOO(
+        (xb.data, xb.indices[:, list(perm)]),
+        shape=tuple(xb.shape[p] for p in perm)))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    dense = _coo(x).todense()
+    out = dense.sum() if axis is None else dense.sum(
+        axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return Tensor(out)
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)

@@ -1,0 +1,263 @@
+"""paddle.distribution / paddle.fft / paddle.sparse parity namespaces
+(reference python/paddle/distribution/, python/paddle/fft.py,
+paddle/phi/kernels/sparse/) — numpy/scipy-free reference checks in the
+OpTest style."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import distribution as dist
+
+
+class TestDistributions:
+    def test_normal_moments_logprob_entropy(self):
+        d = dist.Normal(1.5, 2.0)
+        assert float(d.mean.numpy()) == 1.5
+        np.testing.assert_allclose(float(d.variance.numpy()), 4.0)
+        # log N(x=1.5 | 1.5, 2) = -log(2·sqrt(2π))
+        np.testing.assert_allclose(
+            float(d.log_prob(pit.Tensor(np.float32(1.5))).numpy()),
+            -math.log(2.0 * math.sqrt(2 * math.pi)), rtol=1e-6)
+        np.testing.assert_allclose(
+            float(d.entropy().numpy()),
+            0.5 * math.log(2 * math.pi * math.e * 4.0), rtol=1e-6)
+
+    def test_normal_sampling_statistics(self):
+        pit.seed(0)
+        d = dist.Normal(3.0, 0.5)
+        s = d.sample((20000,)).numpy()
+        assert abs(s.mean() - 3.0) < 0.02
+        assert abs(s.std() - 0.5) < 0.02
+
+    def test_normal_rsample_pathwise_grad(self):
+        pit.seed(1)
+        loc = pit.Tensor(np.float32(0.0))
+        loc.stop_gradient = False
+        d = dist.Normal(loc, 1.0)
+        d.rsample((64,)).sum().backward()
+        np.testing.assert_allclose(loc.grad.numpy(), 64.0)
+
+    def test_uniform(self):
+        d = dist.Uniform(2.0, 6.0)
+        np.testing.assert_allclose(float(d.mean.numpy()), 4.0)
+        np.testing.assert_allclose(float(d.variance.numpy()), 16 / 12)
+        np.testing.assert_allclose(
+            float(d.log_prob(pit.Tensor(np.float32(3.0))).numpy()),
+            -math.log(4.0), rtol=1e-6)
+        assert float(d.log_prob(pit.Tensor(np.float32(7.0))).numpy()) \
+            == -np.inf
+        pit.seed(2)
+        s = d.sample((5000,)).numpy()
+        assert s.min() >= 2.0 and s.max() < 6.0
+
+    def test_categorical(self):
+        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
+        d = dist.Categorical(logits=pit.Tensor(logits))
+        np.testing.assert_allclose(d.probs.numpy(), [0.2, 0.3, 0.5],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(
+            float(d.log_prob(np.array(2)).numpy()), math.log(0.5),
+            rtol=1e-6)
+        ent = -(0.2 * math.log(0.2) + 0.3 * math.log(0.3)
+                + 0.5 * math.log(0.5))
+        np.testing.assert_allclose(float(d.entropy().numpy()), ent,
+                                   rtol=1e-6)
+        pit.seed(3)
+        s = d.sample((8000,)).numpy()
+        freq = np.bincount(s, minlength=3) / len(s)
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+
+    def test_bernoulli(self):
+        d = dist.Bernoulli(0.3)
+        np.testing.assert_allclose(float(d.mean.numpy()), 0.3)
+        np.testing.assert_allclose(float(d.variance.numpy()), 0.21,
+                                   rtol=1e-5)
+        lp1 = float(d.log_prob(pit.Tensor(np.float32(1.0))).numpy())
+        np.testing.assert_allclose(lp1, math.log(0.3), rtol=1e-4)
+
+    def test_beta_dirichlet_multinomial_laplace_gumbel(self):
+        b = dist.Beta(2.0, 3.0)
+        np.testing.assert_allclose(float(b.mean.numpy()), 0.4, rtol=1e-6)
+        # Beta(2,3) pdf at 0.5: x(1-x)^2 / B(2,3), B = Γ2Γ3/Γ5 = 1·2/24
+        np.testing.assert_allclose(
+            float(b.prob(pit.Tensor(np.float32(0.5))).numpy()),
+            0.5 * 0.25 / (2 / 24), rtol=1e-5)
+        dd = dist.Dirichlet(pit.Tensor(np.array([1.0, 2.0, 3.0],
+                                                np.float32)))
+        np.testing.assert_allclose(dd.mean.numpy(), [1 / 6, 2 / 6, 3 / 6],
+                                   rtol=1e-6)
+        m = dist.Multinomial(10, pit.Tensor(np.array([0.5, 0.5],
+                                                     np.float32)))
+        np.testing.assert_allclose(m.mean.numpy(), [5.0, 5.0])
+        # Multinomial(10, .5/.5) at [5,5]: C(10,5)/2^10
+        np.testing.assert_allclose(
+            float(m.prob(pit.Tensor(np.array([5.0, 5.0],
+                                             np.float32))).numpy()),
+            252 / 1024, rtol=1e-5)
+        lap = dist.Laplace(0.0, 1.0)
+        np.testing.assert_allclose(
+            float(lap.log_prob(pit.Tensor(np.float32(0.0))).numpy()),
+            -math.log(2.0), rtol=1e-6)
+        g = dist.Gumbel(0.0, 1.0)
+        pit.seed(4)
+        s = g.sample((20000,)).numpy()
+        assert abs(s.mean() - 0.5772) < 0.03
+
+    def test_kl_normal_exact(self):
+        p = dist.Normal(0.0, 1.0)
+        q = dist.Normal(1.0, 2.0)
+        # 0.5(σp²/σq² + (μ diff)²/σq² - 1 - ln σp²/σq²)
+        expect = 0.5 * (0.25 + 0.25 - 1 - math.log(0.25))
+        np.testing.assert_allclose(float(dist.kl_divergence(p, q).numpy()),
+                                   expect, rtol=1e-6)
+
+    def test_kl_montecarlo_consistency(self):
+        """KL rules vs Monte-Carlo estimate E_p[log p - log q]."""
+        pit.seed(5)
+        cases = [
+            (dist.Laplace(0.0, 1.0), dist.Laplace(0.5, 2.0)),
+            (dist.Beta(2.0, 2.0), dist.Beta(3.0, 1.5)),
+        ]
+        for p, q in cases:
+            s = p.sample((40000,))
+            mc = float((p.log_prob(s) - q.log_prob(s)).numpy().mean())
+            kl = float(dist.kl_divergence(p, q).numpy())
+            assert abs(mc - kl) < 0.05, (type(p).__name__, mc, kl)
+
+    def test_kl_categorical_and_unregistered(self):
+        p = dist.Categorical(probs=pit.Tensor(np.array([0.5, 0.5],
+                                                       np.float32)))
+        q = dist.Categorical(probs=pit.Tensor(np.array([0.9, 0.1],
+                                                       np.float32)))
+        expect = 0.5 * math.log(0.5 / 0.9) + 0.5 * math.log(0.5 / 0.1)
+        np.testing.assert_allclose(float(dist.kl_divergence(p, q).numpy()),
+                                   expect, rtol=1e-5)
+        with pytest.raises(NotImplementedError):
+            dist.kl_divergence(p, dist.Normal(0.0, 1.0))
+
+
+class TestFFT:
+    def test_fft_matches_numpy(self):
+        x = np.random.RandomState(0).randn(16).astype(np.float32)
+        out = pit.fft.fft(pit.Tensor(x)).numpy()
+        np.testing.assert_allclose(out, np.fft.fft(x), atol=1e-4)
+
+    def test_rfft_irfft_roundtrip(self):
+        x = np.random.RandomState(1).randn(4, 32).astype(np.float32)
+        f = pit.fft.rfft(pit.Tensor(x))
+        assert f.shape[-1] == 17
+        back = pit.fft.irfft(f, n=32).numpy()
+        np.testing.assert_allclose(back, x, atol=1e-5)
+
+    def test_fft2_and_norm(self):
+        x = np.random.RandomState(2).randn(8, 8).astype(np.float32)
+        out = pit.fft.fft2(pit.Tensor(x), norm="ortho").numpy()
+        np.testing.assert_allclose(out, np.fft.fft2(x, norm="ortho"),
+                                   atol=1e-4)
+
+    def test_fftfreq_shift(self):
+        np.testing.assert_allclose(pit.fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5))
+        x = np.arange(8.0, dtype=np.float32)
+        np.testing.assert_allclose(
+            pit.fft.fftshift(pit.Tensor(x)).numpy(), np.fft.fftshift(x))
+
+    def test_fft_gradient(self):
+        x = pit.Tensor(np.random.RandomState(3).randn(16)
+                       .astype(np.float32))
+        x.stop_gradient = False
+        # |rfft(x)|^2 summed — real loss through a complex op
+        f = pit.fft.rfft(x)
+        (f.abs() ** 2.0).sum().backward()
+        g = x.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+        # Parseval: d/dx sum|F|^2 = 2·N·x for rfft up to hermitian terms —
+        # check numerically instead
+        xn = x.numpy()
+
+        def loss(a):
+            return float((np.abs(np.fft.rfft(a)) ** 2).sum())
+
+        eps = 1e-3
+        for i in (0, 5):
+            xp, xm = xn.copy(), xn.copy()
+            xp[i] += eps
+            xm[i] -= eps
+            np.testing.assert_allclose(g[i],
+                                       (loss(xp) - loss(xm)) / (2 * eps),
+                                       rtol=1e-2, atol=1e-2)
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        dense = np.array([[0, 2, 0], [3, 0, 4]], np.float32)
+        idx = np.array([[0, 1, 1], [1, 0, 2]], np.int64)
+        vals = np.array([2.0, 3.0, 4.0], np.float32)
+        sp = pit.sparse.sparse_coo_tensor(idx, vals, shape=(2, 3))
+        np.testing.assert_array_equal(sp.to_dense().numpy(), dense)
+        assert sp.nnz == 3
+        np.testing.assert_array_equal(sp.indices().numpy(), idx)
+        np.testing.assert_array_equal(sp.values().numpy(), vals)
+
+    def test_csr_roundtrip_and_convert(self):
+        dense = np.array([[1, 0, 2], [0, 0, 3]], np.float32)
+        sp = pit.sparse.sparse_csr_tensor(
+            [0, 2, 3], [0, 2, 2], [1.0, 2.0, 3.0], shape=(2, 3))
+        np.testing.assert_array_equal(sp.to_dense().numpy(), dense)
+        coo = sp.to_sparse_coo()
+        np.testing.assert_array_equal(coo.to_dense().numpy(), dense)
+        back = coo.to_sparse_csr()
+        np.testing.assert_array_equal(back.crows().numpy(), [0, 2, 3])
+        np.testing.assert_array_equal(back.cols().numpy(), [0, 2, 2])
+
+    def test_arithmetic(self):
+        a_d = np.array([[1, 0], [0, 2]], np.float32)
+        b_d = np.array([[0, 3], [0, 1]], np.float32)
+        a = pit.sparse.sparse_coo_tensor([[0, 1], [0, 1]], [1.0, 2.0],
+                                         shape=(2, 2))
+        b = pit.sparse.sparse_coo_tensor([[0, 1], [1, 1]], [3.0, 1.0],
+                                         shape=(2, 2))
+        np.testing.assert_array_equal(
+            pit.sparse.add(a, b).to_dense().numpy(), a_d + b_d)
+        np.testing.assert_array_equal(
+            pit.sparse.subtract(a, b).to_dense().numpy(), a_d - b_d)
+        dense = np.array([[2, 0], [5, 7]], np.float32)
+        np.testing.assert_array_equal(
+            pit.sparse.multiply(a, pit.Tensor(dense)).to_dense().numpy(),
+            a_d * dense)
+
+    def test_spmm_and_masked(self):
+        rng = np.random.RandomState(4)
+        dense_a = (rng.rand(4, 5) * (rng.rand(4, 5) > 0.5)).astype(
+            np.float32)
+        idx = np.nonzero(dense_a)
+        sp = pit.sparse.sparse_coo_tensor(
+            np.stack(idx), dense_a[idx], shape=dense_a.shape)
+        y = rng.randn(5, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            pit.sparse.matmul(sp, pit.Tensor(y)).numpy(), dense_a @ y,
+            rtol=1e-5, atol=1e-5)
+        # SDDMM: (x yᵀ) at mask pattern
+        x1 = rng.randn(4, 6).astype(np.float32)
+        y1 = rng.randn(6, 5).astype(np.float32)
+        out = pit.sparse.masked_matmul(pit.Tensor(x1), pit.Tensor(y1), sp)
+        full = x1 @ y1
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   full * (dense_a != 0), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_unary_and_transpose_sum(self):
+        sp = pit.sparse.sparse_coo_tensor([[0, 1], [1, 0]], [-2.0, 3.0],
+                                          shape=(2, 2))
+        np.testing.assert_array_equal(
+            pit.sparse.relu(sp).to_dense().numpy(),
+            [[0, 0], [3, 0]])
+        np.testing.assert_allclose(
+            pit.sparse.tanh(sp).values().numpy(),
+            np.tanh([-2.0, 3.0]), rtol=1e-6)
+        t = pit.sparse.transpose(sp, (1, 0))
+        np.testing.assert_array_equal(t.to_dense().numpy(),
+                                      [[0, 3], [-2, 0]])
+        assert float(pit.sparse.sum(sp).numpy()) == 1.0

@@ -1,0 +1,162 @@
+"""PyLayer user-defined autograd functions (reference
+paddle/fluid/eager/pylayer/py_layer_node.h, pybind/eager_py_layer.cc).
+
+OpTest-style: analytic grads from the user backward checked against
+finite differences and against the equivalent built-in-op composition.
+"""
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import PyLayer
+
+
+class Cube(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        ctx.save_for_backward(x)
+        return x * x * x
+
+    @staticmethod
+    def backward(ctx, grad):
+        (x,) = ctx.saved_tensor()
+        return 3.0 * x * x * grad
+
+
+def _t(arr, requires=True):
+    t = pit.Tensor(np.asarray(arr, np.float32))
+    t.stop_gradient = not requires
+    return t
+
+
+def test_forward_backward_matches_composition():
+    x = _t(np.random.RandomState(0).randn(4, 5))
+    y = Cube.apply(x)
+    y.sum().backward()
+    g = x.grad.numpy()
+
+    x2 = _t(x.numpy())
+    (x2 * x2 * x2).sum().backward()
+    np.testing.assert_allclose(g, x2.grad.numpy(), rtol=1e-6)
+
+
+def test_numeric_gradient():
+    rng = np.random.RandomState(1)
+    xn = rng.randn(3, 3).astype(np.float32)
+    co = rng.randn(3, 3).astype(np.float32)
+
+    def f(arr):
+        return float((Cube.apply(_t(arr, requires=False))
+                      * pit.Tensor(co)).sum().numpy())
+
+    x = _t(xn)
+    (Cube.apply(x) * pit.Tensor(co)).sum().backward()
+    g = x.grad.numpy()
+    eps = 1e-3
+    for i in [(0, 0), (1, 2), (2, 1)]:
+        xp, xm = xn.copy(), xn.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        num = (f(xp) - f(xm)) / (2 * eps)
+        np.testing.assert_allclose(g[i], num, rtol=5e-2, atol=1e-2)
+
+
+def test_multiple_inputs_and_outputs():
+    class MulAdd(PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            ctx.save_for_backward(a, b)
+            return a * b, a + b
+
+        @staticmethod
+        def backward(ctx, gmul, gadd):
+            a, b = ctx.saved_tensor()
+            return gmul * b + gadd, gmul * a + gadd
+
+    a = _t([2.0, 3.0])
+    b = _t([4.0, 5.0])
+    m, s = MulAdd.apply(a, b)
+    (m.sum() + 2.0 * s.sum()).backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.array([4, 5]) + 2.0)
+    np.testing.assert_allclose(b.grad.numpy(), np.array([2, 3]) + 2.0)
+
+
+def test_none_grad_for_unused_input():
+    class First(PyLayer):
+        @staticmethod
+        def forward(ctx, a, b):
+            return a * 2.0
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2.0, None
+
+    a = _t([1.0, 2.0])
+    b = _t([3.0, 4.0])
+    First.apply(a, b).sum().backward()
+    np.testing.assert_allclose(a.grad.numpy(), [2.0, 2.0])
+    assert b.grad is None
+
+
+def test_mark_non_differentiable():
+    class WithAux(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            y = x * 2.0
+            aux = x > 0.0
+            ctx.mark_non_differentiable(aux)
+            return y, aux
+
+        @staticmethod
+        def backward(ctx, gy):
+            return gy * 2.0
+
+    x = _t([1.0, -1.0])
+    y, aux = WithAux.apply(x)
+    assert aux.stop_gradient
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_non_tensor_args_and_ctx_attrs():
+    class Scale(PyLayer):
+        @staticmethod
+        def forward(ctx, x, factor):
+            ctx.factor = factor
+            return x * factor
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * ctx.factor
+
+    x = _t([1.0, 2.0])
+    Scale.apply(x, 2.5).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.5, 2.5])
+
+
+def test_chains_with_builtin_ops():
+    x = _t(np.random.RandomState(3).randn(4))
+    y = (Cube.apply(x * 2.0) + 1.0).sum()
+    y.backward()
+    xn = x.numpy()
+    np.testing.assert_allclose(x.grad.numpy(), 3 * (2 * xn) ** 2 * 2,
+                               rtol=1e-5)
+
+
+def test_double_backward_raises_without_retain():
+    x = _t([1.0, 2.0])
+    y = Cube.apply(x)
+    y.sum().backward()
+    with pytest.raises(RuntimeError):
+        y.sum().backward()
+
+
+def test_cannot_instantiate():
+    with pytest.raises(RuntimeError):
+        Cube()
+
+
+def test_stop_gradient_input_no_tape():
+    x = _t([1.0, 2.0], requires=False)
+    y = Cube.apply(x)
+    assert y.stop_gradient

@@ -1,0 +1,200 @@
+"""RNN family: SimpleRNN / LSTM / GRU cells + fused scan stacks.
+
+Reference test pattern: unittests/rnn/test_rnn_nets.py — numpy reference
+cells stepped in Python vs the fused op, values + grads; paddle gate
+orders LSTM [i, f, g, o], GRU [r, z, c] (python/paddle/nn/layer/rnn.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import nn
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    hs = h.shape[-1]
+    outs = []
+    for t in range(x.shape[1]):
+        g = x[:, t] @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, gg, o = (g[:, :hs], g[:, hs:2 * hs], g[:, 2 * hs:3 * hs],
+                       g[:, 3 * hs:])
+        c = _sig(f) * c + _sig(i) * np.tanh(gg)
+        h = _sig(o) * np.tanh(c)
+        outs.append(h)
+    return np.stack(outs, 1), h, c
+
+
+def _np_gru(x, h, w_ih, w_hh, b_ih, b_hh):
+    hs = h.shape[-1]
+    outs = []
+    for t in range(x.shape[1]):
+        gx = x[:, t] @ w_ih.T + b_ih
+        gh = h @ w_hh.T + b_hh
+        r = _sig(gx[:, :hs] + gh[:, :hs])
+        z = _sig(gx[:, hs:2 * hs] + gh[:, hs:2 * hs])
+        cc = np.tanh(gx[:, 2 * hs:] + r * gh[:, 2 * hs:])
+        h = (h - cc) * z + cc
+        outs.append(h)
+    return np.stack(outs, 1), h
+
+
+def _weights(layer, sfx=""):
+    g = lambda n: getattr(layer, n + sfx).numpy()
+    return (g("weight_ih_l0"), g("weight_hh_l0"), g("bias_ih_l0"),
+            g("bias_hh_l0"))
+
+
+def test_lstm_matches_numpy():
+    pit.seed(0)
+    b, s, isz, hsz = 2, 7, 5, 4
+    lstm = nn.LSTM(isz, hsz)
+    x = np.random.RandomState(0).randn(b, s, isz).astype(np.float32)
+    out, (h_n, c_n) = lstm(pit.Tensor(x))
+    w = _weights(lstm)
+    ref_o, ref_h, ref_c = _np_lstm(x, np.zeros((b, hsz), np.float32),
+                                   np.zeros((b, hsz), np.float32), *w)
+    np.testing.assert_allclose(out.numpy(), ref_o, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(h_n.numpy()[0], ref_h, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(c_n.numpy()[0], ref_c, atol=1e-5, rtol=1e-5)
+
+
+def test_gru_matches_numpy():
+    pit.seed(1)
+    b, s, isz, hsz = 3, 5, 4, 6
+    gru = nn.GRU(isz, hsz)
+    x = np.random.RandomState(1).randn(b, s, isz).astype(np.float32)
+    out, h_n = gru(pit.Tensor(x))
+    w = _weights(gru)
+    ref_o, ref_h = _np_gru(x, np.zeros((b, hsz), np.float32), *w)
+    np.testing.assert_allclose(out.numpy(), ref_o, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(h_n.numpy()[0], ref_h, atol=1e-5, rtol=1e-5)
+
+
+def test_simple_rnn_matches_cell_loop():
+    """The fused scan stack equals the generic RNN(cell) eager loop —
+    cell and stack share no code path."""
+    pit.seed(2)
+    b, s, isz, hsz = 2, 6, 3, 5
+    stack = nn.SimpleRNN(isz, hsz)
+    cell = nn.SimpleRNNCell(isz, hsz)
+    cell.weight_ih.set_value(stack.weight_ih_l0.numpy())
+    cell.weight_hh.set_value(stack.weight_hh_l0.numpy())
+    cell.bias_ih.set_value(stack.bias_ih_l0.numpy())
+    cell.bias_hh.set_value(stack.bias_hh_l0.numpy())
+    x = np.random.RandomState(2).randn(b, s, isz).astype(np.float32)
+    out_s, _ = stack(pit.Tensor(x))
+    out_c, _ = nn.RNN(cell)(pit.Tensor(x))
+    np.testing.assert_allclose(out_s.numpy(), out_c.numpy(), atol=1e-5,
+                               rtol=1e-5)
+
+
+def test_lstm_cell_single_step_matches_numpy():
+    pit.seed(3)
+    b, isz, hsz = 2, 4, 3
+    cell = nn.LSTMCell(isz, hsz)
+    x = np.random.RandomState(3).randn(b, isz).astype(np.float32)
+    h0 = np.random.RandomState(4).randn(b, hsz).astype(np.float32)
+    c0 = np.random.RandomState(5).randn(b, hsz).astype(np.float32)
+    h, (h2, c2) = cell(pit.Tensor(x), (pit.Tensor(h0), pit.Tensor(c0)))
+    ref_o, ref_h, ref_c = _np_lstm(
+        x[:, None], h0, c0, cell.weight_ih.numpy(), cell.weight_hh.numpy(),
+        cell.bias_ih.numpy(), cell.bias_hh.numpy())
+    np.testing.assert_allclose(h.numpy(), ref_h, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(c2.numpy(), ref_c, atol=1e-5, rtol=1e-5)
+
+
+def test_bidirectional_shapes_and_reverse_consistency():
+    pit.seed(4)
+    b, s, isz, hsz = 2, 5, 3, 4
+    bi = nn.GRU(isz, hsz, direction="bidirect")
+    x = np.random.RandomState(6).randn(b, s, isz).astype(np.float32)
+    out, h_n = bi(pit.Tensor(x))
+    assert tuple(out.shape) == (b, s, 2 * hsz)
+    assert tuple(h_n.shape) == (2, b, hsz)
+    # the reverse half equals running the flipped sequence forward
+    w = (bi.weight_ih_l0_reverse.numpy(), bi.weight_hh_l0_reverse.numpy(),
+         bi.bias_ih_l0_reverse.numpy(), bi.bias_hh_l0_reverse.numpy())
+    ref_o, ref_h = _np_gru(x[:, ::-1], np.zeros((b, hsz), np.float32), *w)
+    np.testing.assert_allclose(out.numpy()[:, :, hsz:], ref_o[:, ::-1],
+                               atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(h_n.numpy()[1], ref_h, atol=1e-5, rtol=1e-5)
+
+
+def test_multilayer_stack():
+    pit.seed(5)
+    b, s, isz, hsz = 2, 4, 3, 5
+    lstm = nn.LSTM(isz, hsz, num_layers=2)
+    x = np.random.RandomState(7).randn(b, s, isz).astype(np.float32)
+    out, (h_n, c_n) = lstm(pit.Tensor(x))
+    assert tuple(out.shape) == (b, s, hsz)
+    assert tuple(h_n.shape) == (2, b, hsz)
+    # layer 1 output == manually feeding layer 0's output through layer 1
+    w0 = [getattr(lstm, f"{n}_l0").numpy()
+          for n in ("weight_ih", "weight_hh", "bias_ih", "bias_hh")]
+    w1 = [getattr(lstm, f"{n}_l1").numpy()
+          for n in ("weight_ih", "weight_hh", "bias_ih", "bias_hh")]
+    z = np.zeros((b, hsz), np.float32)
+    o0, _, _ = _np_lstm(x, z, z, *w0)
+    o1, _, _ = _np_lstm(o0, z, z, *w1)
+    np.testing.assert_allclose(out.numpy(), o1, atol=1e-5, rtol=1e-5)
+
+
+def test_sequence_length_masking():
+    pit.seed(6)
+    b, s, isz, hsz = 2, 6, 3, 4
+    gru = nn.GRU(isz, hsz)
+    x = np.random.RandomState(8).randn(b, s, isz).astype(np.float32)
+    lens = np.array([6, 3], np.int32)
+    out, h_n = gru(pit.Tensor(x), sequence_length=pit.Tensor(lens))
+    w = _weights(gru)
+    # row 1: state frozen at t=3, outputs zero beyond
+    ref_o, ref_h = _np_gru(x[1:2, :3], np.zeros((1, hsz), np.float32), *w)
+    np.testing.assert_allclose(out.numpy()[1, :3], ref_o[0], atol=1e-5,
+                               rtol=1e-5)
+    np.testing.assert_array_equal(out.numpy()[1, 3:], 0.0)
+    np.testing.assert_allclose(h_n.numpy()[0, 1], ref_h[0], atol=1e-5,
+                               rtol=1e-5)
+
+
+def test_lstm_numeric_gradient():
+    """OpTest numeric-grad check through the scan (op_test.py:1899)."""
+    pit.seed(7)
+    b, s, isz, hsz = 1, 4, 3, 3
+    lstm = nn.LSTM(isz, hsz)
+    xn = np.random.RandomState(9).randn(b, s, isz).astype(np.float32)
+
+    def f(arr):
+        out, _ = lstm(pit.Tensor(arr))
+        return float(out.sum().numpy())
+
+    x = pit.Tensor(xn)
+    x.stop_gradient = False
+    out, _ = lstm(x)
+    out.sum().backward()
+    g = x.grad.numpy()
+    eps = 1e-3
+    rng = np.random.RandomState(10)
+    for _ in range(4):
+        i = (0, rng.randint(s), rng.randint(isz))
+        xp, xm = xn.copy(), xn.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        np.testing.assert_allclose(g[i], (f(xp) - f(xm)) / (2 * eps),
+                                   rtol=5e-2, atol=1e-2)
+    # weight grads flow too
+    for p in lstm.parameters():
+        assert p.grad is not None
+        assert np.isfinite(p.grad.numpy()).all()
+
+
+@pytest.mark.parametrize("cls", [nn.SimpleRNN, nn.GRU, nn.LSTM])
+def test_time_major_roundtrip(cls):
+    pit.seed(8)
+    m = cls(3, 4, time_major=True)
+    x = np.random.RandomState(11).randn(5, 2, 3).astype(np.float32)
+    out, _ = m(pit.Tensor(x))
+    assert tuple(out.shape) == (5, 2, 4)
