@@ -45,5 +45,10 @@ def __getattr__(name):
         from . import auto_parallel
 
         return getattr(auto_parallel, name)
+    if name in ("ShardedSparseTable", "SparseEmbedding"):
+        # paddle.distributed.ps sparse-table surface (TPU-native PS)
+        from ..parallel import sparse_table
+
+        return getattr(sparse_table, name)
     raise AttributeError(f"module 'paddle_infer_tpu.distributed' has no "
                          f"attribute '{name}'")

@@ -130,5 +130,94 @@ class ElasticManager:
         return exit_code != 0 and self.healthy()
 
 
-__all__ = ["ElasticManager", "FileStore", "ELASTIC_AUTO_PARALLEL_EXIT_CODE",
+class ElasticLauncher:
+    """Spawn + watch + RELAUNCH worker processes (the reference launch
+    watcher: fleet/elastic/manager.py:100-115 watches exit codes and
+    relaunches local procs; test_fleet_launch_elastic.sh drives it).
+
+    ``run(func, args)`` starts ``nprocs`` worker processes, heartbeats the
+    store, and on a worker death applies ``ElasticManager.should_restart``:
+    nonzero exits (and the auto-parallel exit code) get the worker process
+    actually re-executed — a fresh OS process, new pid — up to
+    ``max_restarts`` times; exit 0 marks the replica done.
+    """
+
+    def __init__(self, nprocs: int, np_spec=None, store: Optional[FileStore]
+                 = None, node_id: str = "node0", max_restarts: int = 3,
+                 start_method: str = "fork", poll_interval: float = 0.05,
+                 timeout: float = ELASTIC_TIMEOUT):
+        import tempfile
+
+        self.nprocs = nprocs
+        self.max_restarts = max_restarts
+        self.poll_interval = poll_interval
+        self.start_method = start_method
+        store = store or FileStore(tempfile.mkdtemp(prefix="pit_elastic_"))
+        # membership is per NODE (this launcher heartbeats as one node);
+        # nprocs is the per-node worker count, not the np spec
+        self.manager = ElasticManager(node_id, np_spec or 1, store,
+                                      timeout=timeout)
+
+    def _start(self, ctx, func, args, replica, attempt):
+        import os
+
+        def entry(func, args, replica, attempt):
+            os.environ["PTI_REPLICA_ID"] = str(replica)
+            os.environ["PTI_ATTEMPT"] = str(attempt)
+            func(*args)
+
+        p = ctx.Process(target=entry, args=(func, args, replica, attempt),
+                        daemon=True)
+        p.start()
+        return p
+
+    def run(self, func, args=()):
+        """Returns {"restarts", "attempts" (per replica), "pids" (history
+        per replica)}; raises if a replica exhausts max_restarts or exits
+        unrestartably."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context(self.start_method)
+        self.manager.register()
+        procs = {i: self._start(ctx, func, args, i, 1)
+                 for i in range(self.nprocs)}
+        attempts = {i: 1 for i in range(self.nprocs)}
+        pids = {i: [procs[i].pid] for i in range(self.nprocs)}
+        done = set()
+        restarts = 0
+        try:
+            while len(done) < self.nprocs:
+                self.manager.poll()
+                for i, p in list(procs.items()):
+                    if i in done or p.is_alive():
+                        continue
+                    code = p.exitcode
+                    # killed-by-signal exitcodes are negative (reference
+                    # watcher treats them as failures too)
+                    if code == 0:
+                        done.add(i)
+                        continue
+                    if (self.manager.should_restart(code if code >= 0
+                                                    else 1)
+                            and attempts[i] <= self.max_restarts):
+                        attempts[i] += 1
+                        restarts += 1
+                        procs[i] = self._start(ctx, func, args, i,
+                                               attempts[i])
+                        pids[i].append(procs[i].pid)
+                    else:
+                        raise RuntimeError(
+                            f"replica {i} failed (exit {code}) after "
+                            f"{attempts[i]} attempts")
+                time.sleep(self.poll_interval)
+        finally:
+            for p in procs.values():
+                if p.is_alive():
+                    p.terminate()
+            self.manager.exit()
+        return {"restarts": restarts, "attempts": attempts, "pids": pids}
+
+
+__all__ = ["ElasticManager", "ElasticLauncher", "FileStore",
+           "ELASTIC_AUTO_PARALLEL_EXIT_CODE",
            "ELASTIC_LEVEL_FAULT_TOLERANCE", "ELASTIC_LEVEL_ELASTIC"]

@@ -2,12 +2,12 @@
 fluid/dataloader/dataloader_iter.py:148,342 — single-process and
 multi-worker iterators).
 
-Worker model: the reference forks worker *processes* feeding shared-memory
-queues.  Here workers are host *threads* running numpy collation (numpy
-releases the GIL) with a bounded prefetch queue; device transfer happens in
-the consumer so arrays land in HBM right before use.  This keeps the host
-busy exactly while the TPU computes — the same pipelining the reference gets
-from its DataLoaderIter + pin-memory thread.
+Worker model (matches the reference): ``num_workers > 0`` forks worker
+*processes* feeding shared-memory queues (io/worker.py MultiprocessIter) so
+Python-heavy transform pipelines scale across cores; device transfer
+happens in the consumer so arrays land in HBM right before use.
+``worker_mode="thread"`` keeps the lighter thread pool (numpy-only
+pipelines where collation releases the GIL).
 """
 from __future__ import annotations
 
@@ -45,13 +45,19 @@ class DataLoader:
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn: Optional[Callable] = None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=False, timeout=0, worker_init_fn=None,
-                 to_tensor=True):
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 to_tensor=True, worker_mode="process"):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
         self.to_tensor = to_tensor
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout  # 0/None = no deadline (reference default)
+        assert worker_mode in ("process", "thread")
+        self.worker_mode = worker_mode
+        self._last_iter = None      # exposes worker pids for tests
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if not self._iterable_mode:
             if batch_sampler is not None:
@@ -91,6 +97,8 @@ class DataLoader:
             yield from self._iter_iterable()
         elif self.num_workers == 0:
             yield from self._iter_single()
+        elif self.worker_mode == "process":
+            yield from self._iter_multiprocess()
         else:
             yield from self._iter_threaded()
 
@@ -108,6 +116,21 @@ class DataLoader:
         for indices in self.batch_sampler:
             batch = [self.dataset[i] for i in indices]
             yield self._wrap(self.collate_fn(batch))
+
+    def _iter_multiprocess(self):
+        """Worker processes + shared-memory queues (reference
+        _DataLoaderIterMultiProcess, dataloader_iter.py:342)."""
+        from .worker import MultiprocessIter
+
+        it = MultiprocessIter(
+            self.dataset, self.collate_fn, list(self.batch_sampler),
+            num_workers=self.num_workers,
+            prefetch_factor=self.prefetch_factor,
+            use_shared_memory=self.use_shared_memory,
+            worker_init_fn=self.worker_init_fn, timeout=self.timeout)
+        self._last_iter = it
+        for batch in it:
+            yield self._wrap(batch)
 
     def _iter_threaded(self):
         """Bounded-queue thread pool: in-order delivery via per-batch slots

@@ -38,8 +38,45 @@ def _constrain(x, spec):
         return s
 
     clean = tuple(resolve(s) for s in spec)
+    # activation constraints are hints: drop any axis that does not divide
+    # its dimension (e.g. bs=1 serving under a dp>1 training mesh) instead
+    # of erroring like a hard GSPMD constraint would
+    if ndim is not None:
+        shape = tuple(x.shape)
+        sizes = dict(mesh.shape)
+
+        def fits(s, dim):
+            axes = s if isinstance(s, tuple) else (s,)
+            total = 1
+            for a in axes:
+                total *= sizes.get(a, 1)
+            return total > 0 and dim % total == 0
+
+        clean = tuple(
+            s if s is None or fits(s, shape[i]) else None
+            for i, s in enumerate(clean))
+    # Inside a partial-manual shard_map (the pipeline's manual-"pp" body),
+    # constraints must be built on the trace's abstract mesh — a concrete
+    # NamedSharding would reject the value's pp-varying vma — and must not
+    # mention the manual axes themselves (the value is already manual
+    # there).
+    sh_mesh = mesh
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        manual = set(getattr(am, "manual_axes", ()) or ())
+    except Exception:
+        manual = set()
+    if manual:
+        def drop(s):
+            if isinstance(s, tuple):
+                kept = tuple(a for a in s if a not in manual)
+                return kept or None
+            return None if s in manual else s
+
+        clean = tuple(drop(s) for s in clean)
+        sh_mesh = am
     return jax.lax.with_sharding_constraint(
-        x, NamedSharding(mesh, PartitionSpec(*clean)))
+        x, NamedSharding(sh_mesh, PartitionSpec(*clean)))
 
 
 def _axes_present(s, names):

@@ -420,11 +420,9 @@ class FleetTrainStep:
                 pass
         return Tensor(loss)
 
-    def cost_analysis(self, *batch, **static_kwargs):
-        """XLA's per-step cost analysis (flops, bytes accessed) for the
-        compiled executable serving this batch signature — the
-        compiler-derived backing for MFU claims (vs the hand 6·N·T
-        arithmetic).  Requires the signature to have been stepped once."""
+    def _compiled_executable(self, batch, static_kwargs):
+        """The compiled executable serving this batch signature (must have
+        been stepped once; jax caches the lower+compile)."""
         arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
                        for b in batch)
         sig = tuple((a.shape, str(a.dtype)) for a in arrays) + \
@@ -432,11 +430,24 @@ class FleetTrainStep:
         fn = self._cache.get(sig)
         if fn is None:
             raise RuntimeError("step this batch signature once first")
-        lowered = fn.lower(
+        return fn.lower(
             self.params, self.opt_state, prandom.next_key(),
             jnp.asarray(0.0, jnp.float32), jnp.asarray(0, jnp.int32),
-            arrays)
-        return lowered.compile().cost_analysis()
+            arrays).compile()
+
+    def cost_analysis(self, *batch, **static_kwargs):
+        """XLA's per-step cost analysis (flops, bytes accessed) — the
+        compiler-derived backing for MFU claims (vs the hand 6·N·T
+        arithmetic)."""
+        return self._compiled_executable(batch, static_kwargs) \
+            .cost_analysis()
+
+    def memory_analysis(self, *batch, **static_kwargs):
+        """XLA's compiled-executable memory breakdown (temp/argument/output
+        bytes) — the compiler-reported peak-buffer backing for pipeline
+        schedule memory claims (docs/PIPELINE.md)."""
+        return self._compiled_executable(batch, static_kwargs) \
+            .memory_analysis()
 
     # ------------------------------------------------------------ state i/o
     def sync_params_to_model(self):

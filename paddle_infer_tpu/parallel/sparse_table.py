@@ -1,0 +1,290 @@
+"""Mesh-sharded sparse embedding tables — the TPU-native parameter server.
+
+Reference: the brpc parameter-server stack's sparse tables —
+`MemorySparseTable` (paddle/fluid/distributed/ps/table/memory_sparse_table.h:
+key→row hash shards with per-row optimizer state), the CTR accessors
+(ps/table/ctr_accessor.h: per-slot adagrad/sgd rules), and the Python
+runtime that places them on server processes
+(python/paddle/distributed/ps/the_one_ps.py:921 `_init_server`).
+
+TPU redesign — no server processes, no RPC: the table is ONE device array
+row-sharded over a mesh axis, and every PS verb becomes a compiled SPMD
+program over ICI:
+
+  * pull_sparse  → sharded row gather (GSPMD inserts the all-gather of ids
+    + local gathers + cross-shard select);
+  * push_sparse  → segment-sum de-duplication of the minibatch's gradients
+    followed by a row-wise scatter-apply of the optimizer rule — only the
+    touched rows are read/written, never a dense [rows, dim] gradient
+    (the sparse-table property the reference gets from its hash maps);
+  * per-row optimizer state (adagrad accumulator / adam moments) lives in
+    arrays sharded identically to the table, the analog of
+    MemorySparseTable's per-key value blocks.
+
+The brpc transport, heterogeneous PS (HeterPS / ps_gpu_wrapper) and SSD
+tables are deliberately NOT re-built: their reason to exist is scaling
+beyond one accelerator's memory over a datacenter NIC, which on TPU pods
+is served by sharding the same arrays over more chips' HBM with ICI
+collectives (see README "Parameter-server descope").
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..core.pylayer import PyLayer
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from . import topology
+
+
+def _pick_axis(mesh, axis):
+    if mesh is None or axis is False:     # axis=False forces local mode
+        return None
+    if axis is not None:
+        return axis if mesh.shape.get(axis, 1) > 1 else None
+    for cand in ("sharding", "mp", "dp"):
+        if mesh.shape.get(cand, 1) > 1:
+            return cand
+    return None
+
+
+class ShardedSparseTable:
+    """Row-sharded [num_rows, dim] embedding table + per-row optimizer
+    state, with pull/push compiled per (batch-shape) signature.
+
+    ``optimizer``: "sgd" | "adagrad" | "adam" (reference ctr_accessor
+    naive/adagrad/adam rules)."""
+
+    def __init__(self, num_rows: int, dim: int, optimizer: str = "adagrad",
+                 lr: float = 0.05, initializer_range: float = 0.01,
+                 mesh=None, axis: Optional[str] = None,
+                 dtype=jnp.float32, seed: int = 0, eps: float = 1e-10,
+                 beta1: float = 0.9, beta2: float = 0.999):
+        assert optimizer in ("sgd", "adagrad", "adam")
+        self.dim = dim
+        self.optimizer = optimizer
+        self.lr = lr
+        self.eps = eps
+        self.beta1, self.beta2 = beta1, beta2
+        mesh = mesh if mesh is not None else topology.get_current_mesh()
+        self.mesh = mesh
+        self.axis = _pick_axis(mesh, axis)
+        nshard = mesh.shape[self.axis] if self.axis else 1
+        # pad rows so the shard split is even (padding rows are never
+        # addressed: ids are bounds-checked by the caller contract)
+        self.num_rows = num_rows
+        # +1 scratch row: dead fill slots from the in-batch unique() are
+        # scattered there so they can never alias (and corrupt) a real row
+        self._rows_padded = ((num_rows + 1 + nshard - 1) // nshard) * nshard
+        tbl = jax.random.normal(
+            jax.random.key(seed), (self._rows_padded, dim),
+            dtype) * initializer_range
+        self._sh = (NamedSharding(mesh, P(self.axis, None))
+                    if self.axis else None)
+        self._sh1 = (NamedSharding(mesh, P(self.axis)) if self.axis
+                     else None)
+        self.table = jax.device_put(tbl, self._sh) if self._sh else tbl
+
+        def place(arr, sh):
+            return jax.device_put(arr, sh) if sh is not None else arr
+
+        if optimizer == "adagrad":
+            # per-row accumulator (G2Sum in the reference accessor)
+            self.slot0 = place(jnp.zeros((self._rows_padded,), jnp.float32),
+                               self._sh1)
+            self.slot1 = None
+        elif optimizer == "adam":
+            self.slot0 = place(
+                jnp.zeros((self._rows_padded, dim), jnp.float32), self._sh)
+            self.slot1 = place(
+                jnp.zeros((self._rows_padded, dim), jnp.float32), self._sh)
+        else:
+            self.slot0 = self.slot1 = None
+        self._step = 0
+        self._pending = []          # eager-layer sparse grads: (ids, grads)
+        self._pull_cache = {}
+        self._push_cache = {}
+
+    # ----------------------------------------------------------- pull
+    def _pull_fn(self):
+        def pull(table, ids):
+            rows = jnp.take(table, ids, axis=0)
+            if self._sh is not None:
+                rows = jax.lax.with_sharding_constraint(
+                    rows, NamedSharding(self.mesh, P()))
+            return rows
+
+        return jax.jit(pull)
+
+    def pull_sparse(self, ids):
+        """ids [n] (or any shape) → rows [..., dim] (replicated)."""
+        ids = jnp.asarray(ids, jnp.int32)
+        fn = self._pull_cache.get("pull")
+        if fn is None:
+            fn = self._pull_fn()
+            self._pull_cache["pull"] = fn
+        return fn(self.table, ids)
+
+    # ----------------------------------------------------------- push
+    def _push_fn(self, n):
+        opt = self.optimizer
+
+        def push(table, slot0, slot1, ids, grads, lr, step):
+            # de-duplicate: repeated ids in the minibatch sum their
+            # gradients (segment-sum), like the reference's per-key merge
+            # before the accessor update (memory_sparse_table.cc push)
+            uids, inv = jnp.unique(ids, return_inverse=True, size=n,
+                                   fill_value=-1)
+            g = jax.ops.segment_sum(grads, inv.reshape(-1),
+                                    num_segments=n)
+            live = (uids >= 0)[:, None]
+            g = jnp.where(live, g, 0.0)
+            # dead slots scatter into the scratch row (index num_rows) —
+            # never a real row, so duplicate dead indices are harmless
+            safe = jnp.where(uids >= 0, uids, self.num_rows)
+            if opt == "sgd":
+                upd = lr * g
+            elif opt == "adagrad":
+                acc = slot0.at[safe].add(
+                    jnp.where(live[:, 0], jnp.sum(g * g, axis=1), 0.0))
+                denom = jnp.sqrt(acc[safe] / self.dim + self.eps)
+                upd = (lr / denom)[:, None] * g
+                slot0 = acc
+            else:                   # adam
+                # gather -> update -> scatter-SET: unique live rows write
+                # exactly once (scatter-mul with duplicate indices would
+                # decay rows once per duplicate)
+                m_rows = slot0[safe] * self.beta1 + (1 - self.beta1) * g
+                v_rows = slot1[safe] * self.beta2 \
+                    + (1 - self.beta2) * g * g
+                slot0 = slot0.at[safe].set(
+                    jnp.where(live, m_rows, slot0[safe]))
+                slot1 = slot1.at[safe].set(
+                    jnp.where(live, v_rows, slot1[safe]))
+                bc1 = 1 - self.beta1 ** step
+                bc2 = 1 - self.beta2 ** step
+                upd = lr * (m_rows / bc1) / (
+                    jnp.sqrt(v_rows / bc2) + self.eps)
+            upd = jnp.where(live, upd, 0.0).astype(table.dtype)
+            table = table.at[safe].add(-upd)
+            return table, slot0, slot1
+
+        sh, sh1 = self._sh, self._sh1
+        if sh is None:
+            return jax.jit(push, donate_argnums=(0, 1, 2))
+        rep = NamedSharding(self.mesh, P())
+        # dummy (zero-sized) slots ride replicated; real ones shard with
+        # the table
+        slot0_sh = {"adagrad": sh1, "adam": sh, "sgd": rep}[opt]
+        slot1_sh = sh if opt == "adam" else rep
+        return jax.jit(
+            push,
+            in_shardings=(sh, slot0_sh, slot1_sh, rep, rep, rep, rep),
+            out_shardings=(sh, slot0_sh, slot1_sh),
+            donate_argnums=(0, 1, 2))
+
+    def push_sparse(self, ids, grads, lr: Optional[float] = None):
+        """Apply sparse gradients: ids [n], grads [n, dim]."""
+        ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+        grads = jnp.asarray(grads, jnp.float32).reshape(-1, self.dim)
+        n = ids.shape[0]
+        fn = self._push_cache.get(n)
+        if fn is None:
+            fn = self._push_fn(n)
+            self._push_cache[n] = fn
+        self._step += 1
+        # distinct dummies: donated buffers must be unique
+        slot0 = (self.slot0 if self.slot0 is not None
+                 else jnp.zeros((0,), jnp.float32))
+        slot1 = (self.slot1 if self.slot1 is not None
+                 else jnp.zeros((0,), jnp.float32))
+        out = fn(self.table, slot0, slot1, ids, grads,
+                 jnp.float32(lr if lr is not None else self.lr),
+                 jnp.float32(self._step))
+        self.table, s0, s1 = out
+        if self.slot0 is not None:
+            self.slot0 = s0
+        if self.slot1 is not None:
+            self.slot1 = s1
+
+    # -------------------------------------------- eager-layer integration
+    def queue_grad(self, ids, grads):
+        self._pending.append((ids, grads))
+
+    def apply_pending(self, lr: Optional[float] = None):
+        """Flush grads queued by SparseEmbedding backward passes (one
+        communicator flush, reference async Communicator push batching)."""
+        if not self._pending:
+            return
+        ids = jnp.concatenate([jnp.asarray(i, jnp.int32).reshape(-1)
+                               for i, _ in self._pending])
+        grads = jnp.concatenate(
+            [jnp.asarray(g, jnp.float32).reshape(-1, self.dim)
+             for _, g in self._pending])
+        self._pending = []
+        self.push_sparse(ids, grads, lr)
+
+    # ------------------------------------------------------------- state
+    def state_dict(self):
+        d = {"table": np.asarray(self.table)[: self.num_rows]}
+        if self.slot0 is not None:
+            d["slot0"] = np.asarray(self.slot0)[: self.num_rows]
+        if self.slot1 is not None:
+            d["slot1"] = np.asarray(self.slot1)[: self.num_rows]
+        return d
+
+    def set_state_dict(self, d):
+        def put(cur, new):
+            arr = jnp.asarray(new)
+            pad = self._rows_padded - arr.shape[0]
+            if pad:
+                arr = jnp.concatenate(
+                    [arr, jnp.zeros((pad,) + arr.shape[1:], arr.dtype)])
+            return (jax.device_put(arr, cur.sharding)
+                    if self._sh is not None else arr)
+
+        self.table = put(self.table, d["table"])
+        if "slot0" in d and self.slot0 is not None:
+            self.slot0 = put(self.slot0, d["slot0"])
+        if "slot1" in d and self.slot1 is not None:
+            self.slot1 = put(self.slot1, d["slot1"])
+
+
+class SparseEmbedding(Layer):
+    """Embedding layer backed by a ShardedSparseTable: backward produces
+    (ids, grad-rows) pushed to the table — never a dense [rows, dim]
+    gradient tensor (reference `paddle.static.nn.sparse_embedding`, the
+    PS-backed lookup, the_one_ps.py + pull/push_sparse ops)."""
+
+    def __init__(self, num_embeddings, embedding_dim, table=None, **kw):
+        super().__init__()
+        self.table = table or ShardedSparseTable(num_embeddings,
+                                                 embedding_dim, **kw)
+        # zero-sized float hook: int ids carry no grad themselves, so the
+        # PyLayer tapes through this always-differentiable input instead
+        self._tape_hook = self.create_parameter((1,))
+        self._tape_hook.set_value(np.zeros((1,), np.float32))
+
+    def forward(self, ids):
+        table = self.table
+
+        class _Lookup(PyLayer):
+            @staticmethod
+            def forward(ctx, ids_t, hook):
+                ctx.ids = ids_t._data
+                return Tensor(table.pull_sparse(ctx.ids))
+
+            @staticmethod
+            def backward(ctx, grad):
+                table.queue_grad(ctx.ids.reshape(-1),
+                                 grad._data.reshape(-1, table.dim))
+                return None, None
+
+        ids = ids if isinstance(ids, Tensor) else Tensor(jnp.asarray(ids))
+        return _Lookup.apply(ids, self._tape_hook)

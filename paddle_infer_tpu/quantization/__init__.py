@@ -4,6 +4,8 @@ plus the slim QAT/PTQ toolchain,
 python/paddle/fluid/contrib/slim/quantization/)."""
 from .slim import PTQ, QAT, MovingAverageObserver, QuantedLayer
 from .weight_only import (WeightOnlyLinear, quantize_model)
+from .int8 import Int8Linear, convert_int8
 
 __all__ = ["WeightOnlyLinear", "quantize_model", "QAT", "PTQ",
-           "MovingAverageObserver", "QuantedLayer"]
+           "MovingAverageObserver", "QuantedLayer", "Int8Linear",
+           "convert_int8"]

@@ -1,0 +1,117 @@
+"""Int8-activation serving: int8 × int8 matmul with static activation
+scales.
+
+Reference: fused_multi_transformer_int8_op.cu — the serving variant where
+QAT/PTQ activation scales quantize the matmul *inputs* so the GEMM runs
+int8×int8 (cublasLt IMMA there), completing the quant matrix next to
+weight-only (weights int8/int4, activations float).
+
+TPU-first: the MXU multiplies int8 operands natively when XLA is asked
+for an int32 accumulator (``preferred_element_type=jnp.int32``) — double
+the MAC throughput of bf16 on supporting generations — and the
+requantize/dequantize epilogue fuses into the surrounding elementwise
+ops.  Activation scales are static (observed by QAT/PTQ), so the whole
+quantize → int8 GEMM → dequant chain compiles into one fused program with
+no dynamic reductions on the serving path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import register_op, register_vjp_grad
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+@register_op("int8_linear")
+def _int8_linear(x, qw, w_scale, bias=None, act_scale=1.0):
+    """x [..., in] float; qw [in, out] int8; w_scale [out] f32 (per-channel);
+    static ``act_scale`` quantizes activations symmetrically."""
+    inv = 1.0 / float(act_scale)
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) * inv),
+                  -127, 127).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        xq, qw, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * (w_scale[None] * float(act_scale))
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+register_vjp_grad("int8_linear")
+
+
+class Int8Linear(Layer):
+    """Deploy-time linear with int8 weights AND int8 activations
+    (reference fused_multi_transformer_int8_op.cu qkv/out/ffn int8 GEMMs).
+
+    Built from a float Linear + an observed activation scale (QAT/PTQ);
+    not meant to be trained.
+    """
+
+    def __init__(self, in_features, out_features, act_scale, bias=True):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.act_scale = float(act_scale)
+        # int8/scale buffers are assigned by from_linear (deploy-time
+        # construction from a trained float Linear)
+        self.qweight = None
+        self.w_scale = None
+        self.bias = None
+
+    @classmethod
+    def from_linear(cls, linear, act_scale):
+        w = np.asarray(linear.weight.numpy(), np.float32)   # [in, out]
+        scale = np.maximum(np.abs(w).max(axis=0), 1e-8) / 127.0  # [out]
+        qw = np.clip(np.round(w / scale[None]), -127, 127).astype(np.int8)
+        lay = cls(w.shape[0], w.shape[1], act_scale,
+                  bias=linear.bias is not None)
+        lay.qweight = Tensor(jnp.asarray(qw))
+        lay.qweight.stop_gradient = True
+        lay.w_scale = Tensor(jnp.asarray(scale, jnp.float32))
+        lay.w_scale.stop_gradient = True
+        if linear.bias is not None:
+            lay.bias = Tensor(linear.bias._data)
+            lay.bias.stop_gradient = True
+        return lay
+
+    def forward(self, x):
+        from ..core.dispatch import dispatch as D
+
+        return D("int8_linear", x, self.qweight, self.w_scale, self.bias,
+                 act_scale=self.act_scale)
+
+    def extra_repr(self):
+        return (f"in={self.in_features}, out={self.out_features}, "
+                f"act_scale={self.act_scale:.4g}, int8xint8")
+
+
+def convert_int8(model, default_act_scale=None):
+    """Swap observer-wrapped (QAT/PTQ) linears for Int8Linear using their
+    observed activation scales — the int8-activation analog of
+    QAT.convert (reference save_quantized_model int8 path)."""
+    from ..nn.layers_common import Linear
+    from ..parallel.mp_layers import (ColumnParallelLinear,
+                                      RowParallelLinear)
+    from .slim import QuantedLayer, _swap
+
+    def make(q):
+        inner = q.inner
+        if isinstance(inner, (Linear, ColumnParallelLinear,
+                              RowParallelLinear)):
+            scale = float(np.asarray(q.act_scale.numpy()))
+            if scale <= 0:
+                if not default_act_scale:
+                    raise ValueError(
+                        "convert_int8: layer has no observed activation "
+                        "scale — run calibration batches (PTQ) or pass "
+                        "default_act_scale")
+                scale = default_act_scale
+            return Int8Linear.from_linear(inner, scale)
+        return inner
+
+    return _swap(model, (QuantedLayer,), make)

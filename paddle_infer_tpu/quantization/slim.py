@@ -152,9 +152,12 @@ class QAT:
 
     def quantize(self, model):
         from ..nn.layers_common import Conv2D, Linear
+        from ..parallel.mp_layers import (ColumnParallelLinear,
+                                          RowParallelLinear)
 
         return _swap(
-            model, (Linear, Conv2D),
+            model, (Linear, Conv2D, ColumnParallelLinear,
+                    RowParallelLinear),
             lambda sub: QuantedLayer(sub, self.weight_bits,
                                      self.activation_bits, self.momentum),
             self.skip)
@@ -165,10 +168,16 @@ class QAT:
         the serving sweet spot; scales are exported on the layer)."""
         from .weight_only import WeightOnlyLinear
         from ..nn.layers_common import Linear
+        from ..parallel.mp_layers import (ColumnParallelLinear,
+                                          RowParallelLinear)
 
         def make(q):
             inner = q.inner
-            if isinstance(inner, Linear):
+            # mp layers deploy like plain linears on a single serving
+            # chip (weight layout [in, out] is shared); with real mp
+            # sharding they stay float
+            if isinstance(inner, (Linear, ColumnParallelLinear,
+                                  RowParallelLinear)):
                 lay = WeightOnlyLinear.from_linear(inner)
                 lay.act_scale_value = float(np.asarray(q.act_scale.numpy()))
                 return lay

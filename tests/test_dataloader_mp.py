@@ -1,0 +1,132 @@
+"""Multiprocess DataLoader (VERDICT r2 item 6; reference
+fluid/dataloader/dataloader_iter.py:342 worker processes + shared-memory
+queues): real OS processes, shared-memory transport, in-order delivery,
+error propagation, and a throughput bar above the training consumer's
+101k tokens/s."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_infer_tpu.io import DataLoader, Dataset
+
+
+class TokenDataset(Dataset):
+    """Python-heavy per-sample work (the GIL-bound case thread workers
+    serialize on)."""
+
+    def __init__(self, n=512, seq=512, work=0):
+        self.n = n
+        self.seq = seq
+        self.work = work
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        ids = rng.randint(0, 40000, self.seq).astype(np.int32)
+        for _ in range(self.work):      # simulate python tokenizer work
+            sum(int(x) for x in ids[:64])
+        return ids, np.int64(i)
+
+
+class PidDataset(Dataset):
+    def __len__(self):
+        return 64
+
+    def __getitem__(self, i):
+        return np.full((4,), os.getpid(), np.int64)
+
+
+def test_workers_are_processes():
+    dl = DataLoader(PidDataset(), batch_size=8, num_workers=4,
+                    to_tensor=False)
+    pids = set()
+    for batch in dl:
+        pids.update(int(p) for p in batch[:, 0])
+    assert os.getpid() not in pids          # no batch built in-process
+    assert len(pids) > 1                    # several workers participated
+    assert dl._last_iter.worker_pids == pids
+
+
+def test_in_order_and_complete():
+    ds = TokenDataset(n=64, seq=16)
+    dl = DataLoader(ds, batch_size=8, num_workers=3, to_tensor=False)
+    seen = []
+    for ids, idx in dl:
+        assert ids.shape == (8, 16)
+        seen.extend(int(i) for i in idx)
+    assert seen == list(range(64))          # in-order, nothing dropped
+
+
+def test_matches_single_process():
+    ds = TokenDataset(n=48, seq=32)
+    a = [b for b in DataLoader(ds, batch_size=8, num_workers=0,
+                               to_tensor=False)]
+    b = [b for b in DataLoader(ds, batch_size=8, num_workers=2,
+                               to_tensor=False)]
+    assert len(a) == len(b)
+    for (xa, ia), (xb, ib) in zip(a, b):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ia, ib)
+
+
+def test_no_shared_memory_mode():
+    ds = TokenDataset(n=32, seq=16)
+    out = [b for b in DataLoader(ds, batch_size=8, num_workers=2,
+                                 use_shared_memory=False,
+                                 to_tensor=False)]
+    assert len(out) == 4
+
+
+class BoomDataset(Dataset):
+    def __len__(self):
+        return 32
+
+    def __getitem__(self, i):
+        if i == 17:
+            raise ValueError("boom at 17")
+        return np.zeros(4, np.float32)
+
+
+def test_worker_error_propagates():
+    dl = DataLoader(BoomDataset(), batch_size=8, num_workers=2,
+                    to_tensor=False)
+    with pytest.raises(ValueError, match="boom at 17"):
+        list(dl)
+
+
+def test_worker_init_fn_and_worker_info():
+    from paddle_infer_tpu.io.worker import get_worker_info
+
+    class InfoDataset(Dataset):
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            info = get_worker_info()
+            assert info is not None and 0 <= info.id < info.num_workers
+            return np.full((2,), info.id, np.int64)
+
+    dl = DataLoader(InfoDataset(), batch_size=4, num_workers=2,
+                    to_tensor=False)
+    rows = np.concatenate([b for b in dl])
+    assert set(int(r) for r in rows[:, 0]) <= {0, 1}
+
+
+def test_throughput_beats_training_consumer():
+    """The loader must outrun the 101k tokens/s the train step consumes
+    (VERDICT r2 item 6 done-criterion), with real python work per sample."""
+    ds = TokenDataset(n=256, seq=512, work=2)
+    dl = DataLoader(ds, batch_size=32, num_workers=4, to_tensor=False)
+    it = iter(dl)
+    next(it)                                 # warm the worker pool
+    t0 = time.perf_counter()
+    tokens = 0
+    for ids, _ in it:
+        tokens += ids.size
+    dt = time.perf_counter() - t0
+    rate = tokens / dt
+    assert rate > 101_000, f"loader sustained only {rate:,.0f} tokens/s"

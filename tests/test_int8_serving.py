@@ -1,0 +1,121 @@
+"""Int8-activation serving path (VERDICT r2 item 7; reference
+fused_multi_transformer_int8_op.cu): QAT/PTQ output -> int8 x int8 matmul
+layers served through the generation engines, logits within tolerance of
+the float model."""
+import numpy as np
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import nn
+from paddle_infer_tpu.quantization import PTQ, QAT, Int8Linear, convert_int8
+
+
+def test_int8_linear_matches_float():
+    pit.seed(0)
+    lin = nn.Linear(64, 32)
+    x_np = np.random.RandomState(0).randn(8, 64).astype(np.float32)
+    act_scale = np.abs(x_np).max() / 127.0
+    q = Int8Linear.from_linear(lin, act_scale)
+    ref = lin(pit.Tensor(x_np)).numpy()
+    out = q(pit.Tensor(x_np)).numpy()
+    # int8 weights + int8 activations: ~1% relative error band
+    denom = np.abs(ref).mean()
+    assert np.abs(out - ref).mean() / denom < 0.02
+    assert q.qweight.numpy().dtype == np.int8
+
+
+def test_int8_accumulates_in_int32():
+    """Large reductions must not saturate: accumulation is int32, not
+    int8/int16."""
+    lin = nn.Linear(1024, 4, bias_attr=False)
+    lin.weight.set_value(np.ones((1024, 4), np.float32))
+    x = np.ones((1, 1024), np.float32)
+    q = Int8Linear.from_linear(lin, act_scale=1.0 / 127.0)
+    out = q(pit.Tensor(x)).numpy()
+    np.testing.assert_allclose(out, 1024.0, rtol=1e-2)
+
+
+def test_qat_convert_int8_pipeline():
+    """quantize -> (train) -> convert_int8: the deploy model runs int8
+    GEMMs and tracks the float model."""
+    pit.seed(1)
+
+    class Mlp(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(32, 64)
+            self.fc2 = nn.Linear(64, 8)
+
+        def forward(self, x):
+            return self.fc2(nn.functional.relu(self.fc1(x)))
+
+    model = Mlp()
+    x_np = np.random.RandomState(1).randn(16, 32).astype(np.float32)
+    ref = model(pit.Tensor(x_np)).numpy()
+
+    qat = QAT()
+    model = qat.quantize(model)
+    model.train()
+    model(pit.Tensor(x_np))          # observers see activations
+    model.eval()
+    model = convert_int8(model)
+    kinds = [type(m).__name__ for m in model.sublayers()]
+    assert kinds.count("Int8Linear") == 2
+    out = model(pit.Tensor(x_np)).numpy()
+    denom = np.abs(ref).mean()
+    assert np.abs(out - ref).mean() / denom < 0.05
+
+
+def test_ptq_int8_gpt_serves_through_paged_engine():
+    """PTQ-calibrated GPT converted to int8 activations serves through
+    PagedGenerationEngine; logits within tolerance of fp and greedy decode
+    runs end to end."""
+    from paddle_infer_tpu.inference import (GenerationConfig,
+                                            PagedGenerationEngine)
+    from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_infer_tpu.quantization.slim import QuantedLayer, _swap
+    from paddle_infer_tpu.nn.layers_common import Linear
+
+    pit.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=128, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    fp = GPTForCausalLM(cfg)
+    fp.eval()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 64, (2, 12)).astype(np.int32)
+    ref_logits = fp(pit.Tensor(ids)).numpy()
+
+    q = GPTForCausalLM(cfg)
+    q.set_state_dict(fp.state_dict())
+    calib = [(ids,)]
+    q = PTQ().quantize(q, calib)          # weight-only convert by default
+    # re-wrap is already converted; rebuild the int8 variant from scratch
+    q2 = GPTForCausalLM(cfg)
+    q2.set_state_dict(fp.state_dict())
+    qat = QAT()
+    q2 = qat.quantize(q2)
+    q2.eval()
+    for lay in q2.sublayers():
+        if isinstance(lay, QuantedLayer):
+            lay._calibrating = True
+    q2(pit.Tensor(ids))
+    for lay in q2.sublayers():
+        if isinstance(lay, QuantedLayer):
+            lay._calibrating = False
+    q2 = convert_int8(q2)
+    assert any(type(m).__name__ == "Int8Linear" for m in q2.sublayers())
+
+    got = q2(pit.Tensor(ids)).numpy()
+    denom = np.abs(ref_logits).mean()
+    assert np.abs(got - ref_logits).mean() / denom < 0.1
+
+    eng = PagedGenerationEngine(q2, page_size=8, prompt_bucket=8)
+    seq = eng.generate(ids, GenerationConfig(max_new_tokens=6))
+    assert seq.shape == (2, 6)
+    # greedy tokens track the fp engine on most steps (int8 noise may flip
+    # near-ties on a tiny random model; require majority agreement)
+    fp_eng = PagedGenerationEngine(fp, page_size=8, prompt_bucket=8)
+    fp_seq = fp_eng.generate(ids, GenerationConfig(max_new_tokens=6))
+    agree = (seq == fp_seq).mean()
+    assert agree >= 0.5, (seq, fp_seq)

@@ -1,0 +1,182 @@
+"""TPU-native parameter-server sparse tables (VERDICT r2 item 2).
+
+Reference behavior under test: MemorySparseTable pull/push with per-row
+optimizer state (paddle/fluid/distributed/ps/table/memory_sparse_table.h,
+ctr_accessor.h) and the sparse_embedding layer whose backward pushes
+(id, grad) pairs instead of a dense table gradient
+(python/paddle/distributed/ps/the_one_ps.py).  Runs on the 8-device CPU
+mesh; sharded results must equal a single-device reference.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.parallel import DistributedStrategy, fleet
+from paddle_infer_tpu.parallel.sparse_table import (ShardedSparseTable,
+                                                    SparseEmbedding)
+
+
+@pytest.fixture()
+def mesh8():
+    st = DistributedStrategy()
+    st.hybrid_configs = {"dp_degree": 1, "sharding_degree": 8}
+    fleet.init(is_collective=True, strategy=st)
+    yield
+
+
+def test_table_is_sharded(mesh8):
+    t = ShardedSparseTable(100, 16)
+    assert t.axis == "sharding"
+    assert t._rows_padded % 8 == 0
+    # the device array is genuinely row-sharded over the mesh
+    assert not t.table.sharding.is_fully_replicated
+    assert t.table.sharding.shard_shape(t.table.shape)[0] \
+        == t._rows_padded // 8
+
+
+def test_pull_push_adagrad_exact(mesh8):
+    t = ShardedSparseTable(64, 8, optimizer="adagrad", lr=0.1)
+    ids = np.array([3, 7, 3, 60], np.int32)
+    rows0 = np.asarray(t.pull_sparse(ids))
+    t.push_sparse(ids, np.ones((4, 8), np.float32))
+    rows1 = np.asarray(t.pull_sparse(ids))
+    # id 3 repeats: segment-sum merges to grad 2; adagrad acc = sum g^2
+    exp3 = 0.1 / math.sqrt(8 * 4.0 / 8 + 1e-10) * 2.0
+    exp7 = 0.1 / math.sqrt(8 * 1.0 / 8 + 1e-10) * 1.0
+    np.testing.assert_allclose(rows0[0] - rows1[0], exp3, rtol=1e-5)
+    np.testing.assert_allclose(rows0[1] - rows1[1], exp7, rtol=1e-5)
+    # untouched rows unchanged
+    np.testing.assert_array_equal(np.asarray(t.pull_sparse([5, 20])),
+                                  np.asarray(t.pull_sparse([5, 20])))
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adagrad", "adam"])
+def test_sharded_matches_single_device(mesh8, opt):
+    """The mesh-sharded table must train identically to an unsharded one
+    (the TestDistBase single-vs-multi loss-compare pattern,
+    test_dist_base.py:792)."""
+    kw = dict(optimizer=opt, lr=0.05, seed=3)
+    sharded = ShardedSparseTable(48, 4, axis="sharding", **kw)
+    local = ShardedSparseTable(48, 4, axis=False, **kw)
+    assert sharded.axis == "sharding" and local.axis is None
+    rng = np.random.RandomState(0)
+    for _ in range(4):
+        ids = rng.randint(0, 48, size=6).astype(np.int32)
+        g = rng.randn(6, 4).astype(np.float32)
+        sharded.push_sparse(ids, g)
+        local.push_sparse(ids, g)
+    all_ids = np.arange(48, dtype=np.int32)
+    np.testing.assert_allclose(np.asarray(sharded.pull_sparse(all_ids)),
+                               np.asarray(local.pull_sparse(all_ids)),
+                               atol=1e-6, rtol=1e-5)
+
+
+def test_no_dense_gradient_materialised(mesh8):
+    """The push path touches only minibatch rows — verified by checking
+    untouched rows bit-identical across a training run."""
+    t = ShardedSparseTable(1000, 8, optimizer="adagrad")
+    before = np.asarray(t.pull_sparse(np.arange(500, 1000, dtype=np.int32)))
+    for _ in range(3):
+        t.push_sparse(np.arange(16, dtype=np.int32),
+                      np.random.RandomState(1).randn(16, 8)
+                      .astype(np.float32))
+    after = np.asarray(t.pull_sparse(np.arange(500, 1000, dtype=np.int32)))
+    np.testing.assert_array_equal(before, after)
+
+
+def test_sparse_embedding_layer_end_to_end(mesh8):
+    """SparseEmbedding: forward lookup + backward queues (ids, grads) to
+    the table, apply_pending updates — loss decreases on a toy CTR task."""
+    pit.seed(0)
+    emb = SparseEmbedding(32, 4, optimizer="adagrad", lr=0.5)
+    w = pit.Tensor(np.random.RandomState(1).randn(4, 1)
+                   .astype(np.float32) * 0.1)
+    w.stop_gradient = False
+    rng = np.random.RandomState(2)
+    ids_np = rng.randint(0, 32, size=(16,)).astype(np.int32)
+    y = (ids_np % 2).astype(np.float32)[:, None]
+    losses = []
+    for _ in range(30):
+        rows = emb(pit.Tensor(ids_np))
+        logits = rows.matmul(w)
+        from paddle_infer_tpu.nn import functional as F
+
+        loss = F.sigmoid_focal_loss(logits, pit.Tensor(y), reduction="mean") \
+            if hasattr(F, "sigmoid_focal_loss") else \
+            F.binary_cross_entropy_with_logits(logits, pit.Tensor(y))
+        losses.append(float(loss.numpy()))
+        loss.backward()
+        emb.table.apply_pending()
+        if w.grad is not None:
+            w.set_value(w.numpy() - 0.5 * w.grad.numpy())
+            w.clear_grad()
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+    assert not emb.table._pending
+
+
+def test_embedding_backward_is_sparse(mesh8):
+    """Backward never creates a dense [rows, dim] grad — the queued grads
+    have minibatch shape."""
+    emb = SparseEmbedding(10000, 8)
+    ids = pit.Tensor(np.array([1, 5, 1], np.int32))
+    out = emb(ids)
+    out.sum().backward()
+    assert len(emb.table._pending) == 1
+    qids, qg = emb.table._pending[0]
+    assert qids.shape == (3,)
+    assert qg.shape == (3, 8)
+    # and no dense grad landed anywhere
+    assert emb._tape_hook.grad is None
+    emb.table.apply_pending()
+
+
+def test_state_dict_roundtrip(mesh8):
+    t = ShardedSparseTable(20, 4, optimizer="adam", seed=9)
+    t.push_sparse(np.array([1, 2], np.int32),
+                  np.ones((2, 4), np.float32))
+    d = t.state_dict()
+    t2 = ShardedSparseTable(20, 4, optimizer="adam", seed=0)
+    t2.set_state_dict(d)
+    np.testing.assert_allclose(
+        np.asarray(t.pull_sparse(np.arange(20))),
+        np.asarray(t2.pull_sparse(np.arange(20))), atol=1e-7)
+    # momenta restored too: identical next update
+    t._step = t2._step
+    t.push_sparse(np.array([1], np.int32), np.ones((1, 4), np.float32))
+    t2.push_sparse(np.array([1], np.int32), np.ones((1, 4), np.float32))
+    np.testing.assert_allclose(
+        np.asarray(t.pull_sparse(np.arange(20))),
+        np.asarray(t2.pull_sparse(np.arange(20))), atol=1e-6)
+
+
+def test_adam_duplicate_ids_exact_and_no_row0_corruption(mesh8):
+    """Regression (r3 review): dead fill slots from the in-batch unique()
+    must not decay row 0's adam moments, and duplicate ids must apply ONE
+    merged update — checked against a numpy adam reference."""
+    t = ShardedSparseTable(16, 4, optimizer="adam", lr=0.1, seed=11)
+    all_ids = np.arange(16, dtype=np.int32)
+    w0 = np.asarray(t.pull_sparse(all_ids), np.float64)
+    ids = np.array([5, 5, 9], np.int32)        # duplicates -> dead slots
+    g = np.array([[1, 0, 0, 0], [1, 0, 0, 0], [0, 2, 0, 0]], np.float32)
+    t.push_sparse(ids, g)
+    t.push_sparse(ids, g)
+    w = np.asarray(t.pull_sparse(all_ids), np.float64)
+    # untouched rows (incl. row 0, the old dead-slot scatter target) are
+    # bit-identical
+    touched = np.zeros(16, bool)
+    touched[[5, 9]] = True
+    np.testing.assert_array_equal(w[~touched], w0[~touched])
+    # numpy adam on the MERGED per-row grads
+    ref = w0.copy()
+    m = np.zeros((16, 4)); v = np.zeros((16, 4))
+    merged = np.zeros((16, 4)); merged[5, 0] = 2.0; merged[9, 1] = 2.0
+    for step in (1, 2):
+        for r in (5, 9):
+            m[r] = 0.9 * m[r] + 0.1 * merged[r]
+            v[r] = 0.999 * v[r] + 0.001 * merged[r] ** 2
+            ref[r] -= 0.1 * (m[r] / (1 - 0.9 ** step)) / (
+                np.sqrt(v[r] / (1 - 0.999 ** step)) + 1e-10)
+    np.testing.assert_allclose(w[touched], ref[touched], rtol=1e-5,
+                               atol=1e-6)
