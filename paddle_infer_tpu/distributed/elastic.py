@@ -130,6 +130,15 @@ class ElasticManager:
         return exit_code != 0 and self.healthy()
 
 
+def _elastic_entry(func, args, replica, attempt):
+    # module-level so spawn/forkserver contexts can pickle it
+    import os
+
+    os.environ["PTI_REPLICA_ID"] = str(replica)
+    os.environ["PTI_ATTEMPT"] = str(attempt)
+    func(*args)
+
+
 class ElasticLauncher:
     """Spawn + watch + RELAUNCH worker processes (the reference launch
     watcher: fleet/elastic/manager.py:100-115 watches exit codes and
@@ -159,15 +168,8 @@ class ElasticLauncher:
                                       timeout=timeout)
 
     def _start(self, ctx, func, args, replica, attempt):
-        import os
-
-        def entry(func, args, replica, attempt):
-            os.environ["PTI_REPLICA_ID"] = str(replica)
-            os.environ["PTI_ATTEMPT"] = str(attempt)
-            func(*args)
-
-        p = ctx.Process(target=entry, args=(func, args, replica, attempt),
-                        daemon=True)
+        p = ctx.Process(target=_elastic_entry,
+                        args=(func, args, replica, attempt), daemon=True)
         p.start()
         return p
 

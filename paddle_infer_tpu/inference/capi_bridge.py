@@ -1,0 +1,24 @@
+"""Python half of the C inference API (native/inference_capi.cc).
+
+Reference: the marshal layer under capi_exp/pd_inference_api.h — here the
+C side passes contiguous byte buffers + shapes, this module turns them
+into predictor IO.  Kept import-light: the embedded interpreter pays this
+module's import on first PD_PredictorCreate.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def create_predictor(prefix: str):
+    from . import Config, create_predictor as _create
+
+    return _create(Config(prefix))
+
+
+def run_f32(pred, buf: bytes, shape):
+    arr = np.frombuffer(buf, np.float32).reshape(tuple(int(s)
+                                                       for s in shape))
+    out = pred.run([arr])[0]
+    out = np.ascontiguousarray(np.asarray(out), np.float32)
+    return out.tobytes(), tuple(int(s) for s in out.shape)

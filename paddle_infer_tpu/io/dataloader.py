@@ -57,7 +57,7 @@ class DataLoader:
         self.timeout = timeout  # 0/None = no deadline (reference default)
         assert worker_mode in ("process", "thread")
         self.worker_mode = worker_mode
-        self._last_iter = None      # exposes worker pids for tests
+        self.last_worker_pids = set()   # filled per epoch (observability)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if not self._iterable_mode:
             if batch_sampler is not None:
@@ -128,9 +128,13 @@ class DataLoader:
             prefetch_factor=self.prefetch_factor,
             use_shared_memory=self.use_shared_memory,
             worker_init_fn=self.worker_init_fn, timeout=self.timeout)
-        self._last_iter = it
-        for batch in it:
-            yield self._wrap(batch)
+        try:
+            for batch in it:
+                yield self._wrap(batch)
+        finally:
+            # keep only the pid set — not the iterator (dataset + reorder
+            # buffers) — alive after the epoch
+            self.last_worker_pids = set(it.worker_pids)
 
     def _iter_threaded(self):
         """Bounded-queue thread pool: in-order delivery via per-batch slots

@@ -165,18 +165,22 @@ class MultiprocessIter:
                         waited += min(self._timeout or 5.0, 5.0)
                         dead = [w.pid for w in self._workers
                                 if not w.is_alive()]
-                        if len(dead) == len(self._workers):
+                        if dead:
+                            # a worker never exits mid-epoch on its own:
+                            # its in-flight batch is lost and in-order
+                            # delivery cannot continue — fail loudly
+                            # instead of spinning forever
                             raise RuntimeError(
-                                "DataLoader: every worker died (pids "
-                                f"{dead})") from None
+                                f"DataLoader worker(s) died (pids {dead}) "
+                                "— killed (OOM?) or crashed without a "
+                                "picklable error") from None
                         # timeout=0/None means block as long as workers
                         # live (reference default); a positive timeout is
                         # a hard deadline
                         if self._timeout and waited >= self._timeout:
                             raise RuntimeError(
                                 f"DataLoader worker timeout after "
-                                f"{waited:.0f}s (dead workers: {dead})"
-                            ) from None
+                                f"{waited:.0f}s") from None
                         continue
                     self.worker_pids.add(pid)
                     if err is not None:

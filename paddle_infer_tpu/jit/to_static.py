@@ -60,6 +60,19 @@ class StaticFunction:
 
     def __init__(self, fn: Callable, layer: Optional[Layer] = None,
                  input_spec=None):
+        # AST-convert data-dependent control flow (if/while/for/and/or
+        # over tensors -> lax.cond/while_loop) before tracing — the
+        # reference ProgramTranslator pipeline (dygraph_to_static/
+        # program_translator.py); unsourceable callables (builtins,
+        # already-converted, @not_to_static) trace as-is.
+        if not getattr(fn, "_not_to_static", False) \
+                and not getattr(fn, "__dy2static__", False):
+            try:
+                from .dy2static import convert_function
+
+                fn = convert_function(fn)
+            except (OSError, TypeError, SyntaxError):
+                pass
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec

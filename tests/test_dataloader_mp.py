@@ -48,7 +48,7 @@ def test_workers_are_processes():
         pids.update(int(p) for p in batch[:, 0])
     assert os.getpid() not in pids          # no batch built in-process
     assert len(pids) > 1                    # several workers participated
-    assert dl._last_iter.worker_pids == pids
+    assert dl.last_worker_pids == pids
 
 
 def test_in_order_and_complete():
@@ -116,6 +116,8 @@ def test_worker_init_fn_and_worker_info():
     assert set(int(r) for r in rows[:, 0]) <= {0, 1}
 
 
+@pytest.mark.skipif(os.environ.get("PIT_SKIP_PERF") == "1",
+                    reason="PIT_SKIP_PERF=1 (loaded CI machine)")
 def test_throughput_beats_training_consumer():
     """The loader must outrun the 101k tokens/s the train step consumes
     (VERDICT r2 item 6 done-criterion), with real python work per sample."""

@@ -1,0 +1,229 @@
+"""Dy2static AST transforms (reference dygraph_to_static/
+program_translator.py + ifelse/loop/logical transformers): tensor-
+dependent Python control flow compiles to lax.cond/while_loop under
+to_static, and plain-Python control flow keeps its semantics.
+"""
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.jit.dy2static import convert_function
+from paddle_infer_tpu.jit.to_static import to_static
+
+
+def _t(v):
+    return pit.Tensor(np.asarray(v, np.float32))
+
+
+class TestConverters:
+    def test_tensor_if_both_branches(self):
+        def f(x):
+            if (x.sum() > 0.0):
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        g = convert_function(f)
+        x = _t([1.0, 2.0])
+        np.testing.assert_allclose(g(x).numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(g(_t([-1.0, -2.0])).numpy(),
+                                   [-2.0, -3.0])
+
+    def test_tensor_if_under_jit(self):
+        """The converted if must trace into lax.cond — one executable
+        serves both outcomes."""
+        import jax
+
+        def f(x):
+            if (x.sum() > 0.0):
+                y = x * 2.0
+            else:
+                y = x - 1.0
+            return y
+
+        g = convert_function(f)
+        calls = {"n": 0}
+
+        def run(arr):
+            calls["n"] += 1
+            return g(pit.Tensor(arr))._data
+
+        jit_run = jax.jit(run)
+        np.testing.assert_allclose(
+            np.asarray(jit_run(np.array([1.0, 1.0], np.float32))),
+            [2.0, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(jit_run(np.array([-1.0, -1.0], np.float32))),
+            [-2.0, -2.0])
+        assert calls["n"] == 1          # traced once, branched on-device
+
+    def test_tensor_while(self):
+        def f(x):
+            i = _t(0.0)
+            while (i.sum() < 5.0):
+                x = x + 1.0
+                i = i + 1.0
+            return x
+
+        g = convert_function(f)
+        np.testing.assert_allclose(g(_t([0.0])).numpy(), [5.0])
+
+    def test_tensor_while_under_jit(self):
+        import jax
+
+        def f(x, n):
+            i = n * 0.0
+            while (i < n).sum() > 0.0:
+                x = x * 2.0
+                i = i + 1.0
+            return x
+
+        g = convert_function(f)
+
+        def run(x, n):
+            return g(pit.Tensor(x), pit.Tensor(n))._data
+
+        out = jax.jit(run)(np.float32(1.0), np.float32(4.0))
+        assert float(out) == 16.0
+        out = jax.jit(run)(np.float32(1.0), np.float32(6.0))
+        assert float(out) == 64.0       # same executable, data-driven trip
+
+    def test_for_range_traced_bound(self):
+        import jax
+
+        def f(x, n):
+            acc = x * 0.0
+            for i in range(n):
+                acc = acc + x
+            return acc
+
+        g = convert_function(f)
+        assert float(g(_t(3.0), 4).numpy()) == 12.0
+
+        def run(x, n):
+            return g(pit.Tensor(x), pit.Tensor(n))._data
+
+        assert float(jax.jit(run)(np.float32(3.0), np.int32(5))) == 15.0
+
+    def test_logical_ops_on_tensors(self):
+        def f(a, b):
+            return (a > 0.0) and (b > 0.0)
+
+        g = convert_function(f)
+        assert bool(g(_t(1.0), _t(2.0)).numpy())
+        assert not bool(g(_t(1.0), _t(-2.0)).numpy())
+
+        def h(a):
+            return not (a > 0.0)
+
+        g2 = convert_function(h)
+        assert bool(g2(_t(-1.0)).numpy())
+
+    def test_python_semantics_preserved(self):
+        """Non-tensor control flow through the same converters behaves
+        exactly like python (incl. short-circuit)."""
+        def f(x, flag):
+            hits = []
+            if flag is None:
+                y = x + 1
+            else:
+                y = x + 2
+            z = 0
+            while z < 3:
+                z += 1
+            ok = (flag is None) or hits.append("boom")
+            for i in range(2):
+                y = y + z
+            return y, ok
+
+        g = convert_function(f)
+        y, ok = g(10, None)
+        assert y == 10 + 1 + 3 + 3 and ok is True
+
+    def test_one_sided_assignment_errors_when_traced(self):
+        import jax
+
+        def f(x):
+            if (x.sum() > 0.0):
+                y = x * 2.0
+            return y
+
+        g = convert_function(f)
+        # eager true path works
+        np.testing.assert_allclose(g(_t([1.0])).numpy(), [2.0])
+        with pytest.raises(ValueError, match="only one branch"):
+            jax.jit(lambda a: g(pit.Tensor(a))._data)(
+                np.array([1.0], np.float32))
+
+    def test_early_return_left_as_python(self):
+        def f(x, flag):
+            if flag:
+                return x + 1
+            return x - 1
+
+        g = convert_function(f)
+        assert g(1, True) == 2 and g(1, False) == 0
+
+    def test_closure_and_globals_survive(self):
+        offset = 10.0
+
+        def f(x):
+            if (x.sum() > 0.0):
+                y = x + offset
+            else:
+                y = x - offset
+            return y
+
+        g = convert_function(f)
+        np.testing.assert_allclose(g(_t(1.0)).numpy(), 11.0)
+
+
+class TestToStaticIntegration:
+    def test_to_static_data_dependent_if(self):
+        @to_static
+        def f(x):
+            if (x.sum() > 0.0):
+                y = x * 10.0
+            else:
+                y = x * -1.0
+            return y
+
+        np.testing.assert_allclose(f(_t([2.0])).numpy(), [20.0])
+        np.testing.assert_allclose(f(_t([-2.0])).numpy(), [2.0])
+
+    def test_to_static_layer_with_loop(self):
+        from paddle_infer_tpu import nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x, steps):
+                i = steps * 0
+                while (i < steps).sum() > 0:
+                    x = self.fc(x)
+                    i = i + 1
+                return x
+
+        pit.seed(0)
+        net = Net()
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        # eager reference: apply fc three times
+        ref = pit.Tensor(x)
+        for _ in range(3):
+            ref = net.fc(ref)
+        st = to_static(net)
+        out = st(pit.Tensor(x), pit.Tensor(np.int32(3)))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-6)
+
+    def test_not_to_static_respected(self):
+        from paddle_infer_tpu.jit.to_static import not_to_static
+
+        @not_to_static
+        def f(x):
+            return x + 1
+
+        sf = to_static(f)
+        assert not getattr(sf._fn, "__dy2static__", False)

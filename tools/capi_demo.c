@@ -1,0 +1,80 @@
+/* Pure-C serving client for the TPU framework's C inference API
+ * (reference: the demo clients of capi_exp/pd_inference_api.h).
+ *
+ * Usage: capi_demo <model_prefix> <n_floats_in> <d0> [d1 ...]
+ * Reads float32 input from stdin, writes the flat float32 output to
+ * stdout (text, one value per line) — no Python on this side.
+ */
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef void* (*cfg_create_t)(const char*);
+typedef void (*cfg_destroy_t)(void*);
+typedef void* (*pred_create_t)(void*, char**);
+typedef void (*pred_destroy_t)(void*);
+typedef int (*pred_run_t)(void*, const float*, const int64_t*, int,
+                          float**, int64_t**, int*, char**);
+typedef void (*tensor_destroy_t)(float*, int64_t*);
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    fprintf(stderr, "usage: %s <libpitinfer.so> <model_prefix> <d0> ...\n",
+            argv[0]);
+    return 2;
+  }
+  void* lib = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) {
+    fprintf(stderr, "dlopen: %s\n", dlerror());
+    return 2;
+  }
+  cfg_create_t cfg_create = (cfg_create_t)dlsym(lib, "PD_ConfigCreate");
+  cfg_destroy_t cfg_destroy = (cfg_destroy_t)dlsym(lib, "PD_ConfigDestroy");
+  pred_create_t pred_create =
+      (pred_create_t)dlsym(lib, "PD_PredictorCreate");
+  pred_destroy_t pred_destroy =
+      (pred_destroy_t)dlsym(lib, "PD_PredictorDestroy");
+  pred_run_t pred_run = (pred_run_t)dlsym(lib, "PD_PredictorRun");
+  tensor_destroy_t tensor_destroy =
+      (tensor_destroy_t)dlsym(lib, "PD_TensorDestroy");
+
+  int ndim = argc - 3;
+  int64_t shape[8];
+  size_t numel = 1;
+  for (int i = 0; i < ndim; ++i) {
+    shape[i] = atoll(argv[3 + i]);
+    numel *= (size_t)shape[i];
+  }
+  float* data = (float*)malloc(numel * sizeof(float));
+  for (size_t i = 0; i < numel; ++i) {
+    if (scanf("%f", &data[i]) != 1) {
+      fprintf(stderr, "short input at %zu\n", i);
+      return 2;
+    }
+  }
+
+  void* cfg = cfg_create(argv[2]);
+  char* err = NULL;
+  void* pred = pred_create(cfg, &err);
+  if (!pred) {
+    fprintf(stderr, "PD_PredictorCreate: %s\n", err ? err : "?");
+    return 1;
+  }
+  float* out = NULL;
+  int64_t* oshape = NULL;
+  int ondim = 0;
+  if (pred_run(pred, data, shape, ndim, &out, &oshape, &ondim, &err)) {
+    fprintf(stderr, "PD_PredictorRun: %s\n", err ? err : "?");
+    return 1;
+  }
+  size_t onumel = 1;
+  for (int i = 0; i < ondim; ++i) onumel *= (size_t)oshape[i];
+  fprintf(stderr, "output ndim=%d numel=%zu\n", ondim, onumel);
+  for (size_t i = 0; i < onumel; ++i) printf("%.8g\n", out[i]);
+  tensor_destroy(out, oshape);
+  pred_destroy(pred);
+  cfg_destroy(cfg);
+  free(data);
+  return 0;
+}
