@@ -19,6 +19,8 @@
 
 #include <Python.h>
 
+#include <mutex>
+
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -42,16 +44,17 @@ PyObject* bridge() {
 }
 
 void ensure_python() {
-  if (!Py_IsInitialized()) {
-    Py_InitializeEx(0);
-#if PY_VERSION_HEX < 0x030C0000
-    PyEval_SaveThread();
-#else
-    // 3.12+: Py_InitializeEx leaves us holding the thread state; release
-    // it so PyGILState_Ensure works from any thread
-    PyEval_SaveThread();
-#endif
-  }
+  // concurrent predictor creation from multiple host threads must
+  // initialize the interpreter exactly once
+  static std::once_flag once;
+  std::call_once(once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the thread state Py_InitializeEx leaves us holding so
+      // PyGILState_Ensure works from any thread
+      PyEval_SaveThread();
+    }
+  });
 }
 
 char* dup_error() {
@@ -129,9 +132,9 @@ void PD_PredictorDestroy(void* predictor) {
   free(p);
 }
 
-// Run one float32 input through the model (the zero-copy single-IO fast
-// path; multi-input models go through PD_PredictorRunMulti below).
-// Outputs are malloc'd; free with PD_TensorDestroy.
+// Run one float32 input through the model (the single-IO fast path —
+// the common serving case; multi-IO models serve via the Python
+// predictor).  Outputs are malloc'd; free with PD_TensorDestroy.
 int PD_PredictorRun(void* predictor, const float* data,
                     const int64_t* shape, int ndim, float** out_data,
                     int64_t** out_shape, int* out_ndim, char** error) {

@@ -113,6 +113,15 @@ class Config:
     def enable_weight_only_quant(self, algo="int8"):
         self._weight_only_quant = algo
 
+    def pass_builder(self):
+        """The editable pass list (reference AnalysisConfig::pass_builder
+        + paddle_pass_builder.h): delete_pass/append_pass/insert_pass."""
+        if getattr(self, "_pass_strategy", None) is None:
+            from .passes import TpuPassStrategy
+
+            self._pass_strategy = TpuPassStrategy()
+        return self._pass_strategy
+
     def set_max_batch_size(self, n):
         self._max_batch_size = n
 

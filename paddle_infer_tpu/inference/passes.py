@@ -1,0 +1,231 @@
+"""Pluggable inference optimization passes.
+
+Reference: the analysis pipeline — ``Analyzer::Run`` drives an
+``Argument`` through registered passes (analysis/analyzer.cc:29,
+analysis/passes/), ordered per target by named pass lists
+(api/paddle_pass_builder.cc:86 kTRTSubgraphPasses, :194 GpuPassStrategy,
+:264 CpuPassStrategy) that users edit via
+``config.pass_builder()->DeletePass(...)``.
+
+TPU redesign: two artifact kinds flow through one pipeline —
+  * a **Layer model** (the serving engines' input): passes rewrite the
+    layer tree the way the reference's ir::Graph fusion passes rewrite
+    the graph (delete_dropout_op_pass, weight-only rewrites,
+    convert_to_mixed_precision) before XLA traces it; XLA then owns the
+    low-level fusion the reference hand-codes per pattern;
+  * an **exported artifact** (deserialized StableHLO + param store, the
+    jit.save format): passes transform the parameter/buffer pytrees
+    (precision cast, tied-weight dedup) — the executable is already
+    compiled, so graph rewrites happened on the Layer side.
+
+``PassStrategy`` mirrors paddle_pass_builder's list surface
+(passes/delete_pass/insert_pass/append_pass); ``Analyzer.run`` applies
+whatever the config selects.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+_REGISTRY: Dict[str, "PassInfo"] = {}
+
+
+@dataclass
+class PassInfo:
+    name: str
+    fn: Callable
+    scope: str          # "layer" | "artifact" | "both"
+
+
+@dataclass
+class Argument:
+    """The analysis state handed pass-to-pass (reference
+    analysis/argument.h)."""
+
+    config: object = None
+    model: object = None              # Layer (engine path)
+    params: Optional[dict] = None     # exported-artifact path
+    buffers: Optional[dict] = None
+    exported: object = None
+    applied: List[str] = field(default_factory=list)
+
+
+def register_pass(name: str, scope: str = "both"):
+    def deco(fn):
+        _REGISTRY[name] = PassInfo(name, fn, scope)
+        return fn
+
+    return deco
+
+
+def get_pass(name: str) -> PassInfo:
+    return _REGISTRY[name]
+
+
+class PassStrategy:
+    """Ordered, editable pass list (reference PaddlePassBuilder:
+    paddle_pass_builder.h AppendPass/DeletePass/InsertPass)."""
+
+    def __init__(self, passes: List[str]):
+        self._passes = list(passes)
+
+    def passes(self) -> List[str]:
+        return list(self._passes)
+
+    def append_pass(self, name: str):
+        self._passes.append(name)
+
+    def delete_pass(self, name: str):
+        self._passes = [p for p in self._passes if p != name]
+
+    def insert_pass(self, idx: int, name: str):
+        self._passes.insert(idx, name)
+
+    def clear_passes(self):
+        self._passes = []
+
+
+class TpuPassStrategy(PassStrategy):
+    """The default serving pipeline (the GpuPassStrategy analog,
+    paddle_pass_builder.cc:194)."""
+
+    def __init__(self):
+        super().__init__([
+            "delete_dropout_pass",
+            "params_dedup_pass",
+            "precision_cast_pass",
+            "weight_only_quant_pass",
+        ])
+
+
+class Analyzer:
+    """reference analysis/analyzer.cc Analyzer::Run."""
+
+    def run(self, argument: Argument, strategy: PassStrategy):
+        disabled = set(getattr(argument.config, "_passes_disabled", ()))
+        for name in strategy.passes():
+            if name in disabled:
+                continue
+            info = _REGISTRY.get(name)
+            if info is None:
+                raise KeyError(f"unknown inference pass '{name}' "
+                               f"(registered: {sorted(_REGISTRY)})")
+            is_layer = argument.model is not None
+            if info.scope == "layer" and not is_layer:
+                continue
+            if info.scope == "artifact" and is_layer:
+                continue
+            info.fn(argument)
+            argument.applied.append(name)
+        return argument
+
+
+# ------------------------------------------------------------------ passes
+
+@register_pass("precision_cast_pass", scope="both")
+def _precision_cast(arg: Argument):
+    """convert_to_mixed_precision (reference
+    analysis/passes/convert_to_mixed_precision.cc): cast float params to
+    the configured serving dtype."""
+    from .config import PrecisionType
+
+    prec = getattr(arg.config, "_precision", None)
+    if prec not in (PrecisionType.Bfloat16, PrecisionType.Half):
+        return
+    tgt = jnp.bfloat16 if prec == PrecisionType.Bfloat16 else jnp.float16
+
+    if arg.model is not None:
+        for p in arg.model.parameters():
+            if jnp.issubdtype(p._data.dtype, jnp.floating):
+                p._data = p._data.astype(tgt)
+        return
+    arg.params = {n: (v.astype(tgt)
+                      if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                  for n, v in arg.params.items()}
+
+
+@register_pass("params_dedup_pass", scope="artifact")
+def _params_dedup(arg: Argument):
+    """Share storage between byte-identical parameters (tied embeddings /
+    lm heads) — the memory_optimize_pass analog for weights
+    (analysis/passes/memory_optimize_pass.cc)."""
+    buckets: Dict[tuple, list] = {}
+    out = {}
+    for n, v in arg.params.items():
+        key = (tuple(v.shape), str(v.dtype))
+        hit = None
+        for cand in buckets.get(key, []):
+            if cand is v or bool(jnp.all(cand == v)):
+                hit = cand
+                break
+        if hit is None:
+            buckets.setdefault(key, []).append(v)
+            hit = v
+        out[n] = hit
+    arg.params = out
+
+
+@register_pass("delete_dropout_pass", scope="layer")
+def _delete_dropout(arg: Argument):
+    """reference ir/delete_dropout_op_pass.cc: serving graphs drop
+    dropout entirely (not just eval-scaled)."""
+    from ..nn.layers_common import Dropout
+
+    for lay in arg.model.sublayers():
+        if isinstance(lay, Dropout):
+            lay.p = 0.0
+        if hasattr(lay, "dropout") and isinstance(
+                getattr(lay, "dropout", None), float):
+            lay.dropout = 0.0
+    arg.model.eval()
+
+
+@register_pass("weight_only_quant_pass", scope="layer")
+def _weight_only(arg: Argument):
+    """config.enable_weight_only_quant() → swap linears for
+    WeightOnlyLinear (reference weight_only_linear rewrites applied by
+    the predictor's pass list)."""
+    algo = getattr(arg.config, "_weight_only_quant", None)
+    if not algo:
+        return
+    from ..quantization import quantize_model
+
+    quantize_model(arg.model, algo=f"weight_only_{algo}",
+                   skip=lambda n, l: "embed" in n)
+
+
+@register_pass("int8_activation_pass", scope="layer")
+def _int8_act(arg: Argument):
+    """Opt-in: calibrated QAT/PTQ models serve int8 x int8
+    (quantization/int8.py; reference fused_multi_transformer_int8)."""
+    from ..quantization import convert_int8
+
+    convert_int8(arg.model)
+
+
+# ------------------------------------------------------------- public API
+
+def optimize_model(model, config=None, strategy: Optional[PassStrategy]
+                   = None):
+    """Run the serving pass pipeline over a Layer before handing it to a
+    generation engine / predictor export (the OptimizeInferenceProgram
+    analog, analysis_predictor.cc:1267)."""
+    arg = Argument(config=config, model=model)
+    Analyzer().run(arg, strategy or _strategy_for(config))
+    return model, arg.applied
+
+
+def optimize_artifact(params, buffers, exported, config=None,
+                      strategy: Optional[PassStrategy] = None):
+    arg = Argument(config=config, params=params, buffers=buffers,
+                   exported=exported)
+    Analyzer().run(arg, strategy or _strategy_for(config))
+    return arg
+
+
+def _strategy_for(config):
+    st = getattr(config, "_pass_strategy", None)
+    return st if st is not None else TpuPassStrategy()

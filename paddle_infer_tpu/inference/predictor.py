@@ -76,16 +76,16 @@ class Predictor:
             blob = pickle.load(f)
         params = {n: jnp.asarray(v) for n, v in blob["params"].items()}
         buffers = {n: jnp.asarray(v) for n, v in blob["buffers"].items()}
-        # convert_to_mixed_precision pass analog
-        if self._config._precision in (PrecisionType.Bfloat16,
-                                       PrecisionType.Half):
-            tgt = (jnp.bfloat16 if self._config._precision ==
-                   PrecisionType.Bfloat16 else jnp.float16)
-            params = {n: (v.astype(tgt)
-                          if jnp.issubdtype(v.dtype, jnp.floating) else v)
-                      for n, v in params.items()}
-        self._params = params
-        self._buffers = buffers
+        # the analysis pipeline (passes.py Analyzer; reference
+        # OptimizeInferenceProgram, analysis_predictor.cc:1267) — pass
+        # list editable via config.pass_builder()
+        from .passes import optimize_artifact
+
+        arg = optimize_artifact(params, buffers, self._exported,
+                                config=self._config)
+        self._params = arg.params
+        self._buffers = arg.buffers
+        self._applied_passes = arg.applied
         n_in = len(self._exported.in_avals) - _tree_len(params) \
             - _tree_len(buffers)
         self._input_names = [f"input_{i}" for i in range(max(n_in, 0))]

@@ -119,25 +119,29 @@ def convert_ifelse(pred, true_fn, false_fn, args):
         template = tuple(args[i] for i in idx)
         ops0 = _pack_state(template, "if")
 
+        out_template = []
+
         def call(fn, ops):
             full = list(args)
             for i, v in zip(idx, _rewrap(template, ops)):
                 full[i] = v
-            return _pack_state(fn(*full), "if")
+            res = fn(*full)
+            if not out_template:
+                out_template.append(res)
+            return _pack_state(res, "if")
 
-        t_probe = true_fn(*args)
-        t_arrs = _pack_state(t_probe, "if")
-        f_arrs = _pack_state(false_fn(*args), "if")
-        for a, b in zip(t_arrs, f_arrs):
-            if a.shape != b.shape or a.dtype != b.dtype:
-                raise ValueError(
-                    "dy2static: tensor-if branches must produce matching "
-                    f"shapes/dtypes, got {a.shape}/{a.dtype} vs "
-                    f"{b.shape}/{b.dtype}")
-        out = jax.lax.cond(_to_bool_scalar(pred),
-                           functools.partial(call, true_fn),
-                           functools.partial(call, false_fn), ops0)
-        return _rewrap(t_probe, out)
+        try:
+            # each branch traces exactly once (inside cond); cond itself
+            # enforces matching output avals
+            out = jax.lax.cond(_to_bool_scalar(pred),
+                               functools.partial(call, true_fn),
+                               functools.partial(call, false_fn), ops0)
+        except TypeError as e:
+            raise ValueError(
+                "dy2static: tensor-if branches must produce matching "
+                f"shapes/dtypes for every assigned variable ({e})"
+            ) from None
+        return _rewrap(out_template[0], out)
     pv = _unwrap(pred)
     taken = true_fn if bool(pv) else false_fn
     return taken(*args)
@@ -305,6 +309,9 @@ def _has_escape(stmts):
     return v.found
 
 
+_JST = "__pit_jst__"
+
+
 def _name(n, ctx=None):
     return ast.Name(id=n, ctx=ctx or ast.Load())
 
@@ -312,7 +319,7 @@ def _name(n, ctx=None):
 def _maybe_arg(n):
     # _jst.maybe(lambda: n) — lazily tolerate not-yet-bound names
     return ast.Call(
-        func=ast.Attribute(value=_name("_jst"), attr="maybe",
+        func=ast.Attribute(value=_name(_JST), attr="maybe",
                            ctx=ast.Load()),
         args=[ast.Lambda(
             args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
@@ -356,7 +363,7 @@ class Dy2StaticTransformer(ast.NodeTransformer):
             targets=[ast.Tuple(elts=[_name(s, ast.Store())
                                      for s in stores], ctx=ast.Store())],
             value=ast.Call(
-                func=ast.Attribute(value=_name("_jst"),
+                func=ast.Attribute(value=_name(_JST),
                                    attr="convert_ifelse", ctx=ast.Load()),
                 args=[node.test, _name(tname), _name(fname),
                       ast.Tuple(elts=[_maybe_arg(s) for s in stores],
@@ -390,7 +397,7 @@ class Dy2StaticTransformer(ast.NodeTransformer):
             targets=[ast.Tuple(elts=[_name(s, ast.Store())
                                      for s in stores], ctx=ast.Store())],
             value=ast.Call(
-                func=ast.Attribute(value=_name("_jst"),
+                func=ast.Attribute(value=_name(_JST),
                                    attr="convert_while", ctx=ast.Load()),
                 args=[_name(cname), _name(bname),
                       ast.Tuple(elts=[_maybe_arg(s) for s in stores],
@@ -413,6 +420,19 @@ class Dy2StaticTransformer(ast.NodeTransformer):
         start = ra[0] if len(ra) >= 2 else ast.Constant(value=0)
         stop = ra[1] if len(ra) >= 2 else ra[0]
         step = ra[2] if len(ra) == 3 else ast.Constant(value=1)
+        # the while-lowering needs the step's sign for its comparison;
+        # non-constant steps keep the plain python for
+        descending = False
+        if len(ra) == 3:
+            sv = step
+            if isinstance(sv, ast.UnaryOp) and isinstance(sv.op, ast.USub) \
+                    and isinstance(sv.operand, ast.Constant):
+                descending = True
+            elif isinstance(sv, ast.Constant) \
+                    and isinstance(sv.value, (int, float)):
+                descending = sv.value < 0
+            else:
+                return node
         stop_v = self._fresh("stop")
         step_v = self._fresh("step")
         init = [
@@ -420,7 +440,8 @@ class Dy2StaticTransformer(ast.NodeTransformer):
             ast.Assign(targets=[_name(stop_v, ast.Store())], value=stop),
             ast.Assign(targets=[_name(step_v, ast.Store())], value=step),
         ]
-        test = ast.Compare(left=_name(i), ops=[ast.Lt()],
+        test = ast.Compare(left=_name(i),
+                           ops=[ast.Gt() if descending else ast.Lt()],
                            comparators=[_name(stop_v)])
         incr = ast.AugAssign(target=_name(i, ast.Store()), op=ast.Add(),
                              value=_name(step_v))
@@ -440,7 +461,7 @@ class Dy2StaticTransformer(ast.NodeTransformer):
         expr = node.values[0]
         for rhs in node.values[1:]:
             expr = ast.Call(
-                func=ast.Attribute(value=_name("_jst"), attr=conv,
+                func=ast.Attribute(value=_name(_JST), attr=conv,
                                    ctx=ast.Load()),
                 args=[expr, ast.Lambda(
                     args=ast.arguments(posonlyargs=[], args=[],
@@ -454,7 +475,7 @@ class Dy2StaticTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         if isinstance(node.op, ast.Not):
             return ast.Call(
-                func=ast.Attribute(value=_name("_jst"),
+                func=ast.Attribute(value=_name(_JST),
                                    attr="convert_logical_not",
                                    ctx=ast.Load()),
                 args=[node.operand], keywords=[])
@@ -507,8 +528,11 @@ def convert_function(fn: Callable) -> Callable:
     ast.fix_missing_locations(module)
     code = compile(module, filename=f"<dy2static {func.__name__}>",
                    mode="exec")
-    glb = dict(func.__globals__)
-    glb["_jst"] = _JstModule
+    # execute against the REAL module globals so names defined/patched
+    # after decoration still resolve at call time; only the private
+    # helper binding is injected
+    glb = func.__globals__
+    glb[_JST] = _JstModule
     loc = {}
     exec(code, glb, loc)
     cells = [c.cell_contents for c in (func.__closure__ or ())]

@@ -1,0 +1,127 @@
+"""Kernel autotuner: measured block-size selection with a persistent
+cache.
+
+Reference: paddle/phi/kernels/autotune/ — AutoTuneBase::Run times kernel
+candidates per shape key (auto_tune_base.h), AutoTuneCache keeps the
+winner per (algo, key) and serializes across runs (cache.h), gated by a
+switch (``EnableAutoTune``).
+
+TPU redesign: the tunables are Pallas grid block sizes, not cuDNN algo
+enums.  Tuning happens at *trace time* with concrete dummy operands (the
+live values are tracers), so one benchmark per (kernel, shape) services
+every retrace; winners persist to ``FLAGS_autotune_cache_file`` so a
+serving restart pays nothing.  The incumbent default must lose by >3% to
+be replaced — noisy timings never regress the shipped configuration.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+from ...framework.flags import define_flag, flags
+
+define_flag("use_autotune", True,
+            "measure Pallas kernel block-size candidates per shape and "
+            "cache the winner (reference phi/kernels/autotune)")
+define_flag("autotune_cache_file", "",
+            "JSON file persisting autotune winners across processes")
+
+_CACHE: Dict[str, list] = {}
+_LOADED = False
+_MIN_GAIN = 0.97     # challenger must beat the incumbent by >3%
+
+
+def _cache_path() -> Optional[str]:
+    p = flags("autotune_cache_file")
+    return p or os.environ.get("FLAGS_autotune_cache_file") or None
+
+
+def _load():
+    global _LOADED
+    if _LOADED:
+        return
+    _LOADED = True
+    p = _cache_path()
+    if p and os.path.exists(p):
+        try:
+            with open(p) as f:
+                _CACHE.update(json.load(f))
+        except (OSError, json.JSONDecodeError):   # pragma: no cover
+            pass
+
+
+def _persist():
+    p = _cache_path()
+    if not p:
+        return
+    tmp = p + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(_CACHE, f)
+        os.replace(tmp, p)
+    except OSError:                               # pragma: no cover
+        pass
+
+
+def enabled() -> bool:
+    import jax
+
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:                             # pragma: no cover
+        return False
+    return bool(flags("use_autotune"))
+
+
+def clear():
+    _CACHE.clear()
+
+
+def autotune(key: str, default, candidates: Sequence,
+             measure: Callable[[object], float]):
+    """Return the cached winner for ``key`` or measure ``candidates``
+    (incumbent ``default`` first; challengers must beat it by >3%).
+    ``measure(cand) -> seconds`` should include compile via a warmup call
+    so only steady-state time is compared."""
+    if not enabled():
+        return default
+    _load()
+    hit = _CACHE.get(key)
+    if hit is not None:
+        return tuple(hit) if isinstance(hit, list) else hit
+    best, best_t = default, None
+    try:
+        best_t = measure(default)
+        for cand in candidates:
+            if cand == default:
+                continue
+            try:
+                t = measure(cand)
+            except Exception:       # candidate invalid for this shape
+                continue
+            if best_t is None or t < best_t * _MIN_GAIN:
+                best, best_t = cand, t
+    except Exception:               # pragma: no cover - measurement failed
+        return default
+    _CACHE[key] = list(best) if isinstance(best, tuple) else best
+    _persist()
+    return best
+
+
+def time_fn(fn: Callable[[], object], iters: int = 3) -> float:
+    """Median wall time of ``fn`` after a compile/warmup call; results
+    must expose block_until_ready (jax arrays / pytrees)."""
+    import jax
+
+    out = fn()
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]

@@ -524,10 +524,42 @@ def _prep_seed(dropout_p, dropout_seed):
     return jnp.asarray(dropout_seed).astype(jnp.uint32).reshape(())
 
 
+_BLOCK_CANDIDATES = ((512, 512), (256, 512), (512, 256), (256, 256),
+                     (1024, 512), (128, 128))
+
+
+def _tuned_blocks(kind, b, h, sq, sk, d, dtype, causal, segmented,
+                  dropout_p, interpret, runner):
+    """Measured block-size selection (ops/pallas/autotune.py; reference
+    phi/kernels/autotune AutoTuneBase::Run) — benchmarks fwd+bwd on dummy
+    operands at trace time, keyed by the full shape signature."""
+    from . import autotune as at
+
+    default = (_fit_block(512, sq), _fit_block(512, sk))
+    if interpret or not at.enabled():
+        return default
+    key = (f"{kind}:b{b}h{h}q{sq}k{sk}d{d}:{dtype}:c{int(causal)}"
+           f":s{int(segmented)}:p{dropout_p:g}")
+
+    def measure(blocks):
+        bq = _fit_block(blocks[0], sq)
+        bk = _fit_block(blocks[1], sk)
+        if (bq, bk) != tuple(blocks):
+            raise ValueError("blocks don't fit seq")
+        return at.time_fn(lambda: runner(bq, bk))
+
+    cands = [c for c in _BLOCK_CANDIDATES
+             if c[0] <= sq and c[1] <= sk]
+    try:
+        return at.autotune(key, default, cands, measure)
+    finally:
+        _TUNE_OPERANDS.clear()     # winners are cached; free the HBM
+
+
 def flash_attention(q, k, v, mask=None, q_segment_ids=None,
                     kv_segment_ids=None, dropout_p=0.0, dropout_seed=None,
                     is_causal=False, scale=None,
-                    block_q=512, block_k=512, interpret=None):
+                    block_q=None, block_k=None, interpret=None):
     """Flash attention in (batch, seq, heads, head_dim) layout.
 
     Masking is via int32 ``{q,kv}_segment_ids`` (attend iff equal) plus
@@ -543,13 +575,23 @@ def flash_attention(q, k, v, mask=None, q_segment_ids=None,
                                   "unsupported — use segment ids")
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    if interpret is None:
+        interpret = _interpret()
+    if block_q is None or block_k is None:
+        def runner(bq, bk):
+            return _tune_run(_flash3, b, h, sq, sk, d, q.dtype,
+                             bool(is_causal), q_segment_ids is not None,
+                             float(dropout_p), bq, bk)
+
+        block_q, block_k = _tuned_blocks(
+            "flash", b, h, sq, sk, d, str(q.dtype), bool(is_causal),
+            q_segment_ids is not None, float(dropout_p), interpret,
+            runner)
     block_q = _fit_block(block_q, sq)
     block_k = _fit_block(block_k, sk)
     if sq % block_q or sk % block_k:
         raise ValueError(f"seq ({sq},{sk}) must divide blocks "
                          f"({block_q},{block_k})")
-    if interpret is None:
-        interpret = _interpret()
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     qseg, kseg = _prep_segments(q_segment_ids, kv_segment_ids, b, sq, sk)
     seed = _prep_seed(dropout_p, dropout_seed)
@@ -561,6 +603,47 @@ def flash_attention(q, k, v, mask=None, q_segment_ids=None,
                 bool(is_causal), float(scale), float(dropout_p),
                 int(block_q), int(block_k), bool(interpret), h)
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _tune_run(kernel, b, h, sq, sk, d, dtype, causal, segmented,
+              dropout_p, bq, bk):
+    """One fwd+bwd execution of ``kernel`` on cached dummy operands —
+    what the autotuner times per block candidate."""
+    import numpy as _np
+
+    key = (b, h, sq, sk, d, str(dtype), segmented)
+    ops = _TUNE_OPERANDS.get(key)
+    if ops is None:
+        rng = _np.random.RandomState(0)
+        mk = lambda s_: jnp.asarray(
+            rng.randn(b * h, s_, d).astype(_np.float32) * 0.1, dtype)
+        qf, kf, vf = mk(sq), mk(sk), mk(sk)
+        if segmented:
+            qseg = jnp.broadcast_to(
+                jnp.ones((b, sq, 1), jnp.int32), (b, sq, STAT_LANES))
+            kseg = jnp.broadcast_to(
+                jnp.ones((b, 1, sk), jnp.int32), (b, SEG_SUBLANES, sk))
+        else:
+            qseg = kseg = None
+        ops = (qf, kf, vf, qseg, kseg)
+        _TUNE_OPERANDS[key] = ops
+    qf, kf, vf, qseg, kseg = ops
+    seed = jnp.uint32(0) if dropout_p else None
+    scale = 1.0 / math.sqrt(d)
+
+    @jax.jit
+    def step(qf, kf, vf):
+        def loss(qf, kf, vf):
+            o = kernel(qf, kf, vf, qseg, kseg, seed, causal, scale,
+                       dropout_p, bq, bk, False, h)
+            return jnp.sum(o.astype(jnp.float32))
+
+        return jax.grad(loss, argnums=(0, 1, 2))(qf, kf, vf)
+
+    return step(qf, kf, vf)
+
+
+_TUNE_OPERANDS = {}
 
 
 # --------------------------------------------- hybrid: XLA fwd + Pallas bwd
@@ -643,7 +726,8 @@ _hybrid.defvjp(_hybrid_fwd, _hybrid_bwd)
 
 def hybrid_attention(q, k, v, q_segment_ids=None, kv_segment_ids=None,
                      dropout_p=0.0, dropout_seed=None, is_causal=False,
-                     scale=None, block_q=512, block_k=512, interpret=None):
+                     scale=None, block_q=None, block_k=None,
+                     interpret=None):
     """XLA-forward / Pallas-backward attention, (b, s, h, d) layout.
 
     The training-path default on TPU for moderate sequence lengths (the
@@ -653,13 +737,23 @@ def hybrid_attention(q, k, v, q_segment_ids=None, kv_segment_ids=None,
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    if interpret is None:
+        interpret = _interpret()
+    if block_q is None or block_k is None:
+        def runner(bq, bk):
+            return _tune_run(_hybrid, b, h, sq, sk, d, q.dtype,
+                             bool(is_causal), q_segment_ids is not None,
+                             float(dropout_p), bq, bk)
+
+        block_q, block_k = _tuned_blocks(
+            "hybrid", b, h, sq, sk, d, str(q.dtype), bool(is_causal),
+            q_segment_ids is not None, float(dropout_p), interpret,
+            runner)
     block_q = _fit_block(block_q, sq)
     block_k = _fit_block(block_k, sk)
     if sq % block_q or sk % block_k:
         raise ValueError(f"seq ({sq},{sk}) must divide blocks "
                          f"({block_q},{block_k})")
-    if interpret is None:
-        interpret = _interpret()
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     qseg, kseg = _prep_segments(q_segment_ids, kv_segment_ids, b, sq, sk)
     seed = _prep_seed(dropout_p, dropout_seed)

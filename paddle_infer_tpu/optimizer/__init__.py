@@ -2,10 +2,10 @@
 from . import lr
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 from .optimizer import (Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,
-                        Momentum, Optimizer, RMSProp, SGD)
+                        Lars, Momentum, Optimizer, RMSProp, SGD)
 
 __all__ = [
     "lr", "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
-    "RMSProp", "Lamb", "Adadelta", "Adamax",
+    "RMSProp", "Lamb", "Lars", "Adadelta", "Adamax",
     "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue",
 ]

@@ -351,6 +351,60 @@ class Lamb(Optimizer):
         return new_p.astype(param.dtype), {"m": m, "v": v}
 
 
+class Lars(Optimizer):
+    """LARS momentum: layer-wise trust-ratio-scaled LR (reference
+    lars_momentum op, phi/kernels/gpu/lars_momentum_kernel.cu + the
+    LarsMomentumOptimizer / lars meta-optimizer,
+    fleet/meta_optimizers/lars_optimizer.py) — the large-batch training
+    rule the reference exposes through DistributedStrategy.lars."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, epsilon=1e-8,
+                 exclude_from_weight_decay=None):
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+        self._exclude = tuple(exclude_from_weight_decay or ())
+        super().__init__(learning_rate, parameters, None, grad_clip)
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._data)}
+
+    def _param_weight_decay(self, param) -> float:
+        # reference LarsMomentumOptimizer: params whose name matches
+        # exclude_from_weight_decay use plain momentum (no wd, no trust
+        # ratio) — signalled to _update through the wd argument
+        name = getattr(param, "name", "") or ""
+        if any(pat in name for pat in self._exclude):
+            return 0.0
+        return self._lars_wd
+
+    def _decay_into_grad(self):
+        return False
+
+    def _update(self, param, grad, state, lr, step, wd):
+        g32 = grad.astype(jnp.float32)
+        p32 = param.astype(jnp.float32)
+        p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        g_norm = jnp.sqrt(jnp.sum(g32 * g32))
+        # trust ratio: coeff * ||w|| / (||g|| + wd * ||w||); 1.0 for
+        # zero-norm params (fresh biases) and excluded params (wd arg 0
+        # via _param_weight_decay), like the reference kernel
+        denom = g_norm + wd * p_norm + self._eps
+        ratio = jnp.where(p_norm > 0.0,
+                          self._lars_coeff * p_norm / denom, 1.0)
+        if self._exclude:
+            ratio = jnp.where(wd == 0.0, 1.0, ratio)
+        local_lr = lr.astype(jnp.float32) * ratio
+        v = self._momentum * state["velocity"].astype(jnp.float32) \
+            + local_lr * (g32 + wd * p32)
+        new_p = p32 - v
+        return new_p.astype(param.dtype), {
+            "velocity": v.astype(state["velocity"].dtype)}
+
+
 class Adadelta(Optimizer):
     def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
                  parameters=None, weight_decay=None, grad_clip=None):

@@ -311,3 +311,79 @@ class TestReviewRegressions:
         assert tuple(out.shape) == (1, 3, 8, 8)
         # the appended border must carry real contributions, not zeros
         assert np.abs(out.numpy()[:, :, -1, :]).sum() > 0
+
+
+def test_lars_optimizer_trust_ratio():
+    """LARS (reference lars_momentum_kernel.cu): update = momentum*v +
+    local_lr*(g + wd*p) with local_lr = lr * coeff*||p||/(||g||+wd*||p||);
+    numpy-checked one step."""
+    import numpy as np
+
+    import paddle_infer_tpu as pit
+
+    pit.seed(0)
+    p0 = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    g0 = np.random.RandomState(1).randn(4, 3).astype(np.float32)
+    p = pit.Tensor(p0.copy())
+    p.stop_gradient = False
+    opt = pit.optimizer.Lars(learning_rate=0.1, momentum=0.9,
+                             lars_coeff=0.001, lars_weight_decay=0.0005,
+                             parameters=[p])
+    p.grad = pit.Tensor(g0.copy())
+    opt.step()
+    pn = np.linalg.norm(p0)
+    gn = np.linalg.norm(g0)
+    ratio = 0.001 * pn / (gn + 0.0005 * pn + 1e-8)
+    v = 0.1 * ratio * (g0 + 0.0005 * p0)
+    np.testing.assert_allclose(p.numpy(), p0 - v, rtol=1e-5, atol=1e-6)
+    # second step applies momentum
+    p.grad = pit.Tensor(g0.copy())
+    prev = p.numpy().copy()
+    opt.step()
+    assert not np.allclose(p.numpy(), prev)
+
+
+def test_lars_trains_lenet_step():
+    import numpy as np
+
+    import paddle_infer_tpu as pit
+    from paddle_infer_tpu import nn
+
+    pit.seed(0)
+    model = nn.Linear(8, 4)
+    opt = pit.optimizer.Lars(learning_rate=0.5,
+                             parameters=model.parameters())
+    x = pit.Tensor(np.random.RandomState(0).randn(16, 8)
+                   .astype(np.float32))
+    y = pit.Tensor(np.random.RandomState(1).randint(0, 4, 16)
+                   .astype(np.int32))
+    losses = []
+    for _ in range(10):
+        loss = nn.functional.cross_entropy(model(x), y, reduction="mean")
+        losses.append(float(loss.numpy()))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < losses[0]
+
+
+def test_lars_exclude_from_weight_decay():
+    """Excluded params (reference LarsMomentumOptimizer exclusion list)
+    get plain momentum: no wd term, no trust-ratio scaling."""
+    import numpy as np
+
+    import paddle_infer_tpu as pit
+    from paddle_infer_tpu.core.tensor import Parameter
+
+    p0 = np.random.RandomState(2).randn(6).astype(np.float32)
+    g0 = np.random.RandomState(3).randn(6).astype(np.float32)
+    p = Parameter(p0.copy(), name="encoder.norm.bias")
+    opt = pit.optimizer.Lars(learning_rate=0.1, momentum=0.9,
+                             lars_coeff=0.001, lars_weight_decay=0.0005,
+                             parameters=[p],
+                             exclude_from_weight_decay=["norm", "bias"])
+    p.grad = pit.Tensor(g0.copy())
+    opt.step()
+    # plain momentum step: v = lr * g; p -= v (ratio forced to 1, wd 0)
+    np.testing.assert_allclose(p.numpy(), p0 - 0.1 * g0, rtol=1e-5,
+                               atol=1e-6)
