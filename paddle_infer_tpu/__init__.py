@@ -63,6 +63,7 @@ from . import vision
 from . import hapi
 from .hapi import Model
 from . import device
+from . import audio
 from . import distribution
 from . import fft
 from . import sparse

@@ -1,0 +1,17 @@
+"""paddle.audio parity: spectral feature layers + functional helpers.
+
+Reference: python/paddle/audio/ — functional/functional.py (hz_to_mel,
+compute_fbank_matrix, power_to_db, create_dct) and features/layers.py
+(Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC).
+
+TPU-first: framing is one gather (precomputed indices — no strided
+views), the STFT is the fft namespace's rfft (XLA FFT HLO), and the mel /
+DCT projections are dense matmuls that land on the MXU — the whole
+feature pipeline fuses into a handful of XLA ops and is differentiable.
+"""
+from . import functional  # noqa: F401
+from .features import (LogMelSpectrogram, MelSpectrogram, MFCC,  # noqa
+                       Spectrogram)
+
+__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

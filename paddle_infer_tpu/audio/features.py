@@ -1,0 +1,97 @@
+"""Audio feature layers (reference python/paddle/audio/features/layers.py:
+Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch as D
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from . import functional as AF
+
+
+def _frame_indices(n_samples: int, n_fft: int, hop: int):
+    n_frames = 1 + (n_samples - n_fft) // hop
+    starts = jnp.arange(n_frames) * hop
+    return starts[:, None] + jnp.arange(n_fft)[None, :]   # [frames, n_fft]
+
+
+class Spectrogram(Layer):
+    """STFT magnitude^power: [batch, time] -> [batch, freq, frames]
+    (center-padded, reference Spectrogram defaults)."""
+
+    def __init__(self, n_fft: int = 512, hop_length=None, win_length=None,
+                 window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = AF.get_window(window, self.win_length)._data
+        if self.win_length < n_fft:    # center-pad window to n_fft
+            lp = (n_fft - self.win_length) // 2
+            w = jnp.pad(w, (lp, n_fft - self.win_length - lp))
+        self._window = w
+
+    def forward(self, x):
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        squeeze = arr.ndim == 1
+        if squeeze:
+            arr = arr[None]
+        if self.center:
+            p = self.n_fft // 2
+            arr = jnp.pad(arr, ((0, 0), (p, p)), mode=self.pad_mode)
+        idx = _frame_indices(arr.shape[-1], self.n_fft, self.hop)
+        frames = arr[:, idx] * self._window[None, None, :]
+        spec = jnp.fft.rfft(frames, axis=-1)          # [b, frames, freq]
+        mag = jnp.abs(spec) ** self.power
+        out = jnp.swapaxes(mag, 1, 2)                 # [b, freq, frames]
+        return Tensor(out[0] if squeeze else out)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window: str = "hann", power: float = 2.0,
+                 center: bool = True, n_mels: int = 64, f_min: float = 0.0,
+                 f_max=None, htk: bool = False, norm: str = "slaney"):
+        super().__init__()
+        self._spect = Spectrogram(n_fft, hop_length, win_length, window,
+                                  power, center)
+        self._fbank = AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm)
+
+    def forward(self, x):
+        s = self._spect(x)
+        # [.., freq, frames] x [n_mels, freq]^T — one MXU matmul
+        return D("matmul", Tensor(self._fbank._data), s)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db=None, **mel_kwargs):
+        super().__init__()
+        self._mel = MelSpectrogram(sr=sr, **mel_kwargs)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self._mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40,
+                 norm: str = "ortho", **mel_kwargs):
+        super().__init__()
+        self._log_mel = LogMelSpectrogram(sr=sr, **mel_kwargs)
+        n_mels = self._log_mel._mel._fbank.shape[0]
+        # stored pre-transposed: [n_mfcc, n_mels] left-multiplies the mel
+        # spectrogram directly
+        self._dct_t = Tensor(AF.create_dct(n_mfcc, n_mels,
+                                           norm)._data.T)
+
+    def forward(self, x):
+        lm = self._log_mel(x)                 # [.., n_mels, frames]
+        return D("matmul", self._dct_t, lm)

@@ -1,0 +1,119 @@
+"""Audio functional helpers (reference
+python/paddle/audio/functional/functional.py + window.py)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """reference functional.py hz_to_mel (Slaney default, HTK option)."""
+    f = np.asarray(freq, np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, out)
+    return float(out) if np.isscalar(freq) else out
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = np.asarray(mel, np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                       out)
+    return float(out) if np.isscalar(mel) else out
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                       n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    return np.linspace(0, sr / 2, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None, htk: bool = False,
+                         norm: str = "slaney"):
+    """[n_mels, 1 + n_fft//2] mel filterbank (reference
+    compute_fbank_matrix)."""
+    f_max = f_max if f_max is not None else sr / 2.0
+    fftfreqs = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    weights = np.zeros((n_mels, len(fftfreqs)))
+    for i in range(n_mels):
+        lower = -ramps[i] / fdiff[i]
+        upper = ramps[i + 2] / fdiff[i + 1]
+        weights[i] = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    return Tensor(jnp.asarray(weights, jnp.float32))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: str = "ortho"):
+    """[n_mels, n_mfcc] DCT-II basis (reference create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    basis = np.cos(math.pi / n_mels * (n + 0.5) * k)   # [n_mfcc, n_mels]
+    if norm == "ortho":
+        basis[0] *= 1.0 / math.sqrt(2)
+        basis *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return Tensor(jnp.asarray(basis.T, jnp.float32))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db=80.0):
+    """10·log10(S/ref) with floor + dynamic-range clip (reference
+    power_to_db)."""
+    x = spect._data if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    """hann/hamming/blackman/ones (reference window.py get_window)."""
+    n = win_length
+    denom = n if fftbins else n - 1
+    t = np.arange(n)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * t / denom)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * t / denom)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * t / denom)
+             + 0.08 * np.cos(4 * math.pi * t / denom))
+    elif window in ("ones", "boxcar", "rectangular"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unsupported window '{window}'")
+    return Tensor(jnp.asarray(w, jnp.float32))

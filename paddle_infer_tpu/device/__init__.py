@@ -47,3 +47,70 @@ def synchronize():
 
 def cuda_device_count() -> int:
     return 0
+
+
+# ------------------------------------------------------- memory statistics
+# (reference: paddle/fluid/memory/stats.h Stat singleton — per-device
+#  Allocated/Reserved current + peak, surfaced as
+#  paddle.device.cuda.max_memory_allocated etc.  TPU redesign: the live
+#  numbers come from PJRT's device.memory_stats(); the peak watermark is
+#  tracked host-side across snapshot() calls the way HostMemoryStat
+#  aggregates updates.)
+
+_mem_peak = {}
+_peak_baseline = {}   # PJRT lifetime peak at last reset (non-resettable)
+
+
+def memory_stats(device_id: int = 0) -> dict:
+    """Raw PJRT memory counters for one device (empty dict when the
+    backend does not expose them, e.g. CPU)."""
+    import jax
+
+    dev = jax.devices()[device_id]
+    try:
+        return dict(dev.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(device_id: int = 0) -> int:
+    """Live bytes in use on the device (reference
+    memory::StatGetCurrentValue("Allocated"))."""
+    return int(memory_stats(device_id).get("bytes_in_use", 0))
+
+
+def memory_reserved(device_id: int = 0) -> int:
+    """Bytes reserved from the device allocator (pool limit if exposed)."""
+    st = memory_stats(device_id)
+    return int(st.get("pool_bytes", st.get("bytes_reserved",
+                                           st.get("bytes_limit", 0))))
+
+
+def max_memory_allocated(device_id: int = 0) -> int:
+    """Peak live bytes since the last reset_max_memory_allocated.  PJRT's
+    peak counter is a lifetime value, so resets record it as a baseline:
+    only growth past the baseline (or live snapshots) raises the
+    watermark afterwards."""
+    st = memory_stats(device_id)
+    lifetime = int(st.get("peak_bytes_in_use", 0))
+    base = _peak_baseline.get(device_id, 0)
+    cand = lifetime if lifetime > base else 0
+    _mem_peak[device_id] = max(_mem_peak.get(device_id, 0),
+                               int(st.get("bytes_in_use", 0)), cand)
+    return _mem_peak[device_id]
+
+
+def reset_max_memory_allocated(device_id: int = 0):
+    _mem_peak[device_id] = 0
+    _peak_baseline[device_id] = int(
+        memory_stats(device_id).get("peak_bytes_in_use", 0))
+
+
+class cuda:
+    """Name-parity shim: paddle.device.cuda.* memory queries map to the
+    TPU device counters (there is no CUDA here by design)."""
+
+    memory_allocated = staticmethod(memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    reset_max_memory_allocated = staticmethod(reset_max_memory_allocated)

@@ -95,8 +95,8 @@ class TpuPassStrategy(PassStrategy):
     def __init__(self):
         super().__init__([
             "delete_dropout_pass",
-            "params_dedup_pass",
-            "precision_cast_pass",
+            "precision_cast_pass",      # cast BEFORE dedup so tied
+            "params_dedup_pass",        # weights stay shared post-cast
             "weight_only_quant_pass",
         ])
 
@@ -155,7 +155,12 @@ def _params_dedup(arg: Argument):
     buckets: Dict[tuple, list] = {}
     out = {}
     for n, v in arg.params.items():
-        key = (tuple(v.shape), str(v.dtype))
+        # cheap content digest narrows the bucket to near-certain matches
+        # (one scalar fetch per param) before any full-tensor compare —
+        # O(n) instead of O(n^2) device comparisons per shape class
+        digest = float(jnp.sum(jnp.abs(v.astype(jnp.float32)))) \
+            if jnp.issubdtype(v.dtype, jnp.inexact) else float(jnp.sum(v))
+        key = (tuple(v.shape), str(v.dtype), digest)
         hit = None
         for cand in buckets.get(key, []):
             if cand is v or bool(jnp.all(cand == v)):

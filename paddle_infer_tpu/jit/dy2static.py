@@ -137,10 +137,14 @@ def convert_ifelse(pred, true_fn, false_fn, args):
                                functools.partial(call, true_fn),
                                functools.partial(call, false_fn), ops0)
         except TypeError as e:
+            msg = str(e)
+            if not any(tok in msg for tok in
+                       ("true_fun", "false_fun", "branch", "cond")):
+                raise          # a real bug inside a branch body
             raise ValueError(
                 "dy2static: tensor-if branches must produce matching "
                 f"shapes/dtypes for every assigned variable ({e})"
-            ) from None
+            ) from e
         return _rewrap(out_template[0], out)
     pv = _unwrap(pred)
     taken = true_fn if bool(pv) else false_fn

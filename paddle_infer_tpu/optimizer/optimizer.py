@@ -369,17 +369,20 @@ class Lars(Optimizer):
         self._exclude = tuple(exclude_from_weight_decay or ())
         super().__init__(learning_rate, parameters, None, grad_clip)
 
+    def _is_excluded(self, param) -> bool:
+        name = getattr(param, "name", "") or ""
+        return any(pat in name for pat in self._exclude)
+
     def _init_state(self, p):
-        return {"velocity": jnp.zeros_like(p._data)}
+        # reference LarsMomentumOptimizer: excluded params (by name) use
+        # plain momentum — the flag travels in the state so the shared
+        # jitted rule stays trace-stable
+        return {"velocity": jnp.zeros_like(p._data),
+                "lars_on": jnp.float32(0.0 if self._is_excluded(p)
+                                       else 1.0)}
 
     def _param_weight_decay(self, param) -> float:
-        # reference LarsMomentumOptimizer: params whose name matches
-        # exclude_from_weight_decay use plain momentum (no wd, no trust
-        # ratio) — signalled to _update through the wd argument
-        name = getattr(param, "name", "") or ""
-        if any(pat in name for pat in self._exclude):
-            return 0.0
-        return self._lars_wd
+        return 0.0 if self._is_excluded(param) else self._lars_wd
 
     def _decay_into_grad(self):
         return False
@@ -390,19 +393,19 @@ class Lars(Optimizer):
         p_norm = jnp.sqrt(jnp.sum(p32 * p32))
         g_norm = jnp.sqrt(jnp.sum(g32 * g32))
         # trust ratio: coeff * ||w|| / (||g|| + wd * ||w||); 1.0 for
-        # zero-norm params (fresh biases) and excluded params (wd arg 0
-        # via _param_weight_decay), like the reference kernel
+        # zero-norm params (fresh biases) and excluded params, like the
+        # reference kernel
         denom = g_norm + wd * p_norm + self._eps
         ratio = jnp.where(p_norm > 0.0,
                           self._lars_coeff * p_norm / denom, 1.0)
-        if self._exclude:
-            ratio = jnp.where(wd == 0.0, 1.0, ratio)
+        ratio = jnp.where(state["lars_on"] > 0.0, ratio, 1.0)
         local_lr = lr.astype(jnp.float32) * ratio
         v = self._momentum * state["velocity"].astype(jnp.float32) \
             + local_lr * (g32 + wd * p32)
         new_p = p32 - v
         return new_p.astype(param.dtype), {
-            "velocity": v.astype(state["velocity"].dtype)}
+            "velocity": v.astype(state["velocity"].dtype),
+            "lars_on": state["lars_on"]}
 
 
 class Adadelta(Optimizer):

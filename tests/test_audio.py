@@ -1,0 +1,87 @@
+"""paddle.audio parity (reference python/paddle/audio/): spectral
+features checked against direct numpy STFT computations."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import audio
+
+
+def _tone(sr=8000, n=4096, f=440.0):
+    t = np.arange(n) / sr
+    return (0.5 * np.sin(2 * math.pi * f * t)).astype(np.float32)
+
+
+class TestFunctional:
+    def test_mel_hz_roundtrip(self):
+        for htk in (False, True):
+            f = np.array([0.0, 440.0, 1000.0, 4000.0])
+            back = audio.functional.mel_to_hz(
+                audio.functional.hz_to_mel(f, htk), htk)
+            np.testing.assert_allclose(back, f, rtol=1e-6, atol=1e-6)
+
+    def test_fbank_shape_and_partition(self):
+        fb = audio.functional.compute_fbank_matrix(8000, 512, n_mels=40)
+        assert tuple(fb.shape) == (40, 257)
+        w = fb.numpy()
+        assert (w >= 0).all()
+        # every filter has support
+        assert (w.sum(axis=1) > 0).all()
+
+    def test_power_to_db(self):
+        s = pit.Tensor(np.array([1.0, 10.0, 100.0], np.float32))
+        db = audio.functional.power_to_db(s, top_db=None).numpy()
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-5)
+
+    def test_windows(self):
+        h = audio.functional.get_window("hann", 8).numpy()
+        np.testing.assert_allclose(
+            h, 0.5 - 0.5 * np.cos(2 * math.pi * np.arange(8) / 8),
+            atol=1e-6)
+        with pytest.raises(ValueError):
+            audio.functional.get_window("nope", 8)
+
+
+class TestFeatures:
+    def test_spectrogram_matches_numpy_stft(self):
+        x = _tone()
+        n_fft, hop = 512, 128
+        sp = audio.Spectrogram(n_fft=n_fft, hop_length=hop, center=False,
+                               power=2.0)
+        out = sp(pit.Tensor(x)).numpy()
+        # manual framed stft
+        win = 0.5 - 0.5 * np.cos(2 * math.pi * np.arange(n_fft) / n_fft)
+        n_frames = 1 + (len(x) - n_fft) // hop
+        ref = np.stack([
+            np.abs(np.fft.rfft(x[i * hop:i * hop + n_fft] * win)) ** 2
+            for i in range(n_frames)], axis=1)
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+    def test_spectrogram_peak_at_tone_frequency(self):
+        sr, f = 8000, 440.0
+        sp = audio.Spectrogram(n_fft=1024, hop_length=256)
+        out = sp(pit.Tensor(_tone(sr, 8192, f))).numpy()
+        peak_bin = out.mean(axis=1).argmax()
+        np.testing.assert_allclose(peak_bin * sr / 1024, f, atol=sr / 1024)
+
+    def test_mel_and_log_mel_and_mfcc_shapes(self):
+        x = pit.Tensor(_tone())
+        mel = audio.MelSpectrogram(sr=8000, n_fft=512, n_mels=40)(x)
+        assert mel.shape[0] == 40
+        logmel = audio.LogMelSpectrogram(sr=8000, n_fft=512, n_mels=40)(x)
+        assert tuple(logmel.shape) == tuple(mel.shape)
+        mfcc = audio.MFCC(sr=8000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+        assert mfcc.shape[0] == 13
+        assert np.isfinite(mfcc.numpy()).all()
+
+    def test_batched_input(self):
+        x = np.stack([_tone(), _tone(f=880.0)])
+        out = audio.MelSpectrogram(sr=8000, n_fft=512, n_mels=32)(
+            pit.Tensor(x))
+        assert out.shape[0] == 2 and out.shape[1] == 32
+        # different tones -> different features
+        o = out.numpy()
+        assert np.abs(o[0] - o[1]).max() > 1e-3

@@ -220,3 +220,22 @@ class TestElasticRelaunch:
                        else (lambda d: None), (str(tmp_path),))
         assert stats["restarts"] == 0
         assert all(len(v) == 1 for v in stats["pids"].values())
+
+
+def test_device_memory_stats_api():
+    """Memory observability (reference memory/stats.h Stat singleton):
+    the counters exist, return ints, and the peak watermark is monotone
+    and resettable (zero on backends that don't expose PJRT stats)."""
+    import paddle_infer_tpu as pit
+
+    a = pit.device.memory_allocated()
+    r = pit.device.memory_reserved()
+    assert isinstance(a, int) and isinstance(r, int) and a >= 0 and r >= 0
+    peak1 = pit.device.max_memory_allocated()
+    peak2 = pit.device.max_memory_allocated()
+    assert peak2 >= peak1 >= 0
+    pit.device.reset_max_memory_allocated()
+    assert pit.device.max_memory_allocated() >= 0
+    # cuda-shim parity surface
+    assert pit.device.cuda.memory_allocated() == \
+        pit.device.memory_allocated()
