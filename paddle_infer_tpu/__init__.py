@@ -67,6 +67,7 @@ from . import audio
 from . import distribution
 from . import fft
 from . import sparse
+from . import text
 from .framework import save, load, set_flags, get_flags, flags
 from .framework.io import save_state_dict, load_state_dict
 

@@ -152,24 +152,31 @@ def _params_dedup(arg: Argument):
     """Share storage between byte-identical parameters (tied embeddings /
     lm heads) — the memory_optimize_pass analog for weights
     (analysis/passes/memory_optimize_pass.cc)."""
-    buckets: Dict[tuple, list] = {}
-    out = {}
+    by_meta: Dict[tuple, list] = {}
     for n, v in arg.params.items():
-        # cheap content digest narrows the bucket to near-certain matches
-        # (one scalar fetch per param) before any full-tensor compare —
-        # O(n) instead of O(n^2) device comparisons per shape class
-        digest = float(jnp.sum(jnp.abs(v.astype(jnp.float32)))) \
-            if jnp.issubdtype(v.dtype, jnp.inexact) else float(jnp.sum(v))
-        key = (tuple(v.shape), str(v.dtype), digest)
-        hit = None
-        for cand in buckets.get(key, []):
-            if cand is v or bool(jnp.all(cand == v)):
-                hit = cand
-                break
-        if hit is None:
-            buckets.setdefault(key, []).append(v)
-            hit = v
-        out[n] = hit
+        by_meta.setdefault((tuple(v.shape), str(v.dtype)), []).append(n)
+    out = dict(arg.params)
+    for meta, names in by_meta.items():
+        if len(names) < 2:
+            continue            # unique shape/dtype: no syncs at all
+        # one cheap digest per candidate (only within ambiguous buckets),
+        # then a full compare only on digest collisions — O(n) syncs in
+        # the worst case instead of O(n^2) full-tensor compares
+        reps: Dict[float, list] = {}
+        for n in names:
+            v = arg.params[n]
+            digest = float(jnp.sum(jnp.abs(v.astype(jnp.float32)))) \
+                if jnp.issubdtype(v.dtype, jnp.inexact) \
+                else float(jnp.sum(v))
+            hit = None
+            for cand in reps.get(digest, []):
+                if cand is v or bool(jnp.all(cand == v)):
+                    hit = cand
+                    break
+            if hit is None:
+                reps.setdefault(digest, []).append(v)
+                hit = v
+            out[n] = hit
     arg.params = out
 
 

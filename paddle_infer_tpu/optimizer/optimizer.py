@@ -128,10 +128,14 @@ class Optimizer:
     # functional bridge (compile path) -------------------------------------
     def functional_init(self, params: dict) -> dict:
         """params: {name: array} -> state pytree {name: {slot: array}}."""
-        return {n: self._init_state_arr(a) for n, a in params.items()}
+        return {n: self._init_state_arr(a, n) for n, a in params.items()}
 
-    def _init_state_arr(self, arr) -> dict:
+    def _init_state_arr(self, arr, name=None) -> dict:
         p = Tensor(arr)
+        if name is not None:
+            # name-aware rules (LARS exclusion lists, per-param decay)
+            # must see the parameter's identity on the compiled path too
+            p.name = name
         return self._init_state(p)
 
     def functional_update(self, params: dict, grads: dict, state: dict,
@@ -384,6 +388,10 @@ class Lars(Optimizer):
     def _param_weight_decay(self, param) -> float:
         return 0.0 if self._is_excluded(param) else self._lars_wd
 
+    def _named_weight_decay(self, name: str) -> float:
+        return 0.0 if any(pat in name for pat in self._exclude) \
+            else self._lars_wd
+
     def _decay_into_grad(self):
         return False
 
@@ -398,14 +406,18 @@ class Lars(Optimizer):
         denom = g_norm + wd * p_norm + self._eps
         ratio = jnp.where(p_norm > 0.0,
                           self._lars_coeff * p_norm / denom, 1.0)
-        ratio = jnp.where(state["lars_on"] > 0.0, ratio, 1.0)
+        # .get: checkpoints saved before the flag existed resume as
+        # non-excluded (the only safe reading of an unflagged state)
+        lars_on = state.get("lars_on", jnp.float32(1.0))
+        ratio = jnp.where(lars_on > 0.0, ratio, 1.0)
         local_lr = lr.astype(jnp.float32) * ratio
         v = self._momentum * state["velocity"].astype(jnp.float32) \
             + local_lr * (g32 + wd * p32)
         new_p = p32 - v
-        return new_p.astype(param.dtype), {
-            "velocity": v.astype(state["velocity"].dtype),
-            "lars_on": state["lars_on"]}
+        new_state = {"velocity": v.astype(state["velocity"].dtype)}
+        if "lars_on" in state:       # keep the restored structure
+            new_state["lars_on"] = state["lars_on"]
+        return new_p.astype(param.dtype), new_state
 
 
 class Adadelta(Optimizer):

@@ -1,0 +1,165 @@
+"""paddle.text parity: sequence decoding + text datasets.
+
+Reference: python/paddle/text/ — ``viterbi_decode`` / ``ViterbiDecoder``
+(text/viterbi_decode.py → phi viterbi_decode kernel) and the dataset
+wrappers (datasets/imdb.py, uci_housing.py ...).
+
+TPU-first: Viterbi is one ``lax.scan`` forward over time carrying the
+per-tag best scores + backpointers, then a reverse scan for the path —
+the whole decode compiles to two XLA loops, batched, no host python per
+step.  Datasets are seeded-synthetic stand-ins with the reference
+shapes/label semantics (archive parsing is out of scope — passing
+``data_file`` raises rather than silently training on noise).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import register_op
+from ..core.tensor import Tensor
+from ..io.dataset import Dataset
+from ..nn.layer import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "UCIHousing"]
+
+
+@register_op("viterbi_decode", save_inputs=False)
+def _viterbi_decode(potentials, transitions, lengths,
+                    include_bos_eos_tag=True):
+    """potentials [b, s, n]; transitions [n, n]; lengths [b] int.
+    Returns (scores [b], paths [b, s]) — reference
+    phi/kernels/cpu/viterbi_decode_kernel.cc semantics: with
+    include_bos_eos_tag, tag n-2 is BOS (start boost) and n-1 EOS
+    (stop boost)."""
+    b, s, n = potentials.shape
+    pot = potentials.astype(jnp.float32)
+    trans = transitions.astype(jnp.float32)
+    lengths = lengths.astype(jnp.int32)
+
+    init = pot[:, 0]
+    if include_bos_eos_tag:
+        init = init + trans[n - 2][None, :]
+
+    def step(carry, inp):
+        alpha = carry                            # [b, n]
+        t, emit = inp                            # emit [b, n]
+        # scores[b, i, j] = alpha[b, i] + trans[i, j] + emit[b, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)   # [b, n]
+        best_score = jnp.max(scores, axis=1) + emit
+        live = (t < lengths)[:, None]
+        alpha_new = jnp.where(live, best_score, alpha)
+        return alpha_new, best_prev
+
+    emits = jnp.swapaxes(pot[:, 1:], 0, 1)       # [s-1, b, n]
+    alpha, backptrs = jax.lax.scan(
+        step, init, (jnp.arange(1, s), emits))
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, n - 1][None, :]
+
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)   # [b]
+
+    # backtrack: walk backpointers from each row's last valid step
+    def back(carry, inp):
+        tag = carry                              # [b]
+        t, bp = inp                              # bp [b, n] for step t
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        # positions at or past the row's length keep the tag unchanged
+        live = t < lengths
+        new_tag = jnp.where(live, prev.astype(jnp.int32), tag)
+        return new_tag, new_tag
+
+    rev_t = jnp.arange(s - 1, 0, -1)
+    _, path_rev = jax.lax.scan(
+        back, last_tag, (rev_t, backptrs[::-1]))
+    paths = jnp.concatenate(
+        [path_rev[::-1].T, last_tag[:, None]], axis=1)        # [b, s]
+    # entries past each row's length are padded with the row's final tag;
+    # mask to 0 like the reference's length-cropped output
+    col = jnp.arange(s)[None, :]
+    paths = jnp.where(col < lengths[:, None], paths, 0)
+    return scores, paths
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    from ..core.dispatch import dispatch as D
+
+    return D("viterbi_decode", potentials, transition_params, lengths,
+             include_bos_eos_tag=bool(include_bos_eos_tag))
+
+
+class ViterbiDecoder(Layer):
+    """reference text/viterbi_decode.py ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(transitions, jnp.float32))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+# ------------------------------------------------------------- datasets
+
+class Imdb(Dataset):
+    """Sentiment dataset (reference datasets/imdb.py): seeded synthetic
+    token sequences whose label correlates with a vocabulary split, so
+    models can genuinely fit it in tests.  Archive parsing is not
+    implemented — ``data_file`` raises instead of silently substituting
+    noise."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 vocab_size=2048, seq_len=128, synthetic_size=2048):
+        if data_file is not None:
+            raise NotImplementedError(
+                "Imdb archive loading is not supported; omit data_file "
+                "for the synthetic dataset")
+        self.mode = mode
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = synthetic_size if mode == "train" else synthetic_size // 4
+        self.labels = rng.randint(0, 2, n).astype(np.int64)
+        half = vocab_size // 2
+        docs = []
+        for y in self.labels:
+            lo, hi = (2, half) if y == 0 else (half, vocab_size)
+            docs.append(rng.randint(lo, hi, seq_len).astype(np.int64))
+        self.docs = np.stack(docs)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+
+class UCIHousing(Dataset):
+    """Boston-housing style regression set (reference
+    datasets/uci_housing.py): 13 features -> 1 target, synthetic linear
+    ground truth + noise (``data_file`` raises, see module docstring)."""
+
+    FEATURES = 13
+
+    def __init__(self, data_file=None, mode="train", synthetic_size=512):
+        if data_file is not None:
+            raise NotImplementedError(
+                "UCIHousing file loading is not supported; omit "
+                "data_file for the synthetic dataset")
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = synthetic_size if mode == "train" else synthetic_size // 4
+        self.x = rng.randn(n, self.FEATURES).astype(np.float32)
+        w = np.linspace(-1.0, 1.0, self.FEATURES).astype(np.float32)
+        self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(
+            np.float32)[:, None]
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]

@@ -1,0 +1,93 @@
+"""paddle.text parity: viterbi decode vs a numpy dynamic program, plus
+the dataset wrappers (reference python/paddle/text/)."""
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import text
+
+
+def _np_viterbi(pot, trans, length, bos_eos=True):
+    s, n = pot.shape
+    alpha = pot[0] + (trans[n - 2] if bos_eos else 0)
+    ptr = []
+    for t in range(1, length):
+        scores = alpha[:, None] + trans
+        ptr.append(scores.argmax(0))
+        alpha = scores.max(0) + pot[t]
+    if bos_eos:
+        alpha = alpha + trans[:, n - 1]
+    best = int(alpha.argmax())
+    path = [best]
+    for bp in reversed(ptr):
+        path.append(int(bp[path[-1]]))
+    return float(alpha.max()), list(reversed(path))
+
+
+@pytest.mark.parametrize("bos_eos", [True, False])
+def test_viterbi_matches_numpy(bos_eos):
+    rng = np.random.RandomState(0)
+    b, s, n = 3, 7, 5
+    pot = rng.randn(b, s, n).astype(np.float32)
+    trans = rng.randn(n, n).astype(np.float32)
+    lengths = np.array([7, 7, 7], np.int32)
+    scores, paths = text.viterbi_decode(
+        pit.Tensor(pot), pit.Tensor(trans), pit.Tensor(lengths),
+        include_bos_eos_tag=bos_eos)
+    for i in range(b):
+        ref_s, ref_p = _np_viterbi(pot[i], trans, 7, bos_eos)
+        np.testing.assert_allclose(float(scores.numpy()[i]), ref_s,
+                                   rtol=1e-5)
+        assert paths.numpy()[i].tolist() == ref_p, i
+
+
+def test_viterbi_variable_lengths():
+    rng = np.random.RandomState(1)
+    b, s, n = 2, 6, 4
+    pot = rng.randn(b, s, n).astype(np.float32)
+    trans = rng.randn(n, n).astype(np.float32)
+    lengths = np.array([6, 3], np.int32)
+    scores, paths = text.viterbi_decode(
+        pit.Tensor(pot), pit.Tensor(trans), pit.Tensor(lengths),
+        include_bos_eos_tag=False)
+    ref_s, ref_p = _np_viterbi(pot[1], trans, 3, False)
+    np.testing.assert_allclose(float(scores.numpy()[1]), ref_s, rtol=1e-5)
+    assert paths.numpy()[1, :3].tolist() == ref_p
+    assert (paths.numpy()[1, 3:] == 0).all()     # pad positions zeroed
+
+
+def test_viterbi_decoder_layer():
+    rng = np.random.RandomState(2)
+    trans = rng.randn(4, 4).astype(np.float32)
+    dec = text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+    pot = rng.randn(1, 5, 4).astype(np.float32)
+    scores, paths = dec(pit.Tensor(pot),
+                        pit.Tensor(np.array([5], np.int32)))
+    assert tuple(paths.shape) == (1, 5)
+    assert np.isfinite(scores.numpy()).all()
+
+
+def test_datasets_trainable():
+    from paddle_infer_tpu import nn
+    from paddle_infer_tpu.io import DataLoader
+
+    ds = text.UCIHousing(mode="train", synthetic_size=256)
+    assert len(ds) == 256
+    model = nn.Linear(text.UCIHousing.FEATURES, 1)
+    opt = pit.optimizer.Adam(learning_rate=0.05,
+                             parameters=model.parameters())
+    first = last = None
+    for _ in range(10):
+        for x, y in DataLoader(ds, batch_size=64):
+            loss = ((model(x) - y) ** 2.0).mean()
+            if first is None:
+                first = float(loss.numpy())
+            last = float(loss.numpy())
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+    assert last < first * 0.5
+
+    imdb = text.Imdb(mode="test", synthetic_size=64)
+    doc, label = imdb[0]
+    assert doc.ndim == 1 and label in (0, 1)
