@@ -180,6 +180,12 @@ _EXPORTS = [
     "isnan", "isinf", "isfinite", "norm", "cross", "scale", "unstack",
     "masked_fill", "repeat_interleave", "kron", "outer", "inverse", "det",
     "solve", "mod", "floor_divide", "lerp", "nan_to_num", "addmm",
+    # round-3 breadth batch
+    "trace", "diff", "nanmean", "nansum", "nanmedian", "logcumsumexp",
+    "frac", "heaviside", "rad2deg", "deg2rad", "gcd", "lcm", "rot90",
+    "searchsorted", "bucketize", "index_add", "diag_embed", "tensordot",
+    "inner", "vander", "cov", "corrcoef", "cholesky_solve", "multi_dot",
+    "renorm",
 ]
 
 globals().update({name: _fn(name) for name in _EXPORTS})

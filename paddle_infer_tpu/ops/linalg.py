@@ -170,3 +170,27 @@ defop("histogram", vjp=False)(
     lambda x, bins=100, min=0, max=0:
     jnp.histogram(x, bins=bins, range=None if min == 0 and max == 0 else (min, max))[0])
 defop("mv")(lambda x, vec: jnp.matmul(x, vec))
+
+
+# ---- breadth batch (reference python/paddle/tensor/linalg.py)
+
+defop("tensordot")(lambda x, y, axes=2: jnp.tensordot(x, y, axes=axes))
+defop("inner")(lambda x, y: jnp.inner(x, y))
+defop("vander")(lambda x, n=None, increasing=False:
+                jnp.vander(x, N=n, increasing=increasing))
+defop("cov")(lambda x, rowvar=True, ddof=True:
+             jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0))
+defop("corrcoef")(lambda x, rowvar=True: jnp.corrcoef(x, rowvar=rowvar))
+defop("cholesky_solve")(
+    lambda x, y, upper=False:
+    jax.scipy.linalg.cho_solve((y, not upper), x))
+defop("multi_dot")(lambda *mats: jnp.linalg.multi_dot(mats))
+defop("renorm")(lambda x, p, axis, max_norm: _renorm(x, p, axis, max_norm))
+
+
+def _renorm(x, p, axis, max_norm):
+    # scale each slice along `axis` whose p-norm exceeds max_norm down to it
+    axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * scale

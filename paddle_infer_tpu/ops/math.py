@@ -382,3 +382,66 @@ def _rms_norm_op(x, weight=None, epsilon=1e-6):
 
 
 register_vjp_grad("rms_norm")
+
+
+# ---- breadth batch (reference python/paddle/tensor/math.py + linalg.py):
+# long-tail ops lowered straight to XLA with auto-vjp backward rules
+
+defop("trace")(lambda x, offset=0, axis1=0, axis2=1:
+               jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2))
+defop("diff")(lambda x, n=1, axis=-1: jnp.diff(x, n=n, axis=axis))
+defop("nanmean")(lambda x, axis=None, keepdim=False:
+                 jnp.nanmean(x, axis=axis, keepdims=keepdim))
+defop("nansum")(lambda x, axis=None, keepdim=False:
+                jnp.nansum(x, axis=axis, keepdims=keepdim))
+defop("nanmedian")(lambda x, axis=None, keepdim=False:
+                   jnp.nanmedian(x, axis=axis, keepdims=keepdim))
+def _logcumsumexp(x, axis=None):
+    # paddle default: flattened scan (matches cumsum above)
+    if axis is None:
+        return jax.lax.cumlogsumexp(x.reshape(-1), axis=0)
+    return jax.lax.cumlogsumexp(x, axis=axis % x.ndim)
+
+
+defop("logcumsumexp")(_logcumsumexp)
+defop("frac")(lambda x: x - jnp.trunc(x))
+defop("heaviside")(lambda x, y: jnp.heaviside(x, y))
+defop("rad2deg")(lambda x: jnp.rad2deg(x))
+defop("deg2rad")(lambda x: jnp.deg2rad(x))
+defop("gcd", vjp=False)(lambda x, y: jnp.gcd(x, y))
+defop("lcm", vjp=False)(lambda x, y: jnp.lcm(x, y))
+defop("rot90")(lambda x, k=1, axes=(0, 1): jnp.rot90(x, k=k, axes=axes))
+defop("searchsorted", vjp=False)(
+    lambda sorted_sequence, values, right=False:
+    jnp.searchsorted(sorted_sequence, values,
+                     side="right" if right else "left"))
+defop("bucketize", vjp=False)(
+    lambda x, sorted_sequence, right=False:
+    jnp.searchsorted(sorted_sequence, x,
+                     side="right" if right else "left"))
+defop("index_add")(lambda x, index, value, axis=0:
+                   x.at[(slice(None),) * (axis % x.ndim) + (index,)]
+                   .add(value))
+defop("diag_embed")(lambda x, offset=0, dim1=-2, dim2=-1:
+                    jnp.vectorize(jnp.diag, signature="(n)->(n,n)")(x)
+                    if offset == 0 and dim1 == -2 and dim2 == -1 else
+                    _diag_embed_general(x, offset, dim1, dim2))
+
+
+def _diag_embed_general(x, offset, dim1, dim2):
+    base = jnp.vectorize(lambda v: jnp.diag(v, k=offset),
+                         signature="(n)->(m,m)")(x)
+    nd = base.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) == (nd - 2, nd - 1):
+        return base
+    # build the output->source map: the two diagonal axes go to d1/d2
+    # (order-sensitive: d2 may precede d1), batch axes fill the rest
+    perm = [None] * nd
+    perm[d1] = nd - 2
+    perm[d2] = nd - 1
+    batch = iter(range(nd - 2))
+    for i in range(nd):
+        if perm[i] is None:
+            perm[i] = next(batch)
+    return base.transpose(perm)

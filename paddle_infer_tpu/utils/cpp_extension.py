@@ -138,7 +138,8 @@ def load(name: str, sources: Sequence[str], ops: Sequence[str],
                 jax.ShapeDtypeStruct(x.shape, jnp.float32),
                 x.astype(jnp.float32), vmap_method="sequential")
 
-        register_op(f"custom_{op_name}", jit=False)(impl)
+        op_key = f"custom_{name}_{op_name}"
+        register_op(op_key, jit=False)(impl)
 
         grad_sym = op_name + grad_suffix
         if hasattr(lib, grad_sym):
@@ -157,12 +158,12 @@ def load(name: str, sources: Sequence[str], ops: Sequence[str],
                     vmap_method="sequential")
                 return (Tensor(gin.astype(x._data.dtype)),)
 
-            register_grad(f"custom_{op_name}")(grad_rule)
+            register_grad(op_key)(grad_rule)
 
-        def api(x, _n=op_name):
+        def api(x, _k=op_key):
             from ..core.dispatch import dispatch
 
-            return dispatch(f"custom_{_n}", x)
+            return dispatch(_k, x)
 
         setattr(ns, op_name, api)
     return ns

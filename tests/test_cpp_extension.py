@@ -67,7 +67,7 @@ def test_works_under_jit(ext):
 
     @jax.jit
     def f(a):
-        return raw("custom_cube_op", a) + 1.0
+        return raw("custom_cube_ext_cube_op", a) + 1.0
 
     x = jnp.asarray([2.0, 3.0], jnp.float32)
     np.testing.assert_allclose(np.asarray(f(x)), [9.0, 28.0], rtol=1e-6)
@@ -94,3 +94,40 @@ def test_build_error_surfaces(tmp_path):
     with pytest.raises(RuntimeError, match="build failed"):
         load("bad_ext", [str(bad)], ops=["x"],
              build_directory=str(tmp_path))
+
+
+def test_two_extensions_same_symbol_do_not_collide(tmp_path):
+    """Regression (r3 review): the registry key includes the extension
+    name, so a same-named symbol in another extension neither hijacks
+    dispatch nor inherits the first extension's gradient."""
+    import textwrap as tw
+
+    from paddle_infer_tpu.utils.cpp_extension import load
+
+    a = tmp_path / "a.cc"
+    a.write_text(tw.dedent("""
+        #include <cstdint>
+        extern "C" void op(const float* in, float* out,
+                           const int64_t* shape, int ndim) {
+          int64_t n = 1;
+          for (int i = 0; i < ndim; ++i) n *= shape[i];
+          for (int64_t i = 0; i < n; ++i) out[i] = in[i] * 2.0f;
+        }
+    """))
+    b = tmp_path / "b.cc"
+    b.write_text(tw.dedent("""
+        #include <cstdint>
+        extern "C" void op(const float* in, float* out,
+                           const int64_t* shape, int ndim) {
+          int64_t n = 1;
+          for (int i = 0; i < ndim; ++i) n *= shape[i];
+          for (int64_t i = 0; i < n; ++i) out[i] = in[i] * 10.0f;
+        }
+    """))
+    ext_a = load("ext_a", [str(a)], ops=["op"],
+                 build_directory=str(tmp_path))
+    ext_b = load("ext_b", [str(b)], ops=["op"],
+                 build_directory=str(tmp_path))
+    x = pit.Tensor(np.array([3.0], np.float32))
+    assert float(ext_a.op(x).numpy()[0]) == 6.0
+    assert float(ext_b.op(x).numpy()[0]) == 30.0

@@ -341,3 +341,105 @@ def _np_conv2d(x, w, stride, padding):
                        j * stride:j * stride + kw]
             out[:, :, i, j] = np.einsum("ncij,ocij->no", patch, w)
     return out
+
+
+class TestBreadthOps:
+    """Round-3 long-tail op batch vs numpy (reference tensor/math.py,
+    linalg.py surfaces)."""
+
+    def _t(self, a):
+        return pit.Tensor(np.asarray(a, np.float32))
+
+    def test_math_batch(self):
+        from paddle_infer_tpu.core.dispatch import dispatch as D
+
+        m = np.arange(9, dtype=np.float32).reshape(3, 3)
+        t = self._t(m)
+        assert float(D("trace", t).numpy()) == np.trace(m)
+        np.testing.assert_allclose(D("diff", t).numpy(),
+                                   np.diff(m), rtol=1e-6)
+        x = np.array([1.0, np.nan, 3.0], np.float32)
+        assert float(D("nanmean", self._t(x)).numpy()) == 2.0
+        assert float(D("nansum", self._t(x)).numpy()) == 4.0
+        np.testing.assert_allclose(
+            D("frac", self._t([1.5, -2.25])).numpy(), [0.5, -0.25])
+        np.testing.assert_allclose(
+            D("rad2deg", self._t([np.pi])).numpy(), [180.0], rtol=1e-5)
+        np.testing.assert_allclose(
+            D("heaviside", self._t([-1.0, 0.0, 2.0]),
+              self._t([0.5, 0.5, 0.5])).numpy(), [0.0, 0.5, 1.0])
+        np.testing.assert_allclose(
+            D("logcumsumexp", self._t([0.0, 0.0])).numpy(),
+            np.log(np.cumsum(np.exp([0.0, 0.0]))), rtol=1e-6)
+        assert D("gcd", pit.Tensor(np.array([12])),
+                 pit.Tensor(np.array([18]))).numpy()[0] == 6
+        np.testing.assert_allclose(
+            D("rot90", t).numpy(), np.rot90(m))
+
+    def test_search_and_scatter(self):
+        from paddle_infer_tpu.core.dispatch import dispatch as D
+
+        seq = self._t([1.0, 3.0, 5.0])
+        np.testing.assert_array_equal(
+            D("searchsorted", seq, self._t([2.0, 5.0])).numpy(), [1, 2])
+        np.testing.assert_array_equal(
+            D("bucketize", self._t([2.0, 5.0]), seq, right=True).numpy(),
+            [1, 3])
+        out = D("index_add", self._t(np.zeros((3, 2))),
+                pit.Tensor(np.array([0, 2])),
+                self._t(np.ones((2, 2))), axis=0)
+        np.testing.assert_array_equal(out.numpy(),
+                                      [[1, 1], [0, 0], [1, 1]])
+
+    def test_linalg_batch(self):
+        from paddle_infer_tpu.core.dispatch import dispatch as D
+
+        m = np.arange(9, dtype=np.float32).reshape(3, 3)
+        t = self._t(m)
+        assert float(D("tensordot", t, t).numpy()) == np.tensordot(m, m)
+        np.testing.assert_allclose(
+            D("multi_dot", t, t, t).numpy(),
+            np.linalg.multi_dot([m, m, m]), rtol=1e-5)
+        v = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            D("vander", self._t(v)).numpy(), np.vander(v), rtol=1e-6)
+        data = np.random.RandomState(0).randn(3, 10).astype(np.float32)
+        np.testing.assert_allclose(D("cov", self._t(data)).numpy(),
+                                   np.cov(data), rtol=1e-4)
+        np.testing.assert_allclose(D("corrcoef", self._t(data)).numpy(),
+                                   np.corrcoef(data), rtol=1e-4)
+        # renorm caps each axis-0 slice's 2-norm at 1
+        r = D("renorm", t, p=2.0, axis=0, max_norm=1.0).numpy()
+        norms = np.linalg.norm(r, axis=1)
+        assert (norms <= 1.0 + 1e-5).all()
+        # cholesky_solve round trip: A x = b with A = L L^T
+        a = np.array([[4.0, 2.0], [2.0, 3.0]], np.float32)
+        el = np.linalg.cholesky(a)
+        b = np.array([[1.0], [2.0]], np.float32)
+        x = D("cholesky_solve", self._t(b), self._t(el)).numpy()
+        np.testing.assert_allclose(a @ x, b, atol=1e-5)
+
+    def test_diag_embed_grad(self):
+        from paddle_infer_tpu.core.dispatch import dispatch as D
+
+        v = self._t([1.0, 2.0, 3.0])
+        v.stop_gradient = False
+        out = D("diag_embed", v)
+        np.testing.assert_allclose(out.numpy(), np.diag([1.0, 2.0, 3.0]))
+        out.sum().backward()
+        np.testing.assert_allclose(v.grad.numpy(), [1.0, 1.0, 1.0])
+
+    def test_diag_embed_permuted_dims(self):
+        """Regression (r3 review): dim2 < dim1 placements must match the
+        torch/paddle axis convention, not land the batch axis on a
+        diagonal position."""
+        from paddle_infer_tpu.core.dispatch import dispatch as D
+
+        x = self._t(np.arange(6).reshape(2, 3))
+        out = D("diag_embed", x, offset=0, dim1=1, dim2=0)
+        assert tuple(out.shape) == (3, 3, 2)
+        ref = np.zeros((3, 3, 2), np.float32)
+        for b in range(2):
+            for i in range(3):
+                ref[i, i, b] = 3 * b + i        # x[b, i]
+        np.testing.assert_allclose(out.numpy(), ref)
