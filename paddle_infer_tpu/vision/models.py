@@ -5,8 +5,7 @@ conv7-pool-4stages-avgpool-fc topology."""
 from __future__ import annotations
 
 from ..nn.layer import Layer
-from ..nn.layers_common import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D,
-                                Flatten, Linear, MaxPool2D, Sequential)
+from ..nn.layers_common import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout, Flatten, Linear, MaxPool2D, ReLU, ReLU6, Sequential)
 from ..nn import functional as F
 
 
@@ -136,5 +135,153 @@ def resnet152(**kw):
     return _resnet(152, **kw)
 
 
-__all__ = ["ResNet", "BasicBlock", "BottleneckBlock", "resnet18",
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "MobileNetV2",
+           "mobilenet_v2",
+           "ResNet", "BasicBlock", "BottleneckBlock", "resnet18",
            "resnet34", "resnet50", "resnet101", "resnet152"]
+
+
+class VGG(Layer):
+    """reference python/paddle/vision/models/vgg.py (cfg-driven conv
+    stacks + 3-layer classifier head)."""
+
+    CFGS = {
+        11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512,
+             "M"],
+        13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+             512, 512, "M"],
+        16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512,
+             512, "M", 512, 512, 512, "M"],
+        19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512,
+             512, 512, 512, "M", 512, 512, 512, 512, "M"],
+    }
+
+    def __init__(self, depth=16, num_classes=1000, batch_norm=False,
+                 with_pool=True):
+        super().__init__()
+        layers = []
+        in_c = 3
+        for v in self.CFGS[depth]:
+            if v == "M":
+                layers.append(MaxPool2D(kernel_size=2, stride=2))
+            else:
+                layers.append(Conv2D(in_c, v, 3, padding=1))
+                if batch_norm:
+                    layers.append(BatchNorm2D(v))
+                layers.append(ReLU())
+                in_c = v
+        self.features = Sequential(*layers)
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((7, 7))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self._flatten = Flatten()
+            self.classifier = Sequential(
+                Linear(512 * 7 * 7, 4096), ReLU(), Dropout(0.5),
+                Linear(4096, 4096), ReLU(), Dropout(0.5),
+                Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self._flatten(x)
+            x = self.classifier(x)
+        return x
+
+
+def vgg11(batch_norm=False, **kw):
+    return VGG(11, batch_norm=batch_norm, **kw)
+
+
+def vgg13(batch_norm=False, **kw):
+    return VGG(13, batch_norm=batch_norm, **kw)
+
+
+def vgg16(batch_norm=False, **kw):
+    return VGG(16, batch_norm=batch_norm, **kw)
+
+
+def vgg19(batch_norm=False, **kw):
+    return VGG(19, batch_norm=batch_norm, **kw)
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    """reference mobilenetv2.py _make_divisible: round channels to the
+    nearest multiple of 8, never dropping more than 10%."""
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers += [Conv2D(inp, hidden, 1, bias_attr=False),
+                       BatchNorm2D(hidden), ReLU6()]
+        layers += [
+            Conv2D(hidden, hidden, 3, stride=stride, padding=1,
+                   groups=hidden, bias_attr=False),
+            BatchNorm2D(hidden), ReLU6(),
+            Conv2D(hidden, oup, 1, bias_attr=False), BatchNorm2D(oup),
+        ]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    """reference python/paddle/vision/models/mobilenetv2.py (inverted
+    residuals, depthwise convs — the depthwise 3x3 lowers to XLA
+    feature-group convolution)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2),
+               (6, 64, 4, 2), (6, 96, 3, 1), (6, 160, 3, 2),
+               (6, 320, 1, 1)]
+        inp = _make_divisible(32 * scale)
+        features = [Conv2D(3, inp, 3, stride=2, padding=1,
+                           bias_attr=False), BatchNorm2D(inp), ReLU6()]
+        for t, c, n, s in cfg:
+            oup = _make_divisible(c * scale)
+            for i in range(n):
+                features.append(_InvertedResidual(
+                    inp, oup, s if i == 0 else 1, t))
+                inp = oup
+        last = _make_divisible(1280 * max(1.0, scale))
+        features += [Conv2D(inp, last, 1, bias_attr=False),
+                     BatchNorm2D(last), ReLU6()]
+        self.features = Sequential(*features)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self._flatten = Flatten()
+            self.classifier = Sequential(Dropout(0.2),
+                                         Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self._flatten(x)
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v2(scale=1.0, **kw):
+    return MobileNetV2(scale=scale, **kw)
