@@ -16,6 +16,9 @@ from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
                         RowParallelLinear, VocabParallelEmbedding)
 from . import fleet
 from .fleet import DistributedStrategy, FleetTrainStep
+from .meta_optimizers import (DGCTrainStep, LocalSGDTrainStep,
+                              dgc_compress,
+                              distributed_train_step)
 from .sharding import (DygraphShardingOptimizer, GroupShardedOptimizerStage2,
                        GroupShardedStage2, GroupShardedStage3,
                        group_sharded_parallel)
@@ -32,6 +35,8 @@ __all__ = [
     "broadcast", "reduce", "alltoall", "ppermute", "barrier", "new_group",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "fleet", "DistributedStrategy", "FleetTrainStep",
+    "LocalSGDTrainStep", "DGCTrainStep", "dgc_compress",
+    "distributed_train_step",
     "group_sharded_parallel", "get_rng_state_tracker", "RNGStatesTracker",
     "model_parallel_random_seed", "ring_attention", "ulysses_attention",
     "LayerDesc", "PipelineStack",

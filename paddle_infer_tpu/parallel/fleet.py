@@ -63,6 +63,15 @@ class DistributedStrategy:
         self.recompute_configs: Dict[str, Any] = {}
         self.gradient_merge = False
         self.gradient_merge_configs: Dict[str, Any] = {"k_steps": 1}
+        # DP-only meta-optimizers (reference localsgd_optimizer.py /
+        # dgc_optimizer.py) — routed by meta_optimizers.
+        # distributed_train_step; FleetTrainStep refuses them so the flags
+        # can never silently no-op
+        self.localsgd = False
+        self.localsgd_configs: Dict[str, Any] = {"k_steps": 4}
+        self.dgc = False
+        self.dgc_configs: Dict[str, Any] = {
+            "rampup_begin_step": 0, "sparsity": 0.75}
         self.pipeline_configs: Dict[str, Any] = {"accumulate_steps": 1}
         for k, v in kw.items():
             setattr(self, k, v)
@@ -175,6 +184,62 @@ def _tree_shardings(mesh, specs):
         is_leaf=lambda x: isinstance(x, P))
 
 
+def batch_arrays(batch) -> tuple:
+    """Tensor/ndarray batch -> jax arrays (shared by all step flavors)."""
+    return tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
+                 for b in batch)
+
+
+def batch_signature(arrays, static_kwargs) -> tuple:
+    """The compiled-cache key: batch shapes/dtypes + static kwargs."""
+    return tuple((a.shape, str(a.dtype)) for a in arrays) + \
+        tuple(sorted(static_kwargs.items()))
+
+
+def lr_scheduler_tick(optimizer):
+    """Advance the optimizer's LR scheduler by one step if it has one —
+    shared by every compiled train-step flavor."""
+    if hasattr(optimizer._lr, "step"):
+        try:
+            optimizer._lr.step()
+        except TypeError:
+            pass
+
+
+def make_pure_loss(model: Layer, loss_fn: Callable, strategy,
+                   static_kwargs) -> Callable:
+    """``(params, key, batch_arrays) -> f32 scalar`` closure over the eager
+    model — the traced core every compiled train step (FleetTrainStep, the
+    LocalSGD/DGC meta-optimizer steps) shares.  Applies the strategy's AMP
+    autocast state and recompute wrapping."""
+
+    def pure(params, key, batch):
+        with prandom.trace_key_scope(key):
+            prev_amp = None
+            if strategy.amp:
+                from ..core.dtype import convert_dtype
+
+                prev_amp = dispatch_mod.set_amp_state(
+                    True, convert_dtype(
+                        strategy.amp_configs.get("dtype", "bfloat16")),
+                    strategy.amp_configs.get("level", "O1"))
+            try:
+                tensors = [Tensor(b) for b in batch]
+                loss = loss_fn(model.functional_caller(params), *tensors,
+                               **static_kwargs)
+            finally:
+                if prev_amp is not None:
+                    dispatch_mod.set_amp_state(
+                        prev_amp["enabled"], prev_amp["dtype"],
+                        prev_amp["level"])
+            arr = loss._data if isinstance(loss, Tensor) else loss
+            return arr.astype(jnp.float32)
+
+    if strategy.recompute:
+        pure = jax.checkpoint(pure, static_argnums=())
+    return pure
+
+
 class FleetTrainStep:
     """One compiled SPMD program for the whole training step.
 
@@ -193,6 +258,12 @@ class FleetTrainStep:
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.strategy = strategy or _state.strategy or DistributedStrategy()
+        if getattr(self.strategy, "localsgd", False) \
+                or getattr(self.strategy, "dgc", False):
+            raise ValueError(
+                "strategy.localsgd/dgc need their own step schedule — "
+                "use parallel.distributed_train_step(...) (routes to "
+                "LocalSGDTrainStep / DGCTrainStep)")
         self.hcg = hcg or _state.hcg
         if self.hcg is None:
             raise RuntimeError("fleet.init(...) must run before FleetTrainStep")
@@ -271,34 +342,8 @@ class FleetTrainStep:
 
     # ------------------------------------------------------------- building
     def _pure_loss(self, static_kwargs):
-        model, loss_fn = self.model, self.loss_fn
-        strategy = self.strategy
-
-        def pure(params, key, batch):
-            with prandom.trace_key_scope(key):
-                prev_amp = None
-                if strategy.amp:
-                    from ..core.dtype import convert_dtype
-
-                    prev_amp = dispatch_mod.set_amp_state(
-                        True, convert_dtype(
-                            strategy.amp_configs.get("dtype", "bfloat16")),
-                        strategy.amp_configs.get("level", "O1"))
-                try:
-                    tensors = [Tensor(b) for b in batch]
-                    loss = loss_fn(model.functional_caller(params), *tensors,
-                                   **static_kwargs)
-                finally:
-                    if prev_amp is not None:
-                        dispatch_mod.set_amp_state(
-                            prev_amp["enabled"], prev_amp["dtype"],
-                            prev_amp["level"])
-                arr = loss._data if isinstance(loss, Tensor) else loss
-                return arr.astype(jnp.float32)
-
-        if strategy.recompute:
-            pure = jax.checkpoint(pure, static_argnums=())
-        return pure
+        return make_pure_loss(self.model, self.loss_fn, self.strategy,
+                              static_kwargs)
 
     def _build(self, batch_sig, static_kwargs):
         strategy = self.strategy
@@ -399,10 +444,8 @@ class FleetTrainStep:
         params/opt state on device in their sharded layout."""
         if self.opt_state is None:
             self._init_opt_state()
-        arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
-                       for b in batch)
-        sig = tuple((a.shape, str(a.dtype)) for a in arrays) + \
-            tuple(sorted(static_kwargs.items()))
+        arrays = batch_arrays(batch)
+        sig = batch_signature(arrays, static_kwargs)
         fn = self._cache.get(sig)
         if fn is None:
             fn = self._build(arrays, static_kwargs)
@@ -413,20 +456,14 @@ class FleetTrainStep:
         self.params, self.opt_state, loss = fn(
             self.params, self.opt_state, key, lr,
             jnp.asarray(self._step_count, jnp.int32), arrays)
-        if hasattr(self.optimizer._lr, "step"):
-            try:
-                self.optimizer._lr.step()
-            except TypeError:
-                pass
+        lr_scheduler_tick(self.optimizer)
         return Tensor(loss)
 
     def _compiled_executable(self, batch, static_kwargs):
         """The compiled executable serving this batch signature (must have
         been stepped once; jax caches the lower+compile)."""
-        arrays = tuple(b._data if isinstance(b, Tensor) else jnp.asarray(b)
-                       for b in batch)
-        sig = tuple((a.shape, str(a.dtype)) for a in arrays) + \
-            tuple(sorted(static_kwargs.items()))
+        arrays = batch_arrays(batch)
+        sig = batch_signature(arrays, static_kwargs)
         fn = self._cache.get(sig)
         if fn is None:
             raise RuntimeError("step this batch signature once first")

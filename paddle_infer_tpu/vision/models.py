@@ -5,7 +5,9 @@ conv7-pool-4stages-avgpool-fc topology."""
 from __future__ import annotations
 
 from ..nn.layer import Layer
-from ..nn.layers_common import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout, Flatten, Linear, MaxPool2D, ReLU, ReLU6, Sequential)
+from ..nn.layers_common import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D,
+                                Dropout, Flatten, Linear, MaxPool2D, ReLU,
+                                ReLU6, Sequential)
 from ..nn import functional as F
 
 
