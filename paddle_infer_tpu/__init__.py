@@ -68,6 +68,7 @@ from . import distribution
 from . import fft
 from . import sparse
 from . import text
+from . import geometric
 from .framework import save, load, set_flags, get_flags, flags
 from .framework.io import save_state_dict, load_state_dict
 

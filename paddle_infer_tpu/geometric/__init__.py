@@ -1,0 +1,212 @@
+"""Graph-learning domain (reference: python/paddle/geometric/ —
+``message_passing/send_recv.py`` send_u_recv / send_ue_recv / send_uv,
+``math.py`` segment_sum/mean/max/min, ``sampling/neighbors.py``
+sample_neighbors, ``reindex.py`` reindex_graph; kernels
+paddle/phi/kernels/gpu/graph_send_recv_kernel.cu, segment_pool_kernel.cu).
+
+TPU-first: segment reductions ARE the message-passing primitive on XLA —
+``jax.ops.segment_*`` lowers to sorted-scatter programs the compiler can
+fuse with the gather of source features, so every send_*_recv is one
+gather + one segment reduce with no materialized edge matrix.  Neighbor
+sampling is data-dependent-shape by nature and therefore a HOST-side
+(numpy) utility producing static-shape padded arrays for the device step,
+the same host/device split the multiprocess DataLoader uses.
+
+All segment ops require ``segment_ids`` sorted ascending (the reference's
+segment_pool contract) but send_u_recv-style ops accept arbitrary
+dst_index order (graph_send_recv semantics) — they use unsorted scatter.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "send_uv", "sample_neighbors",
+           "reindex_graph"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _num_segments(ids, out_size):
+    if out_size is not None:
+        return int(out_size)
+    # static shape required under jit: callers inside jit must pass
+    # out_size; eager callers get the max id + 1
+    return int(jnp.max(ids)) + 1 if ids.size else 0
+
+
+def segment_sum(data, segment_ids, out_size: Optional[int] = None):
+    """reference: python/paddle/geometric/math.py segment_sum (kernel
+    segment_pool_kernel SUM)."""
+    d, ids = _arr(data), _arr(segment_ids).astype(jnp.int32)
+    n = _num_segments(ids, out_size)
+    return Tensor(jax.ops.segment_sum(d, ids, num_segments=n))
+
+
+def segment_mean(data, segment_ids, out_size: Optional[int] = None):
+    d, ids = _arr(data), _arr(segment_ids).astype(jnp.int32)
+    n = _num_segments(ids, out_size)
+    tot = jax.ops.segment_sum(d, ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), ids,
+                              num_segments=n)
+    cnt = jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (d.ndim - 1))
+    return Tensor(tot / cnt)
+
+
+def segment_max(data, segment_ids, out_size: Optional[int] = None):
+    """Empty segments yield 0 (reference segment_pool fills with 0)."""
+    d, ids = _arr(data), _arr(segment_ids).astype(jnp.int32)
+    n = _num_segments(ids, out_size)
+    out = jax.ops.segment_max(d, ids, num_segments=n)
+    has = jax.ops.segment_sum(jnp.ones((d.shape[0],), jnp.float32), ids,
+                              num_segments=n) > 0
+    has = has.reshape((-1,) + (1,) * (d.ndim - 1))
+    return Tensor(jnp.where(has, out, jnp.zeros_like(out)))
+
+
+def segment_min(data, segment_ids, out_size: Optional[int] = None):
+    d, ids = _arr(data), _arr(segment_ids).astype(jnp.int32)
+    n = _num_segments(ids, out_size)
+    out = jax.ops.segment_min(d, ids, num_segments=n)
+    has = jax.ops.segment_sum(jnp.ones((d.shape[0],), jnp.float32), ids,
+                              num_segments=n) > 0
+    has = has.reshape((-1,) + (1,) * (d.ndim - 1))
+    return Tensor(jnp.where(has, out, jnp.zeros_like(out)))
+
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,   # composed below
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _reduce_to_dst(msgs, dst, n, reduce_op):
+    if reduce_op == "mean":
+        tot = jax.ops.segment_sum(msgs, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), msgs.dtype),
+                                  dst, num_segments=n)
+        cnt = jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (msgs.ndim - 1))
+        return tot / cnt
+    red = _REDUCERS.get(reduce_op)
+    if red is None:
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    out = red(msgs, dst, num_segments=n)
+    if reduce_op in ("max", "min"):
+        has = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), jnp.float32),
+                                  dst, num_segments=n) > 0
+        has = has.reshape((-1,) + (1,) * (msgs.ndim - 1))
+        out = jnp.where(has, out, jnp.zeros_like(out))
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None):
+    """Gather source-node features along edges, reduce at destinations
+    (reference: geometric/message_passing/send_recv.py send_u_recv,
+    kernel graph_send_recv_kernel.cu).  One XLA gather + one segment
+    scatter-reduce; differentiable end to end."""
+    xa = _arr(x)
+    src = _arr(src_index).astype(jnp.int32)
+    dst = _arr(dst_index).astype(jnp.int32)
+    n = out_size if out_size is not None else xa.shape[0]
+    return Tensor(_reduce_to_dst(xa[src], dst, int(n), reduce_op))
+
+
+def send_ue_recv(x, e, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size: Optional[int] = None):
+    """Combine source features with edge features, then reduce
+    (reference send_ue_recv; message_op add/sub/mul/div)."""
+    xa, ea = _arr(x), _arr(e)
+    src = _arr(src_index).astype(jnp.int32)
+    dst = _arr(dst_index).astype(jnp.int32)
+    gathered = xa[src]
+    if ea.ndim < gathered.ndim:
+        ea = ea.reshape(ea.shape + (1,) * (gathered.ndim - ea.ndim))
+    if message_op == "add":
+        msgs = gathered + ea
+    elif message_op == "sub":
+        msgs = gathered - ea
+    elif message_op == "mul":
+        msgs = gathered * ea
+    elif message_op == "div":
+        msgs = gathered / ea
+    else:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    n = out_size if out_size is not None else xa.shape[0]
+    return Tensor(_reduce_to_dst(msgs, dst, int(n), reduce_op))
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add"):
+    """Per-edge combination of source (x[src]) and destination (y[dst])
+    features (reference send_uv) — returns one row per edge."""
+    xa, ya = _arr(x), _arr(y)
+    src = _arr(src_index).astype(jnp.int32)
+    dst = _arr(dst_index).astype(jnp.int32)
+    a, b = xa[src], ya[dst]
+    if message_op == "add":
+        return Tensor(a + b)
+    if message_op == "sub":
+        return Tensor(a - b)
+    if message_op == "mul":
+        return Tensor(a * b)
+    if message_op == "div":
+        return Tensor(a / b)
+    raise ValueError(f"unsupported message_op {message_op!r}")
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     seed: Optional[int] = None):
+    """Uniform neighbor sampling from a CSC graph (reference:
+    geometric/sampling/neighbors.py sample_neighbors, kernel
+    graph_sample_neighbors_kernel.cu).
+
+    HOST-side by design: the result's shape depends on the data, which
+    XLA cannot trace; the sampler runs in numpy (DataLoader-worker
+    territory) and the device step consumes its static-shape output.
+    Returns (out_neighbors, out_count) as Tensors like the reference."""
+    rown = np.asarray(_arr(row))
+    cp = np.asarray(_arr(colptr))
+    nodes = np.asarray(_arr(input_nodes)).reshape(-1)
+    rng = np.random.RandomState(seed)
+    out, counts = [], []
+    for v in nodes:
+        lo, hi = int(cp[v]), int(cp[v + 1])
+        neigh = rown[lo:hi]
+        if sample_size >= 0 and neigh.size > sample_size:
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out.append(neigh)
+        counts.append(neigh.size)
+    flat = np.concatenate(out) if out else np.zeros((0,), rown.dtype)
+    return Tensor(jnp.asarray(flat)), \
+        Tensor(jnp.asarray(np.asarray(counts, np.int32)))
+
+
+def reindex_graph(x, neighbors, count):
+    """Compact global node ids to a local 0..n-1 space (reference:
+    geometric/reindex.py reindex_graph): x's ids come first, then unseen
+    neighbor ids in first-appearance order.  Host-side (hash-map by
+    nature).  Returns (reindexed_src, reindexed_dst, out_nodes)."""
+    xs = np.asarray(_arr(x)).reshape(-1)
+    nb = np.asarray(_arr(neighbors)).reshape(-1)
+    cnt = np.asarray(_arr(count)).reshape(-1)
+    index = {int(v): i for i, v in enumerate(xs)}
+    for v in nb:
+        if int(v) not in index:
+            index[int(v)] = len(index)
+    out_nodes = np.empty(len(index), xs.dtype)
+    for v, i in index.items():
+        out_nodes[i] = v
+    re_src = np.asarray([index[int(v)] for v in nb], np.int64)
+    re_dst = np.repeat(np.arange(cnt.size, dtype=np.int64), cnt)
+    return Tensor(jnp.asarray(re_src)), Tensor(jnp.asarray(re_dst)), \
+        Tensor(jnp.asarray(out_nodes))

@@ -199,6 +199,23 @@ def chunk(x, chunks, axis=0):
     return D("split", x, num_or_sections=chunks, axis=axis)
 
 
+def concat(x, *more, axis=0):
+    # accepts the list-of-tensors public form AND the raw variadic form;
+    # without this, a list operand silently becomes one stacked 5-D array
+    xs = (tuple(x) if isinstance(x, (list, tuple)) else (x,)) + tuple(more)
+    return D("concat", *xs, axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    # sections are static shape data, not a tensor operand — keep them out
+    # of the traced inputs (a traced sections list can't drive jnp.split)
+    if isinstance(num_or_sections, (list, tuple)):
+        num_or_sections = tuple(int(s) for s in num_or_sections)
+    else:
+        num_or_sections = int(num_or_sections)
+    return D("split", x, num_or_sections=num_or_sections, axis=axis)
+
+
 def mm(x, y):
     return D("matmul", x, y)
 

@@ -7,7 +7,6 @@ Backward comes from the auto-vjp fallback (XLA derives transposed convs).
 """
 from __future__ import annotations
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -257,14 +256,15 @@ def _adaptive_avg_pool2d(x, output_size):
     # split into near-equal windows (exact when divisible — the common case)
     if h % oh == 0 and w % ow == 0:
         return jnp.mean(x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
-    ys = np.linspace(0, h, oh + 1).astype(int)
-    xs = np.linspace(0, w, ow + 1).astype(int)
+    # adaptive windows [floor(i*h/oh), ceil((i+1)*h/oh)) — the reference's
+    # AdaptiveAvgPool formula; never empty, so out_size > in_size is valid
     rows = []
     for i in range(oh):
+        y0, y1 = (i * h) // oh, -(-((i + 1) * h) // oh)
         cols = []
         for j in range(ow):
-            cols.append(jnp.mean(x[:, :, ys[i]:ys[i + 1], xs[j]:xs[j + 1]],
-                                 axis=(2, 3)))
+            x0, x1 = (j * w) // ow, -(-((j + 1) * w) // ow)
+            cols.append(jnp.mean(x[:, :, y0:y1, x0:x1], axis=(2, 3)))
         rows.append(jnp.stack(cols, axis=-1))
     return jnp.stack(rows, axis=-2)
 
@@ -278,7 +278,15 @@ def _adaptive_max_pool2d(x, output_size):
     n, c, h, w = x.shape
     if h % oh == 0 and w % ow == 0:
         return jnp.max(x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
-    raise NotImplementedError("adaptive_max_pool2d requires divisible shapes")
+    rows = []
+    for i in range(oh):
+        y0, y1 = (i * h) // oh, -(-((i + 1) * h) // oh)
+        cols = []
+        for j in range(ow):
+            x0, x1 = (j * w) // ow, -(-((j + 1) * w) // ow)
+            cols.append(jnp.max(x[:, :, y0:y1, x0:x1], axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
 
 
 register_vjp_grad("adaptive_max_pool2d")

@@ -76,7 +76,6 @@ class _MetaStepBase:
         self._step_count = 0
         self._cache = {}
 
-    _sig = staticmethod(batch_signature)
     _batch_arrays = staticmethod(batch_arrays)
 
     def __call__(self, *batch, **static_kwargs):
@@ -202,13 +201,30 @@ def distributed_train_step(model: Layer, loss_fn: Callable, optimizer=None,
         return LocalSGDTrainStep(model, loss_fn, optimizer,
                                  strategy=strategy, hcg=hcg, **kw)
     if getattr(strategy, "dgc", False):
+        from ..optimizer.clip import ClipGradByNorm
+        from ..optimizer.optimizer import SGD, Momentum
+
+        if not isinstance(optimizer, (Momentum, SGD)):
+            # the reference DGCMomentumOptimizer wraps Momentum only;
+            # routing Adam etc. here would silently swap the update rule
+            raise TypeError(
+                "strategy.dgc requires a Momentum or SGD optimizer "
+                f"(got {type(optimizer).__name__}); DGC's update rule IS "
+                "momentum SGD — use FleetTrainStep for adaptive optimizers")
         cfg = dict(strategy.dgc_configs or {})
-        clip = getattr(optimizer._grad_clip, "clip_norm", None) \
-            if optimizer._grad_clip is not None else None
+        clip = None
+        if optimizer._grad_clip is not None:
+            if not isinstance(optimizer._grad_clip, ClipGradByNorm):
+                raise ValueError(
+                    "DGC clips gradients per-tensor (ClipGradByNorm); "
+                    f"{type(optimizer._grad_clip).__name__} cannot be "
+                    "honored on this route")
+            clip = optimizer._grad_clip.clip_norm
+        lr_src = optimizer._lr if callable(optimizer._lr) \
+            else optimizer.get_lr          # live view: set_lr stays honored
         return DGCTrainStep(
-            model, loss_fn,
-            learning_rate=optimizer._lr,   # scheduler or float, kept live
-            momentum=getattr(optimizer, "_momentum", 0.9),
+            model, loss_fn, learning_rate=lr_src,
+            momentum=getattr(optimizer, "_momentum", 0.0),
             sparsity=cfg.get("sparsity"),
             rampup_begin_step=cfg.get("rampup_begin_step"),
             clip_norm=clip,
@@ -317,7 +333,9 @@ class DGCTrainStep(_MetaStepBase):
             rank = jax.lax.axis_index("dp")
             loss, grads = jax.value_and_grad(pure_loss)(
                 params, jax.random.fold_in(key, rank), batch)
-            active = step >= rampup
+            # step is 1-based; "> rampup" gives exactly rampup_begin_step
+            # uncompressed warmup steps like the reference's 0-based ">="
+            active = step > rampup
             new_p, new_u, new_v = {}, {}, {}
             sent, total = [], 0
             for n, g in grads.items():

@@ -9,6 +9,7 @@ from ..nn.layers_common import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D,
                                 Dropout, Flatten, Linear, MaxPool2D, ReLU,
                                 ReLU6, Sequential)
 from ..nn import functional as F
+from ..ops import concat, split
 
 
 class BasicBlock(Layer):
@@ -140,7 +141,10 @@ def resnet152(**kw):
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "MobileNetV2",
            "mobilenet_v2",
            "ResNet", "BasicBlock", "BottleneckBlock", "resnet18",
-           "resnet34", "resnet50", "resnet101", "resnet152"]
+           "resnet34", "resnet50", "resnet101", "resnet152",
+           "AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0",
+           "squeezenet1_1", "MobileNetV1", "mobilenet_v1",
+           "ShuffleNetV2", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0"]
 
 
 class VGG(Layer):
@@ -287,3 +291,254 @@ class MobileNetV2(Layer):
 
 def mobilenet_v2(scale=1.0, **kw):
     return MobileNetV2(scale=scale, **kw)
+
+
+class AlexNet(Layer):
+    """reference: python/paddle/vision/models/alexnet.py — the classic
+    5-conv + 3-fc topology (all convs lower straight onto the MXU as
+    implicit-GEMM XLA convolutions)."""
+
+    def __init__(self, num_classes=1000, dropout=0.5):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(64, 192, 5, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(),
+            MaxPool2D(3, stride=2))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self._pool = AdaptiveAvgPool2D((6, 6))
+            self._flatten = Flatten()
+            self.classifier = Sequential(
+                Dropout(dropout), Linear(256 * 6 * 6, 4096), ReLU(),
+                Dropout(dropout), Linear(4096, 4096), ReLU(),
+                Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(self._flatten(self._pool(x)))
+        return x
+
+
+def alexnet(**kw):
+    return AlexNet(**kw)
+
+
+class _Fire(Layer):
+    """SqueezeNet fire module: 1x1 squeeze, then concat(1x1, 3x3) expand."""
+
+    def __init__(self, inp, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Sequential(Conv2D(inp, squeeze, 1), ReLU())
+        self.expand1 = Sequential(Conv2D(squeeze, e1, 1), ReLU())
+        self.expand3 = Sequential(Conv2D(squeeze, e3, 3, padding=1), ReLU())
+
+    def forward(self, x):
+        x = self.squeeze(x)
+        return concat([self.expand1(x), self.expand3(x)], axis=1)
+
+
+class SqueezeNet(Layer):
+    """reference: python/paddle/vision/models/squeezenet.py (v1.0/v1.1
+    fire-module stacks; classifier is a 1x1 conv + global average)."""
+
+    def __init__(self, version="1.1", num_classes=1000, with_pool=True):
+        super().__init__()
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(),
+                MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                MaxPool2D(3, stride=2), _Fire(512, 64, 256, 256))
+        elif version == "1.1":
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(),
+                MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        else:
+            raise ValueError(f"unknown SqueezeNet version {version!r}")
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(0.5), Conv2D(512, num_classes, 1), ReLU())
+        if with_pool:
+            self._pool = AdaptiveAvgPool2D((1, 1))
+        self._flatten = Flatten()
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self._pool(x)
+        return self._flatten(x)
+
+
+def squeezenet1_0(**kw):
+    return SqueezeNet(version="1.0", **kw)
+
+
+def squeezenet1_1(**kw):
+    return SqueezeNet(version="1.1", **kw)
+
+
+def _conv_bn(inp, oup, k, stride=1, padding=0, groups=1, act=True):
+    layers = [Conv2D(inp, oup, k, stride=stride, padding=padding,
+                     groups=groups, bias_attr=False), BatchNorm2D(oup)]
+    if act:
+        layers.append(ReLU())
+    return Sequential(*layers)
+
+
+class MobileNetV1(Layer):
+    """reference: python/paddle/vision/models/mobilenetv1.py — depthwise-
+    separable stacks (dw 3x3 as feature-group conv + pw 1x1 on the MXU)."""
+
+    _CFG = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+            (1024, 1)]
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        inp = int(32 * scale)
+        blocks = [_conv_bn(3, inp, 3, stride=2, padding=1)]
+        for c, s in self._CFG:
+            oup = int(c * scale)
+            blocks.append(_conv_bn(inp, inp, 3, stride=s, padding=1,
+                                   groups=inp))          # depthwise
+            blocks.append(_conv_bn(inp, oup, 1))          # pointwise
+            inp = oup
+        self.features = Sequential(*blocks)
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self._pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self._flatten = Flatten()
+            self.fc = Linear(inp, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self._pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self._flatten(x))
+        return x
+
+
+def mobilenet_v1(scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+def _channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = x.reshape([b, groups, c // groups, h, w])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([b, c, h, w])
+
+
+class _ShuffleUnit(Layer):
+    """ShuffleNetV2 unit: stride-1 splits channels (half passes through),
+    stride-2 processes both halves; outputs concat + channel shuffle."""
+
+    def __init__(self, inp, oup, stride):
+        super().__init__()
+        self.stride = stride
+        branch = oup // 2
+        if stride == 1:
+            right_in = inp // 2
+        else:
+            right_in = inp
+            self.left = Sequential(
+                Conv2D(inp, inp, 3, stride=2, padding=1, groups=inp,
+                       bias_attr=False), BatchNorm2D(inp),
+                Conv2D(inp, branch, 1, bias_attr=False),
+                BatchNorm2D(branch), ReLU())
+        self.right = Sequential(
+            Conv2D(right_in, branch, 1, bias_attr=False),
+            BatchNorm2D(branch), ReLU(),
+            Conv2D(branch, branch, 3, stride=stride, padding=1,
+                   groups=branch, bias_attr=False), BatchNorm2D(branch),
+            Conv2D(branch, branch, 1, bias_attr=False),
+            BatchNorm2D(branch), ReLU())
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            left, right = split(x, [half, half], axis=1)
+            out = concat([left, self.right(right)], axis=1)
+        else:
+            out = concat([self.left(x), self.right(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    """reference: python/paddle/vision/models/shufflenetv2.py — the
+    channel-split + shuffle topology; the shuffle is two reshapes and a
+    transpose, which XLA folds into the surrounding convs' layouts."""
+
+    _STAGES = {0.25: [24, 24, 48, 96, 512], 0.5: [24, 48, 96, 192, 1024],
+               1.0: [24, 116, 232, 464, 1024],
+               1.5: [24, 176, 352, 704, 1024],
+               2.0: [24, 244, 488, 976, 2048]}
+    _REPEATS = [4, 8, 4]
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        chans = self._STAGES.get(scale)
+        if chans is None:
+            raise ValueError(f"unsupported ShuffleNetV2 scale {scale}")
+        stem = chans[0]
+        self.conv1 = Sequential(
+            Conv2D(3, stem, 3, stride=2, padding=1, bias_attr=False),
+            BatchNorm2D(stem), ReLU())
+        self.pool1 = MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        inp = stem
+        for stage, rep in enumerate(self._REPEATS):
+            oup = chans[stage + 1]
+            for i in range(rep):
+                blocks.append(_ShuffleUnit(inp, oup, 2 if i == 0 else 1))
+                inp = oup
+        self.features = Sequential(*blocks)
+        last = chans[-1]
+        self.conv_last = Sequential(
+            Conv2D(inp, last, 1, bias_attr=False), BatchNorm2D(last),
+            ReLU())
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self._pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self._flatten = Flatten()
+            self.fc = Linear(last, num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.features(self.pool1(self.conv1(x))))
+        if self.with_pool:
+            x = self._pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self._flatten(x))
+        return x
+
+
+def shufflenet_v2_x1_0(**kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x0_5(**kw):
+    return ShuffleNetV2(scale=0.5, **kw)

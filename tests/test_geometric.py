@@ -1,0 +1,136 @@
+"""Geometric (graph) domain tests vs numpy references (reference test
+style: python/paddle/fluid/tests/unittests/test_graph_send_recv_op.py,
+test_segment_ops.py)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import geometric as G
+
+
+def _np_segment(data, ids, n, op):
+    out = np.zeros((n,) + data.shape[1:], data.dtype)
+    if op in ("max", "min"):
+        pass  # handled per segment below
+    for s in range(n):
+        rows = data[ids == s]
+        if rows.size == 0:
+            continue
+        if op == "sum":
+            out[s] = rows.sum(0)
+        elif op == "mean":
+            out[s] = rows.mean(0)
+        elif op == "max":
+            out[s] = rows.max(0)
+        elif op == "min":
+            out[s] = rows.min(0)
+    return out
+
+
+class TestSegmentOps:
+    def setup_method(self, _):
+        rng = np.random.RandomState(0)
+        self.data = rng.randn(12, 4).astype(np.float32)
+        self.ids = np.sort(rng.randint(0, 5, 12)).astype(np.int32)
+
+    @pytest.mark.parametrize("op", ["sum", "mean", "max", "min"])
+    def test_matches_numpy(self, op):
+        fn = getattr(G, f"segment_{op}")
+        got = fn(self.data, self.ids, out_size=5).numpy()
+        ref = _np_segment(self.data, self.ids, 5, op)
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_empty_segment_fills_zero(self):
+        ids = np.asarray([0, 0, 3], np.int32)   # segments 1,2 empty
+        data = np.ones((3, 2), np.float32)
+        got = G.segment_max(data, ids, out_size=4).numpy()
+        assert (got[1] == 0).all() and (got[2] == 0).all()
+        assert (got[0] == 1).all() and (got[3] == 1).all()
+
+    def test_segment_sum_grad(self):
+        def f(d):
+            return jax.ops.segment_sum(d, jnp.asarray(self.ids),
+                                       num_segments=5).sum()
+
+        g = jax.grad(f)(jnp.asarray(self.data))
+        np.testing.assert_allclose(np.asarray(g), np.ones_like(self.data))
+
+
+class TestMessagePassing:
+    def setup_method(self, _):
+        # 4-node graph, edges src->dst
+        self.x = np.arange(8, dtype=np.float32).reshape(4, 2)
+        self.src = np.asarray([0, 1, 2, 0], np.int32)
+        self.dst = np.asarray([1, 2, 1, 0], np.int32)
+
+    def test_send_u_recv_sum(self):
+        got = G.send_u_recv(self.x, self.src, self.dst, "sum").numpy()
+        ref = np.zeros_like(self.x)
+        for s, d in zip(self.src, self.dst):
+            ref[d] += self.x[s]
+        np.testing.assert_allclose(got, ref)
+
+    def test_send_u_recv_mean_unreached_zero(self):
+        got = G.send_u_recv(self.x, self.src, self.dst, "mean").numpy()
+        assert (got[3] == 0).all()    # node 3 receives nothing
+        np.testing.assert_allclose(got[1],
+                                   (self.x[0] + self.x[2]) / 2)
+
+    def test_send_ue_recv(self):
+        e = np.ones((4,), np.float32) * 10
+        got = G.send_ue_recv(self.x, e, self.src, self.dst,
+                             "add", "sum").numpy()
+        ref = np.zeros_like(self.x)
+        for i, (s, d) in enumerate(zip(self.src, self.dst)):
+            ref[d] += self.x[s] + 10
+        np.testing.assert_allclose(got, ref)
+
+    def test_send_uv(self):
+        got = G.send_uv(self.x, self.x, self.src, self.dst, "mul").numpy()
+        ref = self.x[self.src] * self.x[self.dst]
+        np.testing.assert_allclose(got, ref)
+
+    def test_differentiable_through_gather_scatter(self):
+        src, dst = jnp.asarray(self.src), jnp.asarray(self.dst)
+
+        def loss(x):
+            out = G.send_u_recv(pit.to_tensor(x), src, dst, "sum",
+                                out_size=4)
+            return (out._data ** 2).sum()
+
+        g = jax.grad(loss)(jnp.asarray(self.x))
+        assert np.isfinite(np.asarray(g)).all()
+        # node 3 sends nothing -> zero grad row
+        assert (np.asarray(g)[3] == 0).all()
+
+
+class TestSampling:
+    def test_sample_and_reindex(self):
+        # CSC: node v's neighbors = row[colptr[v]:colptr[v+1]]
+        row = np.asarray([1, 2, 3, 0, 2, 0, 1, 3, 9], np.int64)
+        colptr = np.asarray([0, 3, 5, 8, 9], np.int64)
+        nodes = np.asarray([0, 2], np.int64)
+        nb, cnt = G.sample_neighbors(row, colptr, nodes, sample_size=2,
+                                     seed=0)
+        nb, cnt = nb.numpy(), cnt.numpy()
+        assert cnt.tolist() == [2, 2]
+        assert set(nb[:2]).issubset({1, 2, 3})
+        assert set(nb[2:]).issubset({0, 1, 3})
+        re_src, re_dst, out_nodes = G.reindex_graph(nodes, nb, cnt)
+        out_nodes = out_nodes.numpy()
+        # input nodes keep the first slots
+        assert out_nodes[0] == 0 and out_nodes[1] == 2
+        # reindexed edges map back to the sampled neighbor ids
+        np.testing.assert_array_equal(out_nodes[re_src.numpy()], nb)
+        np.testing.assert_array_equal(re_dst.numpy(),
+                                      np.repeat([0, 1], 2))
+
+    def test_full_neighborhood_when_unrestricted(self):
+        row = np.asarray([1, 2, 3, 0], np.int64)
+        colptr = np.asarray([0, 3, 4], np.int64)
+        nb, cnt = G.sample_neighbors(row, colptr,
+                                     np.asarray([0, 1], np.int64))
+        assert cnt.numpy().tolist() == [3, 1]
+        np.testing.assert_array_equal(nb.numpy(), [1, 2, 3, 0])

@@ -200,3 +200,74 @@ class TestVisionZoo:
 
         assert any(getattr(l, "groups", 1) > 1 for l in m.sublayers()
                    if isinstance(l, Conv2D))
+
+
+class TestVisionZooRound3:
+    """AlexNet / SqueezeNet / MobileNetV1 / ShuffleNetV2 (reference
+    python/paddle/vision/models/) — forward shapes + param counts."""
+
+    def _check(self, model, in_hw=64, num_classes=10):
+        import numpy as np
+
+        model.eval()
+        x = pit.to_tensor(np.random.RandomState(0).randn(
+            2, 3, in_hw, in_hw).astype(np.float32))
+        out = model(x)
+        assert list(out.shape) == [2, num_classes]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_alexnet(self):
+        from paddle_infer_tpu.vision.models import alexnet
+
+        self._check(alexnet(num_classes=10), in_hw=127)
+
+    def test_squeezenet(self):
+        from paddle_infer_tpu.vision.models import squeezenet1_1
+
+        self._check(squeezenet1_1(num_classes=10), in_hw=64)
+
+    def test_mobilenet_v1(self):
+        from paddle_infer_tpu.vision.models import mobilenet_v1
+
+        m = mobilenet_v1(scale=0.25, num_classes=10)
+        self._check(m, in_hw=64)
+        # depthwise blocks: 13 dw + 13 pw + stem convs
+        n_convs = sum(1 for _, l in m.named_sublayers()
+                      if l.__class__.__name__ == "Conv2D")
+        assert n_convs == 27
+
+    def test_shufflenet_v2(self):
+        import numpy as np
+        from paddle_infer_tpu.vision.models import (ShuffleNetV2,
+                                                    shufflenet_v2_x0_5)
+
+        m = shufflenet_v2_x0_5(num_classes=10)
+        self._check(m, in_hw=64)
+        # stride-1 unit keeps channel count; shuffle preserves shape
+        from paddle_infer_tpu.vision.models import _channel_shuffle
+
+        x = pit.to_tensor(np.arange(16, dtype=np.float32).reshape(
+            1, 4, 2, 2))
+        y = _channel_shuffle(x, 2)
+        assert list(y.shape) == [1, 4, 2, 2]
+        # groups=2 shuffle interleaves the two halves: [0,2,1,3]
+        np.testing.assert_array_equal(
+            y.numpy()[0, :, 0, 0], x.numpy()[0, [0, 2, 1, 3], 0, 0])
+
+    def test_shufflenet_trains(self):
+        import numpy as np
+        from paddle_infer_tpu.vision.models import shufflenet_v2_x0_5
+
+        m = shufflenet_v2_x0_5(num_classes=4)
+        m.train()
+        opt = pit.optimizer.SGD(learning_rate=0.01,
+                                parameters=m.parameters())
+        x = pit.to_tensor(np.random.RandomState(0).randn(
+            2, 3, 64, 64).astype(np.float32))
+        y = pit.to_tensor(np.asarray([0, 1], np.int64))
+        from paddle_infer_tpu import nn
+
+        loss = nn.functional.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        assert np.isfinite(float(loss.numpy()))
