@@ -178,6 +178,20 @@ def _amp_cast_arrays(name, arrays):
 
 # ------------------------------------------------------------------ dispatch
 
+# Program-IR tracer hook (framework/ir.py ProgramTracer): when set, every
+# dispatch is also recorded as an OpNode — the graph-capture surface that
+# replaces the reference's separate static-graph authoring mode.
+_ACTIVE_TRACER = None
+
+
+def set_tracer(tracer):
+    """Install/remove the IR tracer; returns the previous one."""
+    global _ACTIVE_TRACER
+    prev = _ACTIVE_TRACER
+    _ACTIVE_TRACER = tracer
+    return prev
+
+
 def _shadow(t: Tensor, arr) -> Tensor:
     """View of ``t`` with a different payload but the same tape linkage."""
     s = Tensor(arr, stop_gradient=t.stop_gradient)
@@ -293,6 +307,9 @@ def dispatch(name: str, *inputs, **attrs):
             o._grad_node = node
             o._out_slot = slot
             node.out_tensors.append((weakref.ref(o), slot))
+
+    if _ACTIVE_TRACER is not None:
+        _ACTIVE_TRACER.record(name, tensors, attrs, outs)
 
     if multi:
         return tuple(outs)

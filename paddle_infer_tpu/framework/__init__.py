@@ -2,6 +2,7 @@
 python/paddle/framework/io.py, paddle/fluid/platform/flags.cc)."""
 from .io import save, load, save_state_dict, load_state_dict
 from .flags import set_flags, get_flags, flags
+from . import ir
 
-__all__ = ["save", "load", "save_state_dict", "load_state_dict",
+__all__ = ["ir", "save", "load", "save_state_dict", "load_state_dict",
            "set_flags", "get_flags", "flags"]

@@ -1,0 +1,479 @@
+"""Graph/program IR + pluggable pass framework (layer L2).
+
+Reference: the static-graph representation and its rewriting machinery —
+``ProgramDesc``/``OpDesc``/``VarDesc`` (framework/program_desc.h:32,
+framework.proto), the IR ``Graph`` + ``Pass`` framework (framework/ir/
+graph.h:86, ir/pass.h:69) with ``GraphPatternDetector``
+(ir/graph_pattern_detector.h:287) driving 200+ fusion passes, and the
+executors that consume the result (naive_executor.cc:61 sequential loop;
+new_executor/interpretercore.h:39).
+
+TPU-first redesign.  The reference builds its graph from protobuf op
+descs emitted by a separate static-graph authoring mode; here the eager
+dispatcher IS the authoring surface: a ``ProgramTracer`` observes
+``core.dispatch.dispatch`` and records every op call into a ``Program``
+(ops + typed vars), so any eager/Layer code becomes a graph with zero
+user changes — the dy2static idea applied at the op level.  Passes
+rewrite the op list with pattern matching (DCE, constant folding,
+dropout deletion, matmul+add -> addmm fusion).  Execution is TPU-shaped:
+``Program.run`` is the NaiveExecutor analog (sequential per-op replay,
+debuggable), and ``Program.compile()`` jits the whole replay into ONE
+XLA executable — the InterpreterCore's dependency analysis, stream
+assignment, and GC all become the XLA compiler's problem, which is the
+point of the redesign.
+
+Serialization: ``to_dict``/``from_dict`` are the framework.proto analog
+(JSON-able; const payloads inline, params by name).
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch as dispatch_mod
+from ..core.tensor import Tensor
+
+
+# ------------------------------------------------------------------- program
+
+@dataclass
+class VarDesc:
+    """A typed value slot (reference framework VarDesc)."""
+
+    id: int
+    kind: str                      # "input" | "param" | "const" | "tmp"
+    shape: tuple
+    dtype: str
+    name: Optional[str] = None     # params: the state_dict name
+    const_value: Optional[np.ndarray] = None
+
+
+@dataclass
+class OpNode:
+    """One op invocation (reference OpDesc)."""
+
+    name: str
+    inputs: List[int]              # var ids (None -> -1)
+    outputs: List[int]
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+
+class Program:
+    """Ops + vars + designated feed/fetch (reference ProgramDesc, single
+    block: XLA control flow lives inside ops, not in nested blocks)."""
+
+    def __init__(self):
+        self.vars: Dict[int, VarDesc] = {}
+        self.ops: List[OpNode] = []
+        self.feed_ids: List[int] = []
+        self.fetch_ids: List[int] = []
+        self._next_id = 0
+
+    # ------------------------------------------------------------ building
+    def new_var(self, kind, shape, dtype, name=None, const_value=None):
+        vid = self._next_id
+        self._next_id += 1
+        self.vars[vid] = VarDesc(vid, kind, tuple(shape), str(dtype), name,
+                                 const_value)
+        return vid
+
+    # ------------------------------------------------------------ querying
+    def consumers(self) -> Dict[int, List[int]]:
+        """var id -> indices of ops reading it."""
+        out: Dict[int, List[int]] = {}
+        for i, op in enumerate(self.ops):
+            for vid in op.inputs:
+                if vid >= 0:
+                    out.setdefault(vid, []).append(i)
+        return out
+
+    def producer(self) -> Dict[int, int]:
+        """var id -> index of the op writing it."""
+        out = {}
+        for i, op in enumerate(self.ops):
+            for vid in op.outputs:
+                out[vid] = i
+        return out
+
+    def param_names(self) -> List[str]:
+        return [v.name for v in self.vars.values() if v.kind == "param"]
+
+    def __repr__(self):
+        lines = [f"Program({len(self.ops)} ops, {len(self.vars)} vars)"]
+        for op in self.ops:
+            ins = ",".join(str(i) for i in op.inputs)
+            outs = ",".join(str(i) for i in op.outputs)
+            lines.append(f"  {op.name}({ins}) -> {outs}")
+        return "\n".join(lines)
+
+    # ----------------------------------------------------------- execution
+    def _replay(self, feeds: Sequence, params: Dict[str, Any]):
+        env: Dict[int, Any] = {}
+        for vid, feed in zip(self.feed_ids, feeds):
+            env[vid] = feed._data if isinstance(feed, Tensor) \
+                else jnp.asarray(feed)
+        for vid, var in self.vars.items():
+            if var.kind == "const":
+                env[vid] = jnp.asarray(var.const_value)
+            elif var.kind == "param":
+                if var.name not in params:
+                    raise KeyError(f"missing param {var.name!r}")
+                p = params[var.name]
+                env[vid] = p._data if isinstance(p, Tensor) \
+                    else jnp.asarray(p)
+        for op in self.ops:
+            args = [env[v] if v >= 0 else None for v in op.inputs]
+            out = dispatch_mod.raw(op.name, *args, **op.attrs)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for vid, arr in zip(op.outputs, outs):
+                env[vid] = arr
+        return tuple(env[v] for v in self.fetch_ids)
+
+    def run(self, feeds: Sequence, params: Optional[Dict] = None):
+        """Sequential interpretation (the NaiveExecutor analog) — eager,
+        op-at-a-time, good for debugging passes."""
+        outs = self._replay(feeds, params or {})
+        return tuple(Tensor(o) for o in outs)
+
+    def compile(self) -> Callable:
+        """One jitted XLA executable for the whole program (the
+        InterpreterCore/StandaloneExecutor analog: scheduling, fusion and
+        buffer reuse delegated to the compiler)."""
+
+        def fn(feeds, params):
+            return self._replay(feeds, params)
+
+        return jax.jit(fn)
+
+    # ------------------------------------------------------- serialization
+    def to_dict(self) -> dict:
+        return {
+            "vars": [
+                {"id": v.id, "kind": v.kind, "shape": list(v.shape),
+                 "dtype": v.dtype, "name": v.name,
+                 "const_value": (_const_to_json(v.const_value)
+                                 if v.const_value is not None else None)}
+                for v in self.vars.values()],
+            "ops": [{"name": o.name, "inputs": o.inputs,
+                     "outputs": o.outputs,
+                     "attrs": _jsonable_attrs(o.attrs)}
+                    for o in self.ops],
+            "feed_ids": self.feed_ids,
+            "fetch_ids": self.fetch_ids,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Program":
+        p = cls()
+        for v in d["vars"]:
+            cv = None if v["const_value"] is None else _const_from_json(
+                v["const_value"], v["dtype"])
+            p.vars[v["id"]] = VarDesc(v["id"], v["kind"],
+                                      tuple(v["shape"]), v["dtype"],
+                                      v["name"], cv)
+            p._next_id = max(p._next_id, v["id"] + 1)
+        p.ops = [OpNode(o["name"], list(o["inputs"]), list(o["outputs"]),
+                        _unjson_attrs(o["attrs"])) for o in d["ops"]]
+        p.feed_ids = list(d["feed_ids"])
+        p.fetch_ids = list(d["fetch_ids"])
+        return p
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @classmethod
+    def from_json(cls, s: str) -> "Program":
+        return cls.from_dict(json.loads(s))
+
+
+def _is_prng_key(arr) -> bool:
+    try:
+        return jax.dtypes.issubdtype(arr.dtype, jax.dtypes.prng_key)
+    except Exception:
+        return False
+
+
+def _const_to_json(arr):
+    if _is_prng_key(arr):
+        return {"__prng__":
+                np.asarray(jax.random.key_data(arr)).tolist()}
+    return np.asarray(arr).tolist()
+
+
+def _const_from_json(v, dtype):
+    if isinstance(v, dict) and "__prng__" in v:
+        return jax.random.wrap_key_data(
+            jnp.asarray(v["__prng__"], jnp.uint32))
+    return np.asarray(v, dtype=dtype)
+
+
+def _jsonable_attrs(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, tuple):
+            out[k] = {"__tuple__": list(v)}
+        else:
+            out[k] = v
+    return out
+
+
+def _unjson_attrs(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, dict) and "__tuple__" in v:
+            out[k] = tuple(v["__tuple__"])
+        else:
+            out[k] = v
+    return out
+
+
+# -------------------------------------------------------------------- tracer
+
+class ProgramTracer:
+    """Observes the eager dispatcher and records a Program.
+
+    Input tensors are declared up front; parameters are identified by
+    object identity against ``params``; any other tensor entering from
+    outside the trace becomes a const var (e.g. dropout keys, constants
+    baked by the caller)."""
+
+    def __init__(self, params: Optional[Dict[str, Tensor]] = None):
+        self.program = Program()
+        self._var_of: Dict[int, int] = {}     # id(Tensor) -> var id
+        self._keepalive: List[Tensor] = []    # pin ids against GC reuse
+        self._param_ids = {}
+        for name, p in (params or {}).items():
+            self._param_ids[id(p)] = name
+            self._keepalive.append(p)
+
+    # tracer protocol (called from dispatch)
+    def record(self, name, in_tensors, attrs, out_tensors):
+        op_in = []
+        for t in in_tensors:
+            if t is None:
+                op_in.append(-1)
+                continue
+            vid = self._var_of.get(id(t))
+            if vid is None:
+                if id(t) in self._param_ids:
+                    vid = self.program.new_var(
+                        "param", t.shape, t.dtype,
+                        name=self._param_ids[id(t)])
+                else:
+                    arr = t._data
+                    if not _is_prng_key(arr):   # keys stay jax-typed
+                        arr = np.asarray(arr)
+                    vid = self.program.new_var(
+                        "const", t.shape, t.dtype, const_value=arr)
+                self._var_of[id(t)] = vid
+                self._keepalive.append(t)
+            op_in.append(vid)
+        op_out = []
+        for t in out_tensors:
+            if t is None:
+                op_out.append(-1)
+                continue
+            vid = self.program.new_var("tmp", t.shape, t.dtype)
+            self._var_of[id(t)] = vid
+            self._keepalive.append(t)
+            op_out.append(vid)
+        self.program.ops.append(OpNode(name, op_in, op_out, dict(attrs)))
+
+    def declare_input(self, t: Tensor):
+        vid = self.program.new_var("input", t.shape, t.dtype)
+        self._var_of[id(t)] = vid
+        self._keepalive.append(t)
+        self.program.feed_ids.append(vid)
+        return vid
+
+    def declare_output(self, t: Tensor):
+        vid = self._var_of.get(id(t))
+        if vid is None:
+            raise ValueError("output tensor was not produced by the trace")
+        self.program.fetch_ids.append(vid)
+
+
+def trace_program(fn: Callable, example_inputs: Sequence,
+                  params: Optional[Dict[str, Tensor]] = None) -> Program:
+    """Run ``fn(*example_inputs)`` eagerly with the tracer attached and
+    return the captured Program.  For a Layer, pass
+    ``dict(layer.named_parameters())`` (or use ``trace_layer``)."""
+    tracer = ProgramTracer(params)
+    ins = [x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+           for x in example_inputs]
+    for t in ins:
+        tracer.declare_input(t)
+    prev = dispatch_mod.set_tracer(tracer)
+    try:
+        out = fn(*ins)
+    finally:
+        dispatch_mod.set_tracer(prev)
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    for t in outs:
+        tracer.declare_output(t)
+    return tracer.program
+
+
+def trace_layer(layer, example_inputs: Sequence) -> Program:
+    """Capture a Layer's forward as a Program with named param vars."""
+    return trace_program(lambda *xs: layer(*xs), example_inputs,
+                         params=dict(layer.named_parameters()))
+
+
+# -------------------------------------------------------------------- passes
+
+_PASS_REGISTRY: Dict[str, Callable[[Program], Program]] = {}
+
+
+def register_ir_pass(name: str):
+    """Register a Program->Program rewrite (reference ir/pass.h:69
+    REGISTER_PASS)."""
+
+    def deco(fn):
+        _PASS_REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def ir_pass_names():
+    return sorted(_PASS_REGISTRY)
+
+
+class PassManager:
+    """Ordered pass list (reference paddle_pass_builder's strategies),
+    editable like pass_builder()->DeletePass()."""
+
+    DEFAULT = ["delete_dropout_pass", "constant_fold_pass",
+               "fuse_matmul_add_pass", "dce_pass"]
+
+    def __init__(self, passes: Optional[List[str]] = None):
+        self.passes = list(self.DEFAULT if passes is None else passes)
+
+    def delete_pass(self, name):
+        self.passes = [p for p in self.passes if p != name]
+
+    def append_pass(self, name):
+        self.passes.append(name)
+
+    def run(self, program: Program) -> Program:
+        for name in self.passes:
+            program = _PASS_REGISTRY[name](program)
+        return program
+
+
+def _substitute(program: Program, mapping: Dict[int, int]):
+    """Rewire all op inputs and fetches through ``mapping``."""
+    for op in program.ops:
+        op.inputs = [mapping.get(v, v) for v in op.inputs]
+    program.fetch_ids = [mapping.get(v, v) for v in program.fetch_ids]
+
+
+@register_ir_pass("dce_pass")
+def dce_pass(program: Program) -> Program:
+    """Dead-code elimination: drop ops whose outputs reach no fetch
+    (reference ir graph pruning / memory_optimize groundwork)."""
+    live = set(program.fetch_ids)
+    keep = []
+    for op in reversed(program.ops):
+        if any(v in live for v in op.outputs):
+            keep.append(op)
+            live.update(v for v in op.inputs if v >= 0)
+    program.ops = list(reversed(keep))
+    used = set(program.feed_ids) | set(program.fetch_ids) | {
+        v for op in program.ops for v in op.inputs + op.outputs if v >= 0}
+    program.vars = {k: v for k, v in program.vars.items() if k in used}
+    return program
+
+
+_NONDETERMINISTIC_OPS = {"dropout", "uniform_random", "gaussian_random",
+                         "randint", "bernoulli", "multinomial"}
+
+
+@register_ir_pass("constant_fold_pass")
+def constant_fold_pass(program: Program) -> Program:
+    """Evaluate ops whose inputs are all consts and inline the result
+    (reference constant_folding_pass)."""
+    new_ops = []
+    for op in program.ops:
+        if op.name in _NONDETERMINISTIC_OPS or not op.inputs \
+                or not all(v >= 0 and program.vars[v].kind == "const"
+                           for v in op.inputs):
+            new_ops.append(op)
+            continue
+        args = [jnp.asarray(program.vars[v].const_value)
+                for v in op.inputs]
+        out = dispatch_mod.raw(op.name, *args, **op.attrs)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        for vid, arr in zip(op.outputs, outs):
+            var = program.vars[vid]
+            var.kind = "const"
+            var.const_value = np.asarray(arr)
+    program.ops = new_ops
+    return program
+
+
+@register_ir_pass("delete_dropout_pass")
+def delete_dropout_pass(program: Program) -> Program:
+    """Remove dropout at inference (reference
+    delete_dropout_op_x_pass in the inference pass lists): consumers of
+    the dropout output read its input instead."""
+    mapping = {}
+    kept = []
+    for op in program.ops:
+        if op.name == "dropout":
+            mapping[op.outputs[0]] = op.inputs[0]
+        else:
+            kept.append(op)
+    program.ops = kept
+    # chase chains of dropouts
+    for k in list(mapping):
+        v = mapping[k]
+        while v in mapping:
+            v = mapping[v]
+        mapping[k] = v
+    _substitute(program, mapping)
+    return program
+
+
+@register_ir_pass("fuse_matmul_add_pass")
+def fuse_matmul_add_pass(program: Program) -> Program:
+    """matmul(x, w) + b -> addmm(b, x, w) — the linear-bias fusion the
+    reference does via fc_fuse_pass / GraphPatternDetector; on TPU the
+    value is a smaller graph (XLA fuses the arithmetic either way)."""
+    producer = program.producer()
+    consumers = program.consumers()
+    kept: List[OpNode] = []
+    fused_away = set()
+    for i, op in enumerate(program.ops):
+        if i in fused_away:
+            continue
+        if op.name == "add" and len(op.inputs) == 2:
+            a, b = op.inputs
+            src = producer.get(a)
+            if src is not None and program.ops[src].name == "matmul" \
+                    and not program.ops[src].attrs \
+                    and len(consumers.get(a, [])) == 1 \
+                    and a not in program.fetch_ids \
+                    and src not in fused_away:
+                mm = program.ops[src]
+                kept = [k for k in kept if k is not mm]
+                kept.append(OpNode("addmm", [b] + list(mm.inputs),
+                                   list(op.outputs)))
+                fused_away.add(src)
+                continue
+        kept.append(op)
+    program.ops = kept
+    return program
+
+
+# ------------------------------------------------------------ one-call sugar
+
+def optimize_program(program: Program,
+                     passes: Optional[List[str]] = None) -> Program:
+    return PassManager(passes).run(program)

@@ -7,14 +7,17 @@ paddle/phi/kernels/gpu/graph_send_recv_kernel.cu, segment_pool_kernel.cu).
 TPU-first: segment reductions ARE the message-passing primitive on XLA —
 ``jax.ops.segment_*`` lowers to sorted-scatter programs the compiler can
 fuse with the gather of source features, so every send_*_recv is one
-gather + one segment reduce with no materialized edge matrix.  Neighbor
-sampling is data-dependent-shape by nature and therefore a HOST-side
-(numpy) utility producing static-shape padded arrays for the device step,
-the same host/device split the multiprocess DataLoader uses.
+gather + one segment reduce with no materialized edge matrix.  Everything
+routes through the op dispatcher (registered ops + vjp grads), so the
+eager tape and ``loss.backward()`` work through graph layers exactly like
+any nn layer.  Neighbor sampling is data-dependent-shape by nature and
+therefore a HOST-side (numpy) utility producing static-shape padded
+arrays for the device step, the same host/device split the multiprocess
+DataLoader uses.
 
-All segment ops require ``segment_ids`` sorted ascending (the reference's
-segment_pool contract) but send_u_recv-style ops accept arbitrary
-dst_index order (graph_send_recv semantics) — they use unsorted scatter.
+Segment ops follow the reference's segment_pool contract (sorted ids are
+the common case but not required — unsorted scatter is used); empty
+segments fill with 0 like the reference.
 """
 from __future__ import annotations
 
@@ -24,6 +27,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.dispatch import dispatch as D, register_op, register_vjp_grad
 from ..core.tensor import Tensor
 
 __all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
@@ -35,78 +39,105 @@ def _arr(x):
     return x._data if isinstance(x, Tensor) else jnp.asarray(x)
 
 
+def _reduce(msgs, ids, n, reduce_op):
+    """Shared segment reduction with reference fill semantics: mean
+    divides by a clamped count, max/min zero-fill empty segments."""
+    ids = ids.astype(jnp.int32)
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, ids, num_segments=n)
+    if reduce_op == "mean":
+        tot = jax.ops.segment_sum(msgs, ids, num_segments=n)
+        cnt = jax.ops.segment_sum(
+            jnp.ones((msgs.shape[0],), msgs.dtype), ids, num_segments=n)
+        return tot / jnp.maximum(cnt, 1).reshape(
+            (-1,) + (1,) * (msgs.ndim - 1))
+    red = {"max": jax.ops.segment_max, "min": jax.ops.segment_min}.get(
+        reduce_op)
+    if red is None:
+        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
+    out = red(msgs, ids, num_segments=n)
+    has = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), jnp.float32),
+                              ids, num_segments=n) > 0
+    has = has.reshape((-1,) + (1,) * (msgs.ndim - 1))
+    return jnp.where(has, out, jnp.zeros_like(out))
+
+
+def _combine(a, b, message_op):
+    if message_op == "add":
+        return a + b
+    if message_op == "sub":
+        return a - b
+    if message_op == "mul":
+        return a * b
+    if message_op == "div":
+        return a / b
+    raise ValueError(f"unsupported message_op {message_op!r}")
+
+
+@register_op("graph_segment_pool")
+def _graph_segment_pool(data, segment_ids, *, n, pool_type):
+    return _reduce(data, segment_ids, n, pool_type)
+
+
+register_vjp_grad("graph_segment_pool")
+
+
+@register_op("graph_send_recv")
+def _graph_send_recv(x, src_index, dst_index, *, n, reduce_op):
+    return _reduce(x[src_index.astype(jnp.int32)],
+                   dst_index, n, reduce_op)
+
+
+register_vjp_grad("graph_send_recv")
+
+
+@register_op("graph_send_ue_recv")
+def _graph_send_ue_recv(x, e, src_index, dst_index, *, n, message_op,
+                        reduce_op):
+    gathered = x[src_index.astype(jnp.int32)]
+    if e.ndim < gathered.ndim:
+        e = e.reshape(e.shape + (1,) * (gathered.ndim - e.ndim))
+    return _reduce(_combine(gathered, e, message_op), dst_index, n,
+                   reduce_op)
+
+
+register_vjp_grad("graph_send_ue_recv")
+
+
+@register_op("graph_send_uv")
+def _graph_send_uv(x, y, src_index, dst_index, *, message_op):
+    return _combine(x[src_index.astype(jnp.int32)],
+                    y[dst_index.astype(jnp.int32)], message_op)
+
+
+register_vjp_grad("graph_send_uv")
+
+
 def _num_segments(ids, out_size):
     if out_size is not None:
         return int(out_size)
     # static shape required under jit: callers inside jit must pass
     # out_size; eager callers get the max id + 1
-    return int(jnp.max(ids)) + 1 if ids.size else 0
+    arr = _arr(ids)
+    return int(jnp.max(arr)) + 1 if arr.size else 0
 
 
-def segment_sum(data, segment_ids, out_size: Optional[int] = None):
-    """reference: python/paddle/geometric/math.py segment_sum (kernel
-    segment_pool_kernel SUM)."""
-    d, ids = _arr(data), _arr(segment_ids).astype(jnp.int32)
-    n = _num_segments(ids, out_size)
-    return Tensor(jax.ops.segment_sum(d, ids, num_segments=n))
+def _segment(op):
+    def fn(data, segment_ids, out_size: Optional[int] = None):
+        n = _num_segments(segment_ids, out_size)
+        return D("graph_segment_pool", data, segment_ids, n=n,
+                 pool_type=op)
+
+    fn.__name__ = f"segment_{op}"
+    fn.__doc__ = (f"reference: python/paddle/geometric/math.py "
+                  f"segment_{op} (kernel segment_pool_kernel {op.upper()}).")
+    return fn
 
 
-def segment_mean(data, segment_ids, out_size: Optional[int] = None):
-    d, ids = _arr(data), _arr(segment_ids).astype(jnp.int32)
-    n = _num_segments(ids, out_size)
-    tot = jax.ops.segment_sum(d, ids, num_segments=n)
-    cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), ids,
-                              num_segments=n)
-    cnt = jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (d.ndim - 1))
-    return Tensor(tot / cnt)
-
-
-def segment_max(data, segment_ids, out_size: Optional[int] = None):
-    """Empty segments yield 0 (reference segment_pool fills with 0)."""
-    d, ids = _arr(data), _arr(segment_ids).astype(jnp.int32)
-    n = _num_segments(ids, out_size)
-    out = jax.ops.segment_max(d, ids, num_segments=n)
-    has = jax.ops.segment_sum(jnp.ones((d.shape[0],), jnp.float32), ids,
-                              num_segments=n) > 0
-    has = has.reshape((-1,) + (1,) * (d.ndim - 1))
-    return Tensor(jnp.where(has, out, jnp.zeros_like(out)))
-
-
-def segment_min(data, segment_ids, out_size: Optional[int] = None):
-    d, ids = _arr(data), _arr(segment_ids).astype(jnp.int32)
-    n = _num_segments(ids, out_size)
-    out = jax.ops.segment_min(d, ids, num_segments=n)
-    has = jax.ops.segment_sum(jnp.ones((d.shape[0],), jnp.float32), ids,
-                              num_segments=n) > 0
-    has = has.reshape((-1,) + (1,) * (d.ndim - 1))
-    return Tensor(jnp.where(has, out, jnp.zeros_like(out)))
-
-
-_REDUCERS = {
-    "sum": jax.ops.segment_sum,
-    "mean": None,   # composed below
-    "max": jax.ops.segment_max,
-    "min": jax.ops.segment_min,
-}
-
-
-def _reduce_to_dst(msgs, dst, n, reduce_op):
-    if reduce_op == "mean":
-        tot = jax.ops.segment_sum(msgs, dst, num_segments=n)
-        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), msgs.dtype),
-                                  dst, num_segments=n)
-        cnt = jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (msgs.ndim - 1))
-        return tot / cnt
-    red = _REDUCERS.get(reduce_op)
-    if red is None:
-        raise ValueError(f"unsupported reduce_op {reduce_op!r}")
-    out = red(msgs, dst, num_segments=n)
-    if reduce_op in ("max", "min"):
-        has = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), jnp.float32),
-                                  dst, num_segments=n) > 0
-        has = has.reshape((-1,) + (1,) * (msgs.ndim - 1))
-        out = jnp.where(has, out, jnp.zeros_like(out))
-    return out
+segment_sum = _segment("sum")
+segment_mean = _segment("mean")
+segment_max = _segment("max")
+segment_min = _segment("min")
 
 
 def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
@@ -114,54 +145,26 @@ def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
     """Gather source-node features along edges, reduce at destinations
     (reference: geometric/message_passing/send_recv.py send_u_recv,
     kernel graph_send_recv_kernel.cu).  One XLA gather + one segment
-    scatter-reduce; differentiable end to end."""
-    xa = _arr(x)
-    src = _arr(src_index).astype(jnp.int32)
-    dst = _arr(dst_index).astype(jnp.int32)
-    n = out_size if out_size is not None else xa.shape[0]
-    return Tensor(_reduce_to_dst(xa[src], dst, int(n), reduce_op))
+    scatter-reduce; differentiable through the eager tape."""
+    n = out_size if out_size is not None else _arr(x).shape[0]
+    return D("graph_send_recv", x, src_index, dst_index, n=int(n),
+             reduce_op=reduce_op)
 
 
 def send_ue_recv(x, e, src_index, dst_index, message_op: str = "add",
                  reduce_op: str = "sum", out_size: Optional[int] = None):
     """Combine source features with edge features, then reduce
     (reference send_ue_recv; message_op add/sub/mul/div)."""
-    xa, ea = _arr(x), _arr(e)
-    src = _arr(src_index).astype(jnp.int32)
-    dst = _arr(dst_index).astype(jnp.int32)
-    gathered = xa[src]
-    if ea.ndim < gathered.ndim:
-        ea = ea.reshape(ea.shape + (1,) * (gathered.ndim - ea.ndim))
-    if message_op == "add":
-        msgs = gathered + ea
-    elif message_op == "sub":
-        msgs = gathered - ea
-    elif message_op == "mul":
-        msgs = gathered * ea
-    elif message_op == "div":
-        msgs = gathered / ea
-    else:
-        raise ValueError(f"unsupported message_op {message_op!r}")
-    n = out_size if out_size is not None else xa.shape[0]
-    return Tensor(_reduce_to_dst(msgs, dst, int(n), reduce_op))
+    n = out_size if out_size is not None else _arr(x).shape[0]
+    return D("graph_send_ue_recv", x, e, src_index, dst_index, n=int(n),
+             message_op=message_op, reduce_op=reduce_op)
 
 
 def send_uv(x, y, src_index, dst_index, message_op: str = "add"):
     """Per-edge combination of source (x[src]) and destination (y[dst])
     features (reference send_uv) — returns one row per edge."""
-    xa, ya = _arr(x), _arr(y)
-    src = _arr(src_index).astype(jnp.int32)
-    dst = _arr(dst_index).astype(jnp.int32)
-    a, b = xa[src], ya[dst]
-    if message_op == "add":
-        return Tensor(a + b)
-    if message_op == "sub":
-        return Tensor(a - b)
-    if message_op == "mul":
-        return Tensor(a * b)
-    if message_op == "div":
-        return Tensor(a / b)
-    raise ValueError(f"unsupported message_op {message_op!r}")
+    return D("graph_send_uv", x, y, src_index, dst_index,
+             message_op=message_op)
 
 
 def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,

@@ -256,15 +256,21 @@ def _adaptive_avg_pool2d(x, output_size):
     # split into near-equal windows (exact when divisible — the common case)
     if h % oh == 0 and w % ow == 0:
         return jnp.mean(x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
-    # adaptive windows [floor(i*h/oh), ceil((i+1)*h/oh)) — the reference's
-    # AdaptiveAvgPool formula; never empty, so out_size > in_size is valid
+    return _adaptive_pool_windows(x, oh, ow, jnp.mean)
+
+
+def _adaptive_pool_windows(x, oh, ow, reduce_fn):
+    """Adaptive windows [floor(i*h/oh), ceil((i+1)*h/oh)) — the
+    reference's AdaptivePool formula; never empty, so out_size > in_size
+    is valid."""
+    _, _, h, w = x.shape
     rows = []
     for i in range(oh):
         y0, y1 = (i * h) // oh, -(-((i + 1) * h) // oh)
         cols = []
         for j in range(ow):
             x0, x1 = (j * w) // ow, -(-((j + 1) * w) // ow)
-            cols.append(jnp.mean(x[:, :, y0:y1, x0:x1], axis=(2, 3)))
+            cols.append(reduce_fn(x[:, :, y0:y1, x0:x1], axis=(2, 3)))
         rows.append(jnp.stack(cols, axis=-1))
     return jnp.stack(rows, axis=-2)
 
@@ -278,15 +284,7 @@ def _adaptive_max_pool2d(x, output_size):
     n, c, h, w = x.shape
     if h % oh == 0 and w % ow == 0:
         return jnp.max(x.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
-    rows = []
-    for i in range(oh):
-        y0, y1 = (i * h) // oh, -(-((i + 1) * h) // oh)
-        cols = []
-        for j in range(ow):
-            x0, x1 = (j * w) // ow, -(-((j + 1) * w) // ow)
-            cols.append(jnp.max(x[:, :, y0:y1, x0:x1], axis=(2, 3)))
-        rows.append(jnp.stack(cols, axis=-1))
-    return jnp.stack(rows, axis=-2)
+    return _adaptive_pool_windows(x, oh, ow, jnp.max)
 
 
 register_vjp_grad("adaptive_max_pool2d")

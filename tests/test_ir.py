@@ -1,0 +1,176 @@
+"""Program IR + pass framework tests (reference test style:
+unittests/ir/ — build graph, apply pass, assert fused op and numeric
+equality)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import nn
+from paddle_infer_tpu.core.tensor import Tensor
+from paddle_infer_tpu.framework.ir import (PassManager, Program,
+                                           optimize_program, trace_layer,
+                                           trace_program)
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+        self.drop = nn.Dropout(0.5)
+
+    def forward(self, x):
+        return self.fc2(self.drop(nn.functional.relu(self.fc1(x))))
+
+
+def _x(n=3, d=8):
+    return np.random.RandomState(0).randn(n, d).astype(np.float32)
+
+
+class TestTraceAndRun:
+    def test_capture_and_interpret(self):
+        m = _MLP()
+        m.eval()
+        x = _x()
+        prog = trace_layer(m, [x])
+        assert prog.feed_ids and prog.fetch_ids
+        names = [op.name for op in prog.ops]
+        assert "matmul" in names and "relu" in names
+        assert set(prog.param_names()) == {
+            "fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+        out, = prog.run([x], dict(m.named_parameters()))
+        np.testing.assert_allclose(out.numpy(), m(Tensor(jnp.asarray(x)))
+                                   .numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_compiled_executable_matches(self):
+        m = _MLP()
+        m.eval()
+        x = _x()
+        prog = trace_layer(m, [x])
+        fn = prog.compile()
+        params = {n: p._data for n, p in m.named_parameters()}
+        out, = fn((jnp.asarray(x),), params)
+        np.testing.assert_allclose(np.asarray(out),
+                                   m(Tensor(jnp.asarray(x))).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_roundtrip_serialization(self):
+        m = _MLP()
+        m.eval()
+        x = _x()
+        prog = trace_layer(m, [x])
+        clone = Program.from_json(prog.to_json())
+        out, = clone.run([x], dict(m.named_parameters()))
+        np.testing.assert_allclose(out.numpy(),
+                                   m(Tensor(jnp.asarray(x))).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+class TestPasses:
+    def test_delete_dropout(self):
+        m = _MLP()
+        m.train()     # dropout active in the trace
+        x = _x()
+        prog = trace_layer(m, [x])
+        assert any(op.name == "dropout" for op in prog.ops)
+        prog = optimize_program(prog, ["delete_dropout_pass", "dce_pass"])
+        assert not any(op.name == "dropout" for op in prog.ops)
+        # after deletion the program computes the eval-mode forward
+        m.eval()
+        out, = prog.run([x], dict(m.named_parameters()))
+        np.testing.assert_allclose(out.numpy(),
+                                   m(Tensor(jnp.asarray(x))).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fuse_matmul_add(self):
+        m = _MLP()
+        m.eval()
+        x = _x()
+        prog = trace_layer(m, [x])
+        n_mm = sum(op.name == "matmul" for op in prog.ops)
+        assert n_mm == 2
+        prog = optimize_program(prog)
+        names = [op.name for op in prog.ops]
+        assert names.count("addmm") == 2
+        assert "matmul" not in names and "add" not in names
+        out, = prog.run([x], dict(m.named_parameters()))
+        np.testing.assert_allclose(out.numpy(),
+                                   m(Tensor(jnp.asarray(x))).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_constant_fold(self):
+        def f(x):
+            c = pit.to_tensor(np.ones((4,), np.float32))
+            d = c * 2.0 + 1.0            # foldable: consts only
+            return x + d
+
+        x = np.zeros((4,), np.float32)
+        prog = trace_program(f, [x])
+        n_before = len(prog.ops)
+        prog = optimize_program(prog, ["constant_fold_pass", "dce_pass"])
+        assert len(prog.ops) < n_before
+        # everything but the final add folded away
+        assert [op.name for op in prog.ops] == ["add"]
+        out, = prog.run([x])
+        np.testing.assert_allclose(out.numpy(), np.full((4,), 3.0))
+
+    def test_dce_drops_unused_branch(self):
+        def f(x):
+            unused = x * 100.0
+            y = x + 1.0
+            _ = unused.sum()             # dead: not returned
+            return y
+
+        x = np.ones((4,), np.float32)
+        prog = trace_program(f, [x])
+        prog = optimize_program(prog, ["dce_pass"])
+        names = [op.name for op in prog.ops]
+        assert "add" in names
+        assert all(n not in ("multiply", "sum") for n in names) or \
+            len(names) == 1
+
+    def test_pass_manager_editable(self):
+        pm = PassManager()
+        assert "fuse_matmul_add_pass" in pm.passes
+        pm.delete_pass("fuse_matmul_add_pass")
+        m = _MLP()
+        m.eval()
+        prog = trace_layer(m, [_x()])
+        prog = pm.run(prog)
+        assert any(op.name == "matmul" for op in prog.ops)
+
+    def test_fusion_respects_fetched_matmul(self):
+        """A matmul whose output is itself fetched must not be fused away
+        (review finding: replay crashed with a producer-less fetch)."""
+
+        def f(x, w, b):
+            t = pit.matmul(x, w)
+            return t + b, t
+
+        x = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+        w = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        b = np.random.RandomState(2).randn(4).astype(np.float32)
+        prog = trace_program(f, [x, w, b])
+        prog = optimize_program(prog, ["fuse_matmul_add_pass"])
+        o1, o2 = prog.run([x, w, b])
+        np.testing.assert_allclose(o1.numpy(), x @ w + b, rtol=1e-5)
+        np.testing.assert_allclose(o2.numpy(), x @ w, rtol=1e-5)
+
+    def test_fusion_respects_multi_consumer(self):
+        """matmul feeding two consumers must NOT be fused away."""
+
+        def f(x, w, b):
+            t = pit.matmul(x, w)
+            return t + b, t * 2.0
+
+        x = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+        w = np.random.RandomState(1).randn(3, 4).astype(np.float32)
+        b = np.random.RandomState(2).randn(4).astype(np.float32)
+        prog = trace_program(f, [x, w, b])
+        prog = optimize_program(prog, ["fuse_matmul_add_pass"])
+        names = [op.name for op in prog.ops]
+        assert "matmul" in names and "addmm" not in names
+        o1, o2 = prog.run([x, w, b])
+        np.testing.assert_allclose(o1.numpy(), x @ w + b, rtol=1e-5)
+        np.testing.assert_allclose(o2.numpy(), (x @ w) * 2, rtol=1e-5)
