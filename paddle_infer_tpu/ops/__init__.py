@@ -186,6 +186,11 @@ _EXPORTS = [
     "searchsorted", "bucketize", "index_add", "diag_embed", "tensordot",
     "inner", "vander", "cov", "corrcoef", "cholesky_solve", "multi_dot",
     "renorm",
+    # round-3 breadth batch 2
+    "nextafter", "copysign", "ldexp", "trapezoid", "nanquantile",
+    "angle", "conj", "bincount", "diagflat", "index_put", "scatter_nd",
+    "scatter_nd_add", "masked_select", "unique", "cdist", "lu_factor",
+    "eig",
 ]
 
 globals().update({name: _fn(name) for name in _EXPORTS})
@@ -214,6 +219,39 @@ def split(x, num_or_sections, axis=0):
     else:
         num_or_sections = int(num_or_sections)
     return D("split", x, num_or_sections=num_or_sections, axis=axis)
+
+
+def trapezoid(y, x=None, dx=1.0, axis=-1):
+    # sample points are a tensor operand, not an attr
+    return D("trapezoid", y, x, dx=float(dx), axis=int(axis))
+
+
+def bincount(x, weights=None, minlength=0):
+    # weights is a tensor operand, not an attr
+    return D("bincount", x, weights, minlength=int(minlength))
+
+
+def scatter_nd(index, updates, shape):
+    # shape is static config, not an operand
+    return D("scatter_nd", index, updates,
+             shape=tuple(int(s) for s in shape))
+
+
+def real(x):
+    return D("real_part", x)
+
+
+def imag(x):
+    return D("imag_part", x)
+
+
+def cond(x, p=None):
+    # p is config, not an operand (reference paddle.linalg.cond)
+    return D("matrix_cond", x, p=str(p) if p is not None else "2")
+
+
+def lu(x):
+    return D("lu_factor", x)
 
 
 def mm(x, y):

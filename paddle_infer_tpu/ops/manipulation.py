@@ -392,3 +392,34 @@ def _unfold_impl(x, axis, size, step):
     moved = jnp.moveaxis(x, axis, 0)
     out = moved[idx]  # (n, size, ...)
     return jnp.moveaxis(out, (0, 1), (axis, x.ndim if axis >= 0 else axis))
+
+
+# ---- round-3 breadth batch 2 (reference tensor/manipulation.py,
+# tensor/search.py)
+defop("diagflat")(lambda x, offset=0: jnp.diagflat(x, k=offset))
+defop("index_put")(
+    lambda x, value, *indices, accumulate=False:
+    x.at[tuple(i.astype(jnp.int32) for i in indices)].add(value)
+    if accumulate else
+    x.at[tuple(i.astype(jnp.int32) for i in indices)].set(value))
+defop("scatter_nd")(
+    lambda index, updates, *, shape:
+    jnp.zeros(tuple(shape), updates.dtype)
+    .at[tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))].add(updates))
+defop("scatter_nd_add")(
+    lambda x, index, updates:
+    x.at[tuple(jnp.moveaxis(index.astype(jnp.int32), -1, 0))].add(updates))
+# data-dependent output shapes -> eager-only ops (reference kernels emit
+# dynamic-shaped outputs; XLA can't, so these never enter a jit region)
+register_op("masked_select", jit=False)(lambda x, mask: x[mask])
+# cache=False: the vjp must run eagerly too — a jitted backward would
+# trace the boolean mask into a non-concrete index
+register_vjp_grad("masked_select", cache=False)
+
+
+@register_op("unique", save_inputs=False, jit=False)
+def _unique(x, return_index=False, return_inverse=False,
+            return_counts=False):
+    return jnp.unique(x.reshape(-1), return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts)

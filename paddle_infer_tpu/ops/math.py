@@ -445,3 +445,26 @@ def _diag_embed_general(x, offset, dim1, dim2):
         if perm[i] is None:
             perm[i] = next(batch)
     return base.transpose(perm)
+
+
+# ---- round-3 breadth batch 2 (reference python/paddle/tensor/math.py)
+defop("nextafter", vjp=False)(lambda x, y: jnp.nextafter(x, y))
+defop("copysign")(lambda x, y: jnp.copysign(x, y))
+defop("ldexp")(lambda x, y: jnp.ldexp(x, y.astype(jnp.int32)))
+defop("trapezoid")(lambda y, x=None, dx=1.0, axis=-1:
+                   jnp.trapezoid(y, x=x, dx=dx, axis=axis))
+defop("nanquantile", vjp=False)(
+    lambda x, q, axis=None, keepdim=False:
+    jnp.nanquantile(x, q, axis=axis, keepdims=keepdim))
+# complex-number accessors (reference tensor/attribute.py real/imag,
+# tensor/math.py angle/conj) — complex arrays come from the fft domain
+defop("angle")(lambda x: jnp.angle(x))
+defop("conj")(lambda x: jnp.conj(x))
+defop("real_part", vjp=False)(lambda x: jnp.real(x))
+defop("imag_part", vjp=False)(lambda x: jnp.imag(x))
+# data-dependent output size -> eager-only (jit=False), like the
+# reference's dynamic-shape ops
+defop("bincount", vjp=False, jit=False)(
+    lambda x, weights=None, minlength=0:
+    jnp.bincount(x.reshape(-1), weights=None if weights is None
+                 else weights.reshape(-1), minlength=int(minlength)))

@@ -443,3 +443,136 @@ class TestBreadthOps:
             for i in range(3):
                 ref[i, i, b] = 3 * b + i        # x[b, i]
         np.testing.assert_allclose(out.numpy(), ref)
+
+
+class TestOpBreadthBatch2:
+    """Round-3 batch 2 vs numpy (reference OpTest style)."""
+
+    def setup_method(self, _):
+        self.rng = np.random.RandomState(0)
+
+    def test_float_pair_ops(self):
+        x = self.rng.randn(8).astype(np.float32)
+        y = self.rng.randn(8).astype(np.float32)
+        np.testing.assert_allclose(pit.nextafter(x, y).numpy(),
+                                   np.nextafter(x, y))
+        np.testing.assert_allclose(pit.copysign(x, y).numpy(),
+                                   np.copysign(x, y))
+        e = self.rng.randint(-3, 4, 8).astype(np.int32)
+        np.testing.assert_allclose(pit.ldexp(x, e).numpy(),
+                                   np.ldexp(x, e), rtol=1e-6)
+
+    def test_trapezoid_quantile(self):
+        y = self.rng.rand(5, 9).astype(np.float32)
+        np.testing.assert_allclose(pit.trapezoid(y, dx=0.5).numpy(),
+                                   np.trapezoid(y, dx=0.5, axis=-1),
+                                   rtol=1e-6)
+        x = y.copy()
+        x[0, :3] = np.nan
+        np.testing.assert_allclose(
+            pit.nanquantile(x, 0.5, axis=1).numpy(),
+            np.nanquantile(x, 0.5, axis=1), rtol=1e-6)
+
+    def test_complex_accessors(self):
+        z = (self.rng.randn(6) + 1j * self.rng.randn(6)).astype(np.complex64)
+        np.testing.assert_allclose(pit.real(z).numpy(), z.real)
+        np.testing.assert_allclose(pit.imag(z).numpy(), z.imag)
+        np.testing.assert_allclose(pit.conj(z).numpy(), np.conj(z))
+        np.testing.assert_allclose(pit.angle(z).numpy(), np.angle(z),
+                                   rtol=1e-6)
+
+    def test_bincount_unique_masked_select(self):
+        x = np.asarray([1, 3, 1, 0, 3, 3], np.int32)
+        np.testing.assert_array_equal(pit.bincount(x).numpy(),
+                                      np.bincount(x))
+        w = np.asarray([1., 2., 3., 4., 5., 6.], np.float32)
+        np.testing.assert_allclose(
+            pit.bincount(x, weights=w, minlength=6).numpy(),
+            np.bincount(x, weights=w, minlength=6))
+        u, inv, cnt = pit.unique(x, return_inverse=True,
+                                 return_counts=True)
+        ru, rinv, rcnt = np.unique(x, return_inverse=True,
+                                   return_counts=True)
+        np.testing.assert_array_equal(u.numpy(), ru)
+        np.testing.assert_array_equal(inv.numpy().reshape(-1), rinv)
+        np.testing.assert_array_equal(cnt.numpy(), rcnt)
+        d = self.rng.randn(3, 4).astype(np.float32)
+        mask = d > 0
+        np.testing.assert_allclose(pit.masked_select(d, mask).numpy(),
+                                   d[mask])
+
+    def test_masked_select_grad(self):
+        d = self.rng.randn(3, 4).astype(np.float32)
+        mask = d > 0
+        t = pit.to_tensor(d)
+        t.stop_gradient = False
+        pit.masked_select(t, mask).sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(),
+                                   mask.astype(np.float32))
+
+    def test_scatter_index_put_diagflat(self):
+        idx = np.asarray([[0], [2]], np.int64)
+        upd = np.asarray([[1., 2.], [3., 4.]], np.float32)
+        out = pit.scatter_nd(idx, upd, [4, 2]).numpy()
+        ref = np.zeros((4, 2), np.float32)
+        ref[0] += upd[0]; ref[2] += upd[1]
+        np.testing.assert_allclose(out, ref)
+        base = np.ones((4, 2), np.float32)
+        np.testing.assert_allclose(
+            pit.scatter_nd_add(base, idx, upd).numpy(), base + ref)
+        x = np.zeros((3, 3), np.float32)
+        np.testing.assert_allclose(
+            pit.index_put(x, np.asarray([5., 7.], np.float32),
+                          np.asarray([0, 2]), np.asarray([1, 1])).numpy(),
+            np.asarray([[0, 5, 0], [0, 0, 0], [0, 7, 0]], np.float32))
+        v = np.asarray([1., 2., 3.], np.float32)
+        np.testing.assert_allclose(pit.diagflat(v, offset=1).numpy(),
+                                   np.diagflat(v, 1))
+
+    def test_cdist_lu_eig_cond(self):
+        x = self.rng.randn(4, 3).astype(np.float32)
+        y = self.rng.randn(5, 3).astype(np.float32)
+        from scipy.spatial.distance import cdist as sp_cdist
+
+        np.testing.assert_allclose(pit.cdist(x, y).numpy(),
+                                   sp_cdist(x, y), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(pit.cdist(x, y, p=1.0).numpy(),
+                                   sp_cdist(x, y, metric="minkowski", p=1),
+                                   rtol=1e-4, atol=1e-5)
+        a = (self.rng.randn(4, 4) + 4 * np.eye(4)).astype(np.float32)
+        lu_m, piv = pit.lu(a)
+        import scipy.linalg as sla
+
+        ref_lu, ref_piv = sla.lu_factor(a)
+        np.testing.assert_allclose(lu_m.numpy(), ref_lu, rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_array_equal(piv.numpy(), ref_piv)
+        w, v = pit.eig(a)
+        # eigpairs verify by definition A v = w v
+        np.testing.assert_allclose(a @ v.numpy(),
+                                   v.numpy() * w.numpy()[None, :],
+                                   rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(pit.cond(a).numpy(),
+                                   np.linalg.cond(a), rtol=1e-4)
+        for p_pit, p_np in [("fro", "fro"), (1, 1), (np.inf, np.inf),
+                            ("nuc", "nuc"), (-1, -1)]:
+            np.testing.assert_allclose(
+                pit.cond(a, p=p_pit).numpy(), np.linalg.cond(a, p_np),
+                rtol=1e-4, err_msg=f"p={p_pit}")
+        with pytest.raises(ValueError):
+            pit.cond(a, p="bogus")
+
+    def test_cdist_inf_and_self_grad(self):
+        x = self.rng.randn(4, 3).astype(np.float32)
+        from scipy.spatial.distance import cdist as sp_cdist
+
+        np.testing.assert_allclose(
+            pit.cdist(x, x[:2], p=float("inf")).numpy(),
+            sp_cdist(x, x[:2], metric="chebyshev"), rtol=1e-5)
+        # self-distance: zero diagonal must not NaN the gradient
+        t = pit.to_tensor(x)
+        t.stop_gradient = False
+        pit.cdist(t, x.copy()).sum().backward()
+        assert np.isfinite(t.grad.numpy()).all()
+        with pytest.raises(ValueError):
+            pit.cdist(x, x, p=-1.0)
