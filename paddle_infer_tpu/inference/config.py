@@ -93,7 +93,8 @@ class Config:
         self._glog_info = False
 
     def switch_ir_optim(self, flag=True):
-        pass
+        # honored by Predictor.from_layer (the graph-IR serving mode)
+        self._ir_optim = bool(flag)
 
     def enable_profile(self):
         self._enable_profile = True

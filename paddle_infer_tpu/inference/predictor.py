@@ -51,6 +51,8 @@ class _IOHandle:
 class Predictor:
     def __init__(self, config: Config, _shared=None):
         self._config = config
+        self._program = None            # IR-serving mode (from_layer)
+        self._program_fn = None
         if _shared is not None:
             (self._exported, self._params, self._buffers,
              self._input_names) = _shared
@@ -60,6 +62,45 @@ class Predictor:
             n: _IOHandle(n) for n in self._input_names}
         self._outputs: List[jax.Array] = []
         self._lock = threading.Lock()
+
+    @classmethod
+    def from_layer(cls, layer, example_inputs, config: Optional[Config] = None):
+        """Serve a live Layer through the graph IR: trace the forward into
+        a Program (framework/ir.py), run the IR PassManager (the reference
+        OptimizeInferenceProgram's ir_analysis_pass stage — DCE, constant
+        fold, dropout deletion, matmul+add fusion; honoring
+        config.switch_ir_optim), then compile the optimized program into
+        one XLA executable."""
+        from ..framework.ir import PassManager, trace_layer
+
+        self = cls.__new__(cls)
+        self._config = config if config is not None else Config()
+        was_training = getattr(layer, "training", False)
+        layer.eval()                    # serve eval-mode semantics...
+        try:
+            prog = trace_layer(layer, list(example_inputs))
+        finally:
+            if was_training:
+                layer.train()           # ...without mutating the caller
+        self._applied_passes = []
+        if getattr(self._config, "_ir_optim", True):
+            pm = PassManager()
+            disabled = getattr(self._config, "_passes_disabled", ())
+            for name in disabled:       # same knob as the artifact path
+                pm.delete_pass(name)
+            prog = pm.run(prog)
+            self._applied_passes = list(pm.passes)
+        self._program = prog
+        self._program_fn = prog.compile()
+        self._params = {n: p._data for n, p in layer.named_parameters()}
+        self._buffers = {}
+        self._exported = None
+        self._input_names = [f"input_{i}" for i in
+                             range(len(prog.feed_ids))]
+        self._inputs = {n: _IOHandle(n) for n in self._input_names}
+        self._outputs = []
+        self._lock = threading.Lock()
+        return self
 
     # ---------------------------------------------------------------- load
     def _load(self, config: Config):
@@ -116,7 +157,11 @@ class Predictor:
             arrays = [self._inputs[n].to_array() for n in self._input_names]
         # precision cast of inputs to match exported signature
         with self._lock:
-            out = self._exported.call(self._params, self._buffers, *arrays)
+            if self._program_fn is not None:
+                out = self._program_fn(tuple(arrays), self._params)
+            else:
+                out = self._exported.call(self._params, self._buffers,
+                                          *arrays)
         flat = jax.tree_util.tree_leaves(out)
         self._outputs = flat
         if inputs is not None:
@@ -126,6 +171,13 @@ class Predictor:
     def clone(self):
         """Weight-sharing clone for per-thread serving (reference:
         analysis_predictor.cc Clone — shares Scope)."""
+        if self._program is not None:
+            c = Predictor.__new__(Predictor)
+            c.__dict__.update(self.__dict__)
+            c._inputs = {n: _IOHandle(n) for n in self._input_names}
+            c._outputs = []
+            c._lock = threading.Lock()
+            return c
         return Predictor(self._config,
                          _shared=(self._exported, self._params, self._buffers,
                                   self._input_names))

@@ -5,9 +5,10 @@ conv7-pool-4stages-avgpool-fc topology."""
 from __future__ import annotations
 
 from ..nn.layer import Layer
-from ..nn.layers_common import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D,
-                                Dropout, Flatten, Linear, MaxPool2D, ReLU,
-                                ReLU6, Sequential)
+from ..nn.layers_common import (AdaptiveAvgPool2D, AvgPool2D,
+                                BatchNorm2D, Conv2D, Dropout, Flatten,
+                                Linear, MaxPool2D, ReLU, ReLU6,
+                                Sequential)
 from ..nn import functional as F
 from ..ops import concat, split
 
@@ -144,7 +145,9 @@ __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "MobileNetV2",
            "resnet34", "resnet50", "resnet101", "resnet152",
            "AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0",
            "squeezenet1_1", "MobileNetV1", "mobilenet_v1",
-           "ShuffleNetV2", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0"]
+           "ShuffleNetV2", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264", "GoogLeNet", "googlenet"]
 
 
 class VGG(Layer):
@@ -542,3 +545,184 @@ def shufflenet_v2_x1_0(**kw):
 
 def shufflenet_v2_x0_5(**kw):
     return ShuffleNetV2(scale=0.5, **kw)
+
+
+class _DenseLayer(Layer):
+    """BN-ReLU-1x1(4k) -> BN-ReLU-3x3(k), output concatenated onto the
+    running feature bundle."""
+
+    def __init__(self, inp, growth, bn_size=4):
+        super().__init__()
+        mid = bn_size * growth
+        self.bn1 = BatchNorm2D(inp)
+        self.conv1 = Conv2D(inp, mid, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(mid)
+        self.conv2 = Conv2D(mid, growth, 3, padding=1, bias_attr=False)
+
+    def forward(self, x):
+        out = self.conv1(F.relu(self.bn1(x)))
+        out = self.conv2(F.relu(self.bn2(out)))
+        return concat([x, out], axis=1)
+
+
+class _Transition(Layer):
+    def __init__(self, inp, oup):
+        super().__init__()
+        self.bn = BatchNorm2D(inp)
+        self.conv = Conv2D(inp, oup, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(F.relu(self.bn(x))))
+
+
+class DenseNet(Layer):
+    """reference: python/paddle/vision/models/densenet.py — dense blocks
+    with feature concatenation (XLA folds the concat chain into the
+    following conv's gather)."""
+
+    _CFGS = {121: [6, 12, 24, 16], 161: [6, 12, 36, 24],
+             169: [6, 12, 32, 32], 201: [6, 12, 48, 32],
+             264: [6, 12, 64, 48]}
+
+    def __init__(self, layers=121, growth_rate=None, num_init_features=None,
+                 bn_size=4, num_classes=1000, with_pool=True):
+        super().__init__()
+        # densenet161's wider defaults apply only when not overridden
+        if growth_rate is None:
+            growth_rate = 48 if layers == 161 else 32
+        if num_init_features is None:
+            num_init_features = 96 if layers == 161 else 64
+        blocks_cfg = self._CFGS[layers]
+        feats = [Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                        bias_attr=False), BatchNorm2D(num_init_features),
+                 ReLU(), MaxPool2D(3, stride=2, padding=1)]
+        ch = num_init_features
+        for bi, n in enumerate(blocks_cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth_rate, bn_size))
+                ch += growth_rate
+            if bi != len(blocks_cfg) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch //= 2
+        feats += [BatchNorm2D(ch), ReLU()]
+        self.features = Sequential(*feats)
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self._pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self._flatten = Flatten()
+            self.fc = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self._pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self._flatten(x))
+        return x
+
+
+def densenet121(**kw):
+    return DenseNet(layers=121, **kw)
+
+
+def densenet161(**kw):
+    return DenseNet(layers=161, **kw)
+
+
+def densenet169(**kw):
+    return DenseNet(layers=169, **kw)
+
+
+def densenet201(**kw):
+    return DenseNet(layers=201, **kw)
+
+
+def densenet264(**kw):
+    return DenseNet(layers=264, **kw)
+
+
+class _Inception(Layer):
+    """GoogLeNet inception block: 1x1 / 1x1-3x3 / 1x1-5x5 / pool-1x1
+    branches concatenated."""
+
+    def __init__(self, inp, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = Sequential(Conv2D(inp, c1, 1), ReLU())
+        self.b3 = Sequential(Conv2D(inp, c3r, 1), ReLU(),
+                             Conv2D(c3r, c3, 3, padding=1), ReLU())
+        self.b5 = Sequential(Conv2D(inp, c5r, 1), ReLU(),
+                             Conv2D(c5r, c5, 5, padding=2), ReLU())
+        self.bp = Sequential(MaxPool2D(3, stride=1, padding=1),
+                             Conv2D(inp, pp, 1), ReLU())
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b3(x), self.b5(x), self.bp(x)],
+                      axis=1)
+
+
+class GoogLeNet(Layer):
+    """reference: python/paddle/vision/models/googlenet.py — returns
+    (main, aux1, aux2) logits like the reference (aux heads feed the
+    deep-supervision loss during training)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            Conv2D(3, 64, 7, stride=2, padding=3), ReLU(),
+            MaxPool2D(3, stride=2, padding=1),
+            Conv2D(64, 64, 1), ReLU(),
+            Conv2D(64, 192, 3, padding=1), ReLU(),
+            MaxPool2D(3, stride=2, padding=1))
+        self.inc3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, stride=2, padding=1)
+        self.inc4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, stride=2, padding=1)
+        self.inc5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self._pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self._flatten = Flatten()
+            self._drop = Dropout(0.2)
+            self.fc = Linear(1024, num_classes)
+            # aux classifiers off inc4a / inc4d (reference GoogLeNetOutAux)
+            self.aux1 = Sequential(AdaptiveAvgPool2D((4, 4)),
+                                   Conv2D(512, 128, 1), ReLU())
+            self.aux1_fc = Sequential(Flatten(), Linear(128 * 16, 1024),
+                                      ReLU(), Dropout(0.7),
+                                      Linear(1024, num_classes))
+            self.aux2 = Sequential(AdaptiveAvgPool2D((4, 4)),
+                                   Conv2D(528, 128, 1), ReLU())
+            self.aux2_fc = Sequential(Flatten(), Linear(128 * 16, 1024),
+                                      ReLU(), Dropout(0.7),
+                                      Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.pool3(self.inc3b(self.inc3a(self.stem(x))))
+        x4a = self.inc4a(x)
+        x = self.inc4d(self.inc4c(self.inc4b(x4a)))
+        x4d = x
+        x = self.pool4(self.inc4e(x))
+        x = self.inc5b(self.inc5a(x))
+        if self.with_pool:
+            x = self._pool(x)
+        if self.num_classes > 0:
+            out = self.fc(self._drop(self._flatten(x)))
+            aux1 = self.aux1_fc(self.aux1(x4a))
+            aux2 = self.aux2_fc(self.aux2(x4d))
+            return out, aux1, aux2
+        return x
+
+
+def googlenet(**kw):
+    return GoogLeNet(**kw)

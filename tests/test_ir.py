@@ -174,3 +174,51 @@ class TestPasses:
         o1, o2 = prog.run([x, w, b])
         np.testing.assert_allclose(o1.numpy(), x @ w + b, rtol=1e-5)
         np.testing.assert_allclose(o2.numpy(), (x @ w) * 2, rtol=1e-5)
+
+
+class TestPredictorFromLayer:
+    """IR-serving predictor mode (reference: AnalysisPredictor's
+    OptimizeInferenceProgram running ir passes before NaiveExecutor)."""
+
+    def test_serves_optimized_program(self):
+        from paddle_infer_tpu.inference.predictor import Predictor
+
+        m = _MLP()
+        m.train()
+        x = _x()
+        pred = Predictor.from_layer(m, [x])
+        # serving traces eval semantics (no dropout op even from a
+        # train-mode model) WITHOUT mutating the caller's mode
+        assert m.training
+        assert not any(op.name == "dropout" for op in pred._program.ops)
+        assert any(op.name == "addmm" for op in pred._program.ops)
+        out = pred.run([x])[0]
+        m.eval()
+        np.testing.assert_allclose(out, m(Tensor(jnp.asarray(x))).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        # clone shares the compiled program + params
+        c = pred.clone()
+        assert c._program is pred._program
+        np.testing.assert_allclose(c.run([x])[0], out, rtol=1e-6)
+
+    def test_ir_optim_off_and_delete_pass(self):
+        from paddle_infer_tpu.inference import Config
+        from paddle_infer_tpu.inference.predictor import Predictor
+
+        cfg = Config()
+        cfg.switch_ir_optim(False)
+        m = _MLP()
+        m.eval()
+        x = _x()
+        pred = Predictor.from_layer(m, [x], config=cfg)
+        assert pred._applied_passes == []
+        assert any(op.name == "matmul" for op in pred._program.ops)
+        np.testing.assert_allclose(pred.run([x])[0],
+                                   m(Tensor(jnp.asarray(x))).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        # config.delete_pass is honored like on the artifact path
+        cfg2 = Config()
+        cfg2.delete_pass("fuse_matmul_add_pass")
+        pred2 = Predictor.from_layer(m, [x], config=cfg2)
+        assert "fuse_matmul_add_pass" not in pred2._applied_passes
+        assert any(op.name == "matmul" for op in pred2._program.ops)

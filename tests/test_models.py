@@ -271,3 +271,34 @@ class TestVisionZooRound3:
         loss.backward()
         opt.step()
         assert np.isfinite(float(loss.numpy()))
+
+
+class TestVisionZooRound3b:
+    """DenseNet / GoogLeNet (reference python/paddle/vision/models/)."""
+
+    def test_densenet(self):
+        import numpy as np
+        from paddle_infer_tpu.vision.models import densenet121
+
+        m = densenet121(num_classes=10)
+        m.eval()
+        x = pit.to_tensor(np.random.RandomState(0).randn(
+            1, 3, 64, 64).astype(np.float32))
+        out = m(x)
+        assert list(out.shape) == [1, 10]
+        assert np.isfinite(out.numpy()).all()
+        # densenet121 channel bookkeeping: final features = 1024
+        assert m.fc.weight.shape[0] == 1024
+
+    def test_googlenet_aux_heads(self):
+        import numpy as np
+        from paddle_infer_tpu.vision.models import googlenet
+
+        m = googlenet(num_classes=7)
+        m.eval()
+        x = pit.to_tensor(np.random.RandomState(0).randn(
+            1, 3, 96, 96).astype(np.float32))
+        out, aux1, aux2 = m(x)
+        for o in (out, aux1, aux2):
+            assert list(o.shape) == [1, 7]
+            assert np.isfinite(o.numpy()).all()
