@@ -69,6 +69,7 @@ from . import fft
 from . import sparse
 from . import text
 from . import geometric
+from . import incubate
 from .framework import save, load, set_flags, get_flags, flags
 from .framework.io import save_state_dict, load_state_dict
 

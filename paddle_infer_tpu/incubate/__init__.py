@@ -1,0 +1,5 @@
+"""Incubate namespace (reference: python/paddle/incubate/ — the staging
+area for the fork's fused-transformer serving APIs)."""
+from . import nn  # noqa: F401
+
+__all__ = ["nn"]

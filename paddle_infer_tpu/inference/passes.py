@@ -105,6 +105,10 @@ class Analyzer:
     """reference analysis/analyzer.cc Analyzer::Run."""
 
     def run(self, argument: Argument, strategy: PassStrategy):
+        if not getattr(argument.config, "_ir_optim", True):
+            # config.switch_ir_optim(False): skip the whole pipeline on
+            # every serving path, not just Predictor.from_layer
+            return argument
         disabled = set(getattr(argument.config, "_passes_disabled", ()))
         for name in strategy.passes():
             if name in disabled:

@@ -75,13 +75,24 @@ class Predictor:
 
         self = cls.__new__(cls)
         self._config = config if config is not None else Config()
-        was_training = getattr(layer, "training", False)
-        layer.eval()                    # serve eval-mode semantics...
+        if getattr(self._config, "_weight_only_quant", None):
+            raise NotImplementedError(
+                "enable_weight_only_quant is not supported in the "
+                "graph-IR serving mode; use the engines "
+                "(GenerationEngine/PagedGenerationEngine) or the saved-"
+                "artifact Predictor, whose layer pipeline applies the "
+                "quant swap")
+        # serve eval-mode semantics, then restore EXACTLY the caller's
+        # per-sublayer modes (a blanket .train() would unfreeze any
+        # deliberately-eval'd sublayer, e.g. frozen BatchNorm)
+        modes = [(layer, layer.training)] + [
+            (sub, sub.training) for _, sub in layer.named_sublayers()]
+        layer.eval()
         try:
             prog = trace_layer(layer, list(example_inputs))
         finally:
-            if was_training:
-                layer.train()           # ...without mutating the caller
+            for sub, mode in modes:
+                sub.training = mode
         self._applied_passes = []
         if getattr(self._config, "_ir_optim", True):
             pm = PassManager()
@@ -93,6 +104,18 @@ class Predictor:
         self._program = prog
         self._program_fn = prog.compile()
         self._params = {n: p._data for n, p in layer.named_parameters()}
+        # precision knob, same semantics as the artifact path's
+        # precision_cast_pass (params cast; activations follow by
+        # promotion inside the compiled program)
+        prec = getattr(self._config, "_precision", None)
+        if prec in (PrecisionType.Bfloat16, PrecisionType.Half):
+            tgt = jnp.bfloat16 if prec == PrecisionType.Bfloat16 \
+                else jnp.float16
+            self._params = {
+                n: (v.astype(tgt)
+                    if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                for n, v in self._params.items()}
+            self._applied_passes.append("precision_cast_pass")
         self._buffers = {}
         self._exported = None
         self._input_names = [f"input_{i}" for i in

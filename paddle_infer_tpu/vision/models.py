@@ -147,7 +147,8 @@ __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "MobileNetV2",
            "squeezenet1_1", "MobileNetV1", "mobilenet_v1",
            "ShuffleNetV2", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
            "DenseNet", "densenet121", "densenet161", "densenet169",
-           "densenet201", "densenet264", "GoogLeNet", "googlenet"]
+           "densenet201", "densenet264", "GoogLeNet", "googlenet",
+           "InceptionV3", "inception_v3"]
 
 
 class VGG(Layer):
@@ -663,6 +664,20 @@ class _Inception(Layer):
                       axis=1)
 
 
+class _AuxHead(Layer):
+    """GoogLeNet deep-supervision classifier (reference GoogLeNetOutAux)."""
+
+    def __init__(self, inp, num_classes):
+        super().__init__()
+        self.head = Sequential(
+            AdaptiveAvgPool2D((4, 4)), Conv2D(inp, 128, 1), ReLU(),
+            Flatten(), Linear(128 * 16, 1024), ReLU(), Dropout(0.7),
+            Linear(1024, num_classes))
+
+    def forward(self, x):
+        return self.head(x)
+
+
 class GoogLeNet(Layer):
     """reference: python/paddle/vision/models/googlenet.py — returns
     (main, aux1, aux2) logits like the reference (aux heads feed the
@@ -695,17 +710,9 @@ class GoogLeNet(Layer):
             self._flatten = Flatten()
             self._drop = Dropout(0.2)
             self.fc = Linear(1024, num_classes)
-            # aux classifiers off inc4a / inc4d (reference GoogLeNetOutAux)
-            self.aux1 = Sequential(AdaptiveAvgPool2D((4, 4)),
-                                   Conv2D(512, 128, 1), ReLU())
-            self.aux1_fc = Sequential(Flatten(), Linear(128 * 16, 1024),
-                                      ReLU(), Dropout(0.7),
-                                      Linear(1024, num_classes))
-            self.aux2 = Sequential(AdaptiveAvgPool2D((4, 4)),
-                                   Conv2D(528, 128, 1), ReLU())
-            self.aux2_fc = Sequential(Flatten(), Linear(128 * 16, 1024),
-                                      ReLU(), Dropout(0.7),
-                                      Linear(1024, num_classes))
+            # aux classifiers off inc4a / inc4d
+            self.aux1 = _AuxHead(512, num_classes)
+            self.aux2 = _AuxHead(528, num_classes)
 
     def forward(self, x):
         x = self.pool3(self.inc3b(self.inc3a(self.stem(x))))
@@ -718,11 +725,141 @@ class GoogLeNet(Layer):
             x = self._pool(x)
         if self.num_classes > 0:
             out = self.fc(self._drop(self._flatten(x)))
-            aux1 = self.aux1_fc(self.aux1(x4a))
-            aux2 = self.aux2_fc(self.aux2(x4d))
-            return out, aux1, aux2
+            return out, self.aux1(x4a), self.aux2(x4d)
         return x
 
 
 def googlenet(**kw):
     return GoogLeNet(**kw)
+
+
+def _cbr(inp, oup, k, stride=1, padding=0):
+    """conv-bn-relu (reference inceptionv3.py ConvBNLayer)."""
+    return Sequential(Conv2D(inp, oup, k, stride=stride, padding=padding,
+                             bias_attr=False), BatchNorm2D(oup), ReLU())
+
+
+class _InceptionA(Layer):
+    def __init__(self, inp, pool_features):
+        super().__init__()
+        self.b1 = _cbr(inp, 64, 1)
+        self.b5 = Sequential(_cbr(inp, 48, 1), _cbr(48, 64, 5, padding=2))
+        self.b3d = Sequential(_cbr(inp, 64, 1), _cbr(64, 96, 3, padding=1),
+                              _cbr(96, 96, 3, padding=1))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             _cbr(inp, pool_features, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5(x), self.b3d(x), self.bp(x)],
+                      axis=1)
+
+
+class _InceptionB(Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = _cbr(inp, 384, 3, stride=2)
+        self.b3d = Sequential(_cbr(inp, 64, 1), _cbr(64, 96, 3, padding=1),
+                              _cbr(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(Layer):
+    def __init__(self, inp, c7):
+        super().__init__()
+        self.b1 = _cbr(inp, 192, 1)
+        self.b7 = Sequential(_cbr(inp, c7, 1),
+                             _cbr(c7, c7, (1, 7), padding=(0, 3)),
+                             _cbr(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(_cbr(inp, c7, 1),
+                              _cbr(c7, c7, (7, 1), padding=(3, 0)),
+                              _cbr(c7, c7, (1, 7), padding=(0, 3)),
+                              _cbr(c7, c7, (7, 1), padding=(3, 0)),
+                              _cbr(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             _cbr(inp, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)],
+                      axis=1)
+
+
+class _InceptionD(Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b3 = Sequential(_cbr(inp, 192, 1), _cbr(192, 320, 3, stride=2))
+        self.b7x3 = Sequential(_cbr(inp, 192, 1),
+                               _cbr(192, 192, (1, 7), padding=(0, 3)),
+                               _cbr(192, 192, (7, 1), padding=(3, 0)),
+                               _cbr(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.b7x3(x), self.pool(x)], axis=1)
+
+
+class _InceptionE(Layer):
+    def __init__(self, inp):
+        super().__init__()
+        self.b1 = _cbr(inp, 320, 1)
+        self.b3_stem = _cbr(inp, 384, 1)
+        self.b3_a = _cbr(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _cbr(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = Sequential(_cbr(inp, 448, 1),
+                                   _cbr(448, 384, 3, padding=1))
+        self.b3d_a = _cbr(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _cbr(384, 384, (3, 1), padding=(1, 0))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             _cbr(inp, 192, 1))
+
+    def forward(self, x):
+        s3 = self.b3_stem(x)
+        s3d = self.b3d_stem(x)
+        return concat([self.b1(x),
+                       concat([self.b3_a(s3), self.b3_b(s3)], axis=1),
+                       concat([self.b3d_a(s3d), self.b3d_b(s3d)], axis=1),
+                       self.bp(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    """reference: python/paddle/vision/models/inceptionv3.py — the
+    A/B/C/D/E block stack with factorized 7x7 and 3x3 convolutions
+    (asymmetric 1x7/7x1 pairs; every branch is MXU conv + XLA-fused
+    BN/ReLU)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            _cbr(3, 32, 3, stride=2), _cbr(32, 32, 3),
+            _cbr(32, 64, 3, padding=1), MaxPool2D(3, stride=2),
+            _cbr(64, 80, 1), _cbr(80, 192, 3), MaxPool2D(3, stride=2))
+        self.blocks = Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64),
+            _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self._pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self._flatten = Flatten()
+            self._drop = Dropout(0.2)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self._pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self._drop(self._flatten(x)))
+        return x
+
+
+def inception_v3(**kw):
+    return InceptionV3(**kw)

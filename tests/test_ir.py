@@ -222,3 +222,44 @@ class TestPredictorFromLayer:
         pred2 = Predictor.from_layer(m, [x], config=cfg2)
         assert "fuse_matmul_add_pass" not in pred2._applied_passes
         assert any(op.name == "matmul" for op in pred2._program.ops)
+
+    def test_frozen_sublayer_mode_preserved(self):
+        """from_layer must restore per-sublayer modes exactly — a frozen
+        (eval'd) BN inside a training model stays frozen."""
+        from paddle_infer_tpu.inference.predictor import Predictor
+
+        class WithBN(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+                self.bn = nn.BatchNorm1D(8)
+
+            def forward(self, x):
+                return self.bn(self.fc(x))
+
+        m = WithBN()
+        m.train()
+        m.bn.eval()          # deliberately frozen
+        Predictor.from_layer(m, [_x(4, 8)])
+        assert m.training and not m.bn.training
+
+    def test_precision_knob_honored(self):
+        from paddle_infer_tpu.inference import Config
+        from paddle_infer_tpu.inference.predictor import Predictor
+
+        cfg = Config()
+        cfg.enable_low_precision()      # bfloat16
+        m = _MLP()
+        m.eval()
+        pred = Predictor.from_layer(m, [_x()], config=cfg)
+        assert "precision_cast_pass" in pred._applied_passes
+        assert all(str(v.dtype) == "bfloat16"
+                   for v in pred._params.values())
+        out = pred.run([_x()])[0]
+        np.testing.assert_allclose(
+            out.astype(np.float32), m(Tensor(jnp.asarray(_x()))).numpy(),
+            rtol=0.05, atol=0.05)
+        cfg2 = Config()
+        cfg2.enable_weight_only_quant("int8")
+        with pytest.raises(NotImplementedError):
+            Predictor.from_layer(m, [_x()], config=cfg2)

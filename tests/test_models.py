@@ -302,3 +302,20 @@ class TestVisionZooRound3b:
         for o in (out, aux1, aux2):
             assert list(o.shape) == [1, 7]
             assert np.isfinite(o.numpy()).all()
+
+
+class TestInceptionV3:
+    def test_forward(self):
+        import numpy as np
+        from paddle_infer_tpu.vision.models import inception_v3
+
+        m = inception_v3(num_classes=5)
+        m.eval()
+        # 299 is canonical; 139 keeps CPU test time sane and exercises
+        # every reduction stage
+        x = pit.to_tensor(np.random.RandomState(0).randn(
+            1, 3, 139, 139).astype(np.float32))
+        out = m(x)
+        assert list(out.shape) == [1, 5]
+        assert np.isfinite(out.numpy()).all()
+        assert m.fc.weight.shape[0] == 2048
