@@ -70,6 +70,7 @@ from . import sparse
 from . import text
 from . import geometric
 from . import incubate
+from . import signal
 from .framework import save, load, set_flags, get_flags, flags
 from .framework.io import save_state_dict, load_state_dict
 

@@ -240,3 +240,62 @@ class ReduceOnPlateau(LRScheduler):
                 self.last_lr = max(self.last_lr * self.factor, self.min_lr)
                 self.num_bad = 0
                 self.cooldown_counter = self.cooldown
+
+
+class MultiplicativeDecay(LRScheduler):
+    """reference optimizer/lr.py MultiplicativeDecay: lr multiplied by
+    lr_lambda(epoch) cumulatively each step."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        # stateless product so step(epoch=k) jumps, resume via last_epoch,
+        # and repeated get_lr() calls all agree
+        lr = self.base_lr
+        for e in range(1, self.last_epoch + 1):
+            lr *= self.lr_lambda(e)
+        return lr
+
+
+class CyclicLR(LRScheduler):
+    """reference optimizer/lr.py CyclicLR (triangular policies): lr
+    cycles between base_learning_rate and max_learning_rate."""
+
+    def __init__(self, base_learning_rate, max_learning_rate,
+                 step_size_up, step_size_down=None,
+                 mode="triangular", exp_gamma=1.0, scale_fn=None,
+                 scale_mode="cycle", last_epoch=-1, verbose=False):
+        self.max_lr = max_learning_rate
+        self.step_size_up = step_size_up
+        self.step_size_down = step_size_down or step_size_up
+        self.mode = mode
+        self.exp_gamma = exp_gamma
+        if scale_fn is not None:
+            self.scale_fn, self.scale_mode = scale_fn, scale_mode
+        elif mode == "triangular":
+            self.scale_fn, self.scale_mode = (lambda c: 1.0), "cycle"
+        elif mode == "triangular2":
+            self.scale_fn = lambda c: 1.0 / (2.0 ** (c - 1))
+            self.scale_mode = "cycle"
+        elif mode == "exp_range":
+            self.scale_fn = lambda it: self.exp_gamma ** it
+            self.scale_mode = "iterations"
+        else:
+            raise ValueError(f"unknown CyclicLR mode {mode!r}")
+        super().__init__(base_learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        total = self.step_size_up + self.step_size_down
+        it = max(self.last_epoch, 0)
+        cycle = it // total + 1
+        pos = it % total
+        if pos < self.step_size_up:
+            pct = pos / self.step_size_up
+        else:
+            pct = 1.0 - (pos - self.step_size_up) / self.step_size_down
+        amp = (self.max_lr - self.base_lr) * pct
+        scale = self.scale_fn(cycle if self.scale_mode == "cycle" else it)
+        return self.base_lr + amp * scale

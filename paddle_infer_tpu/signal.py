@@ -1,0 +1,130 @@
+"""Signal processing (reference: python/paddle/signal.py — frame,
+overlap_add, stft, istft; kernels paddle/phi/kernels/cpu|gpu/
+frame_kernel, overlap_add_kernel + the fft stack).
+
+TPU-first: framing is one strided gather and the FFT is XLA's native
+``fft`` HLO, so an stft is gather → window multiply → batched rfft in a
+single fused program; istft is the exact adjoint (irfft → window →
+overlap-add scatter) with the standard window-envelope normalization.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .core.dispatch import defop, dispatch as D
+from .core.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _prep_window(window, win_length: int, n_fft: int):
+    """Default rectangular window + center-pad to n_fft (shared by
+    stft/istft so their conventions can't drift apart)."""
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = window._data if isinstance(window, Tensor) \
+            else jnp.asarray(window)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+    return win
+
+
+@defop("signal_frame")
+def _frame(x, *, frame_length, hop_length, axis=-1):
+    if axis not in (-1, x.ndim - 1):
+        raise ValueError("frame supports the last axis only")
+    n = x.shape[-1]
+    if n < frame_length:
+        raise ValueError(
+            f"signal length {n} is shorter than frame_length "
+            f"{frame_length}")
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    out = x[..., idx]                       # [..., num, frame_length]
+    return jnp.moveaxis(out, -2, -1)        # [..., frame_length, num]
+
+
+@defop("signal_overlap_add")
+def _overlap_add(x, *, hop_length, axis=-1):
+    if axis not in (-1, x.ndim - 1):
+        raise ValueError("overlap_add supports the last axis only")
+    frame_length, num = x.shape[-2], x.shape[-1]
+    n = frame_length + hop_length * (num - 1)
+    frames = jnp.moveaxis(x, -1, -2)        # [..., num, frame_length]
+    starts = jnp.arange(num) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    out = jnp.zeros(x.shape[:-2] + (n,), x.dtype)
+    return out.at[..., idx].add(frames)
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1):
+    """Slice overlapping frames (reference signal.py frame): output
+    [..., frame_length, num_frames]."""
+    return D("signal_frame", x, frame_length=int(frame_length),
+             hop_length=int(hop_length), axis=int(axis))
+
+
+def overlap_add(x, hop_length: int, axis: int = -1):
+    """Adjoint of frame (reference signal.py overlap_add)."""
+    return D("signal_overlap_add", x, hop_length=int(hop_length),
+             axis=int(axis))
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center=True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True):
+    """Short-time Fourier transform (reference signal.py stft):
+    real [..., n] -> complex [..., n_fft//2+1 (or n_fft), num_frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    win = _prep_window(window, win_length, n_fft)
+    if center:
+        pad = [(0, 0)] * (arr.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        arr = jnp.pad(arr, pad, mode=pad_mode)
+    frames = _frame(arr, frame_length=n_fft, hop_length=hop_length)
+    frames = frames * win[:, None]
+    frames = jnp.moveaxis(frames, -1, -2)   # [..., num, n_fft]
+    spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+        else jnp.fft.fft(frames, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return Tensor(jnp.moveaxis(spec, -1, -2))   # [..., freq, num]
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center=True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False):
+    """Inverse STFT (reference signal.py istft) with window-envelope
+    normalization (NOLA)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    spec = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    win = _prep_window(window, win_length, n_fft)
+    spec = jnp.moveaxis(spec, -2, -1)       # [..., num, freq]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided \
+        else jnp.fft.ifft(spec, axis=-1)
+    if not return_complex and jnp.iscomplexobj(frames):
+        frames = frames.real
+    frames = frames * win
+    sig = _overlap_add(jnp.moveaxis(frames, -1, -2),
+                       hop_length=hop_length)
+    env = _overlap_add(
+        jnp.broadcast_to((win * win)[:, None],
+                         (n_fft, frames.shape[-2])),
+        hop_length=hop_length)
+    sig = sig / jnp.maximum(env, 1e-11)
+    if center:
+        sig = sig[..., n_fft // 2: sig.shape[-1] - n_fft // 2]
+    if length is not None:
+        sig = sig[..., :length]
+    return Tensor(sig)

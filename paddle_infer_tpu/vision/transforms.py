@@ -90,3 +90,150 @@ class CenterCrop:
         top = (arr.shape[0] - h) // 2
         left = (arr.shape[1] - w) // 2
         return arr[top:top + h, left:left + w]
+
+
+class RandomVerticalFlip:
+    """reference transforms.RandomVerticalFlip."""
+
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[::-1].copy()
+        return img
+
+
+class Pad:
+    """reference transforms.Pad (constant/edge/reflect), HWC or HW."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, int):
+            padding = (padding, padding, padding, padding)  # l, t, r, b
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = self.padding
+        pads = [(t, b), (l, r)] + ([(0, 0)] if arr.ndim == 3 else [])
+        if self.padding_mode == "constant":
+            return np.pad(arr, pads, constant_values=self.fill)
+        return np.pad(arr, pads, mode=self.padding_mode)
+
+
+def _rgb_to_gray(arr):
+    """ITU-R 601-2 luma, HWC float in -> HW float out (shared by
+    Grayscale and ColorJitter's saturation path)."""
+    if arr.ndim == 2:
+        return arr
+    return (0.299 * arr[..., 0] + 0.587 * arr[..., 1]
+            + 0.114 * arr[..., 2])
+
+
+class Grayscale:
+    """reference transforms.Grayscale: ITU-R 601-2 luma."""
+
+    def __init__(self, num_output_channels=1):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        g = _rgb_to_gray(np.asarray(img).astype(np.float32))[..., None]
+        if self.num_output_channels == 3:
+            g = np.repeat(g, 3, axis=-1)
+        return g.astype(np.asarray(img).dtype)
+
+
+class ColorJitter:
+    """reference transforms.ColorJitter — brightness/contrast/saturation
+    (hue shift omitted: it needs an HSV round-trip the reference also
+    spends most of its cost on; not worth host-side here).  Factors are
+    drawn uniformly from [max(0, 1-v), 1+v], HWC float or uint8."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+
+    @staticmethod
+    def _factor(v):
+        return np.random.uniform(max(0.0, 1 - v), 1 + v) if v else None
+
+    def __call__(self, img):
+        arr = np.asarray(img).astype(np.float32)
+        was_uint8 = np.asarray(img).dtype == np.uint8
+        b = self._factor(self.brightness)
+        if b is not None:
+            arr = arr * b
+        c = self._factor(self.contrast)
+        if c is not None:
+            mean = arr.mean()
+            arr = (arr - mean) * c + mean
+        s = self._factor(self.saturation)
+        if s is not None and arr.ndim == 3:
+            gray = _rgb_to_gray(arr)[..., None]
+            arr = (arr - gray) * s + gray
+        if was_uint8:
+            # only uint8 has a defined value range; float images keep
+            # whatever range they came in with (0..1 OR 0..255)
+            return np.clip(arr, 0, 255).astype(np.uint8)
+        return arr
+
+
+class RandomResizedCrop:
+    """reference transforms.RandomResizedCrop: random area/aspect crop,
+    then resize."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3)):
+        self.size = size if isinstance(size, (list, tuple)) else (size,
+                                                                  size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size)
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[0], arr.shape[1]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                return self._resize(arr[top:top + ch, left:left + cw])
+        return self._resize(arr)   # fallback: whole image
+
+
+class RandomRotation:
+    """reference transforms.RandomRotation — nearest-neighbor rotation
+    about the image center (host-side numpy, like the rest)."""
+
+    def __init__(self, degrees):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        h, w = arr.shape[0], arr.shape[1]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        ys, xs = np.mgrid[0:h, 0:w]
+        c, s = np.cos(angle), np.sin(angle)
+        src_y = c * (ys - cy) + s * (xs - cx) + cy
+        src_x = -s * (ys - cy) + c * (xs - cx) + cx
+        sy = np.clip(np.round(src_y).astype(int), 0, h - 1)
+        sx = np.clip(np.round(src_x).astype(int), 0, w - 1)
+        out = arr[sy, sx]
+        inside = ((src_y >= 0) & (src_y <= h - 1)
+                  & (src_x >= 0) & (src_x <= w - 1))
+        if arr.ndim == 3:
+            inside = inside[..., None]
+        return np.where(inside, out, 0).astype(arr.dtype)

@@ -126,3 +126,64 @@ class TestResNet:
         loss2 = pit.nn.functional.cross_entropy(net(x), y)
         assert float(loss2.numpy()) != float(loss.numpy())
         assert np.isfinite(float(loss2.numpy()))
+
+
+class TestTransformsRound3:
+    """Round-3 transform batch (reference
+    python/paddle/vision/transforms/)."""
+
+    def setup_method(self, _):
+        np.random.seed(0)
+        self.img = np.random.randint(0, 255, (16, 12, 3)).astype(np.uint8)
+
+    def test_pad_and_vflip(self):
+        from paddle_infer_tpu.vision.transforms import (Pad,
+                                                        RandomVerticalFlip)
+
+        out = Pad(2)(self.img)
+        assert out.shape == (20, 16, 3)
+        assert (out[:2] == 0).all()
+        flipped = RandomVerticalFlip(prob=1.0)(self.img)
+        np.testing.assert_array_equal(flipped, self.img[::-1])
+
+    def test_grayscale(self):
+        from paddle_infer_tpu.vision.transforms import Grayscale
+
+        g1 = Grayscale()(self.img)
+        assert g1.shape == (16, 12, 1)
+        g3 = Grayscale(3)(self.img)
+        assert g3.shape == (16, 12, 3)
+        np.testing.assert_array_equal(g3[..., 0], g3[..., 1])
+
+    def test_color_jitter_bounds(self):
+        from paddle_infer_tpu.vision.transforms import ColorJitter
+
+        out = ColorJitter(brightness=0.5, contrast=0.5,
+                          saturation=0.5)(self.img)
+        assert out.dtype == np.uint8
+        assert out.shape == self.img.shape
+        assert out.min() >= 0 and out.max() <= 255
+
+    def test_random_resized_crop(self):
+        from paddle_infer_tpu.vision.transforms import RandomResizedCrop
+
+        out = RandomResizedCrop(8)(self.img)
+        assert out.shape == (8, 8, 3)
+
+    def test_rotation_identity_at_zero(self):
+        from paddle_infer_tpu.vision.transforms import RandomRotation
+
+        out = RandomRotation((0, 0))(self.img)
+        np.testing.assert_array_equal(out, self.img)
+        out90 = RandomRotation((90, 90))(self.img)
+        assert out90.shape == self.img.shape
+
+    def test_color_jitter_float_range_kept(self):
+        """Float images keep their value range (review finding: 0-255
+        floats were clipped to [0,1])."""
+        from paddle_infer_tpu.vision.transforms import ColorJitter
+
+        img = self.img.astype(np.float32)    # 0..255 float
+        out = ColorJitter(brightness=0.1)(img)
+        assert out.dtype == np.float32
+        assert out.max() > 2.0               # not crushed to [0,1]

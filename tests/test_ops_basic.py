@@ -576,3 +576,51 @@ class TestOpBreadthBatch2:
         assert np.isfinite(t.grad.numpy()).all()
         with pytest.raises(ValueError):
             pit.cdist(x, x, p=-1.0)
+
+
+class TestLRSchedulersRound3:
+    def test_multiplicative_decay(self):
+        from paddle_infer_tpu.optimizer.lr import MultiplicativeDecay
+
+        s = MultiplicativeDecay(1.0, lambda e: 0.5)
+        vals = [s()]
+        for _ in range(3):
+            s.step()
+            vals.append(s())
+        np.testing.assert_allclose(vals, [1.0, 0.5, 0.25, 0.125])
+
+    def test_cyclic_triangular(self):
+        from paddle_infer_tpu.optimizer.lr import CyclicLR
+
+        s = CyclicLR(base_learning_rate=0.1, max_learning_rate=0.5,
+                     step_size_up=4)
+        seen = [s()]
+        for _ in range(8):
+            s.step()
+            seen.append(s())
+        np.testing.assert_allclose(seen[0], 0.1)
+        np.testing.assert_allclose(seen[4], 0.5)   # peak at top of cycle
+        np.testing.assert_allclose(seen[8], 0.1)   # back to base
+        assert seen[2] == pytest.approx(0.3)
+
+    def test_cyclic_triangular2_halves(self):
+        from paddle_infer_tpu.optimizer.lr import CyclicLR
+
+        s = CyclicLR(base_learning_rate=0.0, max_learning_rate=1.0,
+                     step_size_up=2, mode="triangular2")
+        peaks = []
+        for i in range(1, 9):
+            s.step()
+            if i % 4 == 2:
+                peaks.append(s())
+        np.testing.assert_allclose(peaks, [1.0, 0.5])
+
+    def test_multiplicative_nonsequential(self):
+        """step(epoch=k) jumps and repeated reads agree (stateless)."""
+        from paddle_infer_tpu.optimizer.lr import MultiplicativeDecay
+
+        s = MultiplicativeDecay(1.0, lambda e: 0.5)
+        s.step(epoch=3)
+        assert s() == pytest.approx(0.125)
+        assert s.get_lr() == pytest.approx(0.125)
+        assert s.get_lr() == pytest.approx(0.125)

@@ -1,0 +1,69 @@
+"""signal namespace tests vs numpy/scipy references (reference:
+python/paddle/signal.py; test style test_signal.py / test_stft_op.py)."""
+import numpy as np
+import pytest
+import scipy.signal as sps
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import signal as S
+
+
+class TestFrameOverlap:
+    def test_frame_matches_manual(self):
+        x = np.arange(10, dtype=np.float32)
+        out = S.frame(x, frame_length=4, hop_length=2).numpy()
+        assert out.shape == (4, 4)
+        for j, start in enumerate(range(0, 7, 2)):
+            np.testing.assert_array_equal(out[:, j], x[start:start + 4])
+
+    def test_overlap_add_is_adjoint(self):
+        x = np.random.RandomState(0).randn(2, 12).astype(np.float32)
+        frames = S.frame(x, frame_length=4, hop_length=4)
+        rec = S.overlap_add(frames, hop_length=4).numpy()
+        np.testing.assert_allclose(rec, x, rtol=1e-6)
+
+    def test_overlap_add_sums_overlaps(self):
+        frames = np.ones((3, 2), np.float32)   # frame_length 3, 2 frames
+        out = S.overlap_add(frames, hop_length=1).numpy()
+        np.testing.assert_allclose(out, [1, 2, 2, 1])
+
+
+class TestStft:
+    def test_matches_scipy(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(512).astype(np.float32)
+        n_fft, hop = 128, 32
+        win = np.hanning(n_fft).astype(np.float32)
+        got = S.stft(x, n_fft=n_fft, hop_length=hop, window=win).numpy()
+        _, _, ref = sps.stft(x, window=win, nperseg=n_fft, noverlap=n_fft
+                             - hop, boundary="even", padded=False,
+                             return_onesided=True, scaling="spectrum")
+        # scipy scales by 1/win.sum(); paddle/librosa convention does not
+        ref = ref * win.sum()
+        assert got.shape[0] == n_fft // 2 + 1
+        n = min(got.shape[1], ref.shape[1])
+        np.testing.assert_allclose(got[:, 1:n - 1], ref[:, 1:n - 1],
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_istft_roundtrip(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1024).astype(np.float32)
+        n_fft, hop = 256, 64
+        win = np.hanning(n_fft).astype(np.float32)
+        spec = S.stft(x, n_fft=n_fft, hop_length=hop, window=win)
+        rec = S.istft(spec, n_fft=n_fft, hop_length=hop, window=win,
+                      length=1024).numpy()
+        np.testing.assert_allclose(rec, x, rtol=1e-4, atol=1e-4)
+
+    def test_batched_and_normalized(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(3, 512).astype(np.float32)
+        spec = S.stft(x, n_fft=128, normalized=True)
+        assert spec.numpy().shape[0] == 3
+        rec = S.istft(spec, n_fft=128, normalized=True,
+                      length=512).numpy()
+        np.testing.assert_allclose(rec, x, rtol=1e-4, atol=1e-4)
+
+    def test_short_signal_raises(self):
+        with pytest.raises(ValueError, match="shorter"):
+            S.frame(np.zeros(2, np.float32), frame_length=8, hop_length=4)
