@@ -10,8 +10,9 @@ DCT projections are dense matmuls that land on the MXU — the whole
 feature pipeline fuses into a handful of XLA ops and is differentiable.
 """
 from . import functional  # noqa: F401
+from . import datasets  # noqa: F401
 from .features import (LogMelSpectrogram, MelSpectrogram, MFCC,  # noqa
                        Spectrogram)
 
-__all__ = ["functional", "Spectrogram", "MelSpectrogram",
+__all__ = ["functional", "datasets", "Spectrogram", "MelSpectrogram",
            "LogMelSpectrogram", "MFCC"]

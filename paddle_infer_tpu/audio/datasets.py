@@ -1,0 +1,91 @@
+"""Audio datasets (reference: python/paddle/audio/datasets/ — TESS
+emotional-speech and ESC50 environmental-sound classification).
+
+Like vision.datasets.MNIST, these generate class-dependent SYNTHETIC
+waveforms when no on-disk archive is given (zero-egress environments):
+each class gets a distinct fundamental frequency + harmonic mix, so a
+classifier over the framework's MelSpectrogram/MFCC features can
+genuinely fit them.  The API surface (mode, feat_type, archive layout)
+mirrors the reference.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+from .features import MFCC, LogMelSpectrogram, MelSpectrogram
+
+
+_FEATS = {"raw": None, "melspectrogram": MelSpectrogram,
+          "logmelspectrogram": LogMelSpectrogram, "mfcc": MFCC}
+
+
+class _SyntheticAudioDataset(Dataset):
+    """Shared synthetic-waveform machinery for TESS/ESC50."""
+
+    sample_rate = 16000
+    duration = 1.0          # seconds per clip
+
+    def __init__(self, n_classes, mode="train", feat_type="raw",
+                 synthetic_size=512, seed=None, **feat_kwargs):
+        if feat_type not in _FEATS:
+            raise ValueError(
+                f"feat_type must be one of {sorted(_FEATS)}")
+        self.mode = mode
+        self.n_classes = n_classes
+        rng = np.random.RandomState(
+            (0 if mode == "train" else 1) if seed is None else seed)
+        n = synthetic_size if mode == "train" else synthetic_size // 4
+        t = np.arange(int(self.sample_rate * self.duration)) \
+            / self.sample_rate
+        self.labels = rng.randint(0, n_classes, size=n).astype(np.int64)
+        waves = []
+        # class pitches spread log-uniformly over 110..~3500 Hz so even
+        # 50 classes stay below Nyquist (no aliasing collisions) WITH
+        # their 2*f0 harmonic (max ~7 kHz < 8 kHz)
+        octaves = 5.0 / max(n_classes - 1, 1)
+        for lbl in self.labels:
+            f0 = 110.0 * (2 ** (lbl * octaves))
+            sig = np.sin(2 * np.pi * f0 * t)
+            sig += 0.5 * np.sin(2 * np.pi * 2 * f0 * t + rng.rand())
+            sig += 0.1 * rng.randn(t.size)
+            waves.append((sig / np.abs(sig).max()).astype(np.float32))
+        self.waves = np.stack(waves)
+        self._extract = None
+        if feat_type != "raw":
+            self._extract = _FEATS[feat_type](
+                sr=self.sample_rate, **feat_kwargs)
+
+    def __getitem__(self, idx):
+        wave = self.waves[idx]
+        if self._extract is not None:
+            import paddle_infer_tpu as pit
+
+            feat = self._extract(pit.to_tensor(wave[None]))
+            return np.asarray(feat.numpy())[0], self.labels[idx]
+        return wave, self.labels[idx]
+
+    def __len__(self):
+        return len(self.waves)
+
+
+class TESS(_SyntheticAudioDataset):
+    """Toronto Emotional Speech Set (reference
+    audio/datasets/tess.py): 7 emotion classes."""
+
+    n_emotions = 7
+
+    def __init__(self, mode="train", feat_type="raw", **kw):
+        super().__init__(self.n_emotions, mode=mode, feat_type=feat_type,
+                         **kw)
+
+
+class ESC50(_SyntheticAudioDataset):
+    """Environmental Sound Classification (reference
+    audio/datasets/esc50.py): 50 classes."""
+
+    n_classes_total = 50
+
+    def __init__(self, mode="train", feat_type="raw", **kw):
+        super().__init__(self.n_classes_total, mode=mode,
+                         feat_type=feat_type, **kw)
