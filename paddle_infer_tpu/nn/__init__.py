@@ -1,6 +1,7 @@
 """paddle_infer_tpu.nn — layers and functional API
 (reference: python/paddle/nn/)."""
 from .layer import Layer
+from .layers_extra import *  # noqa: F401,F403
 from . import functional
 from . import initializer
 from .layers_common import (  # noqa: F401

@@ -539,3 +539,87 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k=None,
 
 
 # the fused "sdpa" op itself is registered in ops/attention.py
+
+
+# ---- round-3 nD / misc batch (reference nn/functional/*)
+
+def max_pool1d(x, kernel_size, stride=None, padding=0):
+    return D("max_pool1d", x, kernel_size=_t(kernel_size),
+             stride=_t(stride) if stride is not None else None,
+             padding=_t(padding))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0):
+    return D("avg_pool1d", x, kernel_size=_t(kernel_size),
+             stride=_t(stride) if stride is not None else None,
+             padding=_t(padding))
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0):
+    return D("max_pool3d", x, kernel_size=_t(kernel_size),
+             stride=_t(stride) if stride is not None else None,
+             padding=_t(padding))
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0):
+    return D("avg_pool3d", x, kernel_size=_t(kernel_size),
+             stride=_t(stride) if stride is not None else None,
+             padding=_t(padding))
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1):
+    return D("conv1d_transpose", x, weight, bias, stride=_t(stride),
+             padding=_t(padding), output_padding=_t(output_padding),
+             dilation=_t(dilation), groups=groups)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1):
+    return D("conv3d_transpose", x, weight, bias, stride=_t(stride),
+             padding=_t(padding), output_padding=_t(output_padding),
+             dilation=_t(dilation), groups=groups)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    return D("local_response_norm", x, size=int(size), alpha=float(alpha),
+             beta=float(beta), k=float(k))
+
+
+def log_sigmoid(x):
+    # -softplus(-x), numerically stable
+    return D("scale", D("softplus", D("scale", x, scale=-1.0)), scale=-1.0)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = D("sum", D("multiply", x1, x2), axis=axis, keepdim=False)
+    n1 = D("sqrt", D("sum", D("multiply", x1, x1), axis=axis,
+                     keepdim=False))
+    n2 = D("sqrt", D("sum", D("multiply", x2, x2), axis=axis,
+                     keepdim=False))
+    denom = D("maximum", D("multiply", n1, n2), eps)
+    return D("divide", dot, denom)
+
+
+def pixel_shuffle(x, upscale_factor):
+    r = int(upscale_factor)
+    b, c, h, w = x.shape
+    x = D("reshape", x, shape=(b, c // (r * r), r, r, h, w))
+    x = D("transpose", x, perm=(0, 1, 4, 2, 5, 3))
+    return D("reshape", x, shape=(b, c // (r * r), h * r, w * r))
+
+
+def pixel_unshuffle(x, downscale_factor):
+    r = int(downscale_factor)
+    b, c, h, w = x.shape
+    x = D("reshape", x, shape=(b, c, h // r, r, w // r, r))
+    x = D("transpose", x, perm=(0, 1, 3, 5, 2, 4))
+    return D("reshape", x, shape=(b, c * r * r, h // r, w // r))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1):
+    pair = lambda v: tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+    return D("fold_col2im", x, output_sizes=pair(output_sizes),
+             kernel_sizes=pair(kernel_sizes), strides=pair(strides),
+             paddings=pair(paddings), dilations=pair(dilations))

@@ -311,6 +311,8 @@ class Upsample(Layer):
 class Pad2D(Layer):
     def __init__(self, padding, mode="constant", value=0.0):
         super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * 4          # (left, right, top, bottom)
         self.padding, self.mode, self.value = padding, mode, value
 
     def forward(self, x):

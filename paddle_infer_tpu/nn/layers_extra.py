@@ -1,0 +1,387 @@
+"""Round-3 layer-breadth batch (reference: python/paddle/nn/layer/ —
+conv.py Conv3D/Conv{1,3}DTranspose, pooling.py 1-D/3-D pools, norm.py
+InstanceNorm1D/SpectralNorm/LocalResponseNorm, vision.py PixelShuffle,
+common.py Pad/Identity/Bilinear/CosineSimilarity/Unfold/Fold,
+distance.py PairwiseDistance).
+
+All forwards are thin dispatches onto registry ops, so they trace into
+fleet/jit/IR programs like every other layer.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch as D
+from ..core.tensor import Tensor
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+from .layers_common import InstanceNorm2D, Pad2D
+
+__all__ = [
+    "Conv3D", "Conv1DTranspose", "Conv3DTranspose", "MaxPool1D",
+    "AvgPool1D", "MaxPool3D", "AvgPool3D", "InstanceNorm1D",
+    "SpectralNorm", "LocalResponseNorm", "PixelShuffle", "PixelUnshuffle",
+    "Pad1D", "Pad3D", "ZeroPad2D", "CosineSimilarity",
+    "PairwiseDistance", "Bilinear", "Unfold", "Fold", "Identity",
+    "AlphaDropout", "Dropout3D", "LogSigmoid", "UpsamplingBilinear2D",
+    "EmbeddingBag",
+]
+
+
+class Conv3D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size,) * 3
+        self.stride, self.padding = stride, padding
+        self.dilation, self.groups = dilation, groups
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + tuple(ks),
+            attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True))
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, stride=self.stride,
+                        padding=self.padding, dilation=self.dilation,
+                        groups=self.groups)
+
+
+class _ConvTransposeNd(Layer):
+    _nd = 1
+    _fn = staticmethod(F.conv1d_transpose)
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size,) * self._nd
+        self.stride, self.padding = stride, padding
+        self.output_padding, self.dilation = output_padding, dilation
+        self.groups = groups
+        # IO<spatial> layout (paddle conv_transpose convention)
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups) + tuple(ks),
+            attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True))
+
+    def forward(self, x):
+        return self._fn(x, self.weight, self.bias, stride=self.stride,
+                        padding=self.padding,
+                        output_padding=self.output_padding,
+                        dilation=self.dilation, groups=self.groups)
+
+
+class Conv1DTranspose(_ConvTransposeNd):
+    _nd = 1
+    _fn = staticmethod(F.conv1d_transpose)
+
+
+class Conv3DTranspose(_ConvTransposeNd):
+    _nd = 3
+    _fn = staticmethod(F.conv3d_transpose)
+
+
+class _PoolNd(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0):
+        super().__init__()
+        self.kernel_size, self.stride = kernel_size, stride
+        self.padding = padding
+
+    def forward(self, x):
+        return type(self)._fn(x, self.kernel_size, self.stride,
+                              self.padding)
+
+
+class MaxPool1D(_PoolNd):
+    _fn = staticmethod(F.max_pool1d)
+
+
+class AvgPool1D(_PoolNd):
+    _fn = staticmethod(F.avg_pool1d)
+
+
+class MaxPool3D(_PoolNd):
+    _fn = staticmethod(F.max_pool3d)
+
+
+class AvgPool3D(_PoolNd):
+    _fn = staticmethod(F.avg_pool3d)
+
+
+class InstanceNorm1D(InstanceNorm2D):
+    """instance_norm is rank-generic; the 1-D layer is API surface."""
+
+
+class SpectralNorm(Layer):
+    """reference nn/layer/norm.py SpectralNorm: power-iteration estimate
+    of the top singular value; ``forward(weight)`` returns weight/sigma.
+    The u/v vectors are buffers updated in train mode."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        import numpy as np
+
+        rng = np.random.RandomState(0)
+        self.register_buffer("weight_u", Tensor(
+            jnp.asarray(rng.randn(h).astype(np.float32))))
+        self.register_buffer("weight_v", Tensor(
+            jnp.asarray(rng.randn(w).astype(np.float32))))
+
+    def forward(self, weight):
+        w = weight if isinstance(weight, Tensor) else Tensor(weight)
+        # power iteration on detached data (the buffers' update never
+        # carries gradient, matching the reference)
+        wa = jax.lax.stop_gradient(w._data)
+        mat = jnp.moveaxis(wa, self.dim, 0).reshape(wa.shape[self.dim], -1)
+        u, v = self.weight_u._data, self.weight_v._data
+        for _ in range(self.power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        if self.training:
+            self.weight_u._data = u
+            self.weight_v._data = v
+        # sigma recomputed THROUGH the tape so d(w/sigma)/dw includes
+        # sigma's dependence on w (u, v fixed)
+        perm = (self.dim,) + tuple(i for i in range(w.ndim)
+                                   if i != self.dim)
+        wmat = D("reshape", D("transpose", w, perm=perm),
+                 shape=(w.shape[self.dim], -1))
+        sigma = D("matmul", D("matmul", Tensor(u[None, :]), wmat),
+                  Tensor(v[:, None]))          # [1, 1]
+        sigma = D("reshape", sigma, shape=(1,) * w.ndim)
+        return D("divide", w, sigma)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, x):
+        return F.local_response_norm(x, self.size, self.alpha, self.beta,
+                                     self.k)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor)
+
+
+class _PadNd(Layer):
+    _nd = 1
+
+    def __init__(self, padding, mode="constant", value=0.0):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * (2 * self._nd)
+        self.padding = list(padding)
+        self.mode = mode
+        self.value = value
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode=self.mode, value=self.value)
+
+
+class Pad1D(_PadNd):
+    _nd = 1
+
+
+class Pad3D(_PadNd):
+    _nd = 3
+
+
+class ZeroPad2D(Pad2D):
+    """Subclasses the canonical nn.Pad2D (layers_common) so isinstance
+    walks see one Pad2D type."""
+
+    def __init__(self, padding):
+        super().__init__(padding, mode="constant", value=0.0)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class PairwiseDistance(Layer):
+    """reference nn/layer/distance.py: p-norm of x-y along the last
+    axis."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        diff = D("add", D("subtract", x, y), self.epsilon)
+        a = D("abs", diff)
+        s = D("sum", D("pow", a, float(self.p)), axis=-1,
+              keepdim=self.keepdim)
+        return D("pow", s, 1.0 / float(self.p))
+
+
+class Bilinear(Layer):
+    """out[b, o] = x1[b, :] @ W[o] @ x2[b, :] + bias (reference
+    nn/layer/common.py Bilinear) — one einsum on the MXU."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features), attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (out_features,), attr=bias_attr, is_bias=True))
+
+    def forward(self, x1, x2):
+        out = D("einsum_op", x1, self.weight, x2, equation="bi,oij,bj->bo")
+        if self.bias is not None:
+            out = D("add", out, self.bias)
+        return out
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        self.kernel_sizes, self.strides = kernel_sizes, strides
+        self.paddings, self.dilations = paddings, dilations
+
+    def forward(self, x):
+        return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
+                        self.dilations)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1):
+        super().__init__()
+        self.output_sizes, self.kernel_sizes = output_sizes, kernel_sizes
+        self.strides, self.paddings = strides, paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes,
+                      self.strides, self.paddings, self.dilations)
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class AlphaDropout(Layer):
+    """SELU-consistent dropout (reference nn/layer/common.py
+    AlphaDropout): dropped units take the negative saturation value and
+    the output is affinely rescaled to preserve mean/variance."""
+
+    _ALPHA_P = -1.7580993408473766  # -alpha * scale of SELU
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        from ..core import random as prandom
+
+        p = self.p
+        a = ((1 - p) * (1 + p * self._ALPHA_P ** 2)) ** -0.5
+        b = -a * p * self._ALPHA_P
+        mask = jax.random.bernoulli(prandom.next_key(), 1 - p,
+                                    tuple(x.shape))
+        keep = Tensor(mask.astype(x._data.dtype))   # gradless const
+        out = D("add",
+                D("multiply", x, keep),
+                D("scale", D("subtract", 1.0, keep),
+                  scale=self._ALPHA_P))
+        return D("add", D("scale", out, scale=a), b)
+
+
+class Dropout3D(Layer):
+    """Whole-channel dropout over NCDHW (reference Dropout3D)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        from ..core import random as prandom
+
+        key = prandom.next_key()
+        return D("dropout", x, Tensor(key), p=float(self.p), upscale=True,
+                 bcast_dims=(2, 3, 4))
+
+
+class LogSigmoid(Layer):
+    def forward(self, x):
+        return F.log_sigmoid(x)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor,
+                             mode="bilinear", align_corners=True)
+
+
+class EmbeddingBag(Layer):
+    """Lookup + per-bag reduction in one traced program (reference
+    incubate _embedding_bag; bags are rows of a [B, L] id matrix)."""
+
+    def __init__(self, num_embeddings, embedding_dim, mode="mean",
+                 weight_attr=None):
+        super().__init__()
+        if mode not in ("mean", "sum", "max"):
+            raise ValueError(f"unsupported mode {mode!r}")
+        self.mode = mode
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        self._reduce = mode        # validated above; op names coincide
+
+    def forward(self, ids):
+        emb = D("gather", self.weight, ids, axis=0)   # [B, L, D]
+        return D(self._reduce, emb, axis=1, keepdim=False)
+
+
+import jax  # noqa: E402  (SpectralNorm stop_gradient)

@@ -330,3 +330,176 @@ def _unfold_im2col(x, kernel_sizes, strides=1, paddings=0, dilations=1):
 
 
 register_vjp_grad("unfold_im2col")
+
+
+# ---- round-3 nD pool / transpose batch (reference pool2d/pool3d kernels,
+# conv{2,3}d_transpose; phi/kernels/impl/pool_kernel_impl.h)
+
+def _tup(v, n):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+def _pool_nd(x, kernel_size, stride, padding, nd, reducer, init):
+    ks = _tup(kernel_size, nd)
+    st = _tup(stride if stride is not None else kernel_size, nd)
+    pad = _tup(padding, nd)
+    pad_cfg = [(0, 0), (0, 0)] + [(p, p) for p in pad]
+    return lax.reduce_window(
+        x, init, reducer, window_dimensions=(1, 1) + ks,
+        window_strides=(1, 1) + st, padding=pad_cfg)
+
+
+def _max_init(x):
+    return -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+
+
+@register_op("max_pool1d")
+def _max_pool1d(x, kernel_size, stride=None, padding=0):
+    return _pool_nd(x, kernel_size, stride, padding, 1, lax.max,
+                    _max_init(x))
+
+
+def _avg_pool_nd(x, kernel_size, stride, padding, nd):
+    """Exclusive counting (paddle's default): padded positions are not
+    counted in the divisor, matching avg_pool2d's behavior."""
+    summed = _pool_nd(x, kernel_size, stride, padding, nd, lax.add, 0.0)
+    pad = _tup(padding, nd)
+    if all(p == 0 for p in pad):
+        ks = _tup(kernel_size, nd)
+        vol = 1
+        for k in ks:
+            vol *= k
+        return summed / vol
+    counts = _pool_nd(jnp.ones_like(x), kernel_size, stride, padding, nd,
+                      lax.add, 0.0)
+    return summed / counts
+
+
+@register_op("avg_pool1d")
+def _avg_pool1d(x, kernel_size, stride=None, padding=0):
+    return _avg_pool_nd(x, kernel_size, stride, padding, 1)
+
+
+@register_op("max_pool3d")
+def _max_pool3d(x, kernel_size, stride=None, padding=0):
+    return _pool_nd(x, kernel_size, stride, padding, 3, lax.max,
+                    _max_init(x))
+
+
+@register_op("avg_pool3d")
+def _avg_pool3d(x, kernel_size, stride=None, padding=0):
+    return _avg_pool_nd(x, kernel_size, stride, padding, 3)
+
+
+for _name in ("max_pool1d", "avg_pool1d", "max_pool3d", "avg_pool3d"):
+    register_vjp_grad(_name)
+
+
+@register_op("conv1d_transpose")
+def _conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                      output_padding=0, dilation=1, groups=1):
+    """[N,C,L] transposed conv by riding the 2-D kernel with a unit
+    height (weight IOK -> IO1K)."""
+    def one(v):
+        return v[0] if isinstance(v, (list, tuple)) else v
+
+    out = _conv2d_transpose(
+        x[:, :, None, :], weight[:, :, None, :], None,
+        stride=(1, one(stride)), padding=(0, one(padding)),
+        output_padding=(0, one(output_padding)),
+        dilation=(1, one(dilation)), groups=groups)
+    out = out[:, :, 0]
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1)
+    return out
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                      output_padding=0, dilation=1, groups=1):
+    """NCDHW transposed conv (weight IODHW), same input-dilated-conv
+    construction as the 2-D path."""
+    stride = _tup(stride, 3)
+    dilation = _tup(dilation, 3)
+    pad = _tup(padding, 3)
+    op_pad = _tup(output_padding, 3)
+    kd = [(weight.shape[2 + i] - 1) * dilation[i] + 1 for i in range(3)]
+    pad_cfg = [(kd[i] - 1 - pad[i], kd[i] - 1 - pad[i] + op_pad[i])
+               for i in range(3)]
+    if groups != 1:
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(weight, groups, axis=0)
+        outs = [_deconv3_single(xi, wi, stride, pad_cfg, dilation)
+                for xi, wi in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        out = _deconv3_single(x, weight, stride, pad_cfg, dilation)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+def _deconv3_single(x, w, stride, pad_cfg, dilation):
+    w_flip = jnp.flip(w, axis=(2, 3, 4))       # IODHW
+    w_t = jnp.swapaxes(w_flip, 0, 1)           # OIDHW
+    dn = lax.conv_dimension_numbers(x.shape, w_t.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    return lax.conv_general_dilated(
+        x, w_t, window_strides=(1, 1, 1), padding=pad_cfg,
+        lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+        precision=_prec(x))
+
+
+for _name in ("conv1d_transpose", "conv3d_transpose"):
+    register_vjp_grad(_name)
+
+
+@register_op("local_response_norm")
+def _local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    """Across-channel LRN (reference lrn op): one reduce_window over the
+    channel axis.  Paddle semantics: alpha scales the window MEAN of
+    squares (its implementation avg-pools), i.e. k + alpha*sum/size."""
+    sq = x * x
+    lo = (size - 1) // 2
+    hi = size - 1 - lo
+    acc = lax.reduce_window(
+        sq, 0.0, lax.add,
+        window_dimensions=(1, size) + (1,) * (x.ndim - 2),
+        window_strides=(1,) * x.ndim,
+        padding=[(0, 0), (lo, hi)] + [(0, 0)] * (x.ndim - 2))
+    return x / (k + alpha * acc / size) ** beta
+
+
+register_vjp_grad("local_response_norm")
+
+
+@register_op("fold_col2im")
+def _fold(x, *, output_sizes, kernel_sizes, strides, paddings, dilations):
+    """col2im, the adjoint of unfold (reference fold op): x is
+    [N, C*kh*kw, L] -> [N, C, H, W] with overlapping patches summed."""
+    n, ckk, num = x.shape
+    kh, kw = kernel_sizes
+    c = ckk // (kh * kw)
+    oh, ow = output_sizes
+    sh, sw = strides
+    ph, pw = paddings
+    dh, dw = dilations
+    out_h = oh + 2 * ph
+    out_w = ow + 2 * pw
+    nh = (out_h - (dh * (kh - 1) + 1)) // sh + 1
+    nw = (out_w - (dw * (kw - 1) + 1)) // sw + 1
+    cols = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, out_h, out_w), x.dtype)
+    ys = (jnp.arange(nh) * sh)[:, None, None, None] \
+        + (jnp.arange(kh) * dh)[None, None, :, None]
+    xs = (jnp.arange(nw) * sw)[None, :, None, None] \
+        + (jnp.arange(kw) * dw)[None, None, None, :]
+    ys = jnp.broadcast_to(ys, (nh, nw, kh, kw)).reshape(-1)
+    xs = jnp.broadcast_to(xs, (nh, nw, kh, kw)).reshape(-1)
+    vals = cols.transpose(0, 1, 4, 5, 2, 3).reshape(n, c, -1)
+    out = out.at[:, :, ys, xs].add(vals)
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+register_vjp_grad("fold_col2im")

@@ -1,0 +1,180 @@
+"""Round-3 layer-breadth batch tests (reference nn/layer/*)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import nn
+
+
+def _t(shape, seed=0):
+    return pit.to_tensor(np.random.RandomState(seed).randn(
+        *shape).astype(np.float32))
+
+
+class TestConvPoolNd:
+    def test_conv3d(self):
+        m = nn.Conv3D(2, 4, 3, padding=1)
+        out = m(_t((1, 2, 4, 4, 4)))
+        assert list(out.shape) == [1, 4, 4, 4, 4]
+
+    def test_conv1d_transpose_inverts_stride(self):
+        m = nn.Conv1DTranspose(3, 2, 4, stride=2, padding=1)
+        out = m(_t((1, 3, 8)))
+        assert list(out.shape) == [1, 2, 16]
+
+    def test_conv3d_transpose(self):
+        m = nn.Conv3DTranspose(2, 3, 2, stride=2)
+        out = m(_t((1, 2, 3, 3, 3)))
+        assert list(out.shape) == [1, 3, 6, 6, 6]
+
+    def test_pools(self):
+        x1 = _t((1, 2, 8))
+        assert list(nn.MaxPool1D(2)(x1).shape) == [1, 2, 4]
+        assert list(nn.AvgPool1D(2)(x1).shape) == [1, 2, 4]
+        x3 = _t((1, 2, 4, 4, 4))
+        assert list(nn.MaxPool3D(2)(x3).shape) == [1, 2, 2, 2, 2]
+        out = nn.AvgPool3D(2)(pit.to_tensor(np.ones(
+            (1, 1, 2, 2, 2), np.float32)))
+        np.testing.assert_allclose(out.numpy(), np.ones((1, 1, 1, 1, 1)))
+
+
+class TestNorms:
+    def test_instance_norm1d(self):
+        m = nn.InstanceNorm1D(3)
+        out = m(_t((2, 3, 16))).numpy()
+        np.testing.assert_allclose(out.mean(axis=2), 0, atol=1e-5)
+        np.testing.assert_allclose(out.std(axis=2), 1, atol=1e-2)
+
+    def test_local_response_norm(self):
+        x = np.abs(np.random.RandomState(0).randn(
+            1, 6, 3, 3)).astype(np.float32)
+        out = nn.LocalResponseNorm(3, alpha=1e-2, beta=0.5, k=1.0)(
+            pit.to_tensor(x)).numpy()
+        # manual reference at channel 2
+        acc = (x[:, 1] ** 2 + x[:, 2] ** 2 + x[:, 3] ** 2)
+        ref = x[:, 2] / np.sqrt(1.0 + 1e-2 * acc / 3)   # alpha * mean
+        np.testing.assert_allclose(out[:, 2], ref, rtol=1e-5)
+
+    def test_spectral_norm(self):
+        m = nn.SpectralNorm((4, 6), power_iters=20)
+        m.train()
+        w = _t((4, 6), seed=3)
+        wn = m(w)
+        s = np.linalg.svd(wn.numpy(), compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+        # differentiable through the tape
+        w.stop_gradient = False
+        m(w).sum().backward()
+        assert np.isfinite(w.grad.numpy()).all()
+
+
+class TestShapeLayers:
+    def test_pixel_shuffle_roundtrip(self):
+        x = _t((1, 8, 3, 3))
+        up = nn.PixelShuffle(2)(x)
+        assert list(up.shape) == [1, 2, 6, 6]
+        back = nn.PixelUnshuffle(2)(up)
+        np.testing.assert_allclose(back.numpy(), x.numpy())
+
+    def test_pad2d_int_and_isinstance(self):
+        """nn.Pad2D accepts an int and ZeroPad2D is a Pad2D (review
+        finding: the star-import shadowing broke both)."""
+        x = _t((1, 2, 4, 4))
+        out = nn.Pad2D(3)(x)
+        assert list(out.shape) == [1, 2, 10, 10]
+        assert isinstance(nn.ZeroPad2D(1), nn.Pad2D)
+
+    def test_avg_pool_exclusive_counting(self):
+        """Padded positions excluded from the divisor (paddle default)."""
+        x = pit.to_tensor(np.ones((1, 1, 4), np.float32))
+        out = nn.AvgPool1D(3, stride=1, padding=1)(x).numpy()
+        np.testing.assert_allclose(out[0, 0], [1.0, 1.0, 1.0, 1.0])
+
+    def test_pads(self):
+        x = _t((1, 2, 4))
+        assert list(nn.Pad1D([1, 2])(x).shape) == [1, 2, 7]
+        x2 = _t((1, 2, 4, 4))
+        assert list(nn.ZeroPad2D(1)(x2).shape) == [1, 2, 6, 6]
+        x3 = _t((1, 2, 3, 3, 3))
+        assert list(nn.Pad3D(1)(x3).shape) == [1, 2, 5, 5, 5]
+
+    def test_unfold_fold_roundtrip(self):
+        x = _t((2, 3, 6, 6))
+        u = nn.Unfold(kernel_sizes=2, strides=2)(x)
+        back = nn.Fold((6, 6), kernel_sizes=2, strides=2)(u)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-6)
+
+    def test_identity_and_upsample(self):
+        x = _t((1, 2, 4, 4))
+        assert nn.Identity()(x) is x
+        out = nn.UpsamplingBilinear2D(scale_factor=2)(x)
+        assert list(out.shape) == [1, 2, 8, 8]
+
+
+class TestMiscLayers:
+    def test_cosine_similarity(self):
+        a = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        b = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+        out = nn.CosineSimilarity(axis=1)(pit.to_tensor(a),
+                                          pit.to_tensor(b)).numpy()
+        ref = (a * b).sum(1) / (np.linalg.norm(a, axis=1)
+                                * np.linalg.norm(b, axis=1))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_pairwise_distance(self):
+        a = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        b = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+        out = nn.PairwiseDistance(p=2.0)(pit.to_tensor(a),
+                                         pit.to_tensor(b)).numpy()
+        ref = np.linalg.norm(a - b + 1e-6, axis=1)
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    def test_bilinear(self):
+        m = nn.Bilinear(3, 4, 2)
+        x1, x2 = _t((5, 3)), _t((5, 4), seed=1)
+        out = m(x1, x2).numpy()
+        w = np.asarray(m.weight.numpy())
+        ref = np.einsum("bi,oij,bj->bo", x1.numpy(), w, x2.numpy()) \
+            + m.bias.numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_alpha_dropout_stats(self):
+        pit.seed(0)
+        m = nn.AlphaDropout(p=0.3)
+        m.train()
+        x = _t((4096,))
+        out = m(x).numpy()
+        # mean/var approximately preserved (SELU self-normalizing prop)
+        assert abs(out.mean() - x.numpy().mean()) < 0.1
+        assert abs(out.std() - x.numpy().std()) < 0.15
+        m.eval()
+        np.testing.assert_allclose(m(x).numpy(), x.numpy())
+
+    def test_dropout3d_whole_channels(self):
+        pit.seed(0)
+        m = nn.Dropout3D(p=0.5)
+        m.train()
+        x = pit.to_tensor(np.ones((2, 8, 3, 3, 3), np.float32))
+        out = m(x).numpy()
+        # each channel either fully zero or fully scaled
+        per_chan = out.reshape(2, 8, -1)
+        for b in range(2):
+            for c in range(8):
+                vals = np.unique(per_chan[b, c])
+                assert len(vals) == 1
+
+    def test_log_sigmoid(self):
+        x = np.random.RandomState(0).randn(16).astype(np.float32)
+        out = nn.LogSigmoid()(pit.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out, np.log(1 / (1 + np.exp(-x))),
+                                   rtol=1e-4, atol=1e-6)
+
+    def test_embedding_bag(self):
+        m = nn.EmbeddingBag(10, 4, mode="mean")
+        ids = np.asarray([[1, 2, 3], [4, 4, 4]], np.int32)
+        out = m(pit.to_tensor(ids)).numpy()
+        w = m.weight.numpy()
+        np.testing.assert_allclose(out[0], w[[1, 2, 3]].mean(0),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out[1], w[4], rtol=1e-5)
