@@ -593,12 +593,12 @@ def log_sigmoid(x):
 
 def cosine_similarity(x1, x2, axis=1, eps=1e-8):
     dot = D("sum", D("multiply", x1, x2), axis=axis, keepdim=False)
-    n1 = D("sqrt", D("sum", D("multiply", x1, x1), axis=axis,
-                     keepdim=False))
-    n2 = D("sqrt", D("sum", D("multiply", x2, x2), axis=axis,
-                     keepdim=False))
-    denom = D("maximum", D("multiply", n1, n2), eps)
-    return D("divide", dot, denom)
+    # eps inside the sqrt keeps zero rows' gradients finite
+    n1 = D("sqrt", D("add", D("sum", D("multiply", x1, x1), axis=axis,
+                              keepdim=False), eps * eps))
+    n2 = D("sqrt", D("add", D("sum", D("multiply", x2, x2), axis=axis,
+                              keepdim=False), eps * eps))
+    return D("divide", dot, D("multiply", n1, n2))
 
 
 def pixel_shuffle(x, upscale_factor):
@@ -623,3 +623,77 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
     return D("fold_col2im", x, output_sizes=pair(output_sizes),
              kernel_sizes=pair(kernel_sizes), strides=pair(strides),
              paddings=pair(paddings), dilations=pair(dilations))
+
+
+# ---- round-3 loss batch (reference nn/functional/loss.py)
+
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return D("mean", loss)
+    if reduction == "sum":
+        return D("sum", loss)
+    if reduction == "none":
+        return loss
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """reference: F.ctc_loss over the warpctc op — here a compiled
+    lax.scan alpha recursion (ops/loss.py ctc_loss_op)."""
+    loss = D("ctc_loss_op", log_probs, labels, input_lengths,
+             label_lengths, blank=int(blank))
+    if norm_by_times:
+        lens = input_lengths if isinstance(input_lengths, Tensor) \
+            else Tensor(jnp.asarray(input_lengths))
+        loss = D("divide", loss, D("cast", lens, dtype="float32"))
+    return _reduce_loss(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0,
+                        reduction="mean"):
+    return _reduce_loss(D("margin_ranking_loss_op", input, other, label,
+                          margin=float(margin)), reduction)
+
+
+def soft_margin_loss(input, label, reduction="mean"):
+    return _reduce_loss(D("soft_margin_loss_op", input, label), reduction)
+
+
+def square_error_cost(input, label):
+    return D("square_error_cost", input, label)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return D("log_loss_op", input, label, epsilon=float(epsilon))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    return _reduce_loss(D("hinge_embedding_loss_op", input, label,
+                          margin=float(margin)), reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    return _reduce_loss(D("cosine_embedding_loss_op", input1, input2,
+                          label, margin=float(margin)), reduction)
+
+
+def triplet_margin_loss(anchor, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, reduction="mean"):
+    return _reduce_loss(
+        D("triplet_margin_loss_op", anchor, positive, negative,
+          margin=float(margin), p=float(p), epsilon=float(epsilon)),
+        reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum"):
+    return _reduce_loss(D("sigmoid_focal_loss_op", logit, label,
+                          normalizer, alpha=float(alpha),
+                          gamma=float(gamma)), reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    return D("mean", D("dice_loss_op", input, label,
+                       epsilon=float(epsilon)))

@@ -377,11 +377,85 @@ class EmbeddingBag(Layer):
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim), attr=weight_attr,
             default_initializer=I.Normal(0.0, 0.02))
-        self._reduce = mode        # validated above; op names coincide
 
     def forward(self, ids):
         emb = D("gather", self.weight, ids, axis=0)   # [B, L, D]
-        return D(self._reduce, emb, axis=1, keepdim=False)
+        return D(self.mode, emb, axis=1, keepdim=False)
 
 
 import jax  # noqa: E402  (SpectralNorm stop_gradient)
+
+
+class CTCLoss(Layer):
+    """reference nn/layer/loss.py CTCLoss over the warpctc op."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths,
+                          label_lengths, blank=self.blank,
+                          reduction=self.reduction,
+                          norm_by_times=norm_by_times)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, other, label):
+        return F.margin_ranking_loss(input, other, label,
+                                     margin=self.margin,
+                                     reduction=self.reduction)
+
+
+class HingeEmbeddingLoss(Layer):
+    def __init__(self, margin=1.0, reduction="mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, label):
+        return F.hinge_embedding_loss(input, label, margin=self.margin,
+                                      reduction=self.reduction)
+
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input1, input2, label):
+        return F.cosine_embedding_loss(input1, input2, label,
+                                       margin=self.margin,
+                                       reduction=self.reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6,
+                 reduction="mean"):
+        super().__init__()
+        self.margin, self.p = margin, p
+        self.epsilon, self.reduction = epsilon, reduction
+
+    def forward(self, anchor, positive, negative):
+        return F.triplet_margin_loss(anchor, positive, negative,
+                                     margin=self.margin, p=self.p,
+                                     epsilon=self.epsilon,
+                                     reduction=self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label,
+                                  reduction=self.reduction)
+
+
+__all__ += ["CTCLoss", "MarginRankingLoss", "HingeEmbeddingLoss",
+            "CosineEmbeddingLoss", "TripletMarginLoss", "SoftMarginLoss"]

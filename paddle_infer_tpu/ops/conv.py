@@ -360,12 +360,13 @@ def _max_pool1d(x, kernel_size, stride=None, padding=0):
                     _max_init(x))
 
 
-def _avg_pool_nd(x, kernel_size, stride, padding, nd):
-    """Exclusive counting (paddle's default): padded positions are not
-    counted in the divisor, matching avg_pool2d's behavior."""
+def _avg_pool_nd(x, kernel_size, stride, padding, nd, exclusive):
+    """``exclusive=True`` (paddle's pooling default) leaves padded
+    positions out of the divisor; ``exclusive=False`` divides by the
+    full kernel volume (== avg_pool2d's count_include_pad=True)."""
     summed = _pool_nd(x, kernel_size, stride, padding, nd, lax.add, 0.0)
     pad = _tup(padding, nd)
-    if all(p == 0 for p in pad):
+    if not exclusive or all(p == 0 for p in pad):
         ks = _tup(kernel_size, nd)
         vol = 1
         for k in ks:
@@ -377,8 +378,8 @@ def _avg_pool_nd(x, kernel_size, stride, padding, nd):
 
 
 @register_op("avg_pool1d")
-def _avg_pool1d(x, kernel_size, stride=None, padding=0):
-    return _avg_pool_nd(x, kernel_size, stride, padding, 1)
+def _avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True):
+    return _avg_pool_nd(x, kernel_size, stride, padding, 1, exclusive)
 
 
 @register_op("max_pool3d")
@@ -388,8 +389,8 @@ def _max_pool3d(x, kernel_size, stride=None, padding=0):
 
 
 @register_op("avg_pool3d")
-def _avg_pool3d(x, kernel_size, stride=None, padding=0):
-    return _avg_pool_nd(x, kernel_size, stride, padding, 3)
+def _avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True):
+    return _avg_pool_nd(x, kernel_size, stride, padding, 3, exclusive)
 
 
 for _name in ("max_pool1d", "avg_pool1d", "max_pool3d", "avg_pool3d"):

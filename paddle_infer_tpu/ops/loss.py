@@ -109,3 +109,153 @@ def _nll(log_probs, label, ignore_index=-100):
 
 
 register_vjp_grad("nll_loss_op")
+
+
+# ---- round-3 loss batch (reference: warpctc_op / ctc_loss, margin and
+# embedding losses in python/paddle/nn/functional/loss.py)
+
+@register_op("ctc_loss_op")
+def _ctc_loss(log_probs, labels, input_lengths, label_lengths, *,
+              blank=0):
+    """CTC loss via the log-domain alpha recursion as one ``lax.scan``
+    over time (reference: warpctc kernel, operators/warpctc_op.*; here
+    the recursion is a compiled static-shape program — no warp-ctc
+    library, XLA derives the beta/backward pass by AD through the scan).
+
+    log_probs: [T, B, C] log-softmax outputs; labels: [B, L] padded;
+    returns per-sample negative log likelihood [B]."""
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    NEG = -1e30
+
+    labels = labels.astype(jnp.int32)
+    # extended sequence: blank, l1, blank, l2, ... blank  [B, S]
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    s_idx = jnp.arange(S)
+    in_label = (s_idx % 2) == 1
+    # skip transition allowed where ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = in_label[None, :] & (ext != ext_m2)
+    # positions beyond this sample's 2*len+1 are invalid
+    valid = s_idx[None, :] < (2 * label_lengths[:, None] + 1)
+
+    def emit(t_probs):        # [B, C] -> [B, S] log p of ext symbol
+        return jnp.take_along_axis(t_probs, ext, axis=-1)
+
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(log_probs[0, jnp.arange(B), blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_lengths > 0,
+                  log_probs[0, jnp.arange(B), labels[:, 0]], NEG))
+    alpha0 = jnp.where(valid, alpha0, NEG)
+
+    def step(alpha, t):
+        prev1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                        constant_values=NEG)[:, :S]
+        prev2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                        constant_values=NEG)[:, :S]
+        stacked = jnp.stack(
+            [alpha, prev1, jnp.where(can_skip, prev2, NEG)], 0)
+        merged = jax.scipy.special.logsumexp(stacked, axis=0)
+        new = merged + emit(log_probs[t])
+        new = jnp.where(valid, new, NEG)
+        # freeze rows whose input ended before t
+        active = (t < input_lengths)[:, None]
+        return jnp.where(active, new, alpha), None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+    last = 2 * label_lengths        # index of final blank
+    idx_b = jnp.arange(B)
+    tail = jnp.stack([alpha[idx_b, last],
+                      jnp.where(label_lengths > 0,
+                                alpha[idx_b, last - 1], NEG)], 0)
+    return -jax.scipy.special.logsumexp(tail, axis=0)
+
+
+register_vjp_grad("ctc_loss_op")
+
+defop("margin_ranking_loss_op")(
+    lambda x, y, label, margin=0.0:
+    jnp.maximum(0.0, -label * (x - y) + margin))
+def _soft_margin(x, label):
+    # stable softplus(-label*x): log1p(exp(z)) overflows past z~88
+    z = -label * x
+    return jnp.maximum(z, 0) + jnp.log1p(jnp.exp(-jnp.abs(z)))
+
+
+defop("soft_margin_loss_op")(_soft_margin)
+defop("square_error_cost")(lambda x, label: (x - label) ** 2)
+defop("log_loss_op")(
+    lambda x, label, epsilon=1e-4:
+    -label * jnp.log(x + epsilon)
+    - (1 - label) * jnp.log(1 - x + epsilon))
+
+
+@register_op("hinge_embedding_loss_op")
+def _hinge_embedding(x, label, margin=1.0):
+    return jnp.where(label > 0, x, jnp.maximum(0.0, margin - x))
+
+
+register_vjp_grad("hinge_embedding_loss_op")
+
+
+@register_op("cosine_embedding_loss_op")
+def _cosine_embedding(x1, x2, label, margin=0.0):
+    dot = jnp.sum(x1 * x2, axis=-1)
+    # eps INSIDE the sqrt: sqrt'(0) is inf, so a zero row would NaN the
+    # backward even though the forward is guarded
+    n1 = jnp.sqrt(jnp.sum(x1 * x1, axis=-1) + 1e-12)
+    n2 = jnp.sqrt(jnp.sum(x2 * x2, axis=-1) + 1e-12)
+    cos = dot / (n1 * n2)
+    return jnp.where(label > 0, 1.0 - cos,
+                     jnp.maximum(0.0, cos - margin))
+
+
+register_vjp_grad("cosine_embedding_loss_op")
+
+
+@register_op("triplet_margin_loss_op")
+def _triplet_margin(anchor, positive, negative, margin=1.0, p=2.0,
+                    epsilon=1e-6):
+    def dist(a, b):
+        return jnp.sum(jnp.abs(a - b + epsilon) ** p,
+                       axis=-1) ** (1.0 / p)
+
+    return jnp.maximum(
+        0.0, dist(anchor, positive) - dist(anchor, negative) + margin)
+
+
+register_vjp_grad("triplet_margin_loss_op")
+
+
+@register_op("sigmoid_focal_loss_op")
+def _sigmoid_focal(logit, label, normalizer=None, alpha=0.25, gamma=2.0):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label \
+        + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * (1 - p_t) ** gamma * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return loss
+
+
+register_vjp_grad("sigmoid_focal_loss_op")
+
+
+@register_op("dice_loss_op")
+def _dice(input, label, epsilon=1e-5):
+    # input [N, ..., C] probabilities, label [N, ..., 1] class ids
+    label_one_hot = jax.nn.one_hot(jnp.squeeze(label, -1),
+                                   input.shape[-1], dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * label_one_hot, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) \
+        + jnp.sum(label_one_hot, axis=reduce_dims)
+    return 1.0 - (2.0 * inter + epsilon) / (union + epsilon)
+
+
+register_vjp_grad("dice_loss_op")

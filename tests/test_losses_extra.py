@@ -1,0 +1,194 @@
+"""Round-3 loss batch tests — CTC against brute-force alignment
+enumeration, the rest against numpy (reference test_warpctc_op.py,
+test_*_loss.py style)."""
+import itertools
+
+import numpy as np
+import pytest
+import jax
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.nn import functional as F
+
+
+def _brute_force_ctc(log_probs, label, T, blank=0):
+    """Sum over all alignments of length T that collapse to `label`."""
+    C = log_probs.shape[1]
+    total = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        # collapse: remove repeats then blanks
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev:
+                collapsed.append(s)
+            prev = s
+        collapsed = [s for s in collapsed if s != blank]
+        if collapsed == list(label):
+            lp = sum(log_probs[t, s] for t, s in enumerate(path))
+            total = np.logaddexp(total, lp)
+    return -total
+
+
+class TestCTC:
+    def test_matches_brute_force(self):
+        rng = np.random.RandomState(0)
+        T, B, C = 4, 2, 3          # small enough to enumerate 3^4 paths
+        logits = rng.randn(T, B, C).astype(np.float32)
+        log_probs = logits - np.log(
+            np.exp(logits).sum(-1, keepdims=True))
+        labels = np.asarray([[1, 2], [2, 0]], np.int32)  # row 1 len 1
+        in_lens = np.asarray([4, 3], np.int32)
+        lab_lens = np.asarray([2, 1], np.int32)
+        got = F.ctc_loss(log_probs, labels, in_lens, lab_lens,
+                         reduction="none").numpy()
+        ref0 = _brute_force_ctc(log_probs[:4, 0], [1, 2], 4)
+        ref1 = _brute_force_ctc(log_probs[:3, 1], [2], 3)
+        np.testing.assert_allclose(got, [ref0, ref1], rtol=1e-4)
+
+    def test_differentiable(self):
+        rng = np.random.RandomState(0)
+        logits = rng.randn(6, 2, 4).astype(np.float32)
+        labels = np.asarray([[1, 2, 1], [3, 3, 0]], np.int32)
+        in_lens = np.asarray([6, 5], np.int32)
+        lab_lens = np.asarray([3, 2], np.int32)
+
+        def loss_fn(lg):
+            lp = jax.nn.log_softmax(lg, axis=-1)
+            return F.ctc_loss(pit.to_tensor(lp), labels, in_lens,
+                              lab_lens)._data
+
+        g = jax.grad(loss_fn)(logits)
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).sum() > 0
+        # padding beyond input_lengths gets no gradient
+        assert np.abs(np.asarray(g)[5, 1]).sum() < 1e-6
+
+    def test_repeated_labels_need_blank(self):
+        """P(label with repeat) over too-short input is zero (=inf loss):
+        'aa' needs at least 3 frames (a, blank, a)."""
+        lp = np.log(np.full((2, 1, 3), 1.0 / 3, np.float32))
+        loss = F.ctc_loss(lp, np.asarray([[1, 1]], np.int32),
+                          np.asarray([2], np.int32),
+                          np.asarray([2], np.int32),
+                          reduction="none").numpy()
+        assert loss[0] > 1e6   # -log 0
+
+
+class TestMiscLosses:
+    def setup_method(self, _):
+        self.rng = np.random.RandomState(0)
+
+    def test_margin_ranking(self):
+        x = self.rng.randn(8).astype(np.float32)
+        y = self.rng.randn(8).astype(np.float32)
+        lab = np.sign(self.rng.randn(8)).astype(np.float32)
+        got = F.margin_ranking_loss(x, y, lab, margin=0.1,
+                                    reduction="none").numpy()
+        np.testing.assert_allclose(
+            got, np.maximum(0, -lab * (x - y) + 0.1), rtol=1e-6)
+
+    def test_soft_margin_and_hinge(self):
+        x = self.rng.randn(8).astype(np.float32)
+        lab = np.sign(self.rng.randn(8)).astype(np.float32)
+        np.testing.assert_allclose(
+            F.soft_margin_loss(x, lab, reduction="none").numpy(),
+            np.log1p(np.exp(-lab * x)), rtol=1e-5)
+        got = F.hinge_embedding_loss(x, lab, reduction="none").numpy()
+        ref = np.where(lab > 0, x, np.maximum(0, 1.0 - x))
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_cosine_embedding(self):
+        a = self.rng.randn(4, 6).astype(np.float32)
+        b = self.rng.randn(4, 6).astype(np.float32)
+        lab = np.asarray([1, -1, 1, -1], np.float32)
+        got = F.cosine_embedding_loss(a, b, lab, margin=0.2,
+                                      reduction="none").numpy()
+        cos = (a * b).sum(1) / (np.linalg.norm(a, axis=1)
+                                * np.linalg.norm(b, axis=1))
+        ref = np.where(lab > 0, 1 - cos, np.maximum(0, cos - 0.2))
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    def test_triplet_margin(self):
+        a, p, n = (self.rng.randn(4, 6).astype(np.float32)
+                   for _ in range(3))
+        got = F.triplet_margin_loss(a, p, n, margin=0.5,
+                                    reduction="none").numpy()
+        dp = np.linalg.norm(a - p + 1e-6, axis=1)
+        dn = np.linalg.norm(a - n + 1e-6, axis=1)
+        np.testing.assert_allclose(got, np.maximum(0, dp - dn + 0.5),
+                                   rtol=1e-4)
+
+    def test_focal_dice_log_square(self):
+        logit = self.rng.randn(8).astype(np.float32)
+        lab = (self.rng.rand(8) > 0.5).astype(np.float32)
+        got = F.sigmoid_focal_loss(logit, lab, reduction="none").numpy()
+        p = 1 / (1 + np.exp(-logit))
+        ce = -(lab * np.log(p) + (1 - lab) * np.log(1 - p))
+        pt = p * lab + (1 - p) * (1 - lab)
+        at = 0.25 * lab + 0.75 * (1 - lab)
+        np.testing.assert_allclose(got, at * (1 - pt) ** 2 * ce,
+                                   rtol=1e-4)
+        probs = np.abs(self.rng.rand(3, 4)).astype(np.float32)
+        probs /= probs.sum(-1, keepdims=True)
+        label = self.rng.randint(0, 4, (3, 1))
+        d = F.dice_loss(probs, label).numpy()
+        assert 0 <= float(d) <= 1
+        x = np.clip(self.rng.rand(8), 0.05, 0.95).astype(np.float32)
+        np.testing.assert_allclose(
+            F.log_loss(x, lab).numpy(),
+            -lab * np.log(x + 1e-4) - (1 - lab) * np.log(1 - x + 1e-4),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            F.square_error_cost(x, lab).numpy(), (x - lab) ** 2,
+            rtol=1e-6)
+
+
+class TestLossLayers:
+    def test_layer_wrappers(self):
+        from paddle_infer_tpu import nn
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(6).astype(np.float32)
+        lab = np.sign(rng.randn(6)).astype(np.float32)
+        l1 = nn.MarginRankingLoss(margin=0.1)(pit.to_tensor(x),
+                                              pit.to_tensor(-x),
+                                              pit.to_tensor(lab))
+        assert np.isfinite(float(l1.numpy()))
+        l2 = nn.SoftMarginLoss()(pit.to_tensor(x), pit.to_tensor(lab))
+        assert np.isfinite(float(l2.numpy()))
+        lp = np.log(np.full((3, 1, 4), 0.25, np.float32))
+        l3 = nn.CTCLoss()(pit.to_tensor(lp),
+                          np.asarray([[1]], np.int32),
+                          np.asarray([3], np.int32),
+                          np.asarray([1], np.int32))
+        assert np.isfinite(float(l3.numpy()))
+
+
+class TestNumericalStability:
+    """Review findings pinned: large-logit and zero-vector grads stay
+    finite."""
+
+    def test_soft_margin_large_logits(self):
+        x = np.asarray([100.0, -100.0], np.float32)
+        lab = np.asarray([-1.0, 1.0], np.float32)
+        out = F.soft_margin_loss(x, lab, reduction="none").numpy()
+        np.testing.assert_allclose(out, [100.0, 100.0], rtol=1e-5)
+        t = pit.to_tensor(x)
+        t.stop_gradient = False
+        F.soft_margin_loss(t, lab).backward()
+        assert np.isfinite(t.grad.numpy()).all()
+
+    def test_cosine_zero_row_grad_finite(self):
+        a = np.zeros((2, 4), np.float32)
+        a[1] = 1.0
+        b = np.ones((2, 4), np.float32)
+        t = pit.to_tensor(a)
+        t.stop_gradient = False
+        F.cosine_embedding_loss(t, b, np.asarray([1.0, 1.0],
+                                                 np.float32)).backward()
+        assert np.isfinite(t.grad.numpy()).all()
+        t2 = pit.to_tensor(a)
+        t2.stop_gradient = False
+        F.cosine_similarity(t2, pit.to_tensor(b)).sum().backward()
+        assert np.isfinite(t2.grad.numpy()).all()
