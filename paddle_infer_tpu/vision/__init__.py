@@ -2,5 +2,6 @@
 Model zoo entries live in paddle_infer_tpu.models (resnet etc.)."""
 from . import transforms
 from . import datasets
+from . import ops
 
-__all__ = ["transforms", "datasets"]
+__all__ = ["transforms", "datasets", "ops"]

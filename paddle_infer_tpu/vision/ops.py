@@ -1,0 +1,321 @@
+"""Vision operators (reference: python/paddle/vision/ops.py — nms,
+roi_align, roi_pool, box_coder, deform_conv2d; kernels under
+paddle/fluid/operators/detection/ and phi/kernels/gpu/roi_align_kernel).
+
+TPU split: roi_align / roi_pool / box_coder are static-shape device ops
+(bilinear gathers + reductions a TPU handles well, registered through
+the dispatcher so they trace and differentiate); nms is data-dependent
+by nature and runs HOST-side in numpy like the reference's CPU kernel —
+its output feeds static-shape device programs downstream.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch as D, register_op, register_vjp_grad
+from ..core.tensor import Tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "DeformConv2D",
+           "deform_conv2d"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None,
+        category_idxs=None, categories=None, top_k: Optional[int] = None):
+    """Greedy hard NMS (reference vision/ops.py nms): returns kept box
+    indices, score-descending.  Host-side numpy — the output length is
+    data-dependent, which XLA cannot express; batched multiclass via
+    ``category_idxs`` offsets boxes per class like the reference."""
+    b = np.asarray(_arr(boxes), np.float32)
+    if scores is not None:
+        s = np.asarray(_arr(scores), np.float32)
+        order = np.argsort(-s)
+    else:
+        order = np.arange(b.shape[0])
+    excluded = np.zeros(b.shape[0], bool)
+    if category_idxs is not None and categories is not None:
+        # reference semantics: only boxes whose category is listed
+        # participate (and appear in the result)
+        cat_arr = np.asarray(_arr(category_idxs))
+        excluded = ~np.isin(cat_arr, np.asarray(list(categories)))
+    if category_idxs is not None:
+        # disjoint per-category NMS: shift each category into its own
+        # coordinate island so cross-category IoU is 0
+        cat = np.asarray(_arr(category_idxs))
+        offset = (b.max() + 1.0) * cat.astype(np.float32)
+        b = b + offset[:, None]
+    x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    areas = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    keep = []
+    suppressed = excluded.copy()
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(int(i))
+        xx1 = np.maximum(x1[i], x1)
+        yy1 = np.maximum(y1[i], y1)
+        xx2 = np.minimum(x2[i], x2)
+        yy2 = np.minimum(y2[i], y2)
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
+        suppressed |= iou > iou_threshold
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(np.asarray(keep, np.int64)))
+
+
+@register_op("roi_align_op")
+def _roi_align(x, boxes, boxes_num, *, output_size, spatial_scale=1.0,
+               sampling_ratio=-1, aligned=True):
+    """[N,C,H,W] + rois [R,4] -> [R,C,oh,ow] by average of bilinear
+    samples per bin (reference roi_align_kernel).  ``boxes_num`` maps
+    rois to batch images."""
+    oh, ow = output_size
+    n, c, h, w = x.shape
+    if sampling_ratio > 0:
+        ry = rx = sampling_ratio
+    else:
+        # adaptive default (reference: ceil(roi_size / output_size)) —
+        # roi sizes are traced, so use the static worst case: sample
+        # spacing <= 1 px guarantees parity with dense bin averaging
+        ry = max(1, -(-h // oh))
+        rx = max(1, -(-w // ow))
+    # roi -> batch index
+    reps = jnp.repeat(jnp.arange(boxes_num.shape[0]), boxes_num,
+                      total_repeat_length=boxes.shape[0])
+
+    half = 0.5 if aligned else 0.0
+
+    def one_roi(box, b_idx):
+        x1, y1, x2, y2 = box * spatial_scale
+        x1, y1 = x1 - half, y1 - half
+        x2, y2 = x2 - half, y2 - half
+        rw = jnp.maximum(x2 - x1, 1e-3)
+        rh = jnp.maximum(y2 - y1, 1e-3)
+        bin_w, bin_h = rw / ow, rh / oh
+        # sample grid: (oh*ry, ow*rx) points
+        gy = y1 + (jnp.arange(oh * ry) + 0.5) * (bin_h / ry)
+        gx = x1 + (jnp.arange(ow * rx) + 0.5) * (bin_w / rx)
+        yy = jnp.clip(gy, 0, h - 1)
+        xx = jnp.clip(gx, 0, w - 1)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, h - 1)
+        x1i = jnp.minimum(x0 + 1, w - 1)
+        wy = (yy - y0)[:, None]
+        wx = (xx - x0)[None, :]
+        img = x[b_idx]                       # [C,H,W]
+        f00 = img[:, y0][:, :, x0]
+        f01 = img[:, y0][:, :, x1i]
+        f10 = img[:, y1i][:, :, x0]
+        f11 = img[:, y1i][:, :, x1i]
+        samp = (f00 * (1 - wy) * (1 - wx) + f01 * (1 - wy) * wx
+                + f10 * wy * (1 - wx) + f11 * wy * wx)
+        # average ry x rx samples per bin
+        samp = samp.reshape(c, oh, ry, ow, rx)
+        return samp.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(boxes, reps)
+
+
+register_vjp_grad("roi_align_op")
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return D("roi_align_op", x, boxes, boxes_num,
+             output_size=tuple(output_size),
+             spatial_scale=float(spatial_scale),
+             sampling_ratio=int(sampling_ratio), aligned=bool(aligned))
+
+
+@register_op("roi_pool_op")
+def _roi_pool(x, boxes, boxes_num, *, output_size, spatial_scale=1.0):
+    """Max-pool variant (reference roi_pool_kernel): integer bin edges,
+    max over each bin via the roi_align sampling grid with a dense
+    4x-oversample max (bins are small; exactness at integer coords)."""
+    oh, ow = output_size
+    n, c, h, w = x.shape
+    reps = jnp.repeat(jnp.arange(boxes_num.shape[0]), boxes_num,
+                      total_repeat_length=boxes.shape[0])
+    # sample spacing <= 1 px: every integer pixel of every bin is
+    # visited, so the max equals the reference's dense per-bin max
+    ry = max(1, -(-h // oh))
+    rx = max(1, -(-w // ow))
+
+    def one_roi(box, b_idx):
+        x1, y1, x2, y2 = jnp.round(box * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        gy = y1 + (jnp.arange(oh * ry) + 0.5) * (rh / (oh * ry))
+        gx = x1 + (jnp.arange(ow * rx) + 0.5) * (rw / (ow * rx))
+        yi = jnp.clip(jnp.floor(gy), 0, h - 1).astype(jnp.int32)
+        xi = jnp.clip(jnp.floor(gx), 0, w - 1).astype(jnp.int32)
+        img = x[b_idx]
+        samp = img[:, yi][:, :, xi]          # [C, oh*ry, ow*rx]
+        samp = samp.reshape(c, oh, ry, ow, rx)
+        return samp.max(axis=(2, 4))
+
+    return jax.vmap(one_roi)(boxes, reps)
+
+
+register_vjp_grad("roi_pool_op")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return D("roi_pool_op", x, boxes, boxes_num,
+             output_size=tuple(output_size),
+             spatial_scale=float(spatial_scale))
+
+
+@register_op("box_coder_op")
+def _box_coder(prior_box, prior_box_var, target_box, *, code_type,
+               box_normalized=True):
+    """Encode/decode detection box deltas (reference box_coder_op).
+
+    encode_center_size: target corner boxes -> (dx, dy, dw, dh) deltas
+    w.r.t. priors; decode_center_size: deltas -> corner boxes."""
+    norm = 0.0 if box_normalized else 1.0
+    pw = prior_box[:, 2] - prior_box[:, 0] + norm
+    ph = prior_box[:, 3] - prior_box[:, 1] + norm
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + norm
+        th = target_box[:, 3] - target_box[:, 1] + norm
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=-1)
+        return out / prior_box_var
+    if code_type == "decode_center_size":
+        d = target_box * prior_box_var
+        cx = d[..., 0] * pw + pcx
+        cy = d[..., 1] * ph + pcy
+        bw = jnp.exp(d[..., 2]) * pw
+        bh = jnp.exp(d[..., 3]) * ph
+        return jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
+                          cx + bw * 0.5 - norm, cy + bh * 0.5 - norm],
+                         axis=-1)
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+register_vjp_grad("box_coder_op")
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True):
+    return D("box_coder_op", prior_box, prior_box_var, target_box,
+             code_type=code_type, box_normalized=bool(box_normalized))
+
+
+@register_op("deform_conv2d_op")
+def _deform_conv2d(x, offset, weight, bias=None, mask=None, *, stride=1,
+                   padding=0, dilation=1):
+    """Deformable conv v1/v2 (reference deformable_conv_op): sample the
+    input at offset-shifted kernel taps via bilinear gather, then a 1x1
+    contraction — gather + matmul, both TPU-native."""
+    n, cin, h, w = x.shape
+    cout, _, kh, kw = weight.shape
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) \
+        else dilation
+    oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    ow = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    hp, wp = h + 2 * ph, w + 2 * pw
+
+    # sampling position for output pixel (i,j), tap (u,v):
+    #   y = i*sh + u*dh + offset_y ; x = j*sw + v*dw + offset_x
+    base_y = (jnp.arange(oh)[:, None, None, None] * sh
+              + jnp.arange(kh)[None, None, :, None] * dh)  # [oh,1,kh,1]
+    base_x = (jnp.arange(ow)[None, :, None, None] * sw
+              + jnp.arange(kw)[None, None, None, :] * dw)  # [1,ow,1,kw]
+    off = offset.reshape(n, kh * kw, 2, oh, ow)
+    oy = off[:, :, 0].reshape(n, kh, kw, oh, ow) \
+        .transpose(0, 3, 4, 1, 2)                  # [n,oh,ow,kh,kw]
+    ox = off[:, :, 1].reshape(n, kh, kw, oh, ow) \
+        .transpose(0, 3, 4, 1, 2)
+    raw_y = base_y[None] + oy
+    raw_x = base_x[None] + ox
+    # reference bilinear im2col: samples outside the (padded) image
+    # contribute ZERO, not a replicated border pixel
+    in_range = ((raw_y >= 0) & (raw_y <= hp - 1)
+                & (raw_x >= 0) & (raw_x <= wp - 1)).astype(x.dtype)
+    sy = jnp.clip(raw_y, 0, hp - 1)
+    sx = jnp.clip(raw_x, 0, wp - 1)
+    y0 = jnp.floor(sy).astype(jnp.int32)
+    x0 = jnp.floor(sx).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, hp - 1)
+    x1 = jnp.minimum(x0 + 1, wp - 1)
+    wy = sy - y0
+    wx = sx - x0
+
+    if mask is not None:
+        mm = mask.reshape(n, kh, kw, oh, ow).transpose(0, 3, 4, 1, 2)
+    else:
+        mm = jnp.ones((n, 1, 1, 1, 1), x.dtype)
+
+    def per_image(img, y0_, y1_, x0_, x1_, wy_, wx_, m, ok):
+        f00 = img[:, y0_, x0_]                     # [cin,oh,ow,kh,kw]
+        f01 = img[:, y0_, x1_]
+        f10 = img[:, y1_, x0_]
+        f11 = img[:, y1_, x1_]
+        val = (f00 * (1 - wy_) * (1 - wx_) + f01 * (1 - wy_) * wx_
+               + f10 * wy_ * (1 - wx_) + f11 * wy_ * wx_) * m * ok
+        return jnp.einsum("cijuv,ocuv->oij", val, weight)
+
+    outs = jax.vmap(per_image)(xp, y0, y1, x0, x1, wy, wx, mm, in_range)
+    if bias is not None:
+        outs = outs + bias.reshape(1, -1, 1, 1)
+    return outs
+
+
+register_vjp_grad("deform_conv2d_op")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, mask=None):
+    return D("deform_conv2d_op", x, offset, weight, bias, mask,
+             stride=stride if isinstance(stride, int) else tuple(stride),
+             padding=padding if isinstance(padding, int)
+             else tuple(padding),
+             dilation=dilation if isinstance(dilation, int)
+             else tuple(dilation))
+
+
+from ..nn.layer import Layer          # noqa: E402
+
+
+class DeformConv2D(Layer):
+    """reference vision/ops.py DeformConv2D layer over deform_conv2d."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size, kernel_size)
+        self.stride, self.padding, self.dilation = stride, padding, \
+            dilation
+        self.weight = self.create_parameter(
+            (out_channels, in_channels) + tuple(ks), attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             stride=self.stride, padding=self.padding,
+                             dilation=self.dilation, mask=mask)

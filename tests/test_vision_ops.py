@@ -1,0 +1,169 @@
+"""Vision op tests (reference: test_ops.py for paddle.vision.ops —
+nms/roi_align/roi_pool/box_coder/deform_conv2d)."""
+import numpy as np
+import pytest
+import jax
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.vision import ops as V
+
+
+class TestNMS:
+    def test_greedy_suppression(self):
+        boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11],
+                            [20, 20, 30, 30], [0, 0, 9, 9]], np.float32)
+        scores = np.asarray([0.9, 0.8, 0.95, 0.3], np.float32)
+        keep = V.nms(boxes, iou_threshold=0.5, scores=scores).numpy()
+        # box2 is disjoint (kept, highest), box0 kept, box1+3 overlap box0
+        assert keep.tolist() == [2, 0]
+
+    def test_categories_do_not_suppress_each_other(self):
+        boxes = np.asarray([[0, 0, 10, 10], [0, 0, 10, 10]], np.float32)
+        scores = np.asarray([0.9, 0.8], np.float32)
+        cats = np.asarray([0, 1])
+        keep = V.nms(boxes, iou_threshold=0.5, scores=scores,
+                     category_idxs=cats, categories=[0, 1]).numpy()
+        assert sorted(keep.tolist()) == [0, 1]
+
+    def test_top_k(self):
+        boxes = np.asarray([[0, 0, 1, 1], [5, 5, 6, 6],
+                            [10, 10, 11, 11]], np.float32)
+        scores = np.asarray([0.1, 0.9, 0.5], np.float32)
+        keep = V.nms(boxes, 0.5, scores=scores, top_k=2).numpy()
+        assert keep.tolist() == [1, 2]
+
+
+class TestRoiAlign:
+    def test_constant_region(self):
+        """A constant-valued image stays constant through bilinear
+        averaging regardless of roi geometry."""
+        x = np.full((1, 3, 16, 16), 7.0, np.float32)
+        boxes = np.asarray([[2.3, 3.7, 11.9, 13.1]], np.float32)
+        out = V.roi_align(x, boxes, np.asarray([1], np.int32),
+                          output_size=4).numpy()
+        assert out.shape == (1, 3, 4, 4)
+        np.testing.assert_allclose(out, 7.0, rtol=1e-5)
+
+    def test_gradient_flows_to_input(self):
+        x = np.random.RandomState(0).randn(1, 2, 8, 8).astype(np.float32)
+        boxes = np.asarray([[1.0, 1.0, 6.0, 6.0]], np.float32)
+
+        def f(img):
+            out = V.roi_align(pit.to_tensor(img), boxes,
+                              np.asarray([1], np.int32), output_size=2)
+            return (out._data ** 2).sum()
+
+        g = jax.grad(f)(x)
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.abs(np.asarray(g)).sum() > 0
+
+    def test_linear_ramp_exact(self):
+        """On a linear ramp, bilinear sampling is exact: each output bin
+        equals the ramp at the bin's sample-average position."""
+        h = w = 8
+        ramp = np.tile(np.arange(w, dtype=np.float32), (h, 1))
+        x = ramp[None, None]
+        boxes = np.asarray([[0.0, 0.0, 8.0, 8.0]], np.float32)
+        out = V.roi_align(x, boxes, np.asarray([1], np.int32),
+                          output_size=4, aligned=False).numpy()[0, 0]
+        # bin centers along x: 1.0, 3.0, 5.0, 7.0 -> clipped ramp mean
+        ref_cols = out[0]
+        assert np.all(np.diff(ref_cols) > 0)
+        np.testing.assert_allclose(out, np.tile(ref_cols, (4, 1)),
+                                   rtol=1e-5)
+
+
+class TestRoiPoolBoxCoder:
+    def test_roi_pool_max(self):
+        x = np.zeros((1, 1, 8, 8), np.float32)
+        x[0, 0, 2, 2] = 5.0
+        x[0, 0, 6, 6] = 9.0
+        boxes = np.asarray([[0, 0, 7, 7]], np.float32)
+        out = V.roi_pool(x, boxes, np.asarray([1], np.int32),
+                         output_size=2).numpy()[0, 0]
+        assert out[0, 0] == 5.0 and out[1, 1] == 9.0
+
+    def test_box_coder_roundtrip(self):
+        rng = np.random.RandomState(0)
+        priors = np.abs(rng.rand(5, 4)).astype(np.float32)
+        priors[:, 2:] = priors[:, :2] + 1.0 + rng.rand(5, 2)
+        targets = priors + 0.3
+        var = np.full((5, 4), 0.5, np.float32)
+        enc = V.box_coder(priors, var, targets,
+                          code_type="encode_center_size").numpy()
+        dec = V.box_coder(priors, var, enc,
+                          code_type="decode_center_size").numpy()
+        np.testing.assert_allclose(dec, targets, rtol=1e-4, atol=1e-4)
+
+
+class TestDeformConv:
+    def test_zero_offset_matches_conv2d(self):
+        """With zero offsets (and no mask) deformable conv IS conv2d."""
+        from paddle_infer_tpu.nn import functional as F
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32)
+        offset = np.zeros((2, 2 * 9, 6, 6), np.float32)
+        got = V.deform_conv2d(x, offset, w).numpy()
+        ref = F.conv2d(pit.to_tensor(x), pit.to_tensor(w)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_layer_and_mask(self):
+        pit.seed(0)
+        m = V.DeformConv2D(2, 3, 3, padding=1)
+        x = pit.to_tensor(np.random.RandomState(0).randn(
+            1, 2, 6, 6).astype(np.float32))
+        offset = pit.to_tensor(np.zeros((1, 18, 6, 6), np.float32))
+        mask = pit.to_tensor(np.ones((1, 9, 6, 6), np.float32))
+        out = m(x, offset, mask=mask)
+        assert list(out.shape) == [1, 3, 6, 6]
+        # zero mask kills the response (minus bias)
+        out0 = m(x, offset, mask=pit.to_tensor(
+            np.zeros((1, 9, 6, 6), np.float32)))
+        np.testing.assert_allclose(
+            out0.numpy(), np.broadcast_to(
+                m.bias.numpy()[None, :, None, None], out0.numpy().shape),
+            atol=1e-6)
+
+
+class TestReviewFindings:
+    """Review-finding pins: asymmetric hyperparams, dense-max parity,
+    category filtering, out-of-range zero contribution."""
+
+    def test_nms_categories_filter(self):
+        boxes = np.asarray([[0, 0, 1, 1], [5, 5, 6, 6]], np.float32)
+        scores = np.asarray([0.9, 0.8], np.float32)
+        keep = V.nms(boxes, 0.5, scores=scores,
+                     category_idxs=np.asarray([0, 1]),
+                     categories=[0]).numpy()
+        assert keep.tolist() == [0]     # class-1 box excluded
+
+    def test_roi_pool_finds_isolated_peak(self):
+        x = np.zeros((1, 1, 64, 64), np.float32)
+        x[0, 0, 5, 13] = 100.0
+        boxes = np.asarray([[0, 0, 63, 63]], np.float32)
+        out = V.roi_pool(x, boxes, np.asarray([1], np.int32),
+                         output_size=2).numpy()[0, 0]
+        assert out[0, 0] == 100.0       # peak in the top-left bin
+
+    def test_deform_conv_asymmetric_stride(self):
+        from paddle_infer_tpu.nn import functional as F
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 2, 8, 8).astype(np.float32)
+        w = rng.randn(3, 2, 3, 3).astype(np.float32)
+        # stride (1,2): oh=6, ow=3
+        offset = np.zeros((1, 18, 6, 3), np.float32)
+        got = V.deform_conv2d(x, offset, w, stride=(1, 2)).numpy()
+        ref = F.conv2d(pit.to_tensor(x), pit.to_tensor(w),
+                       stride=(1, 2)).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=1e-4)
+
+    def test_deform_conv_out_of_range_is_zero(self):
+        x = np.ones((1, 1, 4, 4), np.float32)
+        w = np.ones((1, 1, 1, 1), np.float32)
+        # push every sample far outside: contribution must be 0
+        offset = np.full((1, 2, 4, 4), 100.0, np.float32)
+        out = V.deform_conv2d(x, offset, w).numpy()
+        np.testing.assert_allclose(out, 0.0)
