@@ -524,3 +524,323 @@ def _kl_beta(p, q):
             + (pa - qa) * D("digamma", pa)
             + (pb - qb) * D("digamma", pb)
             + (qa + qb - pa - pb) * D("digamma", ps))
+
+
+# ---- round-3 batch: transforms + composed distributions (reference
+# distribution/transform.py — 12 Transform classes,
+# transformed_distribution.py, independent.py, exponential_family.py,
+# lognormal.py, geometric.py, cauchy.py, exponential.py, poisson.py)
+
+class Transform:
+    """Bijector (reference distribution/transform.py Transform):
+    forward/inverse + log|det J| for TransformedDistribution."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * _t(x)
+
+    def inverse(self, y):
+        return (_t(y) - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return D("log", D("abs", self.scale)) + 0.0 * _t(x)
+
+
+class ExpTransform(Transform):
+    """y = exp(x)."""
+
+    def forward(self, x):
+        return D("exp", _t(x))
+
+    def inverse(self, y):
+        return D("log", _t(y))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(x)
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x)."""
+
+    def forward(self, x):
+        return D("sigmoid", _t(x))
+
+    def inverse(self, y):
+        y = _t(y)
+        return D("log", y) - D("log", 1.0 - y)
+
+    def forward_log_det_jacobian(self, x):
+        # log sigmoid'(x) = -softplus(-x) - softplus(x)
+        x = _t(x)
+        return -(D("softplus", x) + D("softplus", -x))
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return D("tanh", _t(x))
+
+    def inverse(self, y):
+        y = _t(y)
+        return 0.5 * (D("log", 1.0 + y) - D("log", 1.0 - y))
+
+    def forward_log_det_jacobian(self, x):
+        x = _t(x)
+        # log(1 - tanh^2) = 2*(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - D("softplus", -2.0 * x))
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            j = t.forward_log_det_jacobian(x)
+            total = j if total is None else total + j
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """reference transformed_distribution.py: base dist pushed through
+    a transform chain; log_prob by change of variables."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = ChainTransform(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def rsample(self, shape=()):
+        return self.transform.forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        return self.base.log_prob(x) \
+            - self.transform.forward_log_det_jacobian(x)
+
+
+class Independent(Distribution):
+    """reference independent.py: reinterpret the last
+    ``reinterpreted_batch_rank`` batch dims as event dims (log_prob
+    sums over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + base.event_shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return D("sum", lp, axis=tuple(range(lp.ndim - self.rank,
+                                             lp.ndim)), keepdim=False)
+
+    def entropy(self):
+        ent = self.base.entropy()
+        return D("sum", ent, axis=tuple(range(ent.ndim - self.rank,
+                                              ent.ndim)), keepdim=False)
+
+
+class ExponentialFamily(Distribution):
+    """reference exponential_family.py: entropy via the Bregman identity
+    over natural parameters (subclasses supply _natural_parameters and
+    _log_normalizer); mirrored here as the API anchor."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural):
+        raise NotImplementedError
+
+
+class LogNormal(TransformedDistribution):
+    """reference lognormal.py: exp(Normal)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(Normal(loc, scale), ExpTransform())
+
+    @property
+    def mean(self):
+        return D("exp", self.loc + 0.5 * self.scale * self.scale)
+
+    @property
+    def variance(self):
+        s2 = self.scale * self.scale
+        return (D("exp", s2) - 1.0) * D("exp", 2.0 * self.loc + s2)
+
+    def entropy(self):
+        return self.base.entropy() + self.loc
+
+
+class Exponential(Distribution):
+    """reference exponential.py: rate-parameterized."""
+
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    @property
+    def variance(self):
+        return 1.0 / (self.rate * self.rate)
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = Tensor(jax.random.uniform(prandom.next_key(), shape,
+                                      jnp.float32, 1e-7, 1.0))
+        return -D("log", u) / self.rate
+
+    def log_prob(self, value):
+        return D("log", self.rate) - self.rate * _t(value)
+
+    def entropy(self):
+        return 1.0 - D("log", self.rate)
+
+
+class Geometric(Distribution):
+    """reference geometric.py: trials until first success, support
+    {0, 1, ...} (paddle counts failures before success)."""
+
+    def __init__(self, probs):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return (1.0 - self.probs) / self.probs
+
+    @property
+    def variance(self):
+        return (1.0 - self.probs) / (self.probs * self.probs)
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(prandom.next_key(), shape, jnp.float32,
+                               1e-7, 1.0)
+        p = jnp.broadcast_to(self.probs._data, shape)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-p)))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return v * D("log", 1.0 - self.probs) + D("log", self.probs)
+
+    def entropy(self):
+        p = self.probs
+        q = 1.0 - p
+        return -(q * D("log", q) + p * D("log", p)) / p
+
+
+class Cauchy(Distribution):
+    """reference cauchy.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    def rsample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        u = Tensor(jax.random.uniform(prandom.next_key(), shape,
+                                      jnp.float32, 1e-6, 1.0 - 1e-6))
+        return self.loc + self.scale * D("tan", math.pi * (u - 0.5))
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return -math.log(math.pi) - D("log", self.scale) \
+            - D("log", 1.0 + z * z)
+
+    def entropy(self):
+        return math.log(4.0 * math.pi) + D("log", self.scale)
+
+
+class Poisson(Distribution):
+    """reference poisson.py: rate-parameterized counts."""
+
+    def __init__(self, rate):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        shape = _shape(shape) + self.batch_shape
+        lam = jnp.broadcast_to(self.rate._data, shape)
+        return Tensor(jax.random.poisson(prandom.next_key(), lam,
+                                         shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return v * D("log", self.rate) - self.rate \
+            - D("lgamma", v + 1.0)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return D("log", p.rate) - D("log", q.rate) + r - 1.0
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    return (-p.entropy()
+            - D("log", q.probs)
+            - (1.0 - p.probs) / p.probs * D("log", 1.0 - q.probs))
+
+
+__all__ += ["Transform", "AffineTransform", "ExpTransform",
+            "SigmoidTransform", "TanhTransform", "ChainTransform",
+            "TransformedDistribution", "Independent",
+            "ExponentialFamily", "LogNormal", "Exponential", "Geometric",
+            "Cauchy", "Poisson"]

@@ -47,9 +47,11 @@ def nms(boxes, iou_threshold: float = 0.3, scores=None,
         excluded = ~np.isin(cat_arr, np.asarray(list(categories)))
     if category_idxs is not None:
         # disjoint per-category NMS: shift each category into its own
-        # coordinate island so cross-category IoU is 0
+        # coordinate island so cross-category IoU is 0 (span-relative so
+        # negative coordinates can't alias across islands)
         cat = np.asarray(_arr(category_idxs))
-        offset = (b.max() + 1.0) * cat.astype(np.float32)
+        span = float(b.max() - b.min()) + 1.0
+        offset = span * cat.astype(np.float32)
         b = b + offset[:, None]
     x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
     areas = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
@@ -103,6 +105,10 @@ def _roi_align(x, boxes, boxes_num, *, output_size, spatial_scale=1.0,
         # sample grid: (oh*ry, ow*rx) points
         gy = y1 + (jnp.arange(oh * ry) + 0.5) * (bin_h / ry)
         gx = x1 + (jnp.arange(ow * rx) + 0.5) * (bin_w / rx)
+        # samples outside the feature map contribute ZERO (reference
+        # kernel semantics), not a replicated border pixel
+        ok = ((gy >= -1.0) & (gy <= h))[:, None] \
+            & ((gx >= -1.0) & (gx <= w))[None, :]
         yy = jnp.clip(gy, 0, h - 1)
         xx = jnp.clip(gx, 0, w - 1)
         y0 = jnp.floor(yy).astype(jnp.int32)
@@ -118,6 +124,7 @@ def _roi_align(x, boxes, boxes_num, *, output_size, spatial_scale=1.0,
         f11 = img[:, y1i][:, :, x1i]
         samp = (f00 * (1 - wy) * (1 - wx) + f01 * (1 - wy) * wx
                 + f10 * wy * (1 - wx) + f11 * wy * wx)
+        samp = samp * ok[None].astype(samp.dtype)
         # average ry x rx samples per bin
         samp = samp.reshape(c, oh, ry, ow, rx)
         return samp.mean(axis=(2, 4))

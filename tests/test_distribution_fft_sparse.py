@@ -261,3 +261,110 @@ class TestSparse:
         np.testing.assert_array_equal(t.to_dense().numpy(),
                                       [[0, 3], [-2, 0]])
         assert float(pit.sparse.sum(sp).numpy()) == 1.0
+
+
+class TestDistributionsRound3:
+    """Transforms + composed distributions (reference
+    distribution/transform.py, transformed_distribution.py etc.)."""
+
+    def test_lognormal_matches_scipy(self):
+        from scipy import stats
+
+        from paddle_infer_tpu.distribution import LogNormal
+
+        d = LogNormal(0.5, 0.8)
+        xs = np.asarray([0.5, 1.0, 2.5], np.float32)
+        ref = stats.lognorm.logpdf(xs, s=0.8, scale=np.exp(0.5))
+        np.testing.assert_allclose(d.log_prob(xs).numpy(), ref,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(float(d.mean.numpy()),
+                                   stats.lognorm.mean(0.8,
+                                                      scale=np.exp(0.5)),
+                                   rtol=1e-5)
+        s = d.sample((2000,)).numpy()
+        assert (s > 0).all()
+
+    def test_transformed_distribution_change_of_variables(self):
+        from scipy import stats
+
+        from paddle_infer_tpu.distribution import (AffineTransform,
+                                                   Normal,
+                                                   TransformedDistribution)
+
+        d = TransformedDistribution(Normal(0.0, 1.0),
+                                    AffineTransform(3.0, 2.0))
+        xs = np.asarray([1.0, 3.0, 6.0], np.float32)
+        np.testing.assert_allclose(d.log_prob(xs).numpy(),
+                                   stats.norm.logpdf(xs, 3.0, 2.0),
+                                   rtol=1e-4)
+
+    def test_sigmoid_tanh_transforms_invert(self):
+        from paddle_infer_tpu.distribution import (SigmoidTransform,
+                                                   TanhTransform)
+
+        x = np.linspace(-2, 2, 9).astype(np.float32)
+        for T in (SigmoidTransform, TanhTransform):
+            t = T()
+            np.testing.assert_allclose(
+                t.inverse(t.forward(x)).numpy(), x, rtol=1e-4,
+                atol=1e-5)
+            # log|det J| matches numerical derivative
+            eps = 1e-3
+            num = (t.forward(x + eps).numpy()
+                   - t.forward(x - eps).numpy()) / (2 * eps)
+            np.testing.assert_allclose(
+                t.forward_log_det_jacobian(x).numpy(), np.log(num),
+                rtol=1e-2, atol=1e-3)
+
+    def test_independent_sums_event_dims(self):
+        from paddle_infer_tpu.distribution import Independent, Normal
+
+        base = Normal(np.zeros((3, 4), np.float32),
+                      np.ones((3, 4), np.float32))
+        d = Independent(base, 1)
+        assert d.batch_shape == (3,) and d.event_shape == (4,)
+        x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(d.log_prob(x).numpy(),
+                                   base.log_prob(x).numpy().sum(-1),
+                                   rtol=1e-5)
+
+    def test_exponential_geometric_cauchy_poisson(self):
+        from scipy import stats
+
+        from paddle_infer_tpu.distribution import (Cauchy, Exponential,
+                                                   Geometric,
+                                                   kl_divergence, Poisson)
+
+        e = Exponential(2.0)
+        np.testing.assert_allclose(e.log_prob(1.5).numpy(),
+                                   stats.expon.logpdf(1.5, scale=0.5),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(e.mean.numpy()), 0.5)
+        kl = kl_divergence(Exponential(2.0), Exponential(3.0))
+        ref = np.log(2 / 3) + 3 / 2 - 1
+        np.testing.assert_allclose(float(kl.numpy()), ref, rtol=1e-5)
+
+        g = Geometric(0.3)
+        np.testing.assert_allclose(g.log_prob(4.0).numpy(),
+                                   stats.geom.logpmf(5, 0.3),
+                                   rtol=1e-5)   # scipy counts trials
+        c = Cauchy(1.0, 2.0)
+        np.testing.assert_allclose(c.log_prob(0.5).numpy(),
+                                   stats.cauchy.logpdf(0.5, 1.0, 2.0),
+                                   rtol=1e-5)
+        p = Poisson(3.0)
+        np.testing.assert_allclose(p.log_prob(2.0).numpy(),
+                                   stats.poisson.logpmf(2, 3.0),
+                                   rtol=1e-5)
+        s = p.sample((4000,)).numpy()
+        np.testing.assert_allclose(s.mean(), 3.0, rtol=0.1)
+
+
+class TestFlopsUtility:
+    def test_flops_counts_matmul(self):
+        import paddle_infer_tpu as pit
+        from paddle_infer_tpu import nn
+
+        m = nn.Linear(64, 32)
+        f = pit.flops(m, (4, 64))
+        assert 16000 <= f <= 20000     # 2*4*64*32 + bias

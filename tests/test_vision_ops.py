@@ -167,3 +167,25 @@ class TestReviewFindings:
         offset = np.full((1, 2, 4, 4), 100.0, np.float32)
         out = V.deform_conv2d(x, offset, w).numpy()
         np.testing.assert_allclose(out, 0.0)
+
+    def test_nms_negative_coords_categories(self):
+        """Span-relative category islands: negative-coordinate boxes in
+        another class must not alias onto class 0 (review finding)."""
+        boxes = np.asarray([[0, 0, 10, 10], [-11, -11, -1, -1]],
+                           np.float32)
+        scores = np.asarray([0.9, 0.8], np.float32)
+        keep = V.nms(boxes, 0.5, scores=scores,
+                     category_idxs=np.asarray([0, 1]),
+                     categories=[0, 1]).numpy()
+        assert sorted(keep.tolist()) == [0, 1]
+
+    def test_roi_align_outside_is_zero(self):
+        """Bins past the feature map average in zeros (reference kernel),
+        not replicated border pixels."""
+        x = np.ones((1, 1, 16, 16), np.float32)
+        boxes = np.asarray([[0.0, 0.0, 32.0, 32.0]], np.float32)
+        out = V.roi_align(x, boxes, np.asarray([1], np.int32),
+                          output_size=2).numpy()[0, 0]
+        # top-left bin fully inside -> 1.0; bottom-right fully outside -> ~0
+        np.testing.assert_allclose(out[0, 0], 1.0, rtol=1e-5)
+        assert out[1, 1] < 0.1
