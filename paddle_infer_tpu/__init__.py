@@ -71,6 +71,7 @@ from . import text
 from . import geometric
 from . import incubate
 from . import signal
+from . import utils
 from .framework import save, load, set_flags, get_flags, flags
 from .framework.io import save_state_dict, load_state_dict
 
@@ -135,7 +136,10 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
 
     x = np.zeros(tuple(input_size), np.float32)
     params = {n: p._data for n, p in net.named_parameters()}
-    was_training = net.training
+    # per-sublayer mode save/restore (a blanket .train() would unfreeze
+    # deliberately-eval'd sublayers — same pattern as Predictor.from_layer)
+    modes = [(net, net.training)] + [(sub, sub.training)
+                                     for _, sub in net.named_sublayers()]
     net.eval()
     try:
         def fwd(p, xx):
@@ -145,8 +149,8 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
         compiled = jax.jit(fwd).lower(params, jnp.asarray(x)).compile()
         cost = compiled.cost_analysis() or {}
     finally:
-        if was_training:
-            net.train()
+        for sub, mode in modes:
+            sub.training = mode
     total = int(cost.get("flops", 0.0))
     if print_detail:
         print(f"Total FLOPs: {total:,} (XLA cost analysis)")

@@ -663,6 +663,14 @@ class Independent(Distribution):
         super().__init__(bs[:len(bs) - self.rank],
                          bs[len(bs) - self.rank:] + base.event_shape)
 
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
     def rsample(self, shape=()):
         return self.base.rsample(shape)
 

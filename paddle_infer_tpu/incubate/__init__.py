@@ -1,5 +1,6 @@
 """Incubate namespace (reference: python/paddle/incubate/ — the staging
 area for the fork's fused-transformer serving APIs)."""
 from . import nn  # noqa: F401
+from . import autograd  # noqa: F401
 
-__all__ = ["nn"]
+__all__ = ["nn", "autograd"]

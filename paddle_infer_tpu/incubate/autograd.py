@@ -1,0 +1,96 @@
+"""Functional autograd transforms (reference:
+python/paddle/incubate/autograd/ — jvp, vjp, Jacobian, Hessian over the
+dual-tape primal machinery).
+
+TPU-first: these ARE jax's native transforms — the reference builds
+forward-mode AD by double-program transformation; here jax.jvp /
+jax.jacfwd / jax.jacrev operate on the same functional core the
+compiled train steps use, wrapped to speak Tensor in/out.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian"]
+
+
+def _unwrap(xs):
+    if isinstance(xs, (list, tuple)):
+        return tuple(x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                     for x in xs)
+    return (xs._data if isinstance(xs, Tensor) else jnp.asarray(xs),)
+
+
+def _wrap_fn(func):
+    def fn(*arrays):
+        out = func(*[Tensor(a) for a in arrays])
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    return fn
+
+
+def _rewrap(out):
+    if isinstance(out, tuple):
+        return tuple(Tensor(o) for o in out)
+    return Tensor(out)
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: returns (outputs, J @ v) (reference
+    incubate/autograd/functional.py jvp)."""
+    primals = _unwrap(xs)
+    tangents = _unwrap(v) if v is not None else tuple(
+        jnp.ones_like(p) for p in primals)
+    out, jv = jax.jvp(_wrap_fn(func), primals, tangents)
+    return _rewrap(out), _rewrap(jv)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: returns (outputs, v @ J) (reference vjp)."""
+    primals = _unwrap(xs)
+    out, pullback = jax.vjp(_wrap_fn(func), *primals)
+    if v is not None:
+        cot = _unwrap(v)
+        cot = cot[0] if not isinstance(out, tuple) else cot
+    else:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    grads = pullback(cot)
+    grads = grads[0] if len(grads) == 1 else grads
+    return _rewrap(out), _rewrap(grads)
+
+
+class Jacobian:
+    """Lazy full Jacobian (reference incubate/autograd Jacobian):
+    index like a matrix; computed once via jacrev."""
+
+    def __init__(self, func, xs, is_batched=False):
+        primals = _unwrap(xs)
+        self._jac = jax.jacrev(_wrap_fn(func))(*primals)
+
+    def __getitem__(self, idx):
+        return Tensor(jnp.asarray(self._jac)[idx])
+
+    @property
+    def shape(self):
+        return tuple(jnp.asarray(self._jac).shape)
+
+
+class Hessian:
+    """Lazy Hessian (reference Hessian): forward-over-reverse."""
+
+    def __init__(self, func, xs, is_batched=False):
+        primals = _unwrap(xs)
+        self._hess = jax.jacfwd(jax.jacrev(_wrap_fn(func)))(*primals)
+
+    def __getitem__(self, idx):
+        return Tensor(jnp.asarray(self._hess)[idx])
+
+    @property
+    def shape(self):
+        return tuple(jnp.asarray(self._hess).shape)

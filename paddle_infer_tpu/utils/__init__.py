@@ -1,0 +1,30 @@
+"""Utilities (reference: python/paddle/utils/ — cpp_extension,
+install_check.run_check, deprecated helpers)."""
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["cpp_extension", "run_check"]
+
+
+def run_check():
+    """Install self-check (reference utils/install_check.py run_check):
+    run a tiny train step on the available device and report."""
+    import numpy as np
+
+    import paddle_infer_tpu as pit
+    from paddle_infer_tpu import nn
+
+    import jax
+
+    dev = jax.devices()[0]
+    pit.seed(0)
+    m = nn.Linear(4, 2)
+    opt = pit.optimizer.SGD(learning_rate=0.1,
+                            parameters=m.parameters())
+    x = pit.to_tensor(np.ones((2, 4), np.float32))
+    loss = (m(x) ** 2).mean()
+    loss.backward()
+    opt.step()
+    print(f"paddle_infer_tpu is installed successfully! "
+          f"(device: {dev.platform}:{dev.id}, "
+          f"loss={float(loss.numpy()):.4f})")
+    return True

@@ -368,3 +368,11 @@ class TestFlopsUtility:
         m = nn.Linear(64, 32)
         f = pit.flops(m, (4, 64))
         assert 16000 <= f <= 20000     # 2*4*64*32 + bias
+
+    def test_independent_forwards_moments(self):
+        from paddle_infer_tpu.distribution import Independent, Normal
+
+        d = Independent(Normal(np.full((2, 3), 1.5, np.float32),
+                               np.ones((2, 3), np.float32)), 1)
+        np.testing.assert_allclose(d.mean.numpy(), 1.5)
+        np.testing.assert_allclose(d.variance.numpy(), 1.0)

@@ -2,6 +2,7 @@
 python/paddle/incubate/nn/layer/fused_transformer.py
 FusedMultiTransformer :1017)."""
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 import paddle_infer_tpu as pit
@@ -67,3 +68,46 @@ class TestFusedTransformer:
                                    atol=1e-5)
         np.testing.assert_allclose(steps[2], full[:, 5], rtol=1e-4,
                                    atol=1e-5)
+
+
+class TestIncubateAutograd:
+    """reference incubate/autograd functional.py jvp/vjp/Jacobian/
+    Hessian."""
+
+    def test_jvp_vjp(self):
+        from paddle_infer_tpu.incubate.autograd import jvp, vjp
+
+        def f(x):
+            return (x * x).sum()
+
+        x = pit.to_tensor(np.asarray([1.0, 2.0, 3.0], np.float32))
+        v = pit.to_tensor(np.asarray([1.0, 0.0, 0.0], np.float32))
+        out, jv = jvp(f, x, v)
+        assert float(out.numpy()) == pytest.approx(14.0)
+        assert float(jv.numpy()) == pytest.approx(2.0)   # d/dx1 = 2x1
+        out2, g = vjp(f, x)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0, 6.0])
+
+    def test_jacobian_hessian(self):
+        from paddle_infer_tpu.incubate.autograd import Hessian, Jacobian
+
+        def f(x):
+            return x * x
+
+        x = pit.to_tensor(np.asarray([1.0, 2.0], np.float32))
+        J = Jacobian(f, x)
+        np.testing.assert_allclose(J[:].numpy(),
+                                   np.diag([2.0, 4.0]), rtol=1e-5)
+
+        def g(x):
+            return (x ** 3).sum()
+
+        H = Hessian(g, x)
+        np.testing.assert_allclose(H[:].numpy(),
+                                   np.diag([6.0, 12.0]), rtol=1e-5)
+
+    def test_run_check(self, capsys):
+        import paddle_infer_tpu as pit
+
+        assert pit.utils.run_check() is True
+        assert "successfully" in capsys.readouterr().out
