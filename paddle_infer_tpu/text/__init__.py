@@ -22,7 +22,8 @@ from ..core.tensor import Tensor
 from ..io.dataset import Dataset
 from ..nn.layer import Layer
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "UCIHousing"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "UCIHousing",
+           "Conll05st", "Movielens"]
 
 
 @register_op("viterbi_decode", save_inputs=False)
@@ -117,13 +118,9 @@ class Imdb(Dataset):
 
     def __init__(self, data_file=None, mode="train", cutoff=150,
                  vocab_size=2048, seq_len=128, synthetic_size=2048):
-        if data_file is not None:
-            raise NotImplementedError(
-                "Imdb archive loading is not supported; omit data_file "
-                "for the synthetic dataset")
         self.mode = mode
-        rng = np.random.RandomState(0 if mode == "train" else 1)
-        n = synthetic_size if mode == "train" else synthetic_size // 4
+        rng, n = _synthetic_setup("Imdb", data_file, mode,
+                                  synthetic_size)
         self.labels = rng.randint(0, 2, n).astype(np.int64)
         half = vocab_size // 2
         docs = []
@@ -139,6 +136,20 @@ class Imdb(Dataset):
         return self.docs[i], self.labels[i]
 
 
+def _synthetic_setup(name, data_file, mode, synthetic_size, seed=None):
+    """Shared synthetic-dataset boilerplate: data_file guard + per-mode
+    rng + train/test split size (used by all four datasets so the split
+    convention can't drift)."""
+    if data_file is not None:
+        raise NotImplementedError(
+            f"{name} archive loading is not supported; omit data_file "
+            "for the synthetic dataset")
+    rng = np.random.RandomState(
+        (0 if mode == "train" else 1) if seed is None else seed)
+    n = synthetic_size if mode == "train" else synthetic_size // 4
+    return rng, n
+
+
 class UCIHousing(Dataset):
     """Boston-housing style regression set (reference
     datasets/uci_housing.py): 13 features -> 1 target, synthetic linear
@@ -147,12 +158,8 @@ class UCIHousing(Dataset):
     FEATURES = 13
 
     def __init__(self, data_file=None, mode="train", synthetic_size=512):
-        if data_file is not None:
-            raise NotImplementedError(
-                "UCIHousing file loading is not supported; omit "
-                "data_file for the synthetic dataset")
-        rng = np.random.RandomState(0 if mode == "train" else 1)
-        n = synthetic_size if mode == "train" else synthetic_size // 4
+        rng, n = _synthetic_setup("UCIHousing", data_file, mode,
+                                  synthetic_size)
         self.x = rng.randn(n, self.FEATURES).astype(np.float32)
         w = np.linspace(-1.0, 1.0, self.FEATURES).astype(np.float32)
         self.y = (self.x @ w + 0.1 * rng.randn(n)).astype(
@@ -163,3 +170,66 @@ class UCIHousing(Dataset):
 
     def __getitem__(self, i):
         return self.x[i], self.y[i]
+
+
+class Conll05st(Dataset):
+    """SRL dataset (reference text/datasets/conll05.py): synthetic
+    (word, predicate, context..., mark) -> BIO-label rows with a
+    deterministic word->label correlation so taggers can fit it."""
+
+    N_LABELS = 67          # reference label dict size
+
+    def __init__(self, data_file=None, mode="train", seq_len=32,
+                 vocab_size=4096, synthetic_size=1024):
+        self.mode = mode
+        rng, n = _synthetic_setup("Conll05st", data_file, mode,
+                                  synthetic_size)
+        self.words = rng.randint(2, vocab_size, (n, seq_len)) \
+            .astype(np.int64)
+        # the predicate IS a token of the sentence (reference semantics:
+        # mark flags the predicate position), so marks carry signal
+        pos = rng.randint(0, seq_len, n)
+        self.predicates = self.words[np.arange(n), pos][:, None] \
+            .repeat(seq_len, 1)
+        # label correlates with word id bucket (learnable structure)
+        self.labels = (self.words % self.N_LABELS).astype(np.int64)
+        self.marks = (self.words == self.predicates).astype(np.int64)
+
+    def __len__(self):
+        return len(self.words)
+
+    def __getitem__(self, i):
+        return (self.words[i], self.predicates[i], self.marks[i],
+                self.labels[i])
+
+
+class Movielens(Dataset):
+    """Rating dataset (reference text/datasets/movielens.py): synthetic
+    (user feature vector, movie feature vector) -> rating rows where the
+    rating is a noisy inner product, so factorization models fit it."""
+
+    def __init__(self, data_file=None, mode="train", n_users=512,
+                 n_movies=1024, synthetic_size=4096, seed=None):
+        self.mode = mode
+        rng, n = _synthetic_setup("Movielens", data_file, mode,
+                                  synthetic_size, seed=seed)
+        k = 8
+        # ONE ground-truth rating function shared by every mode (a
+        # per-mode function would make test labels unlearnable)
+        truth = np.random.RandomState(42)
+        self._u_emb = truth.randn(n_users, k).astype(np.float32)
+        self._m_emb = truth.randn(n_movies, k).astype(np.float32)
+        self.user_ids = rng.randint(0, n_users, n).astype(np.int64)
+        self.movie_ids = rng.randint(0, n_movies, n).astype(np.int64)
+        raw = np.sum(self._u_emb[self.user_ids]
+                     * self._m_emb[self.movie_ids], axis=1)
+        raw = raw + 0.1 * rng.randn(n).astype(np.float32)
+        # squash to the full 1..5 star range
+        self.ratings = np.clip(
+            np.round(3.0 + 2.0 * np.tanh(raw)), 1, 5).astype(np.float32)
+
+    def __len__(self):
+        return len(self.ratings)
+
+    def __getitem__(self, i):
+        return self.user_ids[i], self.movie_ids[i], self.ratings[i]

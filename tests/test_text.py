@@ -91,3 +91,35 @@ def test_datasets_trainable():
     imdb = text.Imdb(mode="test", synthetic_size=64)
     doc, label = imdb[0]
     assert doc.ndim == 1 and label in (0, 1)
+
+
+class TestTextDatasetsRound3:
+    def test_conll05(self):
+        from paddle_infer_tpu.text import Conll05st
+
+        ds = Conll05st(synthetic_size=64, seq_len=16)
+        assert len(ds) == 64
+        words, pred, marks, labels = ds[0]
+        assert words.shape == (16,) and labels.shape == (16,)
+        assert labels.max() < Conll05st.N_LABELS
+        assert set(np.unique(marks)).issubset({0, 1})
+        with pytest.raises(NotImplementedError):
+            Conll05st(data_file="x")
+
+    def test_movielens(self):
+        from paddle_infer_tpu.text import Movielens
+
+        ds = Movielens(synthetic_size=256)
+        u, m, r = ds[0]
+        assert 1.0 <= r <= 5.0
+        rs = np.asarray([ds[i][2] for i in range(256)])
+        assert rs.std() > 0.1          # not degenerate
+        # train and test share ONE ground-truth rating function
+        tr = Movielens(mode="train", synthetic_size=4096)
+        te = Movielens(mode="test", synthetic_size=4096)
+        np.testing.assert_allclose(tr._u_emb, te._u_emb)
+        # marks carry signal: exactly the predicate position(s) flagged
+        from paddle_infer_tpu.text import Conll05st
+
+        ds2 = Conll05st(synthetic_size=64, seq_len=16)
+        assert ds2.marks.sum(axis=1).min() >= 1
