@@ -144,8 +144,10 @@ def _synthetic_setup(name, data_file, mode, synthetic_size, seed=None):
         raise NotImplementedError(
             f"{name} archive loading is not supported; omit data_file "
             "for the synthetic dataset")
-    rng = np.random.RandomState(
-        (0 if mode == "train" else 1) if seed is None else seed)
+    base = 0 if mode == "train" else 1
+    # explicit seed offsets, never replaces, the mode component — a
+    # shared stream would make the test split a prefix of train (leak)
+    rng = np.random.RandomState(base + (0 if seed is None else 2 * seed))
     n = synthetic_size if mode == "train" else synthetic_size // 4
     return rng, n
 

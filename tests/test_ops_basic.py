@@ -624,3 +624,74 @@ class TestLRSchedulersRound3:
         assert s() == pytest.approx(0.125)
         assert s.get_lr() == pytest.approx(0.125)
         assert s.get_lr() == pytest.approx(0.125)
+
+
+class TestRound3NumericGrads:
+    """OpTest numeric-gradient discipline (SURVEY §4) for the round-3
+    op batches."""
+
+    def setup_method(self, _):
+        self.rng = np.random.RandomState(0)
+
+    def test_copysign_ldexp_grad(self):
+        x = self.rng.randn(6).astype(np.float32) + 2.0   # away from 0
+        y = self.rng.randn(6).astype(np.float32) + 1.0
+        check_grad("copysign", [x, y], input_indices=[0])
+        e = np.full(6, 2.0, np.float32)      # d/dx ldexp(x, 2) = 4
+        check_grad("ldexp", [x, e], input_indices=[0])
+
+    def test_trapezoid_grad(self):
+        y = self.rng.rand(3, 8).astype(np.float32)
+        check_grad("trapezoid", [y], {"dx": 0.5, "axis": -1})
+
+    def test_diagflat_scatter_grad(self):
+        v = self.rng.randn(4).astype(np.float32)
+        check_grad("diagflat", [v])
+        x = self.rng.randn(3, 2).astype(np.float32)
+        idx = np.asarray([[0], [2]], np.int64)
+        upd = self.rng.randn(2, 2).astype(np.float32)
+        check_grad("scatter_nd_add", [x, idx, upd],
+                   input_indices=[0, 2])
+
+    def test_cdist_grad(self):
+        # distinct points: the grad-safe zero branch is tested elsewhere
+        x = self.rng.randn(4, 3).astype(np.float32)
+        y = self.rng.randn(3, 3).astype(np.float32) + 5.0
+        check_grad("cdist", [x, y])
+        check_grad("cdist", [x, y], {"p": 1.5})
+
+    def test_fold_grad(self):
+        u = self.rng.randn(1, 4, 4).astype(np.float32)
+        check_grad("fold_col2im", [u],
+                   {"output_sizes": (4, 4), "kernel_sizes": (2, 2),
+                    "strides": (2, 2), "paddings": (0, 0),
+                    "dilations": (1, 1)})
+
+    def test_pool_nd_grads(self):
+        x = self.rng.randn(1, 2, 8).astype(np.float32)
+        check_grad("avg_pool1d", [x], {"kernel_size": 2})
+        x3 = self.rng.randn(1, 1, 4, 4, 4).astype(np.float32)
+        check_grad("avg_pool3d", [x3], {"kernel_size": 2})
+
+    def test_conv_transpose_nd_grads(self):
+        x = self.rng.randn(1, 2, 6).astype(np.float32)
+        w = self.rng.randn(2, 3, 3).astype(np.float32)
+        check_grad("conv1d_transpose", [x, w], {"stride": 2})
+        x3 = self.rng.randn(1, 1, 3, 3, 3).astype(np.float32)
+        w3 = self.rng.randn(1, 2, 2, 2, 2).astype(np.float32)
+        check_grad("conv3d_transpose", [x3, w3], {"stride": 2})
+
+    def test_lrn_grad(self):
+        x = self.rng.randn(1, 6, 3, 3).astype(np.float32)
+        check_grad("local_response_norm", [x], {"size": 3})
+
+    def test_segment_and_send_recv_grads(self):
+        d = self.rng.randn(8, 3).astype(np.float32)
+        ids = np.sort(self.rng.randint(0, 3, 8)).astype(np.int32)
+        check_grad("graph_segment_pool", [d, ids],
+                   {"n": 3, "pool_type": "mean"}, input_indices=[0])
+        src = self.rng.randint(0, 4, 6).astype(np.int32)
+        dst = self.rng.randint(0, 4, 6).astype(np.int32)
+        x = self.rng.randn(4, 3).astype(np.float32)
+        check_grad("graph_send_recv", [x, src, dst],
+                   {"n": 4, "reduce_op": "sum"}, input_indices=[0])

@@ -123,3 +123,12 @@ class TestTextDatasetsRound3:
 
         ds2 = Conll05st(synthetic_size=64, seq_len=16)
         assert ds2.marks.sum(axis=1).min() >= 1
+
+    def test_seeded_split_does_not_leak(self):
+        from paddle_infer_tpu.text import Movielens
+
+        tr = Movielens(mode="train", synthetic_size=256, seed=7)
+        te = Movielens(mode="test", synthetic_size=256, seed=7)
+        # test ids must NOT be a prefix of train ids
+        assert not np.array_equal(tr.user_ids[:len(te.user_ids)],
+                                  te.user_ids)
