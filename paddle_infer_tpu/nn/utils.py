@@ -1,0 +1,166 @@
+"""Parameter utilities (reference: python/paddle/nn/utils/ —
+weight_norm_hook.py weight_norm/remove_weight_norm, spectral_norm_hook,
+clip_grad_norm_, transform_parameters.py parameters_to_vector).
+
+weight_norm reparameterizes ``weight = g * v / ||v||`` with (g, v) as
+the trainable parameters and the weight recomputed by a forward
+pre-hook — the recomputation happens inside the traced program, so
+gradients flow to g and v through the same tape/compiled step as any
+other parameter.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import dispatch as D
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "clip_grad_norm_", "parameters_to_vector",
+           "vector_to_parameters"]
+
+
+def _norm_except(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    sq = D("sum", D("multiply", v, v), axis=axes, keepdim=True)
+    return D("sqrt", sq)
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """Apply weight normalization (reference weight_norm_hook.py):
+    replaces ``layer.<name>`` with g * v/||v|| recomputed per forward."""
+    w = getattr(layer, name)
+    if not isinstance(w, (Parameter, Tensor)):
+        raise ValueError(f"layer has no tensor attribute {name!r}")
+    if dim is not None:
+        dim = dim % w.ndim       # negative dims mean the usual axis
+    v = Parameter(w._data)
+    if dim is None:              # norm over everything -> scalar g
+        g0 = jnp.sqrt(jnp.sum(w._data * w._data))[None]
+        g = Parameter(g0)
+    else:
+        g = Parameter(_norm_except(Tensor(w._data), dim)._data)
+    # deregister the fused weight; register the new leaves
+    if name in layer._parameters:
+        del layer._parameters[name]
+    setattr(layer, name + "_v", v)
+    setattr(layer, name + "_g", g)
+
+    def _recompute(lyr, inputs):
+        vv = getattr(lyr, name + "_v")
+        gg = getattr(lyr, name + "_g")
+        if dim is None:
+            nrm = D("sqrt", D("sum", D("multiply", vv, vv)))
+        else:
+            nrm = _norm_except(vv, dim)
+        object.__setattr__(lyr, name,
+                           D("multiply", D("divide", vv, nrm), gg))
+        return None
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_state = (name, dim, handle)
+    _recompute(layer, ())        # keep .weight usable outside forward
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    """Fold g, v back into a plain weight Parameter (reference
+    remove_weight_norm)."""
+    state = getattr(layer, "_weight_norm_state", None)
+    if state is None or state[0] != name:
+        raise ValueError(f"{name!r} has no weight norm applied")
+    _, dim, handle = state
+    handle.remove()
+    v = getattr(layer, name + "_v")
+    g = getattr(layer, name + "_g")
+    if dim is None:
+        nrm = D("sqrt", D("sum", D("multiply", v, v)))
+    else:
+        nrm = _norm_except(v, dim)
+    fused = D("multiply", D("divide", v, nrm), g)
+    for suffix in ("_v", "_g"):
+        layer._parameters.pop(name + suffix, None)
+        layer.__dict__.pop(name + suffix, None)
+    layer.__dict__.pop(name, None)     # drop the hook-computed tensor
+    setattr(layer, name, Parameter(fused._data))
+    del layer._weight_norm_state
+    return layer
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: int = 0):
+    """Apply spectral normalization via a forward pre-hook (reference
+    spectral_norm_hook.py), reusing the SpectralNorm layer's power
+    iteration."""
+    from .layers_extra import SpectralNorm
+
+    w = getattr(layer, name)
+    sn = SpectralNorm(tuple(w.shape), dim=dim,
+                      power_iters=n_power_iterations, eps=eps)
+    layer._spectral_norm_module = sn
+    orig = Parameter(w._data)
+    if name in layer._parameters:
+        del layer._parameters[name]
+    setattr(layer, name + "_orig", orig)
+
+    def _recompute(lyr, inputs):
+        sn.training = lyr.training
+        object.__setattr__(lyr, name,
+                           sn(getattr(lyr, name + "_orig")))
+        return None
+
+    layer.register_forward_pre_hook(_recompute)
+    _recompute(layer, ())
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm: float, norm_type: float = 2.0,
+                    error_if_nonfinite: bool = False):
+    """In-place global-norm gradient clip (reference clip_grad_norm_);
+    returns the total norm."""
+    if isinstance(parameters, (Parameter, Tensor)):
+        parameters = [parameters]
+    parameters = list(parameters)    # a generator must survive 2 passes
+    grads = [p.grad for p in parameters
+             if p is not None and p.grad is not None]
+    if not grads:
+        return Tensor(jnp.asarray(0.0))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g._data)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._data) ** norm_type) for g in grads])) \
+            ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError("gradient norm is non-finite")
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    for p in parameters:
+        if p is not None and p.grad is not None:
+            p.grad._data = p.grad._data * scale
+    return Tensor(total)
+
+
+def parameters_to_vector(parameters):
+    """Flatten parameters into one vector (reference
+    transform_parameters.py)."""
+    return Tensor(jnp.concatenate(
+        [p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters):
+    """Write a flat vector back into the parameters (validated BEFORE
+    mutating, so a bad vector never leaves the model half-written)."""
+    arr = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    parameters = list(parameters)
+    total = sum(int(p.size) for p in parameters)
+    if total != arr.shape[0]:
+        raise ValueError(
+            f"vector length {arr.shape[0]} does not match parameter "
+            f"count {total}")
+    offset = 0
+    for p in parameters:
+        n = int(p.size)
+        p._data = arr[offset:offset + n].reshape(tuple(p.shape)) \
+            .astype(p._data.dtype)
+        offset += n

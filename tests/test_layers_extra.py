@@ -178,3 +178,94 @@ class TestMiscLayers:
         np.testing.assert_allclose(out[0], w[[1, 2, 3]].mean(0),
                                    rtol=1e-5)
         np.testing.assert_allclose(out[1], w[4], rtol=1e-5)
+
+
+class TestNNUtils:
+    """reference nn/utils/ weight_norm_hook, clip_grad_norm_,
+    transform_parameters."""
+
+    def test_weight_norm_roundtrip_and_training(self):
+        from paddle_infer_tpu.nn.utils import (remove_weight_norm,
+                                               weight_norm)
+
+        pit.seed(0)
+        m = nn.Linear(6, 4)
+        ref_w = m.weight.numpy().copy()
+        x = _t((3, 6))
+        ref_out = m(x).numpy()
+        weight_norm(m, dim=0)
+        names = [n for n, _ in m.named_parameters()]
+        assert "weight_g" in names and "weight_v" in names
+        assert "weight" not in names
+        np.testing.assert_allclose(m(x).numpy(), ref_out, rtol=1e-5)
+        # grads flow to g and v
+        loss = (m(x) ** 2).mean()
+        loss.backward()
+        assert np.abs(m.weight_g.grad.numpy()).sum() > 0
+        assert np.abs(m.weight_v.grad.numpy()).sum() > 0
+        remove_weight_norm(m)
+        names = [n for n, _ in m.named_parameters()]
+        assert "weight" in names and "weight_g" not in names
+        np.testing.assert_allclose(m.weight.numpy(), ref_w, rtol=1e-5)
+        np.testing.assert_allclose(m(x).numpy(), ref_out, rtol=1e-5)
+
+    def test_spectral_norm_hook(self):
+        from paddle_infer_tpu.nn.utils import spectral_norm
+
+        pit.seed(0)
+        m = nn.Linear(8, 6)
+        spectral_norm(m, n_power_iterations=20)
+        m.eval()
+        m(_t((2, 8)))
+        s = np.linalg.svd(np.asarray(m.weight.numpy()),
+                          compute_uv=False)
+        np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+    def test_clip_grad_norm(self):
+        from paddle_infer_tpu.nn.utils import clip_grad_norm_
+
+        m = nn.Linear(4, 4)
+        (m(_t((2, 4))) ** 2).sum().backward()
+        total = clip_grad_norm_(list(m.parameters()), max_norm=0.1)
+        gn = np.sqrt(sum((p.grad.numpy() ** 2).sum()
+                         for p in m.parameters()))
+        assert gn <= 0.11
+        assert float(total.numpy()) > 0
+
+    def test_parameter_vector_roundtrip(self):
+        from paddle_infer_tpu.nn.utils import (parameters_to_vector,
+                                               vector_to_parameters)
+
+        m = nn.Linear(3, 2)
+        vec = parameters_to_vector(list(m.parameters()))
+        assert vec.shape[0] == 3 * 2 + 2
+        vector_to_parameters(vec * 0 + 1.0, list(m.parameters()))
+        for p in m.parameters():
+            np.testing.assert_allclose(p.numpy(), 1.0)
+
+    def test_utils_review_findings(self):
+        """Generator input clips, negative dim is a real axis, bad
+        vector never half-writes."""
+        from paddle_infer_tpu.nn.utils import (clip_grad_norm_,
+                                               vector_to_parameters,
+                                               weight_norm)
+
+        m = nn.Linear(4, 4)
+        (m(_t((2, 4))) ** 2).sum().backward()
+        clip_grad_norm_((p for p in m.parameters()), max_norm=0.1)
+        gn = np.sqrt(sum((p.grad.numpy() ** 2).sum()
+                         for p in m.parameters()))
+        assert gn <= 0.11                      # generator still clipped
+
+        m2 = nn.Linear(6, 4)
+        weight_norm(m2, dim=-1)                # last axis, not scalar
+        assert list(m2.weight_g.shape) == [1, 4]
+
+        m3 = nn.Linear(3, 2)
+        before = [p.numpy().copy() for p in m3.parameters()]
+        with pytest.raises(ValueError):
+            vector_to_parameters(
+                pit.to_tensor(np.zeros(999, np.float32)),
+                list(m3.parameters()))
+        for p, b in zip(m3.parameters(), before):
+            np.testing.assert_array_equal(p.numpy(), b)
