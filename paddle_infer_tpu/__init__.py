@@ -72,11 +72,41 @@ from . import geometric
 from . import incubate
 from . import signal
 from . import utils
+from . import regularizer
+from .hapi import callbacks  # noqa: F401  (paddle.callbacks alias)
 from .framework import save, load, set_flags, get_flags, flags
 from .framework.io import save_state_dict, load_state_dict
 
 import paddle_infer_tpu.distributed as distributed  # noqa: F401
 from . import parallel  # noqa: F401
+
+
+class version:
+    """reference paddle.version module surface."""
+
+    full_version = __version__
+    major, minor, patch = (__version__.split(".") + ["0"])[:3]
+    cuda_version = "False"
+
+    @staticmethod
+    def show():
+        print(f"paddle_infer_tpu {__version__} (TPU/XLA build)")
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Batch a sample reader (reference paddle.batch / fluid layers io):
+    wraps a generator fn yielding samples into one yielding lists."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
 
 
 def is_compiled_with_cuda():

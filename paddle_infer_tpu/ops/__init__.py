@@ -188,6 +188,7 @@ _EXPORTS = [
     "renorm",
     # round-3 breadth batch 2
     "nextafter", "copysign", "ldexp", "trapezoid", "nanquantile",
+    "histogram",
     "angle", "conj", "bincount", "diagflat", "index_put", "scatter_nd",
     "scatter_nd_add", "masked_select", "unique", "cdist", "lu_factor",
     "eig",

@@ -24,6 +24,17 @@ class Optimizer:
                  grad_clip=None, multi_precision=False):
         self._lr = learning_rate
         self._parameters: List[Tensor] = list(parameters) if parameters else []
+        # regularizer objects (paddle.regularizer.L1Decay/L2Decay) are
+        # normalized here; plain floats mean L2
+        self._l1_decay = 0.0
+        if weight_decay is not None and hasattr(weight_decay, "coeff"):
+            from ..regularizer import L1Decay
+
+            if isinstance(weight_decay, L1Decay):
+                self._l1_decay = float(weight_decay.coeff)
+                weight_decay = 0.0
+            else:
+                weight_decay = float(weight_decay.coeff)
         self._weight_decay = weight_decay or 0.0
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
@@ -41,6 +52,15 @@ class Optimizer:
     def _param_weight_decay(self, param) -> float:
         """Per-param decoupled decay coefficient (0 when excluded)."""
         return float(self._weight_decay or 0.0)
+
+    def _decay_excluded(self, param) -> bool:
+        """Whether this param is excluded from ALL decay flavors —
+        subclasses with exclusion lists (AdamW apply_decay_param_fun,
+        Lars exclusions) override; gates L1 the same as L2."""
+        return False
+
+    def _named_decay_excluded(self, name) -> bool:
+        return False
 
     # lr ------------------------------------------------------------------
     def get_lr(self) -> float:
@@ -76,6 +96,8 @@ class Optimizer:
             garr = g._data.astype(p._data.dtype)
             if self._weight_decay and self._decay_into_grad():
                 garr = garr + self._weight_decay * p._data
+            if self._l1_decay and not self._decay_excluded(p):
+                garr = garr + self._l1_decay * jnp.sign(p._data)
             plr = lr * p.optimize_attr.get("learning_rate", 1.0) \
                 if hasattr(p, "optimize_attr") else lr
             wd = 0.0 if self._decay_into_grad() else \
@@ -151,6 +173,8 @@ class Optimizer:
             g = grads[n].astype(p.dtype)
             if self._weight_decay and self._decay_into_grad():
                 g = g + self._weight_decay * p
+            if self._l1_decay and not self._named_decay_excluded(n):
+                g = g + self._l1_decay * jnp.sign(p)
             wd = 0.0 if self._decay_into_grad() else \
                 self._named_weight_decay(n)
             new_params[n], new_state[n] = self._update(
@@ -242,16 +266,22 @@ class AdamW(Adam):
         return False
 
     def _param_weight_decay(self, param):
-        if (self._apply_decay_param_fun is not None
-                and not self._apply_decay_param_fun(param.name or "")):
+        if self._decay_excluded(param):
             return 0.0
         return float(self._weight_decay or 0.0)
 
     def _named_weight_decay(self, name):
-        if (self._apply_decay_param_fun is not None
-                and not self._apply_decay_param_fun(name)):
+        if self._named_decay_excluded(name):
             return 0.0
         return float(self._weight_decay or 0.0)
+
+    def _decay_excluded(self, param):
+        return (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(param.name or ""))
+
+    def _named_decay_excluded(self, name):
+        return (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(name))
 
     def _update(self, param, grad, state, lr, step, wd):
         # decoupled weight decay (skipped per-param via wd=0)

@@ -1,0 +1,25 @@
+"""Weight-decay regularizers (reference: python/paddle/regularizer.py —
+L1Decay/L2Decay objects consumed by optimizers' weight_decay arg)."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L2Decay:
+    """coeff/2 * ||w||^2 — folded into the gradient as coeff*w."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"
+
+
+class L1Decay:
+    """coeff * ||w||_1 — folded into the gradient as coeff*sign(w)."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"

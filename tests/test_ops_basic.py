@@ -695,3 +695,64 @@ class TestRound3NumericGrads:
         x = self.rng.randn(4, 3).astype(np.float32)
         check_grad("graph_send_recv", [x, src, dst],
                    {"n": 4, "reduce_op": "sum"}, input_indices=[0])
+
+
+class TestRegularizerAndMisc:
+    def test_l2decay_object(self):
+        from paddle_infer_tpu.regularizer import L1Decay, L2Decay
+
+        x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+        y = (x @ np.ones((4, 1))).astype(np.float32)
+
+        def run(wd):
+            pit.seed(0)
+            m = pit.nn.Linear(4, 1)
+            opt = pit.optimizer.SGD(learning_rate=0.1,
+                                    parameters=m.parameters(),
+                                    weight_decay=wd)
+            loss = ((m(pit.to_tensor(x)) - pit.to_tensor(y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            return m.weight.numpy()
+
+        np.testing.assert_allclose(run(L2Decay(0.01)), run(0.01),
+                                   rtol=1e-6)
+        # L1: different update (sign-based), still finite
+        w_l1 = run(L1Decay(0.01))
+        assert np.isfinite(w_l1).all()
+        assert not np.allclose(w_l1, run(0.0))
+
+    def test_version_batch_histogram(self):
+        import paddle_infer_tpu as pit
+
+        assert pit.version.full_version == pit.__version__
+        batches = list(pit.batch(lambda: iter(range(5)), 2,
+                                 drop_last=True)())
+        assert [len(b) for b in batches] == [2, 2]
+        h = pit.histogram(np.asarray([0.1, 0.6, 0.7], np.float32),
+                          bins=2, min=0.0, max=1.0).numpy()
+        np.testing.assert_array_equal(h, [1, 2])
+        assert pit.callbacks.EarlyStopping is not None
+
+    def test_l1decay_honors_exclusion(self):
+        from paddle_infer_tpu.regularizer import L1Decay
+
+        x = np.random.RandomState(0).randn(4, 4).astype(np.float32)
+
+        def run(wd, fun):
+            pit.seed(0)
+            m = pit.nn.Linear(4, 2)
+            opt = pit.optimizer.AdamW(
+                learning_rate=0.1, weight_decay=wd,
+                apply_decay_param_fun=fun,
+                parameters=m.parameters())
+            m(pit.to_tensor(x)).sum().backward()
+            opt.step()
+            return m.weight.numpy(), m.bias.numpy()
+
+        w_l1, b_l1 = run(L1Decay(0.5), lambda n: "bias" not in n)
+        w_none, b_none = run(None, None)
+        # excluded bias follows the no-decay trajectory exactly...
+        np.testing.assert_allclose(b_l1, b_none, atol=1e-7)
+        # ...while the non-excluded weight is L1-decayed
+        assert not np.allclose(w_l1, w_none)
