@@ -136,3 +136,60 @@ class Assign(Initializer):
         arr = jnp.asarray(np.asarray(self.value),
                           dtype=dtypes.convert_dtype(dtype))
         return arr.reshape(tuple(shape))
+
+
+class Orthogonal(Initializer):
+    """reference nn/initializer/orthogonal.py: QR-based (semi-)orthogonal
+    init; rows or columns are orthonormal, scaled by gain."""
+
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype="float32"):
+        import jax.numpy as jnp
+
+        shape = tuple(shape)
+        if len(shape) < 2:
+            raise ValueError("Orthogonal requires >= 2 dims")
+        rows = shape[0]
+        cols = 1
+        for s in shape[1:]:
+            cols *= s
+        flat = (max(rows, cols), min(rows, cols))
+        a = jax.random.normal(prandom.next_key(), flat,
+                              dtypes.convert_dtype(dtype))
+        q, r = jnp.linalg.qr(a)
+        # sign correction makes the distribution uniform over O(n)
+        q = q * jnp.sign(jnp.diagonal(r))[None, :]
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape)
+
+
+class Dirac(Initializer):
+    """reference nn/initializer/dirac.py: identity-preserving conv init
+    (weight[i, i % in, center...] = 1)."""
+
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype="float32"):
+        import jax.numpy as jnp
+
+        shape = tuple(shape)
+        if len(shape) < 3:
+            raise ValueError("Dirac requires a conv weight (>= 3 dims)")
+        out_c, in_c = shape[0], shape[1]
+        if out_c % self.groups != 0:
+            raise ValueError(
+                f"out_channels {out_c} not divisible by groups "
+                f"{self.groups}")
+        w = np.zeros(shape, np.float32)
+        centers = tuple(s // 2 for s in shape[2:])
+        per_group = out_c // self.groups
+        # only min(per_group, in_c) channels per group carry the identity
+        # tap; the rest stay zero (reference dirac_ semantics)
+        for g in range(self.groups):
+            for k in range(min(per_group, in_c)):
+                w[(g * per_group + k, k) + centers] = 1.0
+        return jnp.asarray(w, dtypes.convert_dtype(dtype))

@@ -387,3 +387,40 @@ def test_lars_exclude_from_weight_decay():
     # plain momentum step: v = lr * g; p -= v (ratio forced to 1, wd 0)
     np.testing.assert_allclose(p.numpy(), p0 - 0.1 * g0, rtol=1e-5,
                                atol=1e-6)
+
+
+class TestInitializersRound3:
+    def test_orthogonal(self):
+        import paddle_infer_tpu as pit
+        from paddle_infer_tpu.nn.initializer import Orthogonal
+
+        pit.seed(0)
+        w = np.asarray(Orthogonal()( (6, 4) ))
+        np.testing.assert_allclose(w.T @ w, np.eye(4), atol=1e-5)
+        wide = np.asarray(Orthogonal(gain=2.0)((3, 5)))
+        np.testing.assert_allclose(wide @ wide.T, 4.0 * np.eye(3),
+                                   atol=1e-4)
+
+    def test_dirac_identity_conv(self):
+        import paddle_infer_tpu as pit
+        from paddle_infer_tpu import nn
+        from paddle_infer_tpu.nn.initializer import Dirac
+
+        w = np.asarray(Dirac()((3, 3, 3, 3)))
+        x = np.random.RandomState(0).randn(1, 3, 5, 5).astype(np.float32)
+        out = nn.functional.conv2d(pit.to_tensor(x), pit.to_tensor(w),
+                                   padding=1).numpy()
+        np.testing.assert_allclose(out, x, atol=1e-6)
+
+    def test_dirac_extra_channels_zero(self):
+        from paddle_infer_tpu.nn.initializer import Dirac
+
+        w = np.asarray(Dirac()((4, 2, 3, 3)))
+        assert (w[2:] == 0).all()          # no modulo wrap
+        assert w[0, 0, 1, 1] == 1.0 and w[1, 1, 1, 1] == 1.0
+        wg = np.asarray(Dirac(groups=2)((4, 2, 3, 3)))
+        assert wg[2, 0, 1, 1] == 1.0       # group 2 restarts the identity
+        import pytest
+
+        with pytest.raises(ValueError):
+            Dirac(groups=4)((6, 2, 3, 3))
