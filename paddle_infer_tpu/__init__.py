@@ -73,6 +73,12 @@ from . import incubate
 from . import signal
 from . import utils
 from . import regularizer
+# the public linalg namespace must SHADOW the ops.linalg submodule that
+# `from .ops import *` dragged in — `from . import linalg` would see the
+# existing attribute and skip the import, so load it explicitly
+import importlib as _importlib
+
+linalg = _importlib.import_module(".linalg", __name__)
 from .hapi import callbacks  # noqa: F401  (paddle.callbacks alias)
 from .framework import save, load, set_flags, get_flags, flags
 from .framework.io import save_state_dict, load_state_dict

@@ -1,0 +1,80 @@
+"""Public linear-algebra namespace (reference: python/paddle/linalg.py,
+re-exporting tensor/linalg.py ops).  Every entry dispatches through the
+op registry, so the tape/IR/AMP machinery sees them like any op."""
+from __future__ import annotations
+
+from .core.dispatch import dispatch as _D
+from .ops import (cholesky_solve, cond, corrcoef, cov, det, eig,  # noqa
+                  inner, lu, multi_dot, norm, outer, solve)
+
+
+def inv(x):
+    return _D("inverse", x)
+
+__all__ = ["cholesky", "cholesky_solve", "cond", "corrcoef", "cov",
+           "det", "eig", "eigh", "eigvals", "eigvalsh", "inv", "lstsq",
+           "lu", "lu_unpack", "matrix_exp", "matrix_power",
+           "matrix_rank", "multi_dot", "norm", "pinv", "qr", "slogdet",
+           "solve", "svd", "triangular_solve"]
+
+
+def cholesky(x, upper=False):
+    return _D("cholesky", x, upper=upper)
+
+
+def eigh(x, UPLO="L"):
+    return _D("eigh", x, UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L"):
+    vals, _ = _D("eigh", x, UPLO=UPLO)
+    return vals
+
+
+def eigvals(x):
+    return _D("eigvals", x)
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    return _D("lstsq", x, y, rcond=rcond)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True,
+              unpack_pivots=True):
+    return _D("lu_unpack", lu_data, lu_pivots,
+              unpack_ludata=bool(unpack_ludata),
+              unpack_pivots=bool(unpack_pivots))
+
+
+def matrix_exp(x):
+    return _D("matrix_exp", x)
+
+
+def matrix_power(x, n):
+    return _D("matrix_power", x, n=int(n))
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    return _D("matrix_rank", x, tol=tol)
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return _D("pinv", x, rcond=float(rcond))
+
+
+def qr(x, mode="reduced"):
+    return _D("qr", x, mode=mode)
+
+
+def slogdet(x):
+    return _D("slogdet", x)
+
+
+def svd(x, full_matrices=False):
+    return _D("svd", x, full_matrices=full_matrices)
+
+
+def triangular_solve(x, y, upper=True, transpose=False,
+                     unitriangular=False):
+    return _D("triangular_solve", x, y, upper=upper,
+              transpose=transpose, unitriangular=unitriangular)

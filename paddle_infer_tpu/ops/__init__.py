@@ -191,7 +191,7 @@ _EXPORTS = [
     "histogram",
     "angle", "conj", "bincount", "diagflat", "index_put", "scatter_nd",
     "scatter_nd_add", "masked_select", "unique", "cdist", "lu_factor",
-    "eig",
+    "eig", "cholesky",
 ]
 
 globals().update({name: _fn(name) for name in _EXPORTS})

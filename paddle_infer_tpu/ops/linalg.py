@@ -156,12 +156,23 @@ defop("triangular_solve")(
 defop("qr", vjp=False)(lambda x, mode="reduced": tuple(jnp.linalg.qr(x, mode=mode)))
 defop("svd", vjp=False)(
     lambda x, full_matrices=False: tuple(jnp.linalg.svd(x, full_matrices=full_matrices)))
-defop("eigh", vjp=False)(lambda x, UPLO="L": tuple(jnp.linalg.eigh(x, UPLO=UPLO)))
+def _eigh_impl(x, UPLO="L"):
+    # jnp.linalg.eigh symmetrizes (x+x^T)/2, which defeats UPLO — build
+    # the symmetric matrix from the requested triangle explicitly
+    tri = jnp.tril(x) if UPLO == "L" else jnp.triu(x)
+    sym = tri + jnp.swapaxes(tri, -1, -2) \
+        - jnp.eye(x.shape[-1], dtype=x.dtype) \
+        * jnp.diagonal(x, axis1=-2, axis2=-1)[..., None, :]
+    return tuple(jnp.linalg.eigh(sym, symmetrize_input=False))
+
+
+defop("eigh", vjp=False)(_eigh_impl)
 defop("det")(lambda x: jnp.linalg.det(x))
 defop("slogdet", vjp=False)(lambda x: tuple(jnp.linalg.slogdet(x)))
 defop("pinv")(lambda x, rcond=1e-15: jnp.linalg.pinv(x, rtol=rcond))
 defop("matrix_rank", vjp=False)(lambda x, tol=None: jnp.linalg.matrix_rank(x, rtol=tol))
-defop("lstsq", vjp=False)(lambda a, b: tuple(jnp.linalg.lstsq(a, b)[:2]))
+defop("lstsq", vjp=False)(lambda a, b, rcond=None:
+                          tuple(jnp.linalg.lstsq(a, b, rcond=rcond)[:2]))
 defop("trace_op")(lambda x, offset=0, axis1=0, axis2=1:
                   jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2))
 defop("kron")(lambda x, y: jnp.kron(x, y))
@@ -262,3 +273,59 @@ def _matrix_cond(x, p="2"):
     else:
         raise ValueError(f"unsupported cond norm {p!r}")
     return norm(x) * norm(inv)
+
+
+# ---- linalg namespace completion (reference tensor/linalg.py)
+@register_op("eigvals", save_inputs=False, jit=False)
+def _eigvals(x):
+    """General eigenvalues — host-side like eig (no TPU primitive)."""
+    import numpy as _np
+
+    return jnp.asarray(_np.linalg.eigvals(_np.asarray(x)))
+
+
+@register_op("matrix_exp", save_inputs=False)
+def _matrix_exp(x):
+    import jax.scipy.linalg as jsl
+
+    return jsl.expm(x)
+
+
+@register_op("lu_unpack", save_inputs=False)
+def _lu_unpack(lu, pivots, unpack_ludata=True, unpack_pivots=True):
+    """Unpack lu_factor output into (P, L, U) (reference lu_unpack op);
+    batched via vmap over leading dims."""
+    if lu.ndim > 2:
+        batch = lu.shape[:-2]
+        flat_lu = lu.reshape((-1,) + lu.shape[-2:])
+        flat_piv = pivots.reshape((-1,) + pivots.shape[-1:])
+        P, L, U = jax.vmap(
+            lambda a, b: _lu_unpack_single(a, b))(flat_lu, flat_piv)
+        out_p = P.reshape(batch + P.shape[-2:]) if unpack_pivots else None
+        return (out_p,
+                L.reshape(batch + L.shape[-2:]) if unpack_ludata else None,
+                U.reshape(batch + U.shape[-2:]) if unpack_ludata else None)
+    P, L, U = _lu_unpack_single(lu, pivots)
+    return (P if unpack_pivots else None,
+            L if unpack_ludata else None,
+            U if unpack_ludata else None)
+
+
+def _lu_unpack_single(lu, pivots):
+    n, m = lu.shape
+    k = min(n, m)
+    L = jnp.tril(lu[:, :k], -1) + jnp.eye(n, k, dtype=lu.dtype)
+    U = jnp.triu(lu[:k, :])
+    # pivots are 0-based sequential row swaps (jax.scipy lu_factor
+    # convention; NB the reference paddle op documents 1-based)
+    perm = jnp.arange(n)
+    piv = pivots.astype(jnp.int32)
+
+    def swap(p, i):
+        j = piv[i]
+        pi, pj = p[i], p[j]
+        return p.at[i].set(pj).at[j].set(pi), None
+
+    perm, _ = jax.lax.scan(swap, perm, jnp.arange(piv.shape[-1]))
+    P = jnp.eye(n, dtype=lu.dtype)[perm].T
+    return P, L, U

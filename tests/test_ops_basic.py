@@ -756,3 +756,77 @@ class TestRegularizerAndMisc:
         np.testing.assert_allclose(b_l1, b_none, atol=1e-7)
         # ...while the non-excluded weight is L1-decayed
         assert not np.allclose(w_l1, w_none)
+
+
+class TestLinalgNamespace:
+    """Public paddle.linalg namespace (reference python/paddle/linalg.py)."""
+
+    def setup_method(self, _):
+        rng = np.random.RandomState(0)
+        self.a = (rng.randn(4, 4) + 4 * np.eye(4)).astype(np.float32)
+
+    def test_namespace_is_public_module(self):
+        assert pit.linalg.__name__ == "paddle_infer_tpu.linalg"
+        for name in ["cholesky", "qr", "svd", "eigh", "eigvals", "pinv",
+                     "lstsq", "lu", "lu_unpack", "matrix_exp", "slogdet",
+                     "triangular_solve", "inv", "cond", "det"]:
+            assert hasattr(pit.linalg, name), name
+
+    def test_factorizations_reconstruct(self):
+        L = pit.linalg
+        spd = self.a @ self.a.T
+        c = L.cholesky(spd).numpy()
+        np.testing.assert_allclose(c @ c.T, spd, atol=1e-3)
+        q, r = L.qr(self.a)
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), self.a,
+                                   atol=1e-3)
+        lu_m, piv = L.lu(self.a)
+        P, Lm, U = (t.numpy() for t in L.lu_unpack(lu_m, piv))
+        np.testing.assert_allclose(P @ Lm @ U, self.a, atol=1e-3)
+        u, s, vh = L.svd(self.a)
+        np.testing.assert_allclose(
+            u.numpy() @ np.diag(s.numpy()) @ vh.numpy(), self.a,
+            atol=1e-3)
+
+    def test_eigvals_matrix_exp(self):
+        L = pit.linalg
+        w = L.eigvals(self.a).numpy()
+        np.testing.assert_allclose(np.sort(w.real),
+                                   np.sort(np.linalg.eigvals(
+                                       self.a).real), rtol=1e-3)
+        np.testing.assert_allclose(
+            L.matrix_exp(np.zeros((3, 3), np.float32)).numpy(),
+            np.eye(3), atol=1e-6)
+
+    def test_kwargs_forwarded(self):
+        """Review pins: rcond/tol/UPLO actually reach the kernels."""
+        L = pit.linalg
+        d = np.diag([1.0, 1e-6]).astype(np.float32)
+        # rcond=1e-3 truncates the tiny singular value
+        p_small = L.pinv(d, rcond=1e-3).numpy()
+        assert abs(p_small[1, 1]) < 1.0
+        p_full = L.pinv(d).numpy()
+        assert p_full[1, 1] > 1e5
+        assert int(L.matrix_rank(d, tol=1e-3).numpy()) == 1
+        assert int(L.matrix_rank(d).numpy()) == 2
+        # UPLO='U' reads the upper triangle
+        m = np.asarray([[2.0, 5.0], [0.0, 3.0]], np.float32)
+        w_u, _ = L.eigh(m, UPLO="U")
+        ref = np.linalg.eigvalsh(np.asarray([[2, 5], [5, 3]],
+                                            np.float32))
+        np.testing.assert_allclose(np.sort(w_u.numpy()), np.sort(ref),
+                                   rtol=1e-4)
+
+    def test_lu_unpack_batched_and_flags(self):
+        L = pit.linalg
+        rng = np.random.RandomState(0)
+        x = (rng.randn(3, 4, 4) + 4 * np.eye(4)).astype(np.float32)
+        lu_m, piv = L.lu(x)
+        P, Lm, U = L.lu_unpack(lu_m, piv)
+        rec = np.einsum("bij,bjk,bkl->bil", P.numpy(), Lm.numpy(),
+                        U.numpy())
+        np.testing.assert_allclose(rec, x, atol=1e-3)
+        P_only, none_l, none_u = L.lu_unpack(lu_m, piv,
+                                             unpack_ludata=False)
+        assert none_l is None and none_u is None
+        assert P_only.numpy().shape == (3, 4, 4)
