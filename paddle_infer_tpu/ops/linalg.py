@@ -153,14 +153,22 @@ defop("triangular_solve")(
     lambda a, b, upper=True, transpose=False, unitriangular=False:
     jax.scipy.linalg.solve_triangular(a, b, lower=not upper, trans=1 if transpose else 0,
                                       unit_diagonal=unitriangular))
-defop("qr", vjp=False)(lambda x, mode="reduced": tuple(jnp.linalg.qr(x, mode=mode)))
+def _qr_impl(x, mode="reduced"):
+    out = jnp.linalg.qr(x, mode=mode)
+    return out if mode == "r" else tuple(out)   # mode='r' is one array
+
+
+defop("qr", vjp=False)(_qr_impl)
 defop("svd", vjp=False)(
     lambda x, full_matrices=False: tuple(jnp.linalg.svd(x, full_matrices=full_matrices)))
 def _eigh_impl(x, UPLO="L"):
     # jnp.linalg.eigh symmetrizes (x+x^T)/2, which defeats UPLO — build
     # the symmetric matrix from the requested triangle explicitly
     tri = jnp.tril(x) if UPLO == "L" else jnp.triu(x)
-    sym = tri + jnp.swapaxes(tri, -1, -2) \
+    other = jnp.swapaxes(tri, -1, -2)
+    if jnp.iscomplexobj(x):
+        other = jnp.conj(other)     # Hermitian, not merely symmetric
+    sym = tri + other \
         - jnp.eye(x.shape[-1], dtype=x.dtype) \
         * jnp.diagonal(x, axis1=-2, axis2=-1)[..., None, :]
     return tuple(jnp.linalg.eigh(sym, symmetrize_input=False))

@@ -235,3 +235,72 @@ def sum(x, axis=None, dtype=None, keepdim=False):
 
 def is_same_shape(x, y):
     return tuple(x.shape) == tuple(y.shape)
+
+
+def coalesce(x):
+    """Merge duplicate coordinates (reference sparse/coalesce_kernel).
+    Eager (never jitted), so the true post-merge nse is used — keeping
+    the old nse would leave phantom zero rows at out-of-range indices."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("coalesce expects a SparseCooTensor")
+    return SparseCooTensor(x._bcoo.sum_duplicates())
+
+
+def mv(x, vec):
+    """Sparse matrix @ dense vector (reference sparse/mv_kernel)."""
+    v = _arr(vec)
+    if v.ndim != 1:
+        raise ValueError("mv expects a 1-D vector")
+    return Tensor(_coo(x) @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """beta*input + alpha*(x @ y) with sparse x (reference
+    sparse/addmm_kernel)."""
+    return Tensor(beta * _arr(input) + alpha * (_coo(x) @ _arr(y)))
+
+
+class _SparseNN:
+    """paddle.sparse.nn surface (reference python/paddle/sparse/nn):
+    activations on sparse values."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    class Softmax:
+        """Row-wise softmax over CSR rows (reference
+        sparse/softmax_kernel): only stored values participate."""
+
+        def __init__(self, axis=-1):
+            self.axis = axis
+
+        def __call__(self, x):
+            if not isinstance(x, SparseCsrTensor):
+                raise TypeError("sparse.nn.Softmax expects CSR")
+            if self.axis not in (-1, 1):
+                raise ValueError(
+                    "sparse.nn.Softmax supports the last axis only "
+                    "(reference kernel contract)")
+            bcsr = x._bcsr
+            dense = jnp.asarray(bcsr.todense())
+            # mask out non-stored entries so they don't contribute
+            mask = jnp.asarray(
+                jsparse.BCSR((jnp.ones_like(bcsr.data), bcsr.indices,
+                              bcsr.indptr), shape=bcsr.shape).todense())
+            neg = jnp.where(mask > 0, dense, -jnp.inf)
+            ex = jnp.exp(neg - jnp.max(neg, axis=-1, keepdims=True))
+            soft = ex / jnp.sum(ex, axis=-1, keepdims=True)
+            soft = jnp.where(mask > 0, soft, 0.0)
+            return dense_to_csr(Tensor(soft))
+
+
+nn = _SparseNN()
+
+
+def dense_to_csr(t):
+    d = _arr(t)
+    return SparseCsrTensor(jsparse.BCSR.fromdense(d))
+
+
+__all__ += ["coalesce", "mv", "addmm", "nn"]

@@ -376,3 +376,53 @@ class TestFlopsUtility:
                                np.ones((2, 3), np.float32)), 1)
         np.testing.assert_allclose(d.mean.numpy(), 1.5)
         np.testing.assert_allclose(d.variance.numpy(), 1.0)
+
+
+class TestSparseRound3:
+    def test_coalesce_mv_addmm(self):
+        import jax.numpy as jnp
+
+        from paddle_infer_tpu import sparse as S
+
+        # duplicate coordinate -> coalesce sums it
+        import paddle_infer_tpu as pit
+
+        coo = S.sparse_coo_tensor([[0, 0, 1], [1, 1, 0]],
+                                  [1.0, 2.0, 3.0], shape=[2, 2])
+        c = S.coalesce(coo)
+        np.testing.assert_allclose(c.to_dense().numpy(),
+                                   [[0, 3], [3, 0]])
+        v = np.asarray([1.0, 2.0], np.float32)
+        np.testing.assert_allclose(S.mv(c, v).numpy(), [6.0, 3.0])
+        base = np.ones((2, 2), np.float32)
+        y = np.eye(2, dtype=np.float32)
+        out = S.addmm(base, c, y, beta=0.5, alpha=2.0).numpy()
+        np.testing.assert_allclose(out, 0.5 + 2.0 * np.asarray(
+            [[0, 3], [3, 0]], np.float32))
+
+    def test_sparse_nn_softmax(self):
+        from paddle_infer_tpu import sparse as S
+
+        d = np.asarray([[1.0, 0.0, 2.0], [0.0, 5.0, 0.0]], np.float32)
+        csr = S.dense_to_csr(d)
+        out = S.nn.Softmax()(csr).to_dense().numpy()
+        # row 0: softmax over stored {1, 2}; zeros stay zero
+        e = np.exp([1.0, 2.0])
+        np.testing.assert_allclose(out[0], [e[0] / e.sum(), 0,
+                                            e[1] / e.sum()], rtol=1e-5)
+        np.testing.assert_allclose(out[1], [0, 1.0, 0], rtol=1e-6)
+
+    def test_review_pins(self):
+        from paddle_infer_tpu import sparse as S
+        import paddle_infer_tpu as pit
+
+        coo = S.sparse_coo_tensor([[0, 0, 1], [1, 1, 0]],
+                                  [1.0, 2.0, 3.0], shape=[2, 2])
+        c = S.coalesce(coo)
+        assert c.nnz == 2                      # phantom rows gone
+        with pytest.raises(ValueError):
+            S.nn.Softmax(axis=0)(S.dense_to_csr(
+                np.eye(2, dtype=np.float32)))
+        # qr mode='r' returns the R matrix, not a tuple
+        r = pit.linalg.qr(np.eye(3, dtype=np.float32), mode="r")
+        assert r.numpy().shape == (3, 3)
