@@ -266,7 +266,10 @@ class Categorical(Distribution):
         idx = jax.random.categorical(
             prandom.next_key(), self.logits._data,
             shape=shape if shape else None)
-        return Tensor(jnp.asarray(idx, jnp.int64))
+        # int64 when x64 is enabled, else the canonical int32 — avoids
+        # jax's silent-truncation warning while keeping paddle's dtype
+        itype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        return Tensor(jnp.asarray(idx, itype))
 
     def log_prob(self, value):
         v = value._data if isinstance(value, Tensor) else jnp.asarray(value)

@@ -425,8 +425,13 @@ def delete_dropout_pass(program: Program) -> Program:
     the dropout output read its input instead."""
     mapping = {}
     kept = []
+    consumers = program.consumers()
     for op in program.ops:
-        if op.name == "dropout":
+        # only delete when every extra output (e.g. a mask) is unread —
+        # otherwise a consumer would reference a producer-less var
+        if op.name == "dropout" and not any(
+                consumers.get(o) or o in program.fetch_ids
+                for o in op.outputs[1:]):
             mapping[op.outputs[0]] = op.inputs[0]
         else:
             kept.append(op)

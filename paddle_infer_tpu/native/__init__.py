@@ -59,18 +59,32 @@ def _build() -> bool:
         return False
 
 
+def _stale() -> bool:
+    """True when any .cc/.h/Makefile is newer than the built library."""
+    if not os.path.exists(_LIB_PATH):
+        return False
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for name in os.listdir(_NATIVE_DIR):
+        if name.endswith((".cc", ".h")) or name == "Makefile":
+            if os.path.getmtime(os.path.join(_NATIVE_DIR, name)) > lib_mtime:
+                return True
+    return False
+
+
 def _load():
     global _lib, _load_error, _build_attempted
     if _lib is not None:
         return _lib
     if _load_error is not None:
         return None            # failure latched: don't re-spawn make
-    if not os.path.exists(_LIB_PATH):
+    if not os.path.exists(_LIB_PATH) or _stale():
         if _build_attempted or not _build():
             _build_attempted = True
-            _load_error = (
-                f"native library missing and build failed ({_LIB_PATH})")
-            return None
+            if not os.path.exists(_LIB_PATH):
+                _load_error = (
+                    f"native library missing and build failed ({_LIB_PATH})")
+                return None
+            # stale but rebuild failed: fall through and use what exists
         _build_attempted = True
     try:
         lib = ctypes.CDLL(_LIB_PATH)

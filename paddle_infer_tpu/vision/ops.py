@@ -34,6 +34,8 @@ def nms(boxes, iou_threshold: float = 0.3, scores=None,
     data-dependent, which XLA cannot express; batched multiclass via
     ``category_idxs`` offsets boxes per class like the reference."""
     b = np.asarray(_arr(boxes), np.float32)
+    if b.shape[0] == 0:
+        return Tensor(jnp.asarray(np.zeros((0,), np.int64)))
     if scores is not None:
         s = np.asarray(_arr(scores), np.float32)
         order = np.argsort(-s)
