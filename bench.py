@@ -17,10 +17,25 @@ attention term* (12·L·s·hidden per token), and an XLA-compiler-derived
 number from the compiled step's cost_analysis() — the profiler-grade backing
 for the analytic claim.  ``vs_baseline`` keeps the (conservative) analytic
 definition for round-over-round comparability.
+
+Robustness (round-3 verdict, "next round" #1 — r03 died rc=1 on a flaky
+TPU backend init and left the round with no perf evidence): the script now
+runs the measurement in a CHILD process.  The parent retries the TPU child
+on failure, then falls back to a CPU child, and ALWAYS prints a JSON line —
+on total failure the line carries an "error" field instead of the process
+dying.  The child also runs a real-hardware Pallas kernel smoke (flash
+fwd/bwd + paged decode vs the XLA/interpret reference), reports both the
+single-block and best-of-3 throughput estimators (the r02 baseline was
+single-block; ADVICE r3), and gates the decode p50 against the absolute
+targets recorded in BASELINE.md plus the previous round's number.
 """
 from __future__ import annotations
 
+import glob
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -35,6 +50,98 @@ PEAK_BF16_FLOPS = {
     "cpu": 1e12,        # nominal, so the script stays meaningful off-TPU
 }
 
+DECODE_P50_TARGET_MS = 1.70          # BASELINE.md round-4 addendum
+DECODE_MARGINAL_TARGET_MS = 1.0
+
+_REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline"}
+
+
+# --------------------------------------------------------------------------
+# parent: retry / fallback orchestration
+# --------------------------------------------------------------------------
+
+def _last_json(stdout: str):
+    for ln in reversed(stdout.strip().splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                obj = json.loads(ln)
+            except ValueError:
+                continue
+            if _REQUIRED_KEYS <= set(obj):
+                return obj
+    return None
+
+
+def _parent() -> int:
+    # the tunnel-backed TPU registration fails transiently (observed in
+    # r03 and r04 dev runs: "register() failed" → backend absent), so
+    # retry with growing backoff before surrendering to CPU
+    attempts = [("tpu", 3300, 0), ("tpu", 3300, 30), ("tpu", 3300, 90),
+                ("tpu", 3300, 180), ("cpu", 1500, 0)]
+    errors = []
+    for platform, timeout, backoff in attempts:
+        env = os.environ.copy()
+        env["PIT_BENCH_CHILD"] = "1"
+        if platform == "tpu":
+            # leave the env untouched: the TPU appears through the
+            # container's default backend registration.  The child
+            # refuses (rc=3) if it lands on a non-TPU backend so a
+            # silent in-process fallback can't masquerade as TPU data.
+            env["PIT_BENCH_REQUIRE_TPU"] = "1"
+            # a caller-set PYTHONPATH can hide the sitecustomize hook
+            # that registers the backend — re-append its directory
+            try:
+                import sitecustomize as _sc
+
+                sc_dir = os.path.dirname(os.path.abspath(_sc.__file__))
+                paths = env.get("PYTHONPATH", "").split(os.pathsep)
+                if sc_dir not in paths:
+                    env["PYTHONPATH"] = os.pathsep.join(
+                        p for p in (env.get("PYTHONPATH"), sc_dir) if p)
+            except ImportError:
+                pass
+        else:
+            env.pop("PALLAS_AXON_POOL_IPS", None)   # axon shim can hang CPU
+            env.pop("PIT_BENCH_REQUIRE_TPU", None)
+            env["JAX_PLATFORMS"] = "cpu"
+        if backoff:
+            time.sleep(backoff)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                env=env, capture_output=True, text=True, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            errors.append(f"{platform}: timeout after {timeout}s")
+            continue
+        if proc.stderr:
+            sys.stderr.write(proc.stderr[-4000:])
+        result = _last_json(proc.stdout)
+        if proc.returncode == 0 and result is not None:
+            if platform == "cpu":
+                result["vs_baseline"] = 0.0
+                result["error"] = (
+                    "TPU backend unavailable after retries; CPU-fallback "
+                    "numbers, NOT comparable to the baseline: "
+                    + " | ".join(errors))
+            elif errors:
+                result["bench_attempts"] = errors
+            print(json.dumps(result))
+            return 0
+        tail = ""
+        if proc.stderr.strip():
+            tail = proc.stderr.strip().splitlines()[-1][:300]
+        errors.append(f"{platform}: rc={proc.returncode} {tail}")
+    print(json.dumps({
+        "metric": "ernie3.0-base train tokens/sec/chip",
+        "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+        "error": "all bench attempts failed: " + " | ".join(errors)}))
+    return 0          # a JSON line was printed; never die rc!=0
+
+
+# --------------------------------------------------------------------------
+# child: the actual measurement
+# --------------------------------------------------------------------------
 
 def _peak_flops() -> float:
     import jax
@@ -47,7 +154,127 @@ def _peak_flops() -> float:
     return PEAK_BF16_FLOPS["v5lite" if dev.platform == "tpu" else "cpu"]
 
 
-def main():
+def _prev_decode_p50():
+    """Latest recorded decode p50 from BENCH_r*.json (round-over-round
+    gate, round-3 verdict weak #2)."""
+    root = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            parsed = rec.get("parsed") or {}
+            val = parsed.get("decode_p50_ms_per_token_bs1")
+            if val is not None:
+                best = float(val)
+        except Exception:
+            continue
+    return best
+
+
+def _kernel_smoke(on_tpu: bool) -> dict:
+    """Real-hardware Pallas validation (round-3 verdict weak #4: kernels
+    were CI-tested only in interpret mode).  Runs the flash fwd/bwd with
+    segment ids + dropout against the XLA sdpa (the hash-counter dropout
+    RNG is implementation-independent, so outputs must agree), and the
+    paged decode kernel against its interpret-mode reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_infer_tpu.ops.attention import _xla_sdpa
+    from paddle_infer_tpu.ops.pallas.flash_attention import (
+        flash_attention, hybrid_attention)
+    from paddle_infer_tpu.ops.pallas.paged_attention import (
+        paged_attention_decode)
+
+    out = {}
+    b, s, h, d = 2, 512, 4, 64
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, h, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, h, d), jnp.float32)
+    # trailing 64 positions are padding (segment id 0 vs content id 1)
+    seg = (jnp.arange(s) < s - 64).astype(jnp.int32)[None, :].repeat(b, 0)
+    seed = jnp.uint32(1234)
+    tol = 5e-2 if on_tpu else 1e-4      # TPU f32 matmul default precision
+
+    def ref_fn(q_):
+        return _xla_sdpa(q_, k, v, None, seed, 0.1, True, None,
+                         q_segment_ids=seg, kv_segment_ids=seg).sum()
+
+    ref_out = _xla_sdpa(q, k, v, None, seed, 0.1, True, None,
+                        q_segment_ids=seg, kv_segment_ids=seg)
+    ref_dq = jax.grad(ref_fn)(q)
+    for name, fn in (("flash", flash_attention), ("hybrid",
+                                                  hybrid_attention)):
+        o = fn(q, k, v, q_segment_ids=seg, kv_segment_ids=seg,
+               dropout_p=0.1, dropout_seed=seed, is_causal=True)
+        dq = jax.grad(lambda q_: fn(
+            q_, k, v, q_segment_ids=seg, kv_segment_ids=seg, dropout_p=0.1,
+            dropout_seed=seed, is_causal=True).sum())(q)
+        fwd_err = float(jnp.max(jnp.abs(o - ref_out)))
+        bwd_err = float(jnp.max(jnp.abs(dq - ref_dq)))
+        status = "ok" if (fwd_err < tol and bwd_err < tol) else "FAIL"
+        out[name] = f"{status} fwd_err={fwd_err:.2e} bwd_err={bwd_err:.2e}"
+
+    # paged decode: real kernel vs interpret-mode reference
+    pages, page_size = 8, 16
+    kp = jax.random.normal(ks[0], (pages, h, page_size, d), jnp.float32)
+    vp = jax.random.normal(ks[1], (pages, h, page_size, d), jnp.float32)
+    qd = jax.random.normal(ks[2], (b, h, d), jnp.float32)
+    tables = jnp.asarray([[1, 2, 3, 0], [4, 5, 0, 0]], jnp.int32)
+    lengths = jnp.asarray([37, 20], jnp.int32)
+    got = paged_attention_decode(qd, kp, vp, tables, lengths,
+                                 interpret=False)
+    want = paged_attention_decode(qd, kp, vp, tables, lengths,
+                                  interpret=True)
+    err = float(jnp.max(jnp.abs(got - want)))
+    out["paged_decode"] = ("ok" if err < tol else "FAIL") \
+        + f" err={err:.2e}"
+    return out
+
+
+def _resnet50_throughput(on_tpu: bool):
+    """ResNet-50 training throughput (BASELINE.md milestone #3, unbenched
+    until round 4).  bf16 AMP, SGD momentum, synthetic ImageNet batch."""
+    import jax
+
+    import paddle_infer_tpu as pit
+    from paddle_infer_tpu.parallel import (DistributedStrategy,
+                                           FleetTrainStep, fleet)
+    from paddle_infer_tpu.vision.models import resnet50
+
+    batch = 64 if on_tpu else 2
+    size = 224 if on_tpu else 32
+    model = resnet50()
+    model.train()
+    opt = pit.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                 parameters=model.parameters())
+    strategy = DistributedStrategy()
+    strategy.amp = True
+    strategy.amp_configs = {"level": "O2", "dtype": "bfloat16"}
+
+    def loss_fn(m, x, y):
+        return pit.nn.functional.cross_entropy(m(x), y)
+
+    step = FleetTrainStep(model, loss_fn, opt, strategy=strategy)
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, 3, size, size).astype(np.float32)
+    y = rng.randint(0, 1000, (batch,)).astype(np.int32)
+    step(x, y)
+    step(x, y).numpy()                     # compile + settle
+    iters = 20 if on_tpu else 2
+    dt = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(x, y)
+        loss.numpy()
+        dt = min(dt, time.perf_counter() - t0)
+    return batch * iters / dt
+
+
+def _child_main():
     import jax
 
     import paddle_infer_tpu as pit
@@ -57,6 +284,10 @@ def main():
                                            FleetTrainStep, fleet)
 
     on_tpu = jax.devices()[0].platform == "tpu"
+    if os.environ.get("PIT_BENCH_REQUIRE_TPU") and not on_tpu:
+        print(f"child: TPU required but backend is "
+              f"{jax.devices()[0].platform}", file=sys.stderr)
+        return 3
     batch, seq = (32, 512) if on_tpu else (4, 128)
 
     # real pretraining config: dropout 0.1, padded batches (not the clean
@@ -103,19 +334,21 @@ def main():
     step(ids, mask, labels, nsp)
     step(ids, mask, labels, nsp).numpy()
 
-    # best-of-3 timing blocks: the dev chip is shared and a single block
-    # can catch another tenant's burst (observed ±13% run-to-run); noise
-    # only ever slows a block, so max-throughput is the honest estimator
+    # both estimators (ADVICE r3): blocks[0] is the single-block estimate
+    # comparable with r01/r02; min(blocks) is best-of-3 — the dev chip is
+    # shared and another tenant's burst only ever slows a block
     iters = 30 if on_tpu else 5
-    dt = float("inf")
+    blocks = []
     for _ in range(3 if on_tpu else 1):
         t0 = time.perf_counter()
         for _ in range(iters):
             loss = step(ids, mask, labels, nsp)
         loss.numpy()   # sync
-        dt = min(dt, time.perf_counter() - t0)
+        blocks.append(time.perf_counter() - t0)
+    dt = min(blocks)
 
     tokens_per_sec = batch * seq * iters / dt
+    tokens_per_sec_single = batch * seq * iters / blocks[0]
     n_params = sum(int(p.size) for p in model.parameters())
     # 6ND fwd+bwd + the attention term (2 matmuls of 2·s·hidden each, x3
     # for fwd+bwd: 12·L·s·hidden per token; ERNIE attends bidirectionally
@@ -135,8 +368,6 @@ def main():
         if xla_flops > 0:
             mfu_xla = xla_flops * iters / dt / peak
     except Exception as e:
-        import sys
-
         print(f"cost_analysis skipped: {e!r}", file=sys.stderr)
 
     # one xplane capture of the measured region (round-2 verdict item 9);
@@ -153,16 +384,38 @@ def main():
         except Exception:
             xplane_dir = None
 
+    # real-hardware kernel smoke (never kills the headline)
+    kernel_smoke = None
+    if on_tpu:
+        try:
+            kernel_smoke = _kernel_smoke(on_tpu)
+        except Exception as e:
+            kernel_smoke = {"error": repr(e)[:200]}
+
+    # ResNet-50 milestone (#3) throughput
+    resnet_ips = None
+    if on_tpu:
+        try:
+            resnet_ips = _resnet50_throughput(on_tpu)
+        except Exception as e:
+            print(f"resnet50 bench skipped: {e!r}", file=sys.stderr)
+
     # the latency bench needs the native runtime (paged-KV pool); never let
     # it take down the training metric
     try:
         p50_ms, marginal_ms, marginal_int8_ms = _decode_latency_bs1(on_tpu)
         p50_ms = round(p50_ms, 3)
     except Exception as e:
-        import sys
-
         print(f"decode latency bench skipped: {e!r}", file=sys.stderr)
         p50_ms = marginal_ms = marginal_int8_ms = None
+
+    # LLaMA-architecture paged decode (BASELINE milestone #5, scaled-down)
+    llama_marginal = None
+    if on_tpu:
+        try:
+            llama_marginal = _llama_decode_marginal()
+        except Exception as e:
+            print(f"llama decode bench skipped: {e!r}", file=sys.stderr)
 
     result = {
         "metric": "ernie3.0-base train tokens/sec/chip "
@@ -172,19 +425,35 @@ def main():
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.35, 3),
         "mfu_6nt_plus_attn": round(mfu, 4),
+        "tokens_per_sec_single_block": round(tokens_per_sec_single, 1),
     }
     if mfu_xla is not None:
         result["mfu_xla_cost_analysis"] = round(mfu_xla, 4)
     if xplane_dir is not None:
         result["xplane_dir"] = xplane_dir
+    if kernel_smoke is not None:
+        result["kernel_smoke"] = kernel_smoke
+    if resnet_ips is not None:
+        result["resnet50_train_img_per_sec"] = round(resnet_ips, 1)
     if p50_ms is not None:
         result["decode_p50_ms_per_token_bs1"] = p50_ms
+        result["decode_p50_target_ms"] = DECODE_P50_TARGET_MS
+        result["decode_within_target"] = bool(
+            p50_ms <= DECODE_P50_TARGET_MS)
+        prev = _prev_decode_p50()
+        if prev is not None:
+            result["decode_p50_prev_round"] = prev
     if marginal_ms is not None:
         result["decode_marginal_ms_per_token_bs1"] = round(marginal_ms, 3)
+        result["decode_marginal_target_ms"] = DECODE_MARGINAL_TARGET_MS
     if marginal_int8_ms is not None:
         result["decode_marginal_ms_per_token_bs1_int8"] = round(
             marginal_int8_ms, 3)
+    if llama_marginal is not None:
+        result["llama_decode_marginal_ms_per_token_bs1"] = round(
+            llama_marginal, 3)
     print(json.dumps(result))
+    return 0
 
 
 def _decode_latency_bs1(on_tpu: bool):
@@ -268,11 +537,52 @@ def _decode_latency_bs1(on_tpu: bool):
                                          prompt_bucket=prompt)
             marginal_int8 = _marginal(engq)
         except Exception as e:
-            import sys
-
             print(f"int8 decode bench skipped: {e!r}", file=sys.stderr)
     return p50_whole, marginal, marginal_int8
 
 
+def _llama_decode_marginal():
+    """Marginal per-token paged decode for a scaled-down LLaMA
+    architecture (RoPE + RMSNorm + SwiGLU; BASELINE.md milestone #5 bench
+    entry — 7B itself exceeds one dev chip's useful bench window)."""
+    import jax.numpy as jnp
+
+    import paddle_infer_tpu as pit
+    from paddle_infer_tpu.inference import (GenerationConfig,
+                                            PagedGenerationEngine)
+    from paddle_infer_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    pit.seed(0)
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                      num_hidden_layers=8, num_attention_heads=8,
+                      intermediate_size=2816,
+                      max_position_embeddings=1024)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    for p in model.parameters():
+        p._data = p._data.astype(jnp.bfloat16)
+    prompt, max_new, reps = 128, 64, 10
+    eng = PagedGenerationEngine(model, page_size=16, prompt_bucket=prompt)
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (1, prompt)).astype(np.int32)
+    g_long = GenerationConfig(max_new_tokens=max_new)
+    g_short = GenerationConfig(max_new_tokens=max_new // 2)
+    eng.generate(ids, g_long)
+    eng.generate(ids, g_short)
+    t_long, t_short = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        eng.generate(ids, g_long)
+        t_long.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        eng.generate(ids, g_short)
+        t_short.append(time.perf_counter() - t0)
+    m = ((np.percentile(t_long, 50) - np.percentile(t_short, 50))
+         / (max_new - max_new // 2) * 1e3)
+    return float(max(m, 0.0))
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv or os.environ.get("PIT_BENCH_CHILD"):
+        sys.exit(_child_main())
+    sys.exit(_parent())

@@ -1,0 +1,310 @@
+"""Distributed checkpointing with mesh resharding.
+
+Reference: the fork saves per-rank optimizer shards
+(fleet/meta_parallel/sharding/group_sharded_optimizer_stage2.py:51 — each
+rank owns a slice of the flattened slots) and auto-parallel checkpoints
+via dist_saver.py (per-rank files + a dist_attr map used to re-split on a
+different parallel config).
+
+TPU-first redesign: every array in a train state is a jax.Array whose
+NamedSharding already IS the dist_attr.  Save = each host writes the
+raw-bytes chunks it is primary for (``addressable_shards`` with
+replica_id 0) plus a JSON manifest of global shapes/dtypes/chunk offsets;
+load = ``jax.make_array_from_callback`` assembles each device's shard of
+the NEW sharding directly from the mmap'd chunks — so a checkpoint taken
+on pp=2×mp=2 resumes bit-exact on dp=8 (or any other factorization)
+without ever materialising the full state on one host.  No gather at
+save, no scatter at load, chunks stream host→device per shard.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+_MANIFEST = "manifest.json"
+
+
+def _np_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _safe(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.\-]", "_", name)
+
+
+def _spec_list(spec) -> list:
+    out = []
+    for s in tuple(spec):
+        out.append(list(s) if isinstance(s, tuple) else s)
+    return out
+
+
+# ------------------------------------------------------------------- save
+
+def _save_array(name: str, arr, dirpath: str) -> Dict[str, Any]:
+    """Write this process's primary chunks of ``arr``; return its manifest
+    entry.  Works for replicated, host-local, and arbitrarily sharded
+    arrays."""
+    arr = arr if isinstance(arr, jax.Array) else jax.numpy.asarray(arr)
+    meta = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+            "chunks": []}
+    try:
+        meta["spec"] = _spec_list(arr.sharding.spec)
+    except Exception:
+        meta["spec"] = None
+    seen = set()
+    for sh in arr.addressable_shards:
+        if sh.replica_id != 0:
+            continue
+        starts = [0 if s.start is None else int(s.start) for s in sh.index]
+        while len(starts) < arr.ndim:
+            starts.append(0)
+        key = "_".join(map(str, starts)) or "0"
+        if key in seen:
+            continue
+        seen.add(key)
+        data = np.asarray(sh.data)
+        fname = f"{_safe(name)}@{key}.bin"
+        data.tofile(os.path.join(dirpath, fname))
+        meta["chunks"].append({"file": fname, "starts": starts,
+                               "shape": list(data.shape)})
+    return meta
+
+
+def save_distributed(state: Dict[str, Any], path: str,
+                     extra: Optional[dict] = None) -> None:
+    """Save a (possibly nested one level) dict of arrays as per-host
+    chunks + manifest.  Multi-host: every process calls this; process 0
+    writes the manifest (chunk entries are merged via per-process
+    manifest fragments)."""
+    os.makedirs(path, exist_ok=True)
+    if jax.process_count() == 1:
+        # wipe any previous checkpoint in the directory so stale chunk
+        # files can't bleed into a smaller re-save
+        for f in os.listdir(path):
+            if f.endswith(".bin") or f.startswith("manifest"):
+                os.remove(os.path.join(path, f))
+    elif os.path.exists(os.path.join(path, _MANIFEST)):
+        raise ValueError(
+            f"{path} already holds a checkpoint; multi-host saves "
+            "cannot safely overwrite in place — use a fresh directory")
+    arrays = {}
+    for name, v in state.items():
+        if isinstance(v, dict):
+            for k, a in v.items():
+                arrays[f"{name}/{k}"] = a
+        else:
+            arrays[name] = v
+    manifest = {"arrays": {}, "extra": extra or {}}
+    for name, arr in arrays.items():
+        manifest["arrays"][name] = _save_array(name, arr, path)
+    pid = jax.process_index()
+    if jax.process_count() > 1:
+        # per-process fragment, written atomically (rename) so the rank-0
+        # merge can never read a half-written file; rank 0 merges
+        frag = os.path.join(path, f"manifest.{pid}.json")
+        tmp = frag + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, frag)
+        if pid == 0:
+            _merge_fragments(path, manifest)
+    else:
+        with open(os.path.join(path, _MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=1)
+
+
+def _merge_fragments(path: str, base: dict) -> None:
+    import glob as _glob
+    import time
+
+    deadline = time.time() + float(
+        os.environ.get("PIT_CKPT_MERGE_TIMEOUT", "600"))
+    frags = []
+    want = jax.process_count()
+    while True:
+        frags = sorted(f for f in _glob.glob(
+            os.path.join(path, "manifest.*.json"))
+            if not f.endswith(".tmp"))
+        if len(frags) >= want:
+            break
+        if time.time() > deadline:
+            raise TimeoutError(
+                f"checkpoint merge: only {len(frags)}/{want} manifest "
+                f"fragments appeared in {path} — a truncated manifest "
+                "would corrupt the checkpoint, refusing to write it")
+        time.sleep(0.5)
+    merged = {n: dict(m, chunks=list(m["chunks"]))
+              for n, m in base["arrays"].items()}
+    for frag in frags:
+        with open(frag) as f:
+            other = json.load(f)
+        for n, m in other["arrays"].items():
+            entry = merged.setdefault(n, dict(m, chunks=[]))
+            have = {c["file"] for c in entry["chunks"]}
+            entry["chunks"].extend(c for c in m["chunks"]
+                                   if c["file"] not in have)
+    with open(os.path.join(path, _MANIFEST), "w") as f:
+        json.dump({"arrays": merged, "extra": base["extra"]}, f, indent=1)
+
+
+# ------------------------------------------------------------------- load
+
+class _ChunkReader:
+    def __init__(self, path: str, meta: dict):
+        self.path = path
+        self.meta = meta
+        self.dtype = _np_dtype(meta["dtype"])
+        self._mmaps: dict = {}
+
+    def _mm(self, chunk):
+        mm = self._mmaps.get(chunk["file"])
+        if mm is None:
+            mm = np.memmap(os.path.join(self.path, chunk["file"]),
+                           dtype=self.dtype, mode="r",
+                           shape=tuple(chunk["shape"]))
+            self._mmaps[chunk["file"]] = mm
+        return mm
+
+    def region(self, starts, stops) -> np.ndarray:
+        """Assemble the half-open global region [starts, stops) from the
+        stored chunks."""
+        shape = tuple(b - a for a, b in zip(starts, stops))
+        out = np.empty(shape, self.dtype)
+        filled = 0
+        for c in self.meta["chunks"]:
+            cs = c["starts"]
+            ce = [s + n for s, n in zip(cs, c["shape"])]
+            lo = [max(a, s) for a, s in zip(starts, cs)]
+            hi = [min(b, e) for b, e in zip(stops, ce)]
+            if any(a >= b for a, b in zip(lo, hi)):
+                continue
+            src = tuple(slice(a - s, b - s)
+                        for a, s, b in zip(lo, cs, hi))
+            dst = tuple(slice(a - s, b - s)
+                        for a, s, b in zip(lo, starts, hi))
+            out[dst] = self._mm(c)[src]
+            filled += int(np.prod([b - a for a, b in zip(lo, hi)]))
+        if filled < int(np.prod(shape)):
+            raise ValueError(
+                f"checkpoint chunks do not cover region {starts}..{stops} "
+                "(incomplete multi-host checkpoint?)")
+        return out
+
+
+def _load_array(reader: _ChunkReader, mesh, spec):
+    shape = tuple(reader.meta["shape"])
+
+    if mesh is None:
+        return reader.region([0] * len(shape), list(shape))
+
+    sharding = NamedSharding(mesh, spec if spec is not None else P())
+
+    def cb(index):
+        starts = [0 if s.start is None else int(s.start) for s in index]
+        stops = [shape[i] if s.stop is None else int(s.stop)
+                 for i, s in enumerate(index)]
+        while len(starts) < len(shape):
+            i = len(starts)
+            starts.append(0)
+            stops.append(shape[i])
+        return reader.region(starts, stops)
+
+    return jax.make_array_from_callback(shape, sharding, cb)
+
+
+def load_distributed(path: str, mesh=None, specs: Optional[dict] = None):
+    """Load a checkpoint.  ``mesh`` None → full numpy arrays on host.
+    With a mesh: each array is placed with ``specs[name]`` (PartitionSpec;
+    default = the spec recorded at save time filtered to the new mesh's
+    axes), assembling each device shard straight from the chunk files —
+    the resharding path.  Returns (state, extra)."""
+    with open(os.path.join(path, _MANIFEST)) as f:
+        manifest = json.load(f)
+    state: Dict[str, Any] = {}
+    for name, meta in manifest["arrays"].items():
+        reader = _ChunkReader(path, meta)
+        spec = None
+        if mesh is not None:
+            if specs is not None and name in specs:
+                spec = specs[name]
+            else:
+                spec = _restore_spec(meta.get("spec"), mesh,
+                                     tuple(meta["shape"]))
+        arr = _load_array(reader, mesh, spec)
+        if "/" in name:
+            outer, inner = name.split("/", 1)
+            state.setdefault(outer, {})[inner] = arr
+        else:
+            state[name] = arr
+    return state, manifest.get("extra", {})
+
+
+def _restore_spec(saved, mesh, shape) -> P:
+    """The saved spec filtered to axes the new mesh has and dims they
+    divide — replicate anything else."""
+    if saved is None:
+        return P()
+    sizes = dict(mesh.shape)
+    out = []
+    for i, s in enumerate(saved):
+        axes = s if isinstance(s, list) else ([s] if s else [])
+        keep = [a for a in axes if sizes.get(a, 1) > 1]
+        size = int(np.prod([sizes[a] for a in keep])) if keep else 1
+        if keep and i < len(shape) and shape[i] % size == 0:
+            out.append(tuple(keep) if len(keep) > 1 else keep[0])
+        else:
+            out.append(None)
+    return P(*out)
+
+
+# -------------------------------------------------- FleetTrainStep facade
+
+def save_train_state(step, path: str) -> None:
+    """Checkpoint a FleetTrainStep's sharded params + optimizer slots
+    (reference: dist_saver.save + the stage-2 per-rank optimizer files)."""
+    state = {f"param/{n}": a for n, a in step.params.items()}
+    if step.opt_state is not None:
+        for n, slots in step.opt_state.items():
+            for k, a in slots.items():
+                state[f"opt/{n}/{k}"] = a
+    save_distributed(state, path,
+                     extra={"step_count": int(step._step_count)})
+
+
+def load_train_state(step, path: str) -> None:
+    """Resume a FleetTrainStep from ``path`` onto ITS mesh/strategy —
+    which may factorize differently from the one that saved (the
+    dist_saver re-split, done by re-assembly instead of re-split)."""
+    if step.opt_state is None:
+        step._init_opt_state()
+    specs = {}
+    for n in step.params:
+        specs[f"param/{n}"] = step._param_specs[n]
+    for n, slots in step.opt_state.items():
+        for k in slots:
+            specs[f"opt/{n}/{k}"] = step._opt_specs[n][k]
+    state, extra = load_distributed(path, mesh=step.mesh, specs=specs)
+    # load_distributed re-nests on the first "/": state["param"][name],
+    # state["opt"]["<pname>/<slot>"]
+    params = state.get("param", {})
+    for n in step.params:
+        if n not in params:
+            raise KeyError(f"checkpoint missing param {n}")
+        step.params[n] = params[n]
+    for key, a in state.get("opt", {}).items():
+        pname, slot = key.rsplit("/", 1)
+        if pname in step.opt_state and slot in step.opt_state[pname]:
+            step.opt_state[pname][slot] = a
+    step._step_count = int(extra.get("step_count", step._step_count))
+    step.sync_params_to_model()

@@ -350,7 +350,8 @@ class PassManager:
     editable like pass_builder()->DeletePass()."""
 
     DEFAULT = ["delete_dropout_pass", "constant_fold_pass",
-               "fuse_matmul_add_pass", "dce_pass"]
+               "fuse_matmul_add_pass", "fuse_attention_pass",
+               "fuse_ffn_pass", "dce_pass"]
 
     def __init__(self, passes: Optional[List[str]] = None):
         self.passes = list(self.DEFAULT if passes is None else passes)
@@ -474,6 +475,194 @@ def fuse_matmul_add_pass(program: Program) -> Program:
                 continue
         kept.append(op)
     program.ops = kept
+    return program
+
+
+@register_ir_pass("fuse_attention_pass")
+def fuse_attention_pass(program: Program) -> Program:
+    """Rewrite the unfused attention subgraph
+    ``matmul(q,kᵀ) [-> scale] [-> +mask] -> softmax -> matmul(·,v)`` into
+    the fused ``sdpa`` op — the fork's signature serving rewrite
+    (fused_multi_transformer_encoder/decoder_pass,
+    paddle_pass_builder.cc:159-171; round-3 verdict #3).  A plain
+    hand-written transformer served via Predictor.from_layer then reaches
+    the same fused/flash path hand-built models use.
+
+    The matched q/k/v are in the conventional [b, h, s, d] layout (heads
+    split before the score matmul); ``sdpa`` wants [b, s, h, d], so the
+    rewrite brackets it with transposes — free under XLA, which fuses
+    layout changes into the surrounding computation."""
+    consumers = program.consumers()
+    producer = program.producer()
+    fetched = set(program.fetch_ids)
+
+    def sole(v, i):
+        return consumers.get(v, []) == [i] and v not in fetched
+
+    removed: set = set()
+    rewrites = []          # (anchor op index, [replacement OpNodes])
+    for si, sop in enumerate(program.ops):
+        if sop.name != "softmax" or si in removed:
+            continue
+        if sop.attrs.get("axis", -1) not in (-1, 3):
+            continue
+        sm_in, sm_out = sop.inputs[0], sop.outputs[0]
+        outs = consumers.get(sm_out, [])
+        if len(outs) != 1 or sm_out in fetched:
+            continue
+        mi2 = outs[0]
+        mm2 = program.ops[mi2]
+        if mm2.name != "matmul" or mm2.attrs.get("transpose_x") \
+                or mm2.attrs.get("transpose_y") \
+                or mm2.inputs[0] != sm_out:
+            continue
+        vv = mm2.inputs[1]
+
+        # walk backwards through optional +mask and scale to the QK matmul
+        chain = [si]
+        mask_v = None
+        scale = None
+        cur_v = sm_in
+        node_i = producer.get(cur_v)
+        if node_i is None:
+            continue
+        node = program.ops[node_i]
+        if node.name == "add" and sole(node.outputs[0], si):
+            def _scoreish(v):
+                p = producer.get(v)
+                return p is not None and program.ops[p].name in (
+                    "matmul", "scale", "multiply")
+            a, b = node.inputs
+            if _scoreish(a):
+                cur_v, mask_v = a, b
+            elif _scoreish(b):
+                cur_v, mask_v = b, a
+            else:
+                continue
+            chain.append(node_i)
+            node_i = producer.get(cur_v)
+            node = program.ops[node_i]
+        if node.name == "scale" and node.attrs.get("bias", 0.0) == 0.0 \
+                and sole(node.outputs[0], chain[-1]):
+            scale = float(node.attrs.get("scale", 1.0))
+            chain.append(node_i)
+            cur_v = node.inputs[0]
+            node_i = producer.get(cur_v)
+            if node_i is None:
+                continue
+            node = program.ops[node_i]
+        if node.name != "matmul" or node.attrs.get("transpose_x") \
+                or not sole(node.outputs[0], chain[-1]):
+            continue
+        qv, kv = node.inputs
+        if not node.attrs.get("transpose_y"):
+            # explicit transpose(k, [..., d, s]) feeding the scores
+            kp = producer.get(kv)
+            if kp is None or program.ops[kp].name != "transpose":
+                continue
+            perm = tuple(program.ops[kp].attrs.get("perm", ()))
+            if perm != (0, 1, 3, 2) or not sole(kv, node_i):
+                continue
+            chain.append(kp)
+            kv = program.ops[kp].inputs[0]
+        chain.append(node_i)
+        qshape = program.vars[qv].shape
+        if len(qshape) != 4:
+            continue
+
+        # build the replacement: transpose to [b,s,h,d], sdpa, transpose
+        # back into mm2's output var
+        def tvar(src):
+            s0 = program.vars[src].shape
+            return program.new_var(
+                "tmp", (s0[0], s0[2], s0[1], s0[3]),
+                program.vars[src].dtype)
+        tq, tk, tv = tvar(qv), tvar(kv), tvar(vv)
+        so = program.new_var("tmp", program.vars[tq].shape,
+                             program.vars[qv].dtype)
+        perm = (0, 2, 1, 3)
+        new_ops = [
+            OpNode("transpose", [qv], [tq], {"perm": perm}),
+            OpNode("transpose", [kv], [tk], {"perm": perm}),
+            OpNode("transpose", [vv], [tv], {"perm": perm}),
+            # scale=1.0 when no scale op was matched: sdpa would otherwise
+            # default to 1/sqrt(d), which the original graph never applied
+            OpNode("sdpa", [tq, tk, tv] + ([mask_v] if mask_v is not None
+                                           else []),
+                   [so], {"scale": scale if scale is not None else 1.0}),
+            OpNode("transpose", [so], list(mm2.outputs), {"perm": perm}),
+        ]
+        removed.update(chain)
+        removed.add(mi2)
+        # anchor at mm2: every input (q/k/v/mask) is produced before the
+        # QK matmul, and every consumer of mm2's output comes after
+        rewrites.append((mi2, new_ops))
+
+    if not rewrites:
+        return program
+    insert_at = {anchor: ops for anchor, ops in rewrites}
+    new_list: List[OpNode] = []
+    for i, op in enumerate(program.ops):
+        if i in insert_at:
+            new_list.extend(insert_at[i])
+        if i in removed:
+            continue
+        new_list.append(op)
+    program.ops = new_list
+    return program
+
+
+@register_ir_pass("fuse_ffn_pass")
+def fuse_ffn_pass(program: Program) -> Program:
+    """addmm(b1,x,w1) -> activation -> addmm(b2,·,w2)  ==>  fused_ffn
+    (reference fused_feedforward_op.cc; runs after fuse_matmul_add_pass
+    so plain Linear layers have already collapsed to addmm)."""
+    consumers = program.consumers()
+    fetched = set(program.fetch_ids)
+    acts = {"gelu", "relu", "silu", "tanh", "sigmoid"}
+
+    removed: set = set()
+    rewrites = {}
+    producer = program.producer()
+    for ai, aop in enumerate(program.ops):
+        if aop.name not in acts or ai in removed:
+            continue
+        # upstream addmm, downstream addmm, all single-consumer
+        up_i = producer.get(aop.inputs[0])
+        if up_i is None or up_i in removed:
+            continue
+        up = program.ops[up_i]
+        if up.name != "addmm" or up.attrs \
+                or consumers.get(up.outputs[0], []) != [ai] \
+                or up.outputs[0] in fetched:
+            continue
+        outs = consumers.get(aop.outputs[0], [])
+        if len(outs) != 1 or aop.outputs[0] in fetched:
+            continue
+        dn_i = outs[0]
+        dn = program.ops[dn_i]
+        if dn.name != "addmm" or dn.attrs or dn.inputs[1] != aop.outputs[0]:
+            continue
+        b1, x, w1 = up.inputs
+        b2, _, w2 = dn.inputs
+        attrs = {"activation": aop.name}
+        if aop.name == "gelu" and "approximate" in aop.attrs:
+            attrs["approximate"] = aop.attrs["approximate"]
+        # anchor at the downstream addmm: w2/b2 may be produced by ops
+        # between the two addmms, and replay is strictly sequential
+        rewrites[dn_i] = OpNode("fused_ffn", [x, w1, b1, w2, b2],
+                                list(dn.outputs), attrs)
+        removed.update((up_i, ai, dn_i))
+    if not rewrites:
+        return program
+    new_list = []
+    for i, op in enumerate(program.ops):
+        if i in rewrites:
+            new_list.append(rewrites[i])
+        if i in removed:
+            continue
+        new_list.append(op)
+    program.ops = new_list
     return program
 
 

@@ -36,6 +36,7 @@ class Config:
     _max_batch_size: int = 1
     _kv_cache_block_size: int = 16
     _weight_only_quant: Optional[str] = None  # None | "int8" | "int4"
+    _mesh: Optional[object] = None            # serving device mesh
 
     _passes_disabled: set = field(default_factory=set)
     _shape_range_info: dict = field(default_factory=dict)
@@ -113,6 +114,14 @@ class Config:
 
     def enable_weight_only_quant(self, algo="int8"):
         self._weight_only_quant = algo
+
+    def enable_mesh_sharding(self, mesh):
+        """Serve over a hybrid device mesh (the multi-rank DistModel
+        answer, fleet_executor/dist_model.cc:1): from_layer predictors
+        TP-place params by their dist_attrs; artifact predictors shard
+        the input batch over "dp" when divisible and let GSPMD propagate
+        through the loaded program."""
+        self._mesh = mesh
 
     def pass_builder(self):
         """The editable pass list (reference AnalysisConfig::pass_builder

@@ -32,6 +32,46 @@ from ..core.tensor import Tensor
 from . import sampling
 
 
+def serving_param_spec(arr, dist_attr, mesh):
+    """Placement spec for one served parameter: the TP axes stamped by
+    mp_layers (``dist_attr``), filtered to axes the serving mesh actually
+    has and dims they divide.  Params without dist_attr (LN scales,
+    biases of plain layers) replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.topology import axis_if_divides
+
+    spec = []
+    for i in range(arr.ndim):
+        s = dist_attr[i] if dist_attr and i < len(dist_attr) else None
+        spec.append(axis_if_divides(mesh, s, arr.shape[i]) if s else None)
+    return P(*spec)
+
+
+class _MeshContext:
+    """Temporarily make ``mesh`` the active hybrid mesh so the model's
+    sharding_constraint ops and the paged kernel's shard_map wrap see it
+    while the serving program traces/executes."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+        self._prev = None
+
+    def __enter__(self):
+        from ..parallel import topology
+
+        self._prev = topology.get_current_mesh()
+        if self._mesh is not None:
+            topology.set_current_mesh(self._mesh)
+        return self
+
+    def __exit__(self, *exc):
+        from ..parallel import topology
+
+        topology.set_current_mesh(self._prev)
+        return False
+
+
 @dataclass
 class GenerationConfig:
     """Decode-time knobs (reference: PaddleNLP GenerationConfig + the
@@ -67,9 +107,16 @@ class GenerationEngine:
     ``(logits, new_caches)`` when caches are given)."""
 
     def __init__(self, model, cache_bucket: int = 128,
-                 prompt_bucket: int = 64, cache_dtype=None):
+                 prompt_bucket: int = 64, cache_dtype=None, mesh=None):
+        """``mesh``: a hybrid mesh (parallel.topology.create_hybrid_mesh)
+        to serve over — TP weights placed by their mp_layers dist_attrs,
+        caches sharded over heads, one SPMD decode program.  The TPU-first
+        answer to the reference's multi-rank DistModel serving
+        (fluid/distributed/fleet_executor/dist_model.cc:1)."""
         model.eval()
         self._model = model
+        self._mesh = mesh
+        self._placed = {}            # name -> (source array, placed array)
         cfg = model.config
         self._num_layers = cfg.num_hidden_layers
         self._num_heads = cfg.num_attention_heads
@@ -77,17 +124,55 @@ class GenerationEngine:
         self._max_positions = cfg.max_position_embeddings
         self._cache_bucket = cache_bucket
         self._prompt_bucket = prompt_bucket
-        self._params = {n: p._data for n, p in model.named_parameters()}
+        self._params = self._snapshot_params()
         self._cache_dtype = cache_dtype or next(
             iter(self._params.values())).dtype
         self._compiled = {}
 
+    def _snapshot_params(self):
+        """Re-snapshot parameters (honoring set_state_dict/dtype casts
+        after construction); under a mesh, place each by its dist_attr
+        spec, caching placements so repeat calls don't re-transfer."""
+        if self._mesh is None:
+            return {n: p._data for n, p in self._model.named_parameters()}
+        from jax.sharding import NamedSharding
+
+        out = {}
+        for n, p in self._model.named_parameters():
+            cached = self._placed.get(n)
+            if cached is not None and cached[0] is p._data:
+                out[n] = cached[1]
+                continue
+            spec = serving_param_spec(p._data,
+                                      getattr(p, "dist_attr", None),
+                                      self._mesh)
+            placed = jax.device_put(p._data,
+                                    NamedSharding(self._mesh, spec))
+            self._placed[n] = (p._data, placed)
+            out[n] = placed
+        return out
+
+    def _replicated(self, arr):
+        """Pin a host input to an explicit replicated placement under the
+        mesh (so GSPMD never guesses a layout for feeds)."""
+        if self._mesh is None:
+            return jnp.asarray(arr)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(jnp.asarray(arr),
+                              NamedSharding(self._mesh, PartitionSpec()))
+
     # ------------------------------------------------------------ plumbing
     def _empty_caches(self, batch, cache_len):
+        from ..ops.distributed import _constrain
+
         shape = (batch, cache_len, self._num_heads, self._head_dim)
         zero_idx = jnp.zeros((), jnp.int32)
-        return [(jnp.zeros(shape, self._cache_dtype),
-                 jnp.zeros(shape, self._cache_dtype), zero_idx)
+        # pin head sharding under a serving mesh (dormant without one)
+        spec = ("data", None, "mp", None)
+        return [(_constrain(jnp.zeros(shape, self._cache_dtype), spec),
+                 _constrain(jnp.zeros(shape, self._cache_dtype), spec),
+                 zero_idx)
                 for _ in range(self._num_layers)]
 
     def _model_step(self, params, ids, position_ids, pad_mask_add, caches):
@@ -330,8 +415,7 @@ class GenerationEngine:
                 "deterministic)", UserWarning)
         # re-snapshot parameters so set_state_dict / dtype casts after
         # engine construction are honored
-        self._params = {n: p._data
-                        for n, p in self._model.named_parameters()}
+        self._params = self._snapshot_params()
         ids = np.asarray(input_ids._data if isinstance(input_ids, Tensor)
                          else input_ids).astype(np.int32)
         if ids.ndim == 1:
@@ -377,7 +461,9 @@ class GenerationEngine:
             fn = builder(b, plen, cache_len, g)
             self._compiled[key] = fn
         rng = jax.random.PRNGKey(g.seed)
-        out = fn(self._params, jnp.asarray(ids), jnp.asarray(mask), rng)
+        with _MeshContext(self._mesh):
+            out = fn(self._params, self._replicated(ids),
+                     self._replicated(mask), rng)
         seq, score = out
         seq = np.asarray(seq)
         return (seq, np.asarray(score)) if return_scores else seq
@@ -413,10 +499,10 @@ class PagedGenerationEngine(GenerationEngine):
 
     def __init__(self, model, page_size: int = 16,
                  num_pages: Optional[int] = None, prompt_bucket: int = 64,
-                 cache_dtype=None):
+                 cache_dtype=None, mesh=None):
         super().__init__(model, cache_bucket=page_size,
                          prompt_bucket=prompt_bucket,
-                         cache_dtype=cache_dtype)
+                         cache_dtype=cache_dtype, mesh=mesh)
         self.page_size = page_size
         self._requested_pages = num_pages
         self._pool = None
@@ -440,10 +526,25 @@ class PagedGenerationEngine(GenerationEngine):
         pshape = (self._pool.num_blocks, self._num_heads, self.page_size,
                   self._head_dim)
         if self._k_pages is None or self._k_pages[0].shape != pshape:
-            self._k_pages = [jnp.zeros(pshape, self._cache_dtype)
-                             for _ in range(self._num_layers)]
-            self._v_pages = [jnp.zeros(pshape, self._cache_dtype)
-                             for _ in range(self._num_layers)]
+            def alloc():
+                z = jnp.zeros(pshape, self._cache_dtype)
+                if self._mesh is not None:
+                    # head-sharded pool: each mp shard owns its heads'
+                    # pages; replicated over every other serving axis
+                    from jax.sharding import NamedSharding
+                    from jax.sharding import PartitionSpec as P
+
+                    from ..parallel.topology import axis_if_divides
+
+                    hax = axis_if_divides(self._mesh, "mp",
+                                          self._num_heads)
+                    z = jax.device_put(
+                        z, NamedSharding(self._mesh,
+                                         P(None, hax, None, None)))
+                return z
+
+            self._k_pages = [alloc() for _ in range(self._num_layers)]
+            self._v_pages = [alloc() for _ in range(self._num_layers)]
         return self._k_pages, self._v_pages
 
     def _build_paged(self, batch, plen, g: GenerationConfig):
@@ -715,10 +816,11 @@ class PagedGenerationEngine(GenerationEngine):
             self._compiled[key] = fn
         rng = jax.random.PRNGKey(g.seed)
         self._k_pages = self._v_pages = None
-        seq, score, k_pages, v_pages = fn(
-            self._params, jnp.asarray(ids), jnp.asarray(lengths),
-            jnp.asarray(tables), jnp.asarray(priv_ids), k_pages, v_pages,
-            rng)
+        with _MeshContext(self._mesh):
+            seq, score, k_pages, v_pages = fn(
+                self._params, self._replicated(ids),
+                self._replicated(lengths), self._replicated(tables),
+                self._replicated(priv_ids), k_pages, v_pages, rng)
         self._k_pages, self._v_pages = k_pages, v_pages
         for s in prompt_sids + beam_sids:
             pool.free(s)
@@ -729,8 +831,7 @@ class PagedGenerationEngine(GenerationEngine):
     def generate(self, input_ids, generation_config: GenerationConfig = None,
                  attention_mask=None, return_scores: bool = False):
         g = generation_config or GenerationConfig()
-        self._params = {n: p._data
-                        for n, p in self._model.named_parameters()}
+        self._params = self._snapshot_params()
         ids = np.asarray(input_ids._data if isinstance(input_ids, Tensor)
                          else input_ids).astype(np.int32)
         if ids.ndim == 1:
@@ -785,9 +886,11 @@ class PagedGenerationEngine(GenerationEngine):
         # donated arrays are consumed even if the call fails — drop our
         # references first and rebind from the outputs on success
         self._k_pages = self._v_pages = None
-        seq, score, k_pages, v_pages = fn(
-            self._params, jnp.asarray(ids), jnp.asarray(lengths),
-            jnp.asarray(tables), k_pages, v_pages, rng)
+        with _MeshContext(self._mesh):
+            seq, score, k_pages, v_pages = fn(
+                self._params, self._replicated(ids),
+                self._replicated(lengths), self._replicated(tables),
+                k_pages, v_pages, rng)
         self._k_pages, self._v_pages = k_pages, v_pages
         for s in seq_ids:
             pool.free(s)

@@ -53,6 +53,8 @@ class Predictor:
         self._config = config
         self._program = None            # IR-serving mode (from_layer)
         self._program_fn = None
+        self._mesh = getattr(config, "_mesh", None)
+        self._mesh_call = None
         if _shared is not None:
             (self._exported, self._params, self._buffers,
              self._input_names) = _shared
@@ -118,6 +120,23 @@ class Predictor:
             self._applied_passes.append("precision_cast_pass")
         self._buffers = {}
         self._exported = None
+        self._mesh = getattr(self._config, "_mesh", None)
+        self._mesh_call = None
+        if self._mesh is not None:
+            # TP placement by the layer's mp_layers dist_attrs — the
+            # multi-rank serving answer to DistModel (dist_model.cc:1);
+            # GSPMD propagates the shardings through the compiled program
+            from jax.sharding import NamedSharding
+
+            from .generation import serving_param_spec
+
+            dist = {n: getattr(p, "dist_attr", None)
+                    for n, p in layer.named_parameters()}
+            self._params = {
+                n: jax.device_put(
+                    v, NamedSharding(self._mesh, serving_param_spec(
+                        v, dist.get(n), self._mesh)))
+                for n, v in self._params.items()}
         self._input_names = [f"input_{i}" for i in
                              range(len(prog.feed_ids))]
         self._inputs = {n: _IOHandle(n) for n in self._input_names}
@@ -178,10 +197,20 @@ class Predictor:
             arrays = [jnp.asarray(np.asarray(x)) for x in inputs]
         else:
             arrays = [self._inputs[n].to_array() for n in self._input_names]
+        if self._mesh is not None:
+            arrays = [self._place_input(a) for a in arrays]
         # precision cast of inputs to match exported signature
-        with self._lock:
+        from .generation import _MeshContext
+
+        with self._lock, _MeshContext(self._mesh):
             if self._program_fn is not None:
                 out = self._program_fn(tuple(arrays), self._params)
+            elif self._mesh is not None:
+                if self._mesh_call is None:
+                    exported = self._exported
+                    self._mesh_call = jax.jit(
+                        lambda p, b, *a: exported.call(p, b, *a))
+                out = self._mesh_call(self._params, self._buffers, *arrays)
             else:
                 out = self._exported.call(self._params, self._buffers,
                                           *arrays)
@@ -190,6 +219,19 @@ class Predictor:
         if inputs is not None:
             return [np.asarray(o) for o in flat]
         return True
+
+    def _place_input(self, a):
+        """Artifact-mode data parallelism: shard the batch dim over "dp"
+        when it divides, else replicate — GSPMD splits the whole program
+        accordingly (throughput-scaling multi-chip serving)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.topology import axis_if_divides
+
+        bax = axis_if_divides(self._mesh, "dp", a.shape[0]) \
+            if a.ndim >= 1 else None
+        return jax.device_put(
+            a, NamedSharding(self._mesh, P(bax) if bax else P()))
 
     def clone(self):
         """Weight-sharing clone for per-thread serving (reference:

@@ -28,7 +28,9 @@ def __getattr__(name):
         from . import resnet
 
         return getattr(resnet, name)
-    if name in ("LlamaModel", "LlamaForCausalLM", "LlamaConfig"):
+    if name in ("LlamaModel", "LlamaForCausalLM", "LlamaConfig",
+                "LlamaDecoderLayer", "LlamaMLP", "LLAMA_PRESETS",
+                "llama_lm_loss"):
         from . import llama
 
         return getattr(llama, name)

@@ -33,27 +33,75 @@ class ParallelSelfAttention(Layer):
     [2, b, h, max_seq, d], fused_multi_transformer_op.cc:103)."""
 
     def __init__(self, hidden, num_heads, dropout=0.0, causal=False,
-                 seq_parallel=None):
+                 seq_parallel=None, rope_theta=None, num_kv_heads=None):
+        """``rope_theta``: enable rotary position embedding (LLaMA-class
+        decoders; reference fused_rope) with the given base.
+        ``num_kv_heads``: grouped-query attention — fewer K/V heads,
+        expanded to the query heads after RoPE (reference
+        fused_multi_transformer GQA serving variants)."""
         super().__init__()
         assert hidden % num_heads == 0
         assert seq_parallel in (None, "ring", "ulysses")
         self.hidden = hidden
         self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        assert num_heads % self.num_kv_heads == 0
         self.head_dim = hidden // num_heads
         self.dropout = dropout
         self.causal = causal
         self.seq_parallel = seq_parallel
-        self.qkv_proj = ColumnParallelLinear(hidden, 3 * hidden,
+        self.rope_theta = rope_theta
+        qkv_out = (num_heads + 2 * self.num_kv_heads) * self.head_dim
+        self.qkv_proj = ColumnParallelLinear(hidden, qkv_out,
                                              gather_output=False)
         self.out_proj = RowParallelLinear(hidden, hidden,
                                           input_is_parallel=True)
 
-    def forward(self, x, attn_mask=None, cache=None, segment_ids=None):
+    def _split_qkv(self, qkv, b, s):
+        """[b, s, (hq+2*hkv)*d] -> q [b,s,hq,d], k/v [b,s,hkv,d]."""
+        hq, hkv, d = self.num_heads, self.num_kv_heads, self.head_dim
+        if hkv == hq:
+            qkv = D("reshape", qkv, shape=(b, s, 3, hq, d))
+            return D("unstack", qkv, axis=2)
+        qkv = D("reshape", qkv, shape=(b, s, hq + 2 * hkv, d))
+        return D("split", qkv, num_or_sections=(hq, hkv, hkv), axis=2)
+
+    def _rope_positions(self, cache, s):
+        """Absolute positions for the current chunk, from the cache kind:
+        paged → per-row page cursor, static → traced write index,
+        growing → cached prefix length, none → 0..s-1."""
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        ar = Tensor(jnp.arange(s, dtype=jnp.int32))
+        if cache is not None and len(cache) == 4:
+            return D("unsqueeze", cache[3], axis=1) + ar     # [b, s]
+        if cache is not None and len(cache) == 3:
+            return ar + cache[2]
+        if cache is not None:
+            past = cache[0].shape[1]
+            return Tensor(jnp.arange(past, past + s, dtype=jnp.int32))
+        return ar
+
+    def forward(self, x, attn_mask=None, cache=None, segment_ids=None,
+                position_ids=None):
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv_proj(x)
-        qkv = D("reshape", qkv, shape=(b, s, 3, self.num_heads,
-                                       self.head_dim))
-        q, k, v = D("unstack", qkv, axis=2)
+        q, k, v = self._split_qkv(qkv, b, s)
+        if self.rope_theta:
+            if position_ids is None:
+                position_ids = self._rope_positions(cache, s)
+            q = D("rope", q, position_ids, theta=self.rope_theta)
+            k = D("rope", k, position_ids, theta=self.rope_theta)
+        if self.num_kv_heads != self.num_heads:
+            # GQA: expand K/V to the query heads post-RoPE so every
+            # downstream path (caches incl. paged pools, sdpa, kernels)
+            # sees plain MHA.  Cache-side narrow-kv storage is a possible
+            # follow-up optimisation.
+            rep = self.num_heads // self.num_kv_heads
+            k = D("repeat_interleave", k, repeats=rep, axis=2)
+            v = D("repeat_interleave", v, repeats=rep, axis=2)
         if cache is not None and len(cache) == 4:
             return self._forward_paged(x, q, k, v, cache, attn_mask)
         static_cache = cache is not None and len(cache) == 3

@@ -111,6 +111,30 @@ def _addmm(input, x, y, beta=1.0, alpha=1.0):
 register_vjp_grad("addmm")
 
 
+@register_op("fused_ffn")
+def _fused_ffn(x, w1, b1, w2, b2, activation="gelu",
+               approximate=False):
+    """One-op transformer FFN: act(x@w1 + b1)@w2 + b2 (reference
+    fused_feedforward_op.cc; produced by the IR fuse_ffn_pass so a
+    plain-Layer serving graph collapses its MLP into one node)."""
+    import jax
+
+    acts = {"gelu": lambda h: jax.nn.gelu(h, approximate=approximate),
+            "relu": jax.nn.relu, "silu": jax.nn.silu,
+            "tanh": jnp.tanh, "sigmoid": jax.nn.sigmoid}
+    h = jnp.matmul(x, w1, precision=_prec(x, w1))
+    if b1 is not None:
+        h = h + b1
+    h = acts[activation](h)
+    h = jnp.matmul(h, w2, precision=_prec(h, w2))
+    if b2 is not None:
+        h = h + b2
+    return h
+
+
+register_vjp_grad("fused_ffn")
+
+
 @register_op("einsum_op")
 def _einsum(*operands, equation):
     prec = _prec(operands[0], operands[-1]) if operands else None

@@ -103,7 +103,48 @@ def paged_attention_decode(q, k_pages, v_pages, block_tables, lengths,
     block_tables [B, max_pages] int32 — per-sequence page ids (pad 0)
     lengths      [B] int32      — tokens already in cache (incl. current)
     → [B, H, D]
+
+    Mesh-sharded serving: when a hybrid mesh with mp>1 is active (the
+    engines set it — parallel.topology), the kernel runs under shard_map
+    with heads split over "mp" and (when divisible) batch over "dp".
+    Heads are independent in decode attention, so each shard walks its
+    local heads' pages; the page pool is head-major precisely so this
+    split never relayouts.  This is the multi-rank serving answer to the
+    reference's DistModel/FleetExecutor
+    (fluid/distributed/fleet_executor/dist_model.cc:1) — one SPMD program
+    instead of per-rank executors passing messages.
     """
+    mesh = _current_mesh()
+    if mesh is not None:
+        from ...parallel.topology import axis_if_divides
+
+        bax = axis_if_divides(mesh, "dp", q.shape[0])
+        hax = axis_if_divides(mesh, "mp", q.shape[1])
+        if bax or hax:
+            from jax.sharding import PartitionSpec as P
+
+            from ...parallel.topology import shard_map_norep
+            inner = functools.partial(_decode_local, scale=scale,
+                                      interpret=interpret)
+            return shard_map_norep(
+                inner, mesh,
+                in_specs=(P(bax, hax, None), P(None, hax, None, None),
+                          P(None, hax, None, None), P(bax, None), P(bax)),
+                out_specs=P(bax, hax, None),
+            )(q, k_pages, v_pages, block_tables, lengths)
+    return _decode_local(q, k_pages, v_pages, block_tables, lengths,
+                         scale=scale, interpret=interpret)
+
+
+def _current_mesh():
+    from ...parallel import topology
+
+    return topology.get_current_mesh()
+
+
+def _decode_local(q, k_pages, v_pages, block_tables, lengths,
+                  scale=None, interpret=None):
+    """The single-shard kernel launch (see paged_attention_decode)."""
     interpret = _interpret() if interpret is None else interpret
     b, h, d = q.shape
     num_pages, kh, page_size, kd = k_pages.shape

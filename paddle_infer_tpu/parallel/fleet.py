@@ -441,10 +441,17 @@ class FleetTrainStep:
 
     def step(self, *batch, **static_kwargs):
         """Run one training step; returns the loss as a Tensor and keeps
-        params/opt state on device in their sharded layout."""
+        params/opt state on device in their sharded layout.
+
+        Multi-process jobs (jax.distributed initialized, reference
+        multi-trainer fleet run): each process passes its LOCAL batch
+        shard — the reference's per-rank reader semantics — and the step
+        assembles the global sharded arrays."""
         if self.opt_state is None:
             self._init_opt_state()
         arrays = batch_arrays(batch)
+        if jax.process_count() > 1:
+            arrays = self._globalize_batch(arrays)
         sig = batch_signature(arrays, static_kwargs)
         fn = self._cache.get(sig)
         if fn is None:
@@ -458,6 +465,18 @@ class FleetTrainStep:
             jnp.asarray(self._step_count, jnp.int32), arrays)
         lr_scheduler_tick(self.optimizer)
         return Tensor(loss)
+
+    def _globalize_batch(self, arrays):
+        """Per-process local shards -> global arrays over the mesh (the
+        TCPStore-less multi-host path: jax.distributed's coordination
+        service already rendezvoused the processes)."""
+        import numpy as _np
+
+        sig = tuple((a.shape, str(a.dtype)) for a in arrays)
+        shardings = self._batch_shardings(sig)
+        return tuple(
+            jax.make_array_from_process_local_data(sh, _np.asarray(a))
+            for sh, a in zip(shardings, arrays))
 
     def _compiled_executable(self, batch, static_kwargs):
         """The compiled executable serving this batch signature (must have

@@ -58,6 +58,29 @@ def create_hybrid_mesh(dp: int = 1, mp: int = 1, pp: int = 1,
     return Mesh(dev_array, HYBRID_AXES)
 
 
+def axis_if_divides(mesh, axis: str, dim: int) -> Optional[str]:
+    """``axis`` when the mesh has it with size > 1 AND it divides ``dim``
+    — else None (replicate).  The one gating rule for every serving-side
+    sharding decision (params, pools, kernels, feeds)."""
+    size = dict(mesh.shape).get(axis, 1)
+    return axis if (size > 1 and dim % size == 0) else None
+
+
+def shard_map_norep(fn, mesh, in_specs, out_specs):
+    """shard_map without replication checking, across jax versions
+    (check_vma in >=0.8, check_rep before)."""
+    try:
+        from jax import shard_map
+    except ImportError:                   # older jax
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+
+
 class CommunicateTopology:
     """Axis-name ↔ coordinate bookkeeping over an n-D processor grid
     (reference: fleet/base/topology.py:54).  Kept as plain index math so unit

@@ -4,6 +4,9 @@ without accelerators (test_dist_base.py:1316 _run_cluster_gloo)."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# spawned child processes (multi-process distributed tests, DataLoader
+# workers) must not re-run the axon tunnel hook sitecustomize installs
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,186 @@
+"""Distributed checkpointing with mesh resharding (round-3 verdict #5).
+
+Reference bar: per-rank optimizer shards
+(group_sharded_optimizer_stage2.py:51) + dist_saver's save-on-config-A /
+load-on-config-B re-split.  Here: save per-host chunks with shardings,
+reassemble per-device shards of a DIFFERENT mesh factorization at load."""
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.distributed.checkpoint import (load_distributed,
+                                                     load_train_state,
+                                                     save_distributed,
+                                                     save_train_state)
+from paddle_infer_tpu.parallel import (DistributedStrategy, FleetTrainStep,
+                                       LayerDesc, PipelineStack, fleet,
+                                       topology)
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    topology.set_current_mesh(None)
+    fleet._state.initialized = False
+    fleet._state.hcg = None
+    fleet._state.strategy = None
+    topology._CURRENT_HCG = None
+
+
+class TestArrayRoundTrip:
+    def test_sharded_save_host_load(self, tmp_path):
+        mesh = topology.create_hybrid_mesh(mp=4)
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        arr = jax.device_put(x, NamedSharding(mesh, P("mp", None)))
+        save_distributed({"x": arr}, str(tmp_path / "ck"))
+        state, _ = load_distributed(str(tmp_path / "ck"))
+        np.testing.assert_array_equal(state["x"], x)
+
+    def test_reshard_mp4_to_dp8(self, tmp_path):
+        mesh_a = topology.create_hybrid_mesh(mp=4)
+        x = np.random.RandomState(0).rand(8, 16).astype(np.float32)
+        arr = jax.device_put(x, NamedSharding(mesh_a, P(None, "mp")))
+        save_distributed({"w": arr}, str(tmp_path / "ck"))
+        mesh_b = topology.create_hybrid_mesh(dp=8)
+        state, _ = load_distributed(str(tmp_path / "ck"), mesh=mesh_b,
+                                    specs={"w": P("dp", None)})
+        got = state["w"]
+        assert got.sharding.spec == P("dp", None)
+        np.testing.assert_array_equal(np.asarray(got), x)
+
+    def test_saved_spec_filtered_on_new_mesh(self, tmp_path):
+        """Without explicit specs, the recorded spec is reused where the
+        new mesh has the axis, replicated where it doesn't."""
+        mesh_a = topology.create_hybrid_mesh(mp=2, dp=2)
+        x = np.random.RandomState(1).rand(4, 6).astype(np.float32)
+        arr = jax.device_put(x, NamedSharding(mesh_a, P("dp", "mp")))
+        save_distributed({"w": arr}, str(tmp_path / "ck"))
+        mesh_b = topology.create_hybrid_mesh(mp=2)   # no dp axis >1
+        state, _ = load_distributed(str(tmp_path / "ck"), mesh=mesh_b)
+        got = state["w"]
+        np.testing.assert_array_equal(np.asarray(got), x)
+        assert got.sharding.spec[1] == "mp"
+
+    def test_bfloat16_chunks(self, tmp_path):
+        import jax.numpy as jnp
+
+        mesh = topology.create_hybrid_mesh(mp=2)
+        x = (np.random.RandomState(2).rand(4, 4) * 3).astype(np.float32)
+        arr = jax.device_put(jnp.asarray(x, jnp.bfloat16),
+                             NamedSharding(mesh, P("mp")))
+        save_distributed({"b": arr}, str(tmp_path / "ck"))
+        state, _ = load_distributed(str(tmp_path / "ck"))
+        assert state["b"].dtype.name == "bfloat16"
+        np.testing.assert_array_equal(
+            np.asarray(state["b"], np.float32),
+            np.asarray(jnp.asarray(x, jnp.bfloat16), np.float32))
+
+
+def _pipe_model():
+    from paddle_infer_tpu.models.transformer_block import (
+        ParallelTransformerLayer)
+    from paddle_infer_tpu.nn.layer import Layer
+    from paddle_infer_tpu.nn.layers_common import Embedding, Linear
+
+    vocab, hidden, heads, ffn = 64, 32, 2, 64
+
+    class Model(Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = Embedding(vocab, hidden)
+            self.stack = PipelineStack(
+                LayerDesc(ParallelTransformerLayer, hidden, heads, ffn,
+                          dropout=0.0, causal=True, normalize_before=True),
+                num_layers=4, micro_batches=2)
+            self.head = Linear(hidden, vocab)
+
+        def forward(self, ids):
+            return self.head(self.stack(self.embed(ids)))
+
+    return Model, vocab
+
+
+def _make_step(hybrid_configs):
+    Model, vocab = _pipe_model()
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = hybrid_configs
+    fleet.init(is_collective=True, strategy=strategy,
+               devices=jax.devices()[:8])
+    pit.seed(42)
+    model = Model()
+    opt = pit.optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+
+    def loss_fn(m, ids, labels):
+        from paddle_infer_tpu.nn import functional as F
+
+        logits = m(ids)
+        return F.cross_entropy(logits.reshape((-1, vocab)),
+                               labels.reshape((-1,)), reduction="mean")
+
+    return FleetTrainStep(model, loss_fn, opt, strategy=strategy), vocab
+
+
+def _reset():
+    topology.set_current_mesh(None)
+    fleet._state.initialized = False
+    fleet._state.hcg = None
+    fleet._state.strategy = None
+    topology._CURRENT_HCG = None
+
+
+class TestTrainStateReshard:
+    def test_pp2_mp2_save_resume_dp8(self, tmp_path):
+        """The verdict's bar: train 2 steps on pp=2 x mp=2 (x dp=2), save,
+        resume on dp=8 — subsequent losses must match an uninterrupted
+        run."""
+        rng = np.random.RandomState(0)
+        batches = [(rng.randint(0, 64, (8, 8)).astype(np.int32),
+                    rng.randint(0, 64, (8, 8)).astype(np.int32))
+                   for _ in range(4)]
+
+        # uninterrupted run on the pipe mesh
+        step_a, _ = _make_step({"dp_degree": 2, "mp_degree": 2,
+                                "pp_degree": 2})
+        losses_a = [float(step_a(ids, lab).numpy())
+                    for ids, lab in batches]
+        _reset()
+
+        # interrupted: 2 steps, save, resume on dp=8
+        step_b, _ = _make_step({"dp_degree": 2, "mp_degree": 2,
+                                "pp_degree": 2})
+        for ids, lab in batches[:2]:
+            step_b(ids, lab)
+        ck = str(tmp_path / "ck")
+        save_train_state(step_b, ck)
+        _reset()
+
+        step_c, _ = _make_step({"dp_degree": 8})
+        load_train_state(step_c, ck)
+        assert step_c._step_count == 2
+        losses_c = [float(step_c(ids, lab).numpy())
+                    for ids, lab in batches[2:]]
+        np.testing.assert_allclose(losses_c, losses_a[2:], rtol=2e-3)
+
+    def test_optimizer_slots_restored(self, tmp_path):
+        step_a, _ = _make_step({"dp_degree": 4, "mp_degree": 2})
+        rng = np.random.RandomState(1)
+        ids = rng.randint(0, 64, (8, 8)).astype(np.int32)
+        lab = rng.randint(0, 64, (8, 8)).astype(np.int32)
+        step_a(ids, lab)
+        want = {n: {k: np.asarray(a) for k, a in slots.items()}
+                for n, slots in step_a.opt_state.items()}
+        ck = str(tmp_path / "ck")
+        save_train_state(step_a, ck)
+        _reset()
+
+        step_b, _ = _make_step({"dp_degree": 8})
+        load_train_state(step_b, ck)
+        name = next(iter(want))
+        for k, a in want[name].items():
+            np.testing.assert_allclose(
+                np.asarray(step_b.opt_state[name][k]), a, rtol=1e-6)

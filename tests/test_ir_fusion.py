@@ -1,0 +1,188 @@
+"""Transformer fusion passes in the serving IR (round-3 verdict #3; the
+fork's signature rewrite: fused_multi_transformer_encoder/decoder_pass +
+fused_feedforward, paddle_pass_builder.cc:159-171) — a PLAIN hand-written
+transformer served via the IR must reach the fused sdpa / fused_ffn ops."""
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+import paddle_infer_tpu.nn as nn
+from paddle_infer_tpu.core.dispatch import dispatch as D
+from paddle_infer_tpu.core.tensor import Tensor
+from paddle_infer_tpu.framework import ir
+from paddle_infer_tpu.nn import functional as F
+
+
+class PlainAttention(nn.Layer):
+    """Unfused attention exactly as a paddle user writes it: reshape →
+    transpose → QKᵀ (transpose_y) → scale → (+mask) → softmax → ·V."""
+
+    def __init__(self, hidden=32, heads=4, with_mask=False,
+                 explicit_transpose=False, use_scale=True):
+        super().__init__()
+        self.use_scale = use_scale
+        self.h = heads
+        self.d = hidden // heads
+        self.hidden = hidden
+        self.with_mask = with_mask
+        self.explicit_transpose = explicit_transpose
+        self.q = nn.Linear(hidden, hidden)
+        self.k = nn.Linear(hidden, hidden)
+        self.v = nn.Linear(hidden, hidden)
+        self.o = nn.Linear(hidden, hidden)
+
+    def forward(self, x, mask=None):
+        b, s = x.shape[0], x.shape[1]
+
+        def split(t):
+            t = D("reshape", t, shape=(b, s, self.h, self.d))
+            return D("transpose", t, perm=(0, 2, 1, 3))
+
+        q, k, v = split(self.q(x)), split(self.k(x)), split(self.v(x))
+        if self.explicit_transpose:
+            kt = D("transpose", k, perm=(0, 1, 3, 2))
+            scores = D("matmul", q, kt)
+        else:
+            scores = D("matmul", q, k, transpose_y=True)
+        if self.use_scale:
+            scores = D("scale", scores, scale=1.0 / np.sqrt(self.d))
+        if self.with_mask and mask is not None:
+            scores = scores + mask
+        w = F.softmax(scores, axis=-1)
+        out = D("matmul", w, v)
+        out = D("transpose", out, perm=(0, 2, 1, 3))
+        out = D("reshape", out, shape=(b, s, self.hidden))
+        return self.o(out)
+
+
+class PlainFFN(nn.Layer):
+    def __init__(self, hidden=16, ffn=32):
+        super().__init__()
+        self.fc1 = nn.Linear(hidden, ffn)
+        self.fc2 = nn.Linear(ffn, hidden)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x)))
+
+
+def _ops(prog):
+    return [op.name for op in prog.ops]
+
+
+class TestAttentionFusion:
+    @pytest.mark.parametrize("explicit_transpose", [False, True])
+    def test_pattern_fused_and_numerics_match(self, explicit_transpose):
+        pit.seed(0)
+        layer = PlainAttention(explicit_transpose=explicit_transpose)
+        layer.eval()
+        x = np.random.RandomState(0).rand(2, 8, 32).astype(np.float32)
+        prog = ir.trace_layer(layer, [Tensor(x)])
+        want = prog.run([Tensor(x)], dict(layer.named_parameters()))[0]
+        opt = ir.PassManager().run(prog)
+        names = _ops(opt)
+        assert "sdpa" in names, names
+        assert "softmax" not in names
+        got = opt.run([Tensor(x)], dict(layer.named_parameters()))[0]
+        np.testing.assert_allclose(np.asarray(got.numpy()),
+                                   np.asarray(want.numpy()), atol=1e-5)
+
+    def test_unscaled_pattern_keeps_unit_scale(self):
+        """A bare matmul->softmax->matmul graph (scale folded into the
+        weights by the author) must fuse with scale=1.0 — NOT pick up
+        sdpa's default 1/sqrt(d)."""
+        pit.seed(7)
+        layer = PlainAttention(use_scale=False)
+        layer.eval()
+        x = np.random.RandomState(7).rand(2, 8, 32).astype(np.float32)
+        prog = ir.trace_layer(layer, [Tensor(x)])
+        want = prog.run([Tensor(x)], dict(layer.named_parameters()))[0]
+        opt = ir.PassManager().run(prog)
+        assert "sdpa" in _ops(opt)
+        sdpa_op = next(op for op in opt.ops if op.name == "sdpa")
+        assert sdpa_op.attrs.get("scale") == 1.0
+        got = opt.run([Tensor(x)], dict(layer.named_parameters()))[0]
+        np.testing.assert_allclose(np.asarray(got.numpy()),
+                                   np.asarray(want.numpy()), atol=1e-5)
+
+    def test_masked_attention_fused(self):
+        pit.seed(1)
+        layer = PlainAttention(with_mask=True)
+        layer.eval()
+        rs = np.random.RandomState(1)
+        x = rs.rand(2, 8, 32).astype(np.float32)
+        mask = np.where(rs.rand(2, 1, 8, 8) > 0.3, 0.0,
+                        -1e9).astype(np.float32)
+        prog = ir.trace_layer(layer, [Tensor(x), Tensor(mask)])
+        want = prog.run([Tensor(x), Tensor(mask)],
+                        dict(layer.named_parameters()))[0]
+        opt = ir.PassManager().run(prog)
+        assert "sdpa" in _ops(opt)
+        assert "softmax" not in _ops(opt)
+        got = opt.run([Tensor(x), Tensor(mask)],
+                      dict(layer.named_parameters()))[0]
+        np.testing.assert_allclose(np.asarray(got.numpy()),
+                                   np.asarray(want.numpy()), atol=1e-5)
+
+    def test_fetched_intermediate_blocks_fusion(self):
+        """If the attention weights are a fetch target the pattern must
+        NOT collapse."""
+        pit.seed(2)
+
+        def fn(x, q, k):
+            s = D("matmul", q, k, transpose_y=True)
+            w = F.softmax(s, axis=-1)
+            return D("matmul", w, x), w
+
+        rs = np.random.RandomState(2)
+        q = rs.rand(1, 2, 4, 8).astype(np.float32)
+        k = rs.rand(1, 2, 4, 8).astype(np.float32)
+        v = rs.rand(1, 2, 4, 8).astype(np.float32)
+        prog = ir.trace_program(fn, [Tensor(v), Tensor(q), Tensor(k)])
+        opt = ir.PassManager().run(prog)
+        assert "softmax" in _ops(opt)
+
+
+class TestFFNFusion:
+    def test_ffn_fused_and_numerics_match(self):
+        pit.seed(3)
+        layer = PlainFFN()
+        layer.eval()
+        x = np.random.RandomState(3).rand(4, 16).astype(np.float32)
+        prog = ir.trace_layer(layer, [Tensor(x)])
+        want = prog.run([Tensor(x)], dict(layer.named_parameters()))[0]
+        opt = ir.PassManager().run(prog)
+        names = _ops(opt)
+        assert "fused_ffn" in names, names
+        assert "gelu" not in names
+        got = opt.run([Tensor(x)], dict(layer.named_parameters()))[0]
+        np.testing.assert_allclose(np.asarray(got.numpy()),
+                                   np.asarray(want.numpy()), atol=1e-5)
+
+
+class TestEndToEndPredictor:
+    def test_plain_transformer_from_layer_hits_fused_path(self):
+        from paddle_infer_tpu.inference.predictor import Predictor
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.attn = PlainAttention()
+                self.ffn = PlainFFN(32, 64)
+                self.n1 = nn.LayerNorm(32)
+                self.n2 = nn.LayerNorm(32)
+
+            def forward(self, x):
+                x = self.n1(x + self.attn(x))
+                return self.n2(x + self.ffn(x))
+
+        pit.seed(4)
+        blk = Block()
+        blk.eval()
+        x = np.random.RandomState(4).rand(2, 8, 32).astype(np.float32)
+        want = blk(Tensor(x)).numpy()
+        pred = Predictor.from_layer(blk, [Tensor(x)])
+        names = [op.name for op in pred._program.ops]
+        assert "sdpa" in names
+        assert "fused_ffn" in names
+        got = pred.run([x])[0]
+        np.testing.assert_allclose(got, want, atol=1e-5)

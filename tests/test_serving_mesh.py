@@ -1,0 +1,231 @@
+"""Mesh-sharded serving (round-4, the reference's multi-rank DistModel
+serving — fluid/distributed/fleet_executor/dist_model.cc:1,
+inference/api/analysis_predictor.h:95 — redesigned as ONE SPMD decode
+program over a hybrid mesh instead of per-rank executors).
+
+Bar (round-3 verdict, next-round #2): identical tokens from a 1-chip run
+and a mesh run, for the dense engine, the paged engine (incl. beam
+search), and the predictor, at mp=2 and mp=2×dp=2 on the 8-device virtual
+CPU mesh."""
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.inference import Config
+from paddle_infer_tpu.inference.generation import (GenerationConfig,
+                                                   GenerationEngine,
+                                                   PagedGenerationEngine,
+                                                   serving_param_spec)
+from paddle_infer_tpu.inference.predictor import Predictor
+from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_infer_tpu.parallel import topology
+
+
+def _tiny_gpt(**kw):
+    cfg = dict(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+               num_attention_heads=4, intermediate_size=64,
+               max_position_embeddings=64, hidden_dropout_prob=0.0,
+               attention_probs_dropout_prob=0.0)
+    cfg.update(kw)
+    return GPTConfig(**cfg)
+
+
+def _make(seed=0, **kw):
+    pit.seed(seed)
+    model = GPTForCausalLM(_tiny_gpt(**kw))
+    model.eval()
+    return model
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    prev = topology.get_current_mesh()
+    yield
+    topology.set_current_mesh(prev)
+
+
+def _mesh(**deg):
+    return topology.create_hybrid_mesh(**deg)
+
+
+PROMPTS = np.array([[3, 17, 42, 7, 11, 9, 2, 30],
+                    [8, 2, 61, 30, 12, 4, 33, 5]], np.int32)
+
+
+class TestServingParamSpec:
+    def test_tp_axes_filtered_to_mesh(self):
+        mesh = _mesh(mp=2)
+        arr = np.zeros((8, 6), np.float32)
+        # mp divides dim0=8 -> kept; unknown axis dropped
+        assert serving_param_spec(arr, ("mp", None), mesh)[0] == "mp"
+        assert serving_param_spec(arr, ("bogus", None), mesh)[0] is None
+
+    def test_non_divisible_dim_replicates(self):
+        mesh = _mesh(mp=2)
+        arr = np.zeros((7, 6), np.float32)
+        assert serving_param_spec(arr, ("mp", None), mesh)[0] is None
+
+
+class TestDenseEngineMesh:
+    def test_greedy_parity_mp2(self):
+        model = _make()
+        g = GenerationConfig(max_new_tokens=6)
+        ref = GenerationEngine(model, cache_bucket=16,
+                               prompt_bucket=8).generate(PROMPTS, g)
+        got = GenerationEngine(model, cache_bucket=16, prompt_bucket=8,
+                               mesh=_mesh(mp=2)).generate(PROMPTS, g)
+        np.testing.assert_array_equal(ref, got)
+
+    def test_sampling_parity_mp2_dp2(self):
+        model = _make(seed=3)
+        g = GenerationConfig(max_new_tokens=5, do_sample=True, top_k=8,
+                             temperature=0.9, seed=11)
+        ref = GenerationEngine(model, cache_bucket=16,
+                               prompt_bucket=8).generate(PROMPTS, g)
+        got = GenerationEngine(model, cache_bucket=16, prompt_bucket=8,
+                               mesh=_mesh(mp=2, dp=2)).generate(PROMPTS, g)
+        np.testing.assert_array_equal(ref, got)
+
+    def test_beam_parity_mp2(self):
+        model = _make(seed=5)
+        g = GenerationConfig(max_new_tokens=5, num_beams=3)
+        ref = GenerationEngine(model, cache_bucket=16,
+                               prompt_bucket=8).generate(PROMPTS, g)
+        got = GenerationEngine(model, cache_bucket=16, prompt_bucket=8,
+                               mesh=_mesh(mp=2)).generate(PROMPTS, g)
+        np.testing.assert_array_equal(ref, got)
+
+    def test_params_actually_sharded(self):
+        model = _make()
+        mesh = _mesh(mp=2)
+        eng = GenerationEngine(model, mesh=mesh)
+        # qkv_proj weight is ColumnParallel: dim1 sharded over mp
+        name = next(n for n in eng._params if "qkv_proj" in n
+                    and "weight" in n)
+        sh = eng._params[name].sharding
+        assert sh.spec[1] == "mp", sh.spec
+
+
+class TestPagedEngineMesh:
+    def test_greedy_parity_mp2(self):
+        model = _make(seed=1)
+        g = GenerationConfig(max_new_tokens=6)
+        ref = PagedGenerationEngine(model, page_size=8,
+                                    prompt_bucket=8).generate(PROMPTS, g)
+        got = PagedGenerationEngine(
+            model, page_size=8, prompt_bucket=8,
+            mesh=_mesh(mp=2)).generate(PROMPTS, g)
+        np.testing.assert_array_equal(ref, got)
+
+    def test_greedy_parity_mp2_dp2(self):
+        model = _make(seed=1)
+        g = GenerationConfig(max_new_tokens=6)
+        ref = PagedGenerationEngine(model, page_size=8,
+                                    prompt_bucket=8).generate(PROMPTS, g)
+        got = PagedGenerationEngine(
+            model, page_size=8, prompt_bucket=8,
+            mesh=_mesh(mp=2, dp=2)).generate(PROMPTS, g)
+        np.testing.assert_array_equal(ref, got)
+
+    def test_beam_parity_mp2(self):
+        model = _make(seed=2)
+        g = GenerationConfig(max_new_tokens=5, num_beams=3)
+        ref = PagedGenerationEngine(model, page_size=8,
+                                    prompt_bucket=8).generate(PROMPTS, g)
+        got = PagedGenerationEngine(
+            model, page_size=8, prompt_bucket=8,
+            mesh=_mesh(mp=2)).generate(PROMPTS, g)
+        np.testing.assert_array_equal(ref, got)
+
+    def test_pool_head_sharded(self):
+        model = _make(seed=1)
+        mesh = _mesh(mp=2)
+        eng = PagedGenerationEngine(model, page_size=8, prompt_bucket=8,
+                                    mesh=mesh)
+        eng.generate(PROMPTS, GenerationConfig(max_new_tokens=4))
+        assert eng._k_pages[0].sharding.spec[1] == "mp"
+
+
+class TestPredictorMesh:
+    def test_from_layer_tp_parity(self):
+        model = _make(seed=4)
+        x = np.random.RandomState(0).randint(
+            0, 96, (2, 8)).astype(np.int32)
+        ref = Predictor.from_layer(model, [pit.to_tensor(x)])
+        want = ref.run([x])[0]
+        cfg = Config()
+        cfg.enable_mesh_sharding(_mesh(mp=2))
+        p = Predictor.from_layer(model, [pit.to_tensor(x)], config=cfg)
+        got = p.run([x])[0]
+        np.testing.assert_allclose(want, got, atol=1e-5)
+
+    def test_artifact_dp_parity(self, tmp_path):
+        import paddle_infer_tpu.nn as nn
+        from paddle_infer_tpu import inference
+        from paddle_infer_tpu.static import InputSpec
+
+        pit.seed(7)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(16, 8)
+
+            def forward(self, x):
+                return pit.nn.functional.relu(self.fc(x))
+
+        m = M()
+        m.eval()
+        prefix = str(tmp_path / "m")
+        pit.jit.save(m, prefix, input_spec=[InputSpec([4, 16])])
+        x = np.random.RandomState(1).rand(4, 16).astype(np.float32)
+        base = inference.create_predictor(inference.Config(prefix))
+        want = base.run([x])[0]
+        cfg = inference.Config(prefix)
+        cfg.enable_mesh_sharding(_mesh(dp=2))
+        pm = inference.create_predictor(cfg)
+        got = pm.run([x])[0]
+        np.testing.assert_allclose(want, got, atol=1e-5)
+
+
+class TestShardMapKernels:
+    def test_paged_decode_shard_map_matches_local(self):
+        """The paged decode kernel under an active mp mesh (shard_map
+        path) must equal the meshless kernel."""
+        import jax.numpy as jnp
+
+        from paddle_infer_tpu.ops.pallas.paged_attention import (
+            paged_attention_decode)
+
+        rs = np.random.RandomState(0)
+        q = jnp.asarray(rs.rand(2, 4, 8).astype(np.float32))
+        kp = jnp.asarray(rs.rand(6, 4, 4, 8).astype(np.float32))
+        vp = jnp.asarray(rs.rand(6, 4, 4, 8).astype(np.float32))
+        tables = jnp.asarray([[1, 2, 0], [3, 4, 5]], np.int32)
+        lengths = jnp.asarray([6, 11], np.int32)
+        want = paged_attention_decode(q, kp, vp, tables, lengths)
+        topology.set_current_mesh(_mesh(mp=2))
+        got = paged_attention_decode(q, kp, vp, tables, lengths)
+        topology.set_current_mesh(None)
+        np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                                   atol=1e-5)
+
+    def test_flash_shard_map_matches_local(self):
+        """The Pallas flash kernel (interpret mode on CPU) run through the
+        shard_map wrap must equal the direct call."""
+        import jax.numpy as jnp
+
+        from paddle_infer_tpu.ops.attention import _mesh_sharded_attn
+        from paddle_infer_tpu.ops.pallas.flash_attention import (
+            flash_attention)
+
+        rs = np.random.RandomState(1)
+        q = jnp.asarray(rs.rand(2, 128, 4, 8).astype(np.float32))
+        k = jnp.asarray(rs.rand(2, 128, 4, 8).astype(np.float32))
+        v = jnp.asarray(rs.rand(2, 128, 4, 8).astype(np.float32))
+        want = flash_attention(q, k, v, is_causal=True)
+        topology.set_current_mesh(_mesh(mp=2, dp=2))
+        got = _mesh_sharded_attn(flash_attention, q, k, v, is_causal=True)
+        topology.set_current_mesh(None)
+        np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                                   atol=1e-5)
