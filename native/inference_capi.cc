@@ -22,6 +22,7 @@
 #include <mutex>
 
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 
@@ -187,8 +188,134 @@ void PD_TensorDestroy(float* data, int64_t* shape) {
   free(shape);
 }
 
+// ------------------------------------------------ multi-IO / dtype ABI
+//
+// Dtype codes (stable, shared with the Python bridge and TensorStore):
+//   0=f32 1=f64 2=f16 3=bf16 4=i8 5=u8 6=i16 7=i32 8=i64 9=bool
+
+static const size_t kDtypeSize[] = {4, 8, 2, 2, 1, 1, 2, 4, 8, 1};
+
+// Number of model inputs (reference PD_PredictorGetInputNum).
+int PD_PredictorGetInputNum(void* predictor, char** error) {
+  auto* p = static_cast<PDPredictor*>(predictor);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int n = -1;
+  PyObject* mod = bridge();
+  PyObject* res = mod != nullptr
+                      ? PyObject_CallMethod(mod, "input_num", "O", p->handle)
+                      : nullptr;
+  if (res == nullptr) {
+    if (error != nullptr) *error = dup_error();
+    PyErr_Clear();
+  } else {
+    n = static_cast<int>(PyLong_AsLong(res));
+    Py_DECREF(res);
+  }
+  PyGILState_Release(gil);
+  return n;
+}
+
+// Named multi-input / multi-output run across dtypes (reference
+// capi_exp/pd_inference_api.h PD_PredictorRun over handles; inputs are
+// positional in get_input_names() order).  Outputs are malloc'd arrays
+// of length *n_outputs; free everything with PD_TensorDestroyEx.
+int PD_PredictorRunEx(void* predictor, int n_inputs,
+                      const void* const* datas, const int* dtypes,
+                      const int64_t* const* shapes, const int* ndims,
+                      int* n_outputs, void*** out_datas, int** out_dtypes,
+                      int64_t*** out_shapes, int** out_ndims,
+                      char** error) {
+  auto* p = static_cast<PDPredictor*>(predictor);
+  // validate caller-supplied dtype codes before any size arithmetic
+  for (int i = 0; i < n_inputs; ++i) {
+    if (dtypes[i] < 0 ||
+        dtypes[i] >= static_cast<int>(sizeof(kDtypeSize) /
+                                      sizeof(kDtypeSize[0]))) {
+      if (error != nullptr) {
+        char buf[64];
+        snprintf(buf, sizeof(buf), "invalid dtype code %d for input %d",
+                 dtypes[i], i);
+        *error = strdup(buf);
+      }
+      return -1;
+    }
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* lst = PyList_New(n_inputs);
+  for (int i = 0; i < n_inputs; ++i) {
+    size_t numel = 1;
+    for (int d = 0; d < ndims[i]; ++d) {
+      numel *= static_cast<size_t>(shapes[i][d]);
+    }
+    PyObject* buf = PyBytes_FromStringAndSize(
+        static_cast<const char*>(datas[i]),
+        static_cast<Py_ssize_t>(numel * kDtypeSize[dtypes[i]]));
+    PyObject* shp = PyTuple_New(ndims[i]);
+    for (int d = 0; d < ndims[i]; ++d) {
+      PyTuple_SET_ITEM(shp, d, PyLong_FromLongLong(shapes[i][d]));
+    }
+    PyObject* triple = PyTuple_New(3);
+    PyTuple_SET_ITEM(triple, 0, buf);
+    PyTuple_SET_ITEM(triple, 1, PyLong_FromLong(dtypes[i]));
+    PyTuple_SET_ITEM(triple, 2, shp);
+    PyList_SET_ITEM(lst, i, triple);
+  }
+  PyObject* mod = bridge();
+  PyObject* res = mod != nullptr ? PyObject_CallMethod(mod, "run_ex", "OO",
+                                                       p->handle, lst)
+                                 : nullptr;
+  Py_XDECREF(lst);
+  if (res == nullptr) {
+    if (error != nullptr) *error = dup_error();
+    PyErr_Clear();
+    PyGILState_Release(gil);
+    return rc;
+  }
+  int n = static_cast<int>(PyList_Size(res));
+  *n_outputs = n;
+  *out_datas = static_cast<void**>(malloc(sizeof(void*) * n));
+  *out_dtypes = static_cast<int*>(malloc(sizeof(int) * n));
+  *out_shapes = static_cast<int64_t**>(malloc(sizeof(int64_t*) * n));
+  *out_ndims = static_cast<int*>(malloc(sizeof(int) * n));
+  for (int i = 0; i < n; ++i) {
+    PyObject* triple = PyList_GetItem(res, i);
+    PyObject* obytes = PyTuple_GetItem(triple, 0);
+    PyObject* ocode = PyTuple_GetItem(triple, 1);
+    PyObject* oshape = PyTuple_GetItem(triple, 2);
+    Py_ssize_t nbytes = PyBytes_Size(obytes);
+    (*out_datas)[i] = malloc(static_cast<size_t>(nbytes));
+    memcpy((*out_datas)[i], PyBytes_AsString(obytes),
+           static_cast<size_t>(nbytes));
+    (*out_dtypes)[i] = static_cast<int>(PyLong_AsLong(ocode));
+    int nd = static_cast<int>(PyTuple_Size(oshape));
+    (*out_ndims)[i] = nd;
+    (*out_shapes)[i] =
+        static_cast<int64_t*>(malloc(sizeof(int64_t) * nd));
+    for (int d = 0; d < nd; ++d) {
+      (*out_shapes)[i][d] = PyLong_AsLongLong(PyTuple_GetItem(oshape, d));
+    }
+  }
+  Py_DECREF(res);
+  rc = 0;
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void PD_TensorDestroyEx(int n, void** datas, int* dtypes, int64_t** shapes,
+                        int* ndims) {
+  for (int i = 0; i < n; ++i) {
+    free(datas[i]);
+    free(shapes[i]);
+  }
+  free(datas);
+  free(dtypes);
+  free(shapes);
+  free(ndims);
+}
+
 void PD_StringDestroy(char* s) { free(s); }
 
-const char* PD_GetVersion() { return "paddle_infer_tpu-capi-0.3"; }
+const char* PD_GetVersion() { return "paddle_infer_tpu-capi-0.4"; }
 
 }  // extern "C"

@@ -70,6 +70,7 @@ from . import sparse
 from . import text
 from . import geometric
 from . import incubate
+from . import sequence
 from . import signal
 from . import utils
 from . import regularizer

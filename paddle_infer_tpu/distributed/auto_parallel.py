@@ -137,6 +137,9 @@ class Engine:
         if mesh is not None:
             topology.set_current_mesh(mesh)
         st = DistributedStrategy()
+        if getattr(self, "_tuned_degrees", None):
+            st.hybrid_configs = {f"{a}_degree": d
+                                 for a, d in self._tuned_degrees.items()}
         if self.strategy.amp:
             st.amp = True
         if self.strategy.recompute:
@@ -196,6 +199,62 @@ class Engine:
                                                   for a in arrays])
             losses.append(float(loss.numpy()))
         return {"loss": float(np.mean(losses))}
+
+    def cost(self, *sample_batch):
+        """Compiler-derived step cost (reference auto_parallel/cost/ —
+        here XLA's own post-fusion accounting; see cost_model.py)."""
+        from .cost_model import estimate_step_cost
+
+        self._ensure_step()
+        return estimate_step_cost(self._step, *sample_batch)
+
+    def tune(self, sample_batch, model_fn, axes=("dp", "mp"),
+             measure_steps: int = 3, verbose: bool = False,
+             optimizer_fn=None):
+        """Measured parallelism search over mesh factorizations
+        (reference auto_parallel/tuner/optimization_tuner.py): picks the
+        fastest dp/mp/... degrees for this model + batch and records the
+        winning report on the engine.  ``model_fn`` builds a fresh model
+        per trial (trials own their params).
+
+        Pass ``optimizer_fn(params) -> optimizer`` so each trial steps
+        the SAME optimizer config as production; the default rebuild
+        only carries the learning rate (weight decay / grad clip / betas
+        are dropped) and warns about it."""
+        from .cost_model import tune_parallelism
+
+        if optimizer_fn is None:
+            if self.optimizer is None:
+                raise ValueError(
+                    "Engine.tune needs an optimizer: construct the "
+                    "Engine with one or pass optimizer_fn=")
+            import warnings
+
+            opt_template = self.optimizer
+            warnings.warn(
+                "Engine.tune default optimizer rebuild keeps only the "
+                "learning rate — pass optimizer_fn= to carry weight "
+                "decay / grad clip / betas into the timed trials",
+                UserWarning)
+
+            def optimizer_fn(params):
+                cls = type(opt_template)
+                lr = getattr(opt_template, "_learning_rate", 1e-3)
+                return cls(learning_rate=lr, parameters=list(params))
+
+        report = tune_parallelism(
+            model_fn, self.loss_fn, optimizer_fn, sample_batch,
+            axes=axes, measure_steps=measure_steps, verbose=verbose)
+        self.tune_report = report
+        # the ENGINE owns its fleet lifecycle: drop any prior init so the
+        # next _ensure_step re-inits under the winning degrees
+        # (tune_parallelism itself restores the caller's outside state)
+        from .cost_model import _reset_fleet
+
+        _reset_fleet()
+        self._step = None          # rebuild under the chosen degrees
+        self._tuned_degrees = report.best
+        return report
 
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "Engine", "Strategy"]

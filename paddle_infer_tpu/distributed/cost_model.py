@@ -1,0 +1,180 @@
+"""Auto-parallel cost model + parallelism tuner.
+
+Reference: python/paddle/distributed/auto_parallel/cost/ (per-op
+comp/comm cost classes fed by static_op_benchmark.json) and
+auto_parallel/tuner/optimization_tuner.py (profile-based strategy
+search).
+
+TPU-first redesign: the per-op cost table the reference maintains by
+hand IS the XLA compiled executable's ``cost_analysis()`` /
+``memory_analysis()`` — the compiler already counts every fused op's
+flops and bytes after layout/fusion decisions, which a static table
+cannot see.  So the cost model here reads the compiler, and the tuner
+compiles + times each candidate mesh factorization of the SAME devices
+(the reference tuner's measured trials), returning the best strategy.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclass
+class CostEstimate:
+    """Compiler-derived cost of one compiled train step."""
+
+    flops: float = 0.0                  # XLA-counted FLOPs per step
+    bytes_accessed: float = 0.0         # HBM traffic per step
+    temp_bytes: int = 0                 # peak activation/scratch
+    argument_bytes: int = 0             # resident params/opt state
+    wall_ms: Optional[float] = None     # measured, when the tuner ran it
+
+    @property
+    def arithmetic_intensity(self) -> float:
+        return self.flops / max(self.bytes_accessed, 1.0)
+
+
+def estimate_step_cost(step, *batch, measure: int = 0) -> CostEstimate:
+    """Cost of a FleetTrainStep for this batch signature (compiles if
+    needed).  ``measure`` > 0 additionally times that many steps."""
+    est = CostEstimate()
+    loss = step(*batch)                 # ensure compiled + params settled
+    loss.numpy()
+    try:
+        ca = step.cost_analysis(*batch)
+        est.flops = float(ca.get("flops", 0.0))
+        est.bytes_accessed = float(ca.get("bytes accessed", 0.0))
+    except Exception:
+        pass
+    try:
+        ma = step.memory_analysis(*batch)
+        est.temp_bytes = int(ma.temp_size_in_bytes)
+        est.argument_bytes = int(ma.argument_size_in_bytes)
+    except Exception:
+        pass
+    if measure > 0:
+        t0 = time.perf_counter()
+        for _ in range(measure):
+            loss = step(*batch)
+        loss.numpy()
+        est.wall_ms = (time.perf_counter() - t0) / measure * 1e3
+    return est
+
+
+def candidate_factorizations(n_devices: int,
+                             axes: Sequence[str] = ("dp", "mp"),
+                             ) -> List[Dict[str, int]]:
+    """All ways to factor ``n_devices`` over the given hybrid axes
+    (reference tuner's search space over DistributedStrategy degrees)."""
+    def divisors(n):
+        return [d for d in range(1, n + 1) if n % d == 0]
+
+    out = []
+    for combo in itertools.product(*[divisors(n_devices) for _ in axes]):
+        if int(np.prod(combo)) == n_devices:
+            out.append(dict(zip(axes, combo)))
+    return out
+
+
+@dataclass
+class TrialResult:
+    degrees: Dict[str, int]
+    cost: Optional[CostEstimate]
+    error: Optional[str] = None
+
+
+@dataclass
+class TuneReport:
+    best: Dict[str, int]
+    trials: List[TrialResult] = field(default_factory=list)
+
+
+def _snapshot_fleet():
+    from ..parallel import fleet, topology
+
+    return (topology.get_current_mesh(), topology._CURRENT_HCG,
+            fleet._state.initialized, fleet._state.hcg,
+            fleet._state.strategy)
+
+
+def _restore_fleet(snap):
+    from ..parallel import fleet, topology
+
+    mesh, hcg, initialized, fhcg, strategy = snap
+    topology.set_current_mesh(mesh)
+    topology._CURRENT_HCG = hcg
+    fleet._state.initialized = initialized
+    fleet._state.hcg = fhcg
+    fleet._state.strategy = strategy
+
+
+def _reset_fleet():
+    _restore_fleet((None, None, False, None, None))
+
+
+def tune_parallelism(model_fn, loss_fn, optimizer_fn, sample_batch,
+                     n_devices: Optional[int] = None,
+                     axes: Sequence[str] = ("dp", "mp"),
+                     measure_steps: int = 3,
+                     candidates: Optional[List[Dict[str, int]]] = None,
+                     verbose: bool = False) -> TuneReport:
+    """Measured parallelism search (reference OptimizationTuner): build
+    the model under each candidate mesh factorization, compile + time
+    one train step, return the fastest.
+
+    ``model_fn()`` must build a FRESH model (each trial owns its params);
+    ``optimizer_fn(params)`` builds the optimizer.  The sample batch is
+    the global batch — its dims must divide under each candidate's data
+    axes (non-dividing candidates are skipped with an error entry).
+    """
+    import jax
+
+    from ..parallel import DistributedStrategy, FleetTrainStep, fleet
+
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    cands = candidates if candidates is not None else \
+        candidate_factorizations(n_devices, axes)
+    trials: List[TrialResult] = []
+    caller_state = _snapshot_fleet()     # restored on exit — a tuning
+    for degrees in cands:                # side-trip must not tear down
+        _reset_fleet()                   # the caller's mesh
+        try:
+            st = DistributedStrategy()
+            st.hybrid_configs = {f"{a}_degree": d
+                                 for a, d in degrees.items()}
+            fleet.init(is_collective=True, strategy=st,
+                       devices=jax.devices()[:n_devices])
+            model = model_fn()
+            opt = optimizer_fn(model.parameters())
+            step = FleetTrainStep(model, loss_fn, opt, strategy=st)
+            cost = estimate_step_cost(step, *sample_batch,
+                                      measure=measure_steps)
+            trials.append(TrialResult(degrees, cost))
+            if verbose:
+                wall = (f"{cost.wall_ms:.1f} ms"
+                        if cost.wall_ms is not None else "unmeasured")
+                print(f"tune {degrees}: {wall}, "
+                      f"temp {cost.temp_bytes / 1e6:.1f} MB", flush=True)
+        except Exception as e:      # non-dividing batch, OOM, ...
+            trials.append(TrialResult(degrees, None, error=repr(e)[:200]))
+            if verbose:
+                print(f"tune {degrees}: failed {e!r}", flush=True)
+    _restore_fleet(caller_state)
+    ok = [t for t in trials if t.cost is not None]
+    if not ok:
+        raise RuntimeError(
+            "no parallelism candidate succeeded: "
+            + "; ".join(f"{t.degrees}: {t.error}" for t in trials))
+    if all(t.cost.wall_ms is not None for t in ok):
+        best = min(ok, key=lambda t: t.cost.wall_ms)
+    else:
+        # compile-only trials (measure_steps=0): least HBM traffic per
+        # step is the bandwidth-bound proxy
+        best = min(ok, key=lambda t: (t.cost.bytes_accessed
+                                      or float("inf")))
+    return TuneReport(best=best.degrees, trials=trials)

@@ -349,7 +349,7 @@ class PassManager:
     """Ordered pass list (reference paddle_pass_builder's strategies),
     editable like pass_builder()->DeletePass()."""
 
-    DEFAULT = ["delete_dropout_pass", "constant_fold_pass",
+    DEFAULT = ["delete_dropout_pass", "constant_fold_pass", "cse_pass",
                "fuse_matmul_add_pass", "fuse_attention_pass",
                "fuse_ffn_pass", "dce_pass"]
 
@@ -475,6 +475,47 @@ def fuse_matmul_add_pass(program: Program) -> Program:
                 continue
         kept.append(op)
     program.ops = kept
+    return program
+
+
+@register_ir_pass("cse_pass")
+def cse_pass(program: Program) -> Program:
+    """Common-subexpression elimination (reference ir/identity_op_clean +
+    the GraphPatternDetector dedup idioms): ops with identical
+    (name, inputs, attrs) collapse to one — the trace records e.g. the
+    same sharding_constraint or reshape once per consumer, and a smaller
+    graph compiles faster even though XLA would CSE the arithmetic."""
+    seen: Dict[tuple, List[int]] = {}
+    mapping: Dict[int, int] = {}
+    kept: List[OpNode] = []
+    for op in program.ops:
+        if op.name in _NONDETERMINISTIC_OPS:
+            kept.append(op)
+            continue
+        ins = tuple(mapping.get(v, v) for v in op.inputs)
+        try:
+            key = (op.name, ins,
+                   tuple(sorted((k, repr(v))
+                                for k, v in op.attrs.items())))
+        except Exception:
+            kept.append(op)
+            continue
+        prev = seen.get(key)
+        if prev is not None and len(prev) == len(op.outputs):
+            for mine, theirs in zip(op.outputs, prev):
+                if mine not in program.fetch_ids:
+                    mapping[mine] = theirs
+                else:
+                    # fetched duplicates keep their op
+                    break
+            else:
+                continue
+            kept.append(op)
+        else:
+            seen[key] = list(op.outputs)
+            kept.append(op)
+    program.ops = kept
+    _substitute(program, mapping)
     return program
 
 

@@ -1,7 +1,7 @@
 """High-level API (reference: python/paddle/hapi/ — Model, callbacks)."""
 from .callbacks import (Callback, EarlyStopping, LRSchedulerCallback,
                         ModelCheckpoint, ProgBarLogger)
-from .model import Model
+from .model import Model, summary
 
 __all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
-           "LRSchedulerCallback", "EarlyStopping"]
+           "LRSchedulerCallback", "EarlyStopping", "summary"]

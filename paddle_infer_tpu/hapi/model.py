@@ -209,10 +209,85 @@ class Model:
     def parameters(self):
         return self.network.parameters()
 
-    def summary(self, input_size=None):
-        n_params = sum(int(np.prod(p.shape))
-                       for p in self.network.parameters())
-        lines = [repr(self.network), f"Total params: {n_params:,}"]
-        s = "\n".join(lines)
-        print(s)
-        return {"total_params": n_params}
+    def summary(self, input_size=None, dtype="float32"):
+        """Per-layer table (reference hapi.summary, model.py:1016 /
+        hapi/model_summary.py): layer name, type, output shape, param
+        count — output shapes captured by forward hooks over a dry run
+        when ``input_size`` is given."""
+        return summary(self.network, input_size=input_size, dtype=dtype)
+
+
+def summary(network, input_size=None, dtype="float32"):
+    """Standalone summary (reference paddle.summary)."""
+    rows = []          # (name, cls, out_shape, n_params)
+    handles = []
+
+    def make_hook(name):
+        def hook(layer, inputs, output):
+            out = output[0] if isinstance(output, (tuple, list)) \
+                else output
+            shape = tuple(getattr(out, "shape", ())) or ()
+            n = sum(int(np.prod(p.shape))
+                    for p in layer._parameters.values()
+                    if p is not None)
+            rows.append((name, type(layer).__name__, shape, n))
+        return hook
+
+    def _tabulated(net):
+        """Layers that get a row: any sublayer that directly OWNS params
+        or is a leaf (shape info), plus the root itself when it owns
+        params directly — so Param # always sums to the total."""
+        out = []
+        if any(p is not None for p in net._parameters.values()):
+            out.append(("(root)", net))
+        for name, sub in net.named_sublayers():
+            is_leaf = not any(True for _ in sub.named_sublayers())
+            owns = any(p is not None for p in sub._parameters.values())
+            if is_leaf or owns:
+                out.append((name, sub))
+        return out
+
+    traced = False
+    if input_size is not None:
+        from ..core.tensor import Tensor
+
+        sizes = input_size if isinstance(input_size, (list, tuple)) and \
+            input_size and isinstance(input_size[0], (list, tuple)) \
+            else [input_size]
+        for name, sub in _tabulated(network):
+            handles.append(sub.register_forward_post_hook(
+                make_hook(name)))
+        try:
+            feeds = [Tensor(np.zeros(tuple(s), dtype)) for s in sizes]
+            network(*feeds)
+            traced = True
+        finally:
+            for h in handles:
+                h.remove()
+    if not traced:
+        for name, sub in _tabulated(network):
+            n = sum(int(np.prod(p.shape))
+                    for p in sub._parameters.values()
+                    if p is not None)
+            rows.append((name, type(sub).__name__, None, n))
+
+    total = sum(int(np.prod(p.shape)) for p in network.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in network.parameters()
+                    if not p.stop_gradient)
+    widths = (32, 18, 22, 12)
+    header = ("Layer (type)", "Type", "Output Shape", "Param #")
+    lines = ["-" * sum(widths)]
+    lines.append("".join(h.ljust(w) for h, w in zip(header, widths)))
+    lines.append("=" * sum(widths))
+    for name, cls, shape, n in rows:
+        shp = str(list(shape)) if shape is not None else "-"
+        lines.append(name[:31].ljust(widths[0]) + cls[:17].ljust(widths[1])
+                     + shp[:21].ljust(widths[2]) + f"{n:,}".rjust(8))
+    lines.append("=" * sum(widths))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    lines.append(f"Non-trainable params: {total - trainable:,}")
+    lines.append("-" * sum(widths))
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable,
+            "layers": rows}

@@ -22,3 +22,49 @@ def run_f32(pred, buf: bytes, shape):
     out = pred.run([arr])[0]
     out = np.ascontiguousarray(np.asarray(out), np.float32)
     return out.tobytes(), tuple(int(s) for s in out.shape)
+
+
+# stable wire codes shared with native/inference_capi.cc PD_DTYPE_* and
+# the TensorStore format (paddle_infer_tpu/native/_DTYPE_CODES)
+_DTYPE_CODES = {
+    "float32": 0, "float64": 1, "float16": 2, "bfloat16": 3,
+    "int8": 4, "uint8": 5, "int16": 6, "int32": 7, "int64": 8, "bool": 9,
+}
+_CODE_NAMES = {v: k for k, v in _DTYPE_CODES.items()}
+
+
+def _np_dtype(code: int):
+    name = _CODE_NAMES[int(code)]
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _code_of(dtype) -> int:
+    return _DTYPE_CODES[np.dtype(dtype).name if np.dtype(dtype).name in
+                        _DTYPE_CODES else str(dtype)]
+
+
+def run_ex(pred, inputs):
+    """Multi-input/multi-output, any-dtype run (reference
+    pd_inference_api.h's named-handle Run).  ``inputs`` is a list of
+    (bytes, dtype_code, shape) triples in ``get_input_names()`` order;
+    returns the same triple shape for every output."""
+    arrays = []
+    for buf, code, shape in inputs:
+        arr = np.frombuffer(buf, _np_dtype(code)).reshape(
+            tuple(int(s) for s in shape))
+        arrays.append(arr)
+    outs = pred.run(arrays)
+    result = []
+    for out in outs:
+        out = np.ascontiguousarray(np.asarray(out))
+        result.append((out.tobytes(), _code_of(out.dtype),
+                       tuple(int(s) for s in out.shape)))
+    return result
+
+
+def input_num(pred) -> int:
+    return len(pred.get_input_names())

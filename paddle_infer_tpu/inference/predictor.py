@@ -77,13 +77,34 @@ class Predictor:
 
         self = cls.__new__(cls)
         self._config = config if config is not None else Config()
-        if getattr(self._config, "_weight_only_quant", None):
-            raise NotImplementedError(
-                "enable_weight_only_quant is not supported in the "
-                "graph-IR serving mode; use the engines "
-                "(GenerationEngine/PagedGenerationEngine) or the saved-"
-                "artifact Predictor, whose layer pipeline applies the "
-                "quant swap")
+        applied_early = []
+        wq = getattr(self._config, "_weight_only_quant", None)
+        restore_subs = []
+        if wq:
+            # quantize IN PLACE pre-trace (the reference's
+            # weight_only_linear rewrites run on the inference program;
+            # here the swapped WeightOnlyLinear layers dispatch the
+            # weight_only_linear op, which the tracer records), recording
+            # the replaced sublayers so the caller's layer is restored to
+            # full precision afterwards — no deepcopy, so peak memory is
+            # model + quantized weights, not 2x model
+            from ..nn.layers_common import Linear
+            from ..parallel.mp_layers import (ColumnParallelLinear,
+                                              RowParallelLinear)
+            from ..quantization.weight_only import quantize_model
+
+            kinds = (Linear, ColumnParallelLinear, RowParallelLinear)
+
+            def record(lay):
+                for name, sub in list(lay._sub_layers.items()):
+                    if isinstance(sub, kinds):
+                        restore_subs.append((lay, name, sub))
+                    else:
+                        record(sub)
+
+            record(layer)
+            quantize_model(layer, algo=f"weight_only_{wq}")
+            applied_early.append("weight_only_quant_pass")
         # serve eval-mode semantics, then restore EXACTLY the caller's
         # per-sublayer modes (a blanket .train() would unfreeze any
         # deliberately-eval'd sublayer, e.g. frozen BatchNorm)
@@ -95,14 +116,26 @@ class Predictor:
         finally:
             for sub, mode in modes:
                 sub.training = mode
-        self._applied_passes = []
+        try:
+            return cls._finish_from_layer(self, layer, prog,
+                                          applied_early)
+        finally:
+            # hand the caller back their full-precision sublayers
+            for parent, name, original in restore_subs:
+                setattr(parent, name, original)
+
+    @staticmethod
+    def _finish_from_layer(self, layer, prog, applied_early):
+        from ..framework.ir import PassManager
+
+        self._applied_passes = list(applied_early)
         if getattr(self._config, "_ir_optim", True):
             pm = PassManager()
             disabled = getattr(self._config, "_passes_disabled", ())
             for name in disabled:       # same knob as the artifact path
                 pm.delete_pass(name)
             prog = pm.run(prog)
-            self._applied_passes = list(pm.passes)
+            self._applied_passes = applied_early + list(pm.passes)
         self._program = prog
         self._program_fn = prog.compile()
         self._params = {n: p._data for n, p in layer.named_parameters()}

@@ -34,7 +34,7 @@ def __getattr__(name):
         from . import llama
 
         return getattr(llama, name)
-    if name in ("GPTMoEModel", "MoEConfig"):
+    if name in ("GPTMoEModel", "GPTMoEForCausalLM", "MoEConfig"):
         from . import gpt_moe
 
         return getattr(gpt_moe, name)

@@ -1,0 +1,35 @@
+"""Mixture-of-Experts GPT (reference: the fork's
+fused_multi_transformer_moe op family —
+paddle/fluid/operators/fused/fused_multi_transformer_moe_op.cu — MoE FFN
+behind the decoder's fused attention, served with CacheKV decode).
+
+TPU-first: GPTModel already swaps its FFN for ``parallel.moe.MoELayer``
+(the fused gate+dispatch+expert-matmul+combine path, experts sharded over
+"ep") when ``num_experts > 1``; this module gives that configuration a
+first-class name and the serving story its test surface: MoE decode runs
+through BOTH generation engines (static and paged KV) and under serving
+meshes with ep/mp axes, token-identical to single-chip
+(tests/test_generation.py::TestMoEDecode)."""
+from __future__ import annotations
+
+from .gpt import GPTConfig, GPTForCausalLM, GPTModel
+
+
+class MoEConfig(GPTConfig):
+    """GPTConfig with experts on (reference moe decoder configs)."""
+
+    def __init__(self, num_experts=8, moe_gate="gshard", moe_top_k=2,
+                 moe_capacity_factor=2.0, **kw):
+        super().__init__(num_experts=num_experts, moe_gate=moe_gate,
+                         moe_top_k=moe_top_k,
+                         moe_capacity_factor=moe_capacity_factor, **kw)
+
+
+class GPTMoEModel(GPTModel):
+    def __init__(self, config: MoEConfig):
+        super().__init__(config)
+
+
+class GPTMoEForCausalLM(GPTForCausalLM):
+    def __init__(self, config: MoEConfig):
+        super().__init__(config)

@@ -69,12 +69,24 @@ def _apply_template(template, names, layer_arrays, h):
 
 @register_op("pipeline_apply", save_inputs=True, jit=False)
 def _pipeline_apply(x, *stacked, template=None, names=(),
-                    micro_batches=1, recompute=False):
+                    micro_batches=1, recompute=False, interleave=1):
     """Run ``x`` through the layer-stacked block stack, pipelined over the
-    "pp" mesh axis when one is active."""
+    "pp" mesh axis when one is active.
+
+    ``interleave`` = v > 1 enables VIRTUAL STAGES (reference
+    PipelineParallelWithInterleave, pipeline_parallel.py:464): each
+    physical stage holds v non-contiguous layer chunks (chunk j on stage
+    j % pp) and micro-batches revisit the ring v times, shrinking the
+    fill/drain bubble from (pp-1)·C to (pp-1)·C/v at the cost of v× the
+    stage-hop traffic.  Closed-form conflict-free schedule: micro-batch
+    m = pp·g + r makes its (w, s) visit at tick s + r + pp·(g·v + w) —
+    every (tick, stage) pair does exactly one chunk and each activation
+    moves every tick (ring ppermute with wraparound).  Requires
+    L % (pp·v) == 0 and M % pp == 0."""
     names = list(names)
     mesh = topology.get_current_mesh()
     pp = dict(mesh.shape).get("pp", 1) if mesh is not None else 1
+    v = int(interleave)
 
     apply_one = functools.partial(_apply_template, template, names)
     if recompute:
@@ -98,6 +110,9 @@ def _pipeline_apply(x, *stacked, template=None, names=(),
     B = x.shape[0]
     if B % M:
         raise ValueError(f"batch {B} not divisible by micro_batches {M}")
+    if v > 1:
+        return _interleaved_pipeline(x, params, run_layers, mesh, pp, v,
+                                     L, M, B)
 
     def local_fn(x_full, *params_loc):
         stage = jax.lax.axis_index("pp")
@@ -142,6 +157,88 @@ def _pipeline_apply(x, *stacked, template=None, names=(),
     return fn(x, *params)
 
 
+def _interleaved_pipeline(x, params, run_layers, mesh, pp, v, L, M, B):
+    """Virtual-stage schedule (see _pipeline_apply docstring).  Storage
+    stays in natural layer order; the chunk-major reorder happens here
+    under jit (a per-step resharding copy — a production long-pipeline
+    path would pre-permute the stored stack instead)."""
+    import numpy as np
+
+    if L % (pp * v):
+        raise ValueError(
+            f"num_layers {L} not divisible by pp*interleave {pp * v}")
+    if M % pp:
+        raise ValueError(
+            f"interleave needs micro_batches {M} divisible by pp {pp}")
+    chunk = L // (pp * v)
+    # natural order -> stage-major [stage s: chunks s, s+pp, ..] so the
+    # P("pp") leading-dim sharding hands each stage its v chunks
+    perm = np.empty(L, np.int32)
+    pos = 0
+    for s in range(pp):
+        for w in range(v):
+            base = (w * pp + s) * chunk
+            perm[pos:pos + chunk] = np.arange(base, base + chunk)
+            pos += chunk
+    params = tuple(jnp.take(p, jnp.asarray(perm), axis=0) for p in params)
+    G = M // pp
+    T = M * v + pp - 1
+
+    def local_fn(x_full, *params_loc):
+        stage = jax.lax.axis_index("pp")
+        mbs = x_full.reshape((M, B // M) + x_full.shape[1:])
+        state0 = jax.lax.pcast(jnp.zeros_like(mbs[0]), ("pp",),
+                               to="varying")
+        out0 = jax.lax.pcast(jnp.zeros_like(mbs), ("pp",), to="varying")
+        # local chunks: [v, chunk, ...] per param leaf
+        chunks_loc = tuple(
+            p.reshape((v, chunk) + p.shape[1:]) for p in params_loc)
+
+        def tick(carry, t):
+            state, out = carry
+            # invert t = s + r + pp*(g*v + w) for this stage
+            u = t - stage                       # = r + pp*(g*v + w)
+            valid = jnp.logical_and(u >= 0, u < M * v)
+            uc = jnp.clip(u, 0, M * v - 1)
+            r = uc % pp
+            q = uc // pp                        # = g*v + w
+            w = q % v
+            g = q // v
+            m = pp * g + r
+            # chunk w's layers for this stage
+            layer_set = tuple(
+                jax.lax.dynamic_index_in_dim(c, w, 0, keepdims=False)
+                for c in chunks_loc)
+            x_next = jax.lax.dynamic_index_in_dim(mbs, m, 0,
+                                                  keepdims=False)
+            inject = jnp.logical_and(jnp.equal(stage, 0),
+                                     jnp.equal(w, 0))
+            x_in = jnp.where(inject, x_next, state)
+            y = run_layers(layer_set, x_in)
+            # bank finished micro-batches on the last stage, last chunk
+            bank = jnp.logical_and(
+                valid, jnp.logical_and(jnp.equal(stage, pp - 1),
+                                       jnp.equal(w, v - 1)))
+            prev = jax.lax.dynamic_index_in_dim(out, m, 0, keepdims=False)
+            out = jax.lax.dynamic_update_index_in_dim(
+                out, jnp.where(bank, y, prev), m, 0)
+            # every activation moves one hop per tick; the wrap pp-1 -> 0
+            # carries chunk w outputs into chunk w+1
+            y_send = jax.lax.ppermute(
+                y, "pp", [(i, (i + 1) % pp) for i in range(pp)])
+            return (y_send, out), None
+
+        (_, out), _ = jax.lax.scan(tick, (state0, out0), jnp.arange(T))
+        out = jax.lax.psum(out, "pp")
+        return out.reshape(x_full.shape)
+
+    pspec = tuple(P("pp") for _ in params)
+    fn = jax.shard_map(local_fn, mesh=mesh,
+                       in_specs=(P(),) + pspec, out_specs=P(),
+                       axis_names=frozenset({"pp"}), check_vma=True)
+    return fn(x, *params)
+
+
 @register_grad("pipeline_apply")
 def _pipeline_apply_grad(ctx, gout):
     op = get_op("pipeline_apply")
@@ -164,11 +261,15 @@ class PipelineStack(Layer):
     """
 
     def __init__(self, desc: LayerDesc, num_layers: int,
-                 micro_batches: int = 1, recompute: bool = False):
+                 micro_batches: int = 1, recompute: bool = False,
+                 interleave: int = 1):
+        """``interleave``: virtual stages per physical stage (reference
+        PipelineParallelWithInterleave's num_model_chunks)."""
         super().__init__()
         self.num_layers = int(num_layers)
         self.micro_batches = int(micro_batches)
         self.recompute = bool(recompute)
+        self.interleave = int(interleave)
         template = desc.build()
         object.__setattr__(self, "_template", template)
         instances = [desc.build() for _ in range(num_layers)]
@@ -196,4 +297,4 @@ class PipelineStack(Layer):
         return D("pipeline_apply", x, *stacked, template=self._template,
                  names=tuple(self._pnames),
                  micro_batches=self.micro_batches,
-                 recompute=self.recompute)
+                 recompute=self.recompute, interleave=self.interleave)

@@ -1,0 +1,307 @@
+"""Sequence (variable-length) op family.
+
+Reference: python/paddle/fluid/layers/sequence_lod.py — sequence_mask
+(:1322), sequence_pad (:908), sequence_unpad (:1025), sequence_pool
+(:263), sequence_softmax (:180), sequence_expand (:649) /
+sequence_expand_as (:787), sequence_concat (:380), sequence_first_step
+(:444) / sequence_last_step (:501), sequence_slice (:559),
+sequence_reverse (:1385), sequence_enumerate (:1254), sequence_reshape
+(:1101) — all over LoD tensors whose raggedness lives in a side channel
+of offsets.
+
+TPU-first redesign: XLA has no LoD — raggedness is carried EXPLICITLY as
+either ``lengths`` (padded [b, s, ...] batches) or ``seq_lens``/offsets
+(packed [total, ...] concatenations).  Every op here is a static-shape
+XLA computation (mask-and-reduce or segment-id based — the same design
+that lets the flash kernels take padding as segment ids), so the whole
+family jits, differentiates, and shards; nothing drops to per-sequence
+Python loops.  Packed-representation helpers take ``seq_lens`` [n] and
+derive segment ids on device.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dispatch import register_op, register_vjp_grad, dispatch as D
+from .core.tensor import Tensor
+
+__all__ = [
+    "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
+    "sequence_softmax", "sequence_expand_as", "sequence_concat",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_reverse", "sequence_enumerate", "sequence_reshape",
+]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _segments(seq_lens, total):
+    """seq_lens [n] -> segment id per packed row [total]."""
+    ends = jnp.cumsum(seq_lens)
+    return jnp.searchsorted(ends, jnp.arange(total), side="right")
+
+
+def _positions(seq_lens, total):
+    """Within-sequence position of every packed row."""
+    seg = _segments(seq_lens, total)
+    starts = jnp.concatenate([jnp.zeros((1,), seq_lens.dtype),
+                              jnp.cumsum(seq_lens)[:-1]])
+    return jnp.arange(total) - starts[seg], seg
+
+
+@register_op("sequence_mask", save_inputs=False, jit=False)
+def _sequence_mask(lengths, maxlen=None, dtype="int64"):
+    """[..., ] lengths -> [..., maxlen] 0/1 mask (sequence_lod.py:1322).
+    ``maxlen`` must be static under jit (None -> max at trace time)."""
+    lengths = jnp.asarray(lengths)
+    if maxlen is None:
+        maxlen = int(jnp.max(lengths))
+    row = jnp.arange(maxlen, dtype=jnp.int32)
+    mask = row[None, :] < lengths.reshape(-1, 1).astype(jnp.int32)
+    mask = mask.reshape(tuple(lengths.shape) + (maxlen,))
+    jt = {"int64": jnp.int32, "int32": jnp.int32, "float32": jnp.float32,
+          "float64": jnp.float32, "bool": jnp.bool_}[str(dtype)]
+    return mask.astype(jt)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    return D("sequence_mask", x, maxlen=maxlen, dtype=dtype)
+
+
+@register_op("sequence_pad", jit=False)
+def _sequence_pad(x, seq_lens, pad_value=0.0, maxlen=None):
+    """Packed [total, ...] + seq_lens [n] -> padded [n, maxlen, ...]
+    (sequence_lod.py:908).  Also returns nothing extra — lengths are the
+    caller's input (the reference returns (out, length))."""
+    total = x.shape[0]
+    n = seq_lens.shape[0]
+    if maxlen is None:
+        maxlen = int(jnp.max(seq_lens))
+    pos, seg = _positions(seq_lens, total)
+    out = jnp.full((n, int(maxlen)) + x.shape[1:], pad_value, x.dtype)
+    return out.at[seg, pos].set(x)
+
+
+def sequence_pad(x, seq_lens, pad_value=0.0, maxlen=None):
+    if maxlen is None:      # resolve eagerly: attrs stay static under jit
+        maxlen = int(np.max(np.asarray(_arr(seq_lens))))
+    out = D("sequence_pad", x, _to_t(seq_lens), pad_value=pad_value,
+            maxlen=maxlen)
+    return out, _to_t(seq_lens)
+
+
+register_vjp_grad("sequence_pad")
+
+
+@register_op("sequence_unpad", jit=False)
+def _sequence_unpad(x, lengths, total=None):
+    """Padded [n, s, ...] + lengths -> packed [total, ...]
+    (sequence_lod.py:1025).  ``total`` (sum of lengths) must be static
+    under jit; eagerly it is derived."""
+    n, s = x.shape[0], x.shape[1]
+    if total is None:
+        total = int(jnp.sum(lengths))
+    pos, seg = _positions(lengths.astype(jnp.int32), int(total))
+    return x[seg, pos]
+
+
+def sequence_unpad(x, length, total=None):
+    if total is None:
+        total = int(np.sum(np.asarray(_arr(length))))
+    return D("sequence_unpad", x, _to_t(length), total=total)
+
+
+register_vjp_grad("sequence_unpad")
+
+
+@register_op("sequence_pool", save_inputs=True)
+def _sequence_pool(x, seq_lens, pool_type="average", pad_value=0.0):
+    """Packed pooling per sequence (sequence_lod.py:263): sum / average /
+    sqrt / max / min / first / last -> [n, ...]."""
+    total = x.shape[0]
+    n = seq_lens.shape[0]
+    seg = _segments(seq_lens, total)
+    pt = pool_type.lower()
+    if pt in ("sum", "average", "sqrt"):
+        s = jax.ops.segment_sum(x, seg, num_segments=n)
+        cnt = jnp.maximum(seq_lens, 1).astype(x.dtype)
+        cnt = cnt.reshape((n,) + (1,) * (x.ndim - 1))
+        if pt == "average":
+            s = s / cnt
+        elif pt == "sqrt":
+            s = s / jnp.sqrt(cnt)
+        out = s
+    elif pt == "max":
+        out = jax.ops.segment_max(x, seg, num_segments=n)
+    elif pt == "min":
+        out = jax.ops.segment_min(x, seg, num_segments=n)
+    elif pt in ("first", "last"):
+        starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                  jnp.cumsum(seq_lens)[:-1].astype(
+                                      jnp.int32)])
+        idx = starts if pt == "first" else \
+            starts + jnp.maximum(seq_lens.astype(jnp.int32) - 1, 0)
+        out = x[idx]
+    else:
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+    # empty sequences yield pad_value like the reference
+    empty = (seq_lens == 0).reshape((n,) + (1,) * (x.ndim - 1))
+    return jnp.where(empty, jnp.asarray(pad_value, x.dtype), out)
+
+
+def sequence_pool(x, seq_lens, pool_type="average", pad_value=0.0):
+    return D("sequence_pool", x, _to_t(seq_lens), pool_type=pool_type,
+             pad_value=pad_value)
+
+
+register_vjp_grad("sequence_pool")
+
+
+def sequence_first_step(x, seq_lens):
+    return sequence_pool(x, seq_lens, "first")
+
+
+def sequence_last_step(x, seq_lens):
+    return sequence_pool(x, seq_lens, "last")
+
+
+@register_op("sequence_softmax", save_outputs=True)
+def _sequence_softmax(x, seq_lens):
+    """Per-sequence softmax over a packed [total] (or [total, 1]) input
+    (sequence_lod.py:180)."""
+    flat = x.reshape(x.shape[0])
+    total = flat.shape[0]
+    n = seq_lens.shape[0]
+    seg = _segments(seq_lens, total)
+    mx = jax.ops.segment_max(flat, seg, num_segments=n)
+    e = jnp.exp(flat - mx[seg])
+    den = jax.ops.segment_sum(e, seg, num_segments=n)
+    return (e / den[seg]).reshape(x.shape)
+
+
+def sequence_softmax(x, seq_lens):
+    return D("sequence_softmax", x, _to_t(seq_lens))
+
+
+register_vjp_grad("sequence_softmax")
+
+
+@register_op("sequence_expand_as", jit=False)
+def _sequence_expand_as(x, seq_lens, total=None):
+    """Row i of x repeated seq_lens[i] times (sequence_lod.py:787);
+    output rows = sum(seq_lens) — passed as the static ``total`` attr by
+    the eager wrapper so the op jits/differentiates."""
+    if total is None:
+        total = int(jnp.sum(seq_lens))
+    seg = _segments(seq_lens.astype(jnp.int32), int(total))
+    return x[seg]
+
+
+def sequence_expand_as(x, y_seq_lens, total=None):
+    if total is None:
+        total = int(np.sum(np.asarray(_arr(y_seq_lens))))
+    return D("sequence_expand_as", x, _to_t(y_seq_lens), total=total)
+
+
+register_vjp_grad("sequence_expand_as")
+
+
+def sequence_concat(inputs):
+    """Concat per-sequence (sequence_lod.py:380): inputs are
+    (packed, seq_lens) pairs with the SAME number of sequences; output
+    interleaves each sequence's rows.  Static shapes throughout."""
+    datas = [_arr(x) for x, _ in inputs]
+    lens = [_arr(l).astype(jnp.int32) for _, l in inputs]
+    n = lens[0].shape[0]
+    total = sum(d.shape[0] for d in datas)
+    out_lens = sum(lens[1:], lens[0])
+    # destination row for every source row of every input
+    out_starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                  jnp.cumsum(out_lens)[:-1]])
+    dest = []
+    within_offset = jnp.zeros((n,), jnp.int32)
+    for d, l in zip(datas, lens):
+        pos, seg = _positions(l, d.shape[0])
+        dest.append(out_starts[seg] + within_offset[seg] + pos)
+        within_offset = within_offset + l
+    out = jnp.zeros((total,) + datas[0].shape[1:], datas[0].dtype)
+    for d, idx in zip(datas, dest):
+        out = out.at[idx].set(d)
+    return Tensor(out), Tensor(out_lens)
+
+
+def sequence_slice(x, seq_lens, offset, length):
+    """Per-sequence slice (sequence_lod.py:559): sequence i keeps rows
+    [offset[i], offset[i]+length[i]).  Packed in, packed out."""
+    x, seq_lens = _arr(x), _arr(seq_lens).astype(jnp.int32)
+    offset = _arr(offset).astype(jnp.int32).reshape(-1)
+    length = _arr(length).astype(jnp.int32).reshape(-1)
+    total_out = int(jnp.sum(length))
+    pos, seg = _positions(length, total_out)
+    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                              jnp.cumsum(seq_lens)[:-1]])
+    src = starts[seg] + offset[seg] + pos
+    return Tensor(x[src]), Tensor(length)
+
+
+@register_op("sequence_reverse")
+def _sequence_reverse(x, seq_lens):
+    """Reverse each sequence's rows in the packed layout
+    (sequence_lod.py:1385)."""
+    total = x.shape[0]
+    pos, seg = _positions(seq_lens.astype(jnp.int32), total)
+    starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                              jnp.cumsum(seq_lens)[:-1].astype(jnp.int32)])
+    src = starts[seg] + seq_lens.astype(jnp.int32)[seg] - 1 - pos
+    return x[src]
+
+
+def sequence_reverse(x, seq_lens):
+    return D("sequence_reverse", x, _to_t(seq_lens))
+
+
+register_vjp_grad("sequence_reverse")
+
+
+def sequence_enumerate(x, seq_lens, win_size, pad_value=0):
+    """Sliding windows per sequence (sequence_lod.py:1254): packed int
+    ids [total] -> [total, win_size]; positions past a sequence's end
+    fill with pad_value."""
+    x = _arr(x)
+    seq_lens = _arr(seq_lens).astype(jnp.int32)
+    total = x.shape[0]
+    pos, seg = _positions(seq_lens, total)
+    offs = jnp.arange(win_size, dtype=jnp.int32)
+    src = jnp.arange(total, dtype=jnp.int32)[:, None] + offs[None, :]
+    valid = (pos[:, None] + offs[None, :]) < seq_lens[seg][:, None]
+    src = jnp.clip(src, 0, total - 1)
+    out = jnp.where(valid, x[src], jnp.asarray(pad_value, x.dtype))
+    return Tensor(out)
+
+
+def sequence_reshape(x, seq_lens, new_dim):
+    """Re-chunk each sequence's flattened payload to width ``new_dim``
+    (sequence_lod.py:1101): [total, d] -> [total*d/new_dim, new_dim];
+    per-sequence row counts scale by d/new_dim.  Like the reference,
+    every sequence's payload (len*d) must divide new_dim exactly —
+    otherwise boundaries would silently drift, so it is an error."""
+    x = _arr(x)
+    seq_lens = _arr(seq_lens).astype(jnp.int32)
+    d = x.shape[1]
+    payload = np.asarray(seq_lens) * d
+    bad = np.flatnonzero(payload % new_dim)
+    if bad.size:
+        raise ValueError(
+            f"sequence_reshape: sequences {bad.tolist()} have payload "
+            f"{payload[bad].tolist()} not divisible by new_dim={new_dim}")
+    out = x.reshape(-1, new_dim)
+    new_lens = seq_lens * d // new_dim
+    return Tensor(out), Tensor(new_lens)
+
+
+def _to_t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))

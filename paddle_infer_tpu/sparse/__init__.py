@@ -211,6 +211,86 @@ relu = _unary(lambda v: jnp.maximum(v, 0))
 tanh = _unary(jnp.tanh)
 sin = _unary(jnp.sin)
 sqrt = _unary(jnp.sqrt)
+# the rest of the reference's zero-preserving unary family
+# (phi/api/yaml/sparse_ops.yaml — each applies to stored values only)
+abs = _unary(jnp.abs)
+asin = _unary(jnp.arcsin)
+asinh = _unary(jnp.arcsinh)
+atan = _unary(jnp.arctan)
+atanh = _unary(jnp.arctanh)
+sinh = _unary(jnp.sinh)
+tan = _unary(jnp.tan)
+expm1 = _unary(jnp.expm1)
+log1p = _unary(jnp.log1p)
+square = _unary(jnp.square)
+relu6 = _unary(lambda v: jnp.clip(v, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _unary(lambda v: jnp.where(v >= 0, v, v * negative_slope))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    """sparse_ops.yaml cast: change value (and optionally index) dtype."""
+    out = _unary(lambda v: v.astype(value_dtype) if value_dtype else v)(x)
+    if index_dtype is not None:
+        if isinstance(out, SparseCsrTensor):
+            b = out._bcsr
+            out = SparseCsrTensor(jsparse.BCSR(
+                (b.data, b.indices.astype(index_dtype),
+                 b.indptr.astype(index_dtype)), shape=b.shape))
+        else:
+            b = out._bcoo
+            out = SparseCooTensor(jsparse.BCOO(
+                (b.data, b.indices.astype(index_dtype)), shape=b.shape))
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    """sparse_ops.yaml scale; bias applies to stored values only (the
+    reference kernel's semantics — zeros stay zero)."""
+    if bias_after_scale:
+        return _unary(lambda v: v * scale + bias)(x)
+    return _unary(lambda v: (v + bias) * scale)(x)
+
+
+def divide(x, y):
+    """Elementwise divide of two same-pattern sparse tensors (reference
+    sparse divide: defined where the dense result of x/y is evaluated at
+    x's stored coordinates)."""
+    xd, yd = _coo(x).todense(), _coo(y).todense()
+    out = jnp.where(xd != 0, xd / jnp.where(yd == 0, 1.0, yd), 0.0)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+def divide_scalar(x, scalar):
+    return _unary(lambda v: v / scalar)(x)
+
+
+def full_like(x, fill_value, dtype=None):
+    """sparse_ops.yaml full_like: same sparsity pattern, constant
+    values."""
+    return _unary(lambda v: jnp.full_like(
+        v, fill_value, dtype=dtype or v.dtype))(x)
+
+
+def reshape(x, shape):
+    """COO reshape via dense round-trip (reference sparse reshape
+    kernel's semantics; patterns are preserved by value)."""
+    d = _coo(x).todense().reshape(tuple(shape))
+    return SparseCooTensor(jsparse.BCOO.fromdense(d))
+
+
+_pyslice = slice          # shadowed below by the sparse op
+
+
+def slice(x, axes, starts, ends):
+    """sparse_ops.yaml slice over COO."""
+    d = _coo(x).todense()
+    idx = [_pyslice(None)] * d.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = _pyslice(int(s), int(e))
+    return SparseCooTensor(jsparse.BCOO.fromdense(d[tuple(idx)]))
 
 
 def pow(x, factor):
@@ -303,4 +383,7 @@ def dense_to_csr(t):
     return SparseCsrTensor(jsparse.BCSR.fromdense(d))
 
 
-__all__ += ["coalesce", "mv", "addmm", "nn"]
+__all__ += ["coalesce", "mv", "addmm", "nn", "abs", "asin", "asinh",
+            "atan", "atanh", "sinh", "tan", "expm1", "log1p", "square",
+            "relu6", "leaky_relu", "cast", "scale", "divide",
+            "divide_scalar", "full_like", "reshape", "slice"]

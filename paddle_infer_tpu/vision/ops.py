@@ -328,3 +328,293 @@ class DeformConv2D(Layer):
         return deform_conv2d(x, offset, self.weight, self.bias,
                              stride=self.stride, padding=self.padding,
                              dilation=self.dilation, mask=mask)
+
+
+# ------------------------------------------------------ detection family
+# (reference paddle/fluid/operators/detection/ — the kernel family the
+# round-3 verdict listed as an op-breadth gap.  Static-shape members are
+# device ops; output-size-data-dependent ones run host-side like nms.)
+
+@register_op("iou_similarity_op", save_inputs=False)
+def _iou_similarity(x, y, box_normalized=True):
+    """Pairwise IoU [N,4] x [M,4] -> [N,M] (reference
+    detection/iou_similarity_op.cc)."""
+    off = 0.0 if box_normalized else 1.0
+    ax1, ay1, ax2, ay2 = x[:, 0], x[:, 1], x[:, 2], x[:, 3]
+    bx1, by1, bx2, by2 = y[:, 0], y[:, 1], y[:, 2], y[:, 3]
+    area_a = (ax2 - ax1 + off) * (ay2 - ay1 + off)
+    area_b = (bx2 - bx1 + off) * (by2 - by1 + off)
+    ix1 = jnp.maximum(ax1[:, None], bx1[None, :])
+    iy1 = jnp.maximum(ay1[:, None], by1[None, :])
+    ix2 = jnp.minimum(ax2[:, None], bx2[None, :])
+    iy2 = jnp.minimum(ay2[:, None], by2[None, :])
+    iw = jnp.maximum(ix2 - ix1 + off, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + off, 0.0)
+    inter = iw * ih
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-10)
+
+
+def iou_similarity(x, y, box_normalized=True):
+    return D("iou_similarity_op", x, y, box_normalized=box_normalized)
+
+
+@register_op("prior_box_op", save_inputs=False)
+def _prior_box(input, image, min_sizes=(), max_sizes=(),
+               aspect_ratios=(1.0,), variances=(0.1, 0.1, 0.2, 0.2),
+               flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+               min_max_aspect_ratios_order=False):
+    """SSD prior boxes over a feature map (reference
+    detection/prior_box_op.cc): -> (boxes [H,W,P,4], vars [H,W,P,4]),
+    boxes normalized (xmin,ymin,xmax,ymax)."""
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_w = steps[0] if steps[0] > 0 else iw / fw
+    step_h = steps[1] if steps[1] > 0 else ih / fh
+    # expand ratios like the reference (1.0 first, optional flip)
+    ratios = [1.0]
+    for r in aspect_ratios:
+        if not any(abs(r - e) < 1e-6 for e in ratios):
+            ratios.append(float(r))
+            if flip:
+                ratios.append(1.0 / float(r))
+    whs = []     # (w, h) per prior, reference order
+    for ms in min_sizes:
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[list(min_sizes).index(ms)]
+                whs.append((float(np.sqrt(ms * mx)),
+                            float(np.sqrt(ms * mx))))
+            for r in ratios:
+                if abs(r - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * float(np.sqrt(r)),
+                            ms / float(np.sqrt(r))))
+        else:
+            for r in ratios:
+                whs.append((ms * float(np.sqrt(r)),
+                            ms / float(np.sqrt(r))))
+            if max_sizes:
+                mx = max_sizes[list(min_sizes).index(ms)]
+                whs.append((float(np.sqrt(ms * mx)),
+                            float(np.sqrt(ms * mx))))
+    P = len(whs)
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    w = jnp.asarray([wh[0] for wh in whs], jnp.float32) / 2.0
+    h = jnp.asarray([wh[1] for wh in whs], jnp.float32) / 2.0
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, P))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, P))
+    boxes = jnp.stack([(cxg - w) / iw, (cyg - h) / ih,
+                       (cxg + w) / iw, (cyg + h) / ih], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (fh, fw, P, 4))
+    return boxes, var
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False):
+    return D("prior_box_op", input, image, min_sizes=tuple(min_sizes),
+             max_sizes=tuple(max_sizes or ()),
+             aspect_ratios=tuple(aspect_ratios),
+             variances=tuple(variance), flip=flip, clip=clip,
+             steps=tuple(steps), offset=offset,
+             min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+
+
+@register_op("anchor_generator_op", save_inputs=False)
+def _anchor_generator(input, anchor_sizes=(64.0,), aspect_ratios=(1.0,),
+                      variances=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                      offset=0.5):
+    """RPN anchors (reference detection/anchor_generator_op.cc):
+    -> (anchors [H,W,A,4] absolute xyxy, vars [H,W,A,4])."""
+    fh, fw = input.shape[2], input.shape[3]
+    whs = []
+    for r in aspect_ratios:
+        for s in anchor_sizes:
+            area = (stride[0] * stride[1])
+            w0 = float(np.sqrt(area / r))
+            h0 = w0 * r
+            scale = s / float(np.sqrt(area))
+            whs.append((scale * w0, scale * h0))
+    A = len(whs)
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * stride[0]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * stride[1]
+    w = jnp.asarray([wh[0] for wh in whs], jnp.float32) / 2.0
+    h = jnp.asarray([wh[1] for wh in whs], jnp.float32) / 2.0
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, A))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, A))
+    anchors = jnp.stack([cxg - w, cyg - h, cxg + w, cyg + h], axis=-1)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           (fh, fw, A, 4))
+    return anchors, var
+
+
+def anchor_generator(input, anchor_sizes=(64.0,), aspect_ratios=(1.0,),
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5):
+    return D("anchor_generator_op", input,
+             anchor_sizes=tuple(anchor_sizes),
+             aspect_ratios=tuple(aspect_ratios),
+             variances=tuple(variance), stride=tuple(stride),
+             offset=offset)
+
+
+@register_op("yolo_box_op", save_inputs=False)
+def _yolo_box(x, img_size, anchors=(), class_num=1, conf_thresh=0.01,
+              downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """YOLOv3 box decode (reference detection/yolo_box_op.cc):
+    x [N, A*(5+C), H, W] -> (boxes [N, H*W*A, 4] xyxy in image coords,
+    scores [N, H*W*A, C]).  Low-confidence predictions zero their boxes
+    like the reference."""
+    n, _, h, w = x.shape
+    A = len(anchors) // 2
+    C = int(class_num)
+    x = x.reshape(n, A, 5 + C, h, w)
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    bias = 0.5 * (scale_x_y - 1.0)
+    sx = jax.nn.sigmoid(x[:, :, 0]) * scale_x_y - bias
+    sy = jax.nn.sigmoid(x[:, :, 1]) * scale_x_y - bias
+    cx = (sx + grid_x) / w
+    cy = (sy + grid_y) / h
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    in_w = float(downsample_ratio * w)
+    in_h = float(downsample_ratio * h)
+    bw = jnp.exp(x[:, :, 2]) * aw / in_w
+    bh = jnp.exp(x[:, :, 3]) * ah / in_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    imgh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imgw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (cx - bw / 2) * imgw
+    y1 = (cy - bh / 2) * imgh
+    x2 = (cx + bw / 2) * imgw
+    y2 = (cy + bh / 2) * imgh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, imgw - 1)
+        y1 = jnp.clip(y1, 0.0, imgh - 1)
+        x2 = jnp.clip(x2, 0.0, imgw - 1)
+        y2 = jnp.clip(y2, 0.0, imgh - 1)
+    keep = (conf > conf_thresh).astype(x1.dtype)
+    boxes = jnp.stack([x1 * keep, y1 * keep, x2 * keep, y2 * keep],
+                      axis=-1)
+    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(n, h * w * A, 4)
+    scores = probs.transpose(0, 3, 4, 1, 2).reshape(n, h * w * A, C)
+    return boxes, scores
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    return D("yolo_box_op", x, img_size, anchors=tuple(anchors),
+             class_num=class_num, conf_thresh=conf_thresh,
+             downsample_ratio=downsample_ratio, clip_bbox=clip_bbox,
+             scale_x_y=scale_x_y)
+
+
+def matrix_nms(boxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, normalized=True):
+    """Matrix NMS (reference detection/matrix_nms_op.cc, SOLOv2): soft
+    score decay by the min over higher-ranked same-class overlaps.
+    Host-side (output count is data-dependent, like nms).  ``boxes``
+    [N, 4], ``scores`` [C, N]; returns (out [K, 6] = (class, score,
+    x1,y1,x2,y2), index [K])."""
+    b = np.asarray(_arr(boxes), np.float32)
+    s = np.asarray(_arr(scores), np.float32)
+    off = 0.0 if normalized else 1.0
+    outs, idxs = [], []
+    for c in range(s.shape[0]):
+        sc = s[c]
+        sel = np.flatnonzero(sc > score_threshold)
+        if sel.size == 0:
+            continue
+        order = sel[np.argsort(-sc[sel])][:nms_top_k]
+        bb = b[order]
+        x1, y1, x2, y2 = bb[:, 0], bb[:, 1], bb[:, 2], bb[:, 3]
+        area = np.maximum(x2 - x1 + off, 0) * np.maximum(y2 - y1 + off, 0)
+        n = len(order)
+        xx1 = np.maximum(x1[:, None], x1[None, :])
+        yy1 = np.maximum(y1[:, None], y1[None, :])
+        xx2 = np.minimum(x2[:, None], x2[None, :])
+        yy2 = np.minimum(y2[:, None], y2[None, :])
+        inter = np.maximum(xx2 - xx1 + off, 0) * \
+            np.maximum(yy2 - yy1 + off, 0)
+        iou = inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                 1e-10)
+        iou = np.triu(iou, 1)                  # iou[i, j], i higher-scored
+        # iou_cmax[i]: box i's own worst overlap with anything above it
+        iou_cmax = iou.max(axis=0)
+        if use_gaussian:
+            decay = np.exp(-(iou ** 2 - iou_cmax[:, None] ** 2)
+                           / gaussian_sigma).min(axis=0)
+        else:
+            decay = ((1 - iou) / np.maximum(1 - iou_cmax[:, None],
+                                            1e-10)).min(axis=0)
+        dscore = sc[order] * np.minimum(decay, 1.0)
+        keep = dscore > post_threshold
+        for i in np.flatnonzero(keep):
+            outs.append((float(c), float(dscore[i]), *bb[i]))
+            idxs.append(int(order[i]))
+    if not outs:
+        return (Tensor(jnp.zeros((0, 6), jnp.float32)),
+                Tensor(jnp.zeros((0,), jnp.int32)))
+    outs = np.asarray(outs, np.float32)
+    idxs = np.asarray(idxs, np.int32)
+    order = np.argsort(-outs[:, 1])[:keep_top_k]
+    return Tensor(jnp.asarray(outs[order])), Tensor(jnp.asarray(
+        idxs[order]))
+
+
+def distribute_fpn_proposals(rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None):
+    """Assign RoIs to FPN levels (reference
+    detection/distribute_fpn_proposals_op.cc):
+    level = floor(refer_level + log2(sqrt(area)/refer_scale)), clipped.
+    Host-side (ragged outputs).  Returns (per-level roi arrays, restore
+    index mapping concat(levels) rows back to input order)."""
+    r = np.asarray(_arr(rois), np.float32)
+    scale = np.sqrt(np.maximum((r[:, 2] - r[:, 0]), 0)
+                    * np.maximum((r[:, 3] - r[:, 1]), 0))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-8))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, order = [], []
+    for level in range(min_level, max_level + 1):
+        sel = np.flatnonzero(lvl == level)
+        outs.append(Tensor(jnp.asarray(r[sel])))
+        order.extend(sel.tolist())
+    restore = np.empty(len(r), np.int32)
+    restore[np.asarray(order, np.int32)] = np.arange(len(r))
+    return outs, Tensor(jnp.asarray(restore))
+
+
+def bipartite_match(dist_matrix):
+    """Greedy bipartite matching (reference
+    detection/bipartite_match_op.cc, match_type='bipartite'): iteratively
+    take the globally largest entry.  Host-side.  Returns
+    (match_indices [N] int32 with -1 unmatched rows... reference shape:
+    per-column match row [M]) — here: for [N, M] returns
+    (row_to_col [N], match_dist [N])."""
+    d = np.asarray(_arr(dist_matrix), np.float32).copy()
+    n, m = d.shape
+    row_to_col = np.full(n, -1, np.int32)
+    match_dist = np.zeros(n, np.float32)
+    for _ in range(min(n, m)):
+        i, j = np.unravel_index(np.argmax(d), d.shape)
+        if d[i, j] <= 0:
+            break
+        row_to_col[i] = j
+        match_dist[i] = d[i, j]
+        d[i, :] = -1.0
+        d[:, j] = -1.0
+    return Tensor(jnp.asarray(row_to_col)), Tensor(jnp.asarray(match_dist))
+
+
+__all__ += ["iou_similarity", "prior_box", "anchor_generator", "yolo_box",
+            "matrix_nms", "distribute_fpn_proposals", "bipartite_match"]

@@ -93,3 +93,84 @@ class TestEngine:
         assert hist["loss"][-1] < hist["loss"][0]
         out = eng.predict([x[:4]])
         assert out[0].shape == (4, 4)
+
+
+class TestCostAndTuner:
+    def _model_fn(self):
+        import paddle_infer_tpu.nn as nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(16, 64)
+                self.fc2 = nn.Linear(64, 16)
+
+            def forward(self, x):
+                import paddle_infer_tpu as pit
+
+                return self.fc2(pit.nn.functional.gelu(self.fc1(x)))
+
+        pit.seed(0)
+        return Net()
+
+    @staticmethod
+    def _loss(m, x, y):
+        out = m(x)
+        return ((out - y) * (out - y)).mean()
+
+    def test_engine_cost_reads_compiler(self):
+        from paddle_infer_tpu.distributed.auto_parallel import Engine
+
+        model = self._model_fn()
+        opt = pit.optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=model.parameters())
+        eng = Engine(model, loss_fn=self._loss, optimizer=opt)
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 16).astype(np.float32)
+        y = rng.randn(8, 16).astype(np.float32)
+        cost = eng.cost(x, y)
+        assert cost.flops > 0
+        assert cost.temp_bytes >= 0
+        assert cost.argument_bytes > 0
+
+    def test_tuner_picks_a_valid_factorization(self):
+        from paddle_infer_tpu.distributed.cost_model import (
+            candidate_factorizations, tune_parallelism)
+
+        cands = candidate_factorizations(8, ("dp", "mp"))
+        assert {"dp": 8, "mp": 1} in cands and {"dp": 2, "mp": 4} in cands
+        assert all(c["dp"] * c["mp"] == 8 for c in cands)
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(8, 16).astype(np.float32)
+        y = rng.randn(8, 16).astype(np.float32)
+
+        def opt_fn(params):
+            return pit.optimizer.AdamW(learning_rate=1e-3,
+                                       parameters=list(params))
+
+        report = tune_parallelism(
+            self._model_fn, self._loss, opt_fn, (x, y),
+            candidates=[{"dp": 8, "mp": 1}, {"dp": 2, "mp": 4}],
+            measure_steps=2)
+        assert report.best in ({"dp": 8, "mp": 1}, {"dp": 2, "mp": 4})
+        ok = [t for t in report.trials if t.cost is not None]
+        assert len(ok) == 2
+        assert all(t.cost.wall_ms > 0 for t in ok)
+
+    def test_engine_tune_rebuilds_under_winner(self):
+        from paddle_infer_tpu.distributed.auto_parallel import Engine
+
+        model = self._model_fn()
+        opt = pit.optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=model.parameters())
+        eng = Engine(model, loss_fn=self._loss, optimizer=opt)
+        rng = np.random.RandomState(2)
+        x = rng.randn(8, 16).astype(np.float32)
+        y = rng.randn(8, 16).astype(np.float32)
+        report = eng.tune((x, y), self._model_fn,
+                          measure_steps=1)
+        assert report.best
+        # a fit after tuning runs under the chosen degrees
+        hist = eng.fit([(x, y)] * 2, epochs=1)
+        assert np.isfinite(hist["loss"][0])

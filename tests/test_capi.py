@@ -73,3 +73,128 @@ def test_c_client_reports_errors(tmp_path):
         timeout=300)
     assert r.returncode == 1
     assert "no model" in r.stderr or "PD_PredictorCreate" in r.stderr
+
+
+DEMO_EX_SRC = os.path.join(ROOT, "tools", "capi_demo_ex.c")
+
+
+class _TwoOut(pit.nn.Layer):
+    """int32 ids in; (float32 embedding-sum, int64 argmax) out — the
+    multi-dtype multi-output shape the widened ABI must carry."""
+
+    def __init__(self):
+        super().__init__()
+        self.embed = pit.nn.Embedding(32, 8)
+        self.fc = pit.nn.Linear(8, 4)
+
+    def forward(self, ids):
+        h = self.fc(self.embed(ids).mean(axis=1))
+        return h, h.argmax(axis=-1)
+
+
+def _save_two_out(tmp_path):
+    from paddle_infer_tpu.static import InputSpec
+
+    pit.seed(3)
+    model = _TwoOut()
+    model.eval()
+    prefix = str(tmp_path / "twoout")
+    pit.jit.save(model, prefix,
+                 input_spec=[InputSpec([2, 5], dtype="int32")])
+    return model, prefix
+
+
+def test_run_ex_bridge_int32_two_outputs(tmp_path):
+    """The Python half of PD_PredictorRunEx: int32 input, two outputs of
+    different dtypes, byte-exact round trip."""
+    from paddle_infer_tpu.inference import capi_bridge
+
+    model, prefix = _save_two_out(tmp_path)
+    ids = np.random.RandomState(0).randint(0, 32, (2, 5)).astype(np.int32)
+    pred = capi_bridge.create_predictor(prefix)
+    outs = capi_bridge.run_ex(
+        pred, [(ids.tobytes(), capi_bridge._DTYPE_CODES["int32"],
+                ids.shape)])
+    assert len(outs) == 2
+    buf0, code0, shape0 = outs[0]
+    got0 = np.frombuffer(buf0, capi_bridge._np_dtype(code0)).reshape(shape0)
+    want0, want1 = model(pit.to_tensor(ids))
+    np.testing.assert_allclose(got0, want0.numpy(), atol=1e-5)
+    buf1, code1, shape1 = outs[1]
+    got1 = np.frombuffer(buf1, capi_bridge._np_dtype(code1)).reshape(shape1)
+    np.testing.assert_array_equal(got1.astype(np.int64),
+                                  want1.numpy().astype(np.int64))
+
+
+def test_c_client_run_ex_int32_two_outputs(tmp_path):
+    """Full C-level PD_PredictorRunEx (round-3 verdict #8's done bar:
+    an int32 input and two outputs through the C ABI)."""
+    exe = str(tmp_path / "capi_demo_ex")
+    _build(tmp_path)              # ensures LIB exists (or skips)
+    r = subprocess.run(["gcc", "-O2", "-o", exe, DEMO_EX_SRC, "-ldl"],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"cc unavailable: {r.stderr[-200:]}")
+
+    model, prefix = _save_two_out(tmp_path)
+    ids = np.random.RandomState(1).randint(0, 32, (2, 5)).astype(np.int32)
+    want0, want1 = model(pit.to_tensor(ids))
+
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": ROOT + os.pathsep + env.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+    })
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [exe, LIB, prefix, "7", "2", "5"],
+        input="\n".join(str(v) for v in ids.ravel()),
+        capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "model inputs: 1" in r.stderr
+    lines = r.stdout.strip().splitlines()
+    assert lines[0].startswith("output 0 dtype 0 shape 2,4")
+    vals0 = np.array([float(v) for v in lines[1:9]],
+                     np.float32).reshape(2, 4)
+    np.testing.assert_allclose(vals0, want0.numpy(), atol=1e-4)
+    hdr1 = lines[9]
+    assert hdr1.startswith("output 1 dtype")
+    vals1 = np.array([int(v) for v in lines[10:12]])
+    np.testing.assert_array_equal(vals1, want1.numpy().astype(np.int64))
+
+
+def test_from_layer_weight_only_quant(tmp_path):
+    """enable_weight_only_quant now routes through Predictor.from_layer
+    (the predictor.py:79 refusal removed, round-3 verdict #8): outputs
+    track the float model within int8 quant error and the CALLER's layer
+    stays full precision."""
+    from paddle_infer_tpu.inference import Config
+    from paddle_infer_tpu.inference.predictor import Predictor
+    from paddle_infer_tpu.nn.layers_common import Linear
+
+    pit.seed(4)
+
+    class M(pit.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = Linear(16, 32)
+            self.fc2 = Linear(32, 4)
+
+        def forward(self, x):
+            return self.fc2(pit.nn.functional.relu(self.fc1(x)))
+
+    m = M()
+    m.eval()
+    x = np.random.RandomState(2).rand(3, 16).astype(np.float32)
+    want = m(pit.to_tensor(x)).numpy()
+    cfg = Config()
+    cfg.enable_weight_only_quant("int8")
+    pred = Predictor.from_layer(m, [pit.to_tensor(x)], config=cfg)
+    assert "weight_only_quant_pass" in pred._applied_passes
+    got = pred.run([x])[0]
+    np.testing.assert_allclose(got, want, atol=0.05, rtol=0.05)
+    # caller's layer untouched (quant ran on a copy)
+    assert type(m.fc1) is Linear
+    # the traced program really contains the quantized op
+    assert any(op.name == "weight_only_linear"
+               for op in pred._program.ops)

@@ -426,3 +426,81 @@ class TestSparseRound3:
         # qr mode='r' returns the R matrix, not a tuple
         r = pit.linalg.qr(np.eye(3, dtype=np.float32), mode="r")
         assert r.numpy().shape == (3, 3)
+
+
+class TestSparseBreadthRound4:
+    """Round-4 sparse op batch (reference phi/api/yaml/sparse_ops.yaml:
+    the zero-preserving unary family + cast/scale/divide/full_like/
+    reshape/slice)."""
+
+    def _coo(self):
+        from paddle_infer_tpu import sparse
+
+        idx = np.array([[0, 1, 2], [1, 0, 2]], np.int64)
+        vals = np.array([0.5, -2.0, 0.25], np.float32)
+        return sparse.sparse_coo_tensor(idx, vals, (3, 3)), vals
+
+    def test_unary_family_preserves_pattern(self):
+        from paddle_infer_tpu import sparse
+
+        x, vals = self._coo()
+        for name, ref in [("abs", np.abs), ("asin", np.arcsin),
+                          ("atan", np.arctan), ("sinh", np.sinh),
+                          ("tan", np.tan), ("expm1", np.expm1),
+                          ("square", np.square),
+                          ("relu6", lambda v: np.clip(v, 0, 6))]:
+            out = getattr(sparse, name)(x)
+            assert out.nnz == 3
+            np.testing.assert_allclose(np.asarray(out.values()._data),
+                                       ref(vals), rtol=1e-5,
+                                       err_msg=name)
+
+    def test_leaky_relu_and_scale(self):
+        from paddle_infer_tpu import sparse
+
+        x, vals = self._coo()
+        lr = sparse.leaky_relu(x, 0.1)
+        np.testing.assert_allclose(
+            np.asarray(lr.values()._data),
+            np.where(vals >= 0, vals, vals * 0.1), rtol=1e-6)
+        sc = sparse.scale(x, scale=2.0, bias=1.0)
+        np.testing.assert_allclose(np.asarray(sc.values()._data),
+                                   vals * 2 + 1, rtol=1e-6)
+
+    def test_cast(self):
+        from paddle_infer_tpu import sparse
+
+        x, _ = self._coo()
+        out = sparse.cast(x, value_dtype="float64")
+        # x64 disabled -> float64 request becomes f32; pattern kept
+        assert out.nnz == 3
+
+    def test_divide_and_scalar(self):
+        from paddle_infer_tpu import sparse
+
+        x, vals = self._coo()
+        d = sparse.divide(x, x)
+        np.testing.assert_allclose(
+            np.asarray(d.to_dense()._data)[[0, 1, 2], [1, 0, 2]],
+            np.ones(3), rtol=1e-6)
+        ds = sparse.divide_scalar(x, 2.0)
+        np.testing.assert_allclose(np.asarray(ds.values()._data),
+                                   vals / 2, rtol=1e-6)
+
+    def test_full_like_reshape_slice(self):
+        from paddle_infer_tpu import sparse
+
+        x, _ = self._coo()
+        f = sparse.full_like(x, 7.0)
+        np.testing.assert_allclose(np.asarray(f.values()._data),
+                                   [7.0] * 3)
+        r = sparse.reshape(x, (9,))
+        assert tuple(r.shape) == (9,)
+        np.testing.assert_allclose(
+            np.asarray(r.to_dense()._data).reshape(3, 3),
+            np.asarray(x.to_dense()._data))
+        s = sparse.slice(x, axes=[0], starts=[0], ends=[2])
+        assert tuple(s.shape) == (2, 3)
+        np.testing.assert_allclose(
+            np.asarray(s.to_dense()._data),
+            np.asarray(x.to_dense()._data)[:2])

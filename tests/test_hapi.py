@@ -187,3 +187,46 @@ class TestTransformsRound3:
         out = ColorJitter(brightness=0.1)(img)
         assert out.dtype == np.float32
         assert out.max() > 2.0               # not crushed to [0,1]
+
+
+class TestSummary:
+    def test_layer_table_with_shapes(self, capsys):
+        import paddle_infer_tpu.nn as nn
+        from paddle_infer_tpu.hapi import Model
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(16, 32)
+                self.fc2 = nn.Linear(32, 4)
+
+            def forward(self, x):
+                return self.fc2(pit.nn.functional.relu(self.fc1(x)))
+
+        m = Model(Net())
+        info = m.summary(input_size=(2, 16))
+        out = capsys.readouterr().out
+        assert "Total params:" in out
+        assert "Linear" in out
+        # per-layer rows captured with real output shapes
+        shapes = {name: shape for name, _, shape, _ in info["layers"]}
+        assert shapes["fc1"] == (2, 32)
+        assert shapes["fc2"] == (2, 4)
+        assert info["total_params"] == 16 * 32 + 32 + 32 * 4 + 4
+        assert info["trainable_params"] == info["total_params"]
+
+    def test_summary_without_input_size(self):
+        import paddle_infer_tpu.nn as nn
+        from paddle_infer_tpu.hapi import summary
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        info = summary(Net())
+        assert info["total_params"] == 8 * 2 + 2
+        assert info["layers"][0][2] is None     # no dry run -> no shapes

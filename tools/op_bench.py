@@ -1,0 +1,173 @@
+"""Per-op benchmark + regression gate.
+
+Reference: tools/ci_op_benchmark.sh + tools/check_op_benchmark_result.py
+— the fork's CI compares each op's kernel time against a stored baseline
+and fails the build on regression.
+
+Here: a curated op set (the ops that carry the framework's hot paths) is
+timed through the SAME dispatch layer users hit (jit-compiled, forward
+and backward), results keyed by (platform, op, config).  ``--update``
+writes tools/op_bench_baseline.json; ``--check`` compares against it and
+exits non-zero when an op slows past the tolerance (default 1.5x — CI
+machines are noisy; the TPU driver can tighten with --tolerance).
+
+Usage:
+  python tools/op_bench.py --check [--tolerance 1.5]
+  python tools/op_bench.py --update
+  python tools/op_bench.py            # print only
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BASELINE_PATH = os.path.join(HERE, "op_bench_baseline.json")
+
+
+def _cases(on_tpu: bool):
+    """(key, builder) pairs; builder returns (fn, args) to time."""
+    import jax
+    import jax.numpy as jnp
+
+    big = on_tpu
+    rs = np.random.RandomState(0)
+
+    def t(shape, dtype=np.float32):
+        return jnp.asarray(rs.rand(*shape).astype(dtype))
+
+    n = 1024 if big else 128
+    b, s, h, d = (8, 512, 8, 64) if big else (2, 128, 4, 32)
+    cases = []
+
+    from paddle_infer_tpu.core import dispatch as disp
+
+    def op_fwd(name, *args, **attrs):
+        fn = jax.jit(lambda *a: disp.raw(name, *a, **attrs))
+        return fn, args
+
+    def op_fwdbwd(name, *args, **attrs):
+        def run(*a):
+            out = disp.raw(name, *a, **attrs)
+            return jnp.sum(out)
+
+        grad = jax.jit(jax.grad(run))
+        return grad, args
+
+    x2 = t((n, n))
+    w2 = t((n, n))
+    cases.append((f"matmul_{n}x{n}_fwd", op_fwd("matmul", x2, w2)))
+    cases.append((f"matmul_{n}x{n}_bwd", op_fwdbwd("matmul", x2, w2)))
+    cases.append((f"addmm_{n}_fwd",
+                  op_fwd("addmm", t((n,)), x2, w2)))
+    cases.append((f"softmax_{n}_fwd", op_fwd("softmax", x2, axis=-1)))
+    cases.append((f"layer_norm_{n}_fwd",
+                  op_fwd("layer_norm", x2, t((n,)), t((n,)),
+                         epsilon=1e-5)))
+    cases.append((f"rms_norm_{n}_fwd",
+                  op_fwd("rms_norm", x2, t((n,)))))
+    qkv = (t((b, s, h, d)), t((b, s, h, d)), t((b, s, h, d)))
+    cases.append((f"sdpa_b{b}s{s}_fwd",
+                  op_fwd("sdpa", *qkv, is_causal=True)))
+    cases.append((f"sdpa_b{b}s{s}_bwd",
+                  op_fwdbwd("sdpa", *qkv, is_causal=True)))
+    cb = (8, 64, 56) if big else (2, 8, 16)
+    cases.append((f"conv2d_c{cb[1]}_fwd",
+                  op_fwd("conv2d", t((cb[0], cb[1], cb[2], cb[2])),
+                         t((cb[1], cb[1], 3, 3)), None, stride=1,
+                         padding=1)))
+    cases.append((f"reduce_sum_{n}_fwd", op_fwd("sum", x2, axis=None)))
+    ids = jnp.asarray(rs.randint(0, n, (b, s)).astype(np.int32))
+    cases.append((f"embedding_b{b}s{s}_fwd",
+                  op_fwd("embedding", ids, t((n, d)))))
+    cases.append((f"rope_b{b}s{s}_fwd",
+                  op_fwd("rope", qkv[0],
+                         jnp.arange(s, dtype=jnp.int32))))
+    return cases
+
+
+def run_bench(reps: int = 20, warmup: int = 3):
+    import jax
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    results = {}
+    for key, (fn, args) in _cases(on_tpu):
+        try:
+            for _ in range(warmup):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            ms = (time.perf_counter() - t0) / reps * 1e3
+            results[f"{platform}/{key}"] = round(ms, 4)
+        except Exception as e:
+            print(f"{key}: SKIP {e!r}", file=sys.stderr)
+    return results
+
+
+def compare(results: dict, baseline: dict, tolerance: float):
+    """-> (regressions, improvements, missing) in the reference
+    check_op_benchmark_result.py sense."""
+    regressions, improvements, missing = [], [], []
+    for key, ms in results.items():
+        base = baseline.get(key)
+        if base is None:
+            missing.append(key)
+            continue
+        if ms > base * tolerance:
+            regressions.append((key, base, ms))
+        elif ms < base / tolerance:
+            improvements.append((key, base, ms))
+    return regressions, improvements, missing
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true")
+    ap.add_argument("--update", action="store_true")
+    ap.add_argument("--tolerance", type=float, default=1.5)
+    ap.add_argument("--reps", type=int, default=20)
+    args = ap.parse_args(argv)
+
+    results = run_bench(reps=args.reps)
+    for k, v in sorted(results.items()):
+        print(f"{k}: {v} ms")
+    if args.update:
+        baseline = {}
+        if os.path.exists(BASELINE_PATH):
+            with open(BASELINE_PATH) as f:
+                baseline = json.load(f)
+        baseline.update(results)
+        with open(BASELINE_PATH, "w") as f:
+            json.dump(baseline, f, indent=1, sort_keys=True)
+        print(f"baseline updated: {BASELINE_PATH}")
+        return 0
+    if args.check:
+        if not os.path.exists(BASELINE_PATH):
+            print("no baseline recorded — run --update first",
+                  file=sys.stderr)
+            return 0
+        with open(BASELINE_PATH) as f:
+            baseline = json.load(f)
+        reg, imp, missing = compare(results, baseline, args.tolerance)
+        for key, base, ms in imp:
+            print(f"IMPROVED {key}: {base} -> {ms} ms")
+        for key in missing:
+            print(f"NEW (no baseline) {key}")
+        for key, base, ms in reg:
+            print(f"REGRESSION {key}: {base} -> {ms} ms "
+                  f"(> {args.tolerance}x)", file=sys.stderr)
+        return 1 if reg else 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
