@@ -26,6 +26,7 @@ from ..nn.layers_common import Dropout, LayerList, LayerNorm, Linear
 from ..nn.layers_common import Embedding
 from ..parallel.mp_layers import (ParallelCrossEntropy,
                                   VocabParallelEmbedding)
+from .pretrained import PretrainedMixin
 from .transformer_block import ParallelTransformerLayer
 
 ERNIE_PRESETS = {
@@ -48,6 +49,16 @@ ERNIE_PRESETS = {
     "bert-large": dict(hidden_size=1024, num_hidden_layers=24,
                        num_attention_heads=16, intermediate_size=4096,
                        vocab_size=30522),
+    # BASELINE.md milestone #4 ladder (ERNIE-3.5 10B on v5p via TP+ZeRO;
+    # the 1.3b rung is the largest size the CPU host can build for the
+    # measured-scaling study, tools/scale_study.py -> docs/SCALE.md)
+    "ernie-1.3b": dict(hidden_size=2048, num_hidden_layers=24,
+                       num_attention_heads=32, intermediate_size=8192,
+                       vocab_size=50176, max_position_embeddings=2048),
+    "ernie-3.5-10b": dict(hidden_size=4096, num_hidden_layers=48,
+                          num_attention_heads=32,
+                          intermediate_size=16384, vocab_size=50176,
+                          max_position_embeddings=2048),
 }
 
 
@@ -186,9 +197,12 @@ class ErnieMLMHead(Layer):
         return D("sharding_constraint", logits, spec=spec)
 
 
-class ErnieForMaskedLM(Layer):
+class ErnieForMaskedLM(PretrainedMixin, Layer):
+    config_class = ErnieConfig
+
     def __init__(self, config: ErnieConfig):
         super().__init__()
+        self.config = config
         self.ernie = ErnieModel(config)
         self.cls = ErnieMLMHead(config,
                                 self.ernie.embeddings.word_embeddings.weight)
@@ -200,11 +214,14 @@ class ErnieForMaskedLM(Layer):
         return self.cls(seq)
 
 
-class ErnieForPretraining(Layer):
+class ErnieForPretraining(PretrainedMixin, Layer):
     """MLM + next-sentence/sop heads (BERT-style pretraining objective)."""
+
+    config_class = ErnieConfig
 
     def __init__(self, config: ErnieConfig):
         super().__init__()
+        self.config = config
         self.ernie = ErnieModel(config)
         self.cls = ErnieMLMHead(config,
                                 self.ernie.embeddings.word_embeddings.weight)
@@ -217,12 +234,20 @@ class ErnieForPretraining(Layer):
         return self.cls(seq), self.nsp(pooled)
 
 
-class ErnieForSequenceClassification(Layer):
-    def __init__(self, config: ErnieConfig, num_classes=2):
+class ErnieForSequenceClassification(PretrainedMixin, Layer):
+    config_class = ErnieConfig
+
+    def __init__(self, config: ErnieConfig, num_classes=None):
         super().__init__()
+        # num_classes rides on the config so from_pretrained round-trips
+        # the head shape (the mixin rebuilds as cls(config))
+        if num_classes is not None:
+            config.num_classes = num_classes
+        n_cls = getattr(config, "num_classes", 2)
+        self.config = config
         self.ernie = ErnieModel(config)
         self.dropout = Dropout(config.hidden_dropout_prob)
-        self.classifier = Linear(config.hidden_size, num_classes)
+        self.classifier = Linear(config.hidden_size, n_cls)
 
     def forward(self, input_ids, token_type_ids=None, position_ids=None,
                 attention_mask=None):

@@ -16,6 +16,7 @@ from ..nn import functional as F
 from ..nn.layer import Layer
 from ..nn.layers_common import Dropout, Embedding, LayerList, LayerNorm
 from ..parallel.mp_layers import VocabParallelEmbedding
+from .pretrained import PretrainedMixin
 from .transformer_block import ParallelTransformerLayer
 
 GPT_PRESETS = {
@@ -168,8 +169,10 @@ class GPTModel(Layer):
         return x
 
 
-class GPTForCausalLM(Layer):
+class GPTForCausalLM(PretrainedMixin, Layer):
     """LM head tied to the word embedding (vocab-sharded logits)."""
+
+    config_class = GPTConfig
 
     def __init__(self, config: GPTConfig):
         super().__init__()

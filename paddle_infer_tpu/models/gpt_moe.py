@@ -31,5 +31,7 @@ class GPTMoEModel(GPTModel):
 
 
 class GPTMoEForCausalLM(GPTForCausalLM):
+    config_class = MoEConfig
+
     def __init__(self, config: MoEConfig):
         super().__init__(config)

@@ -22,6 +22,7 @@ from ..nn.layer import Layer
 from ..nn.layers_common import LayerList, RMSNorm
 from ..parallel.mp_layers import (ColumnParallelLinear, RowParallelLinear,
                                   VocabParallelEmbedding)
+from .pretrained import PretrainedMixin
 from .transformer_block import ParallelSelfAttention
 
 LLAMA_PRESETS = {
@@ -154,9 +155,11 @@ class LlamaModel(Layer):
         return x
 
 
-class LlamaForCausalLM(Layer):
+class LlamaForCausalLM(PretrainedMixin, Layer):
     """Untied LM head (LLaMA keeps lm_head separate from the embedding),
     column-sharded over the vocab so mp serving splits the logits."""
+
+    config_class = LlamaConfig
 
     def __init__(self, config: LlamaConfig):
         super().__init__()

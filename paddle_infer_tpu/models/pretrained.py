@@ -1,0 +1,70 @@
+"""save_pretrained / from_pretrained for the model zoo.
+
+Reference: PaddleNLP's PretrainedModel surface (the fork's model families
+are consumed through ``AutoModel.from_pretrained`` — config.json + a
+weights payload per directory).
+
+TPU-first: weights go through the native mmap TensorStore
+(native/tensor_store.cc — zero-copy reads at serving start, the
+``.pdiparams`` analog), falling back to pickle when the native library
+is unavailable; the config is plain JSON of the Config object.
+"""
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+_WEIGHTS_PITS = "model.pits"
+_WEIGHTS_PKL = "model.pdparams"
+_CONFIG = "config.json"
+
+
+class PretrainedMixin:
+    """Mixed into the *ForCausalLM / *For* heads; subclasses define
+    ``config_class``."""
+
+    def save_pretrained(self, save_dir: str) -> None:
+        from .. import save as pit_save
+        from .. import native
+
+        os.makedirs(save_dir, exist_ok=True)
+        cfg = {k: v for k, v in vars(self.config).items()
+               if isinstance(v, (int, float, str, bool, list, tuple,
+                                 type(None)))}
+        cfg["architecture"] = type(self).__name__
+        with open(os.path.join(save_dir, _CONFIG), "w") as f:
+            json.dump(cfg, f, indent=1, sort_keys=True)
+        tensors = {n: np.asarray(p._data)
+                   for n, p in self.named_parameters()}
+        if native.available():
+            native.save_tensors(os.path.join(save_dir, _WEIGHTS_PITS),
+                                tensors)
+        else:
+            pit_save(tensors, os.path.join(save_dir, _WEIGHTS_PKL))
+
+    @classmethod
+    def from_pretrained(cls, save_dir: str):
+        from .. import load as pit_load
+        from .. import native
+        from ..core.tensor import Tensor
+
+        with open(os.path.join(save_dir, _CONFIG)) as f:
+            cfg = json.load(f)
+        arch = cfg.pop("architecture", cls.__name__)
+        if arch != cls.__name__:
+            raise ValueError(
+                f"{save_dir} holds a {arch}, not a {cls.__name__} — "
+                f"load it with {arch}.from_pretrained")
+        config = cls.config_class(**cfg)
+        model = cls(config)
+        pits = os.path.join(save_dir, _WEIGHTS_PITS)
+        if os.path.exists(pits):
+            tensors = native.load_tensors(pits)
+        else:
+            tensors = pit_load(os.path.join(save_dir, _WEIGHTS_PKL))
+        model.set_state_dict({n: Tensor(np.asarray(v))
+                              for n, v in tensors.items()})
+        model.eval()
+        return model

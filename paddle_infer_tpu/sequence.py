@@ -240,6 +240,14 @@ def sequence_slice(x, seq_lens, offset, length):
     x, seq_lens = _arr(x), _arr(seq_lens).astype(jnp.int32)
     offset = _arr(offset).astype(jnp.int32).reshape(-1)
     length = _arr(length).astype(jnp.int32).reshape(-1)
+    over = np.flatnonzero(np.asarray(offset) + np.asarray(length)
+                          > np.asarray(seq_lens))
+    if over.size:
+        raise ValueError(
+            f"sequence_slice: offset+length exceeds seq_len for "
+            f"sequences {over.tolist()} (the reference enforces "
+            "offset+length <= seq_len; clamped gathers would leak the "
+            "next sequence's rows)")
     total_out = int(jnp.sum(length))
     pos, seg = _positions(length, total_out)
     starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),

@@ -379,11 +379,11 @@ def _prior_box(input, image, min_sizes=(), max_sizes=(),
             if flip:
                 ratios.append(1.0 / float(r))
     whs = []     # (w, h) per prior, reference order
-    for ms in min_sizes:
-        if min_max_aspect_ratios_order:
+    for mi, ms in enumerate(min_sizes):   # positional max pairing so
+        if min_max_aspect_ratios_order:   # duplicate min_sizes work
             whs.append((ms, ms))
             if max_sizes:
-                mx = max_sizes[list(min_sizes).index(ms)]
+                mx = max_sizes[mi]
                 whs.append((float(np.sqrt(ms * mx)),
                             float(np.sqrt(ms * mx))))
             for r in ratios:
@@ -396,7 +396,7 @@ def _prior_box(input, image, min_sizes=(), max_sizes=(),
                 whs.append((ms * float(np.sqrt(r)),
                             ms / float(np.sqrt(r))))
             if max_sizes:
-                mx = max_sizes[list(min_sizes).index(ms)]
+                mx = max_sizes[mi]
                 whs.append((float(np.sqrt(ms * mx)),
                             float(np.sqrt(ms * mx))))
     P = len(whs)
@@ -578,19 +578,33 @@ def distribute_fpn_proposals(rois, min_level, max_level, refer_level,
     detection/distribute_fpn_proposals_op.cc):
     level = floor(refer_level + log2(sqrt(area)/refer_scale)), clipped.
     Host-side (ragged outputs).  Returns (per-level roi arrays, restore
-    index mapping concat(levels) rows back to input order)."""
+    index mapping concat(levels) rows back to input order); with
+    ``rois_num`` [B] (rois per image) additionally returns the per-level
+    per-image counts, like the reference's rois_num outputs."""
     r = np.asarray(_arr(rois), np.float32)
     scale = np.sqrt(np.maximum((r[:, 2] - r[:, 0]), 0)
                     * np.maximum((r[:, 3] - r[:, 1]), 0))
     lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-8))
     lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
-    outs, order = [], []
+    img = None
+    if rois_num is not None:
+        counts = np.asarray(_arr(rois_num), np.int64)
+        img = np.repeat(np.arange(len(counts)), counts)
+        if len(img) != len(r):
+            raise ValueError(
+                f"rois_num sums to {len(img)} but rois has {len(r)} rows")
+    outs, order, level_counts = [], [], []
     for level in range(min_level, max_level + 1):
         sel = np.flatnonzero(lvl == level)
         outs.append(Tensor(jnp.asarray(r[sel])))
         order.extend(sel.tolist())
+        if img is not None:
+            level_counts.append(Tensor(jnp.asarray(np.bincount(
+                img[sel], minlength=len(counts)).astype(np.int32))))
     restore = np.empty(len(r), np.int32)
     restore[np.asarray(order, np.int32)] = np.arange(len(r))
+    if img is not None:
+        return outs, Tensor(jnp.asarray(restore)), level_counts
     return outs, Tensor(jnp.asarray(restore))
 
 

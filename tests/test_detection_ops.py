@@ -152,3 +152,19 @@ class TestBipartiteMatch:
         d = np.array([[0.9], [0.8]], np.float32)
         row_to_col, _ = V.bipartite_match(Tensor(d))
         assert row_to_col.numpy().tolist() == [0, -1]
+
+
+class TestFPNRoisNum:
+    def test_per_level_per_image_counts(self):
+        rois = np.array([
+            [0, 0, 16, 16], [0, 0, 448, 448],      # image 0
+            [0, 0, 16, 16],                        # image 1
+        ], np.float32)
+        outs, restore, counts = V.distribute_fpn_proposals(
+            Tensor(rois), min_level=2, max_level=5, refer_level=4,
+            refer_scale=224, rois_num=np.array([2, 1], np.int64))
+        # level 2 (smallest) holds both 16x16 rois: one per image
+        np.testing.assert_array_equal(counts[0].numpy(), [1, 1])
+        # top level holds image 0's 448 box
+        np.testing.assert_array_equal(counts[-1].numpy(), [1, 0])
+        assert sum(int(c.numpy().sum()) for c in counts) == 3

@@ -1,0 +1,82 @@
+"""save_pretrained / from_pretrained (PaddleNLP PretrainedModel surface;
+weights through the native mmap TensorStore)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.core.tensor import Tensor
+from paddle_infer_tpu.models import (GPTConfig, GPTForCausalLM,
+                                     LlamaConfig, LlamaForCausalLM)
+
+
+def _tiny_gpt():
+    pit.seed(0)
+    return GPTForCausalLM(GPTConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0))
+
+
+def test_roundtrip_identical_outputs(tmp_path):
+    m = _tiny_gpt()
+    m.eval()
+    d = str(tmp_path / "gpt")
+    m.save_pretrained(d)
+    assert os.path.exists(os.path.join(d, "config.json"))
+    m2 = GPTForCausalLM.from_pretrained(d)
+    ids = np.random.RandomState(0).randint(0, 96, (2, 8)).astype(np.int32)
+    np.testing.assert_allclose(m(Tensor(ids)).numpy(),
+                               m2(Tensor(ids)).numpy(), atol=1e-6)
+
+
+def test_config_preserved_and_arch_checked(tmp_path):
+    pit.seed(1)
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=64, max_position_embeddings=64))
+    d = str(tmp_path / "llama")
+    m.save_pretrained(d)
+    m2 = LlamaForCausalLM.from_pretrained(d)
+    assert m2.config.num_key_value_heads == 2
+    assert m2.config.rope_theta == m.config.rope_theta
+    with pytest.raises(ValueError, match="holds a LlamaForCausalLM"):
+        GPTForCausalLM.from_pretrained(d)
+
+
+def test_loaded_model_generates(tmp_path):
+    m = _tiny_gpt()
+    m.eval()
+    ids = np.random.RandomState(1).randint(0, 96,
+                                           (1, 6)).astype(np.int32)
+    want = m.generate(ids, max_new_tokens=4)
+    d = str(tmp_path / "gpt2")
+    m.save_pretrained(d)
+    m2 = GPTForCausalLM.from_pretrained(d)
+    got = m2.generate(ids, max_new_tokens=4)
+    np.testing.assert_array_equal(want, got)
+
+
+def test_ernie_heads_roundtrip(tmp_path):
+    from paddle_infer_tpu.models import (ErnieConfig,
+                                         ErnieForSequenceClassification)
+
+    pit.seed(2)
+    cfg = ErnieConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=64,
+                      max_position_embeddings=32, type_vocab_size=2,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0)
+    m = ErnieForSequenceClassification(cfg, num_classes=5)
+    m.eval()
+    d = str(tmp_path / "ernie")
+    m.save_pretrained(d)
+    m2 = ErnieForSequenceClassification.from_pretrained(d)
+    assert m2.classifier.weight.shape[-1] == 5
+    ids = np.random.RandomState(0).randint(0, 128,
+                                           (2, 8)).astype(np.int32)
+    np.testing.assert_allclose(m(Tensor(ids)).numpy(),
+                               m2(Tensor(ids)).numpy(), atol=1e-6)

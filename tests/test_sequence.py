@@ -144,3 +144,10 @@ class TestConcatSliceEnumerateReshape:
                                            new_dim=4)
         assert out.shape == [4, 4]
         np.testing.assert_array_equal(new_lens.numpy(), [1, 1, 2])
+
+
+def test_slice_validates_bounds():
+    with pytest.raises(ValueError, match="offset\\+length exceeds"):
+        S.sequence_slice(Tensor(PACKED), Tensor(LENS),
+                         offset=np.array([2, 0, 0], np.int32),
+                         length=np.array([2, 1, 1], np.int32))
