@@ -15,28 +15,37 @@ import sys
 import multiprocessing as mp
 
 
-def _worker(fn, args, env, idx):
+def _worker(fn, args, env, rank):
     os.environ.update(env)
-    os.environ["PTI_PROCESS_ID"] = str(idx)
+    os.environ["PTI_PROCESS_ID"] = str(rank)
     fn(*args)
 
 
 def spawn(func, args=(), nprocs: int = 1, join: bool = True,
-          coordinator_port: int = 12355, **options):
+          coordinator_port: int = 12355, coordinator_addr=None,
+          world_size=None, base_rank: int = 0, **options):
     """Run ``func`` in ``nprocs`` processes (reference: distributed/spawn.py).
     Sets the coordination-service env so each process can
-    ``init_parallel_env()``."""
-    if nprocs == 1:
+    ``init_parallel_env()``.
+
+    Multi-host jobs (the launch CLI's --master/--nnodes/--rank) pass
+    ``coordinator_addr`` (the shared rendezvous), ``world_size``
+    (nnodes * nproc_per_node) and ``base_rank`` (this node's first
+    global rank) so every node joins ONE job instead of forming
+    per-node local rendezvous."""
+    if nprocs == 1 and coordinator_addr is None:
         func(*args)
         return None
     ctx = mp.get_context("spawn")
     env = {
-        "PTI_COORDINATOR_ADDR": f"127.0.0.1:{coordinator_port}",
-        "PTI_NUM_PROCESSES": str(nprocs),
+        "PTI_COORDINATOR_ADDR": coordinator_addr
+        or f"127.0.0.1:{coordinator_port}",
+        "PTI_NUM_PROCESSES": str(world_size or nprocs),
     }
     procs = []
     for i in range(nprocs):
-        p = ctx.Process(target=_worker, args=(func, args, env, i))
+        p = ctx.Process(target=_worker,
+                        args=(func, args, env, base_rank + i))
         p.start()
         procs.append(p)
     if join:
@@ -49,15 +58,60 @@ def spawn(func, args=(), nprocs: int = 1, join: bool = True,
 
 
 def main(argv=None):
-    argv = list(sys.argv[1:] if argv is None else argv)
-    if not argv:
-        print("usage: python -m paddle_infer_tpu.distributed.launch "
-              "script.py [args...]")
-        return 1
-    script, *rest = argv
-    sys.argv = [script] + rest
-    runpy.run_path(script, run_name="__main__")
+    """CLI (reference launch/context/args_envs.py arg surface):
+
+      python -m paddle_infer_tpu.distributed.launch \\
+          [--nproc_per_node N] [--master HOST:PORT] [--nnodes N] \\
+          [--rank R] [--job_id ID] script.py [args...]
+
+    On a TPU host one process drives all local chips, so
+    ``--nproc_per_node`` defaults to 1; >1 spawns local workers wired
+    through the coordination-service env (the reference's per-GPU rank
+    fabrication has no TPU analog).  ``--master/--nnodes/--rank`` export
+    the multi-host rendezvous env consumed by
+    distributed/env.init_parallel_env (the TCPStore analog)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_infer_tpu.distributed.launch")
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--master", type=str, default=None,
+                        help="coordinator HOST:PORT (multi-host)")
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--rank", type=int, default=0,
+                        help="this node's rank")
+    parser.add_argument("--job_id", type=str, default="default")
+    parser.add_argument("--devices", type=str, default=None,
+                        help="accepted for reference-CLI compatibility; "
+                        "TPU chips are auto-discovered")
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(list(sys.argv[1:] if argv is None else argv))
+
+    os.environ["PTI_JOB_ID"] = args.job_id
+    if args.nproc_per_node > 1:
+        # global job: world = nnodes * nproc_per_node, this node's
+        # workers take ranks [rank*nproc, (rank+1)*nproc)
+        spawn(_run_script,
+              (args.training_script, list(args.training_script_args)),
+              nprocs=args.nproc_per_node,
+              coordinator_addr=args.master,
+              world_size=args.nnodes * args.nproc_per_node,
+              base_rank=args.rank * args.nproc_per_node)
+    else:
+        if args.master:
+            os.environ["PTI_COORDINATOR_ADDR"] = args.master
+            os.environ["PTI_NUM_PROCESSES"] = str(args.nnodes)
+            os.environ["PTI_PROCESS_ID"] = str(args.rank)
+        _run_script(args.training_script,
+                    list(args.training_script_args))
     return 0
+
+
+def _run_script(script, script_args):
+    """Module-level so mp spawn can pickle it."""
+    sys.argv = [script] + list(script_args)
+    runpy.run_path(script, run_name="__main__")
 
 
 if __name__ == "__main__":

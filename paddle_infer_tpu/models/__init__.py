@@ -15,19 +15,26 @@ __all__ = [
     "ErnieForMaskedLM", "ErnieForPretraining",
     "ErnieForSequenceClassification", "ErnieModel", "ernie_pretrain_loss",
     "GPT_PRESETS", "GPTConfig", "GPTForCausalLM", "GPTModel", "gpt_lm_loss",
+    # lazy (__getattr__) exports — listed so the API guard covers them
+    "BertModel", "BertForSequenceClassification", "BertForPretraining",
+    "BertConfig", "ResNet", "resnet18", "resnet50",
+    "LlamaModel", "LlamaForCausalLM", "LlamaConfig", "LlamaDecoderLayer",
+    "LlamaMLP", "LLAMA_PRESETS", "llama_lm_loss",
+    "GPTMoEModel", "GPTMoEForCausalLM", "MoEConfig",
+    "AutoModel", "AutoConfig", "PretrainedMixin",
 ]
 
 
 def __getattr__(name):
     if name in ("BertModel", "BertForSequenceClassification",
-                "BertForPretraining", "BertConfig", "ErnieModel"):
+                "BertForPretraining", "BertConfig"):
         from . import bert
 
         return getattr(bert, name)
     if name in ("ResNet", "resnet18", "resnet50"):
-        from . import resnet
+        from ..vision import models as _vm
 
-        return getattr(resnet, name)
+        return getattr(_vm, name)
     if name in ("LlamaModel", "LlamaForCausalLM", "LlamaConfig",
                 "LlamaDecoderLayer", "LlamaMLP", "LLAMA_PRESETS",
                 "llama_lm_loss"):
@@ -38,4 +45,8 @@ def __getattr__(name):
         from . import gpt_moe
 
         return getattr(gpt_moe, name)
+    if name in ("AutoModel", "AutoConfig", "PretrainedMixin"):
+        from . import pretrained
+
+        return getattr(pretrained, name)
     raise AttributeError(name)

@@ -1,0 +1,12 @@
+"""BERT names over the shared ERNIE-family implementation (the two
+architectures are identical at this layer — reference PaddleNLP keeps
+separate modeling files only for tokenizer/head naming; the bert-base /
+bert-large presets live in ERNIE_PRESETS)."""
+from .ernie import ErnieConfig as BertConfig
+from .ernie import ErnieForPretraining as BertForPretraining
+from .ernie import (ErnieForSequenceClassification as
+                    BertForSequenceClassification)
+from .ernie import ErnieModel as BertModel
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertForSequenceClassification"]

@@ -21,6 +21,52 @@ _WEIGHTS_PKL = "model.pdparams"
 _CONFIG = "config.json"
 
 
+class AutoModel:
+    """Architecture-dispatching loader (PaddleNLP AutoModel surface):
+    reads ``architecture`` from config.json and loads through the right
+    class."""
+
+    _REGISTRY = {
+        "GPTForCausalLM": ("gpt", "GPTForCausalLM"),
+        "GPTMoEForCausalLM": ("gpt_moe", "GPTMoEForCausalLM"),
+        "LlamaForCausalLM": ("llama", "LlamaForCausalLM"),
+        "ErnieForMaskedLM": ("ernie", "ErnieForMaskedLM"),
+        "ErnieForPretraining": ("ernie", "ErnieForPretraining"),
+        "ErnieForSequenceClassification": (
+            "ernie", "ErnieForSequenceClassification"),
+    }
+
+    @classmethod
+    def _resolve(cls, save_dir: str):
+        """-> (model class, config dict without 'architecture')."""
+        import importlib
+
+        with open(os.path.join(save_dir, _CONFIG)) as f:
+            cfg = json.load(f)
+        arch = cfg.pop("architecture", None)
+        entry = cls._REGISTRY.get(arch)
+        if entry is None:
+            raise ValueError(
+                f"unknown architecture {arch!r} in {save_dir} "
+                f"(known: {sorted(cls._REGISTRY)})")
+        mod = importlib.import_module(f".{entry[0]}", __package__)
+        return getattr(mod, entry[1]), cfg
+
+    @classmethod
+    def from_pretrained(cls, save_dir: str):
+        model_cls, _ = cls._resolve(save_dir)
+        return model_cls.from_pretrained(save_dir)
+
+
+class AutoConfig:
+    """Config-only loader companion to AutoModel."""
+
+    @classmethod
+    def from_pretrained(cls, save_dir: str):
+        model_cls, cfg = AutoModel._resolve(save_dir)
+        return model_cls.config_class(**cfg)
+
+
 class PretrainedMixin:
     """Mixed into the *ForCausalLM / *For* heads; subclasses define
     ``config_class``."""

@@ -1,0 +1,65 @@
+"""CI tooling parity (SURVEY §2.13): API signature guard
+(API.spec + check_api_compatible analog) and the CrossStackProfiler
+trace merger."""
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+
+
+def _env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    return env
+
+
+def test_api_spec_check_passes_against_committed():
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "api_spec.py"),
+         "--check"], capture_output=True, text=True, env=_env(),
+        timeout=600)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "API surface stable" in r.stdout
+
+
+def test_api_spec_detects_drift(tmp_path):
+    import api_spec
+
+    spec = api_spec.collect()
+    assert "paddle_infer_tpu.sequence.sequence_pad" in spec
+    assert any(k.startswith("paddle_infer_tpu.models.LlamaForCausalLM")
+               for k in spec)
+    # simulate a removed + changed symbol
+    old = dict(spec)
+    k = "paddle_infer_tpu.sequence.sequence_pad"
+    old["paddle_infer_tpu.gone_symbol"] = "(x)"
+    old[k] = "(totally, different)"
+    removed = sorted(set(old) - set(spec))
+    changed = [kk for kk in set(old) & set(spec)
+               if old[kk].strip() != spec[kk].strip()]
+    assert removed == ["paddle_infer_tpu.gone_symbol"]
+    assert k in changed
+
+
+def test_merge_profiles(tmp_path):
+    import merge_profiles
+
+    a = tmp_path / "host0.json"
+    b = tmp_path / "host1.json"
+    a.write_text(json.dumps({"traceEvents": [
+        {"name": "step", "ph": "X", "pid": 1, "tid": 1, "ts": 0,
+         "dur": 5}]}))
+    b.write_text(json.dumps([
+        {"name": "step", "ph": "X", "pid": 1, "tid": 1, "ts": 2,
+         "dur": 5}]))
+    out = merge_profiles.merge([str(a), str(b)])
+    evs = out["traceEvents"]
+    names = [e for e in evs if e.get("ph") == "M"]
+    assert {n["args"]["name"] for n in names} == {"host0/pid1",
+                                                 "host1/pid1"}
+    xs = [e for e in evs if e.get("ph") == "X"]
+    assert len({e["pid"] for e in xs}) == 2     # distinct row groups

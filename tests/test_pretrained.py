@@ -1,6 +1,7 @@
 """save_pretrained / from_pretrained (PaddleNLP PretrainedModel surface;
 weights through the native mmap TensorStore)."""
 import os
+import sys
 
 import numpy as np
 import pytest
@@ -80,3 +81,74 @@ def test_ernie_heads_roundtrip(tmp_path):
                                            (2, 8)).astype(np.int32)
     np.testing.assert_allclose(m(Tensor(ids)).numpy(),
                                m2(Tensor(ids)).numpy(), atol=1e-6)
+
+
+def test_automodel_dispatch(tmp_path):
+    from paddle_infer_tpu.models import AutoConfig, AutoModel
+
+    m = _tiny_gpt()
+    m.eval()
+    d = str(tmp_path / "auto")
+    m.save_pretrained(d)
+    m2 = AutoModel.from_pretrained(d)
+    assert type(m2).__name__ == "GPTForCausalLM"
+    ids = np.random.RandomState(3).randint(0, 96, (1, 6)).astype(np.int32)
+    np.testing.assert_allclose(m(Tensor(ids)).numpy(),
+                               m2(Tensor(ids)).numpy(), atol=1e-6)
+    cfg = AutoConfig.from_pretrained(d)
+    assert cfg.hidden_size == 32
+
+
+def test_launch_cli_args(tmp_path):
+    import subprocess
+    import sys
+
+    script = tmp_path / "job.py"
+    script.write_text(
+        "import os, sys\n"
+        "print('ARGS', sys.argv[1:])\n"
+        "print('JOB', os.environ.get('PTI_JOB_ID'))\n"
+        "print('ADDR', os.environ.get('PTI_COORDINATOR_ADDR'))\n")
+    import os
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_infer_tpu.distributed.launch",
+         "--master", "127.0.0.1:7777", "--nnodes", "2", "--rank", "1",
+         "--job_id", "j1", str(script), "--lr", "0.1"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert r.returncode == 0, r.stderr[-400:]
+    assert "ARGS ['--lr', '0.1']" in r.stdout
+    assert "JOB j1" in r.stdout
+    assert "ADDR 127.0.0.1:7777" in r.stdout
+
+
+def test_launch_multihost_env_wiring(tmp_path):
+    """--master + --nproc_per_node must form ONE global job: world size
+    nnodes*nproc, ranks offset by node rank (review fix)."""
+    import subprocess
+
+    script = tmp_path / "job.py"
+    script.write_text(
+        "import os\n"
+        "print('W', os.environ.get('PTI_NUM_PROCESSES'),"
+        " 'R', os.environ.get('PTI_PROCESS_ID'),"
+        " 'A', os.environ.get('PTI_COORDINATOR_ADDR'))\n")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_infer_tpu.distributed.launch",
+         "--master", "10.0.0.1:9999", "--nnodes", "2", "--rank", "1",
+         "--nproc_per_node", "2", str(script)],
+        capture_output=True, text=True, env=env, timeout=180)
+    assert r.returncode == 0, r.stderr[-400:]
+    lines = sorted(ln for ln in r.stdout.splitlines()
+                   if ln.startswith("W "))
+    assert lines == ["W 4 R 2 A 10.0.0.1:9999",
+                     "W 4 R 3 A 10.0.0.1:9999"], lines

@@ -1,0 +1,61 @@
+"""Merge per-host/per-process chrome traces into one timeline.
+
+Reference: tools/CrossStackProfiler/ (CspReporter.py — offline merge of
+profiler + DCGM + net logs into a single chrome trace for cluster
+jobs).
+
+Here: each process's ``profiler.export_chrome_tracing`` output (and any
+jax.profiler xplane-derived trace converted to chrome JSON) is merged
+into one file, with every input's events re-pidded to its source name
+so the trace viewer shows one row-group per host/process.
+
+Usage:
+  python tools/merge_profiles.py out.json host0.json host1.json ...
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def merge(paths, labels=None):
+    labels = labels or [os.path.splitext(os.path.basename(p))[0]
+                        for p in paths]
+    merged = []
+    for idx, (path, label) in enumerate(zip(paths, labels)):
+        with open(path) as f:
+            data = json.load(f)
+        events = data["traceEvents"] if isinstance(data, dict) else data
+        base_pid = (idx + 1) * 1000
+        seen_pids = {}
+        for ev in events:
+            pid = ev.get("pid", 0)
+            if pid not in seen_pids:
+                seen_pids[pid] = base_pid + len(seen_pids)
+                merged.append({
+                    "name": "process_name", "ph": "M",
+                    "pid": seen_pids[pid],
+                    "args": {"name": f"{label}/pid{pid}"}})
+            ev = dict(ev, pid=seen_pids[pid])
+            merged.append(ev)
+    return {"traceEvents": merged}
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) < 2:
+        print("usage: merge_profiles.py out.json in1.json [in2.json ...]",
+              file=sys.stderr)
+        return 2
+    out, *ins = argv
+    result = merge(ins)
+    with open(out, "w") as f:
+        json.dump(result, f)
+    print(f"merged {len(ins)} traces "
+          f"({len(result['traceEvents'])} events) -> {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
