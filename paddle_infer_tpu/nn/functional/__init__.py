@@ -697,3 +697,32 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
 def dice_loss(input, label, epsilon=1e-5):
     return D("mean", D("dice_loss_op", input, label,
                        epsilon=float(epsilon)))
+
+
+# ---- round-4 breadth batch functional surface (ops/breadth_r4.py)
+
+def affine_grid(theta, out_shape, align_corners=True):
+    return D("affine_grid", theta, out_shape=tuple(out_shape),
+             align_corners=align_corners)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    return D("grid_sample", x, grid, mode=mode,
+             padding_mode=padding_mode, align_corners=align_corners)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    return D("gumbel_softmax", x, temperature=temperature, hard=hard,
+             axis=axis)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25):
+    return D("temporal_shift", x, seg_num=seg_num,
+             shift_ratio=shift_ratio)
+
+
+def warpctc(*args, **kwargs):
+    """Alias of ctc_loss (reference warpctc_op wraps warp-ctc; here one
+    compiled lax.scan op serves both names)."""
+    return ctc_loss(*args, **kwargs)

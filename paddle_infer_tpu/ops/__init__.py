@@ -18,6 +18,7 @@ from . import loss                      # noqa: F401
 from . import creation                  # noqa: F401
 from . import distributed as _dist_ops  # noqa: F401
 from . import attention as _attention   # noqa: F401
+from . import breadth_r4 as _breadth_r4  # noqa: F401
 from . import rnn as _rnn_ops            # noqa: F401
 
 from .creation import *                 # noqa: F401,F403
@@ -192,9 +193,22 @@ _EXPORTS = [
     "angle", "conj", "bincount", "diagflat", "index_put", "scatter_nd",
     "scatter_nd_add", "masked_select", "unique", "cdist", "lu_factor",
     "eig", "cholesky",
+    # round-4 breadth batch (ops/breadth_r4.py)
+    "isclose", "allclose", "kthvalue", "mode", "index_sample",
+    "strided_slice", "broadcast_tensors", "p_norm", "poisson",
+    "gather_tree",
 ]
 
 globals().update({name: _fn(name) for name in _EXPORTS})
+
+
+from .breadth_r4 import (edit_distance, unbind,  # noqa: F401,E402
+                         unique_consecutive)
+
+
+def multiplex(inputs, index):
+    """Public arg order (reference paddle.multiplex(inputs, index))."""
+    return D("multiplex", index, *inputs)
 
 
 def transpose(x, perm):

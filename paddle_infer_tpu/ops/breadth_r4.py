@@ -1,0 +1,382 @@
+"""Round-4 op breadth batch — the remaining reference yaml ops absent
+from the registry (phi/api/yaml/ops.yaml + legacy_ops.yaml; round-3
+verdict §2.1 "op/kernel breadth" gap).
+
+Static-shape members lower straight to XLA with auto-vjp backward
+rules; data-dependent-output members (unique_consecutive) run host-side
+like the reference CPU kernels; edit_distance is a host DP."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as prandom
+from ..core.dispatch import (dispatch as D, register_grad,
+                             register_op, register_vjp_grad)
+from ..core.tensor import Tensor
+
+
+def _op(name, save_inputs=True, vjp=True, jit=True):
+    def deco(fn):
+        register_op(name, save_inputs=save_inputs, jit=jit)(fn)
+        if vjp:
+            register_vjp_grad(name)
+        return fn
+
+    return deco
+
+
+# ------------------------------------------------------- sampling grids
+
+@_op("affine_grid", save_inputs=True)
+def _affine_grid(theta, out_shape=(), align_corners=True):
+    """theta [N, 2, 3] -> grid [N, H, W, 2] (reference affine_grid_op):
+    normalized (x, y) sample coordinates in [-1, 1]."""
+    n, h, w = out_shape[0], out_shape[-2], out_shape[-1]
+
+    def axis(sz):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, sz)
+        step = 2.0 / sz
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, sz)
+
+    ys = axis(h)
+    xs = axis(w)
+    gx, gy = jnp.meshgrid(xs, ys)                     # [H, W]
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    out = jnp.einsum("hwk,nak->nhwa", base.astype(theta.dtype), theta)
+    return out
+
+
+@_op("grid_sample", save_inputs=True)
+def _grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                 align_corners=True):
+    """x [N,C,H,W] + grid [N,Ho,Wo,2] (normalized xy) -> [N,C,Ho,Wo]
+    (reference grid_sample_op)."""
+    n, c, h, w = x.shape
+
+    def unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1.0) / 2.0 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    gx = unnormalize(grid[..., 0], w)                 # [N, Ho, Wo]
+    gy = unnormalize(grid[..., 1], h)
+    if padding_mode == "border":
+        gx = jnp.clip(gx, 0, w - 1)
+        gy = jnp.clip(gy, 0, h - 1)
+    elif padding_mode == "reflection":
+        def reflect(v, size):
+            # align_corners=True reflects about the corner pixels
+            # [0, size-1]; False about the pixel EDGES [-0.5, size-0.5]
+            # (the reference kernel's borders)
+            if align_corners:
+                span = 2.0 * (size - 1)
+                v = jnp.abs(v) % span
+                return jnp.where(v > size - 1, span - v, v)
+            v = v + 0.5
+            span = 2.0 * size
+            v = jnp.abs(v) % span
+            v = jnp.where(v > size, span - v, v)
+            return v - 0.5
+
+        gx = jnp.clip(reflect(gx, w), 0, w - 1)
+        gy = jnp.clip(reflect(gy, h), 0, h - 1)
+
+    def gather(yi, xi):
+        yi = jnp.clip(yi, 0, h - 1)
+        xi = jnp.clip(xi, 0, w - 1)
+        return jax.vmap(lambda img, yy, xx: img[:, yy, xx])(
+            x, yi, xi)                                # [N, C, Ho, Wo]
+
+    if mode == "nearest":
+        out = gather(jnp.round(gy).astype(jnp.int32),
+                     jnp.round(gx).astype(jnp.int32))
+        valid = ((gx >= -0.5) & (gx <= w - 0.5)
+                 & (gy >= -0.5) & (gy <= h - 0.5))
+    else:
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        wx = (gx - x0)[:, None]
+        wy = (gy - y0)[:, None]
+
+        def in_bounds(yi, xi):
+            return ((xi >= 0) & (xi <= w - 1) & (yi >= 0)
+                    & (yi <= h - 1)).astype(x.dtype)[:, None]
+
+        out = 0.0
+        for dy, fy in ((0, 1 - wy), (1, wy)):
+            for dx, fx in ((0, 1 - wx), (1, wx)):
+                contrib = gather(y0 + dy, x0 + dx) * fy * fx
+                if padding_mode == "zeros":
+                    contrib = contrib * in_bounds(y0 + dy, x0 + dx)
+                out = out + contrib
+        return out.astype(x.dtype)
+    if padding_mode == "zeros":
+        out = out * valid.astype(x.dtype)[:, None]
+    return out.astype(x.dtype)
+
+
+# --------------------------------------------------------- selection ops
+
+@_op("index_sample")
+def _index_sample(x, index):
+    """Per-row gather: x [N, D], index [N, K] -> [N, K] (reference
+    index_sample_op)."""
+    return jnp.take_along_axis(x, index.astype(jnp.int32), axis=1)
+
+
+@_op("kthvalue", vjp=False)  # custom grad below (int index output)
+def _kthvalue(x, k=1, axis=-1, keepdim=False):
+    """k-th SMALLEST value + index (reference kthvalue_op)."""
+    idx = jnp.argsort(x, axis=axis)
+    val = jnp.take_along_axis(x, idx, axis=axis)
+    kth_v = jnp.take(val, k - 1, axis=axis)
+    kth_i = jnp.take(idx, k - 1, axis=axis)
+    if keepdim:
+        kth_v = jnp.expand_dims(kth_v, axis)
+        kth_i = jnp.expand_dims(kth_i, axis)
+    return kth_v, kth_i.astype(jnp.int32)
+
+
+@_op("mode", vjp=False)      # custom grad below (int index output)
+def _mode(x, axis=-1, keepdim=False):
+    """Most frequent value along axis (+last index of it), the
+    reference mode_op contract."""
+    sx = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+
+    def counts_of(v):
+        return jnp.sum(jnp.equal(
+            x, jnp.expand_dims(v, axis)), axis=axis)
+
+    # count occurrences of each sorted candidate, take the max count's
+    # LARGEST value (ties break to bigger value like the reference sort)
+    cand_counts = jax.vmap(
+        lambda i: counts_of(jnp.take(sx, i, axis=axis)),
+        out_axes=-1)(jnp.arange(n))                  # [..., n]
+    best = jnp.argmax(cand_counts + jnp.arange(n) * 1e-7, axis=-1)
+    mode_v = jnp.take_along_axis(
+        sx, jnp.expand_dims(best, axis), axis=axis).squeeze(axis)
+    eq = jnp.equal(x, jnp.expand_dims(mode_v, axis))
+    last_idx = (x.shape[axis] - 1 - jnp.argmax(
+        jnp.flip(eq, axis=axis), axis=axis))
+    if keepdim:
+        mode_v = jnp.expand_dims(mode_v, axis)
+        last_idx = jnp.expand_dims(last_idx, axis)
+    return mode_v, last_idx.astype(jnp.int32)
+
+
+@_op("multiplex")
+def _multiplex(index, *inputs):
+    """Row-wise select: out[i] = inputs[index[i]][i] (reference
+    multiplex_op)."""
+    stacked = jnp.stack(inputs, axis=0)              # [K, N, ...]
+    idx = index.reshape(-1).astype(jnp.int32)
+    return jnp.take_along_axis(
+        stacked, idx[None, :, None].reshape(
+            (1, -1) + (1,) * (stacked.ndim - 2)), axis=0)[0]
+
+
+def unbind(x, axis=0):
+    """Split into a tuple along axis (reference unbind_op) — one op
+    serves both public names (unstack already registers fwd + grads)."""
+    return D("unstack", x, axis=axis)
+
+
+@_op("strided_slice")
+def _strided_slice(x, axes=(), starts=(), ends=(), strides=()):
+    """reference strided_slice_op."""
+    sl = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        sl[ax] = slice(int(s), int(e), int(st))
+    return x[tuple(sl)]
+
+
+@_op("broadcast_tensors")
+def _broadcast_tensors(*xs):
+    shape = jnp.broadcast_shapes(*(x.shape for x in xs))
+    return tuple(jnp.broadcast_to(x, shape) for x in xs)
+
+
+@_op("temporal_shift")
+def _temporal_shift(x, seg_num=1, shift_ratio=0.25):
+    """TSM channel shift (reference temporal_shift_op): [N*T, C, H, W],
+    first fold shifts +1 in time, second fold -1, rest stays."""
+    nt, c, h, w = x.shape
+    t = seg_num
+    n = nt // t
+    v = x.reshape(n, t, c, h, w)
+    fold = int(c * shift_ratio)
+    pad = jnp.zeros((n, 1, fold, h, w), x.dtype)
+    fwd = jnp.concatenate([pad, v[:, :-1, :fold]], axis=1)
+    bwd = jnp.concatenate([v[:, 1:, fold:2 * fold],
+                           jnp.zeros((n, 1, fold, h, w), x.dtype)], axis=1)
+    rest = v[:, :, 2 * fold:]
+    return jnp.concatenate([fwd, bwd, rest], axis=2).reshape(nt, c, h, w)
+
+
+# ------------------------------------------------------------ comparison
+
+@_op("isclose", save_inputs=False, vjp=False)
+def _isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@_op("allclose", save_inputs=False, vjp=False)
+def _allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@_op("p_norm")
+def _p_norm(x, porder=2.0, axis=-1, epsilon=1e-12, keepdim=False):
+    """reference p_norm_op (incl. inf norms)."""
+    if porder == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if porder == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    s = jnp.sum(jnp.abs(x) ** porder, axis=axis, keepdims=keepdim)
+    return (s + epsilon) ** (1.0 / porder)
+
+
+# --------------------------------------------------------------- random
+
+@_op("gumbel_softmax", save_inputs=True, jit=False)
+def _gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    """reference gumbel_softmax_op: differentiable categorical samples
+    (straight-through when hard)."""
+    g = -jnp.log(-jnp.log(jax.random.uniform(
+        prandom.next_key(), x.shape, jnp.float32, 1e-10, 1.0)))
+    y = jax.nn.softmax((x.astype(jnp.float32) + g) / temperature,
+                       axis=axis)
+    if hard:
+        oh = jax.nn.one_hot(jnp.argmax(y, axis=axis), x.shape[axis],
+                            axis=axis, dtype=y.dtype)
+        y = oh + y - jax.lax.stop_gradient(y)
+    return y.astype(x.dtype)
+
+
+@_op("poisson", save_inputs=False, vjp=False, jit=False)
+def _poisson(x):
+    """reference poisson_op: elementwise Poisson(lam=x) samples."""
+    return jax.random.poisson(prandom.next_key(),
+                              x.astype(jnp.float32)).astype(jnp.float32)
+
+
+# --------------------------------------- host-side / data-dependent ops
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None):
+    """reference unique_consecutive_op — output length is data-dependent,
+    so host-side numpy like the CPU kernel."""
+    from ..core.tensor import Tensor as T
+
+    arr = np.asarray(x._data if isinstance(x, T) else x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        change = np.concatenate([[True], arr[1:] != arr[:-1]])
+    else:
+        moved = np.moveaxis(arr, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        change = np.concatenate(
+            [[True], np.any(flat[1:] != flat[:-1], axis=1)])
+    starts = np.flatnonzero(change)
+    if axis is None:
+        out = arr[starts]
+    else:
+        out = np.moveaxis(np.moveaxis(arr, axis, 0)[starts], 0, axis)
+    results = [T(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(change) - 1
+        results.append(T(jnp.asarray(inv.astype(np.int32))))
+    if return_counts:
+        counts = np.diff(np.concatenate([starts, [len(change)]]))
+        results.append(T(jnp.asarray(counts.astype(np.int32))))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def edit_distance(hyps, refs, hyp_lens, ref_lens, normalized=True):
+    """Levenshtein distance per pair (reference edit_distance_op):
+    padded int id matrices + lengths -> [B, 1] distances (+ sequence
+    count).  Host DP like the reference CPU kernel."""
+    from ..core.tensor import Tensor as T
+
+    h = np.asarray(hyps._data if isinstance(hyps, T) else hyps)
+    r = np.asarray(refs._data if isinstance(refs, T) else refs)
+    hl = np.asarray(hyp_lens._data if isinstance(hyp_lens, T)
+                    else hyp_lens).reshape(-1)
+    rl = np.asarray(ref_lens._data if isinstance(ref_lens, T)
+                    else ref_lens).reshape(-1)
+    out = np.zeros((h.shape[0], 1), np.float32)
+    for b in range(h.shape[0]):
+        a, bb = h[b, :hl[b]], r[b, :rl[b]]
+        m, n = len(a), len(bb)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, n + 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (a[i - 1] != bb[j - 1]))
+        d = float(dp[n])
+        if normalized and n > 0:
+            d /= n
+        out[b, 0] = d
+    return T(jnp.asarray(out)), T(jnp.asarray(
+        np.asarray([h.shape[0]], np.int64)))
+
+
+@_op("gather_tree", vjp=False)
+def _gather_tree(ids, parents):
+    """Beam-search backtrace (reference gather_tree_op): ids/parents
+    [T, B, W] -> full sequences by walking parents from the last step —
+    a reverse lax.scan, no per-step host loop."""
+    T_, b, w = ids.shape
+
+    def step(beam, t):
+        tok = jnp.take_along_axis(ids[t], beam, axis=1)
+        parent = jnp.take_along_axis(parents[t], beam, axis=1)
+        return parent, tok
+
+    init = jnp.broadcast_to(jnp.arange(w, dtype=parents.dtype)[None],
+                            (b, w))
+    _, toks = jax.lax.scan(step, init, jnp.arange(T_ - 1, -1, -1))
+    return jnp.flip(toks, axis=0)
+
+
+def warpctc(*args, **kwargs):
+    """Alias of the framework's compiled lax.scan CTC loss (reference
+    warpctc_op wraps the warp-ctc library; here one op serves both
+    names)."""
+    from ..nn import functional as F
+
+    return F.ctc_loss(*args, **kwargs)
+
+
+@register_grad("kthvalue")
+def _kthvalue_grad(ctx, gval, gidx=None):
+    (x,) = ctx.inputs
+    axis = ctx.attrs.get("axis", -1)
+    keepdim = ctx.attrs.get("keepdim", False)
+    _, idx = D("kthvalue", x.detach(), **ctx.attrs)
+    if not keepdim:
+        gval = D("unsqueeze", gval, axis=axis)
+        idx = D("unsqueeze", idx, axis=axis)
+    zero = D("multiply", x, 0.0).detach()
+    return (D("put_along_axis", zero, idx, gval,
+              axis=axis if axis >= 0 else x.ndim - 1),)
+
+
+@register_grad("mode")
+def _mode_grad(ctx, gval, gidx=None):
+    (x,) = ctx.inputs
+    axis = ctx.attrs.get("axis", -1)
+    keepdim = ctx.attrs.get("keepdim", False)
+    _, idx = D("mode", x.detach(), **ctx.attrs)
+    if not keepdim:
+        gval = D("unsqueeze", gval, axis=axis)
+        idx = D("unsqueeze", idx, axis=axis)
+    zero = D("multiply", x, 0.0).detach()
+    return (D("put_along_axis", zero, idx, gval,
+              axis=axis if axis >= 0 else x.ndim - 1),)

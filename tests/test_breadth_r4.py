@@ -1,0 +1,199 @@
+"""Round-4 op breadth batch (reference yaml ops absent until now)."""
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.core.dispatch import dispatch as D
+from paddle_infer_tpu.core.tensor import Tensor
+
+
+def T(x):
+    return Tensor(np.asarray(x))
+
+
+class TestGrids:
+    def test_affine_grid_identity(self):
+        theta = np.array([[[1.0, 0, 0], [0, 1, 0]]], np.float32)
+        grid = D("affine_grid", T(theta), out_shape=(1, 1, 2, 2),
+                 align_corners=True).numpy()
+        # identity theta -> corners at +-1
+        np.testing.assert_allclose(grid[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(grid[0, 1, 1], [1, 1], atol=1e-6)
+
+    def test_grid_sample_identity_roundtrip(self):
+        x = np.random.RandomState(0).rand(1, 2, 4, 4).astype(np.float32)
+        theta = np.array([[[1.0, 0, 0], [0, 1, 0]]], np.float32)
+        grid = D("affine_grid", T(theta), out_shape=(1, 2, 4, 4),
+                 align_corners=True)
+        out = D("grid_sample", T(x), grid, mode="bilinear",
+                align_corners=True).numpy()
+        np.testing.assert_allclose(out, x, atol=1e-5)
+
+    def test_grid_sample_zeros_padding(self):
+        x = np.ones((1, 1, 2, 2), np.float32)
+        grid = np.full((1, 1, 1, 2), 5.0, np.float32)   # far outside
+        out = D("grid_sample", T(x), T(grid),
+                padding_mode="zeros").numpy()
+        np.testing.assert_allclose(out, 0.0)
+
+    def test_grid_sample_nearest_border(self):
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 2, 2)
+        grid = np.array([[[[-3.0, -3.0]]]], np.float32)
+        out = D("grid_sample", T(x), T(grid), mode="nearest",
+                padding_mode="border").numpy()
+        np.testing.assert_allclose(out[0, 0, 0, 0], 0.0)
+
+
+class TestSelection:
+    def test_index_sample(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        idx = np.array([[0, 3], [1, 1], [2, 0]], np.int32)
+        out = D("index_sample", T(x), T(idx)).numpy()
+        np.testing.assert_array_equal(out, [[0, 3], [5, 5], [10, 8]])
+
+    def test_kthvalue(self):
+        x = np.array([[3.0, 1.0, 2.0]], np.float32)
+        v, i = D("kthvalue", T(x), k=2, axis=-1)
+        assert float(v.numpy()[0]) == 2.0
+        assert int(i.numpy()[0]) == 2
+
+    def test_mode(self):
+        x = np.array([[1.0, 2.0, 2.0, 3.0]], np.float32)
+        v, i = D("mode", T(x), axis=-1)
+        assert float(v.numpy()[0]) == 2.0
+        assert int(i.numpy()[0]) == 2     # last occurrence
+
+    def test_multiplex(self):
+        a = np.zeros((3, 2), np.float32)
+        b = np.ones((3, 2), np.float32)
+        idx = np.array([[1], [0], [1]], np.int32)
+        out = D("multiplex", T(idx), T(a), T(b)).numpy()
+        np.testing.assert_array_equal(out, [[1, 1], [0, 0], [1, 1]])
+
+    def test_unbind_and_strided_slice(self):
+        x = np.arange(6, dtype=np.float32).reshape(2, 3)
+        parts = pit.unbind(T(x), axis=0)
+        assert len(parts) == 2
+        np.testing.assert_array_equal(parts[1].numpy(), [3, 4, 5])
+        out = D("strided_slice", T(x), axes=(1,), starts=(0,),
+                ends=(3,), strides=(2,)).numpy()
+        np.testing.assert_array_equal(out, [[0, 2], [3, 5]])
+
+    def test_broadcast_tensors(self):
+        a = np.ones((1, 3), np.float32)
+        b = np.ones((2, 1), np.float32)
+        oa, ob = D("broadcast_tensors", T(a), T(b))
+        assert oa.shape == [2, 3] and ob.shape == [2, 3]
+
+    def test_temporal_shift_moves_channels(self):
+        x = np.random.RandomState(1).rand(4, 4, 2, 2).astype(np.float32)
+        out = D("temporal_shift", T(x), seg_num=2,
+                shift_ratio=0.25).numpy()
+        v = x.reshape(2, 2, 4, 2, 2)
+        o = out.reshape(2, 2, 4, 2, 2)
+        # fold 0: shifted forward in time (t=0 zero, t=1 = old t=0)
+        np.testing.assert_allclose(o[:, 0, 0], 0.0)
+        np.testing.assert_allclose(o[:, 1, 0], v[:, 0, 0])
+        # fold 1: shifted backward
+        np.testing.assert_allclose(o[:, 0, 1], v[:, 1, 1])
+        # rest unchanged
+        np.testing.assert_allclose(o[:, :, 2:], v[:, :, 2:])
+
+
+class TestMisc:
+    def test_isclose_allclose(self):
+        a = np.array([1.0, 2.0], np.float32)
+        b = np.array([1.0, 2.1], np.float32)
+        np.testing.assert_array_equal(
+            D("isclose", T(a), T(b)).numpy(), [True, False])
+        assert not bool(D("allclose", T(a), T(b)).numpy())
+        assert bool(D("allclose", T(a), T(a)).numpy())
+
+    def test_p_norm(self):
+        x = np.array([[3.0, 4.0]], np.float32)
+        assert float(D("p_norm", T(x), porder=2.0,
+                       axis=-1).numpy()[0]) == pytest.approx(5.0, 1e-4)
+        assert float(D("p_norm", T(x), porder=float("inf"),
+                       axis=-1).numpy()[0]) == 4.0
+
+    def test_gumbel_softmax(self):
+        pit.seed(0)
+        x = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+        y = D("gumbel_softmax", T(x), temperature=0.5).numpy()
+        np.testing.assert_allclose(y.sum(-1), 1.0, rtol=1e-5)
+        yh = D("gumbel_softmax", T(x), hard=True).numpy()
+        np.testing.assert_allclose(yh.sum(-1), 1.0, rtol=1e-5)
+        assert ((yh == yh.max(-1, keepdims=True)).sum(-1) == 1).all()
+
+    def test_poisson(self):
+        pit.seed(1)
+        lam = np.full((2000,), 4.0, np.float32)
+        s = D("poisson", T(lam)).numpy()
+        assert 3.5 < s.mean() < 4.5
+
+    def test_unique_consecutive(self):
+        from paddle_infer_tpu.ops.breadth_r4 import unique_consecutive
+
+        x = T(np.array([1, 1, 2, 2, 2, 3, 1], np.int32))
+        out, inv, counts = unique_consecutive(x, return_inverse=True,
+                                              return_counts=True)
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3, 1])
+        np.testing.assert_array_equal(inv.numpy(), [0, 0, 1, 1, 1, 2, 3])
+        np.testing.assert_array_equal(counts.numpy(), [2, 3, 1, 1])
+
+    def test_edit_distance(self):
+        from paddle_infer_tpu.ops.breadth_r4 import edit_distance
+
+        hyp = np.array([[1, 2, 3, 0]], np.int64)
+        ref = np.array([[1, 3, 3, 4]], np.int64)
+        d, n = edit_distance(T(hyp), T(ref), T(np.array([3])),
+                             T(np.array([4])), normalized=False)
+        assert float(d.numpy()[0, 0]) == 2.0    # sub 2->3, insert 4
+        assert int(n.numpy()[0]) == 1
+
+    def test_gather_tree(self):
+        # T=3, B=1, W=2 beams
+        ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int32)
+        parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int32)
+        out = D("gather_tree", T(ids), T(parents)).numpy()
+        # beam 0 at t=2 (token 5) came from parent beam 1 at t=1
+        np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+        np.testing.assert_array_equal(out[:, 0, 1], [1, 3, 6])
+
+
+class TestReviewFixes:
+    def test_reflection_padding_pixel_edge(self):
+        """align_corners=False reflects about the -0.5 pixel edge
+        (verified against the reference kernel semantics)."""
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 1, 4)
+        # normalized coord giving unnormalized x = -1.0
+        gx = (2 * (-1.0) + 1) / 4 - 1        # inverse of unnormalize
+        grid = np.array([[[[gx, -0.75]]]], np.float32)
+        out = D("grid_sample", T(x), T(grid), mode="bilinear",
+                padding_mode="reflection", align_corners=False).numpy()
+        assert out[0, 0, 0, 0] == pytest.approx(0.0, abs=1e-5)
+
+    def test_unbind_and_selection_grads_flow(self):
+        x = T(np.random.RandomState(5).rand(3, 4).astype(np.float32))
+        x.stop_gradient = False
+        parts = pit.unbind(x, axis=0)
+        parts[1].sum().backward()
+        g = x.grad.numpy()
+        assert g[1].sum() == 4 and g[0].sum() == 0
+        x.clear_grad()
+        v, _ = pit.kthvalue(x, k=2, axis=-1)
+        v.sum().backward()
+        assert x.grad.numpy().sum() == 3     # one slot per row
+        x.clear_grad()
+        v, _ = pit.mode(x, axis=-1)
+        v.sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_multiplex_public_arg_order(self):
+        a = T(np.zeros((2, 2), np.float32))
+        b = T(np.ones((2, 2), np.float32))
+        out = pit.multiplex([a, b], T(np.array([[1], [0]], np.int32)))
+        np.testing.assert_array_equal(out.numpy(), [[1, 1], [0, 0]])
+
+    def test_warpctc_alias(self):
+        assert pit.nn.functional.warpctc is not None

@@ -132,11 +132,15 @@ def test_launch_multihost_env_wiring(tmp_path):
     import subprocess
 
     script = tmp_path / "job.py"
+    # each worker records its env in its own file (two children share a
+    # stdout pipe — concurrent prints can interleave mid-line)
     script.write_text(
-        "import os\n"
-        "print('W', os.environ.get('PTI_NUM_PROCESSES'),"
-        " 'R', os.environ.get('PTI_PROCESS_ID'),"
-        " 'A', os.environ.get('PTI_COORDINATOR_ADDR'))\n")
+        "import os, sys\n"
+        "r = os.environ.get('PTI_PROCESS_ID')\n"
+        "open(os.path.join(os.path.dirname(os.path.abspath(__file__)),\n"
+        "     f'env.{r}'), 'w').write(\n"
+        "    f\"W {os.environ.get('PTI_NUM_PROCESSES')} \"\n"
+        "    f\"A {os.environ.get('PTI_COORDINATOR_ADDR')}\")\n")
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env["JAX_PLATFORMS"] = "cpu"
@@ -146,9 +150,10 @@ def test_launch_multihost_env_wiring(tmp_path):
         [sys.executable, "-m", "paddle_infer_tpu.distributed.launch",
          "--master", "10.0.0.1:9999", "--nnodes", "2", "--rank", "1",
          "--nproc_per_node", "2", str(script)],
-        capture_output=True, text=True, env=env, timeout=180)
+        capture_output=True, text=True, env=env, timeout=300)
     assert r.returncode == 0, r.stderr[-400:]
-    lines = sorted(ln for ln in r.stdout.splitlines()
-                   if ln.startswith("W "))
-    assert lines == ["W 4 R 2 A 10.0.0.1:9999",
-                     "W 4 R 3 A 10.0.0.1:9999"], lines
+    ranks = sorted(f.name.split(".")[1] for f in tmp_path.glob("env.*"))
+    assert ranks == ["2", "3"], ranks     # node rank 1 -> global 2, 3
+    for rank in ranks:
+        assert (tmp_path / f"env.{rank}").read_text() == \
+            "W 4 A 10.0.0.1:9999"
