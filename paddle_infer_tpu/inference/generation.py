@@ -827,11 +827,140 @@ class PagedGenerationEngine(GenerationEngine):
         seq = np.asarray(seq)
         return (seq, np.asarray(score)) if return_scores else seq
 
-    # ------------------------------------------------------------- public
-    def generate(self, input_ids, generation_config: GenerationConfig = None,
-                 attention_mask=None, return_scores: bool = False):
+    # --------------------------------------------------- streaming decode
+    def _build_stream_prefill(self, batch, plen, g: GenerationConfig):
+        """Prefill + first token as its own program (the step-wise half
+        of _build_paged; reference predictors decode token-by-token, so
+        streaming falls out of their design — here it is an explicit
+        second compiled program over the SAME persistent pools)."""
+        L = self._num_layers
+
+        def run(params, ids, lengths, tables, k_pages, v_pages, rng):
+            zero_pos = jnp.zeros((batch,), jnp.int32)
+            caches = [(k_pages[i], v_pages[i], tables, zero_pos)
+                      for i in range(L)]
+            pos2d = jnp.broadcast_to(
+                jnp.arange(plen, dtype=jnp.int32)[None], (batch, plen))
+            logits, caches = self._model_step(params, ids, pos2d, None,
+                                              caches)
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+            col = jnp.arange(plen, dtype=jnp.int32)[None]
+            hist = jnp.concatenate(
+                [jnp.where(col < lengths[:, None], ids, -1),
+                 jnp.full((batch, g.max_new_tokens), -1, jnp.int32)],
+                axis=1)
+            pick = self._logits_picker(g)
+            k0, rng = jax.random.split(rng)
+            tok, _ = pick(last, hist, 0, k0)
+            fin = (tok == g.eos_token_id) if g.eos_token_id is not None \
+                else jnp.zeros((batch,), jnp.bool_)
+            hist = hist.at[:, plen].set(tok)
+            return (tok, fin, hist, rng,
+                    [c[0] for c in caches], [c[1] for c in caches])
+
+        return jax.jit(run, donate_argnums=(4, 5))
+
+    def _build_stream_chunk(self, batch, plen, chunk, g: GenerationConfig):
+        """Decode ``chunk`` tokens from persistent pools: the body of
+        _build_paged's while_loop as a fixed-length scan, resumable at
+        any step offset."""
+        L = self._num_layers
+
+        def run(params, tok, fin, hist, step0, lengths, tables, k_pages,
+                v_pages, rng):
+            def body(carry, i):
+                tok, fin, hist, caches, rng = carry
+                step = step0 + i
+                pos = lengths + step - 1
+                caches = [(kp, vp, tb, pos) for kp, vp, tb, _ in caches]
+                logits, caches = self._model_step(
+                    params, tok[:, None], pos[:, None], None, caches)
+                key, rng = jax.random.split(rng)
+                pick = self._logits_picker(g)
+                nxt, _ = pick(logits[:, -1], hist, step, key)
+                if g.eos_token_id is not None:
+                    nxt = jnp.where(fin, g.pad_token_id, nxt)
+                    fin = jnp.logical_or(fin, nxt == g.eos_token_id)
+                hist = jax.lax.dynamic_update_slice(
+                    hist, nxt[:, None],
+                    (jnp.zeros((), jnp.int32), plen + step))
+                return (nxt, fin, hist, caches, rng), nxt
+
+            caches = [(k_pages[i], v_pages[i], tables,
+                       jnp.zeros((batch,), jnp.int32)) for i in range(L)]
+            (tok, fin, hist, caches, rng), toks = jax.lax.scan(
+                body, (tok, fin, hist, caches, rng), jnp.arange(chunk))
+            return (toks.T, tok, fin, hist, rng,
+                    [c[0] for c in caches], [c[1] for c in caches])
+
+        return jax.jit(run, donate_argnums=(7, 8))
+
+    def stream(self, input_ids, generation_config: GenerationConfig = None,
+               attention_mask=None, chunk_size: int = 8):
+        """Generator yielding decoded tokens in chunks (np [b, <=chunk])
+        — the streaming serving mode: prefill compiles once, each chunk
+        is one device round-trip over the persistent paged pools, and
+        the stream stops early when every row hits EOS.  Beam search is
+        not streamable (it finalizes globally)."""
         g = generation_config or GenerationConfig()
+        if g.num_beams > 1:
+            raise ValueError("stream() supports sampling/greedy only")
         self._params = self._snapshot_params()
+        ids, lengths, plen, pages_per_seq, pool, tables = \
+            self._prepare_paged_inputs(input_ids, attention_mask, g)
+        b = ids.shape[0]
+        try:
+            k_pages, v_pages = self._ensure_pages()
+            key_p = ("stream-prefill", b, plen, pages_per_seq,
+                     pool.num_blocks, g.cache_key())
+            fn_p = self._compiled.get(key_p)
+            if fn_p is None:
+                fn_p = self._build_stream_prefill(b, plen, g)
+                self._compiled[key_p] = fn_p
+            rng = jax.random.PRNGKey(g.seed)
+            # fixed per-stream feeds: place once, not per chunk
+            lengths_d = self._replicated(lengths)
+            tables_d = self._replicated(tables)
+            # pools are donated into every call: drop our references
+            # first, rebind ONLY from a successful call's outputs (a
+            # failed call consumed them; _ensure_pages then rebuilds)
+            self._k_pages = self._v_pages = None
+            with _MeshContext(self._mesh):
+                tok, fin, hist, rng, k_pages, v_pages = fn_p(
+                    self._params, self._replicated(ids), lengths_d,
+                    tables_d, k_pages, v_pages, rng)
+            self._k_pages, self._v_pages = k_pages, v_pages
+            emitted = 1
+            yield np.asarray(tok)[:, None]
+            while emitted < g.max_new_tokens and not bool(
+                    np.asarray(fin).all()):
+                chunk = min(chunk_size, g.max_new_tokens - emitted)
+                key_c = ("stream-chunk", b, plen, chunk, pages_per_seq,
+                         pool.num_blocks, g.cache_key())
+                fn_c = self._compiled.get(key_c)
+                if fn_c is None:
+                    fn_c = self._build_stream_chunk(b, plen, chunk, g)
+                    self._compiled[key_c] = fn_c
+                self._k_pages = self._v_pages = None
+                with _MeshContext(self._mesh):
+                    toks, tok, fin, hist, rng, k_pages, v_pages = fn_c(
+                        self._params, tok, fin, hist,
+                        jnp.asarray(emitted, jnp.int32), lengths_d,
+                        tables_d, k_pages, v_pages, rng)
+                self._k_pages, self._v_pages = k_pages, v_pages
+                emitted += chunk
+                yield np.asarray(toks)
+        finally:
+            for s in range(b):
+                pool.free(s)
+
+    # ------------------------------------------------------------- public
+    def _prepare_paged_inputs(self, input_ids, attention_mask, g):
+        """Shared input canonicalization for generate() and stream():
+        right-pad repack, page/bucket padding, pool reservation, page
+        tables.  Returns (ids, lengths, plen, pages_per_seq, pool,
+        tables)."""
         ids = np.asarray(input_ids._data if isinstance(input_ids, Tensor)
                          else input_ids).astype(np.int32)
         if ids.ndim == 1:
@@ -858,21 +987,31 @@ class PagedGenerationEngine(GenerationEngine):
         if plen > plen_raw:
             ids = np.pad(ids, ((0, 0), (0, plen - plen_raw)),
                          constant_values=g.pad_token_id)
-
-        if g.num_beams > 1:
-            return self._generate_paged_beam(ids, lengths, plen, g,
-                                             return_scores)
-
         pages_per_seq = -(-(plen + g.max_new_tokens) // self.page_size)
         pool = self._ensure_pool(pages_per_seq * b)
-        seq_ids = list(range(b))
-        for s in seq_ids:
+        for s in range(b):
             pool.free(s)
             pool.reserve(s, plen + g.max_new_tokens)
         tables = np.zeros((b, pages_per_seq), np.int32)
-        for i, s in enumerate(seq_ids):
+        for s in range(b):
             t = pool.block_table(s)[:pages_per_seq]
-            tables[i, :len(t)] = t
+            tables[s, :len(t)] = t
+        return ids, lengths, plen, pages_per_seq, pool, tables
+
+    def generate(self, input_ids, generation_config: GenerationConfig = None,
+                 attention_mask=None, return_scores: bool = False):
+        g = generation_config or GenerationConfig()
+        self._params = self._snapshot_params()
+        ids, lengths, plen, pages_per_seq, pool, tables = \
+            self._prepare_paged_inputs(input_ids, attention_mask, g)
+        b = ids.shape[0]
+        seq_ids = list(range(b))
+
+        if g.num_beams > 1:
+            for s in seq_ids:       # beam path does its own reservations
+                pool.free(s)
+            return self._generate_paged_beam(ids, lengths, plen, g,
+                                             return_scores)
 
         k_pages, v_pages = self._ensure_pages()
 

@@ -433,3 +433,136 @@ class TestMoEDecode:
                 np.testing.assert_array_equal(ref, got)
         finally:
             topology.set_current_mesh(prev)
+
+
+class TestStreaming:
+    """Streaming decode over persistent paged pools (round-4): chunks
+    must concatenate to exactly the fused program's output."""
+
+    def _model(self, seed=0):
+        pit.seed(seed)
+        from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+
+        m = GPTForCausalLM(GPTConfig(
+            vocab_size=96, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0))
+        m.eval()
+        return m
+
+    def test_stream_matches_generate(self):
+        from paddle_infer_tpu.inference.generation import (
+            PagedGenerationEngine)
+
+        m = self._model()
+        ids = np.random.RandomState(0).randint(0, 96,
+                                               (2, 8)).astype(np.int32)
+        g = GenerationConfig(max_new_tokens=11)
+        want = PagedGenerationEngine(m, page_size=8,
+                                     prompt_bucket=8).generate(ids, g)
+        eng = PagedGenerationEngine(m, page_size=8, prompt_bucket=8)
+        chunks = list(eng.stream(ids, g, chunk_size=4))
+        got = np.concatenate(chunks, axis=1)
+        np.testing.assert_array_equal(got, want)
+        # 1 (prefill) + ceil(10/4) chunks
+        assert [c.shape[1] for c in chunks] == [1, 4, 4, 2]
+
+    def test_stream_sampling_matches_generate(self):
+        from paddle_infer_tpu.inference.generation import (
+            PagedGenerationEngine)
+
+        m = self._model(seed=2)
+        ids = np.random.RandomState(1).randint(0, 96,
+                                               (1, 8)).astype(np.int32)
+        g = GenerationConfig(max_new_tokens=8, do_sample=True, top_k=8,
+                             seed=5)
+        want = PagedGenerationEngine(m, page_size=8,
+                                     prompt_bucket=8).generate(ids, g)
+        eng = PagedGenerationEngine(m, page_size=8, prompt_bucket=8)
+        got = np.concatenate(list(eng.stream(ids, g, chunk_size=3)),
+                             axis=1)
+        np.testing.assert_array_equal(got, want)
+
+    def test_stream_eos_early_stop(self):
+        from paddle_infer_tpu.inference.generation import (
+            PagedGenerationEngine)
+
+        m = self._model(seed=3)
+        ids = np.random.RandomState(2).randint(0, 96,
+                                               (1, 8)).astype(np.int32)
+        # discover the greedy tokens, set eos to the 3rd one
+        ref_eng = PagedGenerationEngine(m, page_size=8, prompt_bucket=8)
+        ref = ref_eng.generate(ids, GenerationConfig(max_new_tokens=8))
+        eos = int(ref[0, 2])
+        g = GenerationConfig(max_new_tokens=8, eos_token_id=eos,
+                             pad_token_id=0)
+        eng = PagedGenerationEngine(m, page_size=8, prompt_bucket=8)
+        chunks = list(eng.stream(ids, g, chunk_size=2))
+        got = np.concatenate(chunks, axis=1)
+        # stops within one chunk of hitting EOS
+        assert got.shape[1] <= 6
+        assert eos in got[0]
+
+    def test_stream_rejects_beams(self):
+        from paddle_infer_tpu.inference.generation import (
+            PagedGenerationEngine)
+
+        eng = PagedGenerationEngine(self._model(), page_size=8)
+        with pytest.raises(ValueError, match="sampling/greedy"):
+            next(eng.stream(np.zeros((1, 4), np.int32),
+                            GenerationConfig(num_beams=3)))
+
+    def test_stream_mesh_parity(self):
+        from paddle_infer_tpu.inference.generation import (
+            PagedGenerationEngine)
+        from paddle_infer_tpu.parallel import topology
+
+        m = self._model(seed=4)
+        ids = np.random.RandomState(3).randint(0, 96,
+                                               (2, 8)).astype(np.int32)
+        g = GenerationConfig(max_new_tokens=6)
+        want = PagedGenerationEngine(m, page_size=8,
+                                     prompt_bucket=8).generate(ids, g)
+        mesh = topology.create_hybrid_mesh(mp=2)
+        prev = topology.get_current_mesh()
+        try:
+            eng = PagedGenerationEngine(m, page_size=8, prompt_bucket=8,
+                                        mesh=mesh)
+            got = np.concatenate(list(eng.stream(ids, g, chunk_size=3)),
+                                 axis=1)
+        finally:
+            topology.set_current_mesh(prev)
+        np.testing.assert_array_equal(got, want)
+
+    def test_stream_close_after_first_token_frees_pool(self):
+        """Client disconnect after the first yield must release the pool
+        reservations (review fix: the first yield was outside the
+        try/finally)."""
+        from paddle_infer_tpu.inference.generation import (
+            PagedGenerationEngine)
+
+        m = self._model(seed=5)
+        ids = np.random.RandomState(4).randint(0, 96,
+                                               (2, 8)).astype(np.int32)
+        g = GenerationConfig(max_new_tokens=8)
+        eng = PagedGenerationEngine(m, page_size=8, prompt_bucket=8)
+        free_before = None
+        it = eng.stream(ids, g, chunk_size=2)
+        next(it)
+        it.close()                     # GeneratorExit at the first yield
+        assert eng._pool.free_blocks == eng._pool.num_blocks
+        # engine still fully serviceable
+        want = PagedGenerationEngine(m, page_size=8,
+                                     prompt_bucket=8).generate(ids, g)
+        np.testing.assert_array_equal(eng.generate(ids, g), want)
+
+    def test_stream_enforces_max_positions(self):
+        from paddle_infer_tpu.inference.generation import (
+            PagedGenerationEngine)
+
+        m = self._model(seed=6)
+        eng = PagedGenerationEngine(m, page_size=8, prompt_bucket=8)
+        ids = np.zeros((1, 60), np.int32)
+        with pytest.raises(AssertionError, match="max_position"):
+            next(eng.stream(ids, GenerationConfig(max_new_tokens=10)))
