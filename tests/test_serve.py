@@ -1,0 +1,105 @@
+"""HTTP serving front end (tools/serve.py — the paddle_serving-style
+JSON-over-HTTP layer on top of the engines)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.inference.generation import (GenerationConfig,
+                                                   PagedGenerationEngine)
+from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("model") / "gpt")
+    pit.seed(0)
+    m = GPTForCausalLM(GPTConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0))
+    m.eval()
+    m.save_pretrained(d)
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(ROOT, "tools", "serve.py"),
+         "--model_dir", d, "--port", str(port), "--page_size", "8"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    url = f"http://127.0.0.1:{port}"
+    for _ in range(120):
+        try:
+            with urllib.request.urlopen(url + "/health", timeout=2) as r:
+                if json.load(r)["status"] == "ok":
+                    break
+        except Exception:
+            if proc.poll() is not None:
+                raise RuntimeError(proc.stderr.read()[-1500:])
+            time.sleep(1)
+    else:
+        proc.kill()
+        raise RuntimeError("server never became healthy")
+    yield url, m
+    proc.terminate()
+    proc.wait(timeout=30)
+
+
+def _post(url, path, body):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=300)
+
+
+def test_generate_endpoint_matches_engine(server):
+    url, m = server
+    ids = np.random.RandomState(0).randint(0, 96, (2, 8)).astype(np.int32)
+    g = GenerationConfig(max_new_tokens=6)
+    want = PagedGenerationEngine(m, page_size=8).generate(ids, g)
+    with _post(url, "/generate", {"ids": ids.tolist(),
+                                  "max_new_tokens": 6}) as r:
+        got = np.asarray(json.load(r)["tokens"])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_stream_endpoint_chunks_concatenate(server):
+    url, m = server
+    ids = np.random.RandomState(1).randint(0, 96, (1, 8)).astype(np.int32)
+    g = GenerationConfig(max_new_tokens=7)
+    want = PagedGenerationEngine(m, page_size=8).generate(ids, g)
+    with _post(url, "/generate_stream",
+               {"ids": ids.tolist(), "max_new_tokens": 7,
+                "chunk_size": 3}) as r:
+        chunks = [np.asarray(json.loads(line)["tokens"])
+                  for line in r.read().decode().strip().splitlines()]
+    assert len(chunks) >= 2            # prefill token + >=1 decode chunk
+    np.testing.assert_array_equal(np.concatenate(chunks, axis=1), want)
+
+
+def test_bad_request_400(server):
+    url, _ = server
+    try:
+        _post(url, "/generate", {"nope": 1})
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400

@@ -1,0 +1,143 @@
+"""Minimal HTTP serving front end over the generation engines.
+
+Reference context: the fork's deployment story pairs Paddle Inference
+with a serving layer (paddle_serving / fastdeploy) speaking JSON over
+HTTP.  This is the stdlib-only equivalent for this framework: load a
+``save_pretrained`` directory through AutoModel, serve
+
+  POST /generate          {"ids": [[...]], "max_new_tokens": N, ...}
+                          -> {"tokens": [[...]]}
+  POST /generate_stream   same body -> chunked response, one JSON line
+                          per decoded chunk (PagedGenerationEngine.stream)
+  GET  /health            -> {"status": "ok", "model": ...}
+
+Usage:
+  env PYTHONPATH=. python tools/serve.py --model_dir DIR --port 8800
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+_STATE = {"lock": threading.Lock()}
+
+
+def _engine():
+    if "engine" not in _STATE:
+        from paddle_infer_tpu.inference.generation import (
+            PagedGenerationEngine)
+
+        _STATE["engine"] = PagedGenerationEngine(
+            _STATE["model"], page_size=_STATE["page_size"])
+    return _STATE["engine"]
+
+
+def _gen_config(body):
+    from paddle_infer_tpu.inference.generation import GenerationConfig
+
+    kw = {k: body[k] for k in
+          ("max_new_tokens", "min_length", "do_sample", "temperature",
+           "top_k", "top_p", "num_beams", "length_penalty",
+           "repetition_penalty", "eos_token_id", "pad_token_id", "seed")
+          if k in body}
+    return GenerationConfig(**kw)
+
+
+class Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"     # chunked transfer needs >= 1.1
+
+    def log_message(self, fmt, *args):      # quiet
+        pass
+
+    def _json(self, code, obj):
+        payload = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def do_GET(self):
+        if self.path == "/health":
+            self._json(200, {"status": "ok",
+                             "model": type(_STATE["model"]).__name__})
+        else:
+            self._json(404, {"error": "unknown path"})
+
+    def do_POST(self):
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            ids = np.asarray(body["ids"], np.int32)
+            g = _gen_config(body)
+        except Exception as e:
+            self._json(400, {"error": f"bad request: {e!r}"})
+            return
+        headers_sent = False
+
+        def send_chunk(payload: dict):
+            data = (json.dumps(payload) + "\n").encode()
+            self.wfile.write(f"{len(data):x}\r\n".encode())
+            self.wfile.write(data + b"\r\n")
+
+        try:
+            if self.path == "/generate":
+                # the engine mutates shared state (donated pools, page
+                # reservations) — one request at a time
+                with _STATE["lock"]:
+                    toks = _engine().generate(ids, g)
+                self._json(200, {"tokens": np.asarray(toks).tolist()})
+            elif self.path == "/generate_stream":
+                with _STATE["lock"]:
+                    stream = _engine().stream(
+                        ids, g, chunk_size=int(body.get("chunk_size", 8)))
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    headers_sent = True
+                    for chunk in stream:
+                        send_chunk({"tokens": np.asarray(chunk).tolist()})
+                    self.wfile.write(b"0\r\n\r\n")
+            else:
+                self._json(404, {"error": "unknown path"})
+        except Exception as e:
+            try:
+                if headers_sent:
+                    # mid-stream failure: error rides as a final chunk +
+                    # proper terminator (re-sending headers would corrupt
+                    # the chunked body)
+                    send_chunk({"error": repr(e)[:400]})
+                    self.wfile.write(b"0\r\n\r\n")
+                else:
+                    self._json(500, {"error": repr(e)[:400]})
+            except Exception:
+                pass
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model_dir", required=True,
+                    help="save_pretrained directory (AutoModel-loadable)")
+    ap.add_argument("--port", type=int, default=8800)
+    ap.add_argument("--page_size", type=int, default=16)
+    args = ap.parse_args(argv)
+
+    from paddle_infer_tpu.models import AutoModel
+
+    _STATE["model"] = AutoModel.from_pretrained(args.model_dir)
+    _STATE["page_size"] = args.page_size
+    server = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
+    print(f"serving {type(_STATE['model']).__name__} on "
+          f"127.0.0.1:{args.port}", flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
