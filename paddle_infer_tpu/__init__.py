@@ -86,6 +86,22 @@ from .framework.io import save_state_dict, load_state_dict
 
 import paddle_infer_tpu.distributed as distributed  # noqa: F401
 from . import parallel  # noqa: F401
+from .distributed.data_parallel import DataParallel  # noqa: F401
+
+# --- top-level compat surface (reference paddle/__init__.py __all__) ---
+from .framework.compat import (  # noqa: F401
+    dtype, iinfo, finfo, Place, TPUPlace, CPUPlace, CUDAPlace,
+    CUDAPinnedPlace, NPUPlace, XPUPlace, create_parameter, LazyGuard,
+    is_tensor, is_complex, is_integer, is_floating_point, is_empty,
+    is_grad_enabled, shape, rank, tolist, broadcast_shape, check_shape,
+    get_cuda_rng_state, set_cuda_rng_state, set_printoptions,
+    disable_signal_handler)
+from .framework import compat as _compat
+from .nn import ParamAttr  # noqa: F401
+
+globals().update(_compat._install_inplace())   # tanh_, reshape_, ...
+globals()["bool"] = bool_                       # paddle.bool dtype alias
+from .ops import reverse, floor_mod  # noqa: F401  (aliases below)
 
 
 class version:

@@ -164,6 +164,15 @@ class Tensor:
         return Tensor(self._data, stop_gradient=True, name=self.name)
 
     # ------------------------------------------------------------- mutation
+    def _rebind(self, out: "Tensor") -> "Tensor":
+        """Adopt another tensor's payload AND autograd producer — the one
+        implementation behind every public in-place (`op_`) variant (the
+        reference mutates buffers; XLA ops are functional, so in-place =
+        compute + rebind this Python handle)."""
+        self._data = out._data
+        self._grad_node = out._grad_node
+        return self
+
     def set_value(self, value):
         """In-place replace the payload (used by optimizers / load)."""
         if isinstance(value, Tensor):

@@ -14,8 +14,8 @@ from .core.dispatch import dispatch as D, register_op, register_vjp_grad
 from .core.tensor import Tensor
 
 __all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
-           "fft2", "ifft2", "rfft2", "irfft2",
-           "fftn", "ifftn", "rfftn", "irfftn",
+           "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+           "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
            "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
 
 
@@ -45,6 +45,33 @@ _reg_n("fft2", jnp.fft.fft2)
 _reg_n("ifft2", jnp.fft.ifft2)
 _reg_n("rfft2", jnp.fft.rfft2)
 _reg_n("irfft2", jnp.fft.irfft2)
+def _hfftn_impl(x, s=None, axes=None, norm="backward"):
+    """Hermitian-symmetric n-D FFT (reference python/paddle/fft.py:775):
+    real-spectrum transform on the LAST axis (hfft), plain complex FFT on
+    the rest.  Per-axis norm factors compose multiplicatively, so chaining
+    the two jnp transforms carries the norm correctly."""
+    axes = tuple(axes) if axes is not None else tuple(range(x.ndim))
+    lead, last = axes[:-1], axes[-1]
+    n_last = s[-1] if s is not None else None
+    if lead:
+        x = jnp.fft.fftn(x, s=tuple(s[:-1]) if s is not None else None,
+                         axes=lead, norm=norm)
+    return jnp.fft.hfft(x, n=n_last, axis=last, norm=norm)
+
+
+def _ihfftn_impl(x, s=None, axes=None, norm="backward"):
+    axes = tuple(axes) if axes is not None else tuple(range(x.ndim))
+    lead, last = axes[:-1], axes[-1]
+    n_last = s[-1] if s is not None else None
+    out = jnp.fft.ihfft(x, n=n_last, axis=last, norm=norm)
+    if lead:
+        out = jnp.fft.ifftn(out, s=tuple(s[:-1]) if s is not None else None,
+                            axes=lead, norm=norm)
+    return out
+
+
+_reg_n("hfftn", _hfftn_impl)
+_reg_n("ihfftn", _ihfftn_impl)
 _reg_n("fftn", jnp.fft.fftn)
 _reg_n("ifftn", jnp.fft.ifftn)
 _reg_n("rfftn", jnp.fft.rfftn)
@@ -93,6 +120,22 @@ def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
 
 def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
     return D("irfft2", x, s=_tup(s), axes=_tup(axes), norm=norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return D("hfftn", x, s=_tup(s), axes=_tup(axes), norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return D("ihfftn", x, s=_tup(s), axes=_tup(axes), norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return D("hfftn", x, s=_tup(s), axes=_tup(axes), norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return D("ihfftn", x, s=_tup(s), axes=_tup(axes), norm=norm)
 
 
 def fftn(x, s=None, axes=None, norm="backward", name=None):

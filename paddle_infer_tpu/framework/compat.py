@@ -1,0 +1,272 @@
+"""Top-level API compat surface: the reference ``paddle.*`` names that are
+framework plumbing rather than math ops — dtype objects, Place classes,
+ParamAttr/create_parameter, predicates, RNG state, print options, and the
+in-place (`op_`) function variants.
+
+Reference anchors: python/paddle/__init__.py __all__;
+python/paddle/framework/dtype.py (iinfo/finfo); python/paddle/fluid/core
+Place types; python/paddle/tensor/creation.py create_parameter.
+
+TPU notes: Places exist for migration compatibility — there is one device
+backend (XLA/PJRT), so ``CUDAPlace(0)`` maps to the accelerator device the
+way the reference maps it to GPU 0.  In-place variants rebind the Python
+tensor's buffer (functional under the hood — XLA has no aliased mutation
+at the op level; donation handles true in-place at the executable level).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import dtype as _dtypes
+from ..core import random as _prandom
+from ..core.autograd import grad_enabled
+from ..core.tensor import Tensor
+
+
+class dtype:
+    """``paddle.dtype`` callable: dtype('float32') -> canonical dtype."""
+
+    def __new__(cls, name):
+        return _dtypes.convert_dtype(name)
+
+
+class iinfo:
+    """reference paddle.iinfo (framework/dtype.py): integer type limits."""
+
+    def __init__(self, dt):
+        info = np.iinfo(_dtypes.convert_dtype(dt))
+        self.min, self.max = int(info.min), int(info.max)
+        self.bits = info.bits
+        self.dtype = str(info.dtype)
+
+
+class finfo:
+    """reference paddle.finfo: floating type limits (bfloat16 included)."""
+
+    def __init__(self, dt):
+        import jax.numpy as jnp
+
+        info = jnp.finfo(_dtypes.convert_dtype(dt))
+        self.min, self.max = float(info.min), float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+        self.bits = info.bits
+        self.dtype = str(info.dtype)
+
+
+# ------------------------------------------------------------------ Places
+class Place:
+    """Base device descriptor (reference phi::Place)."""
+
+    _kind = "tpu"
+
+    def __init__(self, device_id=0):
+        self._id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self._kind}:{self._id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place) and self._kind == other._kind
+                and self._id == other._id)
+
+    def __hash__(self):
+        return hash((self._kind, self._id))
+
+
+class TPUPlace(Place):
+    _kind = "tpu"
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class CUDAPlace(Place):
+    """Migration compat: the accelerator place. On this framework the
+    accelerator is the TPU; device_id indexes jax.devices()."""
+
+    _kind = "tpu"
+
+
+class CUDAPinnedPlace(Place):
+    _kind = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+
+class NPUPlace(Place):
+    _kind = "tpu"
+
+
+class XPUPlace(Place):
+    _kind = "tpu"
+
+
+# --------------------------------------------------------------- parameters
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference paddle.create_parameter (tensor/creation.py): a trainable
+    Parameter, default-initialized Xavier-uniform (zeros for bias)."""
+    from ..core.tensor import Parameter
+    from .. import nn
+
+    dt = _dtypes.convert_dtype(dtype)
+    shape = tuple(int(s) for s in shape)
+    if default_initializer is not None:
+        init = default_initializer
+    elif is_bias:
+        init = nn.initializer.Constant(0.0)
+    else:
+        init = nn.initializer.XavierUniform()
+    data = init(shape, dt)
+    return Parameter(data._data if isinstance(data, Tensor) else data,
+                     name=name)
+
+
+class LazyGuard:
+    """reference paddle.LazyGuard (fluid/lazy_init.py): delay parameter
+    materialization.  Here parameter init is already lazy-cheap (host
+    numpy until first device use), so the guard is a pure scope marker."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# -------------------------------------------------------------- predicates
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return np.issubdtype(np.dtype(x.dtype), np.complexfloating)
+
+
+def is_integer(x):
+    return np.issubdtype(np.dtype(x.dtype), np.integer)
+
+
+def is_floating_point(x):
+    return np.issubdtype(np.dtype(x.dtype), np.floating) or \
+        str(x.dtype) == "bfloat16"
+
+
+def is_empty(x):
+    from .. import to_tensor
+
+    return to_tensor(x.size == 0)
+
+
+def is_grad_enabled():
+    return grad_enabled()
+
+
+# ----------------------------------------------------------- shape helpers
+def shape(x):
+    """reference paddle.shape: the shape as an int32 tensor."""
+    from .. import to_tensor
+
+    return to_tensor(np.asarray(x.shape, np.int32))
+
+
+def rank(x):
+    from .. import to_tensor
+
+    return to_tensor(np.asarray(x.ndim, np.int32))
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def check_shape(shape):
+    """reference utils layer check: shapes must be ints with at most one
+    inferred (-1) dim."""
+    shape = list(shape)
+    if sum(1 for s in shape if int(s) == -1) > 1:
+        raise ValueError(f"shape can carry at most one -1 dim, got {shape}")
+    return shape
+
+
+# ------------------------------------------------------------- RNG / misc
+def get_cuda_rng_state():
+    """Migration compat: the accelerator RNG state (here the global JAX
+    key state — reference returns per-GPU generator states)."""
+    return _prandom.get_state()
+
+
+def set_cuda_rng_state(state):
+    _prandom.set_state(state)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr prints via numpy, so numpy's printoptions are the
+    single source of truth (reference tensor/to_string.py keeps its own)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """reference installs/uninstalls C++ fault handlers; no native signal
+    handlers are installed here, so this is a documented no-op."""
+
+
+# --------------------------------------------------------------- in-place
+def _inplace(op_name):
+    """The reference's `op_` variants mutate the tensor. XLA ops are
+    functional, so compute then ``Tensor._rebind`` this handle."""
+
+    def fn(self, *args, **kwargs):
+        from ..core.dispatch import dispatch as D
+
+        return self._rebind(D(op_name, self, *args, **kwargs))
+
+    fn.__name__ = op_name + "_"
+    return fn
+
+
+_INPLACE_OPS = ["tanh", "squeeze", "unsqueeze", "scatter", "index_add",
+                "clip", "scale", "flatten", "exp", "sqrt", "rsqrt",
+                "reciprocal", "round", "floor", "ceil", "subtract", "add"]
+
+
+def _install_inplace():
+    installed = {}
+    for name in _INPLACE_OPS:
+        m = _inplace(name)
+        setattr(Tensor, name + "_", m)
+        installed[name + "_"] = m
+
+    def reshape_(self, shape):
+        return self._rebind(self.reshape(shape))
+
+    Tensor.reshape_ = reshape_
+    installed["reshape_"] = reshape_
+    # top-level function forms: paddle.tanh_(x) etc.
+    fns = {}
+    for name, meth in installed.items():
+        fns[name] = (lambda m: lambda x, *a, **k: m(x, *a, **k))(meth)
+    return fns

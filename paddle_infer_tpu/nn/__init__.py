@@ -2,6 +2,7 @@
 (reference: python/paddle/nn/)."""
 from .layer import Layer
 from .layers_extra import *  # noqa: F401,F403
+from .layers_parity import *  # noqa: F401,F403
 from . import utils  # noqa: F401
 from . import functional
 from . import initializer

@@ -169,7 +169,12 @@ def _t(v):
 # pooling ------------------------------------------------------------------
 
 
-def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False):
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False):
+    if return_mask:
+        return D("max_pool_with_index", x, kernel_size=_t(kernel_size),
+                 stride=_t(stride), padding=_t(padding),
+                 ceil_mode=ceil_mode)
     return D("max_pool2d", x, kernel_size=_t(kernel_size),
              stride=_t(stride), padding=_t(padding), ceil_mode=ceil_mode)
 
@@ -543,7 +548,11 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k=None,
 
 # ---- round-3 nD / misc batch (reference nn/functional/*)
 
-def max_pool1d(x, kernel_size, stride=None, padding=0):
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False):
+    if return_mask:
+        return D("max_pool_with_index", x, kernel_size=_t(kernel_size),
+                 stride=_t(stride) if stride is not None else None,
+                 padding=_t(padding))
     return D("max_pool1d", x, kernel_size=_t(kernel_size),
              stride=_t(stride) if stride is not None else None,
              padding=_t(padding))
@@ -555,7 +564,11 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0):
              padding=_t(padding))
 
 
-def max_pool3d(x, kernel_size, stride=None, padding=0):
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False):
+    if return_mask:
+        return D("max_pool_with_index", x, kernel_size=_t(kernel_size),
+                 stride=_t(stride) if stride is not None else None,
+                 padding=_t(padding))
     return D("max_pool3d", x, kernel_size=_t(kernel_size),
              stride=_t(stride) if stride is not None else None,
              padding=_t(padding))
@@ -726,3 +739,226 @@ def warpctc(*args, **kwargs):
     """Alias of ctc_loss (reference warpctc_op wraps warp-ctc; here one
     compiled lax.scan op serves both names)."""
     return ctc_loss(*args, **kwargs)
+
+
+# ---- round-4 public-API parity batch (ops/nn_parity.py) ------------------
+
+def adaptive_avg_pool1d(x, output_size):
+    return D("adaptive_avg_pool1d", x, output_size=(
+        output_size if isinstance(output_size, int) else output_size[0],))
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    size = (output_size if isinstance(output_size, int)
+            else output_size[0],)
+    if return_mask:
+        return D("adaptive_max_pool1d_with_index", x, output_size=size)
+    return D("adaptive_max_pool1d", x, output_size=size)
+
+
+def adaptive_avg_pool3d(x, output_size):
+    return D("adaptive_avg_pool3d", x, output_size=_t3(output_size))
+
+
+def adaptive_max_pool3d(x, output_size):
+    return D("adaptive_max_pool3d", x, output_size=_t3(output_size))
+
+
+def _t3(v):
+    from ...ops.nn_parity import _nd_tuple
+
+    return _nd_tuple(v, 3)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL"):
+    k = kernel_size[0] if isinstance(kernel_size, (list, tuple)) \
+        else kernel_size
+    s = stride[0] if isinstance(stride, (list, tuple)) else (stride or k)
+    p = padding[0] if isinstance(padding, (list, tuple)) else padding
+    l_out = output_size[-1] if output_size else _unpool_len(
+        x.shape[-1], k, s, p, 0)
+    return D("max_unpool", x, indices, output_size=(l_out,))
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW"):
+    k = _pair2(kernel_size)
+    s = _pair2(stride or kernel_size)
+    p = _pair2(padding)
+    if output_size:
+        hw = tuple(output_size[-2:])
+    else:
+        hw = (_unpool_len(x.shape[2], k[0], s[0], p[0], 0),
+              _unpool_len(x.shape[3], k[1], s[1], p[1], 1))
+    return D("max_unpool", x, indices, output_size=hw)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW"):
+    k = _t3(kernel_size)
+    s = _t3(stride or kernel_size)
+    p = _t3(padding)
+    if output_size:
+        sp = tuple(output_size[-3:])
+    else:
+        sp = tuple(_unpool_len(x.shape[2 + i], k[i], s[i], p[i], i)
+                   for i in range(3))
+    return D("max_unpool", x, indices, output_size=sp)
+
+
+def _pair2(v):
+    from ...ops.nn_parity import _nd_tuple
+
+    return _nd_tuple(v, 2)
+
+
+def _unpool_len(l_in, k, s, p, _i):
+    # inverse of the pool output formula (reference unpooling.h)
+    return (l_in - 1) * s - 2 * p + k
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    return D("pairwise_distance", x, y, p=float(p),
+             epsilon=float(epsilon), keepdim=keepdim)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    if not training or p == 0.0:
+        return x
+    import jax
+
+    mask = Tensor(jax.random.bernoulli(prandom.next_key(), 1.0 - p,
+                                       tuple(x.shape)))
+    return D("alpha_dropout", x, mask, p=float(p))
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    if not training or p == 0.0:
+        return x
+    key_t = Tensor(prandom.next_key())
+    # channel-wise mask: broadcast over the spatial dims of the layout
+    bcast = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
+    return D("dropout", x, key_t, p=float(p), upscale=True,
+             bcast_dims=bcast)
+
+
+def zeropad2d(x, padding, data_format="NCHW"):
+    return pad(x, list(padding), mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    return D("bilinear", x1, x2, weight, bias)
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    if data_format == "NHWC":
+        x = D("transpose", x, perm=(0, 3, 1, 2))
+        out = D("channel_shuffle", x, groups=int(groups))
+        return D("transpose", out, perm=(0, 2, 3, 1))
+    return D("channel_shuffle", x, groups=int(groups))
+
+
+def rrelu(x, lower=1. / 8., upper=1. / 3., training=False):
+    if not training:
+        return D("rrelu_eval", x, lower=float(lower), upper=float(upper))
+    import jax
+
+    slope = Tensor(jax.random.uniform(
+        prandom.next_key(), tuple(x.shape),
+        minval=float(lower), maxval=float(upper)))
+    return D("rrelu_train", x, slope)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False):
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) is not supported; "
+            "the default complete-binary-tree path is")
+    return D("hsigmoid_loss", input, label, weight, bias,
+             num_classes=int(num_classes))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    return D("multi_label_soft_margin_loss", input, label, weight,
+             reduction=reduction)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    return D("npair_loss", anchor, positive, labels, l2_reg=float(l2_reg))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    out = D("margin_cross_entropy", logits, label, margin1=float(margin1),
+            margin2=float(margin2), margin3=float(margin3),
+            scale=float(scale), return_softmax=return_softmax)
+    loss = out[0] if return_softmax else out
+    loss = _reduce_loss(loss, reduction)
+    return (loss, out[1]) if return_softmax else loss
+
+
+def triplet_margin_with_distance_loss(anchor, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean"):
+    if distance_function is not None:
+        d_ap = distance_function(anchor, positive)
+        d_an = distance_function(anchor, negative)
+        if swap:
+            d_pn = distance_function(positive, negative)
+            d_an = D("minimum", d_an, d_pn)
+        zero = D("multiply", d_ap, 0.0)
+        loss = D("maximum", D("add", D("subtract", d_ap, d_an), margin),
+                 zero)
+        return _reduce_loss(loss, reduction)
+    return D("triplet_margin_with_distance_loss", anchor, positive,
+             negative, margin=float(margin), swap=swap,
+             reduction=reduction)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    return D("class_center_sample", label, num_classes=int(num_classes),
+             num_samples=int(num_samples))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None):
+    return D("sparse_attention", query, key, value, sparse_csr_offset,
+             sparse_csr_columns)
+
+
+def gather_tree(ids, parents):
+    return D("gather_tree", ids, parents)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    from ... import sequence as _seq
+
+    return _seq.sequence_mask(x, maxlen=maxlen, dtype=dtype)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    return D("diag_embed", input, offset=offset, dim1=dim1, dim2=dim2)
+
+
+def _make_inplace(fn, name):
+    """In-place functional variant: compute, then Tensor._rebind — the
+    shared implementation the `op_` Tensor methods use too."""
+
+    def wrapper(x, *args, **kwargs):
+        return x._rebind(fn(x, *args, **kwargs))
+
+    wrapper.__name__ = name
+    return wrapper
+
+
+relu_ = _make_inplace(lambda x: D("relu", x), "relu_")
+tanh_ = _make_inplace(lambda x: D("tanh", x), "tanh_")
+elu_ = _make_inplace(lambda x, alpha=1.0: elu(x, alpha), "elu_")
+softmax_ = _make_inplace(lambda x, axis=-1: softmax(x, axis=axis),
+                         "softmax_")

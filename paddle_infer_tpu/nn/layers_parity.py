@@ -1,0 +1,373 @@
+"""Round-4 public-API parity layer batch (reference python/paddle/nn/:
+pooling.py Adaptive*Pool{1,3}D + MaxUnPool*, norm.py InstanceNorm3D,
+vision.py UpsamplingNearest2D/ChannelShuffle, activation.py
+Softmax2D/RReLU, container.py LayerDict, loss.py HSigmoidLoss/
+MultiLabelSoftMarginLoss/TripletMarginWithDistanceLoss, rnn.py
+RNNCellBase/BiRNN, decode.py BeamSearchDecoder/dynamic_decode).
+
+Forwards are thin dispatches onto registry ops (ops/nn_parity.py), so
+they trace into fleet/jit/IR programs like every layer.  The decode pair
+is the seq2seq serving API: dynamic_decode drives any Decoder's
+initialize/step/finalize; BeamSearchDecoder's per-step search reuses the
+fused ``beam_search_softmax`` op (ops/parity.py — the fork's fused decode
+top-k, beam_search_softmax.cu).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import dispatch as D
+from ..core.tensor import Tensor
+from . import functional as F
+from .layer import Layer
+from .layers_common import InstanceNorm2D
+from .rnn import _RNNCellBase as RNNCellBase
+
+__all__ = [
+    "AdaptiveAvgPool1D", "AdaptiveAvgPool3D", "AdaptiveMaxPool1D",
+    "AdaptiveMaxPool3D", "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+    "InstanceNorm3D", "UpsamplingNearest2D", "Softmax2D", "ChannelShuffle",
+    "RReLU", "LayerDict", "HSigmoidLoss", "MultiLabelSoftMarginLoss",
+    "TripletMarginWithDistanceLoss", "RNNCellBase", "BiRNN",
+    "BeamSearchDecoder", "dynamic_decode", "Decoder",
+]
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self.output_size,
+                                     self.return_mask)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self.output_size)
+
+
+class _MaxUnPoolND(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format=None):
+        super().__init__()
+        self.kernel_size, self.stride = kernel_size, stride
+        self.padding, self.output_size = padding, output_size
+
+    def forward(self, x, indices):
+        return self._fn(x, indices, self.kernel_size, self.stride,
+                        self.padding, self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPoolND):
+    _fn = staticmethod(F.max_unpool1d)
+
+
+class MaxUnPool2D(_MaxUnPoolND):
+    _fn = staticmethod(F.max_unpool2d)
+
+
+class MaxUnPool3D(_MaxUnPoolND):
+    _fn = staticmethod(F.max_unpool3d)
+
+
+class InstanceNorm3D(InstanceNorm2D):
+    """Same per-instance, per-channel normalization; instance_norm
+    reduces over all trailing spatial dims, so rank-5 input just works."""
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor,
+                             mode="nearest")
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel axis of NCHW/CHW input (reference
+    activation.py Softmax2D: softmax at each spatial location)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW"):
+        super().__init__()
+        self.groups = groups
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1. / 8., upper=1. / 3.):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class LayerDict(Layer):
+    """Dict container (reference container.py LayerDict): ordered mapping
+    of name -> sublayer with dict surface."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(str(key), layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        layer = self._sub_layers[key]
+        del self._sub_layers[key]
+        return layer
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        items = sublayers.items() if hasattr(sublayers, "items") \
+            else sublayers
+        for key, layer in items:
+            self.add_sublayer(str(key), layer)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "custom-tree hsigmoid is not supported; the default "
+                "complete-binary-tree path is")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr)
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (num_classes - 1, 1), attr=bias_attr, is_bias=True))
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean"):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean"):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, anchor, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            anchor, positive, negative, self.distance_function,
+            self.margin, self.swap, self.reduction)
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two cells (reference rnn.py BiRNN):
+    forward and reverse passes concatenated on the feature axis."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        from .rnn import RNN
+
+        self.cell_fw, self.cell_bw = cell_fw, cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, fin_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_bw, fin_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        out = D("concat", out_fw, out_bw, axis=-1)
+        return out, (fin_fw, fin_bw)
+
+
+# --------------------------------------------------------------- decode
+class Decoder:
+    """Abstract decode-step interface (reference decode.py Decoder,
+    specialized to this driver's state split: cell states vs search
+    state ride separately so SPMD shardings can differ).
+
+    ``dynamic_decode`` calls exactly these signatures:
+      initialize(inits) -> (inputs, cell_states, search_state)
+      step(time, inputs, cell_states, search_state, **kwargs)
+          -> (next_inputs, next_cell_states, next_search_state)
+      finalize(step_outputs, search_state) -> result
+    where search_state[1] must be a bool "finished" array (the driver's
+    stop condition)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, search_state, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, step_outputs, search_state):
+        raise NotImplementedError
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search decode driver over any RNN cell (reference decode.py
+    BeamSearchDecoder).  Per-step scoring runs the fused
+    ``beam_search_softmax`` op; states are kept beam-major [B*W, ...] and
+    reordered by the winning beams' source indices each step."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token, self.end_token = int(start_token), int(end_token)
+        self.beam_size = int(beam_size)
+
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*W, ...] (reference helper of the same name)."""
+        d = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        return Tensor(jnp.repeat(d, beam_size, axis=0))
+
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda s: jnp.repeat(
+                s._data if isinstance(s, Tensor) else jnp.asarray(s),
+                self.beam_size, axis=0),
+            initial_cell_states)
+        first = states
+        while isinstance(first, (list, tuple)):
+            first = first[0]
+        bw = first.shape[0]
+        b = bw // self.beam_size
+        tok = jnp.full((b, self.beam_size), self.start_token, jnp.int32)
+        cum = jnp.where(jnp.arange(self.beam_size)[None, :] == 0,
+                        0.0, -1e9) * jnp.ones((b, 1))
+        fin = jnp.zeros((b, self.beam_size), bool)
+        return tok, states, (cum, fin)
+
+    def step(self, time, tok, states, search_state, **kwargs):
+        cum, fin = search_state
+        b, w = tok.shape
+        ids = Tensor(tok.reshape(-1))
+        inp = self.embedding_fn(ids) if self.embedding_fn else ids
+        out, next_states = self.cell(inp, states)
+        logits = self.output_fn(out) if self.output_fn else out
+        nxt, src, cum, fin = (t._data for t in D(
+            "beam_search_softmax", logits, Tensor(cum), Tensor(fin),
+            num_beams=w, eos_token_id=self.end_token,
+            pad_token_id=self.end_token))
+
+        def reorder(s):
+            d = s._data if isinstance(s, Tensor) else s
+            d = d.reshape((b, w) + d.shape[1:])
+            d = jnp.take_along_axis(
+                d, src.reshape((b, w) + (1,) * (d.ndim - 2)), axis=1)
+            return d.reshape((b * w,) + d.shape[2:])
+
+        next_states = jax.tree_util.tree_map(reorder, next_states)
+        # outputs carry (token, source beam) — finalize backtracks with
+        # them; without the parent chain, reordered beams would splice
+        # tokens from different ancestries
+        return (nxt, src), next_states, (cum, fin)
+
+    def finalize(self, step_outputs, search_state):
+        """Backtrack the beam ancestry (gather_tree, the reference
+        gather_tree_op) and return the best beam per batch."""
+        cum, fin = search_state
+        ids = jnp.stack([t for t, _ in step_outputs], axis=0)  # [T,B,W]
+        parents = jnp.stack([s for _, s in step_outputs], axis=0)
+        full = D("gather_tree", Tensor(ids), Tensor(parents))._data
+        toks = jnp.transpose(full, (1, 2, 0))           # [B, W, T]
+        best = jnp.argmax(cum, axis=1)                  # [B]
+        return (Tensor(jnp.take_along_axis(
+            toks, best[:, None, None], axis=1)[:, 0]), Tensor(cum))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, **kwargs):
+    """Drive a Decoder until all beams finish or max_step_num (reference
+    decode.py dynamic_decode).  Eager step loop — each step's cell call
+    is itself a cached compiled op program."""
+    inputs, states, search = decoder.initialize(inits)
+    steps = []
+    for t in range(int(max_step_num or 32)):
+        out, states, search = decoder.step(t, inputs, states, search,
+                                           **kwargs)
+        steps.append(out)
+        # next inputs: the step's token output (first element if the
+        # decoder emits an output tuple, e.g. (token, source-beam))
+        inputs = out[0] if isinstance(out, tuple) else out
+        if bool(jnp.all(search[1])):
+            break
+    return decoder.finalize(steps, search)

@@ -20,6 +20,8 @@ from . import distributed as _dist_ops  # noqa: F401
 from . import attention as _attention   # noqa: F401
 from . import breadth_r4 as _breadth_r4  # noqa: F401
 from . import rnn as _rnn_ops            # noqa: F401
+from . import parity as _parity          # noqa: F401
+from . import nn_parity as _nn_parity    # noqa: F401
 
 from .creation import *                 # noqa: F401,F403
 from .linalg import einsum              # noqa: F401
@@ -197,6 +199,17 @@ _EXPORTS = [
     "isclose", "allclose", "kthvalue", "mode", "index_sample",
     "strided_slice", "broadcast_tensors", "p_norm", "poisson",
     "gather_tree",
+    # round-4 public-API parity sweep (ops/parity.py + existing registry
+    # ops that had no module-level export)
+    "acos", "acosh", "asin", "asinh", "atan", "atanh", "atan2", "sinh",
+    "cosh", "expm1", "log1p", "log2", "log10", "neg", "reciprocal",
+    "trunc", "lgamma", "digamma", "erfinv", "logit", "stanh", "remainder",
+    "amax", "amin", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "bitwise_not", "logical_xor", "fmax", "fmin", "count_nonzero",
+    "quantile", "diagonal", "moveaxis", "mv", "slice", "as_real",
+    "add_n", "complex", "as_complex", "sgn", "dist", "equal_all",
+    "expand_as", "increment", "take", "crop", "shard_index", "nonzero",
+    "beam_search_softmax",
 ]
 
 globals().update({name: _fn(name) for name in _EXPORTS})
@@ -204,6 +217,34 @@ globals().update({name: _fn(name) for name in _EXPORTS})
 
 from .breadth_r4 import (edit_distance, unbind,  # noqa: F401,E402
                          unique_consecutive)
+from .parity import (logspace, tril_indices, triu_indices,  # noqa: F401,E402
+                     randint_like, standard_normal)
+
+
+def crop(x, shape, offsets=None):
+    """Public positional form (reference paddle.crop(x, shape, offsets));
+    shape/offsets are static attrs, not operands."""
+    shape = tuple(int(s) for s in shape)
+    offsets = tuple(int(o) for o in (offsets or [0] * len(shape)))
+    return D("crop", x, shape=shape, offsets=offsets)
+
+
+def dist(x, y, p=2):
+    return D("dist", x, y, p=float(p))
+
+
+def increment(x, value=1.0):
+    return D("increment", x, value=float(value))
+
+
+def reverse(x, axis):
+    """reference paddle.reverse == flip (tensor/manipulation.py)."""
+    axis = [axis] if isinstance(axis, int) else list(axis)
+    return D("flip", x, axis=axis)
+
+
+def floor_mod(x, y):
+    return D("mod", x, y)
 
 
 def multiplex(inputs, index):

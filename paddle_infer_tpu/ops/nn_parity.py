@@ -1,0 +1,383 @@
+"""nn.functional parity batch (round 4): the remaining reference
+``paddle.nn.functional`` surface.
+
+Device ops are XLA compositions; the one data-dependent op
+(class_center_sample) is eager host-side like ``unique``.
+
+Reference anchors: python/paddle/nn/functional/{pooling,loss,common}.py;
+margin_cross_entropy from paddle/phi/kernels/gpu/margin_cross_entropy_kernel.cu
+(ArcFace-family margin softmax); sparse_attention from
+paddle/phi/kernels/gpu/sparse_attention_kernel.cu (CSR row layout).
+
+TPU notes: sparse_attention keeps the MXU dense — the CSR layout becomes
+an additive mask built ON DEVICE with a searchsorted row-decode (jittable,
+static nnz), then one fused sdpa; that beats gather-per-row on TPU where
+ragged gathers serialize.  max_unpool scatters through ``.at[].set`` which
+XLA lowers to one scatter kernel.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import defop, register_op, register_vjp_grad
+
+# ------------------------------------------------------------- pooling
+def _nd_tuple(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def _adaptive_windows_nd(x, out_sizes, reduce_fn):
+    """Adaptive windows [floor(i*d/od), ceil((i+1)*d/od)) per spatial dim
+    (same formula as the 2-D version in conv.py, any rank)."""
+    spatial = x.shape[2:]
+    nd = len(spatial)
+
+    def rec(slc, dims_done):
+        if dims_done == nd:
+            return reduce_fn(x[(slice(None), slice(None)) + tuple(slc)],
+                             axis=tuple(range(2, 2 + nd)))
+        d, od = spatial[dims_done], out_sizes[dims_done]
+        parts = []
+        for i in range(od):
+            lo, hi = (i * d) // od, -(-((i + 1) * d) // od)
+            parts.append(rec(slc + [slice(lo, hi)], dims_done + 1))
+        return jnp.stack(parts, axis=2 + dims_done)
+
+    return rec([], 0)
+
+
+def _adaptive_pool_nd(x, output_size, nd, reduce_fn):
+    out = _nd_tuple(output_size, nd)
+    spatial = x.shape[2:]
+    if all(s % o == 0 for s, o in zip(spatial, out)):
+        # exact split: reshape + one fused reduce
+        shape = [x.shape[0], x.shape[1]]
+        red_axes = []
+        for i, (s, o) in enumerate(zip(spatial, out)):
+            shape += [o, s // o]
+            red_axes.append(2 + 2 * i + 1)
+        return reduce_fn(x.reshape(shape), axis=tuple(red_axes))
+    return _adaptive_windows_nd(x, out, reduce_fn)
+
+
+defop("adaptive_avg_pool1d")(
+    lambda x, *, output_size: _adaptive_pool_nd(x, output_size, 1, jnp.mean))
+defop("adaptive_max_pool1d")(
+    lambda x, *, output_size: _adaptive_pool_nd(x, output_size, 1, jnp.max))
+defop("adaptive_avg_pool3d")(
+    lambda x, *, output_size: _adaptive_pool_nd(x, output_size, 3, jnp.mean))
+defop("adaptive_max_pool3d")(
+    lambda x, *, output_size: _adaptive_pool_nd(x, output_size, 3, jnp.max))
+
+
+@register_op("adaptive_max_pool1d_with_index")
+def _adaptive_max_pool1d_with_index(x, output_size):
+    """Adaptive max pool with argmax positions (reference
+    max_pool*_with_index adaptive path): same windows as the value-only
+    op; indices address the input length axis."""
+    ol = output_size[0] if isinstance(output_size, tuple) else output_size
+    l = x.shape[-1]
+    outs, idxs = [], []
+    for i in range(ol):
+        lo, hi = (i * l) // ol, -(-((i + 1) * l) // ol)
+        win = x[..., lo:hi]
+        a = jnp.argmax(win, axis=-1)
+        outs.append(jnp.take_along_axis(win, a[..., None], axis=-1)[..., 0])
+        idxs.append((a + lo).astype(jnp.int32))
+    return jnp.stack(outs, axis=-1), jnp.stack(idxs, axis=-1)
+
+
+register_vjp_grad("adaptive_max_pool1d_with_index")
+
+
+def _pool_out_len(l, k, s, p, ceil_mode=False):
+    if ceil_mode:
+        return -(-(l + 2 * p - k) // s) + 1
+    return (l + 2 * p - k) // s + 1
+
+
+@register_op("max_pool_with_index")
+def _max_pool_with_index(x, kernel_size, stride=None, padding=0,
+                         ceil_mode=False):
+    """max_pool{2,3}d_with_index (reference
+    phi/kernels/funcs/pooling.h MaxPoolWithIndex): returns (out, flat
+    spatial argmax indices).  Patch-extract + one argmax over the window
+    axis — XLA fuses the gather/reduce; indices index the UNPADDED input
+    plane, matching the reference mask semantics."""
+    nd = x.ndim - 2
+    k = _nd_tuple(kernel_size, nd)
+    s = _nd_tuple(stride or kernel_size, nd)
+    p = _nd_tuple(padding, nd)
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    neg = jnp.asarray(-jnp.inf, x.dtype) if jnp.issubdtype(
+        x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    out_sp0 = [_pool_out_len(x.shape[2 + i], k[i], s[i], p[i], ceil_mode)
+               for i in range(nd)]
+    # ceil_mode windows may overhang: pad the right edge to cover the
+    # last window's span ((o-1)*s + k), like the reference's ceil path
+    extra = [max(0, (out_sp0[i] - 1) * s[i] + k[i]
+                 - (x.shape[2 + i] + 2 * p[i])) for i in range(nd)]
+    xp = jnp.pad(x, [(0, 0), (0, 0)] + [(p[i], p[i] + extra[i])
+                                        for i in range(nd)],
+                 constant_values=neg)
+    # flat index of every padded position back into the unpadded plane
+    pos = [jnp.arange(xp.shape[2 + i]) - p[i] for i in range(nd)]
+    flat = jnp.zeros((), jnp.int32)
+    for i in range(nd):
+        sh = [1] * nd
+        sh[i] = -1
+        flat = flat * spatial[i] + jnp.clip(
+            pos[i], 0, spatial[i] - 1).reshape(sh).astype(jnp.int32)
+    out_sp = out_sp0
+    # gather all windows: build index grids per dim
+    win = int(np.prod(k))
+    offs = np.stack(np.meshgrid(*[np.arange(ki) for ki in k],
+                                indexing="ij"), -1).reshape(win, nd)
+    starts = np.stack(np.meshgrid(*[np.arange(o) * si
+                                    for o, si in zip(out_sp, s)],
+                                  indexing="ij"), -1).reshape(-1, nd)
+    # absolute padded coords: [n_out, win, nd]
+    coords = starts[:, None, :] + offs[None, :, :]
+    idx = tuple(jnp.asarray(coords[..., i]) for i in range(nd))
+    vals = xp[(slice(None), slice(None)) + idx]          # [N,C,n_out,win]
+    fl = flat[idx]                                       # [n_out, win]
+    a = jnp.argmax(vals, axis=-1)                        # [N,C,n_out]
+    out = jnp.take_along_axis(vals, a[..., None], axis=-1)[..., 0]
+    ind = fl[jnp.arange(fl.shape[0])[None, None, :], a]
+    return (out.reshape((n, c) + tuple(out_sp)),
+            ind.reshape((n, c) + tuple(out_sp)).astype(jnp.int32))
+
+
+register_vjp_grad("max_pool_with_index")
+
+
+@register_op("max_unpool")
+def _max_unpool(x, indices, output_size):
+    """Scatter pooled values back to their argmax positions (reference
+    phi/kernels/funcs/unpooling.h): one XLA scatter per (N,C) plane."""
+    n, c = x.shape[:2]
+    out_len = int(np.prod(output_size))
+    xf = x.reshape(n, c, -1)
+    inf = indices.reshape(n, c, -1).astype(jnp.int32)
+    out = jnp.zeros((n, c, out_len), x.dtype)
+    bn = jnp.arange(n)[:, None, None]
+    bc = jnp.arange(c)[None, :, None]
+    out = out.at[bn, bc, inf].set(xf)
+    return out.reshape((n, c) + tuple(output_size))
+
+
+register_vjp_grad("max_unpool")
+
+
+# ------------------------------------------------------------ elementwise
+defop("channel_shuffle")(
+    lambda x, *, groups:
+    jnp.swapaxes(x.reshape(x.shape[0], groups, x.shape[1] // groups,
+                           *x.shape[2:]), 1, 2).reshape(x.shape))
+defop("bilinear")(
+    lambda x1, x2, weight, bias=None:
+    jnp.einsum("bi,oij,bj->bo", x1, weight, x2) +
+    (0 if bias is None else bias))
+
+
+@register_op("alpha_dropout", save_inputs=False)
+def _alpha_dropout(x, mask, p):
+    """SELU-preserving dropout (reference nn/functional/common.py
+    alpha_dropout math): dropped units go to alpha' = -alpha*scale, then
+    an affine correction restores mean/variance.  mask True = keep
+    (prob 1-p)."""
+    alpha_p = -1.6732632423543772 * 1.0507009873554805
+    a = ((1 - p) * (1 + p * alpha_p * alpha_p)) ** -0.5
+    b = -a * alpha_p * p
+    return a * jnp.where(mask, x, jnp.asarray(alpha_p, x.dtype)) + b
+
+
+defop("rrelu_eval")(lambda x, *, lower, upper:
+                    jnp.where(x >= 0, x, x * ((lower + upper) / 2.0)))
+defop("rrelu_train")(
+    lambda x, slope: jnp.where(x >= 0, x, x * slope))
+
+
+# --------------------------------------------------------------- losses
+defop("pairwise_distance")(
+    lambda x, y, *, p=2.0, epsilon=1e-6, keepdim=False:
+    _p_norm_last(x - y + epsilon, p, keepdim))
+
+
+def _p_norm_last(d, p, keepdim):
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p), axis=-1,
+                             keepdims=keepdim), 1.0 / p)
+
+
+defop("multi_label_soft_margin_loss")(
+    lambda x, label, weight=None, *, reduction="mean":
+    _reduce(_mlsm(x, label, weight), reduction))
+
+
+def _mlsm(x, label, weight):
+    loss = -(label * jax.nn.log_sigmoid(x)
+             + (1 - label) * jax.nn.log_sigmoid(-x))
+    if weight is not None:
+        loss = loss * weight
+    return jnp.mean(loss, axis=-1)
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+defop("npair_loss")(
+    lambda anchor, positive, labels, *, l2_reg=0.002:
+    _npair(anchor, positive, labels, l2_reg))
+
+
+def _npair(anchor, positive, labels, l2_reg):
+    # reference python/paddle/nn/functional/loss.py npair_loss: softmax CE
+    # over anchor·positiveᵀ with same-label targets + L2 on embeddings
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, axis=1))
+                    + jnp.mean(jnp.sum(positive * positive, axis=1))) * 0.25
+    sim = anchor @ positive.T
+    lab = labels.reshape(-1)
+    tgt = (lab[:, None] == lab[None, :]).astype(sim.dtype)
+    tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(tgt * logp, axis=1))
+    return ce + reg
+
+
+defop("triplet_margin_with_distance_loss")(
+    lambda anchor, positive, negative, *, margin=1.0, swap=False,
+    reduction="mean":
+    _triplet(anchor, positive, negative, margin, swap, reduction))
+
+
+def _triplet(a, p, n, margin, swap, reduction):
+    d_ap = _p_norm_last(a - p, 2.0, False)
+    d_an = _p_norm_last(a - n, 2.0, False)
+    if swap:
+        d_pn = _p_norm_last(p - n, 2.0, False)
+        d_an = jnp.minimum(d_an, d_pn)
+    return _reduce(jnp.maximum(d_ap - d_an + margin, 0.0), reduction)
+
+
+@register_op("hsigmoid_loss")
+def _hsigmoid_loss(x, label, weight, bias=None, *, num_classes):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference phi MatrixBitCodeFunctor: leaf c sits at heap node
+    c + num_classes; ancestors' child-direction bits are the code).
+    Path length is static (ceil(log2 C)), so the whole loss is one
+    batched gather + fused BCE — no per-node host loop."""
+    c = int(num_classes)
+    depth = max(1, math.ceil(math.log2(c)))
+    leaf = label.reshape(-1).astype(jnp.int32) + c      # heap leaf id
+    # ancestors bottom-up: node -> node//2; bit = node % 2
+    nodes, bits = [], []
+    node = leaf
+    for _ in range(depth):
+        bits.append(node % 2)
+        node = node // 2
+        nodes.append(node)
+    nodes = jnp.stack(nodes, axis=1)          # [B, depth] internal ids
+    bits = jnp.stack(bits, axis=1).astype(x.dtype)
+    # internal node i (1-rooted heap) -> weight row i-1; rows beyond
+    # num_classes-1 exist only for non-power-of-2 trees: clamp (their
+    # bits still drive a valid BCE; reference pads the same rows)
+    rows = jnp.clip(nodes - 1, 0, weight.shape[0] - 1)
+    w = weight[rows]                          # [B, depth, F]
+    logit = jnp.einsum("bdf,bf->bd", w, x)
+    if bias is not None:
+        logit = logit + bias.reshape(-1)[rows]
+    # bit=1 -> left/0-class in the reference convention: BCE(sigmoid, bit)
+    loss = -(bits * jax.nn.log_sigmoid(logit)
+             + (1 - bits) * jax.nn.log_sigmoid(-logit))
+    return jnp.sum(loss, axis=1, keepdims=True)
+
+
+register_vjp_grad("hsigmoid_loss")
+
+
+@register_op("margin_cross_entropy")
+def _margin_cross_entropy(logits, label, *, margin1=1.0, margin2=0.5,
+                          margin3=0.0, scale=64.0, return_softmax=False):
+    """ArcFace-family margin softmax (reference
+    margin_cross_entropy_kernel.cu): target-class cosine gets
+    cos(m1·θ + m2) − m3, then scaled softmax CE."""
+    lab = label.reshape(-1)
+    onehot = jax.nn.one_hot(lab, logits.shape[-1], dtype=logits.dtype)
+    cos = jnp.clip(logits, -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    adj = jnp.where(onehot > 0, target, cos) * scale
+    logp = jax.nn.log_softmax(adj, axis=-1)
+    loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+register_vjp_grad("margin_cross_entropy")
+
+
+@register_op("sparse_attention")
+def _sparse_attention(q, k, v, offset, columns):
+    """Block/CSR-sparse attention (reference
+    sparse_attention_kernel.cu: per-row CSR column lists).  TPU design:
+    decode the CSR rows on device (searchsorted over static-nnz arange),
+    build the additive mask, and run ONE dense fused sdpa — the MXU eats
+    the dense matmul; ragged per-row gathers would serialize.
+    q/k/v: [B, H, L, D]; offset: [B, H, L+1]; columns: [B, H, nnz]."""
+    b, h, l, d = q.shape
+    nnz = columns.shape[-1]
+    # row of each nnz entry: searchsorted(offset, j, 'right')-1, batched
+    j = jnp.arange(nnz)
+
+    def row_decode(off):          # off: [L+1]
+        return jnp.searchsorted(off, j, side="right") - 1
+
+    rows = jax.vmap(jax.vmap(row_decode))(offset)        # [B,H,nnz]
+    mask = jnp.zeros((b, h, l, l), jnp.bool_)
+    bb = jnp.arange(b)[:, None, None]
+    hh = jnp.arange(h)[None, :, None]
+    mask = mask.at[bb, hh, rows, columns.astype(jnp.int32)].set(True)
+    scores = jnp.einsum("bhld,bhmd->bhlm", q, k) / math.sqrt(d)
+    scores = jnp.where(mask, scores, jnp.asarray(-1e9, scores.dtype))
+    p = jax.nn.softmax(scores, axis=-1)
+    # fully-masked rows (empty CSR rows) must output 0, not uniform
+    p = jnp.where(jnp.any(mask, axis=-1, keepdims=True), p, 0.0)
+    return jnp.einsum("bhlm,bhmd->bhld", p, v)
+
+
+register_vjp_grad("sparse_attention")
+
+
+# ------------------------------------------ data-dependent host-side op
+@register_op("class_center_sample", save_inputs=False, jit=False)
+def _class_center_sample(label, num_classes, num_samples, seed=None):
+    """Sample negative class centers (reference
+    class_center_sample_kernel.cu): keep all positive classes, fill up to
+    num_samples with uniform negatives, remap labels.  Output size is
+    data-dependent -> eager host op like ``unique``."""
+    lab = np.asarray(label).reshape(-1)
+    pos = np.unique(lab)
+    rng = np.random.default_rng(seed)
+    if len(pos) < num_samples:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+        extra = rng.choice(neg_pool, size=num_samples - len(pos),
+                           replace=False)
+        sampled = np.concatenate([pos, np.sort(extra)])
+    else:
+        sampled = pos
+    remap = np.full((num_classes,), -1, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (jnp.asarray(remap[lab]), jnp.asarray(sampled))
