@@ -168,8 +168,9 @@ def disable_static():
 
 def enable_static():
     raise NotImplementedError(
-        "paddle_infer_tpu has no legacy static mode; use paddle_infer_tpu.jit.to_static "
-        "(trace-and-compile) which subsumes it.")
+        "paddle_infer_tpu has no global static mode switch; build programs "
+        "inside static.program_guard (record-eagerly/run-compiled) or use "
+        "jit.to_static — both compile to single XLA executables.")
 
 
 def summary(layer, input_size=None):

@@ -50,5 +50,14 @@ def __getattr__(name):
         from ..parallel import sparse_table
 
         return getattr(sparse_table, name)
+    if name in ("is_initialized", "destroy_process_group", "get_group",
+                "ParallelMode", "alltoall_single", "isend", "irecv",
+                "all_gather_object", "gloo_init_parallel_env",
+                "gloo_barrier", "gloo_release", "split",
+                "ProbabilityEntry", "CountFilterEntry", "ShowClickEntry",
+                "InMemoryDataset", "QueueDataset"):
+        from . import compat
+
+        return getattr(compat, name)
     raise AttributeError(f"module 'paddle_infer_tpu.distributed' has no "
                          f"attribute '{name}'")

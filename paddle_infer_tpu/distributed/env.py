@@ -76,3 +76,23 @@ class ParallelEnv:
     @property
     def local_rank(self):
         return get_rank()
+
+
+def is_initialized() -> bool:
+    """Whether init_parallel_env has run (reference
+    collective.py is_initialized)."""
+    return _initialized
+
+
+def shutdown():
+    """Tear down the jax.distributed client (reference
+    destroy_process_group's store release); idempotent."""
+    global _initialized
+    if not _initialized:
+        return
+    try:
+        if jax.process_count() > 1:
+            jax.distributed.shutdown()
+    except Exception:
+        pass
+    _initialized = False

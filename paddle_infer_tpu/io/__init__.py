@@ -12,10 +12,12 @@ from .dataset import Dataset, IterableDataset, TensorDataset, Subset, \
 from .sampler import (Sampler, SequenceSampler, RandomSampler, BatchSampler,
                       DistributedBatchSampler, WeightedRandomSampler)
 from .dataloader import DataLoader, default_collate_fn
+from .worker import WorkerInfo, get_worker_info
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "Subset", "ComposeDataset",
     "ChainDataset", "random_split", "Sampler", "SequenceSampler",
     "RandomSampler", "BatchSampler", "DistributedBatchSampler",
     "WeightedRandomSampler", "DataLoader", "default_collate_fn",
+    "WorkerInfo", "get_worker_info",
 ]

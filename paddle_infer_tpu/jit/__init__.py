@@ -121,3 +121,74 @@ def load(path) -> TranslatedLayer:
     with open(path + _PARAMS_SUFFIX, "rb") as f:
         blob = pickle.load(f)
     return TranslatedLayer(exported, blob["params"], blob["buffers"])
+
+
+# --- legacy dy2static tooling compat (reference jit/api.py TracedLayer,
+# jit/dy2static/program_translator.py) ------------------------------------
+
+_CODE_LEVEL = 0
+_VERBOSITY = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference dy2static logging_utils.set_code_level: controls dumping
+    of transformed code.  Here dy2static keeps the transformed source on
+    each StaticFunction (fn.transformed_code), so the level only gates
+    printing."""
+    global _CODE_LEVEL
+    _CODE_LEVEL = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference logging_utils.set_verbosity gates dy2static log chatter;
+    this pipeline emits none (AST transform either succeeds silently or
+    raises), so the level is stored for API compat only."""
+    global _VERBOSITY
+    _VERBOSITY = level
+
+
+class ProgramTranslator:
+    """Singleton toggle for dy2static (reference ProgramTranslator): with
+    enable(False), @to_static functions run the original Python."""
+
+    _instance = None
+    enable_to_static = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, flag: bool):
+        type(self).enable_to_static = bool(flag)
+        from .to_static import set_to_static_enabled
+
+        set_to_static_enabled(bool(flag))
+
+
+class TracedLayer:
+    """Legacy trace-based deployment API (reference jit/api.py
+    TracedLayer.trace/save_inference_model).  Subsumed by jit.to_static +
+    jit.save; kept as a thin veneer over them."""
+
+    def __init__(self, layer, static_fn, example_inputs):
+        self._layer = layer
+        self._fn = static_fn
+        self._example_inputs = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        fn = to_static(layer)
+        outs = fn(*inputs)
+        return outs, TracedLayer(layer, fn, inputs)
+
+    def __call__(self, *inputs):
+        return self._fn(*inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        from .to_static import InputSpec
+
+        specs = [InputSpec(list(x.shape), str(x.dtype))
+                 for x in self._example_inputs]
+        save(self._layer, path, input_spec=specs)

@@ -543,6 +543,7 @@ def convert_function(fn: Callable) -> Callable:
     converted = loc[factory_name](*cells)
     converted = functools.wraps(func)(converted)
     converted.__dy2static__ = True
+    converted.__transformed_source__ = ast.unparse(module)
     if bound_self is not None:
         converted = converted.__get__(bound_self, type(bound_self))
     return converted

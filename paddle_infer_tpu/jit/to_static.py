@@ -55,6 +55,14 @@ def _is_arraylike(x):
     return hasattr(x, "shape") and hasattr(x, "dtype")
 
 
+# ProgramTranslator.enable() toggle (list so closures see updates)
+_TO_STATIC_ENABLED = [True]
+
+
+def set_to_static_enabled(flag: bool):
+    _TO_STATIC_ENABLED[0] = bool(flag)
+
+
 class StaticFunction:
     """One compiled executable per input signature (the executable cache)."""
 
@@ -71,6 +79,11 @@ class StaticFunction:
                 from .dy2static import convert_function
 
                 fn = convert_function(fn)
+                import paddle_infer_tpu.jit as _jit_mod
+
+                if getattr(_jit_mod, "_CODE_LEVEL", 0) > 0 and \
+                        hasattr(fn, "__transformed_source__"):
+                    print(fn.__transformed_source__)
             except (OSError, TypeError, SyntaxError):
                 pass
         self._fn = fn
@@ -143,6 +156,9 @@ class StaticFunction:
         return jax.jit(traced), buffer_targets
 
     def __call__(self, *args, **kwargs):
+        if not _TO_STATIC_ENABLED[0]:
+            # ProgramTranslator().enable(False): run the original Python
+            return self._fn(*args, **kwargs)
         layer = self._detected_layer
         arrays = []
         for a in args:

@@ -323,7 +323,7 @@ def new_group(ranks=None, axis: Union[str, Sequence[str], None] = None
     if axis is None:
         axis = mesh.axis_names[0] if ranks is None else _axis_for_ranks(
             mesh, ranks)
-    return Group(mesh, axis)
+    return _register_group(Group(mesh, axis))
 
 
 def _axis_for_ranks(mesh, ranks):
@@ -333,3 +333,23 @@ def _axis_for_ranks(mesh, ranks):
         if sorted(ranks) in [sorted(g) for g in topo.get_comm_list(name)]:
             return name
     raise ValueError(f"ranks {ranks} do not form a mesh-axis group")
+
+
+# group registry (reference _get_group_map: gid -> Group; gid 0 = world)
+_GROUP_REGISTRY = {}
+
+
+def _register_group(group: Group) -> Group:
+    group.id = len(_GROUP_REGISTRY) + 1
+    _GROUP_REGISTRY[group.id] = group
+    return group
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        g = _default_group()
+        g.id = 0          # world group: stable id like registered ones
+        return g
+    if gid not in _GROUP_REGISTRY:
+        raise ValueError(f"no group with id {gid}")
+    return _GROUP_REGISTRY[gid]

@@ -218,6 +218,9 @@ asin = _unary(jnp.arcsin)
 asinh = _unary(jnp.arcsinh)
 atan = _unary(jnp.arctan)
 atanh = _unary(jnp.arctanh)
+neg = _unary(lambda v: -v)
+deg2rad = _unary(jnp.deg2rad)
+rad2deg = _unary(jnp.rad2deg)
 sinh = _unary(jnp.sinh)
 tan = _unary(jnp.tan)
 expm1 = _unary(jnp.expm1)
@@ -384,6 +387,7 @@ def dense_to_csr(t):
 
 
 __all__ += ["coalesce", "mv", "addmm", "nn", "abs", "asin", "asinh",
-            "atan", "atanh", "sinh", "tan", "expm1", "log1p", "square",
+            "atan", "atanh", "neg", "deg2rad", "rad2deg",
+            "sinh", "tan", "expm1", "log1p", "square",
             "relu6", "leaky_relu", "cast", "scale", "divide",
             "divide_scalar", "full_like", "reshape", "slice"]

@@ -9,8 +9,37 @@ from __future__ import annotations
 
 from ..jit import InputSpec, load as _jit_load, save as _jit_save
 from ..jit.to_static import StaticFunction
+from .graph import (  # noqa: F401
+    Program, Variable, program_guard, default_main_program,
+    default_startup_program, data, create_parameter, create_global_var,
+    append_backward, gradients, Executor, Scope, global_scope,
+    scope_guard, BuildStrategy, ExecutionStrategy, CompiledProgram,
+    ParallelExecutor, IpuStrategy, IpuCompiledProgram, ipu_shard_guard,
+    set_ipu_shard, name_scope, device_guard, cpu_places, cuda_places,
+    xpu_places, npu_places, mlu_places, Print, py_func, accuracy, auc,
+    ctr_metric_bundle, exponential_decay, save, load, load_program_state,
+    set_program_state, serialize_program, serialize_persistables,
+    deserialize_program, deserialize_persistables, save_to_file,
+    load_from_file, normalize_program, WeightNormParamAttr,
+    ExponentialMovingAverage)
 
-__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+__all__ = [
+    "InputSpec", "save_inference_model", "load_inference_model",
+    "Program", "Variable", "program_guard", "default_main_program",
+    "default_startup_program", "data", "create_parameter",
+    "create_global_var", "append_backward", "gradients", "Executor",
+    "Scope", "global_scope", "scope_guard", "BuildStrategy",
+    "ExecutionStrategy", "CompiledProgram", "ParallelExecutor",
+    "IpuStrategy", "IpuCompiledProgram", "ipu_shard_guard",
+    "set_ipu_shard", "name_scope", "device_guard", "cpu_places",
+    "cuda_places", "xpu_places", "npu_places", "mlu_places", "Print",
+    "py_func", "accuracy", "auc", "ctr_metric_bundle",
+    "exponential_decay", "save", "load", "load_program_state",
+    "set_program_state", "serialize_program", "serialize_persistables",
+    "deserialize_program", "deserialize_persistables", "save_to_file",
+    "load_from_file", "normalize_program", "WeightNormParamAttr",
+    "ExponentialMovingAverage",
+]
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,

@@ -1,0 +1,586 @@
+"""Static-graph mode on the Program IR (reference python/paddle/static/:
+Program/program_guard/data/Executor and fluid/backward.py).
+
+TPU redesign — "record eagerly, run compiled": inside ``program_guard``
+every dispatched op executes eagerly (so Python stays debuggable, shapes
+are concrete) while the IR tracer records it into the Program.  Layer
+calls, nn.functional, autograd-free math — anything that dispatches —
+becomes program ops.  ``Executor.run`` then replays the captured program
+as ONE jitted XLA executable per feed signature (the InterpreterCore
+analog: scheduling/fusion/buffer-reuse delegated to XLA), and
+``append_backward`` extends the SAME program with IR-level vjp nodes
+(framework/ir.py append_backward_program), so forward+backward compile
+together exactly like the reference's whole-program grad pass.
+"""
+from __future__ import annotations
+
+import contextlib
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch as dispatch_mod
+from ..core.tensor import Parameter, Tensor
+from ..framework import ir as ir_mod
+
+Variable = Tensor     # reference framework.Variable ~ a traced tensor
+
+
+class Program:
+    """User-facing static program (reference static.Program): wraps the
+    IR Program plus the live trace state needed to keep building it."""
+
+    def __init__(self):
+        self._ir = ir_mod.Program()
+        self._tracer = ir_mod.ProgramTracer()
+        self._tracer.program = self._ir
+        self._feed_names: List[str] = []
+        self._fetch_cache = {}       # id(tensor) -> vid (fetch targets)
+        self._param_store: Dict[str, Tensor] = {}
+        self._grad_map: Dict[str, int] = {}   # "name@GRAD" -> var id
+        self.random_seed = 0
+
+    # -- var bookkeeping ---------------------------------------------------
+    def _declare_data(self, name, shape, dtype):
+        if any(s in (-1, None) for s in shape):
+            # trace-based build bakes concrete shapes into op attrs; a
+            # placeholder dim would bake WRONG attrs silently.  XLA's
+            # model is compile-per-shape anyway — declare each size.
+            raise ValueError(
+                f"static.data({name!r}): dynamic dims (-1/None) are not "
+                "supported; give the concrete shape (one compiled "
+                "executable per shape, the XLA model)")
+        # numpy-side zeros: int64 silently canonicalizes to the enabled
+        # int width instead of warning (x64 is off by default)
+        arr = jnp.asarray(np.zeros(tuple(shape), np.dtype(dtype)))
+        t = Tensor(arr, name=name)
+        vid = self._tracer.declare_input(t)
+        self._ir.vars[vid].name = name
+        self._feed_names.append(name)
+        return t
+
+    def _register_param(self, name, tensor):
+        self._param_store[name] = tensor
+        self._tracer._param_ids[id(tensor)] = name
+        self._tracer._keepalive.append(tensor)
+
+    def _vid_of(self, t: Tensor) -> int:
+        vid = self._tracer._var_of.get(id(t))
+        if vid is None:
+            raise ValueError(
+                "tensor was not produced inside this Program's guard")
+        return vid
+
+    def list_vars(self):
+        return [v for v in self._ir.vars.values()]
+
+    def all_parameters(self):
+        return list(self._param_store.values())
+
+    def global_block(self):
+        return self
+
+    @property
+    def ops(self):
+        return self._ir.ops
+
+    def clone(self, for_test=False):
+        import copy
+
+        return copy.deepcopy(self)
+
+    def __repr__(self):
+        return f"static.Program({self._ir!r})"
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    """Build into ``main_program`` (reference static.program_guard): ops
+    dispatched inside record into its IR while executing eagerly."""
+    global _default_main, _default_startup
+    prev_m, prev_s = _default_main, _default_startup
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    prev_tracer = dispatch_mod.set_tracer(main_program._tracer)
+    try:
+        yield
+    finally:
+        dispatch_mod.set_tracer(prev_tracer)
+        _default_main, _default_startup = prev_m, prev_s
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Declare a feed var (reference static.data)."""
+    return _default_main._declare_data(name, shape, dtype)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Static flavor: the parameter registers into the current main
+    program's param store (reference layers/tensor.py create_parameter)."""
+    from ..framework.compat import create_parameter as _eager_create
+
+    p = _eager_create(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+                      default_initializer=default_initializer)
+    pname = name or f"param_{len(_default_main._param_store)}"
+    _default_main._register_param(pname, p)
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    t = Tensor(jnp.full(tuple(shape), value, np.dtype(dtype)), name=name)
+    t.persistable = persistable
+    gname = name or f"gvar_{len(_default_main._param_store)}"
+    _default_main._register_param(gname, t)
+    return t
+
+
+# ----------------------------------------------------------------- grads
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Append IR grad nodes for ``loss`` (reference fluid/backward.py).
+    Returns [(param, grad_var)] and records name@GRAD vars fetchable by
+    Executor.run."""
+    prog = _default_main
+    loss_vid = prog._vid_of(loss)
+    params = (list(parameter_list) if parameter_list
+              else list(prog._param_store.items()))
+    if params and not isinstance(params[0], tuple):
+        params = [(getattr(p, "name", None) or str(i), p)
+                  for i, p in enumerate(params)]
+    wrt = {}
+    for pname, p in params:
+        vid = prog._tracer._var_of.get(id(p))
+        if vid is None:
+            # param never touched by the forward: no grad
+            continue
+        wrt[pname] = vid
+    grad_of = ir_mod.append_backward_program(prog._ir, loss_vid,
+                                             list(wrt.values()))
+    out = []
+    for pname, vid in wrt.items():
+        if vid in grad_of:
+            gvid = grad_of[vid]
+            prog._grad_map[f"{pname}@GRAD"] = gvid
+            gvar = prog._ir.vars[gvid]
+            out.append((prog._param_store.get(pname), gvar))
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(targets)/d(inputs) as fetchable grad vars (reference
+    static.gradients)."""
+    prog = _default_main
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if len(targets) != 1:
+        raise NotImplementedError("one scalar target at a time")
+    wrt = [prog._vid_of(x) for x in inputs]
+    grad_of = ir_mod.append_backward_program(
+        prog._ir, prog._vid_of(targets[0]), wrt)
+    outs = []
+    for vid in wrt:
+        gvid = grad_of.get(vid)
+        outs.append(prog._ir.vars[gvid] if gvid is not None else None)
+    return outs
+
+
+# -------------------------------------------------------------- executor
+class Scope:
+    """Name -> value store (reference framework::Scope)."""
+
+    def __init__(self):
+        self._vars: Dict[str, object] = {}
+
+    def var(self, name):
+        self._vars.setdefault(name, None)
+        return name
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    prev = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+class Executor:
+    """Compiled program runner (reference static.Executor). ``place`` is
+    accepted for compat; XLA owns placement."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._compiled = {}
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            scope=None, return_numpy=True):
+        program = program or _default_main
+        if isinstance(program, CompiledProgram):
+            program = program._program
+        if program is _default_startup or (
+                not program.ops and not program._ir.fetch_ids
+                and fetch_list is None):
+            # startup run: params were eagerly initialized at creation —
+            # the reference runs initializer ops here; nothing to do
+            return []
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        # resolve fetches: Tensor -> vid, VarDesc -> id, "name@GRAD"
+        fetch_vids = []
+        for f in fetch_list:
+            if isinstance(f, ir_mod.VarDesc):
+                fetch_vids.append(f.id)
+            elif isinstance(f, Tensor):
+                fetch_vids.append(program._vid_of(f))
+            elif isinstance(f, str) and f in program._grad_map:
+                fetch_vids.append(program._grad_map[f])
+            else:
+                raise KeyError(f"unknown fetch target {f!r}")
+        feeds = []
+        for name in program._feed_names:
+            if name not in feed:
+                raise KeyError(f"missing feed {name!r}")
+            feeds.append(jnp.asarray(feed[name]))
+        ir = program._ir
+        prev_fetch = ir.fetch_ids
+        ir.fetch_ids = fetch_vids
+        try:
+            # key on the IR object: the cached jitted closure keeps _ir
+            # alive, so its id cannot be reused while the entry exists
+            # (id(program) could — the wrapper isn't captured)
+            key = (id(program._ir), tuple(fetch_vids),
+                   tuple((tuple(f.shape), str(f.dtype)) for f in feeds))
+            if key not in self._compiled:
+                self._compiled[key] = ir.compile()
+            params = {n: (p._data if isinstance(p, Tensor) else p)
+                      for n, p in program._param_store.items()}
+            outs = self._compiled[key](feeds, params)
+        finally:
+            ir.fetch_ids = prev_fetch
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def close(self):
+        self._compiled.clear()
+
+
+# ------------------------------------------------- strategies / wrappers
+class BuildStrategy:
+    """Accepted-and-ignored knobs (reference BuildStrategy): XLA owns
+    fusion/memory decisions the reference exposes here."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = False
+        self.memory_optimize = True
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """reference CompiledProgram(.with_data_parallel descoped: GSPMD owns
+    multi-device execution via the fleet path)."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+
+class ParallelExecutor:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "ParallelExecutor is subsumed by SPMD compilation; use "
+            "Executor (single chip) or the fleet train step (mesh)")
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("no IPU backend in a TPU framework")
+
+
+class IpuCompiledProgram(IpuStrategy):
+    pass
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("no IPU backend in a TPU framework")
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError("no IPU backend in a TPU framework")
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Var-name prefixing is cosmetic here (IR vars are id-addressed);
+    kept for source compat."""
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """XLA owns placement; the reference pins ops to cpu/gpu."""
+    yield
+
+
+def cpu_places(device_count=None):
+    from ..framework.compat import CPUPlace
+
+    return [CPUPlace() for _ in range(device_count or 1)]
+
+
+def cuda_places(device_ids=None):
+    """Compat: accelerator places (TPU chips here)."""
+    import jax
+
+    from ..framework.compat import CUDAPlace
+
+    ids = device_ids if device_ids is not None else range(
+        len(jax.devices()))
+    return [CUDAPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def npu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def mlu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+# ----------------------------------------------------------- utilities
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, **kwargs):
+    """Debug print (reference layers Print op). Eager-during-trace, so it
+    prints at build time; the replay path stays pure."""
+    print(f"{message or 'Var'}: {np.asarray(input._data)[:summarize]}")
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference py_func op: arbitrary Python in the graph. The eager
+    trace calls it directly; its internal dispatches (if any) are what
+    lands in the program — opaque host work cannot enter a compiled XLA
+    program, which the reference's GPU path shares (it syncs to host)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return func(*xs)
+
+
+def accuracy(input, label, k=1, **kwargs):
+    from ..core.dispatch import dispatch as D
+
+    topk = D("topk", input, k=k)[1]
+    hit = D("equal", topk, D("reshape", label, shape=(-1, 1)))
+    return D("mean", D("cast", D("any", hit, axis=-1), dtype="float32"))
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, **kwargs):
+    """Batch AUC as a traced computation (reference static auc op,
+    simplified to the batch statistic)."""
+    from ..core.dispatch import dispatch as D
+
+    pos_score = input[:, 1] if len(input.shape) == 2 else input
+    lab = D("cast", D("reshape", label, shape=(-1,)), dtype="float32")
+    order = D("argsort", pos_score)
+    lab_sorted = D("gather", lab, order)
+    n = lab_sorted.shape[0]
+    ones = lab_sorted * 0.0 + 1.0          # registry ops only: stays IR
+    ranks = D("cumsum", ones, axis=0)
+    n_pos = D("sum", lab_sorted)
+    n_neg = n - n_pos
+    rank_sum = D("sum", D("multiply", ranks, lab_sorted))
+    return D("divide",
+             rank_sum - n_pos * (n_pos + 1.0) / 2.0,
+             D("maximum", n_pos * n_neg, n_pos * 0.0 + 1.0))
+
+
+def ctr_metric_bundle(input, label, **kwargs):
+    """CTR serving metrics (reference static/__init__ ctr_metric_bundle):
+    (auc, batch-averaged predicted ctr, actual ctr)."""
+    from ..core.dispatch import dispatch as D
+
+    pos_score = input[:, 1] if len(input.shape) == 2 else input
+    return (auc(input, label),
+            D("mean", pos_score),
+            D("mean", D("cast", label, dtype="float32")))
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """reference layers/learning_rate_scheduler.py exponential_decay ->
+    the optimizer-side schedule object (the TPU path applies schedules in
+    the optimizer, not as graph ops)."""
+    from ..optimizer import lr as lr_mod
+
+    return lr_mod.ExponentialDecay(learning_rate, gamma=decay_rate)
+
+
+# ---------------------------------------------------------- persistence
+def save(program, model_path, protocol=4):
+    """Persist the program's parameters (reference static/io.py save:
+    .pdparams + .pdmodel)."""
+    state = {n: np.asarray(p._data)
+             for n, p in program._param_store.items()}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=protocol)
+    with open(model_path + ".pdmodel", "wb") as f:
+        f.write(serialize_program(None, None, program=program))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    set_program_state(program, state)
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    for n, arr in state_dict.items():
+        if n in program._param_store:
+            program._param_store[n].set_value(arr)
+
+
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    program = program or _default_main
+    import json
+
+    return json.dumps(program._ir.to_dict()).encode()
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    program = program or _default_main
+    return pickle.dumps({n: np.asarray(p._data)
+                         for n, p in program._param_store.items()})
+
+
+def deserialize_program(data):
+    p = Program()
+    p._ir = ir_mod.Program.from_dict(__import__("json").loads(data))
+    return p
+
+
+def deserialize_persistables(program, data, executor=None):
+    set_program_state(program, pickle.loads(data))
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """reference static/io.py normalize_program: prune to the
+    feed->fetch slice.  The IR's DCE pass is that pruning."""
+    from ..framework.ir import PassManager
+
+    out = program.clone()
+    out._ir = PassManager(["dce_pass"]).run(out._ir)
+    return out
+
+
+# --------------------------------------------------------------- extras
+class WeightNormParamAttr:
+    """reference static/nn WeightNormParamAttr — marker consumed by
+    nn.utils.weight_norm; carried for source compat."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable params (reference static ExponentialMovingAverage
+    built from graph ops; here shadow buffers + apply/restore swap)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow: Dict[int, np.ndarray] = {}
+        self._backup: Dict[int, np.ndarray] = {}
+        self._params: List[Tensor] = []
+
+    def _ensure(self, params):
+        for p in params:
+            if id(p) not in self._shadow:
+                self._params.append(p)
+                self._shadow[id(p)] = np.asarray(p._data)
+
+    def update(self, parameters=None):
+        params = parameters or _default_main.all_parameters()
+        self._ensure(params)
+        d = self._decay
+        for p in params:
+            s = self._shadow[id(p)]
+            self._shadow[id(p)] = d * s + (1 - d) * np.asarray(p._data)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = np.asarray(p._data)
+            p.set_value(self._shadow[id(p)])
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p.set_value(self._backup[id(p)])
+        self._backup.clear()

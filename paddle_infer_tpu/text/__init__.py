@@ -235,3 +235,86 @@ class Movielens(Dataset):
 
     def __getitem__(self, i):
         return self.user_ids[i], self.movie_ids[i], self.ratings[i]
+
+
+class Imikolov(Dataset):
+    """PTB language-model n-grams (reference text/datasets/imikolov.py):
+    each sample is an n-gram of word ids; synthetic corpus is a
+    first-order Markov chain so n-gram models can fit it."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_counts=50, vocab_size=2000,
+                 synthetic_size=2048):
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError(f"data_type must be NGRAM or SEQ, got "
+                             f"{data_type!r}")
+        rng, n = _synthetic_setup("Imikolov", data_file, mode,
+                                  synthetic_size)
+        self.window_size = window_size
+        # Markov chain: next = (3*cur + noise) % vocab — learnable
+        ids = np.empty((n, window_size), np.int64)
+        cur = rng.randint(0, vocab_size, n)
+        for t in range(window_size):
+            ids[:, t] = cur
+            cur = (3 * cur + rng.randint(0, 7, n)) % vocab_size
+        self.samples = ids
+        self.data_type = data_type
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        s = self.samples[i]
+        if self.data_type == "NGRAM":
+            return tuple(s)          # (w0..w_{n-1}) reference tuple form
+        return s[:-1], s[1:]         # SEQ: (input, shifted target)
+
+
+class _SyntheticTranslation(Dataset):
+    """Shared WMT en->xx synthetic pair generator: target is a
+    deterministic per-token mapping of source (+BOS/EOS framing), so
+    seq2seq models can fit it.  Reference datasets/wmt14.py, wmt16.py."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, name, data_file, mode, src_dict_size,
+                 trg_dict_size, seq_len=16, synthetic_size=1024):
+        rng, n = _synthetic_setup(name, data_file, mode, synthetic_size)
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        src = rng.randint(3, src_dict_size, (n, seq_len)).astype(np.int64)
+        trg_body = (src * 7 + 3) % (trg_dict_size - 3) + 3
+        bos = np.full((n, 1), self.BOS, np.int64)
+        eos = np.full((n, 1), self.EOS, np.int64)
+        self.src = src
+        self.trg = np.concatenate([bos, trg_body, eos], axis=1)
+
+    def __len__(self):
+        return len(self.src)
+
+    def __getitem__(self, i):
+        # (source ids, target input [BOS..], target next [..EOS]) —
+        # the reference trainer triple
+        return self.src[i], self.trg[i, :-1], self.trg[i, 1:]
+
+
+class WMT14(_SyntheticTranslation):
+    """reference text/datasets/wmt14.py (en-fr)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 seq_len=16, synthetic_size=1024):
+        super().__init__("WMT14", data_file, mode, dict_size, dict_size,
+                         seq_len, synthetic_size)
+
+
+class WMT16(_SyntheticTranslation):
+    """reference text/datasets/wmt16.py (en-de, separate dict sizes)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=10000,
+                 trg_dict_size=10000, lang="en", seq_len=16,
+                 synthetic_size=1024):
+        super().__init__("WMT16", data_file, mode, src_dict_size,
+                         trg_dict_size, seq_len, synthetic_size)
+
+
+__all__ += ["Imikolov", "WMT14", "WMT16"]

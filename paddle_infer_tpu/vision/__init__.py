@@ -4,4 +4,41 @@ from . import transforms
 from . import datasets
 from . import ops
 
-__all__ = ["transforms", "datasets", "ops"]
+__all__ = ["transforms", "datasets", "ops", "set_image_backend",
+           "get_image_backend", "image_load"]
+
+# image IO backend (reference vision/image.py); PIL decodes for both
+# modes — the cv2 flavor only flips channel order
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(
+            f"expected backend 'pil' or 'cv2', got {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file as HWC uint8 (reference vision/image.py
+    image_load).  PIL backs both modes (cv2 is not a dependency); the
+    cv2 flavor only flips the channel order to BGR."""
+    import numpy as np
+
+    backend = backend or _image_backend
+    try:
+        from PIL import Image
+
+        arr = np.asarray(Image.open(path).convert("RGB"))
+    except ImportError:
+        arr = np.load(path) if str(path).endswith(".npy") else None
+        if arr is None:
+            raise
+    if backend == "cv2":
+        arr = arr[..., ::-1]
+    return arr

@@ -1,0 +1,212 @@
+"""Round-4 namespace parity batch: distributed compat surface, text
+datasets (Imikolov/WMT), sparse unary tail, vision image backend, io
+worker info, jit ProgramTranslator/TracedLayer glue.
+"""
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import distributed as dist
+
+
+class TestDistributedCompat:
+    def test_parallel_mode_and_entries(self):
+        assert dist.ParallelMode.DATA_PARALLEL == 0
+        e = dist.CountFilterEntry(5)
+        assert "count_filter" in repr(e)
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(0.0)
+        s = dist.ShowClickEntry("show", "click")
+        assert s.show_name == "show"
+
+    def test_init_state_roundtrip(self):
+        dist.init_parallel_env()
+        assert dist.is_initialized()
+        dist.destroy_process_group()
+        assert not dist.is_initialized()
+        dist.init_parallel_env()   # restore for other tests
+
+    def test_all_gather_object_single_process(self):
+        objs = []
+        dist.all_gather_object(objs, {"a": 1})
+        assert objs == [{"a": 1}]
+
+    def test_gloo_shims(self):
+        dist.gloo_init_parallel_env(0, 1, "127.0.0.1:1")
+        dist.gloo_barrier()
+        dist.gloo_release()
+
+    def test_isend_irecv_tasks(self):
+        from paddle_infer_tpu.parallel import topology
+        from paddle_infer_tpu.parallel.topology import create_hybrid_mesh
+
+        topology.set_current_mesh(create_hybrid_mesh(dp=8))
+        try:
+            t = pit.to_tensor(np.ones(8, np.float32))
+            task = dist.isend(t, dst=0)
+            assert task.is_completed() and task.wait()
+        finally:
+            topology.set_current_mesh(None)
+
+    def test_split_linear_shapes(self):
+        from paddle_infer_tpu.parallel import topology
+        from paddle_infer_tpu.parallel.topology import create_hybrid_mesh
+
+        topology.set_current_mesh(create_hybrid_mesh(mp=8))
+        try:
+            x = pit.to_tensor(np.ones((2, 6), np.float32))
+            out = dist.split(x, (6, 4), operation="linear", axis=1)
+            assert out.shape == [2, 4]
+            emb = dist.split(pit.to_tensor(np.array([1, 3])), (10, 5),
+                             operation="embedding")
+            assert emb.shape == [2, 5]
+            with pytest.raises(ValueError):
+                dist.split(x, (6, 4), operation="conv")
+        finally:
+            topology.set_current_mesh(None)
+
+    def test_get_group_registry(self):
+        from paddle_infer_tpu.parallel import topology
+        from paddle_infer_tpu.parallel.topology import create_hybrid_mesh
+
+        topology.set_current_mesh(create_hybrid_mesh(dp=8))
+        try:
+            g = dist.new_group(axis="dp")
+            assert dist.get_group(g.id) is g
+            with pytest.raises(ValueError):
+                dist.get_group(10 ** 6)
+        finally:
+            topology.set_current_mesh(None)
+
+
+class TestPSDatasets:
+    def _write_slot_file(self, tmp_path):
+        # MultiSlot text: <n ids> id... per slot, slots: qid(int) emb(float)
+        f = tmp_path / "part-0"
+        lines = []
+        for i in range(6):
+            lines.append(f"1 {i} 2 {i}.5 {i}.25")
+        f.write_text("\n".join(lines) + "\n")
+        return str(f)
+
+    def test_in_memory_dataset(self, tmp_path):
+        from paddle_infer_tpu.native import available
+
+        if not available():
+            pytest.skip("native runtime unavailable")
+        path = self._write_slot_file(tmp_path)
+        ds = dist.InMemoryDataset()
+
+        class V:
+            def __init__(self, name, dtype):
+                self.name, self.dtype = name, dtype
+
+        ds.init(batch_size=2, use_var=[V("qid", "int64"),
+                                       V("emb", "float32")])
+        ds.set_filelist([path])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 6
+        ds.local_shuffle()
+        batches = list(ds)
+        assert len(batches) == 3
+        vals, lod = batches[0]["emb"]
+        assert lod[-1] == len(vals)
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+    def test_queue_dataset_streams(self, tmp_path):
+        from paddle_infer_tpu.native import available
+
+        if not available():
+            pytest.skip("native runtime unavailable")
+        path = self._write_slot_file(tmp_path)
+        ds = dist.QueueDataset()
+
+        class V:
+            def __init__(self, name, dtype):
+                self.name, self.dtype = name, dtype
+
+        ds.init(batch_size=3, use_var=[V("qid", "int64"),
+                                       V("emb", "float32")])
+        ds.set_filelist([path])
+        with pytest.raises(RuntimeError):
+            ds.load_into_memory()
+        assert len(list(ds)) == 2
+
+
+class TestTextDatasets:
+    def test_imikolov(self):
+        ds = pit.text.Imikolov(window_size=4, synthetic_size=64)
+        assert len(ds) == 64
+        gram = ds[0]
+        assert len(gram) == 4
+        seq = pit.text.Imikolov(data_type="SEQ", synthetic_size=8)[0]
+        assert len(seq[0]) == len(seq[1])
+        with pytest.raises(ValueError):
+            pit.text.Imikolov(data_type="BAD")
+        # train/test streams differ
+        tr = pit.text.Imikolov(mode="train", synthetic_size=64).samples
+        te = pit.text.Imikolov(mode="test", synthetic_size=64).samples
+        assert tr.shape[0] == 64 and te.shape[0] == 16
+        assert not np.array_equal(tr[:16], te)
+
+    def test_wmt(self):
+        ds = pit.text.WMT14(seq_len=8, synthetic_size=32)
+        src, trg_in, trg_out = ds[0]
+        assert trg_in[0] == 0          # BOS
+        assert trg_out[-1] == 1        # EOS
+        np.testing.assert_array_equal(trg_in[1:], trg_out[:-1])
+        ds16 = pit.text.WMT16(src_dict_size=100, trg_dict_size=80,
+                              synthetic_size=16)
+        s, ti, to = ds16[3]
+        assert (ti[1:] < 80).all() and (s < 100).all()
+        # target is a learnable deterministic map of source
+        np.testing.assert_array_equal(ti[1:], (s * 7 + 3) % (80 - 3) + 3)
+
+
+class TestSmallNamespaceBits:
+    def test_sparse_unary_tail(self):
+        from paddle_infer_tpu import sparse
+
+        x = pit.to_tensor(np.array([[0., 90.], [-180., 0.]], np.float32))
+        s = sparse.sparse_coo_tensor(
+            np.array([[0, 1], [1, 0]]), np.array([90., -180.], np.float32),
+            (2, 2))
+        np.testing.assert_allclose(
+            np.asarray(sparse.neg(s).to_dense()), -np.asarray(x),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sparse.deg2rad(s).to_dense()),
+            np.deg2rad(np.asarray(x)), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sparse.rad2deg(sparse.deg2rad(s)).to_dense()),
+            np.asarray(x), rtol=1e-5)
+
+    def test_vision_image_backend(self, tmp_path):
+        import paddle_infer_tpu.vision as V
+
+        assert V.get_image_backend() == "pil"
+        V.set_image_backend("cv2")
+        assert V.get_image_backend() == "cv2"
+        with pytest.raises(ValueError):
+            V.set_image_backend("magick")
+        V.set_image_backend("pil")
+        try:
+            from PIL import Image
+        except ImportError:
+            pytest.skip("PIL unavailable")
+        arr = (np.random.default_rng(0).integers(0, 255, (4, 5, 3))
+               .astype(np.uint8))
+        p = str(tmp_path / "img.png")
+        Image.fromarray(arr).save(p)
+        loaded = V.image_load(p)
+        np.testing.assert_array_equal(loaded, arr)
+        bgr = V.image_load(p, backend="cv2")
+        np.testing.assert_array_equal(bgr, arr[..., ::-1])
+
+    def test_worker_info_outside_worker(self):
+        assert pit.io.get_worker_info() is None
+
+    def test_fft_namespace_complete(self):
+        for name in ("hfft2", "ihfft2", "hfftn", "ihfftn"):
+            assert hasattr(pit.fft, name)

@@ -1,0 +1,243 @@
+"""Static-graph mode (paddle.static parity): record-eagerly/run-compiled
+Programs, Executor, IR-level append_backward/gradients, persistence, and
+the strategy/scope surface.  Reference: python/paddle/static/ +
+fluid/backward.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import nn, static
+
+
+def build_linear_program():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 3], "float32")
+        lin = nn.Linear(3, 2)
+        # layer params created outside the store: register explicitly
+        main._register_param("w", lin.weight)
+        main._register_param("b", lin.bias)
+        y = lin(x)
+        loss = y.sum()
+    return main, startup, x, y, loss, lin
+
+
+class TestProgramBuild:
+    def test_ops_recorded_and_executor_runs(self):
+        main, startup, x, y, loss, lin = build_linear_program()
+        names = [op.name for op in main.ops]
+        assert "matmul" in names or "matmul_add" in names
+        exe = static.Executor(static.cpu_places()[0])
+        exe.run(startup)
+        feed_x = np.random.default_rng(0).standard_normal((4, 3)) \
+            .astype(np.float32)
+        out, = exe.run(main, feed={"x": feed_x}, fetch_list=[y])
+        # oracle: eager layer on the same data
+        ref = np.asarray(lin(pit.to_tensor(feed_x)))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_feed_shape_must_be_concrete(self):
+        with pytest.raises(ValueError):
+            with static.program_guard(static.Program()):
+                static.data("x", [-1, 3])
+
+    def test_default_programs_and_guard_swap(self):
+        before = static.default_main_program()
+        p = static.Program()
+        with static.program_guard(p):
+            assert static.default_main_program() is p
+        assert static.default_main_program() is before
+
+    def test_missing_feed_and_unknown_fetch(self):
+        main, startup, x, y, loss, _ = build_linear_program()
+        exe = static.Executor()
+        with pytest.raises(KeyError):
+            exe.run(main, feed={}, fetch_list=[y])
+        with pytest.raises(KeyError):
+            exe.run(main, feed={"x": np.zeros((4, 3), np.float32)},
+                    fetch_list=["nope@GRAD"])
+
+
+class TestStaticBackward:
+    def test_append_backward_matches_eager_grads(self):
+        main, startup, x, y, loss, lin = build_linear_program()
+        with static.program_guard(main, startup):
+            grads = static.append_backward(loss)
+        assert grads, "no (param, grad) pairs returned"
+        exe = static.Executor()
+        feed_x = np.random.default_rng(1).standard_normal((4, 3)) \
+            .astype(np.float32)
+        gw, gb = exe.run(main, feed={"x": feed_x},
+                         fetch_list=["w@GRAD", "b@GRAD"])
+        # eager oracle
+        xe = pit.to_tensor(feed_x)
+        le = lin(xe).sum()
+        le.backward()
+        np.testing.assert_allclose(gw, np.asarray(lin.weight.grad),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(gb, np.asarray(lin.bias.grad),
+                                   rtol=1e-5)
+        lin.weight.grad = lin.bias.grad = None
+
+    def test_gradients_wrt_input(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [3], "float32")
+            y = (x * x).sum()
+            (gx,) = static.gradients(y, x)
+        exe = static.Executor()
+        feed = np.array([1., -2., 3.], np.float32)
+        out, = exe.run(main, feed={"x": feed}, fetch_list=[gx])
+        np.testing.assert_allclose(out, 2 * feed, rtol=1e-6)
+
+    def test_backward_through_none_operand_op(self):
+        # layer_norm(x, weight=None, bias) traces inputs [x, -1, bias]:
+        # the vjp must re-insert the None positionally, not shift bias
+        # into the weight slot
+        import paddle_infer_tpu.nn.functional as F
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 4], "float32")
+            bias = static.create_parameter([4], name="bias", is_bias=True)
+            y = F.layer_norm(x, 4, weight=None, bias=bias)
+            loss = (y * y).sum()
+            static.append_backward(loss, parameter_list=[("bias", bias)])
+        feed = np.random.default_rng(0).standard_normal((2, 4)) \
+            .astype(np.float32)
+        gb, = static.Executor().run(main, feed={"x": feed},
+                                    fetch_list=["bias@GRAD"])
+        # eager oracle
+        be = pit.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+        ye = F.layer_norm(pit.to_tensor(feed), 4, weight=None, bias=be)
+        (ye * ye).sum().backward()
+        np.testing.assert_allclose(gb, np.asarray(be.grad), rtol=1e-5)
+
+    def test_backward_through_nonlinearity(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [5], "float32")
+            y = x.tanh().sum()
+            (gx,) = static.gradients(y, x)
+        feed = np.linspace(-1, 1, 5).astype(np.float32)
+        out, = static.Executor().run(main, feed={"x": feed},
+                                     fetch_list=[gx])
+        np.testing.assert_allclose(out, 1 - np.tanh(feed) ** 2, rtol=1e-5)
+
+
+class TestPersistence:
+    def test_save_load_roundtrip(self, tmp_path):
+        main, startup, x, y, loss, lin = build_linear_program()
+        path = str(tmp_path / "model")
+        static.save(main, path)
+        w0 = np.asarray(lin.weight)
+        lin.weight.set_value(np.zeros_like(w0))
+        static.load(main, path)
+        np.testing.assert_allclose(np.asarray(lin.weight), w0)
+        st = static.load_program_state(path)
+        assert set(st) == {"w", "b"}
+
+    def test_serialize_roundtrip(self, tmp_path):
+        main, *_ = build_linear_program()
+        blob = static.serialize_program(None, None, program=main)
+        p2 = static.deserialize_program(blob)
+        assert len(p2.ops) == len(main.ops)
+        pb = static.serialize_persistables(None, None, program=main)
+        static.save_to_file(str(tmp_path / "x.bin"), pb)
+        assert static.load_from_file(str(tmp_path / "x.bin")) == pb
+
+    def test_normalize_program_prunes(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            y = x * 2.0
+            _dead = x * 3.0  # never fetched
+            main._ir.fetch_ids = [main._vid_of(y)]
+        slim = static.normalize_program(main, None, None)
+        assert len(slim.ops) < len(main.ops)
+
+
+class TestSurface:
+    def test_scope_guard(self):
+        s = static.Scope()
+        with static.scope_guard(s):
+            assert static.global_scope() is s
+            s.set("k", 1)
+        assert static.global_scope() is not s
+
+    def test_places_and_strategies(self):
+        assert len(static.cpu_places(2)) == 2
+        assert static.cuda_places([0]) == [pit.CUDAPlace(0)]
+        bs = static.BuildStrategy()
+        cp = static.CompiledProgram(static.Program(), bs)
+        assert cp._build_strategy is bs
+        with pytest.raises(NotImplementedError):
+            static.ParallelExecutor()
+        with pytest.raises(NotImplementedError):
+            static.IpuStrategy()
+
+    def test_metrics_in_graph(self):
+        main = static.Program()
+        with static.program_guard(main):
+            pred = static.data("p", [6, 2], "float32")
+            label = static.data("l", [6, 1], "int64")
+            acc = static.accuracy(pred, label)
+            a = static.auc(pred, label)
+        p = np.array([[.9, .1], [.2, .8], [.7, .3], [.1, .9], [.6, .4],
+                      [.3, .7]], np.float32)
+        l = np.array([[0], [1], [0], [1], [1], [0]])
+        out_acc, out_auc = static.Executor().run(
+            main, feed={"p": p, "l": l}, fetch_list=[acc, a])
+        np.testing.assert_allclose(out_acc, 4 / 6, rtol=1e-6)
+        # Mann-Whitney oracle: fraction of (pos, neg) pairs ranked right
+        pos = p[l[:, 0] == 1, 1]
+        neg = p[l[:, 0] == 0, 1]
+        oracle = np.mean([s > t for s in pos for t in neg])
+        np.testing.assert_allclose(out_auc, oracle, rtol=1e-5)
+
+    def test_ema(self):
+        main = static.Program()
+        with static.program_guard(main):
+            w = static.create_parameter([2], name="w")
+        ema = static.ExponentialMovingAverage(decay=0.5)
+        w.set_value(np.array([2., 2.], np.float32))
+        ema.update([w])
+        w.set_value(np.array([4., 4.], np.float32))
+        ema.update([w])
+        with ema.apply():
+            got = np.asarray(w)
+        # shadow: s0=init; after two updates with values 2 then 4
+        assert not np.allclose(got, [4., 4.])
+        np.testing.assert_allclose(np.asarray(w), [4., 4.])  # restored
+
+    def test_exponential_decay_maps_to_scheduler(self):
+        sch = static.exponential_decay(0.1, 100, 0.9)
+        assert abs(sch.get_lr() - 0.1) < 1e-9
+
+    def test_program_translator_toggle(self):
+        calls = []
+
+        @pit.jit.to_static
+        def f(x):
+            calls.append(1)
+            return x * 2
+
+        pt = pit.jit.ProgramTranslator.get_instance()
+        pt.enable(False)
+        try:
+            out = f(pit.to_tensor(np.array([3.], np.float32)))
+            assert float(out) == 6.0
+        finally:
+            pt.enable(True)
+
+    def test_traced_layer(self, tmp_path):
+        lin = nn.Linear(3, 2)
+        x = pit.to_tensor(np.ones((1, 3), np.float32))
+        out, traced = pit.jit.TracedLayer.trace(lin, [x])
+        np.testing.assert_allclose(np.asarray(traced(x)),
+                                   np.asarray(out), rtol=1e-6)
+        traced.save_inference_model(str(tmp_path / "tl"))
+        loaded = pit.jit.load(str(tmp_path / "tl"))
+        np.testing.assert_allclose(np.asarray(loaded(x)),
+                                   np.asarray(out), rtol=1e-5)
