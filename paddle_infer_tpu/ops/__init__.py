@@ -320,3 +320,111 @@ def t(x):
 
 def numel(x):
     return x.size
+
+
+# ------------------------------------------------------------------------
+# Tensor method surface completion (reference tensor_method_func list in
+# python/paddle/tensor/__init__.py: every public op is also a method).
+# Bind each module-level function as a method with self as first operand.
+def _install_tensor_methods():
+    g = globals()
+    names = [
+        # math / reduction tail
+        "cov", "corrcoef", "cond", "dist", "cross", "cholesky",
+        "histogram", "bincount", "mv", "logcumsumexp", "logit",
+        "increment", "stanh", "nansum", "nanmean", "count_nonzero",
+        "add_n", "amax", "amin", "fmax", "fmin", "inner", "outer",
+        "remainder", "floor_mod", "inverse", "addmm", "trace", "kron",
+        "kthvalue", "conj", "lgamma", "equal_all", "allclose", "isclose",
+        "expand_as", "gather_nd", "reverse", "scatter", "scatter_nd_add",
+        "shard_index", "slice", "tensordot", "strided_slice", "unique",
+        "unique_consecutive", "unstack", "rot90", "masked_select",
+        "index_select", "nonzero", "index_sample", "median", "nanmedian",
+        "quantile", "nanquantile", "real", "imag", "digamma", "diagonal",
+        "frac", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+        "eig", "multi_dot", "solve", "cholesky_solve", "asinh", "atanh",
+        "acosh", "lu", "as_complex", "as_real", "rad2deg", "deg2rad",
+        "gcd", "lcm", "diff", "mode", "lerp", "erfinv", "angle",
+        "moveaxis", "repeat_interleave", "heaviside", "index_add",
+        "take", "bucketize", "sgn", "multiplex", "beam_search_softmax",
+    ]
+    for name in names:
+        if hasattr(Tensor, name):
+            continue
+        fn = g.get(name)
+        if fn is None:
+            continue
+        # plain function attribute: the descriptor protocol binds self as
+        # the first operand, and API.spec keeps the real signature
+        setattr(Tensor, name, fn)
+    # linalg-namespace methods (reference binds paddle.linalg fns too)
+    from .. import linalg as _linalg_ns
+
+    for name in ["qr", "eigvals", "eigvalsh", "matrix_power", "lstsq",
+                 "triangular_solve", "lu_unpack"]:
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, getattr(_linalg_ns, name))
+
+    # container-first fns: self joins the rest, with list args flattened
+    # (concat()'s list normalization only guards its FIRST argument)
+    def _concat_method(self, others=None, axis=0):
+        rest = (list(others) if isinstance(others, (list, tuple))
+                else [] if others is None else [others])
+        return concat([self] + rest, axis=axis)
+
+    if not hasattr(Tensor, "concat"):
+        Tensor.concat = _concat_method
+    Tensor.stack = lambda self, others=None, axis=0: D(
+        "stack", self, *(others or []), axis=axis)
+    Tensor.broadcast_to = _attr_first_method("broadcast_to", "shape")
+    Tensor.broadcast_shape = lambda self, y_shape: _bshape(
+        self.shape, y_shape)
+    Tensor.broadcast_tensors = lambda self, others: broadcast_tensors(
+        [self] + list(others))
+    Tensor.scatter_nd = lambda self, updates, shape: scatter_nd(
+        self, updates, shape)
+    # predicates / metadata (framework.compat impls)
+    from ..framework import compat as _compat
+
+    Tensor.is_tensor = lambda self: True
+    Tensor.is_complex = lambda self: _compat.is_complex(self)
+    Tensor.is_integer = lambda self: _compat.is_integer(self)
+    Tensor.is_floating_point = lambda self: _compat.is_floating_point(self)
+    Tensor.is_empty = lambda self: _compat.is_empty(self)
+    Tensor.rank = lambda self: _compat.rank(self)
+    # in-place variants (Tensor._rebind keeps autograd linkage)
+    Tensor.remainder_ = lambda self, y: self._rebind(D("mod", self, y))
+    Tensor.lerp_ = lambda self, y, w: self._rebind(D("lerp", self, y, w))
+    Tensor.erfinv_ = lambda self: self._rebind(D("erfinv", self))
+    Tensor.put_along_axis_ = lambda self, idx, values, axis: self._rebind(
+        D("put_along_axis", self, idx, values, axis=axis))
+
+    def _uniform_(self, min=-1.0, max=1.0, seed=0):
+        from .creation import uniform as _uniform
+
+        return self._rebind(
+            D("cast", _uniform(tuple(self.shape), min=min, max=max,
+                               seed=seed or None), dtype=str(self.dtype)))
+
+    Tensor.uniform_ = _uniform_
+
+    def _exponential_(self, lam=1.0):
+        from ..core import random as _prandom
+
+        # dispatched like dropout's hash-RNG (key tensor operand), so
+        # trace/static capture sees a real op, not an opaque fill
+        e = D("exponential_fill", Tensor(_prandom.next_key()),
+              shape=tuple(self.shape), lam=float(lam),
+              dtype=str(self.dtype))
+        return self._rebind(e)
+
+    Tensor.exponential_ = _exponential_
+
+
+def _bshape(a, b):
+    import numpy as _np
+
+    return list(_np.broadcast_shapes(tuple(a), tuple(b)))
+
+
+_install_tensor_methods()

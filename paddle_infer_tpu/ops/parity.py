@@ -75,6 +75,15 @@ def _shard_index(x, index_num, nshards, shard_id, ignore_value):
     return jnp.where(in_shard, x % size, ignore_value).astype(x.dtype)
 
 
+@register_op("exponential_fill", save_inputs=False)
+def _exponential_fill(key, *, shape, lam, dtype):
+    """Exponential(λ) fill behind Tensor.exponential_ (reference
+    exponential_ op): key rides as an operand like the dropout hash-RNG,
+    so the op is visible to trace/static capture."""
+    e = jax.random.exponential(key, tuple(shape)) / lam
+    return e.astype(np.dtype(dtype))
+
+
 # --------------------------------- data-dependent output -> eager host ops
 @register_op("nonzero", jit=False)
 def _nonzero(x, as_tuple=False):

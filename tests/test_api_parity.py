@@ -572,3 +572,61 @@ class TestHermitianFFT:
         spec = np.asarray(pit.fft.ihfftn(T(x[None, :]), axes=[1]))
         ref = np.fft.ihfft(x)
         np.testing.assert_allclose(spec[0], ref, atol=1e-6)
+
+
+class TestTensorMethods:
+    """reference tensor_method_func (python/paddle/tensor/__init__.py):
+    every public op doubles as a Tensor method."""
+
+    def test_surface_complete(self):
+        # spot the families: linalg, reduction, predicate, container
+        t = T(np.array([[4., 1.], [2., 3.]], np.float32))
+        for name in ("trace", "qr", "eigvals", "matrix_power", "lstsq",
+                     "cov", "nonzero", "rank", "is_floating_point",
+                     "is_empty", "bitwise_and", "lu", "mode", "take",
+                     "broadcast_shape", "expand_as", "sgn", "kthvalue"):
+            assert hasattr(pit.Tensor, name), name
+
+    def test_method_equals_function(self):
+        t = T(np.array([[4., 1.], [2., 3.]], np.float32))
+        np.testing.assert_allclose(float(t.trace()),
+                                   float(pit.trace(t)))
+        np.testing.assert_allclose(np.asarray(t.mv(T(np.ones(2,
+                                   np.float32)))),
+                                   np.asarray(pit.mv(t, T(np.ones(2,
+                                   np.float32)))))
+        assert t.broadcast_shape([4, 2, 2]) == [4, 2, 2]
+        q1, r1 = t.qr()
+        q2, r2 = pit.linalg.qr(t)
+        np.testing.assert_allclose(np.asarray(q1), np.asarray(q2))
+
+    def test_container_methods(self):
+        a = T(np.ones((2,), np.float32))
+        b = T(np.zeros((2,), np.float32))
+        out = a.stack([b], axis=0)
+        assert out.shape == [2, 2]
+        cc = a.concat(b)
+        assert cc.shape == [4]
+
+    def test_inplace_methods(self):
+        r = T(np.array([7.], np.float32))
+        assert r.remainder_(T(np.array([3.], np.float32))) is r
+        assert float(r) == 1.0
+        l = T(np.array([0.], np.float32))
+        l.lerp_(T(np.array([10.], np.float32)), 0.5)
+        assert float(l) == 5.0
+        u = T(np.zeros((64,), np.float32))
+        u.uniform_(0, 1, seed=3)
+        arr = np.asarray(u)
+        assert (arr > 0).all() and (arr < 1).all()
+        e = T(np.zeros((2000,), np.float32))
+        e.exponential_(4.0)
+        assert abs(float(e.mean()) - 0.25) < 0.05
+        x = T(np.zeros((3,), np.float32))
+        x.put_along_axis_(T(np.array([1])), T(np.array([9.],
+                          np.float32)), 0)
+        np.testing.assert_allclose(np.asarray(x), [0., 9., 0.])
+        v = T(np.array([0.5], np.float32))
+        v.erfinv_()
+        from math import erf
+        assert abs(erf(float(v)) - 0.5) < 1e-5
