@@ -36,18 +36,21 @@ class BasicBlock(Layer):
 
 class BottleneckBlock(Layer):
     """1x1 → 3x3 → 1x1 (reference resnet.py BottleneckBlock); expansion 4;
-    stride on the 3x3 (v1.5)."""
+    stride on the 3x3 (v1.5).  ``groups``/``base_width`` give the ResNeXt
+    and WideResNet variants (reference resnet.py:495-737)."""
 
     expansion = 4
 
-    def __init__(self, in_ch, ch, stride=1, downsample=None):
+    def __init__(self, in_ch, ch, stride=1, downsample=None, groups=1,
+                 base_width=64):
         super().__init__()
-        self.conv1 = Conv2D(in_ch, ch, 1, bias_attr=False)
-        self.bn1 = BatchNorm2D(ch)
-        self.conv2 = Conv2D(ch, ch, 3, stride=stride, padding=1,
-                            bias_attr=False)
-        self.bn2 = BatchNorm2D(ch)
-        self.conv3 = Conv2D(ch, ch * 4, 1, bias_attr=False)
+        width = int(ch * (base_width / 64.0)) * groups
+        self.conv1 = Conv2D(in_ch, width, 1, bias_attr=False)
+        self.bn1 = BatchNorm2D(width)
+        self.conv2 = Conv2D(width, width, 3, stride=stride, padding=1,
+                            groups=groups, bias_attr=False)
+        self.bn2 = BatchNorm2D(width)
+        self.conv3 = Conv2D(width, ch * 4, 1, bias_attr=False)
         self.bn3 = BatchNorm2D(ch * 4)
         self.downsample = downsample
 
@@ -63,10 +66,15 @@ class ResNet(Layer):
     """reference: python/paddle/vision/models/resnet.py class ResNet."""
 
     def __init__(self, block, depth_cfg, num_classes=1000, with_pool=True,
-                 in_channels=3):
+                 in_channels=3, groups=1, width=64):
         super().__init__()
         self.num_classes = num_classes
         self.with_pool = with_pool
+        if block is BasicBlock and (groups != 1 or width != 64):
+            raise ValueError(
+                "BasicBlock only supports groups=1 and width=64")
+        self.groups = groups
+        self.base_width = width
         self.inplanes = 64
         self.conv1 = Conv2D(in_channels, 64, 7, stride=2, padding=3,
                             bias_attr=False)
@@ -89,10 +97,12 @@ class ResNet(Layer):
                 Conv2D(self.inplanes, ch * block.expansion, 1,
                        stride=stride, bias_attr=False),
                 BatchNorm2D(ch * block.expansion))
-        layers = [block(self.inplanes, ch, stride, downsample)]
+        extra = ({"groups": self.groups, "base_width": self.base_width}
+                 if block is BottleneckBlock else {})
+        layers = [block(self.inplanes, ch, stride, downsample, **extra)]
         self.inplanes = ch * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, ch))
+            layers.append(block(self.inplanes, ch, **extra))
         return Sequential(*layers)
 
     def forward(self, x):
@@ -148,7 +158,12 @@ __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "MobileNetV2",
            "ShuffleNetV2", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
            "DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201", "densenet264", "GoogLeNet", "googlenet",
-           "InceptionV3", "inception_v3"]
+           "InceptionV3", "inception_v3",
+           "resnext50_32x4d", "resnext50_64x4d", "resnext101_32x4d",
+           "resnext101_64x4d", "resnext152_32x4d", "resnext152_64x4d",
+           "wide_resnet50_2", "wide_resnet101_2",
+           "MobileNetV3", "MobileNetV3Small", "MobileNetV3Large",
+           "mobilenet_v3_small", "mobilenet_v3_large"]
 
 
 class VGG(Layer):
@@ -863,3 +878,180 @@ class InceptionV3(Layer):
 
 def inception_v3(**kw):
     return InceptionV3(**kw)
+
+
+# --- ResNeXt / WideResNet variants (reference resnet.py:495-737) ---
+
+def resnext50_32x4d(**kw):
+    return ResNet(BottleneckBlock, _CONFIGS[50][1], groups=32, width=4, **kw)
+
+
+def resnext50_64x4d(**kw):
+    return ResNet(BottleneckBlock, _CONFIGS[50][1], groups=64, width=4, **kw)
+
+
+def resnext101_32x4d(**kw):
+    return ResNet(BottleneckBlock, _CONFIGS[101][1], groups=32, width=4, **kw)
+
+
+def resnext101_64x4d(**kw):
+    return ResNet(BottleneckBlock, _CONFIGS[101][1], groups=64, width=4, **kw)
+
+
+def resnext152_32x4d(**kw):
+    return ResNet(BottleneckBlock, _CONFIGS[152][1], groups=32, width=4, **kw)
+
+
+def resnext152_64x4d(**kw):
+    return ResNet(BottleneckBlock, _CONFIGS[152][1], groups=64, width=4, **kw)
+
+
+def wide_resnet50_2(**kw):
+    return ResNet(BottleneckBlock, _CONFIGS[50][1], width=128, **kw)
+
+
+def wide_resnet101_2(**kw):
+    return ResNet(BottleneckBlock, _CONFIGS[101][1], width=128, **kw)
+
+
+# --- MobileNetV3 (reference mobilenetv3.py; specs from the paper,
+#     "Searching for MobileNetV3") ---
+
+def _make_divisible(v, divisor=8):
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _SqueezeExcite(Layer):
+    """SE with relu/hardsigmoid gating as in MobileNetV3."""
+
+    def __init__(self, ch, squeeze_ch):
+        super().__init__()
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(ch, squeeze_ch, 1)
+        self.fc2 = Conv2D(squeeze_ch, ch, 1)
+
+    def forward(self, x):
+        s = F.relu(self.fc1(self.pool(x)))
+        return x * F.hardsigmoid(self.fc2(s))
+
+
+class _InvertedResidualV3(Layer):
+    """expand 1x1 → depthwise kxk → (SE) → project 1x1."""
+
+    def __init__(self, in_ch, exp_ch, out_ch, k, stride, use_se, use_hs):
+        super().__init__()
+        self.use_res = stride == 1 and in_ch == out_ch
+        act = F.hardswish if use_hs else F.relu
+        self._act = act
+        self.expand = None
+        if exp_ch != in_ch:
+            self.expand = Sequential(Conv2D(in_ch, exp_ch, 1,
+                                            bias_attr=False),
+                                     BatchNorm2D(exp_ch))
+        self.dw = Sequential(
+            Conv2D(exp_ch, exp_ch, k, stride=stride, padding=k // 2,
+                   groups=exp_ch, bias_attr=False),
+            BatchNorm2D(exp_ch))
+        self.se = _SqueezeExcite(exp_ch, _make_divisible(exp_ch // 4)) \
+            if use_se else None
+        self.project = Sequential(Conv2D(exp_ch, out_ch, 1,
+                                         bias_attr=False),
+                                  BatchNorm2D(out_ch))
+
+    def forward(self, x):
+        out = x
+        if self.expand is not None:
+            out = self._act(self.expand(out))
+        out = self._act(self.dw(out))
+        if self.se is not None:
+            out = self.se(out)
+        out = self.project(out)
+        return x + out if self.use_res else out
+
+
+# (k, exp, out, SE, HS, stride) per paper Table 1/2.
+_V3_LARGE = [
+    (3, 16, 16, False, False, 1), (3, 64, 24, False, False, 2),
+    (3, 72, 24, False, False, 1), (5, 72, 40, True, False, 2),
+    (5, 120, 40, True, False, 1), (5, 120, 40, True, False, 1),
+    (3, 240, 80, False, True, 2), (3, 200, 80, False, True, 1),
+    (3, 184, 80, False, True, 1), (3, 184, 80, False, True, 1),
+    (3, 480, 112, True, True, 1), (3, 672, 112, True, True, 1),
+    (5, 672, 160, True, True, 2), (5, 960, 160, True, True, 1),
+    (5, 960, 160, True, True, 1)]
+_V3_SMALL = [
+    (3, 16, 16, True, False, 2), (3, 72, 24, False, False, 2),
+    (3, 88, 24, False, False, 1), (5, 96, 40, True, True, 2),
+    (5, 240, 40, True, True, 1), (5, 240, 40, True, True, 1),
+    (5, 120, 48, True, True, 1), (5, 144, 48, True, True, 1),
+    (5, 288, 96, True, True, 2), (5, 576, 96, True, True, 1),
+    (5, 576, 96, True, True, 1)]
+
+
+class MobileNetV3(Layer):
+    """reference: python/paddle/vision/models/mobilenetv3.py:166."""
+
+    def __init__(self, cfg, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_ch = _make_divisible(16 * scale)
+        self.stem = Sequential(
+            Conv2D(3, in_ch, 3, stride=2, padding=1, bias_attr=False),
+            BatchNorm2D(in_ch))
+        blocks = []
+        for (k, exp, out, se, hs, s) in cfg:
+            exp_ch = _make_divisible(exp * scale)
+            out_ch = _make_divisible(out * scale)
+            blocks.append(_InvertedResidualV3(in_ch, exp_ch, out_ch, k, s,
+                                              se, hs))
+            in_ch = out_ch
+        self.blocks = Sequential(*blocks)
+        head_ch = _make_divisible(cfg[-1][1] * scale)
+        self.head = Sequential(Conv2D(in_ch, head_ch, 1, bias_attr=False),
+                               BatchNorm2D(head_ch))
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.flatten = Flatten()
+            self.fc1 = Linear(head_ch, last_channel)
+            self.dropout = Dropout(0.2)
+            self.fc2 = Linear(last_channel, num_classes)
+
+    def forward(self, x):
+        x = F.hardswish(self.stem(x))
+        x = self.blocks(x)
+        x = F.hardswish(self.head(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.flatten(x)
+            x = self.dropout(F.hardswish(self.fc1(x)))
+            x = self.fc2(x)
+        return x
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, _make_divisible(1024 * scale),
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, _make_divisible(1280 * scale),
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+def mobilenet_v3_small(scale=1.0, **kw):
+    return MobileNetV3Small(scale=scale, **kw)
+
+
+def mobilenet_v3_large(scale=1.0, **kw):
+    return MobileNetV3Large(scale=scale, **kw)

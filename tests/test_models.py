@@ -319,3 +319,63 @@ class TestInceptionV3:
         assert list(out.shape) == [1, 5]
         assert np.isfinite(out.numpy()).all()
         assert m.fc.weight.shape[0] == 2048
+
+
+class TestVisionZooRound4:
+    """MobileNetV3 + ResNeXt + WideResNet (reference
+    python/paddle/vision/models/mobilenetv3.py, resnet.py:495-737)."""
+
+    def _check(self, model, in_hw=64, num_classes=10):
+        import numpy as np
+
+        model.eval()
+        x = pit.to_tensor(np.random.RandomState(0).randn(
+            2, 3, in_hw, in_hw).astype(np.float32))
+        out = model(x)
+        assert list(out.shape) == [2, num_classes]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_mobilenet_v3_small(self):
+        from paddle_infer_tpu.vision.models import mobilenet_v3_small
+
+        m = mobilenet_v3_small(num_classes=10)
+        self._check(m)
+        # 11 inverted-residual blocks, 9 of them with squeeze-excite
+        blocks = [l for l in m.sublayers()
+                  if l.__class__.__name__ == "_InvertedResidualV3"]
+        assert len(blocks) == 11
+        assert sum(1 for b in blocks if b.se is not None) == 9
+
+    def test_mobilenet_v3_large(self):
+        from paddle_infer_tpu.vision.models import mobilenet_v3_large
+
+        m = mobilenet_v3_large(num_classes=10)
+        self._check(m)
+        blocks = [l for l in m.sublayers()
+                  if l.__class__.__name__ == "_InvertedResidualV3"]
+        assert len(blocks) == 15
+
+    def test_mobilenet_v3_scale(self):
+        from paddle_infer_tpu.vision.models import mobilenet_v3_small
+
+        self._check(mobilenet_v3_small(scale=0.5, num_classes=10))
+
+    def test_resnext50(self):
+        from paddle_infer_tpu.vision.models import resnext50_32x4d
+        from paddle_infer_tpu.nn.layers_common import Conv2D
+
+        m = resnext50_32x4d(num_classes=10)
+        self._check(m)
+        assert any(getattr(l, "groups", 1) == 32 for l in m.sublayers()
+                   if isinstance(l, Conv2D))
+
+    def test_wide_resnet50(self):
+        from paddle_infer_tpu.vision.models import (resnet50,
+                                                    wide_resnet50_2)
+
+        m = wide_resnet50_2(num_classes=10)
+        self._check(m)
+        n_wide = sum(int(np.prod(p.shape)) for p in m.parameters())
+        n_base = sum(int(np.prod(p.shape))
+                     for p in resnet50(num_classes=10).parameters())
+        assert n_wide > 1.5 * n_base

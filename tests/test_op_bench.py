@@ -34,8 +34,16 @@ def test_cli_check_passes_against_committed_baseline(tmp_path):
     env = dict(os.environ)
     env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
     env.pop("PALLAS_AXON_POOL_IPS", None)
-    r = subprocess.run(
-        [sys.executable, os.path.join(ROOT, "tools", "op_bench.py"),
-         "--check", "--reps", "3", "--tolerance", "8.0"],
-        capture_output=True, text=True, env=env, timeout=600)
+    cmd = [sys.executable, os.path.join(ROOT, "tools", "op_bench.py"),
+           "--check", "--reps", "3", "--tolerance", "8.0"]
+    r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                       timeout=600)
+    if r.returncode != 0:
+        # One retry: an oversubscribed CI host (suite running next to a
+        # TPU bench) can blow even the 8x tolerance transiently; a real
+        # regression fails both runs.
+        print("op_bench first run failed, retrying; stderr:\n"
+              + r.stderr[-2000:])
+        r = subprocess.run(cmd, capture_output=True, text=True, env=env,
+                           timeout=600)
     assert r.returncode == 0, r.stderr[-500:]
