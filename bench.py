@@ -259,8 +259,12 @@ def _resnet50_throughput(on_tpu: bool):
 
     step = FleetTrainStep(model, loss_fn, opt, strategy=strategy)
     rng = np.random.RandomState(0)
-    x = rng.rand(batch, 3, size, size).astype(np.float32)
-    y = rng.randint(0, 1000, (batch,)).astype(np.int32)
+    # Device-put the batch ONCE: re-feeding numpy would push ~38 MB
+    # through the axon tunnel per step and the transfer, not the chip,
+    # would set the number (see the benchmarking gotcha in the verify
+    # skill).
+    x = pit.to_tensor(rng.rand(batch, 3, size, size).astype(np.float32))
+    y = pit.to_tensor(rng.randint(0, 1000, (batch,)).astype(np.int32))
     step(x, y)
     step(x, y).numpy()                     # compile + settle
     iters = 20 if on_tpu else 2

@@ -27,6 +27,7 @@ Serialization: ``to_dict``/``from_dict`` are the framework.proto analog
 """
 from __future__ import annotations
 
+import inspect
 import json
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -350,8 +351,8 @@ class PassManager:
     editable like pass_builder()->DeletePass()."""
 
     DEFAULT = ["delete_dropout_pass", "constant_fold_pass", "cse_pass",
-               "fuse_matmul_add_pass", "fuse_attention_pass",
-               "fuse_ffn_pass", "dce_pass"]
+               "fold_conv_bn_pass", "fuse_matmul_add_pass",
+               "fuse_attention_pass", "fuse_ffn_pass", "dce_pass"]
 
     def __init__(self, passes: Optional[List[str]] = None):
         self.passes = list(self.DEFAULT if passes is None else passes)
@@ -362,9 +363,19 @@ class PassManager:
     def append_pass(self, name):
         self.passes.append(name)
 
-    def run(self, program: Program) -> Program:
+    def run(self, program: Program,
+            params: Optional[Dict[str, Any]] = None) -> Program:
+        """``params`` (state-dict-name -> array) lets weight-rewriting
+        passes fold numerically, the way the reference's fuse passes read
+        persistable tensors from the scope (conv_bn_fuse_pass.cc); passes
+        that don't declare a ``params`` argument run unchanged."""
         for name in self.passes:
-            program = _PASS_REGISTRY[name](program)
+            fn = _PASS_REGISTRY[name]
+            if params is not None and \
+                    "params" in inspect.signature(fn).parameters:
+                program = fn(program, params=params)
+            else:
+                program = fn(program)
         return program
 
 
@@ -572,7 +583,7 @@ def fuse_attention_pass(program: Program) -> Program:
             def _scoreish(v):
                 p = producer.get(v)
                 return p is not None and program.ops[p].name in (
-                    "matmul", "scale", "multiply")
+                    "matmul", "scale", "multiply", "divide")
             a, b = node.inputs
             if _scoreish(a):
                 cur_v, mask_v = a, b
@@ -583,11 +594,38 @@ def fuse_attention_pass(program: Program) -> Program:
             chain.append(node_i)
             node_i = producer.get(cur_v)
             node = program.ops[node_i]
-        if node.name == "scale" and node.attrs.get("bias", 0.0) == 0.0 \
-                and sole(node.outputs[0], chain[-1]):
-            scale = float(node.attrs.get("scale", 1.0))
+        def _const_scalar(v):
+            var = program.vars.get(v)
+            if var is not None and var.kind == "const" \
+                    and var.const_value is not None \
+                    and np.asarray(var.const_value).size == 1:
+                return float(np.asarray(var.const_value).reshape(()))
+            return None
+
+        # optional scaling: a scale op, or x/sqrt(d) (divide by const
+        # scalar), or x*inv_sqrt_d (multiply) — all idioms user
+        # transformers actually write
+        scl = None
+        if sole(node.outputs[0], chain[-1]):
+            if node.name == "scale" and node.attrs.get("bias", 0.0) == 0.0:
+                scl = (float(node.attrs.get("scale", 1.0)),
+                       node.inputs[0])
+            elif node.name in ("divide", "multiply") and not node.attrs \
+                    and len(node.inputs) == 2:
+                a, b = node.inputs
+                cb = _const_scalar(b)
+                if node.name == "divide":
+                    if cb is not None and cb != 0.0:
+                        scl = (1.0 / cb, a)
+                elif cb is not None:
+                    scl = (cb, a)
+                else:
+                    ca = _const_scalar(a)
+                    if ca is not None:
+                        scl = (ca, b)
+        if scl is not None:
+            scale, cur_v = scl
             chain.append(node_i)
-            cur_v = node.inputs[0]
             node_i = producer.get(cur_v)
             if node_i is None:
                 continue
@@ -596,43 +634,84 @@ def fuse_attention_pass(program: Program) -> Program:
                 or not sole(node.outputs[0], chain[-1]):
             continue
         qv, kv = node.inputs
+        rank = len(program.vars[qv].shape)
         if not node.attrs.get("transpose_y"):
             # explicit transpose(k, [..., d, s]) feeding the scores
             kp = producer.get(kv)
             if kp is None or program.ops[kp].name != "transpose":
                 continue
             perm = tuple(program.ops[kp].attrs.get("perm", ()))
-            if perm != (0, 1, 3, 2) or not sole(kv, node_i):
+            if perm != {4: (0, 1, 3, 2), 3: (0, 2, 1)}.get(rank) \
+                    or not sole(kv, node_i):
                 continue
             chain.append(kp)
             kv = program.ops[kp].inputs[0]
         chain.append(node_i)
         qshape = program.vars[qv].shape
-        if len(qshape) != 4:
+        if len(qshape) not in (3, 4):
             continue
 
-        # build the replacement: transpose to [b,s,h,d], sdpa, transpose
-        # back into mm2's output var
-        def tvar(src):
-            s0 = program.vars[src].shape
-            return program.new_var(
-                "tmp", (s0[0], s0[2], s0[1], s0[3]),
-                program.vars[src].dtype)
-        tq, tk, tv = tvar(qv), tvar(kv), tvar(vv)
-        so = program.new_var("tmp", program.vars[tq].shape,
-                             program.vars[qv].dtype)
-        perm = (0, 2, 1, 3)
-        new_ops = [
-            OpNode("transpose", [qv], [tq], {"perm": perm}),
-            OpNode("transpose", [kv], [tk], {"perm": perm}),
-            OpNode("transpose", [vv], [tv], {"perm": perm}),
+        sdpa_attrs = {
             # scale=1.0 when no scale op was matched: sdpa would otherwise
             # default to 1/sqrt(d), which the original graph never applied
-            OpNode("sdpa", [tq, tk, tv] + ([mask_v] if mask_v is not None
-                                           else []),
-                   [so], {"scale": scale if scale is not None else 1.0}),
-            OpNode("transpose", [so], list(mm2.outputs), {"perm": perm}),
-        ]
+            "scale": scale if scale is not None else 1.0}
+        if len(qshape) == 4:
+            # [b,h,s,d] -> transpose to sdpa's [b,s,h,d] and back
+            def tvar(src):
+                s0 = program.vars[src].shape
+                return program.new_var(
+                    "tmp", (s0[0], s0[2], s0[1], s0[3]),
+                    program.vars[src].dtype)
+            tq, tk, tv = tvar(qv), tvar(kv), tvar(vv)
+            so = program.new_var("tmp", program.vars[tq].shape,
+                                 program.vars[qv].dtype)
+            perm = (0, 2, 1, 3)
+            new_ops = [
+                OpNode("transpose", [qv], [tq], {"perm": perm}),
+                OpNode("transpose", [kv], [tk], {"perm": perm}),
+                OpNode("transpose", [vv], [tv], {"perm": perm}),
+                OpNode("sdpa", [tq, tk, tv]
+                       + ([mask_v] if mask_v is not None else []),
+                       [so], sdpa_attrs),
+                OpNode("transpose", [so], list(mm2.outputs),
+                       {"perm": perm}),
+            ]
+        else:
+            # single-head [b,s,d]: bracket with reshapes to [b,s,1,d]
+            def rvar(src):
+                s0 = program.vars[src].shape
+                return program.new_var("tmp", (s0[0], s0[1], 1, s0[2]),
+                                       program.vars[src].dtype)
+            rq, rk, rv = rvar(qv), rvar(kv), rvar(vv)
+            so = program.new_var("tmp", program.vars[rq].shape,
+                                 program.vars[qv].dtype)
+            m_in = []
+            pre_mask = []
+            if mask_v is not None:
+                ms = program.vars[mask_v].shape
+                if len(ms) == 3:
+                    # (b,s,s) -> (b,1,s,s) so it broadcasts over heads
+                    mr = program.new_var(
+                        "tmp", (ms[0], 1, ms[1], ms[2]),
+                        program.vars[mask_v].dtype)
+                    pre_mask = [OpNode("reshape", [mask_v], [mr],
+                                       {"shape": (ms[0], 1, ms[1],
+                                                  ms[2])})]
+                    m_in = [mr]
+                else:
+                    m_in = [mask_v]
+            oshape = tuple(program.vars[mm2.outputs[0]].shape)
+            new_ops = pre_mask + [
+                OpNode("reshape", [qv], [rq],
+                       {"shape": program.vars[rq].shape}),
+                OpNode("reshape", [kv], [rk],
+                       {"shape": program.vars[rk].shape}),
+                OpNode("reshape", [vv], [rv],
+                       {"shape": program.vars[rv].shape}),
+                OpNode("sdpa", [rq, rk, rv] + m_in, [so], sdpa_attrs),
+                OpNode("reshape", [so], list(mm2.outputs),
+                       {"shape": oshape}),
+            ]
         removed.update(chain)
         removed.add(mi2)
         # anchor at mm2: every input (q/k/v/mask) is produced before the
@@ -704,6 +783,146 @@ def fuse_ffn_pass(program: Program) -> Program:
             continue
         new_list.append(op)
     program.ops = new_list
+    return program
+
+
+def _eval_from_weights(program: Program, vid: int, params, producer,
+                       _depth=0):
+    """Evaluate var ``vid`` to a numpy array when it derives only from
+    consts and params — the IR analog of the reference pattern-detector's
+    persistable-input test (conv_bn_fuse_pass reads scope weights)."""
+    if _depth > 8:
+        return None
+    var = program.vars[vid]
+    if var.kind == "const":
+        return np.asarray(var.const_value)
+    if var.kind == "param":
+        p = params.get(var.name) if params else None
+        if p is None:
+            return None
+        return np.asarray(p._data if isinstance(p, Tensor) else p)
+    idx = producer.get(vid)
+    if idx is None:
+        return None
+    op = program.ops[idx]
+    if op.name in _NONDETERMINISTIC_OPS:
+        return None
+    args = []
+    for v in op.inputs:
+        if v < 0:
+            args.append(None)
+            continue
+        a = _eval_from_weights(program, v, params, producer, _depth + 1)
+        if a is None:
+            return None
+        args.append(a)
+    try:
+        out = dispatch_mod.raw(op.name, *args, **op.attrs)
+    except Exception:
+        return None
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    return np.asarray(outs[list(op.outputs).index(vid)])
+
+
+@register_ir_pass("fold_conv_bn_pass")
+def fold_conv_bn_pass(program: Program, params=None) -> Program:
+    """Fold the channelwise affine chain after a bias-free conv into the
+    conv weight (reference ir/conv_bn_fuse_pass.cc — there a named
+    batch_norm op; here eval-mode BN traces as subtract/multiply/add
+    against consts and reshaped BN params, so the pass matches the
+    decomposed chain).  Produces new ``<w>@bn_fold`` / ``@bn_fold_bias``
+    param entries in ``params`` (folded once, numerically — zero per-call
+    cost) and one bias add, deleting the whole activation-path chain.
+    No-op when PassManager.run was not given param values."""
+    if not params:
+        return program
+    producer = program.producer()
+    consumers = program.consumers()
+    fetched = set(program.fetch_ids)
+    delete: set = set()
+    rewrite_first: Dict[int, Optional[OpNode]] = {}
+    mapping: Dict[int, int] = {}
+    for ci, conv in enumerate(program.ops):
+        if conv.name not in ("conv1d", "conv2d", "conv3d"):
+            continue
+        if len(conv.inputs) > 2 and conv.inputs[2] >= 0:
+            continue                      # conv already has a bias input
+        wvar = program.vars[conv.inputs[1]]
+        if wvar.kind != "param":
+            continue
+        w = params.get(wvar.name)
+        if w is None:
+            continue
+        h = conv.outputs[0]
+        out_shape = program.vars[h].shape
+        ch = out_shape[1]
+        bshape = tuple(ch if i == 1 else 1 for i in range(len(out_shape)))
+        s = np.ones((), np.float64)
+        t = np.zeros((), np.float64)
+        chain: List[int] = []
+        cur = h
+        while True:
+            use = [u for u in consumers.get(cur, []) if u not in delete]
+            if cur in fetched or len(use) != 1:
+                break
+            op = program.ops[use[0]]
+            if op.name not in ("add", "subtract", "multiply") \
+                    or op.attrs or len(op.inputs) != 2 \
+                    or cur not in op.inputs:
+                break
+            if op.name == "subtract" and op.inputs[0] != cur:
+                break                     # c - h flips sign; BN never does
+            other = op.inputs[1] if op.inputs[0] == cur else op.inputs[0]
+            c = _eval_from_weights(program, other, params, producer)
+            if c is None:
+                break
+            c = np.asarray(c, np.float64)
+            try:
+                np.broadcast_to(c, bshape)
+            except ValueError:
+                break                     # not channelwise
+            if op.name == "add":
+                t = t + c
+            elif op.name == "subtract":
+                t = t - c
+            else:
+                s = s * c
+                t = t * c
+            chain.append(use[0])
+            cur = op.outputs[0]
+        if not chain:
+            continue
+        w_np = np.asarray(w._data if isinstance(w, Tensor) else w)
+        s_ch = np.broadcast_to(s, bshape).reshape(
+            (ch,) + (1,) * (w_np.ndim - 1))
+        new_w = (w_np.astype(np.float64) * s_ch).astype(w_np.dtype)
+        w_name = f"{wvar.name}@bn_fold{ci}"
+        params[w_name] = jnp.asarray(new_w)
+        w_vid = program.new_var("param", w_np.shape, str(w_np.dtype),
+                                name=w_name)
+        conv.inputs[1] = w_vid
+        t_full = np.broadcast_to(t, bshape)
+        if np.any(t_full != 0):
+            dt = program.vars[h].dtype
+            b_name = f"{wvar.name}@bn_fold_bias{ci}"
+            params[b_name] = jnp.asarray(t_full.astype(dt))
+            b_vid = program.new_var("param", bshape, dt, name=b_name)
+            rewrite_first[chain[0]] = OpNode("add", [h, b_vid], [cur])
+        else:
+            rewrite_first[chain[0]] = None
+            mapping[cur] = h
+        delete.update(chain)
+    if not delete:
+        return program
+    new_ops = []
+    for i, op in enumerate(program.ops):
+        if i in rewrite_first and rewrite_first[i] is not None:
+            new_ops.append(rewrite_first[i])
+        if i in delete:
+            continue
+        new_ops.append(op)
+    program.ops = new_ops
+    _substitute(program, mapping)
     return program
 
 

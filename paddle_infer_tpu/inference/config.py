@@ -15,6 +15,26 @@ class PrecisionType:
     Int8 = "int8"
 
 
+_PRECISION_ALIASES = {
+    "float32": PrecisionType.Float32, "fp32": PrecisionType.Float32,
+    "bfloat16": PrecisionType.Bfloat16, "bf16": PrecisionType.Bfloat16,
+    "float16": PrecisionType.Half, "fp16": PrecisionType.Half,
+    "half": PrecisionType.Half,
+    "int8": PrecisionType.Int8,
+}
+
+
+def _norm_precision(precision: str) -> str:
+    """Accept the common short spellings; reject typos loudly instead of
+    silently serving float32."""
+    try:
+        return _PRECISION_ALIASES[str(precision).lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown precision {precision!r}; one of "
+            f"{sorted(set(_PRECISION_ALIASES))}") from None
+
+
 @dataclass
 class Config:
     """Create with model path prefix (the jit.save export) or program+params
@@ -82,7 +102,7 @@ class Config:
         raise RuntimeError("paddle_infer_tpu runs on TPU; no GPU backend")
 
     def enable_tpu(self, precision=PrecisionType.Bfloat16):
-        self._precision = precision
+        self._precision = _norm_precision(precision)
 
     def disable_gpu(self):
         pass
@@ -110,7 +130,7 @@ class Config:
         self._passes_disabled.add(name)
 
     def enable_low_precision(self, precision=PrecisionType.Bfloat16):
-        self._precision = precision
+        self._precision = _norm_precision(precision)
 
     def enable_weight_only_quant(self, algo="int8"):
         self._weight_only_quant = algo

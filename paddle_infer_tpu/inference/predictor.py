@@ -129,16 +129,25 @@ class Predictor:
         from ..framework.ir import PassManager
 
         self._applied_passes = list(applied_early)
+        params = {n: p._data for n, p in layer.named_parameters()}
         if getattr(self._config, "_ir_optim", True):
             pm = PassManager()
             disabled = getattr(self._config, "_passes_disabled", ())
             for name in disabled:       # same knob as the artifact path
                 pm.delete_pass(name)
-            prog = pm.run(prog)
+            # param values let weight-folding passes (fold_conv_bn_pass)
+            # rewrite numerically, like the reference passes reading the
+            # scope; they add folded entries to this dict
+            prog = pm.run(prog, params=params)
             self._applied_passes = applied_early + list(pm.passes)
+            # fold passes replace weights (<w>@bn_foldN): drop entries no
+            # program var references so the precision cast / mesh
+            # device_put below don't ship dead conv weights to the chip
+            live = set(prog.param_names())
+            params = {n: v for n, v in params.items() if n in live}
         self._program = prog
         self._program_fn = prog.compile()
-        self._params = {n: p._data for n, p in layer.named_parameters()}
+        self._params = params
         # precision knob, same semantics as the artifact path's
         # precision_cast_pass (params cast; activations follow by
         # promotion inside the compiled program)

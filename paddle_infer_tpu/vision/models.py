@@ -915,14 +915,8 @@ def wide_resnet101_2(**kw):
 
 
 # --- MobileNetV3 (reference mobilenetv3.py; specs from the paper,
-#     "Searching for MobileNetV3") ---
-
-def _make_divisible(v, divisor=8):
-    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
-    if new_v < 0.9 * v:
-        new_v += divisor
-    return new_v
-
+#     "Searching for MobileNetV3"; channel rounding via the module's
+#     _make_divisible helper above) ---
 
 class _SqueezeExcite(Layer):
     """SE with relu/hardsigmoid gating as in MobileNetV3."""

@@ -219,3 +219,199 @@ class TestCSE:
         prog = ir.trace_program(fn, [Tensor(x)])
         opt = ir.PassManager(["cse_pass"]).run(prog)
         assert sum(op.name == "dropout" for op in opt.ops) == 2
+
+
+class TestFoldConvBN:
+    """fold_conv_bn_pass (reference ir/conv_bn_fuse_pass.cc): eval-mode
+    BN decomposes into a channelwise affine chain; with param values the
+    pass folds it into the conv weight numerically."""
+
+    def _traced(self):
+        from paddle_infer_tpu.nn.layers_common import (BatchNorm2D, Conv2D,
+                                                       ReLU, Sequential)
+
+        m = Sequential(Conv2D(3, 8, 3, padding=1, bias_attr=False),
+                       BatchNorm2D(8), ReLU())
+        m.eval()
+        rs = np.random.RandomState(7)
+        m[1]._mean.set_value(rs.rand(8).astype("float32"))
+        m[1]._variance.set_value((rs.rand(8) + 0.5).astype("float32"))
+        m[1].weight.set_value(rs.rand(8).astype("float32"))
+        m[1].bias.set_value(rs.rand(8).astype("float32"))
+        x = pit.to_tensor(rs.randn(2, 3, 8, 8).astype("float32"))
+        return m, x
+
+    def test_chain_folds_to_conv_add(self):
+        m, x = self._traced()
+        ref = m(x).numpy()
+        prog = ir.trace_layer(m, [x])
+        params = {n: p._data for n, p in m.named_parameters()}
+        opt = ir.PassManager().run(prog, params=params)
+        names = [op.name for op in opt.ops]
+        assert names == ["conv2d", "add", "relu"], names
+        assert any("@bn_fold" in n for n in params)
+        out = opt.run([x], params)[0].numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    def test_noop_without_params(self):
+        m, x = self._traced()
+        prog = ir.trace_layer(m, [x])
+        n_before = len(prog.ops)
+        opt = ir.PassManager(["fold_conv_bn_pass"]).run(prog)
+        assert len(opt.ops) == n_before
+
+    def test_conv_with_bias_untouched(self):
+        from paddle_infer_tpu.nn.layers_common import (BatchNorm2D, Conv2D,
+                                                       Sequential)
+
+        m = Sequential(Conv2D(3, 4, 3, padding=1), BatchNorm2D(4))
+        m.eval()
+        x = pit.to_tensor(np.random.RandomState(0).randn(
+            1, 3, 8, 8).astype("float32"))
+        ref = m(x).numpy()
+        prog = ir.trace_layer(m, [x])
+        params = {n: p._data for n, p in m.named_parameters()}
+        opt = ir.PassManager().run(prog, params=params)
+        assert not any("@bn_fold" in (v.name or "")
+                       for v in opt.vars.values())
+        np.testing.assert_allclose(opt.run([x], params)[0].numpy(), ref,
+                                   atol=1e-4)
+
+    def test_fetched_intermediate_not_folded(self):
+        m, x = self._traced()
+        prog = ir.trace_layer(m, [x])
+        # fetch the conv output too: the chain must stay
+        prog.fetch_ids.append(prog.ops[0].outputs[0])
+        params = {n: p._data for n, p in m.named_parameters()}
+        opt = ir.PassManager(["fold_conv_bn_pass"]).run(prog,
+                                                        params=params)
+        assert not any("@bn_fold" in (v.name or "")
+                       for v in opt.vars.values())
+
+    def test_resnet_block_through_predictor(self):
+        from paddle_infer_tpu.inference import Predictor
+        from paddle_infer_tpu.vision.models import resnet18
+
+        r = resnet18(num_classes=10)
+        r.eval()
+        x = pit.to_tensor(np.random.RandomState(5).randn(
+            1, 3, 32, 32).astype("float32"))
+        ref = r(x).numpy()
+        pred = Predictor.from_layer(r, [x])
+        n_fold = sum(1 for n in pred._params if "@bn_fold" in n)
+        assert n_fold >= 15        # every conv+bn pair in resnet18
+        got = pred.run([x.numpy()])[0]
+        np.testing.assert_allclose(got, ref, atol=1e-3)
+
+
+class TestAttentionScaleIdioms:
+    """fuse_attention_pass must catch the scaling idioms users actually
+    write: q@kT / sqrt(d) (divide by const) and single-head 3-D
+    attention (reference pattern zoo: multihead_matmul_fuse_pass covers
+    the equivalent graphs)."""
+
+    def _run(self, fwd, x):
+        prog = ir.trace_program(fwd, [x])
+        ref = fwd(x).numpy()
+        opt = ir.PassManager().run(prog)
+        out = opt.run([x], {})[0].numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+        return [op.name for op in opt.ops]
+
+    def test_divide_scaled_3d(self):
+        import math
+
+        import paddle_infer_tpu.nn.functional as F
+
+        rs = np.random.RandomState(0)
+        q = pit.to_tensor(rs.randn(2, 4, 8).astype("float32"))
+        k = pit.to_tensor(rs.randn(2, 4, 8).astype("float32"))
+        v = pit.to_tensor(rs.randn(2, 4, 8).astype("float32"))
+
+        def fwd(x):
+            att = F.softmax(
+                pit.matmul(x + q, (x + k).transpose([0, 2, 1]))
+                / math.sqrt(8.0), axis=-1)
+            return pit.matmul(att, x + v)
+
+        x = pit.to_tensor(rs.randn(2, 4, 8).astype("float32"))
+        names = self._run(fwd, x)
+        assert "sdpa" in names, names
+        assert "softmax" not in names
+
+    def test_multiply_scaled_4d(self):
+        import paddle_infer_tpu.nn.functional as F
+
+        rs = np.random.RandomState(1)
+        x = pit.to_tensor(rs.randn(2, 2, 4, 8).astype("float32"))
+
+        def fwd(t):
+            att = F.softmax(
+                pit.matmul(t, t.transpose([0, 1, 3, 2])) * 0.125,
+                axis=-1)
+            return pit.matmul(att, t)
+
+        names = self._run(fwd, x)
+        assert "sdpa" in names, names
+
+
+class TestPrecisionAliases:
+    def test_short_spellings(self):
+        from paddle_infer_tpu.inference import Config
+        from paddle_infer_tpu.inference.config import PrecisionType
+
+        for alias, want in (("bf16", PrecisionType.Bfloat16),
+                            ("fp16", PrecisionType.Half),
+                            ("half", PrecisionType.Half),
+                            ("fp32", PrecisionType.Float32)):
+            c = Config()
+            c.enable_tpu(precision=alias)
+            assert c.precision() == want
+
+    def test_typo_rejected(self):
+        from paddle_infer_tpu.inference import Config
+
+        with pytest.raises(ValueError):
+            Config().enable_tpu(precision="bf17")
+
+
+def test_divide_scaled_with_mask_fuses():
+    """Regression: scores/sqrt(d) + mask must still reach sdpa (the
+    _scoreish walk has to accept a divide producer)."""
+    import math
+
+    rs = np.random.RandomState(3)
+    mask = pit.to_tensor(
+        np.triu(np.full((4, 4), -1e9, np.float32), k=1))
+
+    def fwd(x):
+        att = F.softmax(
+            pit.matmul(x, x.transpose([0, 1, 3, 2])) / math.sqrt(8.0)
+            + mask, axis=-1)
+        return pit.matmul(att, x)
+
+    x = pit.to_tensor(rs.randn(2, 2, 4, 8).astype("float32"))
+    prog = ir.trace_program(fwd, [x])
+    ref = fwd(x).numpy()
+    opt = ir.PassManager().run(prog)
+    names = [op.name for op in opt.ops]
+    assert "sdpa" in names, names
+    out = opt.run([x], {})[0].numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_predictor_prunes_dead_params():
+    from paddle_infer_tpu.inference import Predictor
+    from paddle_infer_tpu.nn.layers_common import (BatchNorm2D, Conv2D,
+                                                   Sequential)
+
+    m = Sequential(Conv2D(3, 4, 3, padding=1, bias_attr=False),
+                   BatchNorm2D(4))
+    m.eval()
+    x = pit.to_tensor(np.random.RandomState(0).randn(
+        1, 3, 8, 8).astype("float32"))
+    pred = Predictor.from_layer(m, [x])
+    # the folded weight replaces the original + BN affine params
+    assert any("@bn_fold" in n for n in pred._params)
+    assert "0.weight" not in pred._params
+    assert "1.weight" not in pred._params
