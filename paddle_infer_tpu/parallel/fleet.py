@@ -208,13 +208,23 @@ def lr_scheduler_tick(optimizer):
 
 def make_pure_loss(model: Layer, loss_fn: Callable, strategy,
                    static_kwargs) -> Callable:
-    """``(params, key, batch_arrays) -> f32 scalar`` closure over the eager
-    model — the traced core every compiled train step (FleetTrainStep, the
-    LocalSGD/DGC meta-optimizer steps) shares.  Applies the strategy's AMP
-    autocast state and recompute wrapping."""
+    """``(params, buffers, key, batch_arrays) -> (f32 scalar, new_buffers)``
+    closure over the eager model — the traced core every compiled train
+    step (FleetTrainStep, the LocalSGD/DGC meta-optimizer steps) shares.
+    Applies the strategy's AMP autocast state and recompute wrapping.
 
-    def pure(params, key, batch):
-        with prandom.trace_key_scope(key):
+    Buffer mutations inside the forward (BN running stats via
+    ``jit.trace.update_buffer``) are captured by a trace scope and
+    returned functionally — same contract as ``jit.to_static`` — instead
+    of ``set_value``-ing a traced array into the eager buffer (which
+    would both freeze the stats and poison the buffer with a leaked
+    tracer)."""
+    from ..jit.trace import trace_scope
+
+    buf_names = {id(b): n for n, b in model.named_buffers()}
+
+    def pure(params, buffers, key, batch):
+        with trace_scope() as scope, prandom.trace_key_scope(key):
             prev_amp = None
             if strategy.amp:
                 from ..core.dtype import convert_dtype
@@ -225,15 +235,21 @@ def make_pure_loss(model: Layer, loss_fn: Callable, strategy,
                     strategy.amp_configs.get("level", "O1"))
             try:
                 tensors = [Tensor(b) for b in batch]
-                loss = loss_fn(model.functional_caller(params), *tensors,
-                               **static_kwargs)
+                loss = loss_fn(
+                    model.functional_caller(params, buffers), *tensors,
+                    **static_kwargs)
             finally:
                 if prev_amp is not None:
                     dispatch_mod.set_amp_state(
                         prev_amp["enabled"], prev_amp["dtype"],
                         prev_amp["level"])
-            arr = loss._data if isinstance(loss, Tensor) else loss
-            return arr.astype(jnp.float32)
+        new_buffers = dict(buffers)
+        for t, arr in scope.buffer_updates:
+            name = buf_names.get(id(t))
+            if name is not None and name in new_buffers:
+                new_buffers[name] = arr.astype(new_buffers[name].dtype)
+        arr = loss._data if isinstance(loss, Tensor) else loss
+        return arr.astype(jnp.float32), new_buffers
 
     if strategy.recompute:
         pure = jax.checkpoint(pure, static_argnums=())
@@ -281,6 +297,12 @@ class FleetTrainStep:
                                     self.mesh)
             for n, p in self._param_info}
         self.params = self._place_params()
+        # non-trainable state (BN running stats etc.) carried through the
+        # compiled step functionally, replicated over the mesh
+        self._buffer_info = list(model.named_buffers())
+        rep_sh = _named_sharding(self.mesh, P())
+        self.buffers = {n: jax.device_put(b._data, rep_sh)
+                        for n, b in self._buffer_info}
         self.opt_state = None
         self._opt_specs = None
 
@@ -381,19 +403,20 @@ class FleetTrainStep:
 
             return {n: pin(g, param_specs[n]) for n, g in grads.items()}
 
-        def step_fn(params, opt_state, key, lr, step, batch):
+        def step_fn(params, opt_state, buffers, key, lr, step, batch):
             if k_steps > 1:
                 def micro(carry, idx_mb):
                     i, mb = idx_mb
-                    acc = carry
-                    loss, grads = jax.value_and_grad(pure_loss)(
-                        params, jax.random.fold_in(key, i), mb)
-                    return jax.tree_util.tree_map(jnp.add, acc,
-                                                  grads), loss
+                    acc, bufs = carry
+                    (loss, bufs), grads = jax.value_and_grad(
+                        pure_loss, has_aux=True)(
+                        params, bufs, jax.random.fold_in(key, i), mb)
+                    return (jax.tree_util.tree_map(jnp.add, acc, grads),
+                            bufs), loss
 
                 zero = jax.tree_util.tree_map(jnp.zeros_like, params)
-                grads, losses = jax.lax.scan(
-                    micro, zero,
+                (grads, buffers), losses = jax.lax.scan(
+                    micro, (zero, buffers),
                     (jnp.arange(k_steps),
                      jax.tree_util.tree_map(
                          lambda b: b.reshape((k_steps, b.shape[0] // k_steps)
@@ -401,8 +424,8 @@ class FleetTrainStep:
                 grads = jax.tree_util.tree_map(lambda g: g / k_steps, grads)
                 loss = losses.mean()
             else:
-                loss, grads = jax.value_and_grad(pure_loss)(params, key,
-                                                            batch)
+                (loss, buffers), grads = jax.value_and_grad(
+                    pure_loss, has_aux=True)(params, buffers, key, batch)
             grads = grad_constraint(grads)
             new_params, new_state = opt.functional_update(
                 params, grads, opt_state, lr=lr, step=step)
@@ -411,7 +434,7 @@ class FleetTrainStep:
                 n: jax.lax.with_sharding_constraint(
                     a, _named_sharding(mesh, param_specs[n]))
                 for n, a in new_params.items()}
-            return new_params, new_state, loss
+            return new_params, new_state, buffers, loss
 
         param_sh = _tree_shardings(mesh, param_specs)
         opt_sh = jax.tree_util.tree_map(
@@ -419,11 +442,13 @@ class FleetTrainStep:
             is_leaf=lambda x: isinstance(x, P))
         batch_sh = self._batch_shardings(batch_sig)
         rep = _named_sharding(mesh, P())
-        donate = (0, 1) if self.donate else ()
+        buf_sh = {n: rep for n in self.buffers}
+        donate = (0, 1, 2) if self.donate else ()
         return jax.jit(
             step_fn,
-            in_shardings=(param_sh, opt_sh, rep, rep, rep, batch_sh),
-            out_shardings=(param_sh, opt_sh, rep),
+            in_shardings=(param_sh, opt_sh, buf_sh, rep, rep, rep,
+                          batch_sh),
+            out_shardings=(param_sh, opt_sh, buf_sh, rep),
             donate_argnums=donate)
 
     def _batch_shardings(self, batch_sig):
@@ -460,8 +485,8 @@ class FleetTrainStep:
         self._step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = prandom.next_key()
-        self.params, self.opt_state, loss = fn(
-            self.params, self.opt_state, key, lr,
+        self.params, self.opt_state, self.buffers, loss = fn(
+            self.params, self.opt_state, self.buffers, key, lr,
             jnp.asarray(self._step_count, jnp.int32), arrays)
         lr_scheduler_tick(self.optimizer)
         return Tensor(loss)
@@ -487,7 +512,7 @@ class FleetTrainStep:
         if fn is None:
             raise RuntimeError("step this batch signature once first")
         return fn.lower(
-            self.params, self.opt_state, prandom.next_key(),
+            self.params, self.opt_state, self.buffers, prandom.next_key(),
             jnp.asarray(0.0, jnp.float32), jnp.asarray(0, jnp.int32),
             arrays).compile()
 
@@ -511,6 +536,8 @@ class FleetTrainStep:
         for checkpointing via the normal state_dict path."""
         for n, p in self._param_info:
             p._data = jnp.asarray(self.params[n])
+        for n, b in self._buffer_info:
+            b._data = jnp.asarray(self.buffers[n])
         return self.model
 
     def state_dict(self):

@@ -133,13 +133,19 @@ class LocalSGDTrainStep(_MetaStepBase):
         pure_loss = make_pure_loss(self.model, self.loss_fn, self.strategy,
                                    static_kwargs)
         opt, k = self.optimizer, self.k_steps
+        # buffers captured as constants: LocalSGD replicas would need a
+        # per-replica buffer copy to carry BN stats; frozen stats keep the
+        # compiled program pure without that state (FleetTrainStep is the
+        # path that updates them)
+        buffers0 = {n: b._data for n, b in self.model.named_buffers()}
 
         def local_fn(params_blk, opt_blk, key, lr, step, batch):
             p_loc = jax.tree_util.tree_map(lambda x: x[0], params_blk)
             s_loc = jax.tree_util.tree_map(lambda x: x[0], opt_blk)
             rank = jax.lax.axis_index("dp")
-            loss, grads = jax.value_and_grad(pure_loss)(
-                p_loc, jax.random.fold_in(key, rank), batch)
+            (loss, _), grads = jax.value_and_grad(
+                pure_loss, has_aux=True)(
+                p_loc, buffers0, jax.random.fold_in(key, rank), batch)
             new_p, new_s = opt.functional_update(p_loc, grads, s_loc,
                                                  lr=lr, step=step)
             new_p = jax.lax.cond(
@@ -327,12 +333,15 @@ class DGCTrainStep(_MetaStepBase):
         clip = self.clip_norm
         sparsity, rampup = self.sparsity, self.rampup_begin_step
 
+        buffers0 = {n: b._data for n, b in self.model.named_buffers()}
+
         def local_fn(params, res, key, lr, step, batch):
             u = jax.tree_util.tree_map(lambda x: x[0], res["u"])
             v = jax.tree_util.tree_map(lambda x: x[0], res["v"])
             rank = jax.lax.axis_index("dp")
-            loss, grads = jax.value_and_grad(pure_loss)(
-                params, jax.random.fold_in(key, rank), batch)
+            (loss, _), grads = jax.value_and_grad(
+                pure_loss, has_aux=True)(
+                params, buffers0, jax.random.fold_in(key, rank), batch)
             # step is 1-based; "> rampup" gives exactly rampup_begin_step
             # uncompressed warmup steps like the reference's 0-based ">="
             active = step > rampup

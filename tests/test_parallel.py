@@ -348,3 +348,72 @@ class TestDataParallelWrapper:
         dp.apply_collective_grads()
         # replicated grads: AVG over 8 identical copies is identity
         np.testing.assert_allclose(lin.weight.grad.numpy(), g0, rtol=1e-6)
+
+
+class TestBatchNormInCompiledStep:
+    """BN running stats must be carried functionally through the compiled
+    step (the reference trains BN models under DataParallel as a matter of
+    course); before round 4 the traced update leaked a tracer into the
+    eager buffer and the stats silently never moved."""
+
+    def _bn_model(self):
+        return nn.Sequential(
+            nn.Conv2D(3, 8, 3, padding=1, bias_attr=False),
+            nn.BatchNorm2D(8), nn.ReLU(), nn.Flatten(),
+            nn.Linear(8 * 8 * 8, 4))
+
+    def test_running_stats_update_and_eval_works(self):
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = self._bn_model()
+        model.train()
+        opt = pit.optimizer.SGD(learning_rate=0.05,
+                                parameters=model.parameters())
+
+        def loss_fn(m, x, y):
+            return pit.nn.functional.cross_entropy(m(x), y)
+
+        step = FleetTrainStep(model, loss_fn, opt, strategy=strategy)
+        bn_mean0 = np.asarray(step.buffers["1._mean"]).copy()
+        rs = np.random.RandomState(0)
+        x = (rs.rand(16, 3, 8, 8) * 4 + 1).astype(np.float32)
+        y = rs.randint(0, 4, (16,)).astype(np.int64)
+        for _ in range(3):
+            loss = step(x, y)
+        assert np.isfinite(loss.numpy())
+        bn_mean = np.asarray(step.buffers["1._mean"])
+        assert not np.allclose(bn_mean, bn_mean0), \
+            "BN running mean never updated in the compiled step"
+        # sync back and eval the eager model: buffers must hold concrete
+        # arrays (a leaked tracer would throw here)
+        step.sync_params_to_model()
+        model.eval()
+        out = model(pit.to_tensor(x[:2]))
+        assert np.isfinite(out.numpy()).all()
+        # eager buffer received the carried stats
+        np.testing.assert_allclose(np.asarray(model[1]._mean._data),
+                                   bn_mean, rtol=1e-6)
+
+    def test_gradient_merge_carries_buffers(self):
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 8}
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = self._bn_model()
+        model.train()
+        opt = pit.optimizer.SGD(learning_rate=0.05,
+                                parameters=model.parameters())
+
+        def loss_fn(m, x, y):
+            return pit.nn.functional.cross_entropy(m(x), y)
+
+        step = FleetTrainStep(model, loss_fn, opt, strategy=strategy)
+        mean0 = np.asarray(step.buffers["1._mean"]).copy()
+        rs = np.random.RandomState(1)
+        x = (rs.rand(16, 3, 8, 8) * 2 + 3).astype(np.float32)
+        y = rs.randint(0, 4, (16,)).astype(np.int64)
+        loss = step(x, y)
+        assert np.isfinite(loss.numpy())
+        assert not np.allclose(np.asarray(step.buffers["1._mean"]), mean0)
