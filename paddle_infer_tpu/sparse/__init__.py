@@ -214,6 +214,8 @@ sqrt = _unary(jnp.sqrt)
 # the rest of the reference's zero-preserving unary family
 # (phi/api/yaml/sparse_ops.yaml — each applies to stored values only)
 abs = _unary(jnp.abs)
+acos = _unary(jnp.arccos)
+acosh = _unary(jnp.arccosh)
 asin = _unary(jnp.arcsin)
 asinh = _unary(jnp.arcsinh)
 atan = _unary(jnp.arctan)
@@ -381,13 +383,35 @@ class _SparseNN:
 nn = _SparseNN()
 
 
+def softmax(x, axis=-1):
+    """sparse_ops.yaml softmax (module-level functional form)."""
+    return _SparseNN.Softmax(axis)(x)
+
+
 def dense_to_csr(t):
     d = _arr(t)
     return SparseCsrTensor(jsparse.BCSR.fromdense(d))
 
 
-__all__ += ["coalesce", "mv", "addmm", "nn", "abs", "asin", "asinh",
+def _attach_layers():
+    """Conv3D/SubmConv3D/BatchNorm/SyncBatchNorm live in layers.py (they
+    need nn.Layer, imported lazily to keep package init order free)."""
+    from . import layers as _L
+
+    nn.Conv3D = _L.Conv3D
+    nn.SubmConv3D = _L.SubmConv3D
+    nn.BatchNorm = _L.BatchNorm
+    nn.SyncBatchNorm = _L.SyncBatchNorm
+    nn.functional = _L
+    return _L
+
+
+_attach_layers()
+
+
+__all__ += ["coalesce", "mv", "addmm", "nn", "abs", "acos", "acosh",
+            "asin", "asinh",
             "atan", "atanh", "neg", "deg2rad", "rad2deg",
             "sinh", "tan", "expm1", "log1p", "square",
             "relu6", "leaky_relu", "cast", "scale", "divide",
-            "divide_scalar", "full_like", "reshape", "slice"]
+            "divide_scalar", "full_like", "reshape", "slice", "softmax"]

@@ -1,0 +1,180 @@
+"""Sparse conv/norm layers (reference: paddle/phi/kernels/sparse/
+conv_kernel.* — the gather-GEMM-scatter "rulebook" 3-D sparse conv — and
+python/paddle/sparse/nn layers Conv3D/SubmConv3D/BatchNorm/SyncBatchNorm;
+yaml surface phi/api/yaml/sparse_ops.yaml conv3d, batch_norm_,
+sync_batch_norm_).
+
+TPU-first redesign.  The reference builds a rulebook (kernel-offset ->
+(in, out) index pairs) and runs gather + per-offset GEMM + scatter.  That
+lowering is irregular and memory-bound; on TPU the MXU wants dense,
+batched contractions, so here the conv densifies the bounding volume,
+runs ONE XLA conv3d (NDHWC, MXU-tiled), and re-sparsifies:
+
+* ``SubmConv3D`` — output sites == input sites (submanifold contract):
+  gather the dense output at the input indices; fully jittable.
+* ``Conv3D`` — output sites = occupancy-dilation of the input sites
+  (exactly the rulebook's output geometry): computed host-side with
+  numpy because output nnz is data-dependent — same eager-only contract
+  as the reference kernel, which also sizes its output from the data.
+
+For point-cloud workloads whose bounding grid is much larger than the
+active set this trades FLOPs for regularity — the documented TPU call
+(dense conv at 1-8% occupancy on a 64^3 grid still beats a gather/scatter
+program that cannot tile onto the MXU).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..nn import initializer as I
+from . import SparseCooTensor
+
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+def _dense_conv3d(dense, weight, stride, padding, dilation, groups):
+    """One MXU-tiled XLA conv: dense (N,D,H,W,C), weight (kd,kh,kw,I,O)."""
+    dn = lax.conv_dimension_numbers(dense.shape, weight.shape,
+                                    ("NDHWC", "DHWIO", "NDHWC"))
+    pad = [(p, p) for p in _triple(padding)]
+    return lax.conv_general_dilated(
+        dense, weight, window_strides=_triple(stride), padding=pad,
+        rhs_dilation=_triple(dilation), dimension_numbers=dn,
+        feature_group_count=groups)
+
+
+def conv3d(x: SparseCooTensor, weight, bias=None, stride=1, padding=0,
+           dilation=1, groups=1, subm=False):
+    """Functional sparse conv3d (sparse_ops.yaml conv3d).  ``x`` is a COO
+    tensor of shape (N, D, H, W, C); ``weight`` is (kd, kh, kw, I, O),
+    the reference's layout."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.nn.functional.conv3d expects a "
+                        "SparseCooTensor input")
+    w = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    b = None if bias is None else (
+        bias._data if isinstance(bias, Tensor) else jnp.asarray(bias))
+    dense = x._bcoo.todense()
+    if subm:
+        if _triple(stride) != (1, 1, 1):
+            raise ValueError("submanifold conv requires stride 1")
+        # the submanifold contract (output sites == input sites) implies
+        # kernel-centered same-padding; a different padding would shift
+        # the geometry, so reject it loudly rather than ignore it
+        same_pad = tuple((k - 1) // 2 * d for k, d in
+                         zip(w.shape[:3], _triple(dilation)))
+        if padding not in (0, same_pad) and _triple(padding) != same_pad:
+            raise ValueError(
+                f"submanifold conv geometry requires padding={same_pad} "
+                f"(kernel-centered); got {padding!r}")
+        out = _dense_conv3d(dense, w, 1, same_pad, dilation, groups)
+        if b is not None:
+            out = out + b
+        idx = x._bcoo.indices                       # [nnz, 4] n,d,h,w
+        vals = out[idx[:, 0], idx[:, 1], idx[:, 2], idx[:, 3]]
+        return SparseCooTensor(jsparse.BCOO((vals, idx),
+                                            shape=out.shape))
+    out = _dense_conv3d(dense, w, stride, padding, dilation, groups)
+    if b is not None:
+        out = out + b
+    # output geometry = occupancy dilated by the kernel support (the
+    # rulebook's out-index set) — data-dependent nnz, so host-side.
+    # Occupancy comes from the STORED INDEX SET, not the values: a site
+    # whose channel vector is all zero (e.g. post-ReLU) still occupies
+    # its cell in the rulebook geometry.
+    idx = x._bcoo.indices
+    occ = jnp.zeros(dense.shape[:4] + (1,), dense.dtype).at[
+        idx[:, 0], idx[:, 1], idx[:, 2], idx[:, 3], 0].set(1.0)
+    kernel_ones = jnp.ones(w.shape[:3] + (1, 1), dense.dtype)
+    occ_out = _dense_conv3d(occ, kernel_ones, stride, padding, dilation, 1)
+    active = np.argwhere(np.asarray(occ_out[..., 0]) > 0)   # [nnz_out, 4]
+    vals = out[active[:, 0], active[:, 1], active[:, 2], active[:, 3]]
+    return SparseCooTensor(jsparse.BCOO(
+        (vals, jnp.asarray(active)), shape=out.shape))
+
+
+class SubmConv3D(Layer):
+    """reference python/paddle/sparse/nn/layer/conv.py SubmConv3D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None):
+        super().__init__()
+        if type(self) is SubmConv3D and _triple(stride) != (1, 1, 1):
+            raise ValueError("SubmConv3D requires stride 1 "
+                             "(submanifold geometry contract)")
+        k = _triple(kernel_size)
+        self.weight = self.create_parameter(
+            k + (in_channels // groups, out_channels),
+            default_initializer=I.KaimingUniform())
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (out_channels,), is_bias=True)
+        self._cfg = dict(stride=stride, padding=padding, dilation=dilation,
+                         groups=groups)
+
+    def forward(self, x):
+        return conv3d(x, self.weight, self.bias, subm=True, **self._cfg)
+
+
+class Conv3D(SubmConv3D):
+    """reference python/paddle/sparse/nn/layer/conv.py Conv3D (standard,
+    geometry-dilating sparse conv)."""
+
+    def forward(self, x):
+        return conv3d(x, self.weight, self.bias, subm=False, **self._cfg)
+
+
+class BatchNorm(Layer):
+    """Sparse BN (sparse_ops.yaml batch_norm_): normalizes the stored
+    values per channel — only active sites participate, matching the
+    reference kernel."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        super().__init__()
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (num_features,), default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_features,), is_bias=True)
+        self._mean = self.register_buffer(
+            "_mean", Tensor(jnp.zeros((num_features,), jnp.float32)))
+        self._variance = self.register_buffer(
+            "_variance", Tensor(jnp.ones((num_features,), jnp.float32)))
+
+    def forward(self, x: SparseCooTensor):
+        vals = x._bcoo.data                       # [nnz, C]
+        if self.training:
+            mean = vals.mean(axis=0)
+            var = vals.var(axis=0)
+            from ..jit.trace import update_buffer
+
+            update_buffer(self._mean,
+                          self.momentum * self._mean._data
+                          + (1 - self.momentum) * mean)
+            update_buffer(self._variance,
+                          self.momentum * self._variance._data
+                          + (1 - self.momentum) * var)
+        else:
+            mean, var = self._mean._data, self._variance._data
+        out = (vals - mean) * lax.rsqrt(var + self.epsilon)
+        out = out * self.weight._data + self.bias._data
+        return SparseCooTensor(jsparse.BCOO(
+            (out, x._bcoo.indices), shape=x._bcoo.shape))
+
+
+class SyncBatchNorm(BatchNorm):
+    """sparse_ops.yaml sync_batch_norm_.  On TPU the cross-replica moment
+    reduction is not a separate kernel: when the step is compiled over a
+    mesh, GSPMD inserts the all-reduce for the batch moments (the
+    reference needs an explicit NCCL allreduce; the mesh program gets it
+    from sharding propagation), so the layer body is identical."""

@@ -9,6 +9,7 @@ import pytest
 
 import paddle_infer_tpu as pit
 from paddle_infer_tpu import distribution as dist
+from paddle_infer_tpu import sparse
 
 
 class TestDistributions:
@@ -504,3 +505,121 @@ class TestSparseBreadthRound4:
         np.testing.assert_allclose(
             np.asarray(s.to_dense()._data),
             np.asarray(x.to_dense()._data)[:2])
+
+
+class TestSparseConvRound4:
+    """Sparse conv3d / SubmConv3D / BatchNorm / softmax (sparse_ops.yaml
+    conv3d, batch_norm_, softmax; layers python/paddle/sparse/nn) — the
+    dense-bounding-volume TPU lowering documented in sparse/layers.py."""
+
+    def _grid(self, rs, n_sites=20, ch=3):
+        idx = np.unique(rs.randint(0, 8, (n_sites, 3)), axis=0)
+        n = idx.shape[0]
+        inds = np.concatenate([np.zeros((n, 1), np.int64), idx], axis=1)
+        vals = rs.randn(n, ch).astype("float32")
+        return sparse.sparse_coo_tensor(inds.T, vals,
+                                        shape=(1, 8, 8, 8, ch)), inds, vals
+
+    def test_subm_conv3d_keeps_geometry_and_matches_dense(self):
+        import jax.numpy as jnp
+        from jax import lax
+
+        rs = np.random.RandomState(0)
+        x, inds, _ = self._grid(rs)
+        conv = sparse.nn.SubmConv3D(3, 4, 3, padding=1)
+        y = conv(x)
+        assert y.shape == (1, 8, 8, 8, 4)
+        assert y.nnz == inds.shape[0]
+        np.testing.assert_array_equal(np.asarray(y.indices().numpy()),
+                                      inds.T)
+        dense = np.asarray(x._bcoo.todense())
+        w = np.asarray(conv.weight.numpy())
+        dn = lax.conv_dimension_numbers(dense.shape, w.shape,
+                                        ("NDHWC", "DHWIO", "NDHWC"))
+        ref = lax.conv_general_dilated(
+            jnp.asarray(dense), jnp.asarray(w), (1, 1, 1), [(1, 1)] * 3,
+            dimension_numbers=dn)
+        ref_at = np.asarray(ref)[inds[:, 0], inds[:, 1], inds[:, 2],
+                                 inds[:, 3]] \
+            + np.asarray(conv.bias.numpy())
+        np.testing.assert_allclose(np.asarray(y.values().numpy()), ref_at,
+                                   atol=1e-5)
+
+    def test_conv3d_dilates_geometry(self):
+        rs = np.random.RandomState(1)
+        x, inds, _ = self._grid(rs, n_sites=5)
+        conv = sparse.nn.Conv3D(3, 2, 3, padding=1)
+        y = conv(x)
+        assert y.shape == (1, 8, 8, 8, 2)
+        # standard sparse conv activates the kernel neighborhood
+        assert y.nnz > x.nnz
+
+    def test_conv3d_strided(self):
+        rs = np.random.RandomState(2)
+        x, _, _ = self._grid(rs)
+        y = sparse.nn.Conv3D(3, 4, 3, stride=2, padding=1)(x)
+        assert y.shape == (1, 4, 4, 4, 4)
+
+    def test_subm_requires_stride_1(self):
+        rs = np.random.RandomState(3)
+        x, _, _ = self._grid(rs)
+        with pytest.raises(ValueError):
+            sparse.nn.functional.conv3d(
+                x, np.zeros((3, 3, 3, 3, 4), np.float32), stride=2,
+                subm=True)
+
+    def test_batch_norm_train_eval(self):
+        rs = np.random.RandomState(4)
+        x, _, vals = self._grid(rs, ch=4)
+        bn = sparse.nn.BatchNorm(4)
+        bn.train()
+        y = bn(x)
+        assert y.nnz == x.nnz
+        # normalized over active sites only
+        out = np.asarray(y.values().numpy())
+        np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-5)
+        assert not np.allclose(np.asarray(bn._mean.numpy()), 0.0)
+        bn.eval()
+        y2 = bn(x)
+        assert np.isfinite(np.asarray(y2.values().numpy())).all()
+
+    def test_sync_batch_norm_alias(self):
+        rs = np.random.RandomState(5)
+        x, _, _ = self._grid(rs, ch=4)
+        sbn = sparse.nn.SyncBatchNorm(4)
+        sbn.eval()
+        assert sbn(x).nnz == x.nnz
+
+    def test_module_level_softmax_and_acos(self):
+        rs = np.random.RandomState(6)
+        d = rs.rand(4, 6).astype("float32")
+        s = sparse.softmax(sparse.dense_to_csr(pit.to_tensor(d)))
+        row = np.asarray(s.to_dense().numpy())
+        np.testing.assert_allclose(row.sum(axis=-1), 1.0, rtol=1e-5)
+        v = sparse.acos(sparse.sparse_coo_tensor(
+            np.array([[0], [1]]), np.array([0.5], np.float32),
+            shape=(2, 2)))
+        np.testing.assert_allclose(np.asarray(v.values().numpy()),
+                                   np.arccos(0.5), rtol=1e-6)
+
+    def test_subm_rejects_geometry_breaking_args(self):
+        with pytest.raises(ValueError):
+            sparse.nn.SubmConv3D(3, 4, 3, stride=2)
+        rs = np.random.RandomState(7)
+        x, _, _ = self._grid(rs)
+        with pytest.raises(ValueError):
+            sparse.nn.functional.conv3d(
+                x, np.zeros((3, 3, 3, 3, 4), np.float32), padding=2,
+                subm=True)
+
+    def test_conv3d_geometry_from_indices_not_values(self):
+        # a stored site with an all-zero channel vector (post-ReLU) must
+        # still dilate the output geometry
+        inds = np.array([[0, 0], [2, 5], [2, 5], [2, 5]])  # two sites
+        vals = np.array([[1.0, 1.0, 1.0], [0.0, 0.0, 0.0]],
+                        dtype=np.float32)                  # 2nd all-zero
+        x = sparse.sparse_coo_tensor(inds, vals, shape=(1, 8, 8, 8, 3))
+        y = sparse.nn.Conv3D(3, 2, 3, padding=1, bias_attr=False)(x)
+        out_idx = np.asarray(y.indices().numpy()).T
+        # neighborhood of the zero-valued site (5,5,5) must be active
+        assert any((d, h, w) == (5, 5, 5) for _, d, h, w in out_idx)
