@@ -28,3 +28,75 @@ def run_check():
           f"(device: {dev.platform}:{dev.id}, "
           f"loss={float(loss.numpy()):.4f})")
     return True
+
+
+from . import unique_name  # noqa: E402,F401
+
+__all__.append("unique_name")
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Deprecation decorator (reference utils/deprecated.py): warn once
+    per call site, keep the wrapped behavior."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+__all__.append("deprecated")
+
+
+class dlpack:
+    """DLPack interop (reference paddle.utils.dlpack): zero-copy-ish
+    exchange with other frameworks through the standard capsule."""
+
+    @staticmethod
+    def to_dlpack(tensor):
+        from ..core.tensor import Tensor
+
+        arr = tensor._data if isinstance(tensor, Tensor) else tensor
+        # the array itself implements the standard __dlpack__ /
+        # __dlpack_device__ protocol, which every modern consumer
+        # (torch/numpy/jax from_dlpack) accepts directly
+        return arr
+
+    @staticmethod
+    def from_dlpack(obj):
+        import jax.dlpack
+
+        from ..core.tensor import Tensor
+
+        if not hasattr(obj, "__dlpack__"):
+            # raw PyCapsule from a legacy producer: adapt it to the
+            # protocol (device defaults to CPU, kDLCPU=1)
+            class _CapsuleAdapter:
+                def __init__(self, c):
+                    self._c = c
+
+                def __dlpack__(self, stream=None):
+                    return self._c
+
+                def __dlpack_device__(self):
+                    return (1, 0)
+
+            obj = _CapsuleAdapter(obj)
+        return Tensor(jax.dlpack.from_dlpack(obj))
+
+
+__all__.append("dlpack")

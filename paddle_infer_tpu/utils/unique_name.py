@@ -1,0 +1,42 @@
+"""Unique name generator (reference python/paddle/fluid/unique_name.py,
+re-exported as paddle.utils.unique_name): generate/switch/guard over a
+per-prefix counter namespace — static-graph code uses it to mint var
+names."""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+
+class _Namespace:
+    def __init__(self):
+        self.counters = defaultdict(int)
+
+    def generate(self, key: str) -> str:
+        n = self.counters[key]
+        self.counters[key] += 1
+        return f"{key}_{n}"
+
+
+_current = _Namespace()
+
+
+def generate(key: str) -> str:
+    return _current.generate(key)
+
+
+def switch(new_namespace: _Namespace | None = None) -> _Namespace:
+    """Swap the active namespace, returning the previous one."""
+    global _current
+    prev = _current
+    _current = new_namespace if new_namespace is not None else _Namespace()
+    return prev
+
+
+@contextlib.contextmanager
+def guard(new_namespace: _Namespace | None = None):
+    prev = switch(new_namespace)
+    try:
+        yield
+    finally:
+        switch(prev)

@@ -239,3 +239,47 @@ def test_device_memory_stats_api():
     # cuda-shim parity surface
     assert pit.device.cuda.memory_allocated() == \
         pit.device.memory_allocated()
+
+
+class TestUtilsRound4:
+    """paddle.utils parity corners: unique_name, deprecated, dlpack
+    (reference python/paddle/utils/)."""
+
+    def test_unique_name_generate_and_guard(self):
+        from paddle_infer_tpu.utils import unique_name
+
+        a, b = unique_name.generate("fc"), unique_name.generate("fc")
+        assert a != b and a.startswith("fc_")
+        with unique_name.guard():
+            inner = unique_name.generate("fc")
+            assert inner == "fc_0"
+        # the outer namespace resumes where it left off
+        after = unique_name.generate("fc")
+        assert int(after.rsplit("_", 1)[1]) > int(b.rsplit("_", 1)[1])
+
+    def test_deprecated_warns_and_passes_through(self):
+        import warnings
+
+        from paddle_infer_tpu.utils import deprecated
+
+        @deprecated(update_to="pit.new_api", since="2.4")
+        def old(x):
+            return x * 2
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old(3) == 6
+            assert any("deprecated" in str(m.message) for m in w)
+
+    def test_dlpack_roundtrip_and_torch_interop(self):
+        from paddle_infer_tpu.utils import dlpack
+
+        t = pit.to_tensor(np.arange(4, dtype=np.float32))
+        back = dlpack.from_dlpack(dlpack.to_dlpack(t))
+        np.testing.assert_array_equal(back.numpy(), t.numpy())
+        torch = pytest.importorskip("torch")
+        tt = torch.utils.dlpack.from_dlpack(dlpack.to_dlpack(
+            pit.to_tensor(np.ones(3, np.float32))))
+        assert tt.tolist() == [1.0, 1.0, 1.0]
+        j = dlpack.from_dlpack(torch.arange(3, dtype=torch.float32))
+        np.testing.assert_array_equal(j.numpy(), [0.0, 1.0, 2.0])
