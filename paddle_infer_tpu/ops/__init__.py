@@ -57,14 +57,66 @@ for _name in [
 ]:
     setattr(Tensor, _name, _method(_name))
 
-# reductions / shape
-for _name in [
-    "sum", "mean", "max", "min", "prod", "all", "any", "argmax", "argmin",
-    "logsumexp", "std", "var", "squeeze", "unsqueeze", "flatten",
-    "split", "gather", "topk", "sort", "argsort", "flip",
-    "roll", "clip", "norm", "take_along_axis", "put_along_axis", "tril",
-    "triu", "where", "scale",
+def _attr_method(op_name, argnames):
+    """Methods whose positionals are STATIC ATTRS, not operands —
+    `t.argmax(-1)` means axis=-1 (the paddle Tensor-method surface), and
+    feeding it to dispatch as a tensor input would trace the axis.
+    Tensor-valued arguments (paddle allows `t.clip(min_tensor)`,
+    `t.scale(scale_tensor)`) fall back to the operand path so they stay
+    traced instead of being frozen into the jit cache key."""
+    import jax as _jax
+    import numpy as _np
+
+    def _is_tensorish(v):
+        return isinstance(v, (Tensor, _jax.Array, _np.ndarray))
+
+    def fn(self, *args, **kwargs):
+        if len(args) > len(argnames):
+            raise TypeError(
+                f"{op_name}() takes at most {len(argnames)} positional "
+                f"arguments ({len(args)} given)")
+        import builtins
+
+        # NB: builtins.any — module-level `any` is the reduction op
+        if builtins.any(_is_tensorish(a) for a in args) \
+                or builtins.any(_is_tensorish(v)
+                                for v in kwargs.values()):
+            return D(op_name, self, *args, **kwargs)
+        for name, val in zip(argnames, args):
+            if name in kwargs:
+                raise TypeError(
+                    f"{op_name}() got multiple values for {name!r}")
+            kwargs[name] = val
+        return D(op_name, self, **kwargs)
+
+    fn.__name__ = op_name
+    return fn
+
+
+# reductions / shape: positional args are attrs (axis, k, ...)
+for _name, _argnames in [
+    ("sum", ("axis", "dtype", "keepdim")), ("mean", ("axis", "keepdim")),
+    ("max", ("axis", "keepdim")), ("min", ("axis", "keepdim")),
+    ("prod", ("axis", "keepdim", "dtype")), ("all", ("axis", "keepdim")),
+    ("any", ("axis", "keepdim")), ("argmax", ("axis", "keepdim")),
+    ("argmin", ("axis", "keepdim")),
+    ("logsumexp", ("axis", "keepdim")),
+    ("std", ("axis", "unbiased", "keepdim")),
+    ("var", ("axis", "unbiased", "keepdim")),
+    ("squeeze", ("axis",)), ("unsqueeze", ("axis",)),
+    ("flatten", ("start_axis", "stop_axis")),
+    ("split", ("num_or_sections", "axis")),
+    ("topk", ("k", "axis", "largest", "sorted")),
+    ("sort", ("axis", "descending")), ("argsort", ("axis", "descending")),
+    ("flip", ("axis",)), ("roll", ("shifts", "axis")),
+    ("clip", ("min", "max")), ("norm", ("p", "axis", "keepdim")),
+    ("tril", ("diagonal",)), ("triu", ("diagonal",)),
+    ("scale", ("scale", "bias", "bias_after_scale")),
 ]:
+    setattr(Tensor, _name, _attr_method(_name, _argnames))
+
+# tensor-operand methods in the same family
+for _name in ["gather", "take_along_axis", "put_along_axis", "where"]:
     setattr(Tensor, _name, _method(_name))
 
 

@@ -322,7 +322,10 @@ defop("one_hot", vjp=False)(
 
 
 @register_op("topk")
-def _topk(x, k, axis=-1, largest=True):
+def _topk(x, k, axis=-1, largest=True, sorted=True):
+    # paddle's sorted=False only relaxes the order guarantee; returning
+    # the (always-sorted) lax.top_k result satisfies both
+    del sorted
     if axis != -1 and axis != x.ndim - 1:
         xm = jnp.moveaxis(x, axis, -1)
     else:

@@ -101,8 +101,11 @@ register_grad("min")(_minmax_grad)
 
 
 @register_op("prod")
-def _prod(x, axis=None, keepdim=False):
-    return jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdim)
+def _prod(x, axis=None, keepdim=False, dtype=None):
+    out = jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdim)
+    if dtype is not None:
+        out = out.astype(np.dtype(dtype))
+    return out
 
 
 from ..core.dispatch import register_vjp_grad  # noqa: E402

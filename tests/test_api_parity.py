@@ -630,3 +630,37 @@ class TestTensorMethods:
         v.erfinv_()
         from math import erf
         assert abs(erf(float(v)) - 0.5) < 1e-5
+
+
+class TestPositionalAttrMethods:
+    """Tensor methods whose positionals are static attrs — t.argmax(-1),
+    t.sum(1), t.topk(2) — the surface every paddle example uses (caught
+    by examples/train_lenet.py in round 4: the axis used to be traced as
+    an operand and crashed under jit)."""
+
+    def test_reduction_positional_axis(self):
+        t = pit.to_tensor(
+            np.arange(12, dtype=np.float32).reshape(3, 4))
+        np.testing.assert_array_equal(t.argmax(-1).numpy(), [3, 3, 3])
+        np.testing.assert_array_equal(t.sum(1).numpy(), [6., 22., 38.])
+        assert t.max(0, True).shape == [1, 4]
+        ref = np.arange(12, dtype=np.float32).reshape(3, 4)
+        np.testing.assert_array_equal(t.any(0).numpy(), ref.any(axis=0))
+
+    def test_shape_positional_attrs(self):
+        t = pit.to_tensor(
+            np.arange(12, dtype=np.float32).reshape(3, 4))
+        assert t.flatten(0, 1).shape == [12]
+        assert [p.shape for p in t.split(2, 1)] == [[3, 2], [3, 2]]
+        assert t.unsqueeze(0).shape == [1, 3, 4]
+        vals, idx = t.topk(2)
+        np.testing.assert_array_equal(vals.numpy()[0], [3., 2.])
+        np.testing.assert_array_equal(t.clip(2.0, 5.0).numpy()[0],
+                                      [2., 2., 2., 3.])
+
+    def test_too_many_positionals_raises(self):
+        t = pit.to_tensor(np.zeros((2, 2), np.float32))
+        with pytest.raises(TypeError):
+            t.argmax(0, False, "extra")
+        with pytest.raises(TypeError):
+            t.sum(1, keepdim=True, axis=0)
