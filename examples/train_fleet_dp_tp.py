@@ -1,0 +1,54 @@
+"""Hybrid-parallel training with the fleet facade: one compiled SPMD
+step over a dp x mp mesh (the reference's fleet.distributed_model +
+HybridParallelOptimizer flow, collapsed into FleetTrainStep).
+
+Run (CPU demo mesh): 
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+  python examples/train_fleet_dp_tp.py
+"""
+import numpy as np
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import nn, optimizer
+from paddle_infer_tpu.parallel import (DistributedStrategy, FleetTrainStep,
+                                       fleet)
+from paddle_infer_tpu.parallel.mp_layers import (ColumnParallelLinear,
+                                                 RowParallelLinear)
+
+
+class MLP(nn.Layer):
+    def __init__(self, hidden=64):
+        super().__init__()
+        self.up = ColumnParallelLinear(hidden, hidden * 4)
+        self.down = RowParallelLinear(hidden * 4, hidden)
+        self.head = nn.Linear(hidden, 10)
+
+    def forward(self, x):
+        return self.head(self.down(nn.functional.gelu(self.up(x))))
+
+
+def main(steps=5):
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+    strategy.amp = True
+    strategy.amp_configs = {"level": "O2", "dtype": "bfloat16"}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = MLP()
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        return nn.functional.cross_entropy(m(x), y)
+
+    step = FleetTrainStep(model, loss_fn, opt, strategy=strategy)
+    rs = np.random.RandomState(0)
+    x = rs.rand(16, 64).astype(np.float32)
+    y = rs.randint(0, 10, (16,)).astype(np.int64)
+    for i in range(steps):
+        loss = step(x, y)
+        print(f"step {i} loss {float(loss.numpy()):.4f}")
+    return float(loss.numpy())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Eager training end-to-end: LeNet on synthetic MNIST with AMP + grad
+scaler, checkpointing, and eval (the reference's beginner flow:
+python/paddle quickstart).
+
+Run: python examples/train_lenet.py [--epochs N]
+"""
+import argparse
+
+import numpy as np
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu import amp, nn, optimizer
+from paddle_infer_tpu.io import DataLoader
+from paddle_infer_tpu.models.lenet import LeNet
+from paddle_infer_tpu.vision.datasets import MNIST
+
+
+def main(epochs=1, batch_size=64, limit_batches=None):
+    train_ds = MNIST(mode="train")
+    loader = DataLoader(train_ds, batch_size=batch_size, shuffle=True)
+    model = LeNet()
+    opt = optimizer.AdamW(learning_rate=2e-3,
+                          parameters=model.parameters())
+    scaler = amp.GradScaler()
+    model.train()
+    for epoch in range(epochs):
+        for i, (x, y) in enumerate(loader):
+            if limit_batches and i >= limit_batches:
+                break
+            with amp.auto_cast():
+                loss = nn.functional.cross_entropy(model(x), y)
+            scaler.scale(loss).backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+            if i % 50 == 0:
+                print(f"epoch {epoch} step {i} loss "
+                      f"{float(loss.numpy()):.4f}")
+    pit.save(model.state_dict(), "lenet.pdparams")
+    print("saved lenet.pdparams")
+    model.eval()
+    x, y = next(iter(loader))
+    acc = (model(x).argmax(-1).numpy() == y.numpy()).mean()
+    print(f"train-batch accuracy {acc:.2f}")
+    return float(loss.numpy())
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--limit-batches", type=int, default=None)
+    a = p.parse_args()
+    main(epochs=a.epochs, limit_batches=a.limit_batches)
