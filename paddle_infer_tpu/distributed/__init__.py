@@ -10,41 +10,38 @@ __all__ = ["ParallelEnv", "get_rank", "get_world_size", "init_parallel_env"]
 
 
 def __getattr__(name):
-    # lazy imports to avoid import cycles at package init
+    # lazy imports to avoid import cycles at package init.
+    # importlib.import_module (NOT ``from . import x``): the relative
+    # form re-enters this __getattr__ through _handle_fromlist's
+    # hasattr probe and recurses when the submodule import is itself
+    # in progress (seen: ``from paddle_infer_tpu.distributed import
+    # fleet`` -> RecursionError)
+    import importlib
     if name in ("new_group", "all_reduce", "all_gather", "broadcast",
                 "reduce", "scatter", "alltoall", "reduce_scatter", "send",
                 "recv", "barrier", "ReduceOp", "ProcessGroup", "wait"):
-        from . import collective
-
+        collective = importlib.import_module(".collective", __name__)
         return getattr(collective, name)
     if name == "fleet":
-        from . import fleet
-
-        return fleet
+        return importlib.import_module(".fleet", __name__)
     if name == "DataParallel":
         from .data_parallel import DataParallel
 
         return DataParallel
     if name in ("DeviceMesh", "ProcessMesh", "get_mesh", "set_mesh"):
-        from . import mesh
-
+        mesh = importlib.import_module(".mesh", __name__)
         return getattr(mesh, name)
     if name == "launch":
-        from . import launch
-
-        return launch
+        return importlib.import_module(".launch", __name__)
     if name == "spawn":
         from .launch import spawn
 
         return spawn
     if name == "auto_parallel":
-        from . import auto_parallel
-
-        return auto_parallel
+        return importlib.import_module(".auto_parallel", __name__)
     if name in ("shard_tensor", "shard_op", "Engine"):
-        from . import auto_parallel
-
-        return getattr(auto_parallel, name)
+        ap = importlib.import_module(".auto_parallel", __name__)
+        return getattr(ap, name)
     if name in ("ShardedSparseTable", "SparseEmbedding"):
         # paddle.distributed.ps sparse-table surface (TPU-native PS)
         from ..parallel import sparse_table
@@ -56,7 +53,7 @@ def __getattr__(name):
                 "gloo_barrier", "gloo_release", "split",
                 "ProbabilityEntry", "CountFilterEntry", "ShowClickEntry",
                 "InMemoryDataset", "QueueDataset"):
-        from . import compat
+        compat = importlib.import_module(".compat", __name__)
 
         return getattr(compat, name)
     raise AttributeError(f"module 'paddle_infer_tpu.distributed' has no "

@@ -62,8 +62,17 @@ def _sanitize(name: str) -> str:
 # ------------------------------------------------------------------ the op
 
 def _apply_template(template, names, layer_arrays, h):
+    from ..jit.trace import trace_scope
+
     params = dict(zip(names, layer_arrays))
-    out = template.functional_call(params, Tensor(h))
+    # trace scope: a stage containing BatchNorm would otherwise set_value
+    # a traced array into the eager running-stat buffer (the leak
+    # FleetTrainStep fixes by carrying buffers); pipeline stages don't
+    # carry buffer state, so updates are captured and dropped — BN stats
+    # freeze inside PP stages (use LayerNorm in pipelined blocks, which
+    # is what every transformer stage does anyway)
+    with trace_scope():
+        out = template.functional_call(params, Tensor(h))
     return out._data if isinstance(out, Tensor) else out
 
 

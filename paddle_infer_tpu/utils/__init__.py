@@ -36,8 +36,9 @@ __all__.append("unique_name")
 
 
 def deprecated(update_to="", since="", reason="", level=0):
-    """Deprecation decorator (reference utils/deprecated.py): warn once
-    per call site, keep the wrapped behavior."""
+    """Deprecation decorator (reference utils/deprecated.py).  level 0/1
+    warn and proceed; level >= 2 raises (the reference's hard-removal
+    level)."""
     import functools
     import warnings
 
@@ -51,6 +52,8 @@ def deprecated(update_to="", since="", reason="", level=0):
                 msg += f"; use {update_to} instead"
             if reason:
                 msg += f" ({reason})"
+            if level >= 2:
+                raise RuntimeError(msg)
             warnings.warn(msg, DeprecationWarning, stacklevel=2)
             return fn(*args, **kwargs)
 
@@ -62,41 +65,6 @@ def deprecated(update_to="", since="", reason="", level=0):
 __all__.append("deprecated")
 
 
-class dlpack:
-    """DLPack interop (reference paddle.utils.dlpack): zero-copy-ish
-    exchange with other frameworks through the standard capsule."""
-
-    @staticmethod
-    def to_dlpack(tensor):
-        from ..core.tensor import Tensor
-
-        arr = tensor._data if isinstance(tensor, Tensor) else tensor
-        # the array itself implements the standard __dlpack__ /
-        # __dlpack_device__ protocol, which every modern consumer
-        # (torch/numpy/jax from_dlpack) accepts directly
-        return arr
-
-    @staticmethod
-    def from_dlpack(obj):
-        import jax.dlpack
-
-        from ..core.tensor import Tensor
-
-        if not hasattr(obj, "__dlpack__"):
-            # raw PyCapsule from a legacy producer: adapt it to the
-            # protocol (device defaults to CPU, kDLCPU=1)
-            class _CapsuleAdapter:
-                def __init__(self, c):
-                    self._c = c
-
-                def __dlpack__(self, stream=None):
-                    return self._c
-
-                def __dlpack_device__(self):
-                    return (1, 0)
-
-            obj = _CapsuleAdapter(obj)
-        return Tensor(jax.dlpack.from_dlpack(obj))
-
+from . import dlpack  # noqa: E402,F401
 
 __all__.append("dlpack")

@@ -1,0 +1,42 @@
+"""DLPack interop (reference python/paddle/utils/dlpack.py): exchange
+tensors with other frameworks through the standard capsule/protocol.
+
+``to_dlpack`` returns a legacy 'dltensor' PyCapsule like the reference
+(so capsule-only consumers work); ``from_dlpack`` accepts either a
+protocol object (anything with ``__dlpack__``, the modern form) or a raw
+capsule.  Raw capsules carry no device tag — this framework's producers
+are CPU/host arrays (torch-cpu, numpy), so the adapter labels them
+kDLCPU; accelerator-resident capsules must come in as protocol objects,
+which carry ``__dlpack_device__`` themselves."""
+from __future__ import annotations
+
+
+def to_dlpack(tensor):
+    from ..core.tensor import Tensor
+
+    arr = tensor._data if isinstance(tensor, Tensor) else tensor
+    return arr.__dlpack__()
+
+
+class _CapsuleAdapter:
+    """Wrap a legacy raw capsule in the array-API protocol jax expects.
+    Device is reported as host CPU (see module docstring)."""
+
+    def __init__(self, capsule):
+        self._c = capsule
+
+    def __dlpack__(self, *_, **__):
+        return self._c
+
+    def __dlpack_device__(self):
+        return (1, 0)                    # (kDLCPU, device 0)
+
+
+def from_dlpack(obj):
+    import jax.dlpack
+
+    from ..core.tensor import Tensor
+
+    if not hasattr(obj, "__dlpack__"):
+        obj = _CapsuleAdapter(obj)
+    return Tensor(jax.dlpack.from_dlpack(obj))

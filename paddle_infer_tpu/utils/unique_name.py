@@ -8,33 +8,47 @@ import contextlib
 from collections import defaultdict
 
 
-class _Namespace:
-    def __init__(self):
+class UniqueNameGenerator:
+    """Counter namespace; ``prefix`` matches the reference's
+    UniqueNameGenerator(prefix) string form."""
+
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
         self.counters = defaultdict(int)
 
     def generate(self, key: str) -> str:
         n = self.counters[key]
         self.counters[key] += 1
-        return f"{key}_{n}"
+        return f"{self.prefix}{key}_{n}"
 
 
-_current = _Namespace()
+_current = UniqueNameGenerator()
+
+
+def _coerce(ns):
+    if ns is None:
+        return UniqueNameGenerator()
+    if isinstance(ns, str):
+        # reference guard("worker_") form: a fresh namespace with prefix
+        return UniqueNameGenerator(ns)
+    return ns
 
 
 def generate(key: str) -> str:
     return _current.generate(key)
 
 
-def switch(new_namespace: _Namespace | None = None) -> _Namespace:
-    """Swap the active namespace, returning the previous one."""
+def switch(new_namespace=None) -> UniqueNameGenerator:
+    """Swap the active namespace (UniqueNameGenerator | str prefix |
+    None = fresh), returning the previous one."""
     global _current
     prev = _current
-    _current = new_namespace if new_namespace is not None else _Namespace()
+    _current = _coerce(new_namespace)
     return prev
 
 
 @contextlib.contextmanager
-def guard(new_namespace: _Namespace | None = None):
+def guard(new_namespace=None):
     prev = switch(new_namespace)
     try:
         yield

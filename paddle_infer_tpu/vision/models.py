@@ -1049,3 +1049,10 @@ def mobilenet_v3_small(scale=1.0, **kw):
 
 def mobilenet_v3_large(scale=1.0, **kw):
     return MobileNetV3Large(scale=scale, **kw)
+
+
+# re-export: the reference exposes LeNet under paddle.vision.models too
+# (python/paddle/vision/models/lenet.py)
+from ..models.lenet import LeNet  # noqa: E402
+
+__all__.append("LeNet")

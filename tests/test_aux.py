@@ -283,3 +283,51 @@ class TestUtilsRound4:
         assert tt.tolist() == [1.0, 1.0, 1.0]
         j = dlpack.from_dlpack(torch.arange(3, dtype=torch.float32))
         np.testing.assert_array_equal(j.numpy(), [0.0, 1.0, 2.0])
+
+
+def test_distributed_fromlist_imports():
+    """Regression: ``from paddle_infer_tpu.distributed import fleet``
+    recursed through the lazy __getattr__ (importlib's hasattr probe
+    re-entered it mid-import)."""
+    import subprocess
+    import sys
+
+    code = ("from paddle_infer_tpu.distributed import fleet, launch, "
+            "auto_parallel; print('ok', fleet.DistributedStrategy "
+            "is not None)")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__)))})
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-800:]
+    assert "ok True" in r.stdout
+
+
+def test_unique_name_string_prefix_guard():
+    from paddle_infer_tpu.utils import unique_name
+
+    with unique_name.guard("worker_"):
+        assert unique_name.generate("fc") == "worker_fc_0"
+
+
+def test_deprecated_level2_raises():
+    from paddle_infer_tpu.utils import deprecated
+
+    @deprecated(update_to="pit.new", level=2)
+    def gone():
+        return 1
+
+    with pytest.raises(RuntimeError):
+        gone()
+
+
+def test_dlpack_module_import():
+    import importlib
+
+    mod = importlib.import_module("paddle_infer_tpu.utils.dlpack")
+    t = pit.to_tensor(np.arange(3, dtype=np.float32))
+    np.testing.assert_array_equal(
+        mod.from_dlpack(mod.to_dlpack(t)).numpy(), t.numpy())
