@@ -4,3 +4,7 @@ from . import nn  # noqa: F401
 from . import autograd  # noqa: F401
 
 __all__ = ["nn", "autograd"]
+from . import optimizer  # noqa: E402,F401
+from . import tensor  # noqa: E402,F401
+
+__all__ += ["optimizer", "tensor"]

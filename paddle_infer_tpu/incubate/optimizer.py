@@ -1,0 +1,110 @@
+"""Incubate optimizers (reference python/paddle/incubate/optimizer/
+lookahead.py, modelaverage.py): wrappers over an inner optimizer.
+
+TPU note: both are pure parameter-space bookkeeping — slow/averaged
+copies live as host-managed jax arrays updated after the inner step; no
+kernel work beyond elementwise axpy, which XLA fuses."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class LookAhead:
+    """k-step lookahead (reference lookahead.py LookAhead): every k inner
+    steps, slow <- slow + alpha * (fast - slow); fast <- slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if k < 1:
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._steps = 0
+        self._slow = {}
+
+    @property
+    def _parameters(self):
+        return self.inner_optimizer._parameters
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def step(self):
+        if not self._slow:
+            for p in self._parameters:
+                self._slow[id(p)] = jnp.asarray(p._data)
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            for p in self._parameters:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._data - slow)
+                self._slow[id(p)] = slow
+                p._data = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "steps": self._steps}
+
+
+class ModelAverage:
+    """Running average of parameters (reference modelaverage.py):
+    accumulate after each step; ``apply()`` swaps the averaged weights in
+    (optionally as a context manager), ``restore()`` swaps back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000):
+        self.rate = average_window_rate
+        self.min_window = min_average_window
+        self.max_window = max_average_window
+        self._parameters = list(parameters or [])
+        self._sum = {id(p): jnp.zeros_like(p._data)
+                     for p in self._parameters}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current parameter values (call after the inner
+        optimizer's step)."""
+        for p in self._parameters:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+        self._count += 1
+        window = max(int(self.rate * self._count), 1)
+        window = min(max(window, 1), self.max_window)
+        if self._count > window and self._count > self.min_window:
+            # slide: decay the sum so old params wash out
+            keep = window / self._count
+            for k in self._sum:
+                self._sum[k] = self._sum[k] * keep
+            self._count = window
+
+    def apply(self, need_restore=True):
+        """Swap averaged weights into the parameters."""
+        if self._count == 0:
+            raise RuntimeError("ModelAverage.apply before any step")
+        self._backup = {id(p): p._data for p in self._parameters} \
+            if need_restore else None
+        for p in self._parameters:
+            p._data = (self._sum[id(p)] / self._count).astype(
+                p._data.dtype)
+        return self
+
+    def restore(self):
+        if self._backup is None:
+            raise RuntimeError("nothing to restore")
+        for p in self._parameters:
+            p._data = self._backup[id(p)]
+        self._backup = None
+
+    # context-manager sugar: with ma.apply(): eval(...)
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self._backup is not None:
+            self.restore()

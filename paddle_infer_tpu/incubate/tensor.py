@@ -1,0 +1,6 @@
+"""paddle.incubate.tensor parity (reference incubate/tensor/math.py):
+segment reductions — re-exported from geometric, where the TPU-native
+implementations (jax.ops.segment_*) live."""
+from ..geometric import segment_max, segment_mean, segment_min, segment_sum
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min"]

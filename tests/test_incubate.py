@@ -111,3 +111,71 @@ class TestIncubateAutograd:
 
         assert pit.utils.run_check() is True
         assert "successfully" in capsys.readouterr().out
+
+
+class TestIncubateOptimizers:
+    """LookAhead / ModelAverage (reference incubate/optimizer/)."""
+
+    def _quadratic(self):
+        pit.seed(0)
+        w = pit.nn.Linear(4, 1)
+        x = pit.to_tensor(np.random.RandomState(0).rand(
+            16, 4).astype("float32"))
+        y = pit.to_tensor((np.random.RandomState(0).rand(16, 4).sum(
+            axis=1, keepdims=True)).astype("float32"))
+        return w, x, y
+
+    def test_lookahead_converges_and_syncs(self):
+        from paddle_infer_tpu.incubate.optimizer import LookAhead
+
+        w, x, y = self._quadratic()
+        inner = pit.optimizer.SGD(learning_rate=0.1,
+                                  parameters=w.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=5)
+        losses = []
+        for _ in range(20):
+            loss = ((w(x) - y) ** 2.0).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5
+        with pytest.raises(ValueError):
+            LookAhead(inner, alpha=1.5)
+
+    def test_model_average_apply_restore(self):
+        from paddle_infer_tpu.incubate.optimizer import ModelAverage
+
+        w, x, y = self._quadratic()
+        opt = pit.optimizer.SGD(learning_rate=0.1,
+                                parameters=w.parameters())
+        ma = ModelAverage(0.15, parameters=w.parameters(),
+                          min_average_window=2, max_average_window=10)
+        for _ in range(8):
+            loss = ((w(x) - y) ** 2.0).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ma.step()
+        raw = w.weight.numpy().copy()
+        with ma.apply():
+            averaged = w.weight.numpy().copy()
+            assert not np.allclose(raw, averaged)
+        np.testing.assert_allclose(w.weight.numpy(), raw)   # restored
+
+    def test_incubate_tensor_segment_ops(self):
+        from paddle_infer_tpu.incubate.tensor import (segment_max,
+                                                      segment_mean,
+                                                      segment_min,
+                                                      segment_sum)
+
+        data = pit.to_tensor(np.array([1., 2., 3., 4.], np.float32))
+        ids = pit.to_tensor(np.array([0, 0, 1, 1], np.int32))
+        np.testing.assert_allclose(segment_sum(data, ids).numpy(),
+                                   [3., 7.])
+        np.testing.assert_allclose(segment_mean(data, ids).numpy(),
+                                   [1.5, 3.5])
+        np.testing.assert_allclose(segment_max(data, ids).numpy(),
+                                   [2., 4.])
+        np.testing.assert_allclose(segment_min(data, ids).numpy(),
+                                   [1., 3.])
