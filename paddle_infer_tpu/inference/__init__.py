@@ -5,6 +5,8 @@ from .config import Config, PrecisionType
 from .generation import (GenerationConfig, GenerationEngine,
                          PagedGenerationEngine)
 from .predictor import Predictor, create_predictor
+from .speculative import SpeculativeEngine
 
 __all__ = ["Config", "PrecisionType", "Predictor", "create_predictor",
-           "GenerationConfig", "GenerationEngine", "PagedGenerationEngine"]
+           "GenerationConfig", "GenerationEngine", "PagedGenerationEngine",
+           "SpeculativeEngine"]

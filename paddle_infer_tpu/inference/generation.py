@@ -396,6 +396,48 @@ class GenerationEngine:
 
         return pick
 
+    def _prepare(self, input_ids, attention_mask, g: GenerationConfig,
+                 budget: Optional[int] = None):
+        """Shared prompt preprocessing: coerce to [b, plen] int32,
+        canonicalize to LEFT padding (compiled programs read next-token
+        logits from the final slot), bucket the prompt length, and size
+        the KV cache.  ``budget`` = tokens the cache must hold past the
+        prompt (defaults to max_new_tokens; SpeculativeEngine adds its
+        chunk overshoot).  Returns (ids, mask, plen, cache_len)."""
+        budget = g.max_new_tokens if budget is None else budget
+        ids = np.asarray(input_ids._data if isinstance(input_ids, Tensor)
+                         else input_ids).astype(np.int32)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        b, plen_raw = ids.shape
+        mask = (np.ones_like(ids) if attention_mask is None
+                else np.asarray(attention_mask).astype(np.int32))
+        for i in range(b):
+            real = np.flatnonzero(mask[i])
+            if len(real) and real[-1] != plen_raw - 1:
+                n = len(real)
+                row = ids[i, real]
+                ids[i] = g.pad_token_id
+                mask[i] = 0
+                ids[i, plen_raw - n:] = row
+                mask[i, plen_raw - n:] = 1
+        # bucket the prompt so executables are reused across nearby
+        # lengths, clamped so prompt + budget still fits the position table
+        assert plen_raw + budget <= self._max_positions, (
+            f"prompt {plen_raw} + generation budget {budget} exceeds "
+            f"max_position_embeddings {self._max_positions}")
+        plen = _round_up(max(plen_raw, 1), self._prompt_bucket)
+        plen = max(plen_raw, min(plen, self._max_positions - budget))
+        if plen > plen_raw:  # left-pad to the bucket
+            padw = plen - plen_raw
+            ids = np.pad(ids, ((0, 0), (padw, 0)),
+                         constant_values=g.pad_token_id)
+            mask = np.pad(mask, ((0, 0), (padw, 0)), constant_values=0)
+        cache_len = min(_round_up(plen + budget, self._cache_bucket),
+                        self._max_positions)
+        cache_len = max(cache_len, plen + budget)
+        return ids, mask, plen, cache_len
+
     # ------------------------------------------------------------- public
     def generate(self, input_ids, generation_config: GenerationConfig = None,
                  attention_mask=None, return_scores: bool = False):
@@ -416,41 +458,9 @@ class GenerationEngine:
         # re-snapshot parameters so set_state_dict / dtype casts after
         # engine construction are honored
         self._params = self._snapshot_params()
-        ids = np.asarray(input_ids._data if isinstance(input_ids, Tensor)
-                         else input_ids).astype(np.int32)
-        if ids.ndim == 1:
-            ids = ids[None, :]
-        b, plen_raw = ids.shape
-        mask = (np.ones_like(ids) if attention_mask is None
-                else np.asarray(attention_mask).astype(np.int32))
-        # canonicalize to left padding: the compiled programs read the
-        # next-token logits from the final slot, so any row whose real
-        # tokens don't already end at the last column is shifted right
-        for i in range(b):
-            real = np.flatnonzero(mask[i])
-            if len(real) and real[-1] != plen_raw - 1:
-                n = len(real)
-                row = ids[i, real]
-                ids[i] = g.pad_token_id
-                mask[i] = 0
-                ids[i, plen_raw - n:] = row
-                mask[i, plen_raw - n:] = 1
-        # bucket the prompt so executables are reused across nearby lengths,
-        # clamped so prompt + max_new still fits the position table
-        assert plen_raw + g.max_new_tokens <= self._max_positions, (
-            f"prompt {plen_raw} + max_new {g.max_new_tokens} exceeds "
-            f"max_position_embeddings {self._max_positions}")
-        plen = _round_up(max(plen_raw, 1), self._prompt_bucket)
-        plen = max(plen_raw, min(plen,
-                                 self._max_positions - g.max_new_tokens))
-        if plen > plen_raw:  # left-pad to the bucket
-            padw = plen - plen_raw
-            ids = np.pad(ids, ((0, 0), (padw, 0)),
-                         constant_values=g.pad_token_id)
-            mask = np.pad(mask, ((0, 0), (padw, 0)), constant_values=0)
-        cache_len = min(_round_up(plen + g.max_new_tokens,
-                                  self._cache_bucket), self._max_positions)
-        cache_len = max(cache_len, plen + g.max_new_tokens)
+        ids, mask, plen, cache_len = self._prepare(input_ids,
+                                                   attention_mask, g)
+        b = ids.shape[0]
 
         beam = g.num_beams > 1
         key = ("beam" if beam else "sample", b, plen, cache_len,

@@ -1,0 +1,194 @@
+"""Speculative decoding — draft-model lookahead with target-model chunk
+verification (beyond the reference: Paddle_infer serves decode strictly
+one token per fused-transformer step, fused_multi_transformer_op.cu; the
+TPU engine's chunked static-cache attention makes the verify step one
+MXU-friendly multi-token forward, so the latency feature costs no new
+kernel).
+
+Design (greedy, batch-size 1 — the bs1 p50 latency regime BASELINE.md
+measures):
+
+1. the DRAFT model autoregressively proposes ``gamma`` tokens from its
+   own KV cache;
+2. the TARGET model runs ONE forward over those gamma positions (the
+   static-cache path handles mid-sequence chunks: kv_cache_mask carries
+   intra-chunk causality, transformer_block.py);
+3. the longest prefix of proposals matching the target's own greedy
+   choices is accepted, plus the target's correction token on the first
+   mismatch — so every iteration emits 1..gamma tokens and the output is
+   TOKEN-IDENTICAL to running the target alone;
+4. both caches "rewind" to the confirmed length by rebuilding the cache
+   tuple with a smaller write index — stale buffer slots beyond the
+   index are invisible to kv_cache_mask, so no data movement happens.
+
+Acceptance rate — and therefore speedup — depends on how well the draft
+tracks the target; correctness never does.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .generation import (GenerationConfig, GenerationEngine,
+                         _MeshContext)
+
+
+class SpeculativeEngine:
+    """Greedy speculative generation over (target, draft) causal LMs
+    sharing a tokenizer/vocab."""
+
+    def __init__(self, target_model, draft_model, num_draft_tokens: int = 4,
+                 cache_bucket: int = 128, prompt_bucket: int = 64,
+                 mesh=None):
+        if num_draft_tokens < 1:
+            raise ValueError("num_draft_tokens must be >= 1")
+        self.gamma = int(num_draft_tokens)
+        self._t = GenerationEngine(target_model, cache_bucket=cache_bucket,
+                                   prompt_bucket=prompt_bucket, mesh=mesh)
+        self._d = GenerationEngine(draft_model, cache_bucket=cache_bucket,
+                                   prompt_bucket=prompt_bucket, mesh=mesh)
+        # the shorter position table bounds generation for BOTH engines
+        bound = min(self._t._max_positions, self._d._max_positions)
+        self._t._max_positions = self._d._max_positions = bound
+        self._mesh = mesh
+        self._compiled = {}
+        self.last_acceptance = None      # accepted-draft fraction, host stat
+
+    # ------------------------------------------------------------ program
+    def _build(self, plen, cache_len, g: GenerationConfig):
+        gamma = self.gamma
+        max_new = g.max_new_tokens
+        eos = g.eos_token_id
+        pad = g.pad_token_id
+        eng_t, eng_d = self._t, self._d
+
+        def run(params_t, params_d, ids, prompt_mask):
+            lengths = jnp.sum(prompt_mask, axis=1).astype(jnp.int32)  # [1]
+            pad_add_t = eng_t._pad_mask_add(prompt_mask, cache_len)
+            pad_add_d = eng_d._pad_mask_add(prompt_mask, cache_len)
+            pos = jnp.clip(jnp.cumsum(prompt_mask, axis=1) - 1, 0, None)
+            pos = pos.astype(jnp.int32)
+
+            caches_t = eng_t._empty_caches(1, cache_len)
+            caches_d = eng_d._empty_caches(1, cache_len)
+            logits_t, caches_t = eng_t._model_step(
+                params_t, ids, pos, pad_add_t, caches_t)
+            _, caches_d = eng_d._model_step(
+                params_d, ids, pos, pad_add_d, caches_d)
+            t1 = jnp.argmax(logits_t[:, -1], axis=-1).astype(jnp.int32)
+
+            out = jnp.full((1, max_new + gamma), pad, jnp.int32)
+            out = out.at[:, 0].set(t1)
+            fin = (t1[0] == eos) if eos is not None \
+                else jnp.asarray(False)
+
+            def rewind(caches, idx):
+                return [(k, v, idx) for k, v, _ in caches]
+
+            def cond(state):
+                cur, fin = state[0], state[3]
+                return jnp.logical_and(cur < max_new,
+                                       jnp.logical_not(fin))
+
+            def body(state):
+                cur, last, out, fin, caches_t, caches_d, acc, iters = state
+                base = lengths[0] + cur - 1       # position of `last`
+                idx0 = plen + cur - 1             # cache slots filled
+
+                # --- draft: propose gamma tokens autoregressively
+                def dstep(carry, j):
+                    tok, cd = carry
+                    lg, cd = eng_d._model_step(
+                        params_d, tok[:, None], (base + j)[None, None],
+                        pad_add_d, cd)
+                    nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
+                    return (nxt, cd), (tok[0], nxt[0])
+
+                (_, caches_d), (fed, props) = jax.lax.scan(
+                    dstep, (last, caches_d), jnp.arange(gamma))
+                # fed[j] = token fed at step j (= [last, d1..d_{g-1}]);
+                # props[j] = draft's proposal d_{j+1}
+
+                # --- target: verify the same gamma tokens in one chunk
+                vpos = (base + jnp.arange(gamma))[None, :]
+                lg_t, caches_t = eng_t._model_step(
+                    params_t, fed[None, :], vpos, pad_add_t, caches_t)
+                a = jnp.argmax(lg_t[0], axis=-1).astype(jnp.int32)  # [g]
+
+                # --- accept the longest matching prefix
+                match = props == a                               # [g]
+                n = jnp.argmin(
+                    jnp.concatenate([match.astype(jnp.int32),
+                                     jnp.zeros((1,), jnp.int32)]))
+                # n = index of first mismatch; n == gamma → all accepted
+                count = jnp.where(n < gamma, n + 1, gamma)
+                i = jnp.arange(gamma)
+                emitted = jnp.where(i < n, props, jnp.where(i == n, a, pad))
+                emitted = jnp.where(i < count, emitted, pad)
+
+                if eos is not None:
+                    is_eos = jnp.logical_and(emitted == eos, i < count)
+                    any_eos = jnp.any(is_eos)
+                    first = jnp.argmax(is_eos)     # first True (if any)
+                    count = jnp.where(any_eos, first + 1, count)
+                    emitted = jnp.where(i < count, emitted, pad)
+                    fin = jnp.logical_or(fin, any_eos)
+
+                out = jax.lax.dynamic_update_slice(
+                    out, emitted[None, :], (jnp.zeros((), jnp.int32), cur))
+                last = jnp.take(emitted, count - 1)[None]
+                # confirmed fed tokens == count for both caches
+                caches_t = rewind(caches_t, idx0 + count)
+                caches_d = rewind(caches_d, idx0 + count)
+                return (cur + count, last, out, fin, caches_t, caches_d,
+                        acc + n, iters + 1)
+
+            state = (jnp.asarray(1, jnp.int32), t1, out, fin,
+                     rewind(caches_t, plen), rewind(caches_d, plen),
+                     jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+            state = jax.lax.while_loop(cond, body, state)
+            return state[2][:, :max_new], state[6], state[7]
+
+        return jax.jit(run)
+
+    # ------------------------------------------------------------- public
+    def generate(self, input_ids,
+                 generation_config: Optional[GenerationConfig] = None,
+                 attention_mask=None):
+        g = generation_config or GenerationConfig()
+        if g.do_sample or g.num_beams > 1:
+            raise NotImplementedError(
+                "SpeculativeEngine is greedy-only (sampling needs the "
+                "rejection-resampling scheme; beams defeat speculation)")
+        if g.repetition_penalty != 1.0 or g.min_length > 0:
+            raise NotImplementedError(
+                "history-dependent logit processing breaks chunk "
+                "verification; use GenerationEngine for those configs")
+        self._t._params = self._t._snapshot_params()
+        self._d._params = self._d._snapshot_params()
+        # budget: the last verify chunk may probe up to gamma-1 positions
+        # past max_new before its overshoot is sliced away
+        ids, mask, plen, cache_len = self._t._prepare(
+            input_ids, attention_mask, g,
+            budget=g.max_new_tokens + self.gamma)
+        if ids.shape[0] != 1:
+            raise ValueError("SpeculativeEngine serves batch size 1 "
+                             "(the bs1 latency regime); got "
+                             f"batch={ids.shape[0]}")
+
+        key = (plen, cache_len, g.cache_key())
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = self._build(plen, cache_len, g)
+            self._compiled[key] = fn
+        with _MeshContext(self._mesh):
+            seq, accepted, iters = fn(
+                self._t._params, self._d._params,
+                self._t._replicated(ids), self._t._replicated(mask))
+        iters = int(iters)
+        self.last_acceptance = (float(accepted) / (iters * self.gamma)
+                                if iters else None)
+        return np.asarray(seq)

@@ -1,0 +1,102 @@
+"""Speculative decoding (inference/speculative.py): draft-proposed,
+target-verified chunks must be TOKEN-IDENTICAL to target-only greedy
+generation, for any draft."""
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.inference.generation import (GenerationConfig,
+                                                   GenerationEngine)
+from paddle_infer_tpu.inference.speculative import SpeculativeEngine
+from paddle_infer_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+CFG = dict(vocab_size=97, hidden_size=32, num_hidden_layers=2,
+           num_attention_heads=4, intermediate_size=64,
+           max_position_embeddings=256, hidden_dropout_prob=0.0,
+           attention_probs_dropout_prob=0.0)
+
+
+def _models():
+    pit.seed(0)
+    target = GPTForCausalLM(GPTConfig(**CFG))
+    target.eval()
+    pit.seed(1)
+    draft = GPTForCausalLM(GPTConfig(**CFG))
+    draft.eval()
+    return target, draft
+
+
+class TestSpeculative:
+    def test_identical_to_target_greedy_random_draft(self):
+        target, draft = _models()
+        ids = np.random.RandomState(0).randint(0, 97, (1, 9)) \
+            .astype(np.int32)
+        g = GenerationConfig(max_new_tokens=24, do_sample=False)
+        base = GenerationEngine(target).generate(ids, g)
+        se = SpeculativeEngine(target, draft, num_draft_tokens=4)
+        np.testing.assert_array_equal(se.generate(ids, g), base)
+        # a random draft agrees with the target near-never
+        assert se.last_acceptance is not None
+        assert se.last_acceptance <= 0.5
+
+    def test_identical_with_self_draft_full_acceptance(self):
+        target, _ = _models()
+        ids = np.random.RandomState(1).randint(0, 97, (1, 7)) \
+            .astype(np.int32)
+        g = GenerationConfig(max_new_tokens=17, do_sample=False)
+        base = GenerationEngine(target).generate(ids, g)
+        se = SpeculativeEngine(target, target, num_draft_tokens=4)
+        np.testing.assert_array_equal(se.generate(ids, g), base)
+        assert se.last_acceptance == 1.0
+
+    @pytest.mark.parametrize("gamma", [1, 3, 7])
+    def test_gamma_sweep(self, gamma):
+        target, draft = _models()
+        ids = np.random.RandomState(2).randint(0, 97, (1, 5)) \
+            .astype(np.int32)
+        g = GenerationConfig(max_new_tokens=11, do_sample=False)
+        base = GenerationEngine(target).generate(ids, g)
+        se = SpeculativeEngine(target, draft, num_draft_tokens=gamma)
+        np.testing.assert_array_equal(se.generate(ids, g), base)
+
+    def test_eos_stops_identically(self):
+        target, _ = _models()
+        ids = np.random.RandomState(3).randint(0, 97, (1, 6)) \
+            .astype(np.int32)
+        # pick the token the target emits at step 3 as EOS so the stop
+        # lands mid-chunk
+        probe = GenerationEngine(target).generate(
+            ids, GenerationConfig(max_new_tokens=8, do_sample=False))
+        eos = int(probe[0, 3])
+        g = GenerationConfig(max_new_tokens=16, do_sample=False,
+                             eos_token_id=eos, pad_token_id=0)
+        base = GenerationEngine(target).generate(ids, g)
+        se = SpeculativeEngine(target, target, num_draft_tokens=4)
+        np.testing.assert_array_equal(se.generate(ids, g), base)
+
+    def test_left_padded_prompt(self):
+        target, draft = _models()
+        ids = np.zeros((1, 12), np.int32)
+        mask = np.zeros((1, 12), np.int32)
+        ids[0, 4:] = np.random.RandomState(4).randint(1, 97, 8)
+        mask[0, 4:] = 1
+        g = GenerationConfig(max_new_tokens=9, do_sample=False)
+        base = GenerationEngine(target).generate(ids, g,
+                                                 attention_mask=mask)
+        se = SpeculativeEngine(target, draft, num_draft_tokens=3)
+        np.testing.assert_array_equal(
+            se.generate(ids, g, attention_mask=mask), base)
+
+    def test_rejects_unsupported_configs(self):
+        target, draft = _models()
+        se = SpeculativeEngine(target, draft)
+        ids = np.ones((1, 4), np.int32)
+        with pytest.raises(NotImplementedError):
+            se.generate(ids, GenerationConfig(do_sample=True))
+        with pytest.raises(NotImplementedError):
+            se.generate(ids, GenerationConfig(repetition_penalty=1.2))
+        with pytest.raises(ValueError):
+            se.generate(np.ones((2, 4), np.int32),
+                        GenerationConfig(do_sample=False))
+        with pytest.raises(ValueError):
+            SpeculativeEngine(target, draft, num_draft_tokens=0)
