@@ -155,6 +155,27 @@ class SpeculativeEngine:
         return jax.jit(run)
 
     # ------------------------------------------------------------- public
+    def supports(self, input_ids,
+                 generation_config: Optional[GenerationConfig] = None
+                 ) -> bool:
+        """Whether this request can ride the speculative path: greedy,
+        batch 1, no history-dependent logit processing, and the prompt +
+        max_new + gamma chunk overshoot fits the position table.  Serving
+        layers should route on THIS (not re-derive the conditions) so
+        eligibility can't drift from the engine."""
+        g = generation_config or GenerationConfig()
+        ids = np.asarray(input_ids._data
+                         if hasattr(input_ids, "_data") else input_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        if ids.shape[0] != 1:
+            return False
+        if g.do_sample or g.num_beams > 1 \
+                or g.repetition_penalty != 1.0 or g.min_length > 0:
+            return False
+        return (ids.shape[1] + g.max_new_tokens + self.gamma
+                <= self._t._max_positions)
+
     def generate(self, input_ids,
                  generation_config: Optional[GenerationConfig] = None,
                  attention_mask=None):

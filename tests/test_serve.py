@@ -26,9 +26,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-@pytest.fixture(scope="module")
-def server(tmp_path_factory):
-    d = str(tmp_path_factory.mktemp("model") / "gpt")
+def _tiny_model(save_dir):
     pit.seed(0)
     m = GPTForCausalLM(GPTConfig(
         vocab_size=96, hidden_size=32, num_hidden_layers=2,
@@ -36,14 +34,20 @@ def server(tmp_path_factory):
         max_position_embeddings=64, hidden_dropout_prob=0.0,
         attention_probs_dropout_prob=0.0))
     m.eval()
-    m.save_pretrained(d)
+    m.save_pretrained(save_dir)
+    return m
+
+
+def _spawn_server(model_dir, *extra_args):
+    """Start tools/serve.py, wait for /health, return (url, proc)."""
     port = _free_port()
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
     proc = subprocess.Popen(
         [sys.executable, os.path.join(ROOT, "tools", "serve.py"),
-         "--model_dir", d, "--port", str(port), "--page_size", "8"],
+         "--model_dir", model_dir, "--port", str(port),
+         "--page_size", "8", *extra_args],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True)
     url = f"http://127.0.0.1:{port}"
@@ -51,14 +55,20 @@ def server(tmp_path_factory):
         try:
             with urllib.request.urlopen(url + "/health", timeout=2) as r:
                 if json.load(r)["status"] == "ok":
-                    break
+                    return url, proc
         except Exception:
             if proc.poll() is not None:
                 raise RuntimeError(proc.stderr.read()[-1500:])
             time.sleep(1)
-    else:
-        proc.kill()
-        raise RuntimeError("server never became healthy")
+    proc.kill()
+    raise RuntimeError("server never became healthy")
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("model") / "gpt")
+    m = _tiny_model(d)
+    url, proc = _spawn_server(d)
     yield url, m
     proc.terminate()
     proc.wait(timeout=30)
@@ -103,3 +113,62 @@ def test_bad_request_400(server):
         raise AssertionError("expected 400")
     except urllib.error.HTTPError as e:
         assert e.code == 400
+
+
+def test_speculative_serving_path(tmp_path):
+    """--draft_dir routes greedy bs1 requests through SpeculativeEngine;
+    tokens must match the non-draft paged response (self-draft →
+    acceptance 1.0)."""
+    d = str(tmp_path / "gpt")
+    m = _tiny_model(d)
+    url, proc = _spawn_server(d, "--draft_dir", d,
+                              "--num_draft_tokens", "3")
+    try:
+        ids = np.random.RandomState(5).randint(0, 96, (1, 8)) \
+            .astype(np.int32)
+        g = GenerationConfig(max_new_tokens=6)
+        want = PagedGenerationEngine(m, page_size=8).generate(ids, g)
+        with _post(url, "/generate", {"ids": ids.tolist(),
+                                      "max_new_tokens": 6}) as r:
+            body = json.load(r)
+        assert body.get("speculative") is True
+        assert body.get("acceptance") == 1.0       # self-draft
+        np.testing.assert_array_equal(np.asarray(body["tokens"]), want)
+        # batched request falls back to the paged engine
+        ids2 = np.random.RandomState(6).randint(0, 96, (2, 8)) \
+            .astype(np.int32)
+        with _post(url, "/generate", {"ids": ids2.tolist(),
+                                      "max_new_tokens": 4}) as r:
+            body2 = json.load(r)
+        assert "speculative" not in body2
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+
+
+def test_speculative_budget_falls_back(tmp_path):
+    """A request whose prompt+max_new fits the paged engine but not the
+    speculative chunk budget must FALL BACK, not 500 (supports() owns
+    the eligibility rules)."""
+    d = str(tmp_path / "gpt")
+    m = _tiny_model(d)
+    url, proc = _spawn_server(d, "--draft_dir", d,
+                              "--num_draft_tokens", "4")
+    try:
+        # max_position_embeddings=64: 8 + 56 fits plain decode, but
+        # 8 + 56 + gamma(4) does not
+        ids = np.random.RandomState(7).randint(0, 96, (1, 8)) \
+            .astype(np.int32)
+        with _post(url, "/generate", {"ids": ids.tolist(),
+                                      "max_new_tokens": 56}) as r:
+            body = json.load(r)
+        assert "speculative" not in body
+        assert len(body["tokens"][0]) == 56
+        # flat 1-D prompt still rides the fast path
+        with _post(url, "/generate", {"ids": ids[0].tolist(),
+                                      "max_new_tokens": 6}) as r:
+            body2 = json.load(r)
+        assert body2.get("speculative") is True
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)

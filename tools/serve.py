@@ -37,6 +37,24 @@ def _engine():
     return _STATE["engine"]
 
 
+def _spec_engine():
+    if "spec_engine" not in _STATE:
+        from paddle_infer_tpu.inference.speculative import SpeculativeEngine
+
+        _STATE["spec_engine"] = SpeculativeEngine(
+            _STATE["model"], _STATE["draft_model"],
+            num_draft_tokens=_STATE["num_draft_tokens"])
+    return _STATE["spec_engine"]
+
+
+def _speculatable(ids, g):
+    """Requests the draft-accelerated path can serve — the ENGINE owns
+    the eligibility rules (greedy bs1 within the position budget);
+    everything else falls through to the paged engine."""
+    return (_STATE.get("draft_model") is not None
+            and _spec_engine().supports(ids, g))
+
+
 def _gen_config(body):
     from paddle_infer_tpu.inference.generation import GenerationConfig
 
@@ -90,8 +108,16 @@ class Handler(BaseHTTPRequestHandler):
                 # the engine mutates shared state (donated pools, page
                 # reservations) — one request at a time
                 with _STATE["lock"]:
-                    toks = _engine().generate(ids, g)
-                self._json(200, {"tokens": np.asarray(toks).tolist()})
+                    if _speculatable(ids, g):
+                        eng = _spec_engine()
+                        toks = eng.generate(ids, g)
+                        extra = {"speculative": True,
+                                 "acceptance": eng.last_acceptance}
+                    else:
+                        toks = _engine().generate(ids, g)
+                        extra = {}
+                self._json(200, {"tokens": np.asarray(toks).tolist(),
+                                 **extra})
             elif self.path == "/generate_stream":
                 with _STATE["lock"]:
                     stream = _engine().stream(
@@ -127,12 +153,19 @@ def main(argv=None):
                     help="save_pretrained directory (AutoModel-loadable)")
     ap.add_argument("--port", type=int, default=8800)
     ap.add_argument("--page_size", type=int, default=16)
+    ap.add_argument("--draft_dir", default=None,
+                    help="optional draft model for speculative decoding "
+                         "of greedy bs1 requests")
+    ap.add_argument("--num_draft_tokens", type=int, default=4)
     args = ap.parse_args(argv)
 
     from paddle_infer_tpu.models import AutoModel
 
     _STATE["model"] = AutoModel.from_pretrained(args.model_dir)
     _STATE["page_size"] = args.page_size
+    _STATE["draft_model"] = (AutoModel.from_pretrained(args.draft_dir)
+                             if args.draft_dir else None)
+    _STATE["num_draft_tokens"] = args.num_draft_tokens
     server = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
     print(f"serving {type(_STATE['model']).__name__} on "
           f"127.0.0.1:{args.port}", flush=True)
