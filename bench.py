@@ -56,6 +56,33 @@ DECODE_MARGINAL_TARGET_MS = 1.0
 _REQUIRED_KEYS = {"metric", "value", "unit", "vs_baseline"}
 
 
+class _SectionTimeout(Exception):
+    pass
+
+
+import contextlib  # noqa: E402
+import signal  # noqa: E402
+
+
+@contextlib.contextmanager
+def _section_alarm(seconds: int):
+    """Best-effort per-section time limit (SIGALRM).  A hang inside a
+    GIL-releasing device wait can outlive the alarm (the handler needs
+    Python to resume) — the parent's subprocess timeout plus the
+    preliminary-JSON salvage below remain the hard backstop."""
+
+    def handler(signum, frame):
+        raise _SectionTimeout(f"section exceeded {seconds}s")
+
+    old = signal.signal(signal.SIGALRM, handler)
+    signal.alarm(seconds)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
 # --------------------------------------------------------------------------
 # parent: retry / fallback orchestration
 # --------------------------------------------------------------------------
@@ -111,7 +138,19 @@ def _parent() -> int:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--child"],
                 env=env, capture_output=True, text=True, timeout=timeout)
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as exc:
+            # salvage the child's preliminary headline JSON if it got far
+            # enough before an aux section hung
+            partial = exc.stdout or b""
+            if isinstance(partial, bytes):
+                partial = partial.decode(errors="replace")
+            salvaged = _last_json(partial)
+            if salvaged is not None:
+                salvaged["error"] = (f"{platform}: aux sections timed out "
+                                     f"after {timeout}s; headline metric "
+                                     "salvaged from partial output")
+                print(json.dumps(salvaged))
+                return 0
             errors.append(f"{platform}: timeout after {timeout}s")
             continue
         if proc.stderr:
@@ -388,11 +427,27 @@ def _child_main():
         except Exception:
             xplane_dir = None
 
+    # headline is in hand: print a PRELIMINARY JSON line now, so if an
+    # aux section below hangs past the parent's timeout, the parent
+    # salvages this line from partial stdout instead of losing the round
+    # (r04: conv compiles through the tunnel were observed to hang)
+    print(json.dumps({
+        "metric": "ernie3.0-base train tokens/sec/chip "
+                  "(bf16, bs%d seq%d, dropout 0.1, 10%% padded)"
+                  % (batch, seq),
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.35, 3),
+        "mfu_6nt_plus_attn": round(mfu, 4),
+        "preliminary": "aux sections pending",
+    }), flush=True)
+
     # real-hardware kernel smoke (never kills the headline)
     kernel_smoke = None
     if on_tpu:
         try:
-            kernel_smoke = _kernel_smoke(on_tpu)
+            with _section_alarm(600):
+                kernel_smoke = _kernel_smoke(on_tpu)
         except Exception as e:
             kernel_smoke = {"error": repr(e)[:200]}
 
@@ -400,14 +455,17 @@ def _child_main():
     resnet_ips = None
     if on_tpu:
         try:
-            resnet_ips = _resnet50_throughput(on_tpu)
+            with _section_alarm(900):
+                resnet_ips = _resnet50_throughput(on_tpu)
         except Exception as e:
             print(f"resnet50 bench skipped: {e!r}", file=sys.stderr)
 
     # the latency bench needs the native runtime (paged-KV pool); never let
     # it take down the training metric
     try:
-        p50_ms, marginal_ms, marginal_int8_ms = _decode_latency_bs1(on_tpu)
+        with _section_alarm(900):
+            p50_ms, marginal_ms, marginal_int8_ms = \
+                _decode_latency_bs1(on_tpu)
         p50_ms = round(p50_ms, 3)
     except Exception as e:
         print(f"decode latency bench skipped: {e!r}", file=sys.stderr)
@@ -417,7 +475,8 @@ def _child_main():
     llama_marginal = None
     if on_tpu:
         try:
-            llama_marginal = _llama_decode_marginal()
+            with _section_alarm(600):
+                llama_marginal = _llama_decode_marginal()
         except Exception as e:
             print(f"llama decode bench skipped: {e!r}", file=sys.stderr)
 

@@ -63,3 +63,19 @@ def test_merge_profiles(tmp_path):
                                                  "host1/pid1"}
     xs = [e for e in evs if e.get("ph") == "X"]
     assert len({e["pid"] for e in xs}) == 2     # distinct row groups
+
+
+def test_bench_last_json_salvage():
+    """bench.py parent salvage: _last_json must return the LAST complete
+    metric line (preliminary headline lines count when nothing later
+    parsed)."""
+    sys.path.insert(0, ROOT)
+    import bench
+
+    pre = ('noise\n{"metric": "m", "value": 1.0, "unit": "t/s", '
+           '"vs_baseline": 1.0, "preliminary": "aux pending"}\n')
+    full = pre + ('{"metric": "m", "value": 2.0, "unit": "t/s", '
+                  '"vs_baseline": 1.1}\n')
+    assert bench._last_json(full)["value"] == 2.0
+    assert bench._last_json(pre)["value"] == 1.0       # salvage case
+    assert bench._last_json("garbage\n{broken") is None
