@@ -120,10 +120,9 @@ def create_parameter(shape, dtype="float32", name=None, attr=None,
     shape = tuple(int(s) for s in shape)
     if default_initializer is not None:
         init = default_initializer
-    elif is_bias:
-        init = nn.initializer.Constant(0.0)
     else:
-        init = nn.initializer.XavierUniform()
+        # honors set_global_initializer, same as Layer.create_parameter
+        init = nn.initializer._default_initializer(is_bias)
     data = init(shape, dt)
     return Parameter(data._data if isinstance(data, Tensor) else data,
                      name=name)

@@ -193,3 +193,43 @@ class Dirac(Initializer):
             for k in range(min(per_group, in_c)):
                 w[(g * per_group + k, k) + centers] = 1.0
         return jnp.asarray(w, dtypes.convert_dtype(dtype))
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    """Recommended init gain per activation (reference
+    fluid/initializer.py calculate_gain; the standard Kaiming table)."""
+    ones = {"linear", "conv1d", "conv2d", "conv3d", "conv1d_transpose",
+            "conv2d_transpose", "conv3d_transpose", "sigmoid"}
+    if nonlinearity in ones:
+        return 1.0
+    if nonlinearity == "tanh":
+        return 5.0 / 3.0
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else float(param)
+        return math.sqrt(2.0 / (1.0 + a ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4.0
+    raise ValueError(f"unsupported nonlinearity: {nonlinearity!r}")
+
+
+_GLOBAL_WEIGHT_INIT = None
+_GLOBAL_BIAS_INIT = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Override the default initializers Layer.create_parameter uses when
+    no explicit one is given (reference initializer.py
+    set_global_initializer).  Pass ``None, None`` to restore defaults."""
+    global _GLOBAL_WEIGHT_INIT, _GLOBAL_BIAS_INIT
+    _GLOBAL_WEIGHT_INIT = weight_init
+    _GLOBAL_BIAS_INIT = bias_init
+
+
+def _default_initializer(is_bias: bool):
+    if is_bias:
+        return _GLOBAL_BIAS_INIT if _GLOBAL_BIAS_INIT is not None \
+            else Constant(0.0)
+    return _GLOBAL_WEIGHT_INIT if _GLOBAL_WEIGHT_INIT is not None \
+        else XavierUniform()

@@ -98,8 +98,7 @@ class Layer:
         if attr is not None and getattr(attr, "initializer", None) is not None:
             default_initializer = attr.initializer
         if default_initializer is None:
-            default_initializer = (I.Constant(0.0) if is_bias
-                                   else I.XavierUniform())
+            default_initializer = I._default_initializer(is_bias)
         data = default_initializer(shape, dtype)
         name = None
         if attr is not None and getattr(attr, "name", None):

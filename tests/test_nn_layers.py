@@ -424,3 +424,36 @@ class TestInitializersRound3:
 
         with pytest.raises(ValueError):
             Dirac(groups=4)((6, 2, 3, 3))
+
+
+class TestInitializerGlobals:
+    """calculate_gain + set_global_initializer (reference
+    nn/initializer __all__; fluid/initializer.py)."""
+
+    def test_calculate_gain_table(self):
+        import math
+
+        from paddle_infer_tpu.nn import initializer as I
+
+        assert I.calculate_gain("linear") == 1.0
+        assert I.calculate_gain("tanh") == pytest.approx(5.0 / 3.0)
+        assert I.calculate_gain("relu") == pytest.approx(math.sqrt(2.0))
+        assert I.calculate_gain("leaky_relu", 0.2) == pytest.approx(
+            math.sqrt(2.0 / 1.04))
+        with pytest.raises(ValueError):
+            I.calculate_gain("nope")
+
+    def test_set_global_initializer(self):
+        from paddle_infer_tpu import nn
+        from paddle_infer_tpu.nn import initializer as I
+
+        I.set_global_initializer(I.Constant(3.0), I.Constant(-1.0))
+        try:
+            fc = nn.Linear(4, 2)
+            assert np.all(fc.weight.numpy() == 3.0)
+            assert np.all(fc.bias.numpy() == -1.0)
+        finally:
+            I.set_global_initializer(None, None)
+        fc2 = nn.Linear(4, 2)
+        assert not np.all(fc2.weight.numpy() == 3.0)
+        assert np.all(fc2.bias.numpy() == 0.0)
