@@ -132,6 +132,25 @@ _GATES = {"switch": switch_gate, "gshard": gshard_gate, "naive": naive_gate}
 _FUSED_JIT_CACHE = {}
 
 
+def _mesh_jit(impl, **attrs):
+    """Jit ``impl`` with attrs partial-bound, cached per (impl, mesh,
+    attrs).  The MoE impls pin "ep" shardings against the live mesh, so
+    the eager cache must key on it instead of the dispatcher's attrs-only
+    cache; executables compiled for stale meshes are evicted."""
+    import functools
+
+    key = (impl.__name__, topology.get_current_mesh(),
+           tuple(sorted(attrs.items())))
+    fn = _FUSED_JIT_CACHE.get(key)
+    if fn is None:
+        for k in list(_FUSED_JIT_CACHE):
+            if k[1] is not None and k[1] is not key[1]:
+                del _FUSED_JIT_CACHE[k]
+        fn = jax.jit(functools.partial(impl, **attrs))
+        _FUSED_JIT_CACHE[key] = fn
+    return fn
+
+
 @register_op("fused_moe", jit=False)  # jitted internally, keyed by mesh
 def _fused_moe(x, gate_w, w1, b1, w2, b2, gate="gshard", top_k=2,
                capacity_factor=2.0, activation="gelu"):
@@ -140,53 +159,50 @@ def _fused_moe(x, gate_w, w1, b1, w2, b2, gate="gshard", top_k=2,
 
     x [b, s, d]; gate_w [d, E]; w1 [E, d, f]; b1 [E, f]; w2 [E, f, d];
     b2 [E, d].  Returns (out [b, s, d], aux_loss scalar).
-
-    The impl reads the current mesh (the "ep" pin), so the eager jit cache
-    is keyed by (mesh, attrs) here instead of the dispatcher's attrs-only
-    cache.
     """
-    import functools
-
-    key = (topology.get_current_mesh(), gate, top_k, capacity_factor,
-           activation)
-    fn = _FUSED_JIT_CACHE.get(key)
-    if fn is None:
-        # evict executables compiled for meshes that are no longer current
-        for k in list(_FUSED_JIT_CACHE):
-            if k[0] is not None and k[0] is not key[0]:
-                del _FUSED_JIT_CACHE[k]
-        fn = jax.jit(functools.partial(
-            _fused_moe_impl, gate=gate, top_k=top_k,
-            capacity_factor=capacity_factor, activation=activation))
-        _FUSED_JIT_CACHE[key] = fn
+    fn = _mesh_jit(_fused_moe_impl, gate=gate, top_k=top_k,
+                   capacity_factor=capacity_factor, activation=activation)
     return fn(x, gate_w, w1, b1, w2, b2)
 
 
-def _fused_moe_impl(x, gate_w, w1, b1, w2, b2, gate="gshard", top_k=2,
-                    capacity_factor=2.0, activation="gelu"):
+def _gate_dispatch(x, gate_w, gate, top_k, capacity_factor):
+    """Gate + capacity dispatch front half shared by every fused-MoE
+    variant (float / weight-only / int8): returns the flat tokens, the
+    combine tensor, the ep-pinned per-expert input buffers [E, C, d] and
+    the load-balancing aux loss."""
     b, s, d = x.shape
-    e = gate_w.shape[1]
     n = b * s
     xt = x.reshape(n, d)
     logits = xt.astype(jnp.float32) @ gate_w.astype(jnp.float32)
-    cap = _capacity(n, e, capacity_factor, top_k)
+    cap = _capacity(n, gate_w.shape[1], capacity_factor, top_k)
     if gate == "naive":
         combine, dispatch, aux = naive_gate(logits, cap, top_k=top_k)
     else:
         combine, dispatch, aux = _GATES[gate](logits, cap)
     # dispatch tokens → per-expert buffers [E, C, d]; pin expert dim to
     # "ep" so GSPMD all-to-alls tokens onto expert shards
-    expert_in = jnp.einsum("nec,nd->ecd",
-                           dispatch.astype(x.dtype), xt)
-    expert_in = _pin_ep(expert_in)
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xt)
+    return xt, combine, _pin_ep(expert_in), aux
+
+
+def _combine_out(x, combine, out_e):
+    """Combine back half shared by every fused-MoE variant."""
+    b, s, d = x.shape
+    out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype),
+                     _pin_ep(out_e))
+    return out.reshape(b, s, d)
+
+
+def _fused_moe_impl(x, gate_w, w1, b1, w2, b2, gate="gshard", top_k=2,
+                    capacity_factor=2.0, activation="gelu"):
+    _, combine, expert_in, aux = _gate_dispatch(x, gate_w, gate, top_k,
+                                                capacity_factor)
     act = getattr(jax.nn, activation)
     h = jnp.einsum("ecd,edf->ecf", expert_in, w1.astype(x.dtype))
     h = act(h + b1[:, None, :].astype(h.dtype))
     out_e = jnp.einsum("ecf,efd->ecd", h, w2.astype(x.dtype))
     out_e = out_e + b2[:, None, :].astype(out_e.dtype)
-    out_e = _pin_ep(out_e)
-    out = jnp.einsum("nec,ecd->nd", combine.astype(x.dtype), out_e)
-    return out.reshape(b, s, d), aux.astype(jnp.float32)
+    return _combine_out(x, combine, out_e), aux.astype(jnp.float32)
 
 
 def _pin_ep(arr):

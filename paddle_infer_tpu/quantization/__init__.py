@@ -5,7 +5,10 @@ python/paddle/fluid/contrib/slim/quantization/)."""
 from .slim import PTQ, QAT, MovingAverageObserver, QuantedLayer
 from .weight_only import (WeightOnlyLinear, quantize_model)
 from .int8 import Int8Linear, convert_int8
+from .moe import (Int8MoELayer, WeightOnlyMoELayer,
+                  calibrate_moe_act_scales)
 
 __all__ = ["WeightOnlyLinear", "quantize_model", "QAT", "PTQ",
            "MovingAverageObserver", "QuantedLayer", "Int8Linear",
-           "convert_int8"]
+           "convert_int8", "WeightOnlyMoELayer", "Int8MoELayer",
+           "calibrate_moe_act_scales"]

@@ -180,20 +180,37 @@ class WeightOnlyLinear(Layer):
 def quantize_model(model, algo="weight_only_int8", group_size=-1,
                    skip=None):
     """In-place weight-only quantization pass: swap every linear-like
-    sublayer (weight [in, out]) for WeightOnlyLinear (reference: the
-    predictor's enable_weight_only_quant applying weight_only_linear2
-    rewrites).  ``skip(full_name, layer) -> bool`` exempts layers (e.g.
-    lm_head / embeddings).  Returns the model."""
+    sublayer (weight [in, out]) for WeightOnlyLinear, and every MoE FFN
+    for WeightOnlyMoELayer with quantized stacked expert payloads
+    (reference: the predictor's enable_weight_only_quant applying
+    weight_only_linear2 rewrites; the MoE swap matches
+    fused_multi_transformer_moe_weight_only_op.cu).  ``skip(full_name,
+    layer) -> bool`` exempts layers (e.g. lm_head / embeddings).
+    Returns the model."""
     from ..nn.layers_common import Linear
+    from ..parallel.moe import MoELayer
     from ..parallel.mp_layers import (ColumnParallelLinear,
                                       RowParallelLinear)
+    from .moe import WeightOnlyMoELayer
     from .slim import _swap
 
     def make(sub):
+        if isinstance(sub, MoELayer):
+            # expert payloads quantize per-expert per-channel; grouped
+            # scales are a dense-linear refinement the MoE path doesn't
+            # support (matches the reference moe weight-only op, which
+            # also carries per-channel scales)
+            if group_size not in (-1, None):
+                import warnings
+
+                warnings.warn(
+                    "quantize_model: group_size is ignored for MoE "
+                    "expert weights (per-channel scales are used)")
+            return WeightOnlyMoELayer.from_moe(sub, algo=algo)
         gs = group_size
         if gs not in (-1, None) and sub.weight.shape[0] % gs != 0:
             gs = -1      # fall back to per-channel
         return WeightOnlyLinear.from_linear(sub, algo=algo, group_size=gs)
 
-    return _swap(model, (Linear, ColumnParallelLinear, RowParallelLinear),
-                 make, skip)
+    return _swap(model, (Linear, ColumnParallelLinear, RowParallelLinear,
+                         MoELayer), make, skip)
