@@ -167,9 +167,9 @@ def _fused_moe(x, gate_w, w1, b1, w2, b2, gate="gshard", top_k=2,
 
 def _gate_dispatch(x, gate_w, gate, top_k, capacity_factor):
     """Gate + capacity dispatch front half shared by every fused-MoE
-    variant (float / weight-only / int8): returns the flat tokens, the
-    combine tensor, the ep-pinned per-expert input buffers [E, C, d] and
-    the load-balancing aux loss."""
+    variant (float / weight-only / int8): returns the combine tensor,
+    the ep-pinned per-expert input buffers [E, C, d] and the
+    load-balancing aux loss."""
     b, s, d = x.shape
     n = b * s
     xt = x.reshape(n, d)
@@ -182,7 +182,18 @@ def _gate_dispatch(x, gate_w, gate, top_k, capacity_factor):
     # dispatch tokens → per-expert buffers [E, C, d]; pin expert dim to
     # "ep" so GSPMD all-to-alls tokens onto expert shards
     expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), xt)
-    return xt, combine, _pin_ep(expert_in), aux
+    return combine, _pin_ep(expert_in), aux
+
+
+def _expert_ffn(expert_in, w1, b1, w2, b2, activation):
+    """Batched expert FFN body shared by the float and weight-only
+    variants: one [E,C,d]×[E,d,f] and one [E,C,f]×[E,f,d] MXU einsum."""
+    act = getattr(jax.nn, activation)
+    h = jnp.einsum("ecd,edf->ecf", expert_in,
+                   w1.astype(expert_in.dtype))
+    h = act(h + b1[:, None, :].astype(h.dtype))
+    out_e = jnp.einsum("ecf,efd->ecd", h, w2.astype(expert_in.dtype))
+    return out_e + b2[:, None, :].astype(out_e.dtype)
 
 
 def _combine_out(x, combine, out_e):
@@ -195,13 +206,9 @@ def _combine_out(x, combine, out_e):
 
 def _fused_moe_impl(x, gate_w, w1, b1, w2, b2, gate="gshard", top_k=2,
                     capacity_factor=2.0, activation="gelu"):
-    _, combine, expert_in, aux = _gate_dispatch(x, gate_w, gate, top_k,
-                                                capacity_factor)
-    act = getattr(jax.nn, activation)
-    h = jnp.einsum("ecd,edf->ecf", expert_in, w1.astype(x.dtype))
-    h = act(h + b1[:, None, :].astype(h.dtype))
-    out_e = jnp.einsum("ecf,efd->ecd", h, w2.astype(x.dtype))
-    out_e = out_e + b2[:, None, :].astype(out_e.dtype)
+    combine, expert_in, aux = _gate_dispatch(x, gate_w, gate, top_k,
+                                             capacity_factor)
+    out_e = _expert_ffn(expert_in, w1, b1, w2, b2, activation)
     return _combine_out(x, combine, out_e), aux.astype(jnp.float32)
 
 

@@ -29,8 +29,8 @@ import jax.numpy as jnp
 from ..core.dispatch import dispatch as D, register_op
 from ..core.tensor import Tensor
 from ..nn.layer import Layer
-from ..parallel.moe import (MoELayer, _combine_out, _gate_dispatch,
-                            _mesh_jit)
+from ..parallel.moe import (MoELayer, _combine_out, _expert_ffn,
+                            _gate_dispatch, _mesh_jit)
 from .weight_only import _bits
 
 
@@ -86,15 +86,11 @@ def _fused_moe_wo_impl(x, gate_w, qw1, s1, b1, qw2, s2, b2, gate="gshard",
                        algo="weight_only_int8"):
     """Weight-only fused MoE: dequant rides the expert-matmul operand
     feed (reference fused_multi_transformer_moe_weight_only_op.cu)."""
-    _, combine, expert_in, aux = _gate_dispatch(x, gate_w, gate, top_k,
-                                                capacity_factor)
+    combine, expert_in, aux = _gate_dispatch(x, gate_w, gate, top_k,
+                                             capacity_factor)
     w1 = _moe_weight_dequantize(qw1, s1, algo, x.dtype)
     w2 = _moe_weight_dequantize(qw2, s2, algo, x.dtype)
-    act = getattr(jax.nn, activation)
-    h = jnp.einsum("ecd,edf->ecf", expert_in, w1)
-    h = act(h + b1[:, None, :].astype(h.dtype))
-    out_e = jnp.einsum("ecf,efd->ecd", h, w2)
-    out_e = out_e + b2[:, None, :].astype(out_e.dtype)
+    out_e = _expert_ffn(expert_in, w1, b1, w2, b2, activation)
     return _combine_out(x, combine, out_e), aux.astype(jnp.float32)
 
 
@@ -106,8 +102,8 @@ def _fused_moe_int8_impl(x, gate_w, qw1, s1, b1, qw2, s2, b2,
     the MXU analog of its IMMA GEMMs).  The activation scales are traced
     scalar operands, not compile-time constants, so every layer of a
     model — each with its own calibrated scales — shares ONE executable."""
-    _, combine, expert_in, aux = _gate_dispatch(x, gate_w, gate, top_k,
-                                                capacity_factor)
+    combine, expert_in, aux = _gate_dispatch(x, gate_w, gate, top_k,
+                                             capacity_factor)
 
     def q_act(a, scale):
         return jnp.clip(jnp.round(a.astype(jnp.float32) / scale),
@@ -234,7 +230,7 @@ def calibrate_moe_act_scales(moe, sample_x):
     fused_multi_transformer_moe_int8_op's qkv/ffn in_scale attrs)."""
     x = sample_x._data if isinstance(sample_x, Tensor) else \
         jnp.asarray(sample_x)
-    xt, _, expert_in, _ = _gate_dispatch(
+    _, expert_in, _ = _gate_dispatch(
         x, moe.gate_weight._data, moe.gate_kind, moe.top_k,
         moe.capacity_factor)
     s_in = float(jnp.max(jnp.abs(expert_in))) / 127.0
