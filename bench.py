@@ -669,25 +669,10 @@ def _decode_latency_bs1(on_tpu: bool):
         times.append((time.perf_counter() - t0) / max_new * 1e3)
     p50_whole = float(np.percentile(times, 50))
 
-    # marginal per-token decode: difference of two generation lengths
-    # cancels the fixed prefill + host<->device round-trip cost (the
-    # development tunnel adds ~69 ms per sync that a co-located host
-    # doesn't pay), isolating the steady-state decode step
+    # marginal per-token decode: see _marginal_decode_ms (isolates the
+    # steady-state decode step from prefill + tunnel sync cost)
     def _marginal(engine):
-        g_short = GenerationConfig(max_new_tokens=max_new // 2)
-        engine.generate(ids, g_short)         # compile the short program
-        engine.generate(ids, g)
-        t_long, t_short = [], []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            engine.generate(ids, g)
-            t_long.append(time.perf_counter() - t0)
-            t0 = time.perf_counter()
-            engine.generate(ids, g_short)
-            t_short.append(time.perf_counter() - t0)
-        m = ((np.percentile(t_long, 50) - np.percentile(t_short, 50))
-             / (max_new - max_new // 2) * 1e3)
-        return float(max(m, 0.0))
+        return _marginal_decode_ms(engine, ids, max_new, reps)
 
     marginal = marginal_int8 = None
     if on_tpu:
@@ -705,6 +690,30 @@ def _decode_latency_bs1(on_tpu: bool):
     return p50_whole, marginal, marginal_int8
 
 
+def _marginal_decode_ms(engine, ids, max_new, reps):
+    """Marginal per-token decode via difference of two generation
+    lengths (cancels prefill + the ~69 ms/sync tunnel cost — see module
+    docstring).  Shared by the dense/LLaMA/MoE/quantized decode benches
+    so the methodology can only change in one place."""
+    from paddle_infer_tpu.inference import GenerationConfig
+
+    g_long = GenerationConfig(max_new_tokens=max_new)
+    g_short = GenerationConfig(max_new_tokens=max_new // 2)
+    engine.generate(ids, g_long)       # compile both programs
+    engine.generate(ids, g_short)
+    t_long, t_short = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        engine.generate(ids, g_long)
+        t_long.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        engine.generate(ids, g_short)
+        t_short.append(time.perf_counter() - t0)
+    m = ((np.percentile(t_long, 50) - np.percentile(t_short, 50))
+         / (max_new - max_new // 2) * 1e3)
+    return float(max(m, 0.0))
+
+
 def _llama_decode_marginal():
     """Marginal per-token paged decode for a scaled-down LLaMA
     architecture (RoPE + RMSNorm + SwiGLU; BASELINE.md milestone #5 bench
@@ -712,8 +721,7 @@ def _llama_decode_marginal():
     import jax.numpy as jnp
 
     import paddle_infer_tpu as pit
-    from paddle_infer_tpu.inference import (GenerationConfig,
-                                            PagedGenerationEngine)
+    from paddle_infer_tpu.inference import PagedGenerationEngine
     from paddle_infer_tpu.models import LlamaConfig, LlamaForCausalLM
 
     pit.seed(0)
@@ -725,25 +733,11 @@ def _llama_decode_marginal():
     model.eval()
     for p in model.parameters():
         p._data = p._data.astype(jnp.bfloat16)
-    prompt, max_new, reps = 128, 64, 10
+    prompt = 128
     eng = PagedGenerationEngine(model, page_size=16, prompt_bucket=prompt)
     ids = np.random.RandomState(0).randint(
         0, cfg.vocab_size, (1, prompt)).astype(np.int32)
-    g_long = GenerationConfig(max_new_tokens=max_new)
-    g_short = GenerationConfig(max_new_tokens=max_new // 2)
-    eng.generate(ids, g_long)
-    eng.generate(ids, g_short)
-    t_long, t_short = [], []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        eng.generate(ids, g_long)
-        t_long.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        eng.generate(ids, g_short)
-        t_short.append(time.perf_counter() - t0)
-    m = ((np.percentile(t_long, 50) - np.percentile(t_short, 50))
-         / (max_new - max_new // 2) * 1e3)
-    return float(max(m, 0.0))
+    return _marginal_decode_ms(eng, ids, max_new=64, reps=10)
 
 
 def _moe_decode_marginal():
@@ -755,8 +749,7 @@ def _moe_decode_marginal():
     import jax.numpy as jnp
 
     import paddle_infer_tpu as pit
-    from paddle_infer_tpu.inference import (GenerationConfig,
-                                            PagedGenerationEngine)
+    from paddle_infer_tpu.inference import PagedGenerationEngine
     from paddle_infer_tpu.models import GPTMoEForCausalLM, MoEConfig
     from paddle_infer_tpu.quantization import quantize_model
 
@@ -774,28 +767,14 @@ def _moe_decode_marginal():
             p._data = p._data.astype(jnp.bfloat16)
         return m
 
-    prompt, max_new, reps = 64, 32, 10
+    prompt = 64
     ids = np.random.RandomState(0).randint(
         0, 32000, (1, prompt)).astype(np.int32)
-    g_long = GenerationConfig(max_new_tokens=max_new)
-    g_short = GenerationConfig(max_new_tokens=max_new // 2)
 
     def marginal(model):
         eng = PagedGenerationEngine(model, page_size=16,
                                     prompt_bucket=prompt)
-        eng.generate(ids, g_long)
-        eng.generate(ids, g_short)
-        t_long, t_short = [], []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            eng.generate(ids, g_long)
-            t_long.append(time.perf_counter() - t0)
-            t0 = time.perf_counter()
-            eng.generate(ids, g_short)
-            t_short.append(time.perf_counter() - t0)
-        m = ((np.percentile(t_long, 50) - np.percentile(t_short, 50))
-             / (max_new - max_new // 2) * 1e3)
-        return float(max(m, 0.0))
+        return _marginal_decode_ms(eng, ids, max_new=32, reps=10)
 
     from paddle_infer_tpu.parallel.moe import MoELayer
 

@@ -178,6 +178,20 @@ def _amp_cast_arrays(name, arrays):
 
 # ------------------------------------------------------------------ dispatch
 
+# Profiler hook (profiler.Profiler): when set, every eager dispatch
+# reports (op_name, start_ns, end_ns) — the host-side Operator Summary
+# source (reference: the op-event layer of host_event_recorder).
+_OP_PROFILE_HOOK = None
+
+
+def set_op_profile_hook(fn):
+    """Install/remove the per-op profiling callback; returns previous."""
+    global _OP_PROFILE_HOOK
+    prev = _OP_PROFILE_HOOK
+    _OP_PROFILE_HOOK = fn
+    return prev
+
+
 # Program-IR tracer hook (framework/ir.py ProgramTracer): when set, every
 # dispatch is also recorded as an OpNode — the graph-capture surface that
 # replaces the reference's separate static-graph authoring mode.
@@ -262,7 +276,15 @@ def dispatch(name: str, *inputs, **attrs):
 
     frozen = _freeze_attrs(attrs)
     fn = _get_jitted(op, frozen)
-    out_arrays = fn(*arrays)
+    _hook = _OP_PROFILE_HOOK       # snapshot: stop() may clear it mid-op
+    if _hook is None:
+        out_arrays = fn(*arrays)
+    else:
+        import time as _time
+
+        _t0 = _time.perf_counter_ns()
+        out_arrays = fn(*arrays)
+        _hook(name, _t0, _time.perf_counter_ns())
 
     multi = isinstance(out_arrays, (tuple, list))
     outs_raw = list(out_arrays) if multi else [out_arrays]

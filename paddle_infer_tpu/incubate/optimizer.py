@@ -48,13 +48,40 @@ class LookAhead:
         self.inner_optimizer.clear_grad()
 
     def state_dict(self):
+        """Persist the slow-weight copies alongside the inner state (the
+        reference keeps slow params as optimizer accumulators, so a
+        checkpoint-resume mid-k-cycle must not reinitialize them from the
+        restored fast weights).  Slow copies are keyed by parameter index,
+        matching the base optimizer's state keying."""
+        import jax
+
+        slow = [(i, jax.device_get(self._slow[id(p)]))
+                for i, p in enumerate(self._parameters)
+                if id(p) in self._slow]
         return {"inner": self.inner_optimizer.state_dict(),
-                "steps": self._steps}
+                "steps": self._steps, "slow": slow}
+
+    def set_state_dict(self, state):
+        self.inner_optimizer.set_state_dict(state.get("inner", {}))
+        self._steps = state.get("steps", 0)
+        self._slow = {}
+        for i, arr in state.get("slow", []):
+            self._slow[id(self._parameters[i])] = jnp.asarray(arr)
+
+
+# reference average_accumulates kernel folds sum_1 into sum_2 every
+# 16384 steps so the running fp32 sum never loses low-order bits
+_MAX_NUM_ACCUMULATES = 16384
 
 
 class ModelAverage:
-    """Running average of parameters (reference modelaverage.py):
-    accumulate after each step; ``apply()`` swaps the averaged weights in
+    """Running average of parameters (reference modelaverage.py + the
+    average_accumulates op, phi/kernels/impl/average_accumulates_kernel_impl.h):
+    the three-accumulator shift scheme — sum_1 accumulates each step,
+    folds into sum_2 every 16384 steps (fp32 precision guard), and both
+    shift into sum_3 when the sliding window
+    min(max_average_window, num_updates * rate) closes.  ``apply()`` swaps
+    (sum_1+sum_2+sum_3)/(num_accumulates+old_num_accumulates) in
     (optionally as a context manager), ``restore()`` swaps back."""
 
     def __init__(self, average_window_rate, parameters=None,
@@ -63,35 +90,49 @@ class ModelAverage:
         self.min_window = min_average_window
         self.max_window = max_average_window
         self._parameters = list(parameters or [])
-        self._sum = {id(p): jnp.zeros_like(p._data)
-                     for p in self._parameters}
-        self._count = 0
+        z = lambda p: jnp.zeros_like(p._data, dtype=jnp.float32)  # noqa
+        self._sum_1 = {id(p): z(p) for p in self._parameters}
+        self._sum_2 = {id(p): z(p) for p in self._parameters}
+        self._sum_3 = {id(p): z(p) for p in self._parameters}
+        self._num_accumulates = 0
+        self._old_num_accumulates = 0
+        self._num_updates = 0
         self._backup = None
 
     def step(self):
         """Accumulate the current parameter values (call after the inner
-        optimizer's step)."""
+        optimizer's step) — the average_accumulates update rule."""
         for p in self._parameters:
-            self._sum[id(p)] = self._sum[id(p)] + p._data
-        self._count += 1
-        window = max(int(self.rate * self._count), 1)
-        window = min(max(window, 1), self.max_window)
-        if self._count > window and self._count > self.min_window:
-            # slide: decay the sum so old params wash out
-            keep = window / self._count
-            for k in self._sum:
-                self._sum[k] = self._sum[k] * keep
-            self._count = window
+            self._sum_1[id(p)] = self._sum_1[id(p)] \
+                + p._data.astype(jnp.float32)
+        self._num_accumulates += 1
+        self._num_updates += 1
+        if self._num_updates % _MAX_NUM_ACCUMULATES == 0:
+            for k in self._sum_1:
+                self._sum_2[k] = self._sum_2[k] + self._sum_1[k]
+                self._sum_1[k] = jnp.zeros_like(self._sum_1[k])
+        window = min(self.max_window, self._num_updates * self.rate)
+        if self._num_accumulates >= self.min_window \
+                and self._num_accumulates >= window:
+            for k in self._sum_1:
+                self._sum_3[k] = self._sum_1[k] + self._sum_2[k]
+                self._sum_1[k] = jnp.zeros_like(self._sum_1[k])
+                self._sum_2[k] = jnp.zeros_like(self._sum_2[k])
+            self._old_num_accumulates = self._num_accumulates
+            self._num_accumulates = 0
 
     def apply(self, need_restore=True):
         """Swap averaged weights into the parameters."""
-        if self._count == 0:
+        total = self._num_accumulates + self._old_num_accumulates
+        if total == 0:
             raise RuntimeError("ModelAverage.apply before any step")
         self._backup = {id(p): p._data for p in self._parameters} \
             if need_restore else None
         for p in self._parameters:
-            p._data = (self._sum[id(p)] / self._count).astype(
-                p._data.dtype)
+            k = id(p)
+            avg = (self._sum_1[k] + self._sum_2[k] + self._sum_3[k]) \
+                / total
+            p._data = avg.astype(p._data.dtype)
         return self
 
     def restore(self):

@@ -24,6 +24,17 @@ mistracing):
   * ``for i in range(n)`` with traced ``n`` — lowered to the while form;
   * ``and`` / ``or`` / ``not`` over tensors — non-short-circuit logical
     ops (reference logical_transformer);
+  * nested control flow — if-in-while, while-in-if, for-in-for — each
+    level converts independently (reference's nested ifelse/loop tests);
+  * ``for``/``while`` ... ``else`` without ``break`` — the else body runs
+    unconditionally after the converted loop;
+  * ``assert`` — traced predicates become a raising host callback, the
+    Assert-op analog (reference assert_transformer);
+  * ``print`` — traced arguments print via jax.debug.print at run time
+    (reference print_transformer);
+  * ``int(x)`` / ``float(x)`` / ``bool(x)`` — traced tensors become dtype
+    casts, int32 being the TPU-native integer (reference
+    cast_transformer / convert_var_dtype);
   * statements with ``return``/``break``/``continue`` inside control flow
     are left as plain Python (they still work eagerly and for non-tensor
     predicates; a tensor predicate then raises the usual traced-bool
@@ -191,6 +202,61 @@ def convert_while(cond_fn, body_fn, args):
     while bool(_unwrap(cond_fn(*args))):
         args = body_fn(*args)
     return args
+
+
+def convert_assert(pred, msg=None):
+    """reference assert_transformer → convert_assert (an Assert op that
+    halts the program).  TPU analog: a host callback that raises — XLA
+    surfaces it as a runtime error at the assert's execution point."""
+    if _is_traced(pred):
+        text = str(msg) if msg is not None else \
+            "dy2static: traced assert failed"
+
+        def _check(ok):
+            if not bool(ok):
+                raise AssertionError(text)
+
+        jax.debug.callback(_check, _to_bool_scalar(pred), ordered=True)
+        return
+    assert bool(_unwrap(pred)), msg
+
+
+def convert_print(*args, sep=" ", end="\n", flush=False):
+    """reference print_transformer → convert_print (Print op).  Traced
+    values print via jax.debug.print at run time; pure-Python calls fall
+    through to builtin print."""
+    if any(_is_traced(a) for a in args):
+        parts, fargs = [], []
+        for a in args:
+            if _is_traced(a) or _looks_tensor(a):
+                parts.append("{}")
+                fargs.append(_unwrap(a))
+            else:
+                parts.append(str(a).replace("{", "{{").replace("}", "}}"))
+        fmt = sep.join(parts)
+        if end != "\n":
+            fmt += end.replace("{", "{{").replace("}", "}}")
+        jax.debug.print(fmt, *fargs)
+        return
+    print(*args, sep=sep, end=end, flush=flush)
+
+
+_CAST_DTYPES = {"int": "int32", "float": "float32", "bool": "bool"}
+
+
+def convert_cast(x, kind):
+    """reference cast_transformer → convert_var_dtype: ``int(x)`` /
+    ``float(x)`` / ``bool(x)`` on a TRACED tensor become dtype casts
+    (int32 — the TPU-native integer — rather than the reference's
+    int64).  Concrete values — including eager Tensors — keep builtin
+    semantics (Tensor.__int__ etc. produce real Python scalars, which
+    list indexing / f-strings / dict keys rely on)."""
+    if _is_traced(x):
+        from ..core.tensor import Tensor
+
+        v = jnp.asarray(_unwrap(x))
+        return Tensor(v.astype(jnp.dtype(_CAST_DTYPES[kind])))
+    return {"int": int, "float": float, "bool": bool}[kind](x)
 
 
 def convert_logical_and(lhs, rhs_thunk):
@@ -376,14 +442,51 @@ class Dy2StaticTransformer(ast.NodeTransformer):
         return [branch_fn(tname, node.body),
                 branch_fn(fname, node.orelse), call]
 
+    # ---- assert (reference assert_transformer)
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        return ast.Expr(value=ast.Call(
+            func=ast.Attribute(value=_name(_JST), attr="convert_assert",
+                               ctx=ast.Load()),
+            args=[node.test] + ([node.msg] if node.msg is not None
+                                else []),
+            keywords=[]))
+
+    # ---- print / int / float / bool calls (reference print_transformer
+    # and cast_transformer)
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if isinstance(node.func, ast.Name):
+            if node.func.id == "print" and not any(
+                    kw.arg == "file" for kw in node.keywords):
+                return ast.Call(
+                    func=ast.Attribute(value=_name(_JST),
+                                       attr="convert_print",
+                                       ctx=ast.Load()),
+                    args=node.args, keywords=node.keywords)
+            if node.func.id in ("int", "float", "bool") \
+                    and len(node.args) == 1 and not node.keywords:
+                return ast.Call(
+                    func=ast.Attribute(value=_name(_JST),
+                                       attr="convert_cast",
+                                       ctx=ast.Load()),
+                    args=[node.args[0],
+                          ast.Constant(value=node.func.id)],
+                    keywords=[])
+        return node
+
     # ---- while
     def visit_While(self, node):
         self.generic_visit(node)
-        if node.orelse or _has_escape(node.body):
-            return node
+        if _has_escape(node.body):
+            return node                  # keep python while (+orelse)
+        # loop-else without break: the else body runs unconditionally
+        # after the loop (reference loop_transformer handles for/while
+        # orelse the same way once break is excluded)
+        orelse, node.orelse = node.orelse, []
         stores = _assigned(node.body)
         if not stores:
-            return node
+            return [node] + orelse if orelse else node
         cname = self._fresh("cond")
         bname = self._fresh("body")
         args = ast.arguments(
@@ -407,18 +510,20 @@ class Dy2StaticTransformer(ast.NodeTransformer):
                       ast.Tuple(elts=[_maybe_arg(s) for s in stores],
                                 ctx=ast.Load())],
                 keywords=[]))
-        return [cond_fn, body_fn, call]
+        return [cond_fn, body_fn, call] + orelse
 
     # ---- for i in range(...)
     def visit_For(self, node):
         self.generic_visit(node)
-        if (node.orelse or _has_escape(node.body)
+        if (_has_escape(node.body)
                 or not isinstance(node.target, ast.Name)
                 or not isinstance(node.iter, ast.Call)
                 or not isinstance(node.iter.func, ast.Name)
                 or node.iter.func.id != "range"
                 or not 1 <= len(node.iter.args) <= 3):
-            return node
+            return node                  # keep python for (+orelse)
+        # for-else without break: else runs unconditionally after
+        orelse, node.orelse = node.orelse, []
         i = node.target.id
         ra = node.iter.args
         start = ra[0] if len(ra) >= 2 else ast.Constant(value=0)
@@ -455,7 +560,7 @@ class Dy2StaticTransformer(ast.NodeTransformer):
         converted = self.visit_While(ast.fix_missing_locations(loop))
         if not isinstance(converted, list):
             converted = [converted]
-        return init + converted
+        return init + converted + orelse
 
     # ---- and / or / not
     def visit_BoolOp(self, node):
@@ -495,6 +600,9 @@ class _JstModule:
     convert_logical_and = staticmethod(convert_logical_and)
     convert_logical_or = staticmethod(convert_logical_or)
     convert_logical_not = staticmethod(convert_logical_not)
+    convert_assert = staticmethod(convert_assert)
+    convert_print = staticmethod(convert_print)
+    convert_cast = staticmethod(convert_cast)
 
 
 def convert_function(fn: Callable) -> Callable:

@@ -17,6 +17,9 @@ from enum import Enum
 from typing import List, Optional
 
 
+from .statistic import SortedKeys  # noqa: F401  (reference parity export)
+
+
 class ProfilerState(Enum):
     CLOSED = 0
     READY = 1
@@ -115,8 +118,22 @@ class Profiler:
 
     def start(self):
         global _recording
+        with _events_lock:            # fresh ring per profiling session
+            _events.clear()
+        self._last_trace_dir = None   # don't attach a stale kernel table
         _recording = True
+        self._wall_start = time.perf_counter_ns()
         self._last_step_t = time.perf_counter()
+        # per-op dispatch events feed the Operator Summary table
+        from ..core.dispatch import set_op_profile_hook
+
+        def op_hook(name, t0, t1):
+            with _events_lock:
+                _events.append(_Event(name, t0, t1,
+                                      threading.get_ident(),
+                                      {"cat": "op"}))
+
+        self._prev_op_hook = set_op_profile_hook(op_hook)
         if not self._timer_only:
             try:
                 import jax
@@ -130,6 +147,11 @@ class Profiler:
     def stop(self):
         global _recording
         _recording = False
+        self._wall_ns = time.perf_counter_ns() - getattr(
+            self, "_wall_start", time.perf_counter_ns())
+        from ..core.dispatch import set_op_profile_hook
+
+        set_op_profile_hook(getattr(self, "_prev_op_hook", None))
         if self._xla_trace_dir is not None:
             try:
                 import jax
@@ -137,6 +159,8 @@ class Profiler:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+            # keep the dir for summary()'s Kernel table; cleared on start
+            self._last_trace_dir = self._xla_trace_dir
             self._xla_trace_dir = None
         if self._on_trace_ready is not None:
             self._on_trace_ready(self)
@@ -182,18 +206,19 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
+        """Statistics report (reference profiler.py summary →
+        profiler_statistic tables): Overview, host Operator Summary,
+        UserDefined events, device Kernel Summary parsed from the xplane
+        capture, and the device Memory Summary."""
+        from .statistic import SortedKeys, build_summary
+
         with _events_lock:
             events = list(_events)
-        agg = {}
-        for e in events:
-            name = e.name
-            dur = (e.end - e.start) / 1e6
-            tot, cnt = agg.get(name, (0.0, 0))
-            agg[name] = (tot + dur, cnt + 1)
-        lines = ["name\ttotal_ms\tcount\tavg_ms"]
-        for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0]):
-            lines.append(f"{name}\t{tot:.3f}\t{cnt}\t{tot/cnt:.3f}")
-        return "\n".join(lines)
+        return build_summary(
+            events, getattr(self, "_last_trace_dir", None),
+            sorted_by=sorted_by or SortedKeys.CPUTotal,
+            op_detail=op_detail, time_unit=time_unit,
+            wall_ns=getattr(self, "_wall_ns", None))
 
 
 @contextlib.contextmanager

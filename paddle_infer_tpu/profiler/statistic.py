@@ -1,0 +1,227 @@
+"""Profiler statistics (reference:
+python/paddle/profiler/profiler_statistic.py — SortedKeys, the
+HostStatisticNode tree and the Device/Overview/Operator/Kernel/Memory
+summary tables printed by ``Profiler.summary()``).
+
+TPU redesign: host-side operator stats aggregate from the dispatch-hook
+event ring (the eager analog of the reference's host event tree); device
+-side kernel stats parse the XLA xplane capture via
+``jax.profiler.ProfileData`` (CUPTI's counterpart here is XProf), and the
+memory table reads the live ``device.memory_stats()``.  One module covers
+what the reference spreads over host_statistic/device_statistic trees —
+XLA already merges the per-op device timeline into the xplane.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import re
+from enum import Enum
+from typing import Dict, List, Optional
+
+
+class SortedKeys(Enum):
+    """Sort orders for summary tables (reference SortedKeys)."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    DeviceTotal = 4
+    DeviceAvg = 5
+    DeviceMax = 6
+    DeviceMin = 7
+    # reference aliases (GPU* there; the device here is the TPU)
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class StatItem:
+    """Aggregated per-name timing entry (reference OperatorItem /
+    DeviceItem: call count, total/avg/max/min, ratio of the table)."""
+
+    __slots__ = ("name", "call", "total_ns", "max_ns", "min_ns")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.call = 0
+        self.total_ns = 0.0
+        self.max_ns = 0.0
+        self.min_ns = float("inf")
+
+    def add(self, dur_ns: float):
+        self.call += 1
+        self.total_ns += dur_ns
+        self.max_ns = max(self.max_ns, dur_ns)
+        self.min_ns = min(self.min_ns, dur_ns)
+
+    @property
+    def avg_ns(self) -> float:
+        return self.total_ns / max(self.call, 1)
+
+
+_SORT_ATTR = {
+    SortedKeys.CPUTotal: "total_ns", SortedKeys.CPUAvg: "avg_ns",
+    SortedKeys.CPUMax: "max_ns", SortedKeys.CPUMin: "min_ns",
+    SortedKeys.DeviceTotal: "total_ns", SortedKeys.DeviceAvg: "avg_ns",
+    SortedKeys.DeviceMax: "max_ns", SortedKeys.DeviceMin: "min_ns",
+}
+
+
+def aggregate(names_durs) -> Dict[str, StatItem]:
+    out: Dict[str, StatItem] = {}
+    for name, dur in names_durs:
+        item = out.get(name)
+        if item is None:
+            item = out[name] = StatItem(name)
+        item.add(dur)
+    return out
+
+
+# ------------------------------------------------------------------ xplane
+_IDX_SUFFIX = re.compile(r"\.\d+$")
+# timeline-plumbing events that are not kernels
+_DEVICE_NOISE = ("ThreadpoolListener", "ThunkExecutor", "end: ",
+                 "StartRegion", "StopRegion", "TaskDispatcher")
+
+
+def _is_device_plane(plane_name: str) -> bool:
+    return "/device:" in plane_name
+
+
+def _is_device_line(line_name: str) -> bool:
+    # CPU PJRT puts the XLA executable timeline on host-plane lines named
+    # tf_XLAPjRtCpuClient/...; TPU uses /device: planes with XLA Ops lines
+    return line_name.startswith("tf_XLAPjRt") or "XLA Ops" in line_name \
+        or "XLA Modules" in line_name
+
+
+def device_op_stats(trace_dir: str) -> Optional[Dict[str, StatItem]]:
+    """Per-kernel device-time table from the newest xplane capture under
+    ``trace_dir`` (reference Kernel Summary; source here is XProf's
+    xplane instead of CUPTI).  Returns None when no capture exists or
+    the runtime lacks ProfileData."""
+    try:
+        import jax
+
+        ProfileData = jax.profiler.ProfileData
+    except Exception:
+        return None
+    files = sorted(glob.glob(os.path.join(
+        trace_dir, "**", "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime)
+    if not files:
+        return None
+    pd = ProfileData.from_file(files[-1])
+    pairs = []
+    for plane in pd.planes:
+        device_plane = _is_device_plane(plane.name)
+        for line in plane.lines:
+            if not (device_plane or _is_device_line(line.name)):
+                continue
+            if "Modules" in line.name:
+                continue          # module spans double-count their ops
+            for ev in line.events:
+                name = ev.name
+                if not name or any(t in name for t in _DEVICE_NOISE):
+                    continue
+                dur = float(ev.duration_ns or 0.0)
+                if dur <= 0:
+                    continue
+                pairs.append((_IDX_SUFFIX.sub("", name), dur))
+    return aggregate(pairs) if pairs else None
+
+
+def memory_stats() -> Optional[dict]:
+    """Device memory table source (reference Memory Summary; here the
+    runtime allocator is XLA's BFC whose counters ride on the device)."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        return dict(stats) if stats else None
+    except Exception:
+        return None
+
+
+# ----------------------------------------------------------------- tables
+def _fmt_table(title: str, items: List[StatItem], total_ns: float,
+               time_unit: str, sorted_by, limit: int = 30) -> str:
+    div = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}[time_unit]
+    attr = _SORT_ATTR.get(sorted_by, "total_ns")
+    rows = sorted(items, key=lambda it: -getattr(it, attr))[:limit]
+    w = max([len(r.name) for r in rows] + [4])
+    head = (f"{'Name':<{w}}  {'Calls':>7}  {'Total(' + time_unit + ')':>12}"
+            f"  {'Avg(' + time_unit + ')':>12}  {'Max(' + time_unit + ')':>12}"
+            f"  {'Min(' + time_unit + ')':>12}  {'Ratio(%)':>8}")
+    bar = "-" * len(head)
+    lines = [title, bar, head, bar]
+    for r in rows:
+        ratio = 100.0 * r.total_ns / total_ns if total_ns else 0.0
+        lines.append(
+            f"{r.name:<{w}}  {r.call:>7}  {r.total_ns / div:>12.3f}"
+            f"  {r.avg_ns / div:>12.3f}  {r.max_ns / div:>12.3f}"
+            f"  {r.min_ns / div:>12.3f}  {ratio:>8.2f}")
+    lines.append(bar)
+    return "\n".join(lines)
+
+
+def build_summary(host_events, trace_dir: Optional[str],
+                  sorted_by=SortedKeys.CPUTotal, op_detail: bool = True,
+                  time_unit: str = "ms", wall_ns: Optional[float] = None,
+                  limit: int = 30) -> str:
+    """Assemble the full statistics report (reference
+    profiler_statistic._build_table pipeline → Overview / Operator /
+    Kernel / Memory summaries)."""
+    host_ops = aggregate(
+        ((e.name, e.end - e.start) for e in host_events
+         if e.args.get("cat") == "op"))
+    user_evs = aggregate(
+        ((e.name, e.end - e.start) for e in host_events
+         if e.args.get("cat") != "op"))
+    host_total = sum(it.total_ns for it in host_ops.values())
+    sections = []
+
+    # ---- overview (reference Overview Summary)
+    dev_items = device_op_stats(trace_dir) if trace_dir else None
+    dev_total = sum(it.total_ns for it in dev_items.values()) \
+        if dev_items else 0.0
+    ov = [("host op dispatch", host_total),
+          ("user record events",
+           sum(it.total_ns for it in user_evs.values()))]
+    if dev_items:
+        ov.append(("device kernels (xplane)", dev_total))
+    if wall_ns:
+        ov.append(("profiled wall", wall_ns))
+    div = {"s": 1e9, "ms": 1e6, "us": 1e3, "ns": 1.0}[time_unit]
+    lines = ["Overview Summary", "-" * 48]
+    for name, ns in ov:
+        lines.append(f"{name:<28} {ns / div:>14.3f} {time_unit}")
+    lines.append("-" * 48)
+    sections.append("\n".join(lines))
+
+    if op_detail and host_ops:
+        sections.append(_fmt_table(
+            "Operator Summary (host dispatch)", list(host_ops.values()),
+            host_total, time_unit, sorted_by, limit))
+    if user_evs:
+        sections.append(_fmt_table(
+            "UserDefined Summary (RecordEvent)", list(user_evs.values()),
+            sum(it.total_ns for it in user_evs.values()), time_unit,
+            sorted_by, limit))
+    if dev_items:
+        sections.append(_fmt_table(
+            "Kernel Summary (device, xplane)", list(dev_items.values()),
+            dev_total, time_unit, sorted_by, limit))
+
+    mem = memory_stats()
+    if mem:
+        lines = ["Memory Summary (device)", "-" * 48]
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                  "largest_alloc_size", "num_allocs"):
+            if k in mem:
+                lines.append(f"{k:<28} {mem[k]:>16,}")
+        lines.append("-" * 48)
+        sections.append("\n".join(lines))
+    return "\n\n".join(sections)

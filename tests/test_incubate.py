@@ -163,6 +163,77 @@ class TestIncubateOptimizers:
             assert not np.allclose(raw, averaged)
         np.testing.assert_allclose(w.weight.numpy(), raw)   # restored
 
+    def test_lookahead_state_dict_mid_cycle(self):
+        """Checkpoint-resume mid-k-cycle must restore the SLOW weights,
+        not reinitialize them from the restored fast weights (round-4
+        advisor finding)."""
+        from paddle_infer_tpu.incubate.optimizer import LookAhead
+
+        w, x, y = self._quadratic()
+        inner = pit.optimizer.SGD(learning_rate=0.1,
+                                  parameters=w.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=5)
+        for _ in range(3):                      # mid-cycle: 3 of 5 steps
+            loss = ((w(x) - y) ** 2.0).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        state = opt.state_dict()
+        assert state["steps"] == 3 and len(state["slow"]) == 2
+        slow_snapshot = [np.asarray(a) for _, a in state["slow"]]
+
+        # fresh model+optimizer resumed from the checkpoint
+        pit.seed(0)
+        w2 = pit.nn.Linear(4, 1)
+        for p2, p1 in zip(w2.parameters(), w.parameters()):
+            p2.set_value(p1.numpy())
+        inner2 = pit.optimizer.SGD(learning_rate=0.1,
+                                   parameters=w2.parameters())
+        opt2 = LookAhead(inner2, alpha=0.5, k=5)
+        opt2.set_state_dict(state)
+        got = [np.asarray(opt2._slow[id(p)]) for p in w2.parameters()]
+        for a, b in zip(slow_snapshot, got):
+            np.testing.assert_allclose(a, b)
+        # the resumed cycle continues: 2 more steps trigger the k-sync
+        for _ in range(2):
+            loss = ((w2(x) - y) ** 2.0).mean()
+            loss.backward()
+            opt2.step()
+            opt2.clear_grad()
+        assert opt2._steps == 5
+        # after sync, fast == slow
+        for p2 in w2.parameters():
+            np.testing.assert_allclose(np.asarray(opt2._slow[id(p2)]),
+                                       p2.numpy())
+
+    def test_model_average_shift_scheme(self):
+        """The reference three-accumulator scheme (average_accumulates
+        kernel): when the window closes, sums shift into sum_3 and the
+        average divides by num + old_num accumulates."""
+        from paddle_infer_tpu.incubate.optimizer import ModelAverage
+
+        w, x, y = self._quadratic()
+        opt = pit.optimizer.SGD(learning_rate=0.1,
+                                parameters=w.parameters())
+        ma = ModelAverage(0.5, parameters=w.parameters(),
+                          min_average_window=4, max_average_window=6)
+        history = []
+        for _ in range(10):
+            loss = ((w(x) - y) ** 2.0).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            ma.step()
+            history.append(w.weight.numpy().copy())
+        # window closed at least once -> old_num_accumulates > 0
+        assert ma._old_num_accumulates > 0
+        total = ma._num_accumulates + ma._old_num_accumulates
+        # averaged weights equal the mean of the last `total` snapshots
+        want = np.mean([h for h in history[-total:]], axis=0)
+        with ma.apply():
+            np.testing.assert_allclose(w.weight.numpy(), want,
+                                       rtol=1e-5, atol=1e-6)
+
     def test_incubate_tensor_segment_ops(self):
         from paddle_infer_tpu.incubate.tensor import (segment_max,
                                                       segment_mean,

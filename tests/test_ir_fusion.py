@@ -354,6 +354,35 @@ class TestAttentionScaleIdioms:
         names = self._run(fwd, x)
         assert "sdpa" in names, names
 
+    def test_divide_scaled_3d_with_additive_mask(self):
+        """Rank-3 attention WITH an additive (b,s,s) mask: the fusion
+        must reshape the mask to (b,1,s,s) so it broadcasts over the
+        bracketed head dim (round-4 advisor: this branch had no
+        coverage)."""
+        import math
+
+        import paddle_infer_tpu.nn.functional as F
+
+        rs = np.random.RandomState(2)
+        q = pit.to_tensor(rs.randn(2, 4, 8).astype("float32"))
+        k = pit.to_tensor(rs.randn(2, 4, 8).astype("float32"))
+        v = pit.to_tensor(rs.randn(2, 4, 8).astype("float32"))
+        # additive mask: last position masked out per row
+        mnp = np.zeros((2, 4, 4), np.float32)
+        mnp[:, :, -1] = -1e9
+        mask = pit.to_tensor(mnp)
+
+        def fwd(x):
+            att = F.softmax(
+                pit.matmul(x + q, (x + k).transpose([0, 2, 1]))
+                / math.sqrt(8.0) + mask, axis=-1)
+            return pit.matmul(att, x + v)
+
+        x = pit.to_tensor(rs.randn(2, 4, 8).astype("float32"))
+        names = self._run(fwd, x)
+        assert "sdpa" in names, names
+        assert "softmax" not in names
+
 
 class TestPrecisionAliases:
     def test_short_spellings(self):
