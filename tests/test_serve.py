@@ -134,13 +134,17 @@ def test_speculative_serving_path(tmp_path):
         assert body.get("speculative") is True
         assert body.get("acceptance") == 1.0       # self-draft
         np.testing.assert_array_equal(np.asarray(body["tokens"]), want)
-        # batched request falls back to the paged engine
+        # batched requests ride the speculative path too (round-5
+        # lockstep batching) and stay token-identical to the paged engine
         ids2 = np.random.RandomState(6).randint(0, 96, (2, 8)) \
             .astype(np.int32)
+        g2 = GenerationConfig(max_new_tokens=4)
+        want2 = PagedGenerationEngine(m, page_size=8).generate(ids2, g2)
         with _post(url, "/generate", {"ids": ids2.tolist(),
                                       "max_new_tokens": 4}) as r:
             body2 = json.load(r)
-        assert "speculative" not in body2
+        assert body2.get("speculative") is True
+        np.testing.assert_array_equal(np.asarray(body2["tokens"]), want2)
     finally:
         proc.terminate()
         proc.wait(timeout=30)
