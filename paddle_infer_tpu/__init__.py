@@ -57,6 +57,7 @@ from . import metric
 from . import jit
 from . import static
 from . import inference
+from . import serving
 from . import quantization
 from . import profiler
 from . import vision

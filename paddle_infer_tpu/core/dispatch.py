@@ -181,14 +181,36 @@ def _amp_cast_arrays(name, arrays):
 # Profiler hook (profiler.Profiler): when set, every eager dispatch
 # reports (op_name, start_ns, end_ns) — the host-side Operator Summary
 # source (reference: the op-event layer of host_event_recorder).
+#
+# ASYNC-DISPATCH CAVEAT: XLA dispatch is asynchronous — the jitted call
+# returns as soon as the work is ENQUEUED, so by default (start, end)
+# measures Python dispatch overhead plus queueing, NOT device compute.
+# Per-op wall times are only trustworthy in block mode (below); without
+# it the numbers are still useful for op counts and host-side hotspots,
+# which is what the Operator Summary table advertises.
+#
+# Internally the installed hook is a ``(fn, block)`` pair.
 _OP_PROFILE_HOOK = None
 
 
-def set_op_profile_hook(fn):
-    """Install/remove the per-op profiling callback; returns previous."""
+def set_op_profile_hook(fn, block_until_ready: bool = False):
+    """Install/remove the per-op profiling callback; returns the
+    previous installation (opaque — pass it back here to restore).
+
+    ``block_until_ready=True`` makes every dispatch wait for its outputs
+    before taking the end timestamp, so the interval covers actual
+    device compute (at the cost of serializing the dispatch pipeline —
+    opt-in, for accurate per-op timings, e.g. serving decode-step
+    attribution).  Without it, timings reflect async ENQUEUE cost only
+    (see caveat above)."""
     global _OP_PROFILE_HOOK
     prev = _OP_PROFILE_HOOK
-    _OP_PROFILE_HOOK = fn
+    if fn is None:
+        _OP_PROFILE_HOOK = None
+    elif isinstance(fn, tuple):
+        _OP_PROFILE_HOOK = fn          # restoring a previous installation
+    else:
+        _OP_PROFILE_HOOK = (fn, bool(block_until_ready))
     return prev
 
 
@@ -282,9 +304,15 @@ def dispatch(name: str, *inputs, **attrs):
     else:
         import time as _time
 
+        _hook_fn, _hook_block = _hook
         _t0 = _time.perf_counter_ns()
         out_arrays = fn(*arrays)
-        _hook(name, _t0, _time.perf_counter_ns())
+        if _hook_block:
+            # opt-in sync mode: wait for device completion so the
+            # interval measures compute, not async enqueue (see the
+            # caveat at _OP_PROFILE_HOOK)
+            jax.block_until_ready(out_arrays)
+        _hook_fn(name, _t0, _time.perf_counter_ns())
 
     multi = isinstance(out_arrays, (tuple, list))
     outs_raw = list(out_arrays) if multi else [out_arrays]

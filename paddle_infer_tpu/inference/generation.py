@@ -557,6 +557,54 @@ class PagedGenerationEngine(GenerationEngine):
             self._v_pages = [alloc() for _ in range(self._num_layers)]
         return self._k_pages, self._v_pages
 
+    # ------------------------------------------------------ serving hooks
+    # The serving.EngineCore scheduler owns this engine's pool/pages
+    # across requests (continuous batching never frees the whole batch at
+    # once the way generate()/stream() do).  These three hooks are the
+    # entire surface it needs: parameter refresh, pool sizing, and a
+    # compile-cache + donated-pool wrapper for its own programs.
+
+    def refresh_params(self):
+        """Re-snapshot (and re-place, under a mesh) model parameters —
+        what generate() does implicitly at the top of every call."""
+        self._params = self._snapshot_params()
+        return self._params
+
+    def serving_pool(self, num_pages: int):
+        """Size the native block pool for a serving session (slots ×
+        pages-per-slot + scratch) and return it.  Resizing invalidates
+        the device pools, so EngineCore calls this once up front."""
+        return self._ensure_pool(num_pages)
+
+    def run_paged_program(self, key, builder, *args):
+        """Run a serving-owned compiled program over the persistent page
+        pools.  ``builder()`` must return a jitted fn with signature
+        ``fn(params, *args, k_pages, v_pages)`` whose LAST two outputs
+        are the updated (donated) pools; the leading outputs are
+        returned to the caller.  Pool choreography matches
+        generate()/stream(): references are dropped before the call and
+        rebound only from a successful call's outputs.  If the call
+        raises, the donated pools are gone — ``kv_state_lost()`` then
+        reports True until _ensure_pages rebuilds them (zeroed), and the
+        scheduler must abort every in-flight row."""
+        fn = self._compiled.get(key)
+        if fn is None:
+            fn = builder()
+            self._compiled[key] = fn
+        k_pages, v_pages = self._ensure_pages()
+        args = jax.tree_util.tree_map(self._replicated, tuple(args))
+        self._k_pages = self._v_pages = None
+        with _MeshContext(self._mesh):
+            out = fn(self._params, *args, k_pages, v_pages)
+        *rest, new_k, new_v = out
+        self._k_pages, self._v_pages = new_k, new_v
+        return rest
+
+    def kv_state_lost(self) -> bool:
+        """True when the device pools were consumed by a failed donated
+        call (their contents — every in-flight row's KV — are gone)."""
+        return self._k_pages is None
+
     def _build_paged(self, batch, plen, g: GenerationConfig):
         max_new = g.max_new_tokens
         L = self._num_layers

@@ -121,8 +121,13 @@ class SpeculativeEngine:
                                        jnp.logical_not(jnp.all(fin)))
 
             def body(state):
-                (cur, last, out, fin, caches_t, caches_d, acc, iters) = \
-                    state
+                (cur, last, out, fin, caches_t, caches_d, acc, act_iters,
+                 iters) = state
+                # rows active at iteration entry — the acceptance stat's
+                # denominator: a finished row still rides the lockstep
+                # chunk but proposes nothing, so it must count in neither
+                # numerator nor denominator
+                active = jnp.logical_not(fin)
                 kit = jax.random.fold_in(base_key, iters + 1)
                 base = lengths + cur - 1          # [B] position of `last`
                 idx0 = plen + cur - 1             # cache slots filled
@@ -239,16 +244,18 @@ class SpeculativeEngine:
                 last = jnp.where(fin, last, new_last)
                 caches_t = rewind(caches_t, idx0 + count)
                 caches_d = rewind(caches_d, idx0 + count)
-                acc = acc + jnp.sum(jnp.where(fin, 0, jnp.minimum(n,
-                                                                  gamma)))
+                acc = acc + jnp.sum(
+                    jnp.where(active, jnp.minimum(n, gamma), 0))
+                act_iters = act_iters + jnp.sum(active.astype(jnp.int32))
                 return (cur + count, last, out, fin, caches_t, caches_d,
-                        acc, iters + 1)
+                        acc, act_iters, iters + 1)
 
             state = (jnp.asarray(1, jnp.int32), t1, out, fin,
                      rewind(caches_t, plen), rewind(caches_d, plen),
-                     jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+                     jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+                     jnp.zeros((), jnp.int32))
             state = jax.lax.while_loop(cond, body, state)
-            return state[2][:, :max_new], state[6], state[7]
+            return state[2][:, :max_new], state[6], state[7], state[8]
 
         return jax.jit(run)
 
@@ -299,13 +306,18 @@ class SpeculativeEngine:
             fn = self._build(batch, plen, cache_len, g)
             self._compiled[key] = fn
         with _MeshContext(self._mesh):
-            seq, accepted, iters = fn(
+            seq, accepted, act_iters, iters = fn(
                 self._t._params, self._d._params,
                 self._t._replicated(ids), self._t._replicated(mask),
                 jax.random.PRNGKey(g.seed))
         iters = int(iters)
+        act_iters = int(act_iters)
         self._last_iters = iters
-        self.last_acceptance = (float(accepted) /
-                                (iters * self.gamma * batch)
-                                if iters else None)
+        # acceptance = accepted drafts / drafts PROPOSED: a row finished
+        # (or lockstep-truncated) early proposes nothing in later
+        # iterations, so the denominator is per-row ACTIVE iterations ×
+        # gamma, not iters × gamma × batch (which biased the stat low
+        # whenever rows finished at different times)
+        self.last_acceptance = (float(accepted) / (act_iters * self.gamma)
+                                if act_iters else None)
         return np.asarray(seq)

@@ -32,6 +32,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# jax renamed TPUCompilerParams -> CompilerParams; support both so the
+# kernel (and the serving engine above it) runs on either side of the
+# rename
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
+
 
 def _interpret() -> bool:
     try:
@@ -182,7 +188,7 @@ def _decode_local(q, k_pages, v_pages, block_tables, lengths,
         kernel, grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
     )
     return fn(lengths, block_tables, q, k_pages, v_pages)

@@ -106,18 +106,33 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
 
 class Profiler:
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
-                 timer_only=False, profile_memory=False, with_flops=False):
+                 timer_only=False, profile_memory=False, with_flops=False,
+                 op_sync=False):
+        """``op_sync``: block each dispatched op until its device outputs
+        are ready before timestamping, so the Operator Summary measures
+        compute rather than async enqueue (slower; see the caveat at
+        core.dispatch._OP_PROFILE_HOOK)."""
         self._scheduler = scheduler
         self._on_trace_ready = on_trace_ready
         self._step = 0
         self._state = ProfilerState.CLOSED
         self._timer_only = timer_only
+        self._op_sync = op_sync
         self._xla_trace_dir = None
         self._step_times = []
         self._last_step_t = None
+        self._started = False
 
     def start(self):
         global _recording
+        if self._started:
+            # Re-entry guard: a second start() would capture OUR op hook
+            # as _prev_op_hook, so the paired stop() would "restore" the
+            # hook to itself and leave per-op profiling permanently
+            # installed (taxing every dispatch).  A double start is a
+            # no-op instead.
+            return
+        self._started = True
         with _events_lock:            # fresh ring per profiling session
             _events.clear()
         self._last_trace_dir = None   # don't attach a stale kernel table
@@ -133,7 +148,8 @@ class Profiler:
                                       threading.get_ident(),
                                       {"cat": "op"}))
 
-        self._prev_op_hook = set_op_profile_hook(op_hook)
+        self._prev_op_hook = set_op_profile_hook(
+            op_hook, block_until_ready=self._op_sync)
         if not self._timer_only:
             try:
                 import jax
@@ -146,6 +162,9 @@ class Profiler:
 
     def stop(self):
         global _recording
+        if not self._started:
+            return                    # idempotent, mirrors start()
+        self._started = False
         _recording = False
         self._wall_ns = time.perf_counter_ns() - getattr(
             self, "_wall_start", time.perf_counter_ns())

@@ -92,22 +92,63 @@ def _is_device_plane(plane_name: str) -> bool:
 
 def _is_device_line(line_name: str) -> bool:
     # CPU PJRT puts the XLA executable timeline on host-plane lines named
-    # tf_XLAPjRtCpuClient/...; TPU uses /device: planes with XLA Ops lines
-    return line_name.startswith("tf_XLAPjRt") or "XLA Ops" in line_name \
+    # tf_XLAPjRtCpuClient/... (older runtimes: tf_XLATfrtCpuClient/...);
+    # TPU uses /device: planes with XLA Ops lines
+    return line_name.startswith("tf_XLA") or "XLA Ops" in line_name \
         or "XLA Modules" in line_name
+
+
+def _chrome_trace_device_stats(trace_dir: str):
+    """Fallback kernel source: the profiler also dumps a Chrome trace
+    (*.trace.json.gz) next to the xplane; its thread names mirror the
+    xplane line names, so the same device-line predicate applies.
+    Durations there are microseconds."""
+    import gzip
+    import json
+
+    files = sorted(glob.glob(os.path.join(
+        trace_dir, "**", "*.trace.json.gz"), recursive=True),
+        key=os.path.getmtime)
+    if not files:
+        return None
+    with gzip.open(files[-1], "rt") as f:
+        events = json.load(f).get("traceEvents", [])
+    device_tids = set()
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            tname = (ev.get("args") or {}).get("name", "")
+            if _is_device_line(tname) and "Modules" not in tname:
+                device_tids.add((ev.get("pid"), ev.get("tid")))
+    pairs = []
+    for ev in events:
+        if ev.get("ph") != "X" \
+                or (ev.get("pid"), ev.get("tid")) not in device_tids:
+            continue
+        name = ev.get("name", "")
+        if not name or any(t in name for t in _DEVICE_NOISE):
+            continue
+        dur = float(ev.get("dur") or 0.0) * 1e3    # us -> ns
+        if dur <= 0:
+            continue
+        pairs.append((_IDX_SUFFIX.sub("", name), dur))
+    return aggregate(pairs) if pairs else None
 
 
 def device_op_stats(trace_dir: str) -> Optional[Dict[str, StatItem]]:
     """Per-kernel device-time table from the newest xplane capture under
     ``trace_dir`` (reference Kernel Summary; source here is XProf's
-    xplane instead of CUPTI).  Returns None when no capture exists or
-    the runtime lacks ProfileData."""
+    xplane instead of CUPTI).  Returns None when no capture exists; on
+    runtimes without ``jax.profiler.ProfileData`` the Chrome-trace dump
+    in the same capture dir is parsed instead."""
     try:
         import jax
 
         ProfileData = jax.profiler.ProfileData
     except Exception:
-        return None
+        try:
+            return _chrome_trace_device_stats(trace_dir)
+        except Exception:
+            return None
     files = sorted(glob.glob(os.path.join(
         trace_dir, "**", "*.xplane.pb"), recursive=True),
         key=os.path.getmtime)

@@ -1,0 +1,44 @@
+"""Continuous-batching serving engine — the orchestration layer between
+the paged-KV machinery (``PagedGenerationEngine``,
+``ops/pallas/paged_attention.py``) and an HTTP front end.
+
+This is the gap PAPERS.md "Ragged Paged Attention" identifies between a
+paged attention *kernel* and a serving *engine*: the kernel gives you
+per-row page tables and device-resident pools; somebody still has to
+decide, every step, which requests occupy which KV slots.
+
+Layer map:
+
+  ``RequestQueue``    admission control — depth-bounded FIFO with
+                      per-request deadlines; overload answers with a
+                      graceful rejection (HTTP 429/504) instead of OOM.
+  ``EngineCore``      the scheduler: each iteration admits queued
+                      requests into free KV-block slots (one compiled
+                      prefill per request), runs ONE fused decode step
+                      for every active row, evicts finished rows and
+                      immediately backfills their slots — no
+                      stop-the-world between request generations.
+  ``ServingMetrics``  queue depth, batch occupancy, TTFT, inter-token
+                      latency p50/p99, tokens/s, rejection counts —
+                      exposed by ``tools/serve.py`` as ``GET /metrics``.
+
+Requests with per-request sampling configs share one decode executable:
+temperature/top-k/top-p/eos ride as *per-row arrays* (serving/programs),
+so admitting a new request never recompiles the hot loop.
+"""
+
+from .metrics import ServingMetrics
+from .request import (DeadlineExceededError, QueueFullError, RejectedError,
+                      Request, RequestQueue, RequestState)
+from .engine_core import EngineCore
+
+__all__ = [
+    "EngineCore",
+    "Request",
+    "RequestQueue",
+    "RequestState",
+    "ServingMetrics",
+    "RejectedError",
+    "QueueFullError",
+    "DeadlineExceededError",
+]

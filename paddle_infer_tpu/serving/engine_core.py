@@ -1,0 +1,457 @@
+"""EngineCore — the continuous-batching scheduler.
+
+Each ``run_once()`` iteration (the loop body; a background thread just
+repeats it):
+
+  1. sweep deadlines — expired queued requests are cancelled before they
+     cost a prefill; expired ACTIVE rows are evicted and their KV blocks
+     freed immediately;
+  2. run any exclusive requests at the queue head (engine calls the
+     continuous batch can't host — beams, repetition penalty,
+     speculative — executed on this thread so they never race the pool);
+  3. admit queued requests into free KV-block slots: one compiled
+     prefill each, first token emitted right there (that's the TTFT
+     sample);
+  4. run ONE fused decode chunk for all active rows (a ``lax.scan`` of
+     exactly ``decode_chunk`` steps — rows whose budget ends mid-chunk
+     have their surplus tokens clamped off host-side, so one compiled
+     program serves every batch composition);
+  5. evict finished rows, free their pages, and loop — freed slots are
+     backfilled at the next iteration's step 3, so a late-arriving
+     request joins the SAME fused step as requests admitted long before
+     it (``step_trace`` records the per-step active set to prove it).
+
+There is no stop-the-world: admission, decode and eviction interleave
+at chunk granularity, and per-row sampling parameters live in arrays
+(serving/programs.py) so none of it ever recompiles the hot loop.
+
+Slot/pool layout: slot ``s`` (0..max_batch-1) reserves native-pool
+sequence id ``s``; a one-page scratch reservation (seq id max_batch)
+backs every table entry of inactive rows, so their garbage writes land
+where no live row's attention can see them.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from ..inference.generation import (GenerationConfig, PagedGenerationEngine,
+                                    _round_up)
+from .metrics import ServingMetrics
+from .programs import build_decode, build_prefill
+from .request import (DeadlineExceededError, QueueFullError, RejectedError,
+                      Request, RequestQueue, RequestState)
+
+
+class EngineCore:
+    """Continuous-batching scheduler over a ``PagedGenerationEngine``.
+
+    The engine instance is OWNED by the core for the core's lifetime:
+    direct ``generate()`` calls on it would free/re-reserve the slot
+    sequence ids and corrupt in-flight rows.  Requests the batch can't
+    host go through ``submit_exclusive`` with a *different* engine
+    (``tools/serve.py`` uses the dense ``GenerationEngine``)."""
+
+    def __init__(self, engine: PagedGenerationEngine, max_batch: int = 8,
+                 max_queue: int = 64, decode_chunk: int = 4,
+                 default_timeout_s: Optional[float] = None,
+                 max_model_len: Optional[int] = None,
+                 metrics: Optional[ServingMetrics] = None):
+        self._engine = engine
+        self._max_batch = int(max_batch)
+        self._decode_chunk = max(1, int(decode_chunk))
+        self._default_timeout = default_timeout_s
+        self._metrics = metrics or ServingMetrics()
+        self._queue = RequestQueue(max_depth=max_queue)
+
+        page = engine.page_size
+        self._page = page
+        cap = engine._max_positions
+        self._max_model_len = min(int(max_model_len or cap), cap)
+        # every slot's page table has one fixed width, covering the
+        # worst-case reservation (page-padded prompt or prompt+max_new)
+        self._max_pages = _round_up(self._max_model_len, page) // page
+        self._plen_cap = self._max_pages * page
+
+        engine.refresh_params()
+        self._pool = engine.serving_pool(
+            self._max_batch * self._max_pages + 1)
+        # scratch page: inactive rows' writes land here, reads of live
+        # rows never reach it (attention masks by per-row position)
+        self._pool.free(self._max_batch)
+        self._pool.reserve(self._max_batch, 1)
+        self._scratch = int(self._pool.block_table(self._max_batch)[0])
+
+        self._slots: List[Optional[dict]] = [None] * self._max_batch
+        self.step_trace: List[dict] = []
+        self._step_idx = 0
+        self._step_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._closed = False
+
+    # ------------------------------------------------------------ intake
+    @staticmethod
+    def batchable(g: GenerationConfig) -> bool:
+        """Configs the shared decode executable can host as one row.
+        Repetition penalty needs full token history (per-row widths the
+        fused step can't carry); beams need W rows + reorder."""
+        return g.num_beams == 1 and g.repetition_penalty == 1.0
+
+    @property
+    def metrics(self) -> ServingMetrics:
+        return self._metrics
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    def metrics_snapshot(self) -> dict:
+        return self._metrics.snapshot(queue_depth=len(self._queue),
+                                      active=self.active_count,
+                                      max_batch=self._max_batch)
+
+    def submit(self, input_ids, config: GenerationConfig = None,
+               attention_mask=None,
+               timeout_s: Optional[float] = None) -> List[Request]:
+        """Enqueue one request per row of ``input_ids`` ([b, plen] or
+        [plen]).  All-or-nothing: admission errors (too long, queue
+        full, not batchable) reject the whole call.  Returns the per-row
+        ``Request`` handles immediately — stream or ``result()`` them."""
+        if self._closed:
+            raise RejectedError("serving engine is closed")
+        g = config or GenerationConfig()
+        if not self.batchable(g):
+            self._metrics.on_rejected()
+            raise RejectedError(
+                "config not batchable (beams/repetition_penalty); route "
+                "through submit_exclusive")
+        ids = np.asarray(input_ids, np.int32)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        mask = (np.ones_like(ids) if attention_mask is None
+                else np.asarray(attention_mask).astype(np.int32))
+        rows = []
+        for i in range(ids.shape[0]):
+            real = np.flatnonzero(mask[i])
+            row = ids[i, real] if len(real) else \
+                np.asarray([g.pad_token_id], np.int32)
+            if len(row) + g.max_new_tokens > self._max_model_len:
+                self._metrics.on_rejected()
+                raise RejectedError(
+                    f"prompt {len(row)} + max_new {g.max_new_tokens} "
+                    f"exceeds max_model_len {self._max_model_len}")
+            rows.append(row)
+        timeout_s = self._default_timeout if timeout_s is None else timeout_s
+        reqs = [Request(row, g, timeout_s=timeout_s) for row in rows]
+        try:
+            self._queue.submit_many(reqs)
+        except QueueFullError:
+            self._metrics.on_rejected_queue_full(len(reqs))
+            raise
+        self._metrics.on_submitted(len(reqs))
+        return reqs
+
+    def submit_exclusive(self, fn,
+                         timeout_s: Optional[float] = None) -> Request:
+        """Enqueue an arbitrary engine call to run alone on the
+        scheduler thread (FIFO with batch requests).  The result lands
+        in ``req.value``."""
+        if self._closed:
+            raise RejectedError("serving engine is closed")
+        timeout_s = self._default_timeout if timeout_s is None else timeout_s
+        req = Request(None, GenerationConfig(), timeout_s=timeout_s,
+                      kind="exclusive", exclusive_fn=fn)
+        try:
+            self._queue.submit(req)
+        except QueueFullError:
+            self._metrics.on_rejected_queue_full()
+            raise
+        self._metrics.on_submitted()
+        return req
+
+    # ------------------------------------------------------ the step loop
+    def run_once(self, wait_s: float = 0.0) -> bool:
+        """One scheduler iteration (see module docstring).  Returns True
+        when any request made progress; otherwise blocks up to
+        ``wait_s`` for new submissions.  Thread-safe but serialized —
+        tests drive it directly on an unstarted core."""
+        with self._step_lock:
+            return self._run_once_locked(wait_s)
+
+    def _run_once_locked(self, wait_s: float) -> bool:
+        now = time.monotonic()
+        progressed = False
+
+        for r in self._queue.remove_expired(now):
+            self._metrics.on_deadline()
+            r._finish(RequestState.CANCELLED, DeadlineExceededError(
+                f"request {r.rid} expired after "
+                f"{now - r.arrival:.3f}s in queue"))
+            progressed = True
+
+        for s in list(self._slots):
+            if s is not None and s["req"].expired(now):
+                self._metrics.on_deadline()
+                self._evict(s, RequestState.CANCELLED,
+                            DeadlineExceededError(
+                                f"request {s['req'].rid} deadline "
+                                f"exceeded mid-decode"))
+                progressed = True
+
+        while True:
+            head = self._queue.peek()
+            if head is None or head.kind != "exclusive":
+                break
+            self._run_exclusive(self._queue.pop())
+            progressed = True
+
+        while None in self._slots:
+            head = self._queue.peek()
+            if head is None or head.kind != "batch":
+                break
+            req = self._queue.pop()
+            if req.expired():
+                self._metrics.on_deadline()
+                req._finish(RequestState.CANCELLED, DeadlineExceededError(
+                    f"request {req.rid} expired in queue"))
+                continue
+            self._admit(req, self._slots.index(None))
+            progressed = True
+
+        if self.active_count:
+            self._decode_step()
+            progressed = True
+        elif not progressed and wait_s > 0:
+            self._queue.wait(wait_s)
+        return progressed
+
+    # --------------------------------------------------------- admission
+    def _plen(self, length: int) -> int:
+        plen = _round_up(max(length, 1), self._engine._prompt_bucket)
+        plen = _round_up(min(plen, self._plen_cap), self._page)
+        return max(plen, _round_up(length, self._page))
+
+    def _samp_arrays(self, cfgs):
+        n = len(cfgs)
+        samp = {"temperature": np.ones((n,), np.float32),
+                "top_k": np.zeros((n,), np.int32),
+                "top_p": np.ones((n,), np.float32),
+                "min_len": np.zeros((n,), np.int32),
+                "eos": np.full((n,), -1, np.int32),
+                "do_sample": np.zeros((n,), bool),
+                "pad": np.zeros((n,), np.int32)}
+        for i, g in enumerate(cfgs):
+            if g is None:
+                continue
+            samp["temperature"][i] = g.temperature
+            samp["top_k"][i] = g.top_k or 0
+            samp["top_p"][i] = g.top_p
+            samp["min_len"][i] = g.min_length
+            samp["eos"][i] = -1 if g.eos_token_id is None else g.eos_token_id
+            samp["do_sample"][i] = g.do_sample
+            samp["pad"][i] = g.pad_token_id
+        return samp
+
+    def _admit(self, req: Request, sid: int):
+        g = req.config
+        length = int(req.prompt.size)
+        plen = self._plen(length)
+        ids = np.full((1, plen), g.pad_token_id, np.int32)
+        ids[0, :length] = req.prompt
+        # the prefill writes all plen page slots; decode positions reach
+        # length+max_new-1 — reserve whichever is larger
+        reserve = max(plen, length + g.max_new_tokens)
+        self._pool.free(sid)
+        self._pool.reserve(sid, reserve)
+        table = np.full((self._max_pages,), self._scratch, np.int32)
+        t = self._pool.block_table(sid)[:self._max_pages]
+        table[:len(t)] = np.asarray(t, np.int32)
+        key = np.asarray(
+            jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))
+        eng = self._engine
+        pkey = ("serve-prefill", plen, self._max_pages,
+                self._pool.num_blocks)
+        try:
+            tok, fin = eng.run_paged_program(
+                pkey, lambda: build_prefill(eng, plen, self._max_pages),
+                ids, np.asarray([length], np.int32), table[None],
+                self._samp_arrays([g]), key[None])
+        except Exception as e:
+            self._pool.free(sid)
+            self._metrics.on_failed()
+            req._finish(RequestState.FAILED, e)
+            if eng.kv_state_lost():
+                self._fail_all(e)
+            return
+        tok = int(np.asarray(tok)[0])
+        finished = bool(np.asarray(fin)[0])
+        req._mark_active()
+        self._metrics.on_prefill(time.monotonic() - req.arrival)
+        req._emit(np.asarray([tok], np.int32))
+        self._metrics.on_tokens(1)
+        if finished or g.max_new_tokens <= 1:
+            self._pool.free(sid)
+            req._finish(RequestState.DONE)
+            self._metrics.on_completed(time.monotonic() - req.arrival)
+            return
+        self._slots[sid] = {"req": req, "sid": sid, "g": g,
+                            "length": length, "plen": plen,
+                            "emitted": 1, "last_tok": tok,
+                            "last_emit": time.monotonic(),
+                            "table": table, "key": key}
+
+    # ------------------------------------------------------------ decode
+    def _decode_step(self):
+        active = [s for s in self._slots if s is not None]
+        # ALWAYS run the full chunk: a variable tail size would compile a
+        # fresh program for every distinct min-remaining-budget value
+        # (admission staggering makes those near-arbitrary).  Rows whose
+        # budget ends mid-chunk decode junk for the remaining steps —
+        # harmless: the junk tokens are clamped off host-side below,
+        # overshoot writes land in the row's own reserved pages (or the
+        # scratch page past its table), and the row is evicted before its
+        # pages are ever freed for reuse.
+        S = self._decode_chunk
+        b = self._max_batch
+        tok = np.zeros((b,), np.int32)
+        fin = np.ones((b,), bool)
+        pos0 = np.zeros((b,), np.int32)
+        steps0 = np.zeros((b,), np.int32)
+        tables = np.full((b, self._max_pages), self._scratch, np.int32)
+        keys = np.zeros((b,) + active[0]["key"].shape,
+                        active[0]["key"].dtype)
+        cfgs: List[Optional[GenerationConfig]] = [None] * b
+        for s in active:
+            i = s["sid"]
+            tok[i] = s["last_tok"]
+            fin[i] = False
+            pos0[i] = s["length"] + s["emitted"] - 1
+            steps0[i] = s["emitted"]
+            tables[i] = s["table"]
+            keys[i] = s["key"]
+            cfgs[i] = s["g"]
+        eng = self._engine
+        dkey = ("serve-step", b, S, self._max_pages, self._pool.num_blocks)
+        t0 = time.monotonic()
+        try:
+            toks, fin_out, nvalid = eng.run_paged_program(
+                dkey, lambda: build_decode(eng, b, S, self._max_pages),
+                tok, fin, pos0, steps0, tables,
+                self._samp_arrays(cfgs), keys)
+        except Exception as e:
+            self._metrics.on_failed(0)
+            self._fail_all(e)
+            return
+        wall = time.monotonic() - t0
+        toks = np.asarray(toks)
+        fin_out = np.asarray(fin_out)
+        nvalid = np.asarray(nvalid)
+        self._step_idx += 1
+        emitted_total = 0
+        evicted = []
+        now = time.monotonic()
+        for s in active:
+            i = s["sid"]
+            n = min(int(nvalid[i]),
+                    s["g"].max_new_tokens - s["emitted"])
+            if n > 0:
+                s["req"]._emit(toks[i, :n])
+                s["last_tok"] = int(toks[i, n - 1])
+                s["emitted"] += n
+                s["last_emit"] = now
+                emitted_total += n
+            if bool(fin_out[i]) or s["emitted"] >= s["g"].max_new_tokens:
+                self._evict(s, RequestState.DONE)
+                evicted.append(s["req"].rid)
+        if emitted_total:
+            self._metrics.on_tokens(emitted_total, itl_s=wall / S)
+        self._metrics.on_step(wall * 1e3, len(active), b)
+        self.step_trace.append({
+            "step": self._step_idx, "batch_steps": S,
+            "active": [s["req"].rid for s in active],
+            "evicted": evicted})
+
+    # ---------------------------------------------------------- eviction
+    def _evict(self, slot: dict, state: RequestState,
+               err: Optional[BaseException] = None):
+        self._slots[slot["sid"]] = None
+        self._pool.free(slot["sid"])
+        req = slot["req"]
+        req._finish(state, err)
+        if state == RequestState.DONE:
+            self._metrics.on_completed(time.monotonic() - req.arrival)
+        elif state == RequestState.FAILED:
+            self._metrics.on_failed()
+
+    def _fail_all(self, err: BaseException):
+        """A failed donated call destroyed the page pools — every
+        in-flight row's KV is gone; fail them all rather than decode
+        from zeroed state."""
+        for s in list(self._slots):
+            if s is not None:
+                self._evict(s, RequestState.FAILED, RejectedError(
+                    f"in-flight KV state lost: {err!r}"))
+
+    def _run_exclusive(self, req: Request):
+        if req.expired():
+            self._metrics.on_deadline()
+            req._finish(RequestState.CANCELLED, DeadlineExceededError(
+                f"request {req.rid} expired in queue"))
+            return
+        req._mark_active()
+        try:
+            req.value = req.exclusive_fn()
+            req._finish(RequestState.DONE)
+            self._metrics.on_completed(time.monotonic() - req.arrival)
+        except Exception as e:
+            self._metrics.on_failed()
+            req._finish(RequestState.FAILED, e)
+
+    # ---------------------------------------------------- thread control
+    def start(self) -> "EngineCore":
+        if self._thread is None:
+            self._stop_evt.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="serving-engine-core", daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop_evt.is_set():
+            try:
+                self.run_once(wait_s=0.02)
+            except Exception:
+                # requests are failed individually; the scheduler itself
+                # must outlive any one bad program
+                time.sleep(0.01)
+
+    def stop(self, timeout: float = 10.0):
+        if self._thread is not None:
+            self._stop_evt.set()
+            t, self._thread = self._thread, None
+            t.join(timeout)
+
+    def close(self):
+        """Stop the loop, cancel everything in flight, and release every
+        pool reservation (incl. scratch) so the engine can be reused."""
+        if self._closed:
+            return
+        self._closed = True
+        self.stop()
+        for r in self._queue.drain():
+            r._finish(RequestState.REJECTED,
+                      RejectedError("serving engine closed"))
+        for s in list(self._slots):
+            if s is not None:
+                self._evict(s, RequestState.CANCELLED,
+                            RejectedError("serving engine closed"))
+        self._pool.free(self._max_batch)

@@ -1,0 +1,171 @@
+"""Serving metrics registry.
+
+Lock-protected counters plus bounded reservoirs for the latency
+distributions the serving loop cares about: time-to-first-token,
+inter-token latency, end-to-end latency, decode-step wall time, and
+batch occupancy.  ``snapshot()`` renders everything to a plain dict so
+``tools/serve.py`` can dump it as the ``GET /metrics`` JSON body and
+``bench.py`` can read TTFT percentiles without scraping logs.
+
+Percentiles come from a fixed-size tail reservoir (last N samples, not
+a sketch) — good enough for a serving dashboard and O(1) memory.
+Token throughput is measured over a sliding window of recent
+(timestamp, count) emission events so the reported tokens/s reflects
+steady state rather than lifetime average.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+_RESERVOIR = 2048        # samples kept per latency series
+_RATE_WINDOW_S = 30.0    # sliding window for tokens/s
+
+
+def _percentile(samples, q: float) -> Optional[float]:
+    if not samples:
+        return None
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return float(s[idx])
+
+
+class _Series:
+    """Bounded sample reservoir (keeps the most recent samples)."""
+
+    def __init__(self, maxlen: int = _RESERVOIR):
+        self._d: deque = deque(maxlen=maxlen)
+        self.count = 0
+        self.total = 0.0
+
+    def add(self, v: float):
+        self._d.append(float(v))
+        self.count += 1
+        self.total += float(v)
+
+    def summary(self) -> Dict[str, Optional[float]]:
+        d = list(self._d)
+        return {
+            "count": self.count,
+            "mean": (self.total / self.count) if self.count else None,
+            "p50": _percentile(d, 0.50),
+            "p99": _percentile(d, 0.99),
+            "max": max(d) if d else None,
+        }
+
+
+class ServingMetrics:
+    """Thread-safe registry shared by EngineCore and the HTTP layer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        with getattr(self, "_lock", threading.Lock()):
+            self.submitted = 0
+            self.completed = 0
+            self.failed = 0
+            self.rejected_queue_full = 0
+            self.rejected = 0               # other admission rejections
+            self.cancelled_deadline = 0
+            self.tokens_generated = 0
+            self.prefills = 0
+            self.decode_steps = 0
+            self.ttft = _Series()
+            self.itl = _Series()            # inter-token latency (s)
+            self.e2e = _Series()
+            self.step_ms = _Series()        # one fused decode step (ms)
+            self.occupancy = _Series()      # active rows / max_batch
+            self._emits: deque = deque()    # (t, ntokens) rate window
+
+    # ------------------------------------------------ recording hooks
+    def on_submitted(self, n: int = 1):
+        with self._lock:
+            self.submitted += n
+
+    def on_rejected_queue_full(self, n: int = 1):
+        with self._lock:
+            self.rejected_queue_full += n
+
+    def on_rejected(self, n: int = 1):
+        with self._lock:
+            self.rejected += n
+
+    def on_deadline(self, n: int = 1):
+        with self._lock:
+            self.cancelled_deadline += n
+
+    def on_failed(self, n: int = 1):
+        with self._lock:
+            self.failed += n
+
+    def on_prefill(self, ttft_s: Optional[float] = None):
+        with self._lock:
+            self.prefills += 1
+            if ttft_s is not None:
+                self.ttft.add(ttft_s)
+
+    def on_tokens(self, n: int, itl_s: Optional[float] = None):
+        now = time.monotonic()
+        with self._lock:
+            self.tokens_generated += n
+            self._emits.append((now, n))
+            while self._emits and now - self._emits[0][0] > _RATE_WINDOW_S:
+                self._emits.popleft()
+            if itl_s is not None and n > 0:
+                self.itl.add(itl_s)
+
+    def on_step(self, wall_ms: float, active: int, max_batch: int):
+        with self._lock:
+            self.decode_steps += 1
+            self.step_ms.add(wall_ms)
+            if max_batch > 0:
+                self.occupancy.add(active / max_batch)
+
+    def on_completed(self, e2e_s: Optional[float] = None):
+        with self._lock:
+            self.completed += 1
+            if e2e_s is not None:
+                self.e2e.add(e2e_s)
+
+    # ------------------------------------------------------ rendering
+    def tokens_per_second(self) -> float:
+        now = time.monotonic()
+        with self._lock:
+            while self._emits and now - self._emits[0][0] > _RATE_WINDOW_S:
+                self._emits.popleft()
+            if not self._emits:
+                return 0.0
+            span = max(now - self._emits[0][0], 1e-6)
+            return sum(n for _, n in self._emits) / span
+
+    def snapshot(self, queue_depth: int = 0, active: int = 0,
+                 max_batch: int = 0) -> Dict:
+        tps = self.tokens_per_second()
+        with self._lock:
+            return {
+                "queue_depth": queue_depth,
+                "active": active,
+                "max_batch": max_batch,
+                "batch_occupancy": (active / max_batch) if max_batch else 0.0,
+                "counters": {
+                    "submitted": self.submitted,
+                    "completed": self.completed,
+                    "failed": self.failed,
+                    "rejected_queue_full": self.rejected_queue_full,
+                    "rejected": self.rejected,
+                    "cancelled_deadline": self.cancelled_deadline,
+                    "tokens_generated": self.tokens_generated,
+                    "prefills": self.prefills,
+                    "decode_steps": self.decode_steps,
+                },
+                "tokens_per_second": tps,
+                "ttft_s": self.ttft.summary(),
+                "inter_token_latency_s": self.itl.summary(),
+                "e2e_latency_s": self.e2e.summary(),
+                "decode_step_ms": self.step_ms.summary(),
+                "occupancy": self.occupancy.summary(),
+            }

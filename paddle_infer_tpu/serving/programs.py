@@ -1,0 +1,156 @@
+"""Compiled prefill / decode-step programs for the continuous-batching
+scheduler.
+
+Design constraint: admitting a request must NEVER recompile the decode
+hot loop, whatever its sampling config.  The dense/paged engines key
+executables by ``GenerationConfig.cache_key()`` — fine when one call
+serves one homogeneous batch, fatal for continuous batching where every
+row can carry different knobs.  Here temperature / top-k / top-p /
+min-length / eos / do_sample ride as **per-row arrays** (the ``samp``
+dict), so there is exactly one decode executable per
+(batch, chunk, table-width, pool-size) and heterogeneous requests share
+it.  Greedy rows stay argmax-exact with ``GenerationEngine`` output:
+temperature scaling, top-k and top-p masking never change the argmax
+(the top token always survives every filter), so token parity with the
+engines' greedy path holds bit-for-bit.
+
+Layout contract with ``EngineCore`` (mirrors PagedGenerationEngine's
+stream programs):
+
+  * prompts are RIGHT-padded to a page multiple; ``write_prompt_pages``
+    writes all ``plen`` slots but decode attends only ``pos+1`` entries,
+    so pad KV past the true length is never read;
+  * decode step ``i`` of a chunk feeds the last emitted token, writes
+    its KV at per-row position ``pos0 + i`` and samples the next token
+    (same step algebra as ``_build_stream_chunk``, but with *per-row*
+    lengths/offsets so rows at different generation depths coexist);
+  * inactive batch rows point every table entry at the scratch page
+    with ``fin=True`` — their writes land in garbage the attention mask
+    never exposes to live rows.
+
+Per-row RNG: each request owns a base key (``fold_in(PRNGKey(seed),
+rid)``); step ``s`` uses ``fold_in(base, s)`` — independent streams per
+row that survive the row moving between chunk shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..inference import sampling
+
+# samp dict fields (all shaped [batch]):
+#   temperature f32, top_k i32 (0 = off), top_p f32 (1.0 = off),
+#   min_len i32, eos i32 (-1 = none), do_sample bool, pad i32
+
+
+def _process_rows(logits, samp, steps):
+    """Per-row logits-processor chain (min-length eos ban → temperature
+    → top-k → top-p), vectorized over rows with heterogeneous knobs.
+    Same order as ``sampling.process_logits``."""
+    logits = logits.astype(jnp.float32)
+    vocab = logits.shape[-1]
+
+    eos = samp["eos"]
+    banned = jnp.logical_and(eos >= 0, steps < samp["min_len"])
+    eos_col = jax.nn.one_hot(jnp.maximum(eos, 0), vocab, dtype=jnp.bool_)
+    logits = jnp.where(jnp.logical_and(banned[:, None], eos_col),
+                       sampling.NEG_INF, logits)
+
+    t = jnp.maximum(samp["temperature"].astype(jnp.float32), 1e-6)
+    logits = logits / t[:, None]
+
+    # per-row top-k: k=0 disables by widening to the full vocab, so the
+    # kth threshold is the row minimum and the mask keeps everything
+    k = jnp.where(samp["top_k"] > 0,
+                  jnp.clip(samp["top_k"], 1, vocab), vocab)
+    sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    logits = jnp.where(logits < kth, sampling.NEG_INF, logits)
+
+    # per-row nucleus filter over the post-top-k logits (top token is
+    # always kept, so p=1.0 rows pass through unchanged)
+    sorted2 = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted2, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < samp["top_p"][:, None]
+    keep = keep.at[..., 0].set(True)
+    thresh = jnp.min(jnp.where(keep, sorted2, jnp.inf), axis=-1,
+                     keepdims=True)
+    return jnp.where(logits < thresh, sampling.NEG_INF, logits)
+
+
+def _pick_rows(proc, samp, steps, keys):
+    """Sample (per-row fold_in stream) or argmax, selected per row."""
+    step_keys = jax.vmap(jax.random.fold_in)(keys, steps)
+    sampled = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row))(step_keys, proc)
+    greedy = jnp.argmax(proc, axis=-1)
+    return jnp.where(samp["do_sample"], sampled, greedy).astype(jnp.int32)
+
+
+def build_prefill(engine, plen, max_pages):
+    """Prefill one request (batch of 1) into its reserved pages and pick
+    the first token.  ``run(params, ids[1,plen], lengths[1],
+    tables[1,max_pages], samp, keys[1,2], k_pages, v_pages)`` →
+    ``(tok[1], fin[1], k_pages, v_pages)``; pools are donated."""
+    L = engine._num_layers
+
+    def run(params, ids, lengths, tables, samp, keys, k_pages, v_pages):
+        b = ids.shape[0]
+        zero_pos = jnp.zeros((b,), jnp.int32)
+        caches = [(k_pages[i], v_pages[i], tables, zero_pos)
+                  for i in range(L)]
+        pos2d = jnp.broadcast_to(
+            jnp.arange(plen, dtype=jnp.int32)[None], (b, plen))
+        logits, caches = engine._model_step(params, ids, pos2d, None,
+                                            caches)
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+        steps = jnp.zeros((b,), jnp.int32)
+        proc = _process_rows(last, samp, steps)
+        tok = _pick_rows(proc, samp, steps, keys)
+        fin = jnp.logical_and(samp["eos"] >= 0, tok == samp["eos"])
+        return (tok, fin,
+                [c[0] for c in caches], [c[1] for c in caches])
+
+    return jax.jit(run, donate_argnums=(6, 7))
+
+
+def build_decode(engine, batch, chunk, max_pages):
+    """One fused decode chunk over ALL batch rows: a ``lax.scan`` of
+    ``chunk`` steps (amortizing host dispatch), each feeding every row's
+    last token, writing KV at per-row ``pos0 + i`` and sampling with
+    per-row knobs.  Returns ``(toks[b, chunk], fin[b], nvalid[b],
+    k_pages, v_pages)`` where ``nvalid`` counts tokens emitted before
+    the row finished (rows never see each other's KV: tables are
+    per-row and attention masks by per-row position)."""
+    L = engine._num_layers
+
+    def run(params, tok, fin, pos0, steps0, tables, samp, keys,
+            k_pages, v_pages):
+        def body(carry, i):
+            tok, fin, nvalid, caches = carry
+            pos = pos0 + i
+            steps = steps0 + i
+            caches = [(kp, vp, tb, pos) for kp, vp, tb, _ in caches]
+            logits, caches = engine._model_step(
+                params, tok[:, None], pos[:, None], None, caches)
+            proc = _process_rows(logits[:, -1], samp, steps)
+            nxt = _pick_rows(proc, samp, steps, keys)
+            nxt = jnp.where(fin, samp["pad"], nxt)
+            nvalid = nvalid + jnp.logical_not(fin).astype(jnp.int32)
+            fin = jnp.logical_or(
+                fin, jnp.logical_and(samp["eos"] >= 0, nxt == samp["eos"]))
+            return (nxt, fin, nvalid, caches), nxt
+
+        caches = [(k_pages[i], v_pages[i], tables,
+                   jnp.zeros((batch,), jnp.int32)) for i in range(L)]
+        nvalid0 = jnp.zeros((batch,), jnp.int32)
+        (tok, fin, nvalid, caches), toks = jax.lax.scan(
+            body, (tok, fin, nvalid0, caches),
+            jnp.arange(chunk, dtype=jnp.int32))
+        return (toks.T, fin, nvalid,
+                [c[0] for c in caches], [c[1] for c in caches])
+
+    return jax.jit(run, donate_argnums=(8, 9))

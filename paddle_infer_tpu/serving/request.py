@@ -1,0 +1,209 @@
+"""Request lifecycle + admission control for the serving engine.
+
+A ``Request`` is one sequence (single row) moving through
+QUEUED → ACTIVE → DONE/REJECTED/CANCELLED/FAILED.  Token delivery is
+incremental: the scheduler thread ``_emit()``s chunks as they decode and
+any number of consumer threads read them through ``stream()`` (an
+iterator) or ``result()``/``padded_result()`` (blocking collect) — the
+callback/iterator API ``tools/serve.py``'s chunked-HTTP path consumes.
+
+``RequestQueue`` is the admission-control side: a depth-bounded FIFO.
+``submit_many`` is all-or-nothing so a multi-row HTTP request can't be
+half-admitted, and expired entries are swept by deadline before they
+ever reach a KV slot.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+import time
+from enum import Enum
+from typing import Callable, List, Optional
+
+import numpy as np
+
+
+class RejectedError(RuntimeError):
+    """Request refused by admission control (bad size, shutdown, ...)."""
+
+
+class QueueFullError(RejectedError):
+    """Queue at max depth — backpressure, retry later (HTTP 429)."""
+
+
+class DeadlineExceededError(RejectedError):
+    """Per-request deadline passed while queued or mid-decode (504)."""
+
+
+class RequestState(Enum):
+    QUEUED = "queued"
+    ACTIVE = "active"
+    DONE = "done"
+    REJECTED = "rejected"
+    CANCELLED = "cancelled"
+    FAILED = "failed"
+
+
+_END = object()          # stream sentinel
+_rid_counter = itertools.count(1)
+
+
+class Request:
+    """One serving request (a single sequence row, or one exclusive
+    engine call for configs the continuous batch can't host)."""
+
+    def __init__(self, prompt, config, timeout_s: Optional[float] = None,
+                 kind: str = "batch",
+                 exclusive_fn: Optional[Callable] = None):
+        self.rid = next(_rid_counter)
+        self.prompt = (None if prompt is None
+                       else np.asarray(prompt, np.int32).reshape(-1))
+        self.config = config
+        self.kind = kind
+        self.exclusive_fn = exclusive_fn
+        self.arrival = time.monotonic()
+        self.deadline = (None if timeout_s is None
+                         else self.arrival + float(timeout_s))
+        self.state = RequestState.QUEUED
+        self.error: Optional[BaseException] = None
+        self.value = None                  # exclusive_fn return value
+        self.tokens: List[int] = []        # delivered tokens (this row)
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self._chunks: _queue.Queue = _queue.Queue()
+        self._done = threading.Event()
+
+    # ------------------------------------------------- scheduler side
+    def expired(self, now: Optional[float] = None) -> bool:
+        if self.deadline is None:
+            return False
+        return (time.monotonic() if now is None else now) > self.deadline
+
+    def _mark_active(self):
+        self.state = RequestState.ACTIVE
+
+    def _emit(self, toks: np.ndarray):
+        """Deliver decoded tokens (1-D array) to the consumer."""
+        toks = np.asarray(toks, np.int32).reshape(-1)
+        if toks.size == 0:
+            return
+        if self.first_token_at is None:
+            self.first_token_at = time.monotonic()
+        self.tokens.extend(int(t) for t in toks)
+        self._chunks.put(toks)
+
+    def _finish(self, state: RequestState,
+                error: Optional[BaseException] = None):
+        self.state = state
+        self.error = error
+        self.finished_at = time.monotonic()
+        self._chunks.put(_END)
+        self._done.set()
+
+    # -------------------------------------------------- consumer side
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    @property
+    def emitted(self) -> int:
+        return len(self.tokens)
+
+    def stream(self, timeout: Optional[float] = None):
+        """Iterator over token chunks (np.int32 [n]) as they decode.
+        Raises the request's error (deadline/failure) after draining."""
+        while True:
+            chunk = self._chunks.get(timeout=timeout)
+            if chunk is _END:
+                break
+            yield chunk
+        if self.error is not None:
+            raise self.error
+
+    def wait_tokens(self, n: int, timeout: Optional[float] = None):
+        """Block until ``n`` tokens were delivered or the request ended."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while len(self.tokens) < n and not self._done.is_set():
+            left = None if deadline is None else deadline - time.monotonic()
+            if left is not None and left <= 0:
+                raise TimeoutError(f"request {self.rid}: waited for "
+                                   f"{n} tokens")
+            self._done.wait(0.002 if left is None else min(left, 0.002))
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until finished; return the delivered tokens [n]."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.rid} still running")
+        if self.error is not None:
+            raise self.error
+        return np.asarray(self.tokens, np.int32)
+
+    def padded_result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Like ``result()`` but padded to ``config.max_new_tokens`` with
+        ``pad_token_id`` — shape-identical to one row of
+        ``GenerationEngine.generate``."""
+        toks = self.result(timeout)
+        g = self.config
+        out = np.full((g.max_new_tokens,), g.pad_token_id, np.int32)
+        out[:len(toks)] = toks[:g.max_new_tokens]
+        return out
+
+
+class RequestQueue:
+    """Depth-bounded FIFO with deadline sweeping.  All mutation happens
+    under one condition variable the scheduler waits on."""
+
+    def __init__(self, max_depth: int = 64):
+        self.max_depth = int(max_depth)
+        self._q: List[Request] = []
+        self._cond = threading.Condition()
+
+    def __len__(self):
+        with self._cond:
+            return len(self._q)
+
+    @property
+    def depth(self) -> int:
+        return len(self)
+
+    def submit(self, req: Request):
+        self.submit_many([req])
+
+    def submit_many(self, reqs: List[Request]):
+        """Admit all of ``reqs`` or none (multi-row HTTP bodies must not
+        be half-accepted).  Raises QueueFullError under backpressure."""
+        with self._cond:
+            if len(self._q) + len(reqs) > self.max_depth:
+                raise QueueFullError(
+                    f"queue full ({len(self._q)}/{self.max_depth} deep, "
+                    f"{len(reqs)} arriving)")
+            self._q.extend(reqs)
+            self._cond.notify_all()
+
+    def peek(self) -> Optional[Request]:
+        with self._cond:
+            return self._q[0] if self._q else None
+
+    def pop(self) -> Optional[Request]:
+        with self._cond:
+            return self._q.pop(0) if self._q else None
+
+    def remove_expired(self, now: float) -> List[Request]:
+        """Drop and return every queued request past its deadline."""
+        with self._cond:
+            dead = [r for r in self._q if r.expired(now)]
+            if dead:
+                self._q = [r for r in self._q if not r.expired(now)]
+            return dead
+
+    def drain(self) -> List[Request]:
+        with self._cond:
+            out, self._q = self._q, []
+            return out
+
+    def wait(self, timeout: float):
+        """Sleep until new work is submitted (or timeout)."""
+        with self._cond:
+            if not self._q:
+                self._cond.wait(timeout)

@@ -115,6 +115,80 @@ def test_bad_request_400(server):
         assert e.code == 400
 
 
+def test_metrics_endpoint(server):
+    url, _ = server
+    # generate something first so the counters are non-trivial
+    ids = np.random.RandomState(2).randint(0, 96, (1, 8)).astype(np.int32)
+    with _post(url, "/generate", {"ids": ids.tolist(),
+                                  "max_new_tokens": 4}) as r:
+        json.load(r)
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        snap = json.load(r)
+    assert snap["counters"]["submitted"] >= 1
+    assert snap["counters"]["completed"] >= 1
+    assert snap["counters"]["tokens_generated"] >= 4
+    assert snap["ttft_s"]["count"] >= 1
+    assert "tokens_per_second" in snap and "occupancy" in snap
+    assert snap["max_batch"] >= 1
+
+
+def test_concurrent_posts_share_the_batch(server):
+    """Concurrent clients must all come back correct (they ride the
+    same continuous batch) and the occupancy metric must show fused
+    steps that hosted more than one row."""
+    import threading
+
+    url, m = server
+    eng = PagedGenerationEngine(m, page_size=8)
+    g = GenerationConfig(max_new_tokens=12)
+    prompts = [np.random.RandomState(10 + i).randint(0, 96, (8,))
+               .astype(np.int32) for i in range(4)]
+    want = [eng.generate(p[None], g) for p in prompts]
+    got = [None] * 4
+    errs = []
+
+    def client(i):
+        try:
+            with _post(url, "/generate",
+                       {"ids": prompts[i][None].tolist(),
+                        "max_new_tokens": 12}) as r:
+                got[i] = np.asarray(json.load(r)["tokens"])
+        except Exception as e:          # pragma: no cover - diagnostics
+            errs.append((i, e))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errs, errs
+    for i in range(4):
+        np.testing.assert_array_equal(got[i], want[i])
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        snap = json.load(r)
+    assert snap["counters"]["completed"] >= 4
+    assert snap["occupancy"]["max"] is not None
+
+
+def test_queue_full_maps_to_429(tmp_path):
+    d = str(tmp_path / "gpt")
+    _tiny_model(d)
+    url, proc = _spawn_server(d, "--max_queue", "0")
+    try:
+        ids = [[1, 2, 3, 4]]
+        try:
+            _post(url, "/generate", {"ids": ids, "max_new_tokens": 4})
+            raise AssertionError("expected 429")
+        except urllib.error.HTTPError as e:
+            assert e.code == 429
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            snap = json.load(r)
+        assert snap["counters"]["rejected_queue_full"] >= 1
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)
+
+
 def test_speculative_serving_path(tmp_path):
     """--draft_dir routes greedy bs1 requests through SpeculativeEngine;
     tokens must match the non-draft paged response (self-draft →

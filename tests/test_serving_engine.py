@@ -1,0 +1,259 @@
+"""Continuous-batching serving engine (paddle_infer_tpu/serving/):
+EngineCore step loop, admission control, deadlines, streaming and
+metrics.  Tests drive ``run_once()`` directly on unstarted cores so the
+schedule is deterministic; only the streaming test runs the background
+thread."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.inference.generation import (GenerationConfig,
+                                                   PagedGenerationEngine)
+from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_infer_tpu.serving import (DeadlineExceededError, EngineCore,
+                                      QueueFullError, RejectedError,
+                                      RequestState)
+
+
+@pytest.fixture(scope="module")
+def model():
+    pit.seed(0)
+    m = GPTForCausalLM(GPTConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0))
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    """The engine the cores own (compile cache shared across tests)."""
+    return PagedGenerationEngine(model, page_size=8)
+
+
+@pytest.fixture(scope="module")
+def ref(model):
+    """Separate reference engine — direct generate() on the core-owned
+    engine would corrupt its slot reservations."""
+    return PagedGenerationEngine(model, page_size=8)
+
+
+@pytest.fixture
+def make_core(engine):
+    cores = []
+
+    def make(**kw):
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("decode_chunk", 4)
+        core = EngineCore(engine, **kw)
+        cores.append(core)
+        return core
+
+    yield make
+    for c in cores:
+        c.close()
+
+
+def _drive(core, reqs, max_iters=200):
+    for _ in range(max_iters):
+        if all(r.done for r in reqs):
+            return
+        core.run_once()
+    raise AssertionError("requests did not finish")
+
+
+def _prompt(seed, n=8):
+    return np.random.RandomState(seed).randint(0, 96, (n,)).astype(np.int32)
+
+
+def test_single_request_matches_paged_engine(make_core, ref):
+    core = make_core()
+    ids = _prompt(0)
+    g = GenerationConfig(max_new_tokens=6)
+    (req,) = core.submit(ids, g)
+    _drive(core, [req])
+    want = ref.generate(ids[None], g)[0]
+    np.testing.assert_array_equal(req.padded_result(), want)
+    assert req.state is RequestState.DONE
+
+
+def test_late_arrival_joins_inflight_batch(make_core, ref):
+    """A request enqueued AFTER another started decoding must decode in
+    the same fused step (continuous batching, not stop-the-world) —
+    asserted via the step trace — and both rows stay correct."""
+    core = make_core(decode_chunk=1)
+    g = GenerationConfig(max_new_tokens=8)
+    (ra,) = core.submit(_prompt(1), g)
+    core.run_once()                      # admit A + first decode step
+    core.run_once()                      # A decoding alone
+    assert ra.emitted >= 2 and not ra.done
+    (rb,) = core.submit(_prompt(2), g)   # late arrival
+    _drive(core, [ra, rb])
+    joint = [t for t in core.step_trace
+             if ra.rid in t["active"] and rb.rid in t["active"]]
+    assert joint, "late request never shared a decode step"
+    # and there were A-only steps before B arrived
+    solo = [t for t in core.step_trace
+            if ra.rid in t["active"] and rb.rid not in t["active"]]
+    assert solo
+    np.testing.assert_array_equal(
+        ra.padded_result(), ref.generate(_prompt(1)[None], g)[0])
+    np.testing.assert_array_equal(
+        rb.padded_result(), ref.generate(_prompt(2)[None], g)[0])
+
+
+def test_queue_backpressure_rejects(make_core):
+    core = make_core(max_queue=2)
+    g = GenerationConfig(max_new_tokens=4)
+    core.submit(_prompt(3), g)
+    core.submit(_prompt(4), g)
+    with pytest.raises(QueueFullError):
+        core.submit(_prompt(5), g)
+    snap = core.metrics_snapshot()
+    assert snap["counters"]["rejected_queue_full"] == 1
+    assert snap["queue_depth"] == 2
+
+
+def test_submit_many_is_all_or_nothing(make_core):
+    core = make_core(max_queue=3)
+    core.submit(_prompt(6), GenerationConfig(max_new_tokens=4))
+    ids = np.stack([_prompt(7), _prompt(8), _prompt(9)])
+    with pytest.raises(QueueFullError):
+        core.submit(ids, GenerationConfig(max_new_tokens=4))
+    assert core.queue_depth == 1        # none of the 3 was admitted
+
+
+def test_oversized_prompt_rejected(make_core):
+    core = make_core(max_model_len=64)
+    with pytest.raises(RejectedError):
+        core.submit(_prompt(10), GenerationConfig(max_new_tokens=60))
+    assert core.metrics_snapshot()["counters"]["rejected"] == 1
+
+
+def test_queued_deadline_expires_without_cost(make_core):
+    core = make_core()
+    baseline = core._pool.free_blocks
+    (req,) = core.submit(_prompt(11), GenerationConfig(max_new_tokens=4),
+                         timeout_s=0.01)
+    time.sleep(0.05)
+    core.run_once()
+    with pytest.raises(DeadlineExceededError):
+        req.result()
+    assert req.state is RequestState.CANCELLED
+    assert core._pool.free_blocks == baseline    # never reserved KV
+
+
+def test_active_deadline_frees_kv_blocks(make_core):
+    core = make_core()
+    baseline = core._pool.free_blocks
+    (req,) = core.submit(_prompt(12), GenerationConfig(max_new_tokens=32),
+                         timeout_s=0.3)
+    core.run_once()                     # admit + first decode chunk
+    assert core.active_count == 1
+    assert core._pool.free_blocks < baseline
+    time.sleep(0.35)
+    core.run_once()                     # deadline sweep evicts the row
+    with pytest.raises(DeadlineExceededError):
+        req.result()
+    assert req.state is RequestState.CANCELLED
+    assert core.active_count == 0
+    assert core._pool.free_blocks == baseline
+
+
+def test_streaming_tokens_arrive_incrementally(make_core, ref):
+    core = make_core().start()
+    ids = _prompt(13)
+    g = GenerationConfig(max_new_tokens=6)
+    (req,) = core.submit(ids, g)
+    chunks = list(req.stream(timeout=120))
+    assert len(chunks) >= 2             # prefill token + >=1 decode chunk
+    got = np.concatenate(chunks)
+    want = ref.generate(ids[None], g)[0]
+    np.testing.assert_array_equal(got, want[:len(got)])
+    core.stop()
+
+
+def test_burst_metrics_and_eviction_backfill(make_core, ref):
+    """Burst of 5 single-row requests through 2 slots: completions free
+    slots that are backfilled from the queue, and the metrics snapshot
+    adds up."""
+    core = make_core(max_batch=2)
+    g = GenerationConfig(max_new_tokens=6)
+    reqs = [core.submit(_prompt(20 + i), g)[0] for i in range(5)]
+    _drive(core, reqs)
+    for i, r in enumerate(reqs):
+        np.testing.assert_array_equal(
+            r.padded_result(), ref.generate(_prompt(20 + i)[None], g)[0])
+    snap = core.metrics_snapshot()
+    c = snap["counters"]
+    assert c["submitted"] == 5 and c["completed"] == 5
+    assert c["tokens_generated"] == sum(r.emitted for r in reqs) == 30
+    assert c["prefills"] == 5 and c["decode_steps"] >= 3
+    assert snap["ttft_s"]["count"] == 5 and snap["ttft_s"]["p99"] >= 0
+    assert snap["inter_token_latency_s"]["count"] >= 1
+    assert 0 < snap["occupancy"]["mean"] <= 1.0
+    assert snap["queue_depth"] == 0 and snap["active"] == 0
+    # every decode step ran at most 2 rows, and some step interleaved 2
+    assert all(len(t["active"]) <= 2 for t in core.step_trace)
+    assert any(len(t["active"]) == 2 for t in core.step_trace)
+
+
+def test_mixed_sampling_and_greedy_share_a_step(make_core, ref):
+    """Per-row sampling params live in arrays: a sampled row and a
+    greedy row decode in one fused step, and the greedy row's tokens
+    are unaffected by its neighbour."""
+    core = make_core()
+    greedy = GenerationConfig(max_new_tokens=6)
+    sampled = GenerationConfig(max_new_tokens=6, do_sample=True,
+                               temperature=0.8, top_k=5, top_p=0.9,
+                               seed=7)
+    (rg,) = core.submit(_prompt(30), greedy)
+    (rs,) = core.submit(_prompt(31), sampled)
+    _drive(core, [rg, rs])
+    joint = [t for t in core.step_trace
+             if rg.rid in t["active"] and rs.rid in t["active"]]
+    assert joint
+    np.testing.assert_array_equal(
+        rg.padded_result(), ref.generate(_prompt(30)[None], greedy)[0])
+    toks = rs.result()
+    assert len(toks) == 6 and ((toks >= 0) & (toks < 96)).all()
+
+
+def test_eos_parity_with_engine(make_core, ref):
+    """A config with eos_token_id must stop exactly where the paged
+    engine stops (the eos token itself is emitted, then pad)."""
+    ids = _prompt(32)
+    free_run = ref.generate(ids[None], GenerationConfig(max_new_tokens=6))
+    eos = int(free_run[0, 2])           # greedy will hit it at step 3
+    g = GenerationConfig(max_new_tokens=6, eos_token_id=eos,
+                         pad_token_id=0)
+    core = make_core()
+    (req,) = core.submit(ids, g)
+    _drive(core, [req])
+    np.testing.assert_array_equal(req.padded_result(),
+                                  ref.generate(ids[None], g)[0])
+
+
+def test_exclusive_requests_run_on_scheduler(make_core):
+    core = make_core()
+    req = core.submit_exclusive(lambda: {"answer": 42})
+    core.run_once()
+    assert req.done and req.value == {"answer": 42}
+    assert req.state is RequestState.DONE
+
+
+def test_close_rejects_queued_and_cancels_active(make_core):
+    core = make_core()
+    g = GenerationConfig(max_new_tokens=16)
+    (ra,) = core.submit(_prompt(33), g)
+    core.run_once()                     # A active
+    (rb,) = core.submit(_prompt(34), g)  # B still queued (slot free tho)
+    core.close()
+    assert ra.state is RequestState.CANCELLED
+    assert rb.state is RequestState.REJECTED
+    with pytest.raises(RejectedError):
+        core.submit(_prompt(35), g)

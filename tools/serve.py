@@ -1,15 +1,28 @@
-"""Minimal HTTP serving front end over the generation engines.
+"""HTTP serving front end over the continuous-batching engine.
 
 Reference context: the fork's deployment story pairs Paddle Inference
 with a serving layer (paddle_serving / fastdeploy) speaking JSON over
-HTTP.  This is the stdlib-only equivalent for this framework: load a
-``save_pretrained`` directory through AutoModel, serve
+HTTP.  This is the stdlib-only equivalent for this framework — but all
+generation now flows through ``paddle_infer_tpu.serving.EngineCore``:
+one background scheduler thread owns the paged engine and runs the
+continuous-batching step loop; HTTP handler threads only enqueue
+requests and stream their tokens, so concurrent clients share fused
+decode steps instead of serializing behind a lock.
 
   POST /generate          {"ids": [[...]], "max_new_tokens": N, ...}
                           -> {"tokens": [[...]]}
   POST /generate_stream   same body -> chunked response, one JSON line
-                          per decoded chunk (PagedGenerationEngine.stream)
+                          per decoded chunk
+  GET  /metrics           -> ServingMetrics snapshot (queue depth, batch
+                          occupancy, TTFT/ITL percentiles, tokens/s,
+                          rejection counts)
   GET  /health            -> {"status": "ok", "model": ...}
+
+Admission control maps to HTTP codes: queue full -> 429, deadline
+exceeded -> 504, unbatchable/oversized -> 400.  Requests the batch
+can't host (beams, repetition penalty) and speculative-eligible
+requests run exclusively on the scheduler thread via a separate dense
+engine, FIFO with everything else.
 
 Usage:
   env PYTHONPATH=. python tools/serve.py --model_dir DIR --port 8800
@@ -27,30 +40,55 @@ import numpy as np
 _STATE = {"lock": threading.Lock()}
 
 
-def _engine():
-    if "engine" not in _STATE:
-        from paddle_infer_tpu.inference.generation import (
-            PagedGenerationEngine)
+def _core():
+    """The continuous-batching scheduler (owns the paged engine)."""
+    with _STATE["lock"]:
+        if "core" not in _STATE:
+            from paddle_infer_tpu.inference.generation import (
+                PagedGenerationEngine)
+            from paddle_infer_tpu.serving import EngineCore
 
-        _STATE["engine"] = PagedGenerationEngine(
-            _STATE["model"], page_size=_STATE["page_size"])
-    return _STATE["engine"]
+            engine = PagedGenerationEngine(
+                _STATE["model"], page_size=_STATE["page_size"])
+            _STATE["core"] = EngineCore(
+                engine,
+                max_batch=_STATE["max_batch"],
+                max_queue=_STATE["max_queue"],
+                decode_chunk=_STATE["decode_chunk"],
+                default_timeout_s=_STATE["request_timeout"],
+                max_model_len=_STATE["max_model_len"]).start()
+        return _STATE["core"]
+
+
+def _dense():
+    """Dense-cache fallback engine for exclusive requests.  Deliberately
+    NOT the paged engine: a direct generate() there would free/reserve
+    the slot sequence ids the scheduler holds for in-flight rows."""
+    with _STATE["lock"]:
+        if "dense" not in _STATE:
+            from paddle_infer_tpu.inference.generation import (
+                GenerationEngine)
+
+            _STATE["dense"] = GenerationEngine(_STATE["model"])
+        return _STATE["dense"]
 
 
 def _spec_engine():
-    if "spec_engine" not in _STATE:
-        from paddle_infer_tpu.inference.speculative import SpeculativeEngine
+    with _STATE["lock"]:
+        if "spec_engine" not in _STATE:
+            from paddle_infer_tpu.inference.speculative import (
+                SpeculativeEngine)
 
-        _STATE["spec_engine"] = SpeculativeEngine(
-            _STATE["model"], _STATE["draft_model"],
-            num_draft_tokens=_STATE["num_draft_tokens"])
-    return _STATE["spec_engine"]
+            _STATE["spec_engine"] = SpeculativeEngine(
+                _STATE["model"], _STATE["draft_model"],
+                num_draft_tokens=_STATE["num_draft_tokens"])
+        return _STATE["spec_engine"]
 
 
 def _speculatable(ids, g):
     """Requests the draft-accelerated path can serve — the ENGINE owns
-    the eligibility rules (greedy bs1 within the position budget);
-    everything else falls through to the paged engine."""
+    the eligibility rules (greedy within the position budget);
+    everything else falls through to the batching core."""
     return (_STATE.get("draft_model") is not None
             and _spec_engine().supports(ids, g))
 
@@ -64,6 +102,73 @@ def _gen_config(body):
            "repetition_penalty", "eos_token_id", "pad_token_id", "seed")
           if k in body}
     return GenerationConfig(**kw)
+
+
+def _error_code(e) -> int:
+    from paddle_infer_tpu.serving import (DeadlineExceededError,
+                                          QueueFullError, RejectedError)
+
+    if isinstance(e, QueueFullError):
+        return 429
+    if isinstance(e, (DeadlineExceededError, TimeoutError)):
+        return 504
+    if isinstance(e, RejectedError):
+        return 400
+    return 500
+
+
+def _generate(ids, g, timeout_s):
+    """Route one /generate body; returns (tokens [b, max_new], extra)."""
+    core = _core()
+    if _speculatable(ids, g):
+        def call():
+            eng = _spec_engine()
+            toks = eng.generate(ids, g)
+            return np.asarray(toks), eng.last_acceptance
+
+        req = core.submit_exclusive(call, timeout_s=timeout_s)
+        req.result(timeout=None)
+        toks, acceptance = req.value
+        return toks, {"speculative": True, "acceptance": acceptance}
+    if core.batchable(g):
+        reqs = core.submit(ids, g, timeout_s=timeout_s)
+        return np.stack([r.padded_result(timeout=None) for r in reqs]), {}
+    # beams / repetition penalty: exclusive dense-engine call
+    req = core.submit_exclusive(lambda: _dense().generate(ids, g),
+                                timeout_s=timeout_s)
+    req.result(timeout=None)
+    return np.asarray(req.value), {}
+
+
+def _stream_chunks(ids, g, chunk_size, timeout_s):
+    """Yield [b, <=chunk_size] token blocks as the batch rows decode.
+    Rows finish at different steps; slots past a finished row's last
+    token are pad, matching the engines' [b, max_new] output layout."""
+    core = _core()
+    reqs = core.submit(ids, g, timeout_s=timeout_s)
+    b = len(reqs)
+    emitted = 0
+    while True:
+        # early-stop once every row is done (engine.stream semantics)
+        limit = (g.max_new_tokens if not all(r.done for r in reqs)
+                 else max(r.emitted for r in reqs))
+        if emitted >= limit:
+            break
+        n = min(chunk_size, limit - emitted)
+        for r in reqs:
+            while r.emitted < emitted + n and not r.done:
+                try:
+                    r.wait_tokens(emitted + n, timeout=1.0)
+                except TimeoutError:
+                    continue
+            if r.done and r.error is not None:
+                raise r.error
+        block = np.full((b, n), g.pad_token_id, np.int32)
+        for i, r in enumerate(reqs):
+            part = r.tokens[emitted:emitted + n]
+            block[i, :len(part)] = part
+        yield block
+        emitted += n
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -84,6 +189,8 @@ class Handler(BaseHTTPRequestHandler):
         if self.path == "/health":
             self._json(200, {"status": "ok",
                              "model": type(_STATE["model"]).__name__})
+        elif self.path == "/metrics":
+            self._json(200, _core().metrics_snapshot())
         else:
             self._json(404, {"error": "unknown path"})
 
@@ -93,6 +200,7 @@ class Handler(BaseHTTPRequestHandler):
             body = json.loads(self.rfile.read(length) or b"{}")
             ids = np.asarray(body["ids"], np.int32)
             g = _gen_config(body)
+            timeout_s = body.get("timeout_s", _STATE["request_timeout"])
         except Exception as e:
             self._json(400, {"error": f"bad request: {e!r}"})
             return
@@ -105,32 +213,25 @@ class Handler(BaseHTTPRequestHandler):
 
         try:
             if self.path == "/generate":
-                # the engine mutates shared state (donated pools, page
-                # reservations) — one request at a time
-                with _STATE["lock"]:
-                    if _speculatable(ids, g):
-                        eng = _spec_engine()
-                        toks = eng.generate(ids, g)
-                        extra = {"speculative": True,
-                                 "acceptance": eng.last_acceptance}
-                    else:
-                        toks = _engine().generate(ids, g)
-                        extra = {}
+                toks, extra = _generate(ids, g, timeout_s)
                 self._json(200, {"tokens": np.asarray(toks).tolist(),
                                  **extra})
             elif self.path == "/generate_stream":
-                with _STATE["lock"]:
-                    stream = _engine().stream(
-                        ids, g, chunk_size=int(body.get("chunk_size", 8)))
-                    self.send_response(200)
-                    self.send_header("Content-Type",
-                                     "application/x-ndjson")
-                    self.send_header("Transfer-Encoding", "chunked")
-                    self.end_headers()
-                    headers_sent = True
-                    for chunk in stream:
-                        send_chunk({"tokens": np.asarray(chunk).tolist()})
-                    self.wfile.write(b"0\r\n\r\n")
+                if g.num_beams > 1:
+                    self._json(400, {"error": "streaming supports "
+                                              "sampling/greedy only"})
+                    return
+                chunks = _stream_chunks(
+                    ids, g, chunk_size=int(body.get("chunk_size", 8)),
+                    timeout_s=timeout_s)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                headers_sent = True
+                for chunk in chunks:
+                    send_chunk({"tokens": np.asarray(chunk).tolist()})
+                self.wfile.write(b"0\r\n\r\n")
             else:
                 self._json(404, {"error": "unknown path"})
         except Exception as e:
@@ -142,7 +243,7 @@ class Handler(BaseHTTPRequestHandler):
                     send_chunk({"error": repr(e)[:400]})
                     self.wfile.write(b"0\r\n\r\n")
                 else:
-                    self._json(500, {"error": repr(e)[:400]})
+                    self._json(_error_code(e), {"error": repr(e)[:400]})
             except Exception:
                 pass
 
@@ -153,9 +254,22 @@ def main(argv=None):
                     help="save_pretrained directory (AutoModel-loadable)")
     ap.add_argument("--port", type=int, default=8800)
     ap.add_argument("--page_size", type=int, default=16)
+    ap.add_argument("--max_batch", type=int, default=8,
+                    help="continuous-batching slots (KV reservations)")
+    ap.add_argument("--max_queue", type=int, default=64,
+                    help="admission-control queue depth (beyond -> 429)")
+    ap.add_argument("--decode_chunk", type=int, default=4,
+                    help="fused decode steps per scheduler iteration")
+    ap.add_argument("--request_timeout", type=float, default=None,
+                    help="per-request deadline in seconds (beyond -> 504)")
+    ap.add_argument("--max_model_len", type=int, default=None,
+                    help="bound on prompt+generated length per request; "
+                         "sizes each slot's KV reservation (defaults to "
+                         "the model's max positions — set it lower to "
+                         "shrink the pool the decode step drags along)")
     ap.add_argument("--draft_dir", default=None,
                     help="optional draft model for speculative decoding "
-                         "of greedy bs1 requests")
+                         "of greedy requests")
     ap.add_argument("--num_draft_tokens", type=int, default=4)
     args = ap.parse_args(argv)
 
@@ -163,6 +277,11 @@ def main(argv=None):
 
     _STATE["model"] = AutoModel.from_pretrained(args.model_dir)
     _STATE["page_size"] = args.page_size
+    _STATE["max_batch"] = args.max_batch
+    _STATE["max_queue"] = args.max_queue
+    _STATE["decode_chunk"] = args.decode_chunk
+    _STATE["request_timeout"] = args.request_timeout
+    _STATE["max_model_len"] = args.max_model_len
     _STATE["draft_model"] = (AutoModel.from_pretrained(args.draft_dir)
                              if args.draft_dir else None)
     _STATE["num_draft_tokens"] = args.num_draft_tokens
