@@ -601,8 +601,14 @@ def _child_main():
     if p50_ms is not None:
         result["decode_p50_ms_per_token_bs1"] = p50_ms
         result["decode_p50_target_ms"] = DECODE_P50_TARGET_MS
-        result["decode_within_target"] = bool(
-            p50_ms <= DECODE_P50_TARGET_MS)
+        # pass/fail gates only mean something on the hardware the
+        # targets were recorded for: a CPU-fallback run reports its
+        # numbers but never a verdict against a TPU target
+        if on_tpu:
+            result["decode_within_target"] = bool(
+                p50_ms <= DECODE_P50_TARGET_MS)
+        else:
+            result["gate_skipped"] = "cpu-fallback"
         prev = _prev_decode_p50()
         if prev is not None:
             result["decode_p50_prev_round"] = prev
@@ -951,14 +957,83 @@ def _serving_bench(on_tpu: bool):
         "sequential_tokens_per_s": round(seq_tps, 1),
         "continuous_tokens_per_s": round(cont_tps, 1),
         "speedup": round(cont_tps / seq_tps, 2),
-        "ttft_p50_s": round(snap["ttft_s"]["p50"], 4),
-        "ttft_p99_s": round(snap["ttft_s"]["p99"], 4),
-        "itl_p50_s": round(snap["inter_token_latency_s"]["p50"], 5),
+        "ttft_p50_s": round(snap["ttft_s"]["p50_recent"], 4),
+        "ttft_p99_s": round(snap["ttft_s"]["p99_recent"], 4),
+        "itl_p50_s": round(snap["inter_token_latency_s"]["p50_recent"], 5),
         "mean_batch_occupancy": round(snap["occupancy"]["mean"], 3),
     }
 
 
+def _kernel_summary() -> str:
+    """Program/kernel inventory for the evidence bundle: every XLA
+    compilation this process performed (site, cache key, wall time)
+    plus the eager-op registry size."""
+    from paddle_infer_tpu.core.dispatch import _REGISTRY
+    from paddle_infer_tpu.observability import get_compile_log
+
+    log = get_compile_log()
+    lines = [f"registered eager ops: {len(_REGISTRY)}",
+             f"xla compilations this process: {log.count()}", ""]
+    for ev in log.events():
+        lines.append(f"{ev.wall_s * 1e3:9.1f} ms  {ev.site:18s} "
+                     f"{ev.key!r}")
+    return "\n".join(lines) + "\n"
+
+
+def _evidence_main(out_dir: str) -> int:
+    """``--evidence-dir DIR``: one-shot evidence bundle.  Serves a few
+    requests through a real EngineCore so the compile log, tracer ring,
+    and metrics hold live data, then captures device probe + compile
+    log + kernel summary + trace sample + metrics (JSON and Prometheus)
+    into ONE directory with a manifest."""
+    import jax
+
+    import paddle_infer_tpu as pit
+    from paddle_infer_tpu.inference import (GenerationConfig,
+                                            PagedGenerationEngine)
+    from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_infer_tpu.observability import capture_bundle
+    from paddle_infer_tpu.serving import EngineCore
+
+    platform = jax.devices()[0].platform
+    pit.seed(0)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=128, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    g = GenerationConfig(max_new_tokens=12)
+    rng = np.random.RandomState(0)
+    core = EngineCore(
+        PagedGenerationEngine(model, page_size=16, prompt_bucket=16),
+        max_batch=4, decode_chunk=4, max_model_len=64).start()
+    try:
+        reqs = []
+        for plen in (16, 16, 32):
+            prompt = rng.randint(0, cfg.vocab_size, (plen,)) \
+                .astype(np.int32)
+            reqs += core.submit(prompt, g)
+        for r in reqs:
+            r.result(timeout=600)
+        manifest = capture_bundle(
+            out_dir, core=core, kernel_summary=_kernel_summary(),
+            extra={"platform": platform,
+                   "requests_served": len(reqs),
+                   "coverage": [round(core.tracer.get(r.rid).coverage(), 4)
+                                for r in reqs if core.tracer.get(r.rid)]})
+    finally:
+        core.close()
+    print(json.dumps({"evidence_dir": os.path.abspath(out_dir),
+                      "files": sorted(manifest["files"]),
+                      "missing": manifest["missing"]}))
+    return 0
+
+
 if __name__ == "__main__":
+    if "--evidence-dir" in sys.argv:
+        sys.exit(_evidence_main(
+            sys.argv[sys.argv.index("--evidence-dir") + 1]))
     if "--child" in sys.argv or os.environ.get("PIT_BENCH_CHILD"):
         sys.exit(_child_main())
     sys.exit(_parent())

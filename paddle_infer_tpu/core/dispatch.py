@@ -113,7 +113,15 @@ def _get_jitted(op: OpDef, frozen_attrs):
     if fn is None:
         attrs = dict(frozen_attrs)
         impl = functools.partial(op.impl, **attrs) if attrs else op.impl
-        fn = jax.jit(impl) if op.jit else impl
+        if op.jit:
+            # observability: first execution per shape/dtype signature is
+            # an XLA compilation — logged with wall time so the
+            # recompile detector sees every eager-op compile
+            from ..observability.compilelog import instrument_jit
+
+            fn = instrument_jit(jax.jit(impl), "dispatch", key)
+        else:
+            fn = impl
         _JIT_CACHE[key] = fn
     return fn
 
@@ -439,7 +447,9 @@ def register_vjp_grad(name: str, cache: bool = True):
                 return full_grads
 
             if cache:
-                bwd = jax.jit(bwd_fn)
+                from ..observability.compilelog import instrument_jit
+
+                bwd = instrument_jit(jax.jit(bwd_fn), "dispatch-vjp", key)
                 _VJP_CACHE[key] = bwd
             else:
                 bwd = bwd_fn

@@ -19,6 +19,7 @@ reference predictor's shape-keyed TRT engine cache).
 """
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional
@@ -516,6 +517,8 @@ class PagedGenerationEngine(GenerationEngine):
         self.page_size = page_size
         self._requested_pages = num_pages
         self._pool = None
+        # per-program-key set of seen arg signatures (recompile detector)
+        self._compiled_sigs = {}
         # persistent per-layer device pools [P, h, page, d]; donated into
         # every compiled call and rebound from its outputs, so the arrays
         # genuinely stay put in HBM across requests
@@ -591,11 +594,32 @@ class PagedGenerationEngine(GenerationEngine):
         if fn is None:
             fn = builder()
             self._compiled[key] = fn
+        # observability: a first call with an unseen (shapes, dtypes)
+        # argument signature is an XLA compilation.  The signature spans
+        # only *args — params and pools are fixed per key (the pool is
+        # resized once up front; resizing drops the compiled cache's
+        # validity anyway), so the per-step cost is a few tuple builds.
+        from ..observability.compilelog import (get_compile_log,
+                                                signature_of)
+
+        sigs = self._compiled_sigs.setdefault(key, set())
+        sig = signature_of(args)
+        is_compile = sig not in sigs
         k_pages, v_pages = self._ensure_pages()
         args = jax.tree_util.tree_map(self._replicated, tuple(args))
         self._k_pages = self._v_pages = None
+        t0 = time.perf_counter() if is_compile else 0.0
         with _MeshContext(self._mesh):
             out = fn(self._params, *args, k_pages, v_pages)
+        if is_compile:
+            sigs.add(sig)
+            tag = str(key[0]) if isinstance(key, tuple) and key else \
+                str(key)
+            site = ("serving-decode" if tag in ("serve-step",)
+                    else "serving-prefill" if tag == "serve-prefill"
+                    else f"serving-{tag}")
+            get_compile_log().record(site, key, sig,
+                                     time.perf_counter() - t0)
         *rest, new_k, new_v = out
         self._k_pages, self._v_pages = new_k, new_v
         return rest

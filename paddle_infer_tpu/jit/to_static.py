@@ -13,6 +13,7 @@ strategies' role.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, Optional
 
 import jax
@@ -173,7 +174,8 @@ class StaticFunction:
                tuple(sorted((k, _sig_of(v)) for k, v in kwargs.items())),
                training)
         entry = self._cache.get(sig)
-        if entry is None:
+        cache_miss = entry is None
+        if cache_miss:
             entry = self._build(kwargs)
             self._cache[sig] = entry
         compiled, buffer_targets = entry
@@ -183,7 +185,18 @@ class StaticFunction:
         buffers = ({n: b._data for n, b in layer.named_buffers()}
                    if layer else {})
         key = prandom.next_key()
+        t0 = time.perf_counter() if cache_miss else 0.0
         out_arrays, update_arrays = compiled(params, buffers, key, arrays)
+        if cache_miss:
+            # observability: an executable-cache miss is one XLA trace +
+            # compile; the recompile detector keys it by function so a
+            # shape-unstable caller shows up as a compile storm
+            from ..observability.compilelog import get_compile_log
+
+            get_compile_log().record(
+                "to_static",
+                getattr(self._fn, "__qualname__", repr(self._fn)), sig,
+                time.perf_counter() - t0)
 
         if update_arrays and len(buffer_targets) == len(update_arrays):
             for t, arr in zip(buffer_targets, update_arrays):

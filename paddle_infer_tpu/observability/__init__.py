@@ -1,0 +1,48 @@
+"""Unified observability layer: request tracing, recompile detection,
+Prometheus exposition, and one-shot evidence capture.
+
+The serving engine made latency the product; this package makes latency
+*explainable*:
+
+  ``tracing``     span-based per-request traces (queue wait → prefill →
+                  each fused decode chunk → evict → detokenize) with a
+                  bounded ring of completed traces and Chrome-trace
+                  export mergeable with profiler/xplane captures.
+  ``compilelog``  process-wide XLA compilation log fed by every jit
+                  cache (eager dispatch, to_static, serving programs);
+                  turns "one decode executable, never recompiles" from
+                  a design comment into a monitored invariant.
+  ``prometheus``  text-exposition renderer + validator for the serving
+                  metrics snapshot (content-negotiated ``GET /metrics``
+                  in tools/serve.py).
+  ``evidence``    one-shot bundle capture (device probe, compile log,
+                  kernel summary, trace sample, metrics snapshot) —
+                  ``bench.py --evidence-dir``.
+
+Related work: the reference ships a full profiler stack
+(paddle/fluid/platform/profiler); "A Learned Performance Model for
+TPUs" (arxiv 2008.01040) grounds per-op cost attribution; Ragged Paged
+Attention (arxiv 2604.15464) treats recompile-avoidance as a serving
+invariant — measured here, not asserted.
+"""
+
+from .compilelog import (CompileLog, get_compile_log, instrument_jit,
+                         signature_of)
+from .evidence import capture_bundle
+from .prometheus import (family_names, render_prometheus,
+                         validate_exposition)
+from .tracing import Span, Trace, Tracer
+
+__all__ = [
+    "CompileLog",
+    "get_compile_log",
+    "instrument_jit",
+    "signature_of",
+    "Span",
+    "Trace",
+    "Tracer",
+    "render_prometheus",
+    "validate_exposition",
+    "family_names",
+    "capture_bundle",
+]

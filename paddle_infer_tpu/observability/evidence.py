@@ -1,0 +1,106 @@
+"""One-shot evidence bundle capture.
+
+Round-5 verdict: bench evidence arrives piecemeal (a JSON line here, an
+xplane dir there) and incomplete rounds leave holes.  This module
+writes everything the next TPU-alive round needs into ONE directory in
+one call — device probe, compile log, kernel summary, a serving trace
+sample (request spans + Chrome export), the metrics snapshot in both
+JSON and Prometheus text — plus a ``manifest.json`` naming every file,
+so "is the evidence complete" is a single-directory check.
+
+``bench.py --evidence-dir DIR`` is the CLI entry; the function is also
+callable from a live server for a production snapshot.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from .compilelog import get_compile_log
+from .prometheus import render_prometheus
+
+
+def _device_probe() -> dict:
+    probe = {"captured_at": time.time()}
+    try:
+        import jax
+
+        probe["jax_version"] = jax.__version__
+        devs = jax.devices()
+        probe["platform"] = devs[0].platform
+        probe["device_kind"] = getattr(devs[0], "device_kind", "")
+        probe["device_count"] = len(devs)
+        probe["devices"] = [str(d) for d in devs[:16]]
+    except Exception as e:
+        probe["error"] = repr(e)
+    return probe
+
+
+def capture_bundle(out_dir: str, *, core=None, snapshot: Optional[dict] = None,
+                   kernel_summary: Optional[str] = None,
+                   trace_limit: int = 8,
+                   extra: Optional[dict] = None) -> dict:
+    """Write the evidence bundle into ``out_dir`` and return the
+    manifest.  ``core`` (a ``serving.EngineCore``) supplies the metrics
+    snapshot and trace sample when given; ``snapshot`` overrides or
+    substitutes for it.  Every section is best-effort: a missing piece
+    is recorded in the manifest as absent, never raises."""
+    os.makedirs(out_dir, exist_ok=True)
+    manifest = {"captured_at": time.time(), "files": {}, "missing": []}
+
+    def write(name: str, payload, text: bool = False):
+        path = os.path.join(out_dir, name)
+        with open(path, "w") as f:
+            if text:
+                f.write(payload)
+            else:
+                json.dump(payload, f, indent=1, default=repr)
+        manifest["files"][name] = os.path.getsize(path)
+
+    write("device_probe.json", _device_probe())
+
+    log = get_compile_log()
+    write("compile_log.json", {
+        "summary": log.summary(),
+        "events": [e.to_dict() for e in log.events()]})
+
+    if snapshot is None and core is not None:
+        try:
+            snapshot = core.metrics_snapshot()
+        except Exception as e:
+            manifest["missing"].append(f"metrics: {e!r}")
+    if snapshot is not None:
+        write("metrics.json", snapshot)
+        try:
+            write("metrics.prom",
+                  render_prometheus(snapshot, log.summary()), text=True)
+        except Exception as e:
+            manifest["missing"].append(f"metrics.prom: {e!r}")
+    else:
+        manifest["missing"].append("metrics: no core or snapshot given")
+
+    tracer = getattr(core, "tracer", None)
+    if tracer is not None:
+        done = tracer.completed()[-trace_limit:]
+        write("traces.json", {
+            "summaries": tracer.summaries()[-trace_limit:],
+            "traces": [t.to_dict() for t in done]})
+        merged = {"traceEvents": []}
+        for t in done:
+            merged["traceEvents"].extend(t.to_chrome()["traceEvents"])
+        write("traces.chrome.json", merged)
+    else:
+        manifest["missing"].append("traces: no tracer available")
+
+    if kernel_summary is not None:
+        write("kernel_summary.txt", kernel_summary, text=True)
+    else:
+        manifest["missing"].append("kernel_summary: not captured")
+
+    if extra:
+        write("extra.json", extra)
+
+    write("manifest.json", manifest)
+    return manifest

@@ -1,0 +1,250 @@
+"""Prometheus text-exposition renderer for the serving metrics snapshot.
+
+``ServingMetrics.snapshot()`` stays the JSON source of truth (nested
+dicts, ``None`` for empty percentiles); this module flattens it into
+the Prometheus text format (version 0.0.4): one ``# HELP``/``# TYPE``
+header per family, one sample line per series, reservoir stats as a
+``stat`` label, per-site compile counts as a ``site`` label.  ``None``
+values are dropped rather than rendered as NaN so a fresh server
+scrapes clean.
+
+``tools/check_metrics.py`` validates the output (name/label syntax, no
+duplicate series) and cross-checks the family list against the metric
+catalog in docs/OBSERVABILITY.md — keep all three in sync.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+# snapshot series key -> (prometheus family, help text)
+SERIES_FAMILIES = {
+    "ttft_s": ("serving_ttft_seconds",
+               "Time to first token in seconds"),
+    "inter_token_latency_s": ("serving_inter_token_latency_seconds",
+                              "Per-token latency inside a fused decode "
+                              "chunk in seconds"),
+    "e2e_latency_s": ("serving_e2e_latency_seconds",
+                      "Request end-to-end latency in seconds"),
+    "decode_step_ms": ("serving_decode_step_milliseconds",
+                       "One fused decode chunk wall time in ms"),
+    "occupancy": ("serving_step_occupancy_ratio",
+                  "Active rows / max_batch per decode step"),
+}
+
+
+class _Writer:
+    def __init__(self):
+        self.lines: List[str] = []
+        self._seen_series = set()
+        self._seen_family = set()
+
+    def family(self, name: str, kind: str, help_text: str):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name {name!r}")
+        if name in self._seen_family:
+            return
+        self._seen_family.add(name)
+        self.lines.append(f"# HELP {name} {help_text}")
+        self.lines.append(f"# TYPE {name} {kind}")
+
+    def sample(self, name: str, value, labels: Optional[Dict] = None):
+        if value is None:
+            return
+        if isinstance(value, bool):
+            value = int(value)
+        lstr = ""
+        if labels:
+            parts = []
+            for k in sorted(labels):
+                if not _NAME_RE.match(k):
+                    raise ValueError(f"invalid label name {k!r}")
+                v = str(labels[k]).replace("\\", "\\\\") \
+                    .replace('"', '\\"').replace("\n", "\\n")
+                parts.append(f'{k}="{v}"')
+            lstr = "{" + ",".join(parts) + "}"
+        series = name + lstr
+        if series in self._seen_series:
+            raise ValueError(f"duplicate series {series}")
+        self._seen_series.add(series)
+        self.lines.append(f"{series} {float(value):g}")
+
+    def render(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def render_prometheus(snapshot: dict,
+                      compile_summary: Optional[dict] = None) -> str:
+    """Flatten one ``ServingMetrics.snapshot()`` (plus, optionally, a
+    ``CompileLog.summary()``) into Prometheus text exposition."""
+    w = _Writer()
+
+    w.family("serving_queue_depth", "gauge",
+             "Requests waiting in the admission queue")
+    w.sample("serving_queue_depth", snapshot.get("queue_depth", 0))
+    w.family("serving_active_requests", "gauge",
+             "Requests currently occupying a KV slot")
+    w.sample("serving_active_requests", snapshot.get("active", 0))
+    w.family("serving_max_batch", "gauge",
+             "Configured continuous-batching slots")
+    w.sample("serving_max_batch", snapshot.get("max_batch", 0))
+    w.family("serving_batch_occupancy", "gauge",
+             "active / max_batch at snapshot time")
+    w.sample("serving_batch_occupancy", snapshot.get("batch_occupancy", 0.0))
+
+    kv = snapshot.get("kv_pool") or {}
+    if kv:
+        w.family("serving_kv_pool_blocks", "gauge",
+                 "KV block pool usage by state")
+        w.sample("serving_kv_pool_blocks", kv.get("total_blocks"),
+                 {"state": "total"})
+        w.sample("serving_kv_pool_blocks", kv.get("used_blocks"),
+                 {"state": "used"})
+        w.sample("serving_kv_pool_blocks", kv.get("free_blocks"),
+                 {"state": "free"})
+        w.family("serving_kv_pool_occupancy", "gauge",
+                 "used_blocks / total_blocks")
+        w.sample("serving_kv_pool_occupancy", kv.get("occupancy"))
+
+    counters = snapshot.get("counters") or {}
+    for key in sorted(counters):
+        name = f"serving_{key}_total"
+        w.family(name, "counter", f"Lifetime count of {key} events")
+        w.sample(name, counters[key])
+
+    w.family("serving_tokens_per_second", "gauge",
+             "Sliding-window decode throughput")
+    w.sample("serving_tokens_per_second",
+             snapshot.get("tokens_per_second", 0.0))
+
+    for key, (family, help_text) in SERIES_FAMILIES.items():
+        series = snapshot.get(key)
+        if not isinstance(series, dict):
+            continue
+        w.family(family + "_count", "counter",
+                 f"Lifetime sample count for: {help_text}")
+        w.sample(family + "_count", series.get("count", 0))
+        w.family(family, "gauge",
+                 help_text + " (mean is lifetime; *_recent stats cover "
+                 "the tail reservoir window)")
+        for stat in ("mean", "p50_recent", "p99_recent", "max_recent"):
+            w.sample(family, series.get(stat), {"stat": stat})
+
+    if compile_summary:
+        w.family("compile_count_total", "counter",
+                 "XLA compilations observed since process start")
+        w.sample("compile_count_total",
+                 compile_summary.get("compile_count", 0))
+        by_site = compile_summary.get("compile_count_by_site") or {}
+        if by_site:
+            w.family("compile_count_by_site", "counter",
+                     "XLA compilations per jit cache site")
+            for site in sorted(by_site):
+                w.sample("compile_count_by_site", by_site[site],
+                         {"site": site})
+        w.family("recompile_count_total", "counter",
+                 "Signatures compiled more than once (blown caches)")
+        w.sample("recompile_count_total",
+                 compile_summary.get("recompile_count", 0))
+        w.family("recompile_storm", "gauge",
+                 "1 when any signature compiled more than once")
+        w.sample("recompile_storm",
+                 compile_summary.get("recompile_storm", False))
+        w.family("post_warmup_decode_compiles_total", "counter",
+                 "Decode-loop compilations after warmup (design "
+                 "invariant: must stay 0)")
+        w.sample("post_warmup_decode_compiles_total",
+                 compile_summary.get("post_warmup_decode_compiles", 0))
+        w.family("compile_wall_seconds_total", "counter",
+                 "Wall time spent in observed first-call compilations")
+        w.sample("compile_wall_seconds_total",
+                 compile_summary.get("compile_wall_s_total", 0.0))
+
+    return w.render()
+
+
+def validate_exposition(text: str) -> List[str]:
+    """Syntax check a text exposition; returns a list of problems
+    (empty = valid).  Used by tools/check_metrics.py and the tests —
+    kept here so the renderer and its validator evolve together."""
+    problems = []
+    seen_series = set()
+    typed = set()
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s+(\S+)(\s+\d+)?$")
+    label_re = re.compile(r'^([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"$')
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in (
+                    "counter", "gauge", "histogram", "summary", "untyped"):
+                problems.append(f"line {i}: bad TYPE line: {line!r}")
+            else:
+                typed.add(parts[2])
+            continue
+        if line.startswith("#"):
+            problems.append(f"line {i}: unknown comment {line!r}")
+            continue
+        m = sample_re.match(line)
+        if m is None:
+            problems.append(f"line {i}: unparseable sample {line!r}")
+            continue
+        name, _, labels, value = m.group(1), m.group(2), m.group(3), \
+            m.group(4)
+        base = name
+        for suffix in ("_count", "_sum", "_bucket"):
+            if name.endswith(suffix) and name[:-len(suffix)] in typed:
+                base = name[:-len(suffix)]
+        if base not in typed and name not in typed:
+            problems.append(f"line {i}: sample {name} has no TYPE")
+        if labels:
+            for pair in _split_labels(labels):
+                if not label_re.match(pair):
+                    problems.append(f"line {i}: bad label {pair!r}")
+        key = (name, labels or "")
+        if key in seen_series:
+            problems.append(f"line {i}: duplicate series {name}{{"
+                            f"{labels or ''}}}")
+        seen_series.add(key)
+        try:
+            float(value)
+        except ValueError:
+            if value not in ("NaN", "+Inf", "-Inf"):
+                problems.append(f"line {i}: bad value {value!r}")
+    return problems
+
+
+def _split_labels(body: str) -> List[str]:
+    """Split 'a="x",b="y"' respecting escaped quotes."""
+    out, cur, in_q, esc = [], [], False, False
+    for ch in body:
+        if esc:
+            cur.append(ch)
+            esc = False
+        elif ch == "\\":
+            cur.append(ch)
+            esc = True
+        elif ch == '"':
+            cur.append(ch)
+            in_q = not in_q
+        elif ch == "," and not in_q:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def family_names(text: str) -> List[str]:
+    """Metric family names declared by TYPE lines (catalog cross-check
+    source for tools/check_metrics.py)."""
+    return [ln.split()[2] for ln in text.splitlines()
+            if ln.startswith("# TYPE ")]

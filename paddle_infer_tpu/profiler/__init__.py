@@ -213,7 +213,13 @@ class Profiler:
     def _export_chrome(self, path):
         with _events_lock:
             events = list(_events)
-        trace = {"traceEvents": [
+        # thread_name metadata rows label each host thread so a merge
+        # with serving-tracer exports (observability.tracing.Trace
+        # .to_chrome emits the same row shape) stays navigable
+        meta = [{"name": "thread_name", "ph": "M", "pid": 0, "tid": tid,
+                 "args": {"name": f"host thread {tid}"}}
+                for tid in sorted({e.tid for e in events})]
+        trace = {"traceEvents": meta + [
             {"name": e.name, "ph": "X", "ts": e.start / 1e3,
              "dur": (e.end - e.start) / 1e3, "pid": 0, "tid": e.tid,
              "args": e.args} for e in events]}

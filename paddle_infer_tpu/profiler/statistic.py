@@ -174,6 +174,26 @@ def device_op_stats(trace_dir: str) -> Optional[Dict[str, StatItem]]:
     return aggregate(pairs) if pairs else None
 
 
+def chrome_trace_stats(events: List[dict]) -> Dict[str, StatItem]:
+    """Aggregate the ``ph: "X"`` events of an in-memory Chrome trace
+    (``{"traceEvents": [...]}["traceEvents"]``) into per-name timing
+    items.  Works on profiler exports AND serving-tracer exports
+    (``observability.tracing.Trace.to_chrome``) — the shared event shape
+    is the contract that makes merged captures analyzable with one
+    tool.  Durations in the trace are microseconds; items are ns like
+    every other table here."""
+    pairs = []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        dur = float(ev.get("dur") or 0.0) * 1e3     # us -> ns
+        if not name or dur <= 0:
+            continue
+        pairs.append((name, dur))
+    return aggregate(pairs)
+
+
 def memory_stats() -> Optional[dict]:
     """Device memory table source (reference Memory Summary; here the
     runtime allocator is XLA's BFC whose counters ride on the device)."""

@@ -41,10 +41,15 @@ import numpy as np
 
 from ..inference.generation import (GenerationConfig, PagedGenerationEngine,
                                     _round_up)
+from ..observability import Tracer, get_compile_log
 from .metrics import ServingMetrics
 from .programs import build_decode, build_prefill
 from .request import (DeadlineExceededError, QueueFullError, RejectedError,
                       Request, RequestQueue, RequestState)
+
+_TRACE_STATE = {RequestState.DONE: "done", RequestState.FAILED: "failed",
+                RequestState.CANCELLED: "cancelled",
+                RequestState.REJECTED: "rejected"}
 
 
 class EngineCore:
@@ -60,12 +65,19 @@ class EngineCore:
                  max_queue: int = 64, decode_chunk: int = 4,
                  default_timeout_s: Optional[float] = None,
                  max_model_len: Optional[int] = None,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 tracer: Optional[Tracer] = None):
         self._engine = engine
         self._max_batch = int(max_batch)
         self._decode_chunk = max(1, int(decode_chunk))
         self._default_timeout = default_timeout_s
         self._metrics = metrics or ServingMetrics()
+        # span-based request tracing: every request's wall time is
+        # attributed edge-to-edge (queue_wait → prefill → decode chunks
+        # → evict); completed traces live in the tracer's ring buffer
+        # and serve.py exposes them as GET /trace/<rid>
+        self.tracer = tracer or Tracer()
+        self._decode_warm = False
         self._queue = RequestQueue(max_depth=max_queue)
 
         page = engine.page_size
@@ -115,9 +127,29 @@ class EngineCore:
         return sum(s is not None for s in self._slots)
 
     def metrics_snapshot(self) -> dict:
-        return self._metrics.snapshot(queue_depth=len(self._queue),
-                                      active=self.active_count,
-                                      max_batch=self._max_batch)
+        total = self._pool.num_blocks
+        free = self._pool.free_blocks
+        return self._metrics.snapshot(
+            queue_depth=len(self._queue),
+            active=self.active_count,
+            max_batch=self._max_batch,
+            kv_pool={"total_blocks": int(total),
+                     "free_blocks": int(free),
+                     "used_blocks": int(total - free),
+                     "occupancy": (total - free) / total if total else 0.0})
+
+    # ------------------------------------------------------- trace hooks
+    def _trace_end(self, req: Request, state: RequestState):
+        self.tracer.end(req.rid, _TRACE_STATE.get(state, state.value))
+
+    def _trace_queue_drop(self, req: Request, state: RequestState,
+                          reason: str):
+        """A request that dies in the queue still gets a full trace:
+        one queue_wait span covering its whole life."""
+        now = time.monotonic()
+        self.tracer.add_span(req.rid, "queue_wait", req.arrival, now,
+                             outcome=reason)
+        self._trace_end(req, state)
 
     def submit(self, input_ids, config: GenerationConfig = None,
                attention_mask=None,
@@ -158,6 +190,10 @@ class EngineCore:
             self._metrics.on_rejected_queue_full(len(reqs))
             raise
         self._metrics.on_submitted(len(reqs))
+        for req in reqs:
+            self.tracer.begin(req.rid, kind="batch",
+                              prompt_len=int(req.prompt.size),
+                              max_new_tokens=g.max_new_tokens)
         return reqs
 
     def submit_exclusive(self, fn,
@@ -176,6 +212,7 @@ class EngineCore:
             self._metrics.on_rejected_queue_full()
             raise
         self._metrics.on_submitted()
+        self.tracer.begin(req.rid, kind="exclusive")
         return req
 
     # ------------------------------------------------------ the step loop
@@ -196,6 +233,8 @@ class EngineCore:
             r._finish(RequestState.CANCELLED, DeadlineExceededError(
                 f"request {r.rid} expired after "
                 f"{now - r.arrival:.3f}s in queue"))
+            self._trace_queue_drop(r, RequestState.CANCELLED,
+                                   "deadline-in-queue")
             progressed = True
 
         for s in list(self._slots):
@@ -223,6 +262,8 @@ class EngineCore:
                 self._metrics.on_deadline()
                 req._finish(RequestState.CANCELLED, DeadlineExceededError(
                     f"request {req.rid} expired in queue"))
+                self._trace_queue_drop(req, RequestState.CANCELLED,
+                                       "deadline-in-queue")
                 continue
             self._admit(req, self._slots.index(None))
             progressed = True
@@ -262,6 +303,8 @@ class EngineCore:
         return samp
 
     def _admit(self, req: Request, sid: int):
+        admit_t = time.monotonic()
+        self.tracer.add_span(req.rid, "queue_wait", req.arrival, admit_t)
         g = req.config
         length = int(req.prompt.size)
         plen = self._plen(length)
@@ -289,6 +332,10 @@ class EngineCore:
             self._pool.free(sid)
             self._metrics.on_failed()
             req._finish(RequestState.FAILED, e)
+            self.tracer.add_span(req.rid, "prefill", admit_t,
+                                 time.monotonic(), slot=sid, plen=plen,
+                                 outcome="failed")
+            self._trace_end(req, RequestState.FAILED)
             if eng.kv_state_lost():
                 self._fail_all(e)
             return
@@ -298,16 +345,24 @@ class EngineCore:
         self._metrics.on_prefill(time.monotonic() - req.arrival)
         req._emit(np.asarray([tok], np.int32))
         self._metrics.on_tokens(1)
+        # the prefill span runs edge-to-edge (admission bookkeeping +
+        # compiled prefill + first-token emit) so no scheduler time
+        # between queue_wait and the first decode chunk is unattributed
+        span_end = time.monotonic()
+        self.tracer.add_span(req.rid, "prefill", admit_t, span_end,
+                             slot=sid, plen=plen)
         if finished or g.max_new_tokens <= 1:
             self._pool.free(sid)
             req._finish(RequestState.DONE)
             self._metrics.on_completed(time.monotonic() - req.arrival)
+            self._trace_end(req, RequestState.DONE)
             return
         self._slots[sid] = {"req": req, "sid": sid, "g": g,
                             "length": length, "plen": plen,
                             "emitted": 1, "last_tok": tok,
                             "last_emit": time.monotonic(),
-                            "table": table, "key": key}
+                            "table": table, "key": key,
+                            "span_end": span_end}
 
     # ------------------------------------------------------------ decode
     def _decode_step(self):
@@ -352,6 +407,12 @@ class EngineCore:
             self._fail_all(e)
             return
         wall = time.monotonic() - t0
+        if not self._decode_warm:
+            # first fused chunk on this core's decode key: everything
+            # after this is steady state — any further compile on the
+            # serving-decode site is a recompile and logs a warning
+            get_compile_log().mark_warm("serving-decode", dkey)
+            self._decode_warm = True
         toks = np.asarray(toks)
         fin_out = np.asarray(fin_out)
         nvalid = np.asarray(nvalid)
@@ -369,6 +430,14 @@ class EngineCore:
                 s["emitted"] += n
                 s["last_emit"] = now
                 emitted_total += n
+            # one decode span per active row per chunk, stitched from
+            # the row's previous span end so inter-chunk scheduler time
+            # is attributed, not lost
+            self.tracer.add_span(s["req"].rid, "decode",
+                                 s.get("span_end", t0), now,
+                                 step=self._step_idx, chunk_steps=S,
+                                 tokens=n)
+            s["span_end"] = now
             if bool(fin_out[i]) or s["emitted"] >= s["g"].max_new_tokens:
                 self._evict(s, RequestState.DONE)
                 evicted.append(s["req"].rid)
@@ -387,6 +456,11 @@ class EngineCore:
         self._pool.free(slot["sid"])
         req = slot["req"]
         req._finish(state, err)
+        now = time.monotonic()
+        self.tracer.add_span(req.rid, "evict", slot.get("span_end", now),
+                             now,
+                             outcome=_TRACE_STATE.get(state, state.value))
+        self._trace_end(req, state)
         if state == RequestState.DONE:
             self._metrics.on_completed(time.monotonic() - req.arrival)
         elif state == RequestState.FAILED:
@@ -406,15 +480,25 @@ class EngineCore:
             self._metrics.on_deadline()
             req._finish(RequestState.CANCELLED, DeadlineExceededError(
                 f"request {req.rid} expired in queue"))
+            self._trace_queue_drop(req, RequestState.CANCELLED,
+                                   "deadline-in-queue")
             return
+        start = time.monotonic()
+        self.tracer.add_span(req.rid, "queue_wait", req.arrival, start)
         req._mark_active()
         try:
             req.value = req.exclusive_fn()
             req._finish(RequestState.DONE)
             self._metrics.on_completed(time.monotonic() - req.arrival)
+            self.tracer.add_span(req.rid, "exclusive", start,
+                                 time.monotonic())
+            self._trace_end(req, RequestState.DONE)
         except Exception as e:
             self._metrics.on_failed()
             req._finish(RequestState.FAILED, e)
+            self.tracer.add_span(req.rid, "exclusive", start,
+                                 time.monotonic(), outcome="failed")
+            self._trace_end(req, RequestState.FAILED)
 
     # ---------------------------------------------------- thread control
     def start(self) -> "EngineCore":
@@ -450,6 +534,8 @@ class EngineCore:
         for r in self._queue.drain():
             r._finish(RequestState.REJECTED,
                       RejectedError("serving engine closed"))
+            self._trace_queue_drop(r, RequestState.REJECTED,
+                                   "engine-closed")
         for s in list(self._slots):
             if s is not None:
                 self._evict(s, RequestState.CANCELLED,

@@ -33,7 +33,15 @@ def _percentile(samples, q: float) -> Optional[float]:
 
 
 class _Series:
-    """Bounded sample reservoir (keeps the most recent samples)."""
+    """Bounded sample reservoir (keeps the most recent samples).
+
+    Two windows coexist in one summary and dashboards must not mix them
+    up: ``count``/``mean`` are LIFETIME aggregates over every sample
+    ever added, while the percentiles/max are computed over only the
+    most recent ``window`` samples (≤ ``_RESERVOIR``) still in the
+    reservoir — hence the explicit ``*_recent`` key names.  A p99 that
+    looks great while the lifetime mean is bad means the bad tail has
+    already been evicted from the reservoir."""
 
     def __init__(self, maxlen: int = _RESERVOIR):
         self._d: deque = deque(maxlen=maxlen)
@@ -48,11 +56,12 @@ class _Series:
     def summary(self) -> Dict[str, Optional[float]]:
         d = list(self._d)
         return {
-            "count": self.count,
+            "count": self.count,                      # lifetime
             "mean": (self.total / self.count) if self.count else None,
-            "p50": _percentile(d, 0.50),
-            "p99": _percentile(d, 0.99),
-            "max": max(d) if d else None,
+            "window": len(d),        # samples behind the *_recent stats
+            "p50_recent": _percentile(d, 0.50),
+            "p99_recent": _percentile(d, 0.99),
+            "max_recent": max(d) if d else None,
         }
 
 
@@ -143,10 +152,16 @@ class ServingMetrics:
             return sum(n for _, n in self._emits) / span
 
     def snapshot(self, queue_depth: int = 0, active: int = 0,
-                 max_batch: int = 0) -> Dict:
+                 max_batch: int = 0,
+                 kv_pool: Optional[Dict] = None) -> Dict:
+        """Render everything to a plain dict (the ``GET /metrics`` JSON
+        body).  Latency series carry lifetime ``count``/``mean`` plus
+        reservoir-window ``p50_recent``/``p99_recent``/``max_recent``
+        (see ``_Series``).  ``kv_pool`` is the block-pool occupancy
+        gauge set supplied by ``EngineCore`` (total/used/free blocks)."""
         tps = self.tokens_per_second()
         with self._lock:
-            return {
+            out = {
                 "queue_depth": queue_depth,
                 "active": active,
                 "max_batch": max_batch,
@@ -169,3 +184,16 @@ class ServingMetrics:
                 "decode_step_ms": self.step_ms.summary(),
                 "occupancy": self.occupancy.summary(),
             }
+            if kv_pool is not None:
+                out["kv_pool"] = dict(kv_pool)
+            return out
+
+    def to_prometheus(self, snapshot: Optional[Dict] = None,
+                      compile_summary: Optional[Dict] = None) -> str:
+        """Prometheus text exposition of a snapshot (taken fresh when
+        not given).  The renderer lives in ``observability.prometheus``;
+        this is the convenience entry the HTTP layer calls."""
+        from ..observability.prometheus import render_prometheus
+
+        return render_prometheus(snapshot or self.snapshot(),
+                                 compile_summary)

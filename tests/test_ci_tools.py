@@ -65,6 +65,30 @@ def test_merge_profiles(tmp_path):
     assert len({e["pid"] for e in xs}) == 2     # distinct row groups
 
 
+def test_check_metrics_passes():
+    """The Prometheus exposition must validate and stay in sync with
+    the docs/OBSERVABILITY.md metric catalog."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "check_metrics.py")],
+        capture_output=True, text=True, env=_env(), timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr[-800:]
+    assert "metrics exposition OK" in r.stdout
+
+
+def test_check_metrics_detects_stale_docs(tmp_path):
+    """A catalog entry the renderer doesn't emit (or a family the docs
+    don't list) must fail the check."""
+    import check_metrics
+
+    docs = tmp_path / "OBS.md"
+    docs.write_text("| `serving_queue_depth` | gauge | requests | q |\n"
+                    "| `made_up_family` | gauge | x | stale |\n")
+    problems, _ = check_metrics.run_checks(str(docs))
+    assert any("made_up_family" in p and "not emitted" in p
+               for p in problems)
+    assert any("missing from the catalog" in p for p in problems)
+
+
 def test_bench_last_json_salvage():
     """bench.py parent salvage: _last_json must return the LAST complete
     metric line (preliminary headline lines count when nothing later

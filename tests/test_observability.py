@@ -1,0 +1,262 @@
+"""Unified observability layer (paddle_infer_tpu/observability/):
+span tracer, recompile detector, Prometheus renderer, evidence
+bundle.  Pure-host tests — no model, no device."""
+import json
+import logging
+import time
+
+import numpy as np
+import pytest
+
+from paddle_infer_tpu.observability import (Span, Trace, Tracer,
+                                            capture_bundle, family_names,
+                                            render_prometheus,
+                                            signature_of,
+                                            validate_exposition)
+from paddle_infer_tpu.observability.compilelog import (CompileLog,
+                                                       instrument_jit)
+from paddle_infer_tpu.serving.metrics import ServingMetrics
+
+
+# ------------------------------------------------------------------ tracer
+def test_span_nesting_and_ordering():
+    tr = Tracer()
+    tr.begin(1, kind="test")
+    with tr.span(1, "outer"):
+        with tr.span(1, "inner_a"):
+            pass
+        with tr.span(1, "inner_b"):
+            pass
+    tr.end(1)
+    spans = tr.get(1).ordered()
+    names = [s.name for s in spans]
+    assert names == ["outer", "inner_a", "inner_b"]
+    outer, a, b = spans
+    assert outer.depth == 0 and outer.parent is None
+    assert a.depth == 1 and a.parent == outer.sid
+    assert b.depth == 1 and b.parent == outer.sid
+    assert a.start <= b.start          # ordering preserved
+    assert all(s.end is not None for s in spans)
+
+
+def test_tracer_ring_eviction():
+    tr = Tracer(ring_size=3)
+    for rid in range(5):
+        tr.begin(rid)
+        tr.add_span(rid, "w", 0.0, 1.0)
+        tr.end(rid)
+    assert tr.live_count() == 0
+    done = [t.rid for t in tr.completed()]
+    assert done == [2, 3, 4]           # oldest two evicted
+    assert tr.get(0) is None and tr.get(4) is not None
+
+
+def test_add_span_on_completed_trace():
+    """The HTTP layer appends detokenize after the engine finished."""
+    tr = Tracer()
+    tr.begin(7)
+    tr.end(7, "done")
+    assert tr.add_span(7, "detokenize", 1.0, 2.0) is not None
+    assert "detokenize" in [s.name for s in tr.get(7).spans]
+    assert tr.add_span(999, "x", 0, 1) is None     # unknown rid
+
+
+def test_coverage_interval_union():
+    t = Trace(1)
+    t.begin = 0.0
+    # overlapping spans must not double count; gap 8..9 uncovered
+    t.add(Span("a", 0.0, 5.0))
+    t.add(Span("b", 4.0, 8.0))
+    t.add(Span("c", 9.0, 10.0))
+    t.add(Span("nested", 0.0, 10.0, parent=1, depth=1))  # ignored
+    t.finish = 10.0
+    assert t.coverage() == pytest.approx(0.9)
+    assert t.duration() == pytest.approx(10.0)
+
+
+def test_chrome_export_round_trip():
+    from paddle_infer_tpu.profiler.statistic import chrome_trace_stats
+
+    tr = Tracer()
+    tr.begin(42, kind="batch")
+    tr.add_span(42, "queue_wait", 1.0, 1.5)
+    tr.add_span(42, "decode", 1.5, 1.75, tokens=4)
+    tr.end(42)
+    chrome = tr.get(42).to_chrome()
+    blob = json.loads(json.dumps(chrome))          # JSON round-trip
+    evs = blob["traceEvents"]
+    meta = [e for e in evs if e["ph"] == "M"]
+    assert meta and meta[0]["args"]["name"] == "request 42"
+    xs = {e["name"]: e for e in evs if e["ph"] == "X"}
+    assert xs["queue_wait"]["dur"] == pytest.approx(0.5e6)
+    assert xs["decode"]["args"]["tokens"] == 4
+    assert all(e["tid"] == 42 for e in evs)
+    # the profiler-side aggregator parses the same shape
+    stats = chrome_trace_stats(evs)
+    assert stats["decode"].call == 1
+    assert stats["decode"].total_ns == pytest.approx(0.25e9)
+
+
+def test_trace_summaries_shape():
+    tr = Tracer()
+    tr.begin(5, kind="batch", prompt_len=8)
+    tr.add_span(5, "queue_wait", time.monotonic(), time.monotonic())
+    tr.end(5, "done")
+    (s,) = tr.summaries()
+    assert s["request_id"] == 5 and s["state"] == "done"
+    assert s["meta"]["prompt_len"] == 8 and s["spans"] == 1
+
+
+# -------------------------------------------------------- recompile detector
+def test_signature_of_discriminates_shapes():
+    a = np.zeros((2, 3), np.float32)
+    b = np.zeros((2, 4), np.float32)
+    assert signature_of((a,)) != signature_of((b,))
+    assert signature_of((a,)) == signature_of((np.ones((2, 3), np.float32),))
+    assert signature_of((a.astype(np.int32),)) != signature_of((a,))
+    # dicts order-insensitive, scalars by value, None passes through
+    assert signature_of(({"y": 1, "x": a}, None)) == \
+        signature_of(({"x": a, "y": 1}, None))
+
+
+def test_compile_log_counts_and_warmup(caplog):
+    log = CompileLog()
+    key = ("serve-step", 4)
+    log.record("serving-decode", key, ("sig1",), 0.1)   # warmup compile
+    assert log.compile_count == 1
+    assert log.post_warmup_decode_compiles == 0
+    assert not log.recompile_storm
+    log.mark_warm("serving-decode", key)
+    assert log.is_warm("serving-decode", key)
+    with caplog.at_level(logging.WARNING,
+                         logger="paddle_infer_tpu.observability"):
+        log.record("serving-decode", key, ("sig2",), 0.2)
+    assert log.post_warmup_decode_compiles == 1
+    assert log.post_warmup_compiles == 1
+    assert any("recompile after warmup" in r.message for r in caplog.records)
+    # same signature again -> recompile storm
+    log.record("serving-decode", key, ("sig1",), 0.1)
+    assert log.recompile_storm
+    s = log.summary()
+    assert s["compile_count"] == 3
+    assert s["compile_count_by_site"] == {"serving-decode": 3}
+    assert s["recompile_count"] == 1
+    assert s["post_warmup_decode_compiles"] == 2
+    assert s["compile_wall_s_total"] == pytest.approx(0.4)
+    # warm marks are per (site, key): another core's key is untouched
+    assert not log.is_warm("serving-decode", ("serve-step", 8))
+    log.reset()
+    assert log.compile_count == 0 and not log.is_warm("serving-decode", key)
+
+
+def test_instrument_jit_times_first_calls_only():
+    log = CompileLog()
+    calls = []
+
+    def fn(x):
+        calls.append(x.shape)
+        return x
+
+    import paddle_infer_tpu.observability.compilelog as cl
+
+    orig = cl._LOG
+    cl._LOG = log
+    try:
+        wrapped = instrument_jit(fn, "dispatch", "add")
+        wrapped(np.zeros((2,)))
+        wrapped(np.zeros((2,)))          # same signature: not recorded
+        wrapped(np.zeros((3,)))          # new signature: recorded
+    finally:
+        cl._LOG = orig
+    assert len(calls) == 3               # the fn always runs
+    assert log.compile_count == 2
+    assert [e.site for e in log.events()] == ["dispatch", "dispatch"]
+
+
+# -------------------------------------------------------------- prometheus
+def _fabricated_snapshot():
+    m = ServingMetrics()
+    m.on_submitted(2)
+    m.on_prefill(0.05)
+    m.on_tokens(4, itl_s=0.01)
+    m.on_step(2.5, active=1, max_batch=4)
+    m.on_completed(0.3)
+    return m.snapshot(queue_depth=1, active=1, max_batch=4,
+                      kv_pool={"total_blocks": 16, "used_blocks": 4,
+                               "free_blocks": 12, "occupancy": 0.25})
+
+
+def test_render_prometheus_valid_and_complete():
+    snap = _fabricated_snapshot()
+    text = render_prometheus(snap, {
+        "compile_count": 3, "compile_count_by_site": {"serving-decode": 1},
+        "recompile_count": 0, "recompile_storm": False,
+        "post_warmup_compiles": 0, "post_warmup_decode_compiles": 0,
+        "compile_wall_s_total": 1.25})
+    assert validate_exposition(text) == []
+    fams = family_names(text)
+    assert "serving_ttft_seconds" in fams
+    assert "serving_kv_pool_blocks" in fams
+    assert "post_warmup_decode_compiles_total" in fams
+    assert 'serving_ttft_seconds{stat="p50_recent"}' in text
+    assert 'compile_count_by_site{site="serving-decode"} 1' in text
+    assert "serving_submitted_total 2" in text
+
+
+def test_render_drops_none_values():
+    """A fresh server (no samples yet) must still scrape clean — None
+    percentiles are dropped, not rendered as NaN."""
+    text = render_prometheus(ServingMetrics().snapshot())
+    assert validate_exposition(text) == []
+    assert "None" not in text and "nan" not in text.lower()
+
+
+def test_validate_exposition_catches_garbage():
+    assert validate_exposition("# TYPE foo banana\nfoo 1\n")
+    assert validate_exposition("foo 1\n")                  # no TYPE
+    assert validate_exposition(
+        "# TYPE foo gauge\nfoo 1\nfoo 2\n")                # duplicate
+    assert validate_exposition(
+        "# TYPE foo gauge\nfoo{bad-label=\"x\"} 1\n")      # label syntax
+    assert validate_exposition(
+        "# TYPE foo gauge\nfoo notanumber\n")              # value
+
+
+def test_metrics_to_prometheus_convenience():
+    m = ServingMetrics()
+    m.on_submitted()
+    assert "serving_submitted_total 1" in m.to_prometheus()
+
+
+# ---------------------------------------------------------------- evidence
+def test_capture_bundle_writes_manifest(tmp_path):
+    tracer = Tracer()
+    tracer.begin(1, kind="batch")
+    tracer.add_span(1, "queue_wait", 0.0, 0.5)
+    tracer.end(1)
+
+    class FakeCore:
+        def __init__(self):
+            self.tracer = tracer
+
+        def metrics_snapshot(self):
+            return _fabricated_snapshot()
+
+    out = tmp_path / "bundle"
+    manifest = capture_bundle(str(out), core=FakeCore(),
+                              kernel_summary="kernels: none\n",
+                              extra={"note": "test"})
+    for name in ("manifest.json", "device_probe.json", "compile_log.json",
+                 "metrics.json", "metrics.prom", "traces.json",
+                 "traces.chrome.json", "kernel_summary.txt", "extra.json"):
+        assert (out / name).exists(), name
+        assert name in manifest["files"]
+    assert manifest["missing"] == []
+    with open(out / "traces.json") as f:
+        traces = json.load(f)
+    assert traces["traces"][0]["request_id"] == 1
+    assert validate_exposition((out / "metrics.prom").read_text()) == []
+    # no core at all: capture still succeeds, holes are recorded
+    m2 = capture_bundle(str(tmp_path / "b2"))
+    assert any("metrics" in miss for miss in m2["missing"])
+    assert any("traces" in miss for miss in m2["missing"])

@@ -100,8 +100,11 @@ def test_stream_endpoint_chunks_concatenate(server):
     with _post(url, "/generate_stream",
                {"ids": ids.tolist(), "max_new_tokens": 7,
                 "chunk_size": 3}) as r:
-        chunks = [np.asarray(json.loads(line)["tokens"])
-                  for line in r.read().decode().strip().splitlines()]
+        lines = [json.loads(line)
+                 for line in r.read().decode().strip().splitlines()]
+    # first line is the request-id preamble, the rest are token chunks
+    assert lines[0]["request_ids"] and "tokens" not in lines[0]
+    chunks = [np.asarray(line["tokens"]) for line in lines[1:]]
     assert len(chunks) >= 2            # prefill token + >=1 decode chunk
     np.testing.assert_array_equal(np.concatenate(chunks, axis=1), want)
 
@@ -130,6 +133,64 @@ def test_metrics_endpoint(server):
     assert snap["ttft_s"]["count"] >= 1
     assert "tokens_per_second" in snap and "occupancy" in snap
     assert snap["max_batch"] >= 1
+
+
+def test_trace_endpoint_covers_request(server):
+    """A served request yields a retrievable span trace whose top-level
+    spans cover >=95% of its end-to-end wall time (the acceptance
+    metric), plus Chrome export and ring summaries."""
+    url, _ = server
+    ids = np.random.RandomState(3).randint(0, 96, (1, 8)).astype(np.int32)
+    with _post(url, "/generate", {"ids": ids.tolist(),
+                                  "max_new_tokens": 6}) as r:
+        body = json.load(r)
+    rids = body["request_ids"]
+    assert len(rids) == 1
+    with urllib.request.urlopen(f"{url}/trace/{rids[0]}", timeout=30) as r:
+        tr = json.load(r)
+    assert tr["request_id"] == rids[0]
+    assert tr["state"] == "done"
+    names = [s["name"] for s in tr["spans"]]
+    assert "queue_wait" in names and "prefill" in names
+    assert "decode" in names and "evict" in names
+    assert "detokenize" in names       # appended by the HTTP layer
+    assert tr["coverage"] >= 0.95
+    with urllib.request.urlopen(f"{url}/trace/{rids[0]}?format=chrome",
+                                timeout=30) as r:
+        chrome = json.load(r)
+    evs = chrome["traceEvents"]
+    assert any(e.get("ph") == "M" for e in evs)       # thread_name meta
+    assert any(e.get("ph") == "X" and e.get("dur", 0) >= 0 for e in evs)
+    with urllib.request.urlopen(url + "/traces", timeout=30) as r:
+        summaries = json.load(r)["traces"]
+    assert any(s["request_id"] == rids[0] for s in summaries)
+    # unknown rid -> 404
+    try:
+        urllib.request.urlopen(url + "/trace/999999", timeout=30)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_metrics_content_negotiation(server):
+    """Accept: text/plain renders Prometheus 0.0.4 exposition; the JSON
+    default gains kv_pool gauges and the compile-log section."""
+    url, _ = server
+    req = urllib.request.Request(
+        url + "/metrics", headers={"Accept": "text/plain"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        ctype = r.headers.get("Content-Type", "")
+        text = r.read().decode()
+    assert "text/plain" in ctype
+    assert "# TYPE serving_queue_depth gauge" in text
+    assert 'serving_kv_pool_blocks{state="total"}' in text
+    assert "# TYPE compile_count_total counter" in text
+    from paddle_infer_tpu.observability import validate_exposition
+    assert validate_exposition(text) == []
+    with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+        snap = json.load(r)
+    assert "kv_pool" in snap and snap["kv_pool"]["total_blocks"] > 0
+    assert "compile" in snap and snap["compile"]["compile_count"] >= 1
 
 
 def test_concurrent_posts_share_the_batch(server):
@@ -167,7 +228,7 @@ def test_concurrent_posts_share_the_batch(server):
     with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
         snap = json.load(r)
     assert snap["counters"]["completed"] >= 4
-    assert snap["occupancy"]["max"] is not None
+    assert snap["occupancy"]["max_recent"] is not None
 
 
 def test_queue_full_maps_to_429(tmp_path):

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Metrics-exposition CI check.
+
+Three sync points must agree or dashboards silently break:
+
+  1. the Prometheus text the server renders must be syntactically valid
+     (metric/label name syntax, typed samples, no duplicate series);
+  2. every family in the exposition must appear in the metric catalog
+     in docs/OBSERVABILITY.md and vice versa (``<family>_count``
+     lifetime-sample counters are implied by their base family);
+  3. every latency-series key in ``ServingMetrics.snapshot()`` must
+     have a renderer mapping (``prometheus.SERIES_FAMILIES``) — a new
+     series added to the snapshot but not the renderer would be
+     invisible to scrapers.
+
+Runs on a FABRICATED snapshot (every counter/series/gauge populated,
+plus a compile-log summary with a recompile) so the exposition exercises
+every family the renderer can emit.  Exit 0 = all checks pass.
+
+Usage:
+  env PYTHONPATH=. python tools/check_metrics.py [--docs PATH]
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+_CATALOG_ROW = re.compile(r"^\|\s*`([a-zA-Z_:][a-zA-Z0-9_:]*)`\s*\|")
+
+
+def fabricated_exposition():
+    """(snapshot, compile_summary, rendered_text) with every family the
+    renderer can emit populated."""
+    from paddle_infer_tpu.observability.compilelog import CompileLog
+    from paddle_infer_tpu.observability.prometheus import render_prometheus
+    from paddle_infer_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics()
+    m.on_submitted(4)
+    m.on_rejected()
+    m.on_rejected_queue_full()
+    m.on_deadline()
+    m.on_failed()
+    m.on_prefill(0.050)
+    m.on_prefill(0.071)
+    m.on_tokens(4, itl_s=0.010)
+    m.on_tokens(3, itl_s=0.012)
+    m.on_step(3.5, active=2, max_batch=4)
+    m.on_completed(0.5)
+    snap = m.snapshot(queue_depth=1, active=2, max_batch=4,
+                      kv_pool={"total_blocks": 32, "used_blocks": 8,
+                               "free_blocks": 24, "occupancy": 0.25})
+
+    # local CompileLog (not the process singleton): one prefill, one
+    # warmed decode, one post-warmup recompile so the recompile/storm
+    # families render with non-trivial values
+    logging.getLogger("paddle_infer_tpu.observability").disabled = True
+    try:
+        log = CompileLog()
+        dkey = ("serve-step", 4, 4, 8, 33)
+        log.record("serving-prefill", ("serve-prefill", 16, 8, 33),
+                   (((1, 16), "int32"),), 0.25)
+        log.record("serving-decode", dkey, (((4,), "int32"),), 0.40)
+        log.mark_warm("serving-decode", dkey)
+        log.record("serving-decode", dkey, (((4,), "int32"),), 0.40)
+        summary = log.summary()
+    finally:
+        logging.getLogger("paddle_infer_tpu.observability").disabled = False
+    return snap, summary, render_prometheus(snap, summary)
+
+
+def catalog_names(docs_path: str):
+    """Family names from the docs metric-catalog table (backticked
+    first column of ``| `name` | type | unit | meaning |`` rows).
+    Only rows after a ``Metric catalog`` heading count, up to the next
+    heading — the docs have other backticked tables (span names)."""
+    names = []
+    in_catalog = False
+    saw_heading = False
+    with open(docs_path) as f:
+        for line in f:
+            stripped = line.strip()
+            if stripped.startswith("#"):
+                in_catalog = "metric catalog" in stripped.lower()
+                saw_heading = saw_heading or in_catalog
+                continue
+            if not in_catalog:
+                continue
+            mt = _CATALOG_ROW.match(stripped)
+            if mt and mt.group(1) not in ("family",):
+                names.append(mt.group(1))
+    if not saw_heading:        # headingless doc (tests): take every row
+        with open(docs_path) as f:
+            for line in f:
+                mt = _CATALOG_ROW.match(line.strip())
+                if mt and mt.group(1) not in ("family",):
+                    names.append(mt.group(1))
+    return names
+
+
+def run_checks(docs_path: str):
+    from paddle_infer_tpu.observability.prometheus import (SERIES_FAMILIES,
+                                                           family_names,
+                                                           validate_exposition)
+
+    problems = []
+    snap, summary, text = fabricated_exposition()
+
+    problems += validate_exposition(text)
+
+    families = family_names(text)
+    if len(set(families)) != len(families):
+        problems.append("duplicate TYPE declarations in exposition")
+    catalog = catalog_names(docs_path)
+    if not catalog:
+        problems.append(f"no metric catalog rows found in {docs_path}")
+    cat = set(catalog)
+    for fam in families:
+        if fam in cat:
+            continue
+        if fam.endswith("_count") and fam[:-len("_count")] in cat:
+            continue
+        problems.append(f"exposed family {fam} missing from the "
+                        f"catalog in {docs_path}")
+    for name in catalog:
+        if name not in families:
+            problems.append(f"catalog entry {name} not emitted by the "
+                            "renderer (stale docs?)")
+
+    # snapshot <-> renderer mapping: every reservoir series in the
+    # snapshot must have a SERIES_FAMILIES entry
+    for key, val in snap.items():
+        if isinstance(val, dict) and "p50_recent" in val \
+                and key not in SERIES_FAMILIES:
+            problems.append(f"snapshot series {key!r} has no renderer "
+                            "mapping in prometheus.SERIES_FAMILIES")
+    for key in SERIES_FAMILIES:
+        if key not in snap:
+            problems.append(f"SERIES_FAMILIES key {key!r} absent from "
+                            "ServingMetrics.snapshot()")
+    return problems, len(families)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--docs",
+                    default=os.path.join(ROOT, "docs", "OBSERVABILITY.md"))
+    args = ap.parse_args(argv)
+    problems, n_families = run_checks(args.docs)
+    if problems:
+        for p in problems:
+            print(f"FAIL: {p}")
+        return 1
+    print(f"metrics exposition OK: {n_families} families valid and "
+          f"in sync with {os.path.relpath(args.docs, ROOT)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

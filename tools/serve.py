@@ -10,12 +10,21 @@ requests and stream their tokens, so concurrent clients share fused
 decode steps instead of serializing behind a lock.
 
   POST /generate          {"ids": [[...]], "max_new_tokens": N, ...}
-                          -> {"tokens": [[...]]}
-  POST /generate_stream   same body -> chunked response, one JSON line
-                          per decoded chunk
+                          -> {"tokens": [[...]], "request_ids": [...]}
+  POST /generate_stream   same body -> chunked response: one JSON line
+                          {"request_ids": [...]} then one line per
+                          decoded chunk
   GET  /metrics           -> ServingMetrics snapshot (queue depth, batch
-                          occupancy, TTFT/ITL percentiles, tokens/s,
-                          rejection counts)
+                          occupancy, KV-pool gauges, TTFT/ITL
+                          percentiles, tokens/s, rejection counts,
+                          compile log); with ``Accept: text/plain`` the
+                          same data renders as Prometheus 0.0.4 text
+                          exposition
+  GET  /trace/<rid>       -> span trace of one (recent) request;
+                          ``?format=chrome`` exports Chrome-trace JSON
+                          mergeable with profiler captures
+  GET  /traces            -> one-line summaries of the completed-trace
+                          ring (id, state, duration, span coverage)
   GET  /health            -> {"status": "ok", "model": ...}
 
 Admission control maps to HTTP codes: queue full -> 429, deadline
@@ -33,7 +42,9 @@ import argparse
 import json
 import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
@@ -118,7 +129,9 @@ def _error_code(e) -> int:
 
 
 def _generate(ids, g, timeout_s):
-    """Route one /generate body; returns (tokens [b, max_new], extra)."""
+    """Route one /generate body; returns (tokens [b, max_new], extra).
+    ``extra["request_ids"]`` always carries the engine request ids so
+    the client can fetch the span trace via ``GET /trace/<rid>``."""
     core = _core()
     if _speculatable(ids, g):
         def call():
@@ -129,23 +142,23 @@ def _generate(ids, g, timeout_s):
         req = core.submit_exclusive(call, timeout_s=timeout_s)
         req.result(timeout=None)
         toks, acceptance = req.value
-        return toks, {"speculative": True, "acceptance": acceptance}
+        return toks, {"speculative": True, "acceptance": acceptance,
+                      "request_ids": [req.rid]}
     if core.batchable(g):
         reqs = core.submit(ids, g, timeout_s=timeout_s)
-        return np.stack([r.padded_result(timeout=None) for r in reqs]), {}
+        return (np.stack([r.padded_result(timeout=None) for r in reqs]),
+                {"request_ids": [r.rid for r in reqs]})
     # beams / repetition penalty: exclusive dense-engine call
     req = core.submit_exclusive(lambda: _dense().generate(ids, g),
                                 timeout_s=timeout_s)
     req.result(timeout=None)
-    return np.asarray(req.value), {}
+    return np.asarray(req.value), {"request_ids": [req.rid]}
 
 
-def _stream_chunks(ids, g, chunk_size, timeout_s):
+def _stream_chunks(reqs, g, chunk_size):
     """Yield [b, <=chunk_size] token blocks as the batch rows decode.
     Rows finish at different steps; slots past a finished row's last
     token are pad, matching the engines' [b, max_new] output layout."""
-    core = _core()
-    reqs = core.submit(ids, g, timeout_s=timeout_s)
     b = len(reqs)
     emitted = 0
     while True:
@@ -185,12 +198,53 @@ class Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(payload)
 
+    def _text(self, code, text, content_type):
+        payload = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
     def do_GET(self):
-        if self.path == "/health":
+        from paddle_infer_tpu.observability import get_compile_log
+
+        url = urlparse(self.path)
+        if url.path == "/health":
             self._json(200, {"status": "ok",
                              "model": type(_STATE["model"]).__name__})
-        elif self.path == "/metrics":
-            self._json(200, _core().metrics_snapshot())
+        elif url.path == "/metrics":
+            core = _core()
+            snap = core.metrics_snapshot()
+            compile_summary = get_compile_log().summary()
+            accept = self.headers.get("Accept", "")
+            # content negotiation: Prometheus scrapers say text/plain
+            # (or openmetrics); dashboards/tests default to JSON
+            if "text/plain" in accept or "openmetrics" in accept:
+                self._text(200, core.metrics.to_prometheus(
+                    snap, compile_summary),
+                    "text/plain; version=0.0.4; charset=utf-8")
+            else:
+                snap["compile"] = compile_summary
+                self._json(200, snap)
+        elif url.path == "/traces":
+            self._json(200, {"traces": _core().tracer.summaries()})
+        elif url.path.startswith("/trace/"):
+            try:
+                rid = int(url.path[len("/trace/"):])
+            except ValueError:
+                self._json(400, {"error": "trace id must be an integer"})
+                return
+            tr = _core().tracer.get(rid)
+            if tr is None:
+                self._json(404, {"error": f"no trace for request {rid} "
+                                          "(evicted or never submitted)"})
+                return
+            fmt = parse_qs(url.query).get("format", ["json"])[0]
+            if fmt == "chrome":
+                self._json(200, tr.to_chrome())
+            else:
+                self._json(200, tr.to_dict())
         else:
             self._json(404, {"error": "unknown path"})
 
@@ -214,21 +268,33 @@ class Handler(BaseHTTPRequestHandler):
         try:
             if self.path == "/generate":
                 toks, extra = _generate(ids, g, timeout_s)
-                self._json(200, {"tokens": np.asarray(toks).tolist(),
-                                 **extra})
+                # detokenize/serialize span appended post-finish (the
+                # tracer ring keeps completed traces mutable for this);
+                # recorded BEFORE the response bytes go out so the trace
+                # is complete the moment the client can fetch it
+                t_ser = time.monotonic()
+                payload = {"tokens": np.asarray(toks).tolist(), **extra}
+                tracer = _core().tracer
+                now = time.monotonic()
+                for rid in extra.get("request_ids", []):
+                    tracer.add_span(rid, "detokenize", t_ser, now)
+                self._json(200, payload)
             elif self.path == "/generate_stream":
                 if g.num_beams > 1:
                     self._json(400, {"error": "streaming supports "
                                               "sampling/greedy only"})
                     return
+                # submit BEFORE headers so admission errors (429/504/400)
+                # still map to status codes
+                reqs = _core().submit(ids, g, timeout_s=timeout_s)
                 chunks = _stream_chunks(
-                    ids, g, chunk_size=int(body.get("chunk_size", 8)),
-                    timeout_s=timeout_s)
+                    reqs, g, chunk_size=int(body.get("chunk_size", 8)))
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
                 headers_sent = True
+                send_chunk({"request_ids": [r.rid for r in reqs]})
                 for chunk in chunks:
                     send_chunk({"tokens": np.asarray(chunk).tolist()})
                 self.wfile.write(b"0\r\n\r\n")
