@@ -586,6 +586,11 @@ def _child_main():
     serving = run_section("serving", 600,
                           lambda: _serving_bench(on_tpu), tpu_only=False)
 
+    # prefix KV-cache: warm (shared system prompt) vs cold TTFT
+    prefix_cache = run_section("prefix_cache", 420,
+                               lambda: _prefix_cache_bench(on_tpu),
+                               tpu_only=False)
+
     result = {
         **headline,
         "tokens_per_sec_single_block": round(tokens_per_sec_single, 1),
@@ -634,6 +639,8 @@ def _child_main():
             spec_stats[2], 3)
     if serving is not None:
         result["serving"] = serving
+    if prefix_cache is not None:
+        result["prefix_cache"] = prefix_cache
     if skipped_sections:
         result["skipped_sections"] = skipped_sections
     result["child_wall_s"] = round(time.monotonic() - child_t0, 1)
@@ -961,6 +968,96 @@ def _serving_bench(on_tpu: bool):
         "ttft_p99_s": round(snap["ttft_s"]["p99_recent"], 4),
         "itl_p50_s": round(snap["inter_token_latency_s"]["p50_recent"], 5),
         "mean_batch_occupancy": round(snap["occupancy"]["mean"], 3),
+    }
+
+
+def _prefix_cache_bench(on_tpu: bool):
+    """Prefix-cache TTFT evidence: N clients sharing one long system
+    prompt (distinct short tails), admitted one at a time so TTFT is
+    pure admission + prefill.  The cold pass gives every client its own
+    ``cache_salt`` (no sharing possible); the warm pass runs them in one
+    salt domain after a seed request populated the radix tree, so each
+    admission maps the shared pages and prefills only the tail bucket.
+    Every plen bucket, the page-copy program and the decode chunk are
+    compile-warmed first, so the delta measures prefill work saved, not
+    XLA."""
+    import paddle_infer_tpu as pit
+    from paddle_infer_tpu.inference import (GenerationConfig,
+                                            PagedGenerationEngine)
+    from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_infer_tpu.serving import EngineCore
+
+    pit.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    intermediate_size=256, max_position_embeddings=256,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    n_clients, sys_len, tail_len, max_new = 8, 96, 8, 16
+    rng = np.random.RandomState(0)
+    system = rng.randint(0, cfg.vocab_size, (sys_len,)).astype(np.int32)
+
+    def prompt():
+        return np.concatenate([
+            system,
+            rng.randint(0, cfg.vocab_size, (tail_len,)).astype(np.int32)])
+
+    g = GenerationConfig(max_new_tokens=max_new)
+    core = EngineCore(
+        PagedGenerationEngine(model, page_size=16, prompt_bucket=16),
+        max_batch=4, decode_chunk=8,
+        max_model_len=sys_len + tail_len + max_new,
+        enable_prefix_cache=True).start()
+    try:
+        # compile warmup: cold full-prompt plen, warm suffix plen, the
+        # CoW page-copy program and the fused decode chunk
+        w = prompt()
+        core.submit(w, g, cache_salt="warmup")[0].result(timeout=600)
+        core.submit(prompt(), g, cache_salt="warmup")[0].result(
+            timeout=600)
+        core.submit(w, g, cache_salt="warmup")[0].result(timeout=600)
+
+        def ttft_p50(reqs):
+            ts = sorted(r.first_token_at - r.arrival for r in reqs)
+            return ts[len(ts) // 2]
+
+        # cold pass: per-client salts — no request can reuse another's
+        cold_reqs = []
+        for i in range(n_clients):
+            (r,) = core.submit(prompt(), g, cache_salt=f"cold-{i}")
+            r.result(timeout=600)
+            cold_reqs.append(r)
+
+        # warm pass: one salt domain, tree seeded by the first request
+        core.submit(prompt(), g, cache_salt="shared")[0].result(
+            timeout=600)
+        before = core.prefix_cache.stats_snapshot()
+        warm_reqs = []
+        for i in range(n_clients):
+            (r,) = core.submit(prompt(), g, cache_salt="shared")
+            r.result(timeout=600)
+            warm_reqs.append(r)
+        after = core.prefix_cache.stats_snapshot()
+    finally:
+        core.close()
+    cold_p50 = ttft_p50(cold_reqs)
+    warm_p50 = ttft_p50(warm_reqs)
+    warm_q = after["queries"] - before["queries"]
+    warm_hits = after["hits"] - before["hits"]
+    return {
+        "clients": n_clients,
+        "system_prompt_tokens": sys_len,
+        "tail_tokens": tail_len,
+        "ttft_p50_cold_s": round(cold_p50, 4),
+        "ttft_p50_warm_s": round(warm_p50, 4),
+        "ttft_speedup": round(cold_p50 / warm_p50, 2),
+        "warm_hit_rate": round(warm_hits / warm_q, 3) if warm_q else 0.0,
+        "cached_token_ratio": round(after["token_ratio"], 3),
+        "cow_copies": after["cow_copies"],
+        "evicted_blocks": after["evicted_blocks"],
+        "cached_blocks": after["cached_blocks"],
     }
 
 

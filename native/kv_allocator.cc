@@ -148,6 +148,69 @@ int32_t kv_seq_cow_last(void* pool, int64_t seq, int32_t* src, int32_t* dst) {
   return 1;
 }
 
+// ---- block-level ops (prefix cache: serving/prefix_cache holds direct
+// refs on retained blocks, independent of any live sequence) ----
+
+// Allocate one block outside any sequence (refcount 1).  Returns the
+// block id or -1 when the pool is exhausted.
+int32_t kv_block_alloc(void* pool) {
+  auto* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lock(p->mu);
+  return p->pop_free();
+}
+
+// Take an extra reference on a live block.  Returns the new refcount, or
+// -1 for an out-of-range / free block (ref'ing a freed block is a bug
+// the caller must surface, not paper over).
+int32_t kv_block_ref(void* pool, int32_t block) {
+  auto* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lock(p->mu);
+  if (block < 0 || block >= p->num_blocks || p->refcount[block] <= 0)
+    return -1;
+  return ++p->refcount[block];
+}
+
+// Drop a reference (freeing the block at zero).  Returns the new
+// refcount, or -1 for an out-of-range / already-free block.
+int32_t kv_block_unref(void* pool, int32_t block) {
+  auto* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lock(p->mu);
+  if (block < 0 || block >= p->num_blocks || p->refcount[block] <= 0)
+    return -1;
+  p->unref(block);
+  return p->refcount[block];
+}
+
+// Current refcount of a block (0 = on the free list); -1 out of range.
+int32_t kv_block_refcount(void* pool, int32_t block) {
+  auto* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lock(p->mu);
+  if (block < 0 || block >= p->num_blocks) return -1;
+  return p->refcount[block];
+}
+
+// Replace `seq`'s table with the given blocks (in order), ref'ing each;
+// the sequence's previous blocks are released.  `num_tokens` becomes the
+// sequence length (kv_seq_reserve grows from here without touching the
+// assigned prefix).  Returns the block count, or -1 when any block is
+// out of range or free — in that case nothing is modified.
+int32_t kv_seq_assign(void* pool, int64_t seq, const int32_t* blocks,
+                      int32_t n, int32_t num_tokens) {
+  auto* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lock(p->mu);
+  for (int32_t i = 0; i < n; ++i) {
+    int32_t b = blocks[i];
+    if (b < 0 || b >= p->num_blocks || p->refcount[b] <= 0) return -1;
+  }
+  for (int32_t i = 0; i < n; ++i) ++p->refcount[blocks[i]];
+  auto it = p->tables.find(seq);
+  if (it != p->tables.end())
+    for (int32_t b : it->second) p->unref(b);
+  p->tables[seq] = std::vector<int32_t>(blocks, blocks + n);
+  p->lengths[seq] = num_tokens;
+  return n;
+}
+
 // Release a sequence's blocks.
 void kv_seq_free(void* pool, int64_t seq) {
   auto* p = static_cast<Pool*>(pool);

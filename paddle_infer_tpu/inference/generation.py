@@ -616,7 +616,9 @@ class PagedGenerationEngine(GenerationEngine):
             tag = str(key[0]) if isinstance(key, tuple) and key else \
                 str(key)
             site = ("serving-decode" if tag in ("serve-step",)
-                    else "serving-prefill" if tag == "serve-prefill"
+                    else "serving-prefill"
+                    if tag in ("serve-prefill", "serve-prefill-px")
+                    else "serving-page-copy" if tag == "serve-page-copy"
                     else f"serving-{tag}")
             get_compile_log().record(site, key, sig,
                                      time.perf_counter() - t0)

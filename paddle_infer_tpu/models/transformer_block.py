@@ -75,7 +75,7 @@ class ParallelSelfAttention(Layer):
         from ..core.tensor import Tensor
 
         ar = Tensor(jnp.arange(s, dtype=jnp.int32))
-        if cache is not None and len(cache) == 4:
+        if cache is not None and len(cache) >= 4:
             return D("unsqueeze", cache[3], axis=1) + ar     # [b, s]
         if cache is not None and len(cache) == 3:
             return ar + cache[2]
@@ -102,7 +102,7 @@ class ParallelSelfAttention(Layer):
             rep = self.num_heads // self.num_kv_heads
             k = D("repeat_interleave", k, repeats=rep, axis=2)
             v = D("repeat_interleave", v, repeats=rep, axis=2)
-        if cache is not None and len(cache) == 4:
+        if cache is not None and len(cache) >= 4:
             return self._forward_paged(x, q, k, v, cache, attn_mask)
         static_cache = cache is not None and len(cache) == 3
         if static_cache:
@@ -168,13 +168,29 @@ class ParallelSelfAttention(Layer):
         causally over themselves (right-padded batches: real tokens never
         see pads under causality); decode steps (s == 1) append one token
         at its per-row position and walk the page table with the Pallas
-        decode kernel."""
+        decode kernel.
+
+        A FIVE-element cache (trailing marker, see
+        serving/programs.build_prefix_prefill) selects the windowed
+        suffix-prefill variant: the chunk starts at position
+        ``positions[b]`` (cached-prefix length, possibly mid-page) and
+        attends over the row's whole gathered page window so cached
+        prefix KV participates — the prefix-cache warm path."""
         from ..core.tensor import Tensor
         from ..ops.pallas import paged_attention as PA
 
         b, s = x.shape[0], x.shape[1]
-        k_pages, v_pages, tables, positions = (c._data for c in cache)
-        if s > 1:
+        k_pages, v_pages, tables, positions = (c._data for c in cache[:4])
+        windowed = len(cache) == 5
+        if s > 1 and windowed:
+            k_pages = PA.write_chunk_pages(k_pages, tables, k._data,
+                                           positions)
+            v_pages = PA.write_chunk_pages(v_pages, tables, v._data,
+                                           positions)
+            out = Tensor(PA.prefix_prefill_attention(
+                q._data, k_pages, v_pages, tables, positions))
+            new_pos = positions + s
+        elif s > 1:
             # prefill: pages for slots 0..s-1 (s % page_size == 0, padded
             # by the engine); garbage in pad slots is masked by `lengths`
             # at every later read

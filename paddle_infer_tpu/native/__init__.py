@@ -122,6 +122,12 @@ def _load():
         "kv_seq_cow_last": ([c.c_void_p, c.c_int64, c.POINTER(c.c_int32),
                              c.POINTER(c.c_int32)], c.c_int32),
         "kv_seq_free": ([c.c_void_p, c.c_int64], None),
+        "kv_block_alloc": ([c.c_void_p], c.c_int32),
+        "kv_block_ref": ([c.c_void_p, c.c_int32], c.c_int32),
+        "kv_block_unref": ([c.c_void_p, c.c_int32], c.c_int32),
+        "kv_block_refcount": ([c.c_void_p, c.c_int32], c.c_int32),
+        "kv_seq_assign": ([c.c_void_p, c.c_int64, c.POINTER(c.c_int32),
+                           c.c_int32, c.c_int32], c.c_int32),
         # tensor store
         "tstore_writer_open": ([c.c_char_p], c.c_void_p),
         "tstore_writer_add": ([c.c_void_p, c.c_char_p, c.c_uint32,
@@ -318,6 +324,53 @@ class KVBlockPool:
 
     def free(self, seq_id: int):
         self._lib.kv_seq_free(self._h, seq_id)
+
+    # ---- block-level ops (prefix cache: direct refs on retained blocks,
+    # independent of any live sequence) ----
+    def alloc_block(self) -> int:
+        """Allocate one block outside any sequence (refcount 1)."""
+        b = self._lib.kv_block_alloc(self._h)
+        if b < 0:
+            raise MemoryError(
+                f"KV pool exhausted ({self.num_blocks} blocks)")
+        return int(b)
+
+    def ref_block(self, block: int) -> int:
+        """Take an extra reference on a live block; returns the new
+        refcount.  Ref'ing a free block raises (double-free guard)."""
+        rc = self._lib.kv_block_ref(self._h, block)
+        if rc < 0:
+            raise ValueError(f"ref of free/out-of-range block {block}")
+        return int(rc)
+
+    def unref_block(self, block: int) -> int:
+        """Drop one reference (block returns to the free list at zero);
+        returns the new refcount.  Unref'ing a free block raises."""
+        rc = self._lib.kv_block_unref(self._h, block)
+        if rc < 0:
+            raise ValueError(f"unref of free/out-of-range block {block}")
+        return int(rc)
+
+    def block_refcount(self, block: int) -> int:
+        """Current refcount (0 = free).  Test/diagnostic introspection."""
+        rc = self._lib.kv_block_refcount(self._h, block)
+        if rc < 0:
+            raise ValueError(f"block {block} out of range")
+        return int(rc)
+
+    def assign(self, seq_id: int, blocks, num_tokens: int) -> int:
+        """Replace ``seq_id``'s table with ``blocks`` (each ref'd; the
+        sequence's previous blocks are released) and set its length to
+        ``num_tokens``.  ``reserve`` grows from here without touching
+        the assigned prefix."""
+        blocks = [int(b) for b in blocks]
+        arr = (ctypes.c_int32 * len(blocks))(*blocks)
+        n = self._lib.kv_seq_assign(self._h, seq_id, arr, len(blocks),
+                                    num_tokens)
+        if n < 0:
+            raise ValueError(f"assign with free/out-of-range block in "
+                             f"{blocks}")
+        return int(n)
 
     def __del__(self):
         h = getattr(self, "_h", None)

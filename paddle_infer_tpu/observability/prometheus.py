@@ -108,6 +108,45 @@ def render_prometheus(snapshot: dict,
                  "used_blocks / total_blocks")
         w.sample("serving_kv_pool_occupancy", kv.get("occupancy"))
 
+    px = snapshot.get("prefix_cache") or {}
+    if px:
+        w.family("prefix_cache_queries_total", "counter",
+                 "Prefix-cache lookups at admission")
+        w.sample("prefix_cache_queries_total", px.get("queries"))
+        w.family("prefix_cache_hits_total", "counter",
+                 "Lookups that matched at least one cached token")
+        w.sample("prefix_cache_hits_total", px.get("hits"))
+        w.family("prefix_cache_hit_rate", "gauge",
+                 "hits / queries over the process lifetime")
+        w.sample("prefix_cache_hit_rate", px.get("hit_rate"))
+        w.family("prefix_cache_cached_tokens_total", "counter",
+                 "Prompt tokens served from cached KV pages")
+        w.sample("prefix_cache_cached_tokens_total",
+                 px.get("cached_tokens"))
+        w.family("prefix_cache_prompt_tokens_total", "counter",
+                 "Prompt tokens seen by prefix-cache lookups")
+        w.sample("prefix_cache_prompt_tokens_total",
+                 px.get("prompt_tokens"))
+        w.family("prefix_cache_token_ratio", "gauge",
+                 "cached_tokens / prompt_tokens (cached-token ratio)")
+        w.sample("prefix_cache_token_ratio", px.get("token_ratio"))
+        w.family("prefix_cache_inserts_total", "counter",
+                 "Finished sequences retained into the radix tree")
+        w.sample("prefix_cache_inserts_total", px.get("inserts"))
+        w.family("prefix_cache_evicted_blocks_total", "counter",
+                 "Cached blocks evicted (LRU / watermark / clear)")
+        w.sample("prefix_cache_evicted_blocks_total",
+                 px.get("evicted_blocks"))
+        w.family("prefix_cache_cow_copies_total", "counter",
+                 "Copy-on-write page copies for shared partial tails")
+        w.sample("prefix_cache_cow_copies_total", px.get("cow_copies"))
+        w.family("prefix_cache_blocks", "gauge",
+                 "KV blocks currently retained by the radix tree")
+        w.sample("prefix_cache_blocks", px.get("cached_blocks"))
+        w.family("prefix_cache_nodes", "gauge",
+                 "Full-page nodes currently in the radix tree")
+        w.sample("prefix_cache_nodes", px.get("nodes"))
+
     counters = snapshot.get("counters") or {}
     for key in sorted(counters):
         name = f"serving_{key}_total"

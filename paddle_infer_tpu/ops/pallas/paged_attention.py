@@ -213,6 +213,65 @@ def write_prompt_pages(pages, block_tables, kv):
     return pages.at[idx].set(flat.astype(pages.dtype))
 
 
+def write_chunk_pages(pages, block_tables, kv, offsets):
+    """Scatter a chunk's K or V [B, S, H, D] into the pool at absolute
+    positions ``offsets[b] + i`` — the offset-aware generalisation of
+    ``write_prompt_pages`` for suffix prefill over a cached prefix.
+    Unlike the aligned writer, the chunk may start mid-page (the
+    copy-on-write tail block), so each token scatters to its own
+    (page, slot).  The caller guarantees ``offsets + S`` stays inside
+    the table window."""
+    b, s, h, d = kv.shape
+    page = pages.shape[2]
+    pos = offsets[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+    page_idx = jnp.take_along_axis(block_tables, pos // page, axis=1)
+    slot = pos % page
+    # advanced indices (page_idx, slot) around the head slice: result
+    # dims [B, S, H, D] match kv
+    return pages.at[page_idx, :, slot].set(kv.astype(pages.dtype))
+
+
+def prefix_prefill_attention(q, k_pages, v_pages, block_tables, offsets,
+                             scale=None):
+    """Suffix-prefill attention: queries at absolute positions
+    ``offsets[b] + i`` attend over the row's whole gathered page window
+    (cached prefix + the just-written chunk) under an absolute-position
+    causal mask.
+
+    q            [B, S, H, D]   — the suffix chunk's queries
+    k_pages      [P, H, page, D]
+    v_pages      [P, H, page, D]
+    block_tables [B, max_pages] int32
+    offsets      [B] int32      — tokens already cached per row
+    → [B, S, H, D]
+
+    The window width (max_pages × page) is a per-core constant, so the
+    per-query softmax/contraction shape is identical for every prefill
+    bucket — that is what makes warm-path logits bitwise equal to the
+    cold path on CPU (slots past a query's position mask to exactly
+    zero weight, whatever garbage they hold).  A dense gather is fine
+    for prefill (it is compute-bound already); a ragged Pallas variant
+    is the TPU follow-up.
+    """
+    b, s, h, d = q.shape
+    page = k_pages.shape[2]
+    max_pages = block_tables.shape[1]
+    W = max_pages * page
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    kw = k_pages[block_tables].transpose(0, 1, 3, 2, 4) \
+        .reshape(b, W, h, d).astype(jnp.float32)
+    vw = v_pages[block_tables].transpose(0, 1, 3, 2, 4) \
+        .reshape(b, W, h, d).astype(jnp.float32)
+    pos = offsets[:, None] + jnp.arange(s, dtype=jnp.int32)[None]  # [b, s]
+    mask = jnp.arange(W, dtype=jnp.int32)[None, None, :] <= pos[:, :, None]
+    scores = jnp.einsum("bshd,bwhd->bhsw", q.astype(jnp.float32),
+                        kw) * scale
+    scores = jnp.where(mask[:, None], scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhsw,bwhd->bshd", weights, vw)
+    return out.astype(q.dtype)
+
+
 def write_token_page(pages, block_tables, kv, positions):
     """Write one new token's K or V [B, H, D] at its (page, slot):
     positions [B] is the 0-based token index in each sequence."""

@@ -43,7 +43,9 @@ from ..inference.generation import (GenerationConfig, PagedGenerationEngine,
                                     _round_up)
 from ..observability import Tracer, get_compile_log
 from .metrics import ServingMetrics
-from .programs import build_decode, build_prefill
+from .prefix_cache import PrefixCache
+from .programs import (build_decode, build_page_copy, build_prefill,
+                       build_prefix_prefill)
 from .request import (DeadlineExceededError, QueueFullError, RejectedError,
                       Request, RequestQueue, RequestState)
 
@@ -66,7 +68,9 @@ class EngineCore:
                  default_timeout_s: Optional[float] = None,
                  max_model_len: Optional[int] = None,
                  metrics: Optional[ServingMetrics] = None,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 enable_prefix_cache: bool = False,
+                 prefix_cache_watermark: float = 0.5):
         self._engine = engine
         self._max_batch = int(max_batch)
         self._decode_chunk = max(1, int(decode_chunk))
@@ -98,6 +102,16 @@ class EngineCore:
         self._pool.reserve(self._max_batch, 1)
         self._scratch = int(self._pool.block_table(self._max_batch)[0])
 
+        # automatic prefix caching: finished sequences' pages are
+        # retained in a radix tree and matched against new prompts at
+        # admission (docs/SERVING.md "Prefix caching").  When enabled,
+        # ALL prefills (cold included) run the windowed
+        # ``serve-prefill-px`` program family so warm and cold logits
+        # are bitwise-identical.
+        self._prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self._pool, page, prefix_cache_watermark)
+            if enable_prefix_cache else None)
+
         self._slots: List[Optional[dict]] = [None] * self._max_batch
         self.step_trace: List[dict] = []
         self._step_idx = 0
@@ -126,6 +140,10 @@ class EngineCore:
     def active_count(self) -> int:
         return sum(s is not None for s in self._slots)
 
+    @property
+    def prefix_cache(self) -> Optional[PrefixCache]:
+        return self._prefix_cache
+
     def metrics_snapshot(self) -> dict:
         total = self._pool.num_blocks
         free = self._pool.free_blocks
@@ -136,7 +154,9 @@ class EngineCore:
             kv_pool={"total_blocks": int(total),
                      "free_blocks": int(free),
                      "used_blocks": int(total - free),
-                     "occupancy": (total - free) / total if total else 0.0})
+                     "occupancy": (total - free) / total if total else 0.0},
+            prefix_cache=(self._prefix_cache.stats_snapshot()
+                          if self._prefix_cache is not None else None))
 
     # ------------------------------------------------------- trace hooks
     def _trace_end(self, req: Request, state: RequestState):
@@ -153,7 +173,8 @@ class EngineCore:
 
     def submit(self, input_ids, config: GenerationConfig = None,
                attention_mask=None,
-               timeout_s: Optional[float] = None) -> List[Request]:
+               timeout_s: Optional[float] = None,
+               cache_salt: Optional[str] = None) -> List[Request]:
         """Enqueue one request per row of ``input_ids`` ([b, plen] or
         [plen]).  All-or-nothing: admission errors (too long, queue
         full, not batchable) reject the whole call.  Returns the per-row
@@ -183,7 +204,8 @@ class EngineCore:
                     f"exceeds max_model_len {self._max_model_len}")
             rows.append(row)
         timeout_s = self._default_timeout if timeout_s is None else timeout_s
-        reqs = [Request(row, g, timeout_s=timeout_s) for row in rows]
+        reqs = [Request(row, g, timeout_s=timeout_s, cache_salt=cache_salt)
+                for row in rows]
         try:
             self._queue.submit_many(reqs)
         except QueueFullError:
@@ -302,37 +324,171 @@ class EngineCore:
             samp["pad"][i] = g.pad_token_id
         return samp
 
+    def _match_prefix(self, req: Request):
+        """Query the radix tree for ``req``'s longest cached prefix and
+        trim it until the padded suffix fits the fixed table window
+        (``cached + plen(length - cached) <= plen_cap``; cached == 0
+        always fits because the cold plen clamps to the cap)."""
+        cache = self._prefix_cache
+        length = int(req.prompt.size)
+        match = cache.match(req.prompt, salt=req.cache_salt)
+        while (match.cached_tokens and
+               match.cached_tokens +
+               self._plen(length - match.cached_tokens) > self._plen_cap):
+            cache.trim(match, match.cached_tokens - 1)
+        return match
+
+    def _copy_page(self, src: int, dst: int):
+        """Device-side copy of one physical page across every layer's
+        pools (the CoW step for a shared partial tail block)."""
+        eng = self._engine
+        ckey = ("serve-page-copy", self._pool.num_blocks)
+        eng.run_paged_program(
+            ckey, lambda: build_page_copy(eng),
+            np.asarray([src], np.int32), np.asarray([dst], np.int32))
+
+    def _stage_prefix(self, sid: int, match, length: int, max_new: int):
+        """Map a match onto slot ``sid``'s sequence: copy-on-write the
+        partial tail into a fresh private block, ``assign`` the shared
+        blocks (the sequence takes its own refs — tree eviction can
+        never yank them) and reserve fresh pages for the suffix.  Under
+        pool pressure the match degrades page by page (evicting LRU
+        cache entries first) down to a cold reserve.  Returns the final
+        ``(cached_tokens, reserve)``."""
+        cache = self._prefix_cache
+        pool = self._pool
+        page = self._page
+        while True:
+            cached = match.cached_tokens
+            reserve = max(cached + self._plen(length - cached),
+                          length + max_new)
+            total_pages = -(-reserve // page)
+            cache.ensure_free(total_pages - len(match.blocks))
+            try:
+                cow_dst = None
+                if match.partial_block is not None:
+                    cow_dst = pool.alloc_block()
+                    try:
+                        self._copy_page(match.partial_block, cow_dst)
+                    except BaseException:
+                        pool.unref_block(cow_dst)
+                        raise
+                    cache.on_cow()
+                blocks = list(match.blocks)
+                ntok = len(blocks) * page
+                if cow_dst is not None:
+                    blocks.append(cow_dst)
+                    ntok += match.partial_len
+                try:
+                    if blocks:
+                        pool.assign(sid, blocks, ntok)
+                finally:
+                    if cow_dst is not None:
+                        # drop the allocation ref: on success the
+                        # sequence holds its own; on failure this frees
+                        pool.unref_block(cow_dst)
+                pool.reserve(sid, reserve)
+                return cached, reserve
+            except MemoryError:
+                pool.free(sid)
+                if match.cached_tokens == 0:
+                    cache.ensure_free(total_pages)
+                    pool.reserve(sid, reserve)
+                    return 0, reserve
+                cache.trim(match, match.cached_tokens - 1)
+
+    def _release_slot_kv(self, sid: int, match,
+                         retain_tokens=None, salt=None):
+        """The ONE path KV blocks leave a slot — every admit-failure,
+        eviction and close goes through here so per-request block
+        accounting can never be dropped.  Optionally retains the
+        finished sequence's pages in the prefix cache (the tree takes
+        its refs BEFORE the sequence drops its own), frees the pool
+        reservation, unpins the request's match and enforces the cache
+        watermark."""
+        cache = self._prefix_cache
+        if (cache is not None and retain_tokens is not None
+                and len(retain_tokens) > 0):
+            cache.insert(retain_tokens, self._pool.block_table(sid),
+                         salt=salt)
+        self._pool.free(sid)
+        if cache is not None:
+            if match is not None:
+                cache.release(match)
+            cache.enforce_watermark()
+
     def _admit(self, req: Request, sid: int):
         admit_t = time.monotonic()
         self.tracer.add_span(req.rid, "queue_wait", req.arrival, admit_t)
         g = req.config
         length = int(req.prompt.size)
-        plen = self._plen(length)
+        cache = self._prefix_cache
+        eng = self._engine
+        match = None
+        try:
+            self._pool.free(sid)
+            if cache is not None:
+                match = self._match_prefix(req)
+                cached, reserve = self._stage_prefix(
+                    sid, match, length, g.max_new_tokens)
+                prefill_t = time.monotonic()
+                self.tracer.add_span(
+                    req.rid, "prefix_match", admit_t, prefill_t,
+                    cached_tokens=cached, blocks=len(match.blocks),
+                    cow=int(match.partial_block is not None))
+            else:
+                cached = 0
+                prefill_t = admit_t
+                reserve = max(self._plen(length), length + g.max_new_tokens)
+                self._pool.reserve(sid, reserve)
+        except Exception as e:
+            self._release_slot_kv(sid, match)
+            self._metrics.on_failed()
+            req._finish(RequestState.FAILED, e)
+            self.tracer.add_span(req.rid, "prefill", admit_t,
+                                 time.monotonic(), slot=sid,
+                                 outcome="failed")
+            self._trace_end(req, RequestState.FAILED)
+            if eng.kv_state_lost():
+                self._fail_all(e)
+            return
+        suffix = length - cached
+        plen = self._plen(suffix)
         ids = np.full((1, plen), g.pad_token_id, np.int32)
-        ids[0, :length] = req.prompt
-        # the prefill writes all plen page slots; decode positions reach
-        # length+max_new-1 — reserve whichever is larger
-        reserve = max(plen, length + g.max_new_tokens)
-        self._pool.free(sid)
-        self._pool.reserve(sid, reserve)
+        ids[0, :suffix] = req.prompt[cached:]
         table = np.full((self._max_pages,), self._scratch, np.int32)
         t = self._pool.block_table(sid)[:self._max_pages]
         table[:len(t)] = np.asarray(t, np.int32)
         key = np.asarray(
             jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))
-        eng = self._engine
-        pkey = ("serve-prefill", plen, self._max_pages,
-                self._pool.num_blocks)
+        span_name = "prefill" if cache is None else "suffix_prefill"
         try:
-            tok, fin = eng.run_paged_program(
-                pkey, lambda: build_prefill(eng, plen, self._max_pages),
-                ids, np.asarray([length], np.int32), table[None],
-                self._samp_arrays([g]), key[None])
+            if cache is not None:
+                # windowed family: cold (offset 0) and warm (offset c)
+                # share one executable per plen bucket, so a hit never
+                # compiles anything new
+                pkey = ("serve-prefill-px", plen, self._max_pages,
+                        self._pool.num_blocks)
+                tok, fin = eng.run_paged_program(
+                    pkey,
+                    lambda: build_prefix_prefill(eng, plen,
+                                                 self._max_pages),
+                    ids, np.asarray([suffix], np.int32),
+                    np.asarray([cached], np.int32), table[None],
+                    self._samp_arrays([g]), key[None])
+            else:
+                pkey = ("serve-prefill", plen, self._max_pages,
+                        self._pool.num_blocks)
+                tok, fin = eng.run_paged_program(
+                    pkey,
+                    lambda: build_prefill(eng, plen, self._max_pages),
+                    ids, np.asarray([length], np.int32), table[None],
+                    self._samp_arrays([g]), key[None])
         except Exception as e:
-            self._pool.free(sid)
+            self._release_slot_kv(sid, match)
             self._metrics.on_failed()
             req._finish(RequestState.FAILED, e)
-            self.tracer.add_span(req.rid, "prefill", admit_t,
+            self.tracer.add_span(req.rid, span_name, prefill_t,
                                  time.monotonic(), slot=sid, plen=plen,
                                  outcome="failed")
             self._trace_end(req, RequestState.FAILED)
@@ -349,10 +505,13 @@ class EngineCore:
         # compiled prefill + first-token emit) so no scheduler time
         # between queue_wait and the first decode chunk is unattributed
         span_end = time.monotonic()
-        self.tracer.add_span(req.rid, "prefill", admit_t, span_end,
-                             slot=sid, plen=plen)
+        self.tracer.add_span(req.rid, span_name, prefill_t, span_end,
+                             slot=sid, plen=plen, cached_tokens=cached)
         if finished or g.max_new_tokens <= 1:
-            self._pool.free(sid)
+            # the prompt's KV is fully written — retain it even though
+            # the row never reaches a decode chunk
+            self._release_slot_kv(sid, match, retain_tokens=req.prompt,
+                                  salt=req.cache_salt)
             req._finish(RequestState.DONE)
             self._metrics.on_completed(time.monotonic() - req.arrival)
             self._trace_end(req, RequestState.DONE)
@@ -362,6 +521,7 @@ class EngineCore:
                             "emitted": 1, "last_tok": tok,
                             "last_emit": time.monotonic(),
                             "table": table, "key": key,
+                            "match": match,
                             "span_end": span_end}
 
     # ------------------------------------------------------------ decode
@@ -453,8 +613,19 @@ class EngineCore:
     def _evict(self, slot: dict, state: RequestState,
                err: Optional[BaseException] = None):
         self._slots[slot["sid"]] = None
-        self._pool.free(slot["sid"])
         req = slot["req"]
+        # retain-on-finish: a DONE row's prompt + emitted tokens (minus
+        # the last — its KV is never written) have valid KV in the
+        # row's pages; donate them to the prefix cache instead of
+        # freeing.  Cancelled/failed rows may hold partial or garbage
+        # KV and are never retained.
+        retain = None
+        if state == RequestState.DONE and self._prefix_cache is not None:
+            retain = np.concatenate(
+                [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
+        self._release_slot_kv(slot["sid"], slot.get("match"),
+                              retain_tokens=retain,
+                              salt=req.cache_salt)
         req._finish(state, err)
         now = time.monotonic()
         self.tracer.add_span(req.rid, "evict", slot.get("span_end", now),
@@ -474,6 +645,10 @@ class EngineCore:
             if s is not None:
                 self._evict(s, RequestState.FAILED, RejectedError(
                     f"in-flight KV state lost: {err!r}"))
+        if self._prefix_cache is not None:
+            # the device pools are rebuilt zeroed — every retained page's
+            # contents are gone, so cached entries must go with them
+            self._prefix_cache.clear()
 
     def _run_exclusive(self, req: Request):
         if req.expired():
@@ -540,4 +715,6 @@ class EngineCore:
             if s is not None:
                 self._evict(s, RequestState.CANCELLED,
                             RejectedError("serving engine closed"))
+        if self._prefix_cache is not None:
+            self._prefix_cache.clear()
         self._pool.free(self._max_batch)

@@ -153,12 +153,15 @@ class ServingMetrics:
 
     def snapshot(self, queue_depth: int = 0, active: int = 0,
                  max_batch: int = 0,
-                 kv_pool: Optional[Dict] = None) -> Dict:
+                 kv_pool: Optional[Dict] = None,
+                 prefix_cache: Optional[Dict] = None) -> Dict:
         """Render everything to a plain dict (the ``GET /metrics`` JSON
         body).  Latency series carry lifetime ``count``/``mean`` plus
         reservoir-window ``p50_recent``/``p99_recent``/``max_recent``
         (see ``_Series``).  ``kv_pool`` is the block-pool occupancy
-        gauge set supplied by ``EngineCore`` (total/used/free blocks)."""
+        gauge set supplied by ``EngineCore`` (total/used/free blocks);
+        ``prefix_cache`` is ``PrefixCache.stats_snapshot()`` when the
+        core runs with prefix caching enabled."""
         tps = self.tokens_per_second()
         with self._lock:
             out = {
@@ -186,6 +189,8 @@ class ServingMetrics:
             }
             if kv_pool is not None:
                 out["kv_pool"] = dict(kv_pool)
+            if prefix_cache is not None:
+                out["prefix_cache"] = dict(prefix_cache)
             return out
 
     def to_prometheus(self, snapshot: Optional[Dict] = None,

@@ -1,0 +1,10 @@
+"""Automatic prefix caching for the continuous-batching serving engine.
+
+See ``tree.py`` for the radix-tree index and ownership model, and
+docs/SERVING.md ("Prefix caching") for the end-to-end design:
+match-on-admit, copy-on-write tail blocks, retain-on-finish, and
+LRU + watermark eviction.
+"""
+from .tree import PrefixCache, PrefixMatch
+
+__all__ = ["PrefixCache", "PrefixMatch"]

@@ -1,0 +1,345 @@
+"""Radix-tree prefix cache over paged KV blocks.
+
+The vLLM/SGLang automatic-prefix-caching design adapted to this
+framework's native block pool (native/kv_allocator.cc): completed
+sequences donate their full KV pages to a radix tree keyed on
+page-sized token chunks, and admission walks the tree to map a new
+request's block table onto the shared physical blocks — the prefill
+then covers only the uncached suffix.
+
+Ownership model (the part that keeps the pool honest):
+
+  * every node (and every partial-tail entry) holds exactly ONE native
+    reference on its physical block (``pool.ref_block`` at insert,
+    ``pool.unref_block`` at evict);
+  * a sequence that reuses shared blocks holds its own references via
+    ``pool.assign`` — freeing the sequence never touches the tree's
+    reference, and evicting the tree entry never yanks a block out from
+    under a live sequence (the block survives until every holder drops
+    it);
+  * matched nodes are PINNED (``pins`` — an active-consumer count, not
+    a block refcount) for the lifetime of the consuming request so
+    eviction can never drop a node a queued row is about to attend to.
+
+Chunks are keyed by the exact token tuple: dict lookup hashes the
+tuple (the "block-aligned token-chunk hash") and the tuple equality
+check makes collisions impossible, so a hit is always a true prefix
+match.  ``cache_salt`` isolates tenants: each salt owns a disjoint
+tree, so one tenant can never observe (via TTFT timing) whether
+another tenant's prompt shares its prefix.
+
+Partial tail blocks (a prompt ending mid-page) are cached as
+``partials`` entries keyed by the partial token tuple.  Consumers never
+share them in place — the engine copy-on-writes the page into a fresh
+block before writing the suffix — so partials are never pinned.
+
+Eviction is leaf-first LRU over entries with ``pins == 0``: partial
+entries and childless nodes.  It runs on demand (``ensure_free``) when
+admission needs blocks, and after every release (``enforce_watermark``)
+to keep the cache under ``watermark × pool_blocks`` retained blocks.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+def _common(a, b) -> int:
+    """Length of the common prefix of two token sequences."""
+    k = 0
+    for x, y in zip(a, b):
+        if x != y:
+            break
+        k += 1
+    return k
+
+
+class _Node:
+    """One page-sized chunk of a cached prefix."""
+    __slots__ = ("chunk", "block", "children", "parent", "pins",
+                 "last_used", "partials")
+
+    def __init__(self, chunk: Tuple[int, ...], block: Optional[int],
+                 parent: Optional["_Node"]):
+        self.chunk = chunk
+        self.block = block          # physical block id (None for roots)
+        self.children: Dict[Tuple[int, ...], _Node] = {}
+        self.parent = parent
+        self.pins = 0               # active consumers (NOT block refcount)
+        self.last_used = 0
+        # partial tail pages extending this prefix: token tuple (shorter
+        # than a page) -> [block, last_used]
+        self.partials: Dict[Tuple[int, ...], List[int]] = {}
+
+
+class PrefixMatch:
+    """The result of ``PrefixCache.match`` — pinned until ``release``."""
+    __slots__ = ("nodes", "blocks", "partial_block", "partial_len",
+                 "partial_node", "salt", "_page")
+
+    def __init__(self, nodes, blocks, partial_block, partial_len,
+                 partial_node, salt, page):
+        self.nodes: List[_Node] = nodes
+        self.blocks: List[int] = blocks        # full shared blocks
+        self.partial_block = partial_block     # tail block to CoW, or None
+        self.partial_len = partial_len         # valid tokens in the tail
+        self.partial_node = partial_node       # pinned source node, if any
+        self.salt = salt
+        self._page = page
+
+    @property
+    def cached_tokens(self) -> int:
+        return len(self.blocks) * self._page + self.partial_len
+
+
+class PrefixCache:
+    """Radix-tree index from token prefixes to ref-counted KV blocks.
+
+    Thread-safe (one lock) though the serving scheduler drives it from a
+    single thread; the lock keeps ``stats_snapshot`` readable from HTTP
+    handler threads mid-step.
+    """
+
+    def __init__(self, pool, page_size: int, watermark: float = 0.5):
+        """``pool``: a ``native.KVBlockPool``.  ``watermark``: retained
+        (unpinned-or-not) cache blocks are evicted down to
+        ``watermark × pool.num_blocks`` after every request release."""
+        self._pool = pool
+        self.page = int(page_size)
+        self.watermark = float(watermark)
+        self._roots: Dict[object, _Node] = {}
+        self._clock = 0
+        self._lock = threading.Lock()
+        # counters (rendered under snapshot["prefix_cache"])
+        self.queries = 0
+        self.hits = 0
+        self.cached_tokens_total = 0
+        self.prompt_tokens_total = 0
+        self.inserts = 0
+        self.evicted_blocks = 0
+        self.cow_copies = 0
+        self.cached_blocks = 0      # gauge: blocks the tree holds refs on
+        self.node_count = 0         # gauge: full-page nodes
+
+    # ------------------------------------------------------------- match
+    def match(self, tokens, salt=None) -> PrefixMatch:
+        """Longest cached prefix of ``tokens`` (full pages, then the best
+        partial tail), capped at ``len(tokens) - 1`` so at least one
+        prompt token is always recomputed (its logits seed sampling).
+        Matched nodes are pinned — call ``release`` when the request
+        leaves its slot."""
+        toks = [int(t) for t in tokens]
+        with self._lock:
+            self._clock += 1
+            self.queries += 1
+            self.prompt_tokens_total += len(toks)
+            usable = len(toks) - 1
+            node = self._roots.get(salt)
+            nodes: List[_Node] = []
+            blocks: List[int] = []
+            depth = 0
+            while node is not None and (depth + 1) * self.page <= usable:
+                chunk = tuple(toks[depth * self.page:
+                                   (depth + 1) * self.page])
+                child = node.children.get(chunk)
+                if child is None:
+                    break
+                child.pins += 1
+                child.last_used = self._clock
+                nodes.append(child)
+                blocks.append(child.block)
+                node = child
+                depth += 1
+            partial_block, partial_len, partial_node = None, 0, None
+            best_entry = None
+            if node is not None:
+                rem = toks[depth * self.page:usable]
+                best = 0
+                # candidate tails: explicit partial entries, and full-page
+                # child chunks sharing a proper prefix with the remainder
+                # (the resubmitted-identical-prompt case) — either way the
+                # consumer CoW-copies the block before writing its suffix
+                for ptoks, entry in node.partials.items():
+                    k = _common(ptoks, rem)
+                    if k > best:
+                        best, partial_block = k, entry[0]
+                        best_entry, partial_node = entry, None
+                for chunk, child in node.children.items():
+                    k = _common(chunk, rem)
+                    if k > best:
+                        best, partial_block = k, child.block
+                        best_entry, partial_node = None, child
+                partial_len = best
+                if best == 0:
+                    partial_block = None
+                elif partial_node is not None:
+                    partial_node.pins += 1
+                    partial_node.last_used = self._clock
+                elif best_entry is not None:
+                    best_entry[1] = self._clock
+            m = PrefixMatch(nodes, blocks, partial_block, partial_len,
+                            partial_node, salt, self.page)
+            if m.cached_tokens > 0:
+                self.hits += 1
+                self.cached_tokens_total += m.cached_tokens
+            return m
+
+    def release(self, match: PrefixMatch):
+        """Unpin a match's nodes (request left its slot)."""
+        with self._lock:
+            for node in match.nodes:
+                if node.pins > 0:
+                    node.pins -= 1
+            match.nodes = []
+            match.blocks = []
+            self._drop_partial(match)
+
+    @staticmethod
+    def _drop_partial(match: PrefixMatch):
+        if match.partial_node is not None and match.partial_node.pins > 0:
+            match.partial_node.pins -= 1
+        match.partial_block, match.partial_len = None, 0
+        match.partial_node = None
+
+    def trim(self, match: PrefixMatch, max_tokens: int):
+        """Shrink a match to at most ``max_tokens`` cached tokens
+        (partial tail first, then whole pages), unpinning what's
+        dropped.  The engine uses this to keep
+        ``cached + padded_suffix <= table window``."""
+        with self._lock:
+            if match.partial_len and match.cached_tokens > max_tokens:
+                self._drop_partial(match)
+            while match.cached_tokens > max_tokens and match.nodes:
+                node = match.nodes.pop()
+                match.blocks.pop()
+                if node.pins > 0:
+                    node.pins -= 1
+
+    # ------------------------------------------------------------ insert
+    def insert(self, tokens, blocks, salt=None) -> int:
+        """Retain a finished sequence's KV: walk/extend the tree over
+        ``tokens``' full pages (``blocks`` is the sequence's block table,
+        one entry per page) and cache any mid-page tail as a partial.
+        Existing entries win dedup — the duplicate block stays owned by
+        the sequence and returns to the pool when the sequence is freed.
+        Returns the number of newly retained blocks."""
+        toks = [int(t) for t in tokens]
+        with self._lock:
+            self._clock += 1
+            self.inserts += 1
+            root = self._roots.get(salt)
+            if root is None:
+                root = self._roots[salt] = _Node((), None, None)
+            node = root
+            retained = 0
+            n_full = len(toks) // self.page
+            for i in range(n_full):
+                if i >= len(blocks):
+                    return retained
+                chunk = tuple(toks[i * self.page:(i + 1) * self.page])
+                child = node.children.get(chunk)
+                if child is None:
+                    blk = int(blocks[i])
+                    self._pool.ref_block(blk)
+                    child = _Node(chunk, blk, node)
+                    node.children[chunk] = child
+                    self.cached_blocks += 1
+                    self.node_count += 1
+                    retained += 1
+                child.last_used = self._clock
+                node = child
+            rem = tuple(toks[n_full * self.page:])
+            if rem and n_full < len(blocks):
+                entry = node.partials.get(rem)
+                if entry is None:
+                    blk = int(blocks[n_full])
+                    self._pool.ref_block(blk)
+                    node.partials[rem] = [blk, self._clock]
+                    self.cached_blocks += 1
+                    retained += 1
+                else:
+                    entry[1] = self._clock
+            return retained
+
+    def on_cow(self, n: int = 1):
+        """The engine copied a partial tail block before writing into it."""
+        with self._lock:
+            self.cow_copies += n
+
+    # ---------------------------------------------------------- eviction
+    def _candidates(self):
+        """(last_used, kind, node, key) for every evictable entry:
+        partial entries, and unpinned childless partial-less nodes."""
+        out = []
+        stack = list(self._roots.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            for ptoks, entry in node.partials.items():
+                out.append((entry[1], "partial", node, ptoks))
+            if (node.block is not None and not node.children
+                    and not node.partials and node.pins == 0):
+                out.append((node.last_used, "node", node, node.chunk))
+        return out
+
+    def _evict_one(self) -> bool:
+        cands = self._candidates()
+        if not cands:
+            return False
+        _, kind, node, key = min(cands, key=lambda c: c[0])
+        if kind == "partial":
+            blk, _ = node.partials.pop(key)
+        else:
+            blk = node.block
+            if node.parent is not None:
+                node.parent.children.pop(key, None)
+            self.node_count -= 1
+        self._pool.unref_block(blk)
+        self.cached_blocks -= 1
+        self.evicted_blocks += 1
+        return True
+
+    def ensure_free(self, need_free: int) -> bool:
+        """Evict LRU entries until the pool has ``need_free`` free blocks
+        (or nothing more is evictable).  Returns success."""
+        with self._lock:
+            while self._pool.free_blocks < need_free:
+                if not self._evict_one():
+                    return False
+            return True
+
+    def enforce_watermark(self):
+        """Evict down to ``watermark × pool_blocks`` retained blocks."""
+        cap = int(self.watermark * self._pool.num_blocks)
+        with self._lock:
+            while self.cached_blocks > cap:
+                if not self._evict_one():
+                    break
+
+    def clear(self):
+        """Drop every unpinned entry (engine close)."""
+        with self._lock:
+            while self._evict_one():
+                pass
+            self._roots = {r: n for r, n in self._roots.items()
+                           if n.children or n.partials}
+
+    # ------------------------------------------------------------- stats
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "queries": self.queries,
+                "hits": self.hits,
+                "hit_rate": (self.hits / self.queries
+                             if self.queries else 0.0),
+                "cached_tokens": self.cached_tokens_total,
+                "prompt_tokens": self.prompt_tokens_total,
+                "token_ratio": (self.cached_tokens_total /
+                                self.prompt_tokens_total
+                                if self.prompt_tokens_total else 0.0),
+                "inserts": self.inserts,
+                "evicted_blocks": self.evicted_blocks,
+                "cow_copies": self.cow_copies,
+                "cached_blocks": self.cached_blocks,
+                "nodes": self.node_count,
+            }

@@ -117,6 +117,64 @@ def build_prefill(engine, plen, max_pages):
     return jax.jit(run, donate_argnums=(6, 7))
 
 
+def build_prefix_prefill(engine, plen, max_pages):
+    """Windowed suffix prefill for prefix-cache hits: the row's first
+    ``offsets[0]`` positions already hold cached KV (shared blocks mapped
+    into ``tables``), so only the suffix chunk runs through the model.
+    The chunk writes KV at absolute positions ``offsets + i`` and
+    attends over the row's whole gathered page window with an
+    absolute-position causal mask (see
+    ``transformer_block._forward_paged`` windowed branch), which keeps
+    logits bitwise-identical to a cold full prefill: the reduce window
+    is the constant ``max_pages * page`` for every (plen, offset), so
+    XLA emits the same reduction order, masked slots contribute exactly
+    zero, and the cached KV values are the very floats the cold path
+    would have recomputed.
+
+    ``run(params, ids[1,plen], lengths[1], offsets[1],
+    tables[1,max_pages], samp, keys[1,2], k_pages, v_pages)`` →
+    ``(tok[1], fin[1], k_pages, v_pages)``; pools are donated.
+    ``lengths`` counts valid suffix tokens within the padded chunk;
+    cold requests (offset 0) also run through this family when the
+    prefix cache is enabled, so one executable per plen serves both."""
+    L = engine._num_layers
+
+    def run(params, ids, lengths, offsets, tables, samp, keys,
+            k_pages, v_pages):
+        b = ids.shape[0]
+        marker = jnp.zeros((b,), jnp.int32)
+        caches = [(k_pages[i], v_pages[i], tables, offsets, marker)
+                  for i in range(L)]
+        pos2d = offsets[:, None] + jnp.broadcast_to(
+            jnp.arange(plen, dtype=jnp.int32)[None], (b, plen))
+        logits, caches = engine._model_step(params, ids, pos2d, None,
+                                            caches)
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
+        steps = jnp.zeros((b,), jnp.int32)
+        proc = _process_rows(last, samp, steps)
+        tok = _pick_rows(proc, samp, steps, keys)
+        fin = jnp.logical_and(samp["eos"] >= 0, tok == samp["eos"])
+        return (tok, fin,
+                [c[0] for c in caches], [c[1] for c in caches])
+
+    return jax.jit(run, donate_argnums=(7, 8))
+
+
+def build_page_copy(engine):
+    """Copy one physical page across every layer's pools (the
+    copy-on-write step for a shared partial tail block):
+    ``run(params, src[1], dst[1], k_pages, v_pages)`` →
+    ``(src, k_pages, v_pages)``; pools are donated.  One executable per
+    pool shape, reused for every CoW."""
+    def run(params, src, dst, k_pages, v_pages):
+        k_pages = [kp.at[dst[0]].set(kp[src[0]]) for kp in k_pages]
+        v_pages = [vp.at[dst[0]].set(vp[src[0]]) for vp in v_pages]
+        return (src, k_pages, v_pages)
+
+    return jax.jit(run, donate_argnums=(3, 4))
+
+
 def build_decode(engine, batch, chunk, max_pages):
     """One fused decode chunk over ALL batch rows: a ``lax.scan`` of
     ``chunk`` steps (amortizing host dispatch), each feeding every row's

@@ -55,12 +55,16 @@ class Request:
 
     def __init__(self, prompt, config, timeout_s: Optional[float] = None,
                  kind: str = "batch",
-                 exclusive_fn: Optional[Callable] = None):
+                 exclusive_fn: Optional[Callable] = None,
+                 cache_salt: Optional[str] = None):
         self.rid = next(_rid_counter)
         self.prompt = (None if prompt is None
                        else np.asarray(prompt, np.int32).reshape(-1))
         self.config = config
         self.kind = kind
+        # prefix-cache isolation domain: requests only share cached KV
+        # with requests carrying the same salt (multi-tenant isolation)
+        self.cache_salt = cache_salt
         self.exclusive_fn = exclusive_fn
         self.arrival = time.monotonic()
         self.deadline = (None if timeout_s is None

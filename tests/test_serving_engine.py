@@ -331,3 +331,29 @@ def test_close_rejects_queued_and_cancels_active(make_core):
     assert rb.state is RequestState.REJECTED
     with pytest.raises(RejectedError):
         core.submit(_prompt(35), g)
+
+
+def test_mid_decode_failure_frees_blocks(make_core, engine, monkeypatch):
+    """A decode-chunk exception fails every in-flight row through the
+    shared release path (``_release_slot_kv``); no per-request block
+    accounting may be dropped — the pool returns to its baseline."""
+    core = make_core()
+    baseline = core._pool.free_blocks
+    real = engine.run_paged_program
+
+    def boom(key, builder, *args):
+        if isinstance(key, tuple) and key and key[0] == "serve-step":
+            raise RuntimeError("injected decode failure")
+        return real(key, builder, *args)
+
+    monkeypatch.setattr(engine, "run_paged_program", boom)
+    reqs = core.submit(np.stack([_prompt(70), _prompt(71)]),
+                       GenerationConfig(max_new_tokens=8))
+    core.run_once()                     # admit both, decode chunk raises
+    assert all(r.state is RequestState.FAILED for r in reqs)
+    assert core.active_count == 0
+    assert core._pool.free_blocks == baseline
+    monkeypatch.setattr(engine, "run_paged_program", real)
+    (again,) = core.submit(_prompt(72), GenerationConfig(max_new_tokens=4))
+    _drive(core, [again])               # core stays usable afterwards
+    assert again.state is RequestState.DONE

@@ -55,7 +55,14 @@ def fabricated_exposition():
     m.on_completed(0.5)
     snap = m.snapshot(queue_depth=1, active=2, max_batch=4,
                       kv_pool={"total_blocks": 32, "used_blocks": 8,
-                               "free_blocks": 24, "occupancy": 0.25})
+                               "free_blocks": 24, "occupancy": 0.25},
+                      prefix_cache={"queries": 6, "hits": 4,
+                                    "hit_rate": 4 / 6,
+                                    "cached_tokens": 96,
+                                    "prompt_tokens": 160,
+                                    "token_ratio": 0.6, "inserts": 5,
+                                    "evicted_blocks": 2, "cow_copies": 1,
+                                    "cached_blocks": 7, "nodes": 6})
 
     # local CompileLog (not the process singleton): one prefill, one
     # warmed decode, one post-warmup recompile so the recompile/storm

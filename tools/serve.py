@@ -60,14 +60,19 @@ def _core():
             from paddle_infer_tpu.serving import EngineCore
 
             engine = PagedGenerationEngine(
-                _STATE["model"], page_size=_STATE["page_size"])
+                _STATE["model"], page_size=_STATE["page_size"],
+                prompt_bucket=_STATE.get("prompt_bucket") or 64)
             _STATE["core"] = EngineCore(
                 engine,
                 max_batch=_STATE["max_batch"],
                 max_queue=_STATE["max_queue"],
                 decode_chunk=_STATE["decode_chunk"],
                 default_timeout_s=_STATE["request_timeout"],
-                max_model_len=_STATE["max_model_len"]).start()
+                max_model_len=_STATE["max_model_len"],
+                enable_prefix_cache=_STATE.get("enable_prefix_cache",
+                                               False),
+                prefix_cache_watermark=_STATE.get(
+                    "prefix_cache_watermark", 0.5)).start()
         return _STATE["core"]
 
 
@@ -128,7 +133,7 @@ def _error_code(e) -> int:
     return 500
 
 
-def _generate(ids, g, timeout_s):
+def _generate(ids, g, timeout_s, cache_salt=None):
     """Route one /generate body; returns (tokens [b, max_new], extra).
     ``extra["request_ids"]`` always carries the engine request ids so
     the client can fetch the span trace via ``GET /trace/<rid>``."""
@@ -145,7 +150,8 @@ def _generate(ids, g, timeout_s):
         return toks, {"speculative": True, "acceptance": acceptance,
                       "request_ids": [req.rid]}
     if core.batchable(g):
-        reqs = core.submit(ids, g, timeout_s=timeout_s)
+        reqs = core.submit(ids, g, timeout_s=timeout_s,
+                           cache_salt=cache_salt)
         return (np.stack([r.padded_result(timeout=None) for r in reqs]),
                 {"request_ids": [r.rid for r in reqs]})
     # beams / repetition penalty: exclusive dense-engine call
@@ -255,6 +261,12 @@ class Handler(BaseHTTPRequestHandler):
             ids = np.asarray(body["ids"], np.int32)
             g = _gen_config(body)
             timeout_s = body.get("timeout_s", _STATE["request_timeout"])
+            # per-request prefix-cache isolation domain; clients that
+            # must never share cached KV (multi-tenant) set a tenant
+            # salt — docs/SERVING.md "Prefix caching"
+            cache_salt = body.get("cache_salt")
+            if cache_salt is not None:
+                cache_salt = str(cache_salt)
         except Exception as e:
             self._json(400, {"error": f"bad request: {e!r}"})
             return
@@ -267,7 +279,8 @@ class Handler(BaseHTTPRequestHandler):
 
         try:
             if self.path == "/generate":
-                toks, extra = _generate(ids, g, timeout_s)
+                toks, extra = _generate(ids, g, timeout_s,
+                                        cache_salt=cache_salt)
                 # detokenize/serialize span appended post-finish (the
                 # tracer ring keeps completed traces mutable for this);
                 # recorded BEFORE the response bytes go out so the trace
@@ -286,7 +299,8 @@ class Handler(BaseHTTPRequestHandler):
                     return
                 # submit BEFORE headers so admission errors (429/504/400)
                 # still map to status codes
-                reqs = _core().submit(ids, g, timeout_s=timeout_s)
+                reqs = _core().submit(ids, g, timeout_s=timeout_s,
+                                      cache_salt=cache_salt)
                 chunks = _stream_chunks(
                     reqs, g, chunk_size=int(body.get("chunk_size", 8)))
                 self.send_response(200)
@@ -333,6 +347,21 @@ def main(argv=None):
                          "sizes each slot's KV reservation (defaults to "
                          "the model's max positions — set it lower to "
                          "shrink the pool the decode step drags along)")
+    ap.add_argument("--enable_prefix_cache", action="store_true",
+                    help="retain finished sequences' KV pages in a radix "
+                         "tree and reuse them for shared prompt prefixes "
+                         "(docs/SERVING.md); per-request opt-out via a "
+                         "\"cache_salt\" body field")
+    ap.add_argument("--prefix_cache_watermark", type=float, default=0.5,
+                    help="retained cache blocks are LRU-evicted down to "
+                         "this fraction of the KV pool after each "
+                         "request release")
+    ap.add_argument("--prompt_bucket", type=int, default=64,
+                    help="prefill length rounds up to this multiple (one "
+                         "executable per bucket); keep it well below "
+                         "max_model_len or prefix-cache hits degrade to "
+                         "cold prefills (the padded suffix must still "
+                         "fit the slot window)")
     ap.add_argument("--draft_dir", default=None,
                     help="optional draft model for speculative decoding "
                          "of greedy requests")
@@ -348,6 +377,9 @@ def main(argv=None):
     _STATE["decode_chunk"] = args.decode_chunk
     _STATE["request_timeout"] = args.request_timeout
     _STATE["max_model_len"] = args.max_model_len
+    _STATE["enable_prefix_cache"] = args.enable_prefix_cache
+    _STATE["prefix_cache_watermark"] = args.prefix_cache_watermark
+    _STATE["prompt_bucket"] = args.prompt_bucket
     _STATE["draft_model"] = (AutoModel.from_pretrained(args.draft_dir)
                              if args.draft_dir else None)
     _STATE["num_draft_tokens"] = args.num_draft_tokens
