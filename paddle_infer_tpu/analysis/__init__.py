@@ -1,0 +1,24 @@
+"""tpulint: dependency-free AST static analysis for the TPU serving
+stack.
+
+The framework (``core``) knows nothing about TPUs; the rules
+(``rules/``) encode this codebase's real failure modes — host syncs in
+the decode hot path, recompile-storm cache keys, lock-undisciplined
+attributes, trace-time state capture, missing KV-buffer donation,
+metric-catalog drift, and Pallas grid-rank mismatches.  The CLI lives
+in ``tools/tpulint.py``; the rule catalog is documented in
+``docs/ANALYSIS.md``.
+
+The package is import-light on purpose (stdlib only, no jax/numpy) so
+the linter runs even when the runtime deps are broken — linting must
+be able to diagnose the commit that broke them.
+"""
+from __future__ import annotations
+
+from .core import (Analyzer, FileContext, Finding, ProjectContext,
+                   Rule, apply_baseline, load_baseline, write_baseline)
+from .rules import RULE_CLASSES, all_rules
+
+__all__ = ["Analyzer", "FileContext", "Finding", "ProjectContext",
+           "Rule", "RULE_CLASSES", "all_rules", "apply_baseline",
+           "load_baseline", "write_baseline"]

@@ -1,0 +1,344 @@
+"""tpulint core: findings, suppression, baselines, and the rule engine.
+
+The analyzer is deliberately dependency-free: plain ``ast`` over the
+package source, no imports of the analyzed modules (so it runs in CI
+before anything else does, and a broken module still gets linted).
+Structure:
+
+  * ``Rule`` subclasses implement ``check_file(FileContext)`` for
+    per-file checks and/or ``finalize(ProjectContext)`` for whole-repo
+    checks (e.g. code ↔ docs metric sync);
+  * ``Analyzer`` walks the target paths, parses each file once, runs
+    every rule, and applies per-line suppression comments
+    (``# tpulint: disable=<rule>[,<rule>...]`` on the offending line,
+    ``# tpulint: disable-next-line=<rule>`` on the line above, or
+    ``# tpulint: skip-file`` anywhere in the file);
+  * baselines (``load_baseline`` / ``apply_baseline`` /
+    ``write_baseline``) let a repo adopt a new rule without fixing
+    every legacy finding at once.  Fingerprints deliberately exclude
+    line numbers so unrelated edits don't churn the baseline file.
+
+Rule-specific AST helpers that more than one rule needs (dotted-name
+rendering, jit-wrapped function discovery) live here too.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*(disable|disable-next-line)\s*=\s*"
+    r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+_SKIP_FILE_RE = re.compile(r"#\s*tpulint:\s*skip-file\b")
+
+
+class Finding:
+    """One rule violation at a source location.
+
+    ``symbol`` is the enclosing qualified name (``Class.method``) —
+    together with ``rule``/``path``/``message`` it forms the baseline
+    fingerprint, which excludes the line number on purpose (edits above
+    a legacy finding must not un-baseline it)."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "symbol")
+
+    def __init__(self, rule: str, path: str, line: int, col: int,
+                 message: str, symbol: str = ""):
+        self.rule = rule
+        self.path = path
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.symbol = symbol
+
+    def fingerprint(self) -> Tuple[str, str, str, str]:
+        return (self.rule, self.path, self.symbol, self.message)
+
+    def sort_key(self):
+        return (self.path, self.line, self.col, self.rule, self.message)
+
+    def format(self) -> str:
+        where = f" [{self.symbol}]" if self.symbol else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule}: {self.message}{where}")
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "symbol": self.symbol}
+
+
+class FileContext:
+    """One parsed source file plus its suppression table."""
+
+    def __init__(self, path: str, relpath: str, source: str,
+                 tree: ast.AST):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        self.skip_file = bool(_SKIP_FILE_RE.search(source))
+        self._suppress: Dict[int, set] = {}
+        for i, line in enumerate(self.lines, 1):
+            m = _SUPPRESS_RE.search(line)
+            if m is None:
+                continue
+            rules = {r.strip() for r in m.group(2).split(",")}
+            target = i + 1 if m.group(1) == "disable-next-line" else i
+            self._suppress.setdefault(target, set()).update(rules)
+        self._parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[id(child)] = node
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        rules = self._suppress.get(line)
+        return bool(rules) and (rule in rules or "all" in rules)
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    def qualname(self, node: ast.AST) -> str:
+        """Dotted chain of enclosing class/function names."""
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parent(cur)
+        return ".".join(reversed(parts))
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule, self.relpath, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0) + 1, message,
+                       self.qualname(node))
+
+
+class ProjectContext:
+    """Whole-run state handed to ``Rule.finalize``."""
+
+    def __init__(self, root: str, config: Optional[dict] = None):
+        self.root = root
+        self.config = config or {}
+        self.files: List[FileContext] = []
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``name``/``rationale`` and
+    override ``check_file`` and/or ``finalize``.  ``path_scope`` limits
+    a per-file rule to relpaths containing any of the substrings (empty
+    = every file)."""
+
+    id = ""
+    name = ""
+    rationale = ""
+    path_scope: Tuple[str, ...] = ()
+
+    def in_scope(self, relpath: str) -> bool:
+        if not self.path_scope:
+            return True
+        rel = relpath.replace(os.sep, "/")
+        return any(seg in rel for seg in self.path_scope)
+
+    def check_file(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        return ()
+
+
+class Analyzer:
+    """Run a rule set over files/directories and collect findings."""
+
+    def __init__(self, rules: List[Rule], root: Optional[str] = None,
+                 config: Optional[dict] = None):
+        self.rules = rules
+        self.root = os.path.abspath(root or os.getcwd())
+        self.config = config or {}
+
+    def _iter_files(self, paths: Iterable[str]) -> List[str]:
+        out = []
+        for p in paths:
+            p = os.path.abspath(p)
+            if os.path.isfile(p):
+                out.append(p)
+                continue
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if not d.startswith(".") and d != "__pycache__")
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        return out
+
+    def run(self, paths: Iterable[str]) -> Tuple[List[Finding], int]:
+        project = ProjectContext(self.root, dict(self.config))
+        findings: List[Finding] = []
+        files = self._iter_files(paths)
+        for path in files:
+            relpath = os.path.relpath(path, self.root).replace(os.sep, "/")
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                tree = ast.parse(source, filename=path)
+            except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                findings.append(Finding(
+                    "parse-error", relpath,
+                    getattr(e, "lineno", 1) or 1, 1,
+                    f"file does not parse: {e.__class__.__name__}"))
+                continue
+            ctx = FileContext(path, relpath, source, tree)
+            if ctx.skip_file:
+                continue
+            project.files.append(ctx)
+            for rule in self.rules:
+                if not rule.in_scope(relpath):
+                    continue
+                for f in rule.check_file(ctx):
+                    if not ctx.suppressed(f.line, f.rule):
+                        findings.append(f)
+        ctx_by_rel = {c.relpath: c for c in project.files}
+        for rule in self.rules:
+            for f in rule.finalize(project):
+                ctx = ctx_by_rel.get(f.path)
+                if ctx is not None and ctx.suppressed(f.line, f.rule):
+                    continue
+                findings.append(f)
+        findings.sort(key=Finding.sort_key)
+        return findings, len(files)
+
+
+# ------------------------------------------------------------- baseline
+def load_baseline(path: str) -> Dict[Tuple[str, str, str, str], int]:
+    """Baseline file -> fingerprint -> allowed count.  A missing file is
+    an empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    out: Dict[Tuple[str, str, str, str], int] = {}
+    for e in data.get("entries", []):
+        key = (e["rule"], e["path"], e.get("symbol", ""), e["message"])
+        out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[Tuple[str, str, str, str], int]
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    """Split findings into (new, baselined).  Each baseline entry
+    absorbs up to ``count`` findings with the same fingerprint."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        key = f.fingerprint()
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+def write_baseline(path: str, findings: List[Finding]) -> int:
+    """Rewrite the baseline deterministically: path-relative, sorted,
+    duplicate fingerprints collapsed into counts."""
+    counts: Dict[Tuple[str, str, str, str], int] = {}
+    for f in findings:
+        counts[f.fingerprint()] = counts.get(f.fingerprint(), 0) + 1
+    entries = [{"rule": rule, "path": rel, "symbol": symbol,
+                "message": message, "count": n}
+               for (rule, rel, symbol, message), n in
+               sorted(counts.items())]
+    payload = {"version": 1, "entries": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return len(entries)
+
+
+# ----------------------------------------------------- shared AST utils
+def dotted(node: ast.AST) -> str:
+    """Render ``a.b.c`` attribute chains ('' when not a plain chain)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    return ""
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+_JIT_NAMES = ("jax.jit", "jit", "pjit", "jax.pjit")
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for ``jax.jit`` / ``jit`` or a ``functools.partial(jax.jit,
+    ...)`` expression."""
+    d = dotted(node)
+    if d in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fd = dotted(node.func)
+        if fd in _JIT_NAMES:
+            return True
+        if fd in ("functools.partial", "partial") and node.args \
+                and dotted(node.args[0]) in _JIT_NAMES:
+            return True
+    return False
+
+
+def jit_functions(tree: ast.AST) -> Dict[str, List[ast.FunctionDef]]:
+    """Functions that become XLA programs, two ways:
+
+      * decorated: ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``
+        / ``@to_static``;
+      * wrapped: ``jax.jit(fn, ...)`` somewhere in the module referring
+        to ``fn`` by name (the builder pattern serving/programs.py
+        uses).
+
+    Returns name -> [FunctionDef] (same name can repeat across builder
+    methods)."""
+    defs: Dict[str, List[ast.FunctionDef]] = {}
+    wrapped: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec) or dotted(dec) == "to_static" or (
+                        isinstance(dec, ast.Call)
+                        and dotted(dec.func) == "to_static"):
+                    defs.setdefault(node.name, []).append(node)
+                    break
+        elif isinstance(node, ast.Call) and _is_jit_expr(node.func) \
+                and node.args and isinstance(node.args[0], ast.Name):
+            wrapped.add(node.args[0].id)
+    if wrapped:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and node.name in wrapped:
+                lst = defs.setdefault(node.name, [])
+                if node not in lst:
+                    lst.append(node)
+    return defs
+
+
+def param_names(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in
+             (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names

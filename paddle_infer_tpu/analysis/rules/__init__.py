@@ -1,0 +1,51 @@
+"""tpulint rule registry.
+
+``all_rules()`` returns fresh instances so two Analyzer runs never
+share rule state; ``RULE_CLASSES`` is the ordered catalog the CLI's
+``--list-rules`` and the docs generator read.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Rule
+from .donation import DonationRule
+from .host_sync import HostSyncRule
+from .lock_discipline import LockDisciplineRule
+from .metric_sync import MetricSyncRule
+from .pallas_grid import PallasGridRule
+from .recompile_hazard import RecompileHazardRule
+from .traced_branch import TracedBranchRule
+from .tracer_leak import TracerLeakRule
+
+RULE_CLASSES = [
+    HostSyncRule,
+    RecompileHazardRule,
+    LockDisciplineRule,
+    TracerLeakRule,
+    TracedBranchRule,
+    DonationRule,
+    MetricSyncRule,
+    PallasGridRule,
+]
+
+
+def all_rules(only=None) -> List[Rule]:
+    """Instantiate the registry; ``only`` (iterable of rule ids)
+    restricts the set.  Unknown ids raise so a typoed ``--rules``
+    fails loudly instead of silently passing."""
+    if only is None:
+        return [cls() for cls in RULE_CLASSES]
+    wanted = list(only)
+    known = {cls.id: cls for cls in RULE_CLASSES}
+    unknown = [r for r in wanted if r not in known]
+    if unknown:
+        raise ValueError(
+            f"unknown rule id(s): {', '.join(sorted(unknown))} "
+            f"(known: {', '.join(sorted(known))})")
+    return [known[r]() for r in wanted]
+
+
+__all__ = ["RULE_CLASSES", "all_rules", "DonationRule", "HostSyncRule",
+           "LockDisciplineRule", "MetricSyncRule", "PallasGridRule",
+           "RecompileHazardRule", "TracedBranchRule", "TracerLeakRule"]

@@ -1,0 +1,115 @@
+"""missing-donation: KV-cache-threading jitted programs without buffer
+donation.
+
+Every decode-step program takes the KV pool (``k_pages``/``v_pages``)
+in and returns the updated pool out.  Without ``donate_argnums`` /
+``donate_argnames`` XLA must materialize the output pool next to the
+input pool — for a serving-sized cache that doubles the largest live
+buffer and is the difference between fitting a model in HBM or not.
+The aliasing also removes a full pool copy per step.
+
+The rule finds jit sites (decorators and ``jax.jit(fn, ...)`` wraps)
+whose target function carries KV-pool-shaped parameters and flags the
+site when neither donation keyword is present.  Wrapped names resolve
+lexically: the builder pattern defines many local functions all called
+``run``, and ``jax.jit(run)`` must bind to the one in the innermost
+enclosing scope, not to every same-named sibling in the module.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import FileContext, Rule, _is_jit_expr, param_names
+
+_KV_SUFFIXES = ("_pages", "_cache", "_pool")
+_KV_NAMES = {"kv", "kv_pages", "k_pages", "v_pages", "kv_caches",
+             "k_cache", "v_cache", "cache", "caches", "pages"}
+
+
+def _kv_params(fn: ast.FunctionDef) -> List[str]:
+    out = []
+    for p in param_names(fn):
+        low = p.lower()
+        if low in _KV_NAMES or low.endswith(_KV_SUFFIXES):
+            out.append(p)
+    return out
+
+
+def _has_donation(call: ast.Call) -> bool:
+    return any(kw.arg in ("donate_argnums", "donate_argnames")
+               for kw in call.keywords)
+
+
+class DonationRule(Rule):
+    id = "missing-donation"
+    name = "KV-threading jit without donate_argnums"
+    rationale = ("a decode program that returns the updated KV pool "
+                 "without donating the input doubles peak HBM for the "
+                 "cache and pays a full pool copy every step")
+
+    def check_file(self, ctx: FileContext):
+        defs = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef):
+                defs.setdefault(node.name, []).append(node)
+        for node in ast.walk(ctx.tree):
+            yield from self._check_site(ctx, node, defs)
+
+    def _check_site(self, ctx: FileContext, node: ast.AST, defs):
+        # decorated: @jax.jit / @partial(jax.jit, ...) on a KV function
+        if isinstance(node, ast.FunctionDef):
+            kv = _kv_params(node)
+            if not kv:
+                return
+            for dec in node.decorator_list:
+                if not _is_jit_expr(dec):
+                    continue
+                if isinstance(dec, ast.Call) and _has_donation(dec):
+                    continue
+                yield self._finding(ctx, dec if isinstance(dec, ast.Call)
+                                    else node, node.name, kv)
+        # wrapped: jax.jit(fn, ...) where fn resolves lexically
+        elif isinstance(node, ast.Call) and _is_jit_expr(node.func) \
+                and node.args and isinstance(node.args[0], ast.Name):
+            name = node.args[0].id
+            fn = self._resolve(ctx, node, defs.get(name, ()))
+            if fn is not None:
+                kv = _kv_params(fn)
+                if kv and not _has_donation(node):
+                    yield self._finding(ctx, node, name, kv)
+
+    @staticmethod
+    def _resolve(ctx: FileContext, call: ast.Call, candidates):
+        """The candidate def whose enclosing function is the innermost
+        one also enclosing ``call`` (Python lexical scoping)."""
+        def enclosing(node):
+            cur = ctx.parent(node)
+            while cur is not None and not isinstance(
+                    cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cur = ctx.parent(cur)
+            return cur
+
+        ancestors = []
+        cur = call
+        while cur is not None:
+            cur = enclosing(cur)
+            ancestors.append(cur)       # ends with None (module level)
+            if cur is None:
+                break
+        best, best_depth = None, None
+        for fn in candidates:
+            scope = enclosing(fn)
+            if scope in ancestors:
+                depth = ancestors.index(scope)
+                if best_depth is None or depth < best_depth:
+                    best, best_depth = fn, depth
+        return best
+
+    def _finding(self, ctx: FileContext, node: ast.AST, name: str,
+                 kv: List[str]):
+        return ctx.finding(
+            self.id, node,
+            f"jit of '{name}' threads KV buffers "
+            f"({', '.join(kv)}) but declares no donate_argnums/"
+            "donate_argnames — peak HBM doubles for the pool")

@@ -1,0 +1,311 @@
+"""lock-discipline: instance attributes touched both under and outside
+their class's lock.
+
+A lightweight static race detector for the threaded serving stack.  For
+every class that constructs a ``threading.Lock``/``RLock`` on ``self``:
+
+  1. every direct mutation (``self.x = ...``, ``self.x[i] = ...``,
+     ``self.x += ...``, ``del self.x``) and every mutating container
+     call (``self.x.append(...)`` etc.) is recorded together with
+     whether it executes under ``with self.<lock>``;
+  2. the intra-class call graph (``self._helper()`` calls and
+     ``self.prop`` reads) is solved to a fixpoint so a private helper
+     whose every call site holds the lock counts as locked — the
+     dominant pattern here is ``run_once`` taking the lock once and
+     ``_admit``/``_evict`` doing the mutation;
+  3. any attribute with at least one locked direct mutation becomes
+     "guarded"; every mutation OR read of a guarded attribute that can
+     execute without the lock is a finding.
+
+Two deliberate blind-spot reducers:
+
+  * attributes that are only ever *method-called* (never rebound or
+    item-assigned outside ``__init__``) are treated as owning their own
+    synchronization (``RequestQueue``, ``deque``) and skipped;
+  * ``with getattr(self, "_lock", threading.Lock())`` is flagged on its
+    own: when the default fires the statement acquires a brand-new lock
+    that guards nothing.
+
+``__init__`` is construction-time and exempt.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import FileContext, Rule, dotted
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+               "Lock", "RLock", "Condition"}
+_MUTATORS = {"append", "appendleft", "add", "extend", "extendleft",
+             "insert", "pop", "popleft", "popitem", "remove", "discard",
+             "clear", "update", "setdefault", "sort", "reverse"}
+_EXEMPT_METHODS = {"__init__", "__new__", "__del__"}
+
+
+class _Access:
+    __slots__ = ("attr", "kind", "method", "locked", "node")
+
+    def __init__(self, attr, kind, method, locked, node):
+        self.attr = attr
+        self.kind = kind          # "write" | "mutcall" | "read"
+        self.method = method
+        self.locked = locked      # explicitly inside `with self.<lock>`
+        self.node = node
+
+
+class _CallSite:
+    __slots__ = ("caller", "callee", "locked")
+
+    def __init__(self, caller, callee, locked):
+        self.caller = caller
+        self.callee = callee
+        self.locked = locked
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One pass over a method body tracking explicit lock nesting.
+    Nested function/lambda bodies run later (possibly without the
+    lock), so the locked flag resets inside them."""
+
+    def __init__(self, rule, ctx, cls_name, method, lock_attrs,
+                 method_names):
+        self.rule = rule
+        self.ctx = ctx
+        self.cls_name = cls_name
+        self.method = method
+        self.lock_attrs = lock_attrs
+        self.method_names = method_names
+        self.locked = 0
+        self.depth = 0            # > 0 inside a nested def/lambda
+        self.accesses: List[_Access] = []
+        self.calls: List[_CallSite] = []
+        self.getattr_locks: List[ast.AST] = []
+
+    # ----------------------------------------------------- lock context
+    def _is_lock_expr(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return expr.attr in self.lock_attrs \
+                or "lock" in expr.attr.lower()
+        if isinstance(expr, ast.Call) and dotted(expr.func) == "getattr" \
+                and len(expr.args) >= 2 \
+                and isinstance(expr.args[0], ast.Name) \
+                and expr.args[0].id == "self":
+            name = expr.args[1]
+            if isinstance(name, ast.Constant) \
+                    and isinstance(name.value, str) \
+                    and (name.value in self.lock_attrs
+                         or "lock" in name.value.lower()):
+                if len(expr.args) >= 3:
+                    self.getattr_locks.append(expr)
+                return True
+        return False
+
+    def visit_With(self, node: ast.With):
+        is_lock = any(self._is_lock_expr(item.context_expr)
+                      for item in node.items)
+        for item in node.items:
+            self.visit(item.context_expr)
+        if is_lock and self.depth == 0:
+            self.locked += 1
+            for stmt in node.body:
+                self.visit(stmt)
+            self.locked -= 1
+        else:
+            for stmt in node.body:
+                self.visit(stmt)
+
+    def visit_FunctionDef(self, node):
+        self.depth += 1
+        saved, self.locked = self.locked, 0
+        self.generic_visit(node)
+        self.locked = saved
+        self.depth -= 1
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self.depth += 1
+        saved, self.locked = self.locked, 0
+        self.generic_visit(node)
+        self.locked = saved
+        self.depth -= 1
+
+    # --------------------------------------------------------- accesses
+    def _self_attr(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return None
+
+    def _record(self, attr, kind, node):
+        self.accesses.append(_Access(attr, kind, self.method,
+                                     self.locked > 0, node))
+
+    def _mutation_target(self, target: ast.AST):
+        attr = self._self_attr(target)
+        if attr is not None:
+            self._record(attr, "write", target)
+            return
+        if isinstance(target, ast.Subscript):
+            attr = self._self_attr(target.value)
+            if attr is not None:
+                self._record(attr, "write", target)
+                return
+            self.visit(target)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._mutation_target(el)
+        else:
+            self.visit(target)
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            self._mutation_target(t)
+        self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._mutation_target(node.target)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._mutation_target(node.target)
+            self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete):
+        for t in node.targets:
+            self._mutation_target(t)
+
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            attr = self._self_attr(func.value)
+            if attr is not None:
+                if attr in self.method_names:
+                    self.calls.append(_CallSite(
+                        self.method, attr, self.locked > 0))
+                elif func.attr in _MUTATORS:
+                    self._record(attr, "mutcall", node)
+                # plain self.obj.method() — the object synchronizes
+                # itself; neither read nor mutation
+                for arg in node.args:
+                    self.visit(arg)
+                for kw in node.keywords:
+                    self.visit(kw.value)
+                return
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = self._self_attr(node)
+        if attr is not None and isinstance(node.ctx, ast.Load):
+            if attr in self.method_names:
+                self.calls.append(_CallSite(self.method, attr,
+                                            self.locked > 0))
+            elif attr not in self.lock_attrs:
+                self._record(attr, "read", node)
+            return
+        self.generic_visit(node)
+
+
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    name = "attribute escapes its lock"
+    rationale = ("an attribute mutated under a lock in one method and "
+                 "touched without it in another is a data race waiting "
+                 "for a scheduler/HTTP thread interleaving")
+    path_scope = ("serving", "observability", "prefix_cache")
+
+    def check_file(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(ctx, node)
+
+    @staticmethod
+    def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+        out: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and dotted(node.value.func) in _LOCK_CTORS:
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        out.add(t.attr)
+        return out
+
+    def _check_class(self, ctx: FileContext, cls: ast.ClassDef):
+        lock_attrs = self._lock_attrs(cls)
+        if not lock_attrs:
+            return
+        methods = {n.name: n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        scans: Dict[str, _MethodScan] = {}
+        for name, fn in methods.items():
+            scan = _MethodScan(self, ctx, cls.name, name, lock_attrs,
+                               set(methods))
+            for stmt in fn.body:
+                scan.visit(stmt)
+            scans[name] = scan
+            for expr in scan.getattr_locks:
+                yield ctx.finding(
+                    self.id, expr,
+                    "lock acquired via getattr(self, ..., default) — "
+                    "when the default fires this locks a brand-new "
+                    "Lock that guards nothing")
+
+        # fixpoint: a private method whose every intra-class call site
+        # holds the lock (explicitly or transitively) is lock-context
+        sites: Dict[str, List[_CallSite]] = {}
+        for scan in scans.values():
+            for cs in scan.calls:
+                sites.setdefault(cs.callee, []).append(cs)
+        always: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for name in methods:
+                if name in always or not name.startswith("_") \
+                        or name in _EXEMPT_METHODS:
+                    continue
+                callers = sites.get(name)
+                if callers and all(cs.locked or cs.caller in always
+                                   for cs in callers):
+                    always.add(name)
+                    changed = True
+
+        def effective_locked(acc: _Access) -> bool:
+            return acc.locked or acc.method in always
+
+        def unlocked_via(method: str) -> str:
+            if not method.startswith("_"):
+                return "public entry"
+            callers = sorted({cs.caller for cs in sites.get(method, [])
+                              if not (cs.locked or cs.caller in always)})
+            return ("called without the lock from "
+                    + ", ".join(c + "()" for c in callers)
+                    if callers else "no locked call path")
+
+        accesses = [a for scan in scans.values() for a in scan.accesses
+                    if a.method not in _EXEMPT_METHODS]
+        direct_mut: Set[str] = {a.attr for a in accesses
+                                if a.kind == "write"}
+        guarded: Set[str] = {
+            a.attr for a in accesses
+            if a.kind in ("write", "mutcall") and effective_locked(a)
+            and a.attr in direct_mut}
+        verbs = {"write": "written", "mutcall": "mutated", "read": "read"}
+        for a in accesses:
+            if a.attr in guarded and not effective_locked(a):
+                lock = sorted(lock_attrs)[0]
+                yield ctx.finding(
+                    self.id, a.node,
+                    f"self.{a.attr} is {verbs[a.kind]} in "
+                    f"{cls.name}.{a.method} without self.{lock}, but "
+                    f"is mutated under it elsewhere "
+                    f"({unlocked_via(a.method)})")

@@ -1,0 +1,190 @@
+"""metric-sync: Prometheus families in code vs the docs catalog.
+
+docs/OBSERVABILITY.md carries the operator-facing metric catalog; the
+renderer (``observability/prometheus.py``) is what actually emits.
+This project-level rule parses both sides and reports drift with
+file:line on the exact ``w.family(...)`` call or the exact catalog
+table row — replacing the old name-set diff in tools/check_metrics.py.
+
+Statically recognized emission sites:
+
+  * ``<writer>.family("literal", ...)`` — exact name;
+  * ``<writer>.family(name, ...)`` where ``name`` is assigned an
+    f-string in the same function — a wildcard family (the dynamic
+    ``serving_{key}_total`` counters), matched as a pattern against
+    catalog rows;
+  * ``SERIES_FAMILIES = {key: ("family", ...)}`` — the reservoir
+    families, which also imply a ``<family>_count`` counter.
+
+A catalog row is "covered" when it equals a literal family, matches a
+wildcard, names a SERIES_FAMILIES family, or is the implied
+``<family>_count``.  Everything else drifts, in one direction or the
+other.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..core import FileContext, Finding, ProjectContext, Rule, const_str
+
+_ROW_RE = re.compile(r"^\s*\|\s*`([a-zA-Z_:][a-zA-Z0-9_:]*)`\s*\|")
+_HEADING_RE = re.compile(r"^#{2,4}\s+.*metric catalog", re.IGNORECASE)
+_ANY_HEADING_RE = re.compile(r"^#{2,4}\s+\S")
+
+
+class _Emitted:
+    __slots__ = ("name", "pattern", "path", "line")
+
+    def __init__(self, name, pattern, path, line):
+        self.name = name          # exact family name, or None
+        self.pattern = pattern    # compiled wildcard regex, or None
+        self.path = path
+        self.line = line
+
+
+def _fstring_pattern(node: ast.JoinedStr) -> Optional[re.Pattern]:
+    parts = []
+    for v in node.values:
+        if isinstance(v, ast.Constant):
+            parts.append(re.escape(str(v.value)))
+        else:
+            parts.append(r"[a-zA-Z0-9_]+")
+    try:
+        return re.compile("^" + "".join(parts) + "$")
+    except re.error:
+        return None
+
+
+def collect_emitted(ctx: FileContext) -> List[_Emitted]:
+    """Every family-emission site in one file (see module docstring)."""
+    out: List[_Emitted] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "family" and node.args:
+            arg = node.args[0]
+            s = const_str(arg)
+            if s is not None:
+                out.append(_Emitted(s, None, ctx.relpath, node.lineno))
+            elif isinstance(arg, ast.JoinedStr):
+                pat = _fstring_pattern(arg)
+                if pat:
+                    out.append(_Emitted(None, pat, ctx.relpath,
+                                        node.lineno))
+            elif isinstance(arg, ast.Name):
+                src = _resolve_local_fstring(ctx, node, arg.id)
+                if src is not None:
+                    pat = _fstring_pattern(src)
+                    if pat:
+                        out.append(_Emitted(None, pat, ctx.relpath,
+                                            node.lineno))
+            # BinOp (family + "_count") is the implied-counter
+            # convention, covered separately
+        elif isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name)
+                        and t.id == "SERIES_FAMILIES"
+                        for t in node.targets) \
+                and isinstance(node.value, ast.Dict):
+            for v in node.value.values:
+                fam = None
+                if isinstance(v, ast.Tuple) and v.elts:
+                    fam = const_str(v.elts[0])
+                else:
+                    fam = const_str(v)
+                if fam:
+                    out.append(_Emitted(fam, None, ctx.relpath,
+                                        v.lineno))
+    return out
+
+
+def _resolve_local_fstring(ctx: FileContext, call: ast.Call,
+                           name: str) -> Optional[ast.JoinedStr]:
+    fn = call
+    while fn is not None and not isinstance(
+            fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        fn = ctx.parent(fn)
+    if fn is None:
+        return None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == name
+                        for t in node.targets) \
+                and isinstance(node.value, ast.JoinedStr):
+            return node.value
+    return None
+
+
+def parse_catalog(docs_path: str) -> Dict[str, int]:
+    """Catalog family -> line number.  Rows are read from the
+    '### Metric catalog' section; if no such heading exists every
+    ``| `name` |`` table row in the file counts (headingless docs)."""
+    try:
+        with open(docs_path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return {}
+    start = end = None
+    for i, line in enumerate(lines):
+        if start is None and _HEADING_RE.match(line):
+            start = i + 1
+        elif start is not None and _ANY_HEADING_RE.match(line):
+            end = i
+            break
+    section = lines[start:end] if start is not None else lines
+    offset = start if start is not None else 0
+    out: Dict[str, int] = {}
+    for i, line in enumerate(section):
+        m = _ROW_RE.match(line)
+        if m and m.group(1) not in out:
+            out[m.group(1)] = offset + i + 1
+    return out
+
+
+class MetricSyncRule(Rule):
+    id = "metric-sync"
+    name = "code / docs metric-catalog drift"
+    rationale = ("an uncatalogued family is invisible to operators; a "
+                 "catalogued family nobody emits is a dashboard lying "
+                 "about coverage")
+
+    def finalize(self, project: ProjectContext):
+        emitted: List[_Emitted] = []
+        for ctx in project.files:
+            if "observability" in ctx.relpath \
+                    or "serving" in ctx.relpath:
+                emitted.extend(collect_emitted(ctx))
+        if not emitted:
+            return
+        docs_path = project.config.get("metric_docs") or os.path.join(
+            project.root, "docs", "OBSERVABILITY.md")
+        docs_rel = os.path.relpath(docs_path, project.root) \
+            .replace(os.sep, "/")
+        catalog = parse_catalog(docs_path)
+        if not catalog:
+            yield Finding(self.id, docs_rel, 1, 1,
+                          f"no metric catalog found in {docs_rel} "
+                          "(expected a '### Metric catalog' table)")
+            return
+        exact = {e.name for e in emitted if e.name}
+        patterns = [e.pattern for e in emitted if e.pattern]
+
+        for e in emitted:
+            if e.name and e.name not in catalog:
+                yield Finding(
+                    self.id, e.path, e.line, 1,
+                    f"metric family '{e.name}' is emitted by the code "
+                    f"but missing from the catalog in {docs_rel}")
+
+        for name, line in sorted(catalog.items()):
+            covered = (name in exact
+                       or any(p.match(name) for p in patterns)
+                       or (name.endswith("_count")
+                           and name[:-len("_count")] in exact))
+            if not covered:
+                yield Finding(
+                    self.id, docs_rel, line, 1,
+                    f"metric family '{name}' is cataloged in "
+                    f"{docs_rel} but not emitted by any renderer")

@@ -1,0 +1,119 @@
+"""pallas-grid: ``pl.program_id(axis)`` out of range for the launch
+grid.
+
+A Pallas kernel asking for ``program_id(2)`` under a rank-2 grid fails
+only at lowering time — on a TPU runner, long after review.  The launch
+site declares the truth: ``pl.pallas_call(kernel, grid=(...))`` or a
+``PrefetchScalarGridSpec(grid=(...))`` handed in as ``grid_spec=``.
+
+Resolution is intra-module and name-based: the kernel argument may be
+the kernel function itself, a ``functools.partial(kernel, ...)``, or a
+local name bound to either; the grid may be a tuple literal or a local
+name bound to one.  When several launch sites share a kernel the
+*maximum* rank wins (a kernel legitimately reading fewer axes than the
+grid has is fine; reading more than any launch provides never is).
+Kernels whose grid can't be resolved statically are skipped, not
+guessed at.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from ..core import FileContext, Rule, dotted
+
+_GRID_SPEC_CTORS = ("PrefetchScalarGridSpec", "GridSpec")
+
+
+def _local_env(scope: ast.AST) -> Dict[str, ast.AST]:
+    """name -> assigned value for simple single-target assignments."""
+    env: Dict[str, ast.AST] = {}
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            env[node.targets[0].id] = node.value
+    return env
+
+
+def _deref(expr: ast.AST, env: Dict[str, ast.AST],
+           depth: int = 3) -> ast.AST:
+    while isinstance(expr, ast.Name) and expr.id in env and depth > 0:
+        expr = env[expr.id]
+        depth -= 1
+    return expr
+
+
+def _kernel_name(expr: ast.AST, env: Dict[str, ast.AST]
+                 ) -> Optional[str]:
+    expr = _deref(expr, env)
+    if isinstance(expr, ast.Call) \
+            and dotted(expr.func) in ("functools.partial", "partial") \
+            and expr.args:
+        expr = _deref(expr.args[0], env)
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _grid_rank(call: ast.Call, env: Dict[str, ast.AST]
+               ) -> Optional[int]:
+    for kw in call.keywords:
+        if kw.arg == "grid":
+            grid = _deref(kw.value, env)
+            if isinstance(grid, (ast.Tuple, ast.List)):
+                return len(grid.elts)
+            if isinstance(grid, ast.Constant) \
+                    and isinstance(grid.value, int):
+                return 1
+            return None
+        if kw.arg == "grid_spec":
+            spec = _deref(kw.value, env)
+            if isinstance(spec, ast.Call) and dotted(spec.func) \
+                    .split(".")[-1] in _GRID_SPEC_CTORS:
+                return _grid_rank(spec, env)
+            return None
+    return None
+
+
+class PallasGridRule(Rule):
+    id = "pallas-grid"
+    name = "program_id axis outside the launch grid"
+    rationale = ("a kernel reading a grid axis the pallas_call never "
+                 "declares fails at lowering time on real hardware — "
+                 "catch the rank mismatch at review time")
+
+    def check_file(self, ctx: FileContext):
+        fns = {n.name: n for n in ast.walk(ctx.tree)
+               if isinstance(n, ast.FunctionDef)}
+        ranks: Dict[str, int] = {}
+        scopes = [ctx.tree] + [n for n in ast.walk(ctx.tree)
+                               if isinstance(n, ast.FunctionDef)]
+        for scope in scopes:
+            env = _local_env(scope)
+            for node in ast.walk(scope):
+                if not (isinstance(node, ast.Call)
+                        and dotted(node.func).endswith("pallas_call")
+                        and node.args):
+                    continue
+                kname = _kernel_name(node.args[0], env)
+                rank = _grid_rank(node, env)
+                if kname is None or rank is None or kname not in fns:
+                    continue
+                ranks[kname] = max(ranks.get(kname, 0), rank)
+        for kname, rank in sorted(ranks.items()):
+            yield from self._check_kernel(ctx, fns[kname], rank)
+
+    def _check_kernel(self, ctx: FileContext, fn: ast.FunctionDef,
+                      rank: int):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and dotted(node.func).endswith("program_id") \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, int) \
+                    and node.args[0].value >= rank:
+                yield ctx.finding(
+                    self.id, node,
+                    f"program_id({node.args[0].value}) in kernel "
+                    f"'{fn.name}' but every pallas_call launches it "
+                    f"with a rank-{rank} grid (axes 0..{rank - 1})")

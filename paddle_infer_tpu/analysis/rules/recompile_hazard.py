@@ -1,0 +1,88 @@
+"""recompile-hazard: unbounded Python values flowing into program-cache
+keys.
+
+Every distinct executable-cache key compiles (and retains) one XLA
+program.  A key built from an unbucketed value — a raw ``len()``, an
+f-string over arbitrary data, a ``str()``/``repr()`` of an array —
+makes the cache's cardinality proportional to traffic diversity instead
+of to the bucketed shape family, which is exactly the recompile storm
+``CompileLog`` exists to catch at runtime.  This rule catches it at
+review time.
+
+What counts as a cache key, statically:
+
+  * a tuple assigned to a name ending in ``key`` (the repo convention:
+    ``pkey`` / ``dkey`` / ``ckey``);
+  * a tuple passed directly to ``run_paged_program(...)``;
+  * a subscript write into a name containing ``cache`` / ``compiled``.
+
+Flagged elements: f-strings, ``len(...)``, ``str(...)`` / ``repr(...)``.
+Bare names are deliberately NOT flagged — ``plen`` is fine precisely
+because ``_plen()`` bucketed it — so the rule stays quiet on
+disciplined keys and loud on raw ones.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import FileContext, Rule, dotted
+
+
+def _element_label(el: ast.AST) -> str:
+    if isinstance(el, ast.JoinedStr):
+        return "f-string"
+    if isinstance(el, ast.Call):
+        d = dotted(el.func)
+        if d == "len":
+            return "raw len() (bucket it first)"
+        if d in ("str", "repr"):
+            return f"{d}() of a runtime value"
+    return ""
+
+
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    name = "unbounded value in program-cache key"
+    rationale = ("cache keys built from unbucketed runtime values give "
+                 "the executable cache unbounded cardinality — every "
+                 "novel value pays XLA compile latency")
+
+    def check_file(self, ctx: FileContext):
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign):
+                yield from self._check_assign(ctx, node)
+            elif isinstance(node, ast.Call):
+                yield from self._check_call(ctx, node)
+
+    def _check_assign(self, ctx: FileContext, node: ast.Assign):
+        key_target = any(isinstance(t, ast.Name)
+                         and t.id.lower().endswith("key")
+                         for t in node.targets)
+        if key_target and isinstance(node.value, ast.Tuple):
+            yield from self._check_tuple(ctx, node.value, "cache key")
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                base = dotted(t.value).lower()
+                if ("cache" in base or "compiled" in base) \
+                        and isinstance(t.slice, ast.JoinedStr):
+                    yield ctx.finding(
+                        self.id, t.slice,
+                        f"f-string key into '{dotted(t.value)}' — "
+                        "unbounded cache cardinality")
+
+    def _check_call(self, ctx: FileContext, node: ast.Call):
+        func = node.func
+        if isinstance(func, ast.Attribute) \
+                and func.attr == "run_paged_program" and node.args \
+                and isinstance(node.args[0], ast.Tuple):
+            yield from self._check_tuple(ctx, node.args[0],
+                                         "run_paged_program key")
+
+    def _check_tuple(self, ctx: FileContext, tup: ast.Tuple, what: str):
+        for el in tup.elts:
+            label = _element_label(el)
+            if label:
+                yield ctx.finding(
+                    self.id, el,
+                    f"{label} inside a {what} tuple — every distinct "
+                    "value compiles and retains a fresh executable")

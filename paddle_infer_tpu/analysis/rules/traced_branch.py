@@ -1,0 +1,149 @@
+"""traced-branch: Python control flow on traced array values.
+
+Inside a jitted function, ``if``/``while`` on a traced value either
+raises a ``TracerBoolConversionError`` at first call or — worse, when
+the value happens to be concrete during tracing — silently bakes one
+branch into the compiled program.  The structural fixes are
+``jnp.where`` / ``lax.cond`` / ``lax.while_loop``.
+
+What is *safe* to branch on (and therefore exempt):
+
+  * ``x is None`` / ``x is not None`` — Python identity, resolved at
+    trace time;
+  * ``isinstance(...)``, ``len(x)``, and ``x.shape`` / ``x.ndim`` /
+    ``x.dtype`` / ``x.size`` — static under tracing;
+  * parameters declared static via ``static_argnums`` /
+    ``static_argnames``.
+
+Flagged: a branch test that reads a (non-static) parameter directly,
+or that calls into ``jnp.`` / ``jax.`` (the result of which is always
+traced).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..core import (FileContext, Rule, _is_jit_expr, dotted,
+                    jit_functions, param_names)
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _static_params(tree: ast.AST,
+                   jitted: Dict[str, List[ast.FunctionDef]]
+                   ) -> Dict[str, Set[str]]:
+    """fn name -> parameter names declared static at any jit site
+    (decorator or ``jax.jit(fn, static_arg...)`` wrap)."""
+    out: Dict[str, Set[str]] = {n: set() for n in jitted}
+
+    def absorb(name: str, call: ast.Call):
+        fns = jitted.get(name, [])
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for el in ast.walk(kw.value):
+                    if isinstance(el, ast.Constant) \
+                            and isinstance(el.value, str):
+                        out[name].add(el.value)
+            elif kw.arg == "static_argnums":
+                nums = [el.value for el in ast.walk(kw.value)
+                        if isinstance(el, ast.Constant)
+                        and isinstance(el.value, int)]
+                for fn in fns:
+                    params = param_names(fn)
+                    for i in nums:
+                        if 0 <= i < len(params):
+                            out[name].add(params[i])
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in jitted:
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _is_jit_expr(dec):
+                    absorb(node.name, dec)
+        elif isinstance(node, ast.Call) and _is_jit_expr(node.func) \
+                and node.args and isinstance(node.args[0], ast.Name) \
+                and node.args[0].id in jitted:
+            absorb(node.args[0].id, node)
+    return out
+
+
+def _parents(root: ast.AST) -> Dict[int, ast.AST]:
+    out = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            out[id(child)] = node
+    return out
+
+
+def _exempt(node: ast.AST, parents: Dict[int, ast.AST],
+            stop: ast.AST) -> bool:
+    """True when ``node`` only feeds a trace-static construct."""
+    cur = node
+    while cur is not stop:
+        par = parents.get(id(cur))
+        if par is None:
+            return False
+        if isinstance(par, ast.Attribute) and par.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(par, ast.Call):
+            d = dotted(par.func)
+            if d in ("len", "isinstance", "getattr", "hasattr",
+                     "callable", "type"):
+                return True
+        if isinstance(par, ast.Compare) and cur is par.left \
+                or isinstance(par, ast.Compare) and cur in par.comparators:
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in par.ops):
+                return True
+        cur = par
+    return False
+
+
+class TracedBranchRule(Rule):
+    id = "traced-branch"
+    name = "Python branch on a traced value"
+    rationale = ("`if`/`while` on a traced array either crashes at "
+                 "trace time or freezes one branch into the compiled "
+                 "program; use jnp.where / lax.cond / lax.while_loop")
+
+    def check_file(self, ctx: FileContext):
+        jitted = jit_functions(ctx.tree)
+        if not jitted:
+            return
+        statics = _static_params(ctx.tree, jitted)
+        for name, fns in sorted(jitted.items()):
+            for fn in fns:
+                yield from self._check_fn(ctx, fn, statics.get(name,
+                                                               set()))
+
+    def _check_fn(self, ctx: FileContext, fn: ast.FunctionDef,
+                  static: Set[str]):
+        traced = {p for p in param_names(fn) if p not in static}
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            reason = self._hazard(node.test, traced)
+            if reason:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                yield ctx.finding(
+                    self.id, node,
+                    f"Python `{kind}` on {reason} inside a jitted "
+                    "function — use jnp.where / lax.cond / "
+                    "lax.while_loop")
+
+    @staticmethod
+    def _hazard(test: ast.AST, traced: Set[str]) -> str:
+        parents = _parents(test)
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in traced \
+                    and not _exempt(node, parents, test):
+                return f"traced parameter '{node.id}'"
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d.startswith(("jnp.", "jax.numpy.", "lax.",
+                                 "jax.lax.")) \
+                        and not _exempt(node, parents, test):
+                    return f"the traced result of {d}()"
+        return ""

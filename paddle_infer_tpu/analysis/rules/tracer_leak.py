@@ -1,0 +1,125 @@
+"""tracer-leak: jitted functions reading mutable module state or
+calling impure host functions.
+
+``jax.jit`` traces a function once per signature and replays the XLA
+program after that.  Anything the Python body reads that is not a
+traced argument is baked in at trace time:
+
+  * a module-level ``dict``/``list``/``set`` the function reads will be
+    captured as a constant — later mutations silently never reach the
+    compiled program (the classic "why is my flag ignored" bug);
+  * ``time.*`` / ``random.*`` / ``np.random.*`` calls execute exactly
+    once, at trace time, and the traced value is then replayed forever
+    (``jax.random`` with an explicit key is the sanctioned path).
+
+The rule is deliberately narrow: only module-level names bound to a
+mutable literal (or ``dict()``/``list()``/``set()``/``defaultdict``/
+``deque`` call) count as leaky state — modules, functions, and
+constants are fine to close over.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Set
+
+from ..core import FileContext, Rule, dotted, jit_functions
+
+_MUTABLE_CTORS = {"dict", "list", "set", "bytearray",
+                  "collections.defaultdict", "defaultdict",
+                  "collections.deque", "deque",
+                  "collections.OrderedDict", "OrderedDict",
+                  "collections.Counter", "Counter"}
+_MUTABLE_LITERALS = (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                     ast.ListComp, ast.SetComp)
+_IMPURE_PREFIXES = ("time.", "random.", "np.random.", "numpy.random.")
+_IMPURE_NAMES = {"time.time", "time.monotonic", "time.perf_counter"}
+
+
+def _module_mutables(tree: ast.Module) -> Set[str]:
+    """Module-level names bound to a mutable container."""
+    out: Set[str] = set()
+    for stmt in tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        else:
+            continue
+        mutable = isinstance(value, _MUTABLE_LITERALS) or (
+            isinstance(value, ast.Call)
+            and dotted(value.func) in _MUTABLE_CTORS)
+        if not mutable:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def _local_names(fn: ast.FunctionDef) -> Set[str]:
+    """Names bound inside the function (params, assignments, loops,
+    comprehensions) — these shadow module-level state."""
+    out: Set[str] = set()
+    a = fn.args
+    for p in a.posonlyargs + a.args + a.kwonlyargs:
+        out.add(p.arg)
+    if a.vararg:
+        out.add(a.vararg.arg)
+    if a.kwarg:
+        out.add(a.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            out.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            out.add(node.name)
+        elif isinstance(node, ast.comprehension):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+    return out
+
+
+class TracerLeakRule(Rule):
+    id = "tracer-leak"
+    name = "jitted function captures mutable host state"
+    rationale = ("values a traced function reads from mutable globals "
+                 "or impure host calls are frozen at trace time — the "
+                 "compiled program silently ignores later changes")
+
+    def check_file(self, ctx: FileContext):
+        jitted = jit_functions(ctx.tree)
+        if not jitted:
+            return
+        mutables = _module_mutables(ctx.tree)
+        for name, fns in sorted(jitted.items()):
+            for fn in fns:
+                yield from self._check_fn(ctx, fn, mutables)
+
+    def _check_fn(self, ctx: FileContext, fn: ast.FunctionDef,
+                  mutables: Set[str]):
+        local = _local_names(fn)
+        reported: Dict[str, bool] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.id in mutables and node.id not in local \
+                    and node.id not in reported:
+                reported[node.id] = True
+                yield ctx.finding(
+                    self.id, node,
+                    f"jitted function reads module-level mutable "
+                    f"'{node.id}' — its value is frozen into the traced "
+                    "program; pass it as an argument instead")
+            elif isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d in _IMPURE_NAMES or (
+                        d.startswith(_IMPURE_PREFIXES)
+                        and not d.startswith("np.random.Generator")):
+                    yield ctx.finding(
+                        self.id, node,
+                        f"impure call {d}() inside a jitted function "
+                        "runs once at trace time and is replayed as a "
+                        "constant (use jax.random with an explicit key)")

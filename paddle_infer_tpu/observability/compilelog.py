@@ -146,7 +146,8 @@ class CompileLog:
     def recompile_storm(self) -> bool:
         """True when any single (site, key, signature) compiled more
         than once — an executable cache is being blown and rebuilt."""
-        return self.recompile_count > 0
+        with self._lock:
+            return self.recompile_count > 0
 
     def events(self, site: Optional[str] = None) -> List[CompileEvent]:
         with self._lock:

@@ -611,6 +611,9 @@ def _tune_run(kernel, b, h, sq, sk, d, dtype, causal, segmented,
     what the autotuner times per block candidate."""
     import numpy as _np
 
+    # bounded key: str(dtype) ranges over jnp's closed dtype set, and
+    # this caches autotune dummy operands, not compiled executables
+    # tpulint: disable-next-line=recompile-hazard
     key = (b, h, sq, sk, d, str(dtype), segmented)
     ops = _TUNE_OPERANDS.get(key)
     if ops is None:

@@ -115,7 +115,10 @@ class EngineCore:
         self._slots: List[Optional[dict]] = [None] * self._max_batch
         self.step_trace: List[dict] = []
         self._step_idx = 0
-        self._step_lock = threading.Lock()
+        # RLock: the locked step path reads ``active_count``, which now
+        # takes the lock itself so unlocked readers (HTTP metrics
+        # threads) see a consistent slot table
+        self._step_lock = threading.RLock()
         self._thread: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
         self._closed = False
@@ -138,7 +141,8 @@ class EngineCore:
 
     @property
     def active_count(self) -> int:
-        return sum(s is not None for s in self._slots)
+        with self._step_lock:
+            return sum(s is not None for s in self._slots)
 
     @property
     def prefix_cache(self) -> Optional[PrefixCache]:
@@ -458,7 +462,11 @@ class EngineCore:
         ids[0, :suffix] = req.prompt[cached:]
         table = np.full((self._max_pages,), self._scratch, np.int32)
         t = self._pool.block_table(sid)[:self._max_pages]
+        # intentional host work at admission: the block table and the
+        # per-request fold_in key are tiny, fetched once per admit
+        # tpulint: disable-next-line=host-sync
         table[:len(t)] = np.asarray(t, np.int32)
+        # tpulint: disable-next-line=host-sync
         key = np.asarray(
             jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))
         span_name = "prefill" if cache is None else "suffix_prefill"
@@ -495,7 +503,11 @@ class EngineCore:
             if eng.kv_state_lost():
                 self._fail_all(e)
             return
+        # the intentional once-per-admission sync: the first token and
+        # finish flag drive host-side slot bookkeeping
+        # tpulint: disable-next-line=host-sync
         tok = int(np.asarray(tok)[0])
+        # tpulint: disable-next-line=host-sync
         finished = bool(np.asarray(fin)[0])
         req._mark_active()
         self._metrics.on_prefill(time.monotonic() - req.arrival)
@@ -573,8 +585,13 @@ class EngineCore:
             # serving-decode site is a recompile and logs a warning
             get_compile_log().mark_warm("serving-decode", dkey)
             self._decode_warm = True
+        # the one designed sync per fused chunk: the whole chunk's
+        # tokens/finish/valid-counts come back in a single readback
+        # tpulint: disable-next-line=host-sync
         toks = np.asarray(toks)
+        # tpulint: disable-next-line=host-sync
         fin_out = np.asarray(fin_out)
+        # tpulint: disable-next-line=host-sync
         nvalid = np.asarray(nvalid)
         self._step_idx += 1
         emitted_total = 0
@@ -621,8 +638,11 @@ class EngineCore:
         # KV and are never retained.
         retain = None
         if state == RequestState.DONE and self._prefix_cache is not None:
+            # req.tokens is a host-side list — no device readback here
             retain = np.concatenate(
-                [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
+                [req.prompt,
+                 # tpulint: disable-next-line=host-sync
+                 np.asarray(req.tokens[:-1], np.int32)])
         self._release_slot_kv(slot["sid"], slot.get("match"),
                               retain_tokens=retain,
                               salt=req.cache_salt)
@@ -706,15 +726,19 @@ class EngineCore:
             return
         self._closed = True
         self.stop()
-        for r in self._queue.drain():
-            r._finish(RequestState.REJECTED,
-                      RejectedError("serving engine closed"))
-            self._trace_queue_drop(r, RequestState.REJECTED,
-                                   "engine-closed")
-        for s in list(self._slots):
-            if s is not None:
-                self._evict(s, RequestState.CANCELLED,
-                            RejectedError("serving engine closed"))
-        if self._prefix_cache is not None:
-            self._prefix_cache.clear()
-        self._pool.free(self._max_batch)
+        # the loop thread is joined, but callers driving run_once()
+        # from their own threads may still be mid-step — hold the step
+        # lock so teardown can't interleave with a decode chunk
+        with self._step_lock:
+            for r in self._queue.drain():
+                r._finish(RequestState.REJECTED,
+                          RejectedError("serving engine closed"))
+                self._trace_queue_drop(r, RequestState.REJECTED,
+                                       "engine-closed")
+            for s in list(self._slots):
+                if s is not None:
+                    self._evict(s, RequestState.CANCELLED,
+                                RejectedError("serving engine closed"))
+            if self._prefix_cache is not None:
+                self._prefix_cache.clear()
+            self._pool.free(self._max_batch)

@@ -73,7 +73,10 @@ class ServingMetrics:
         self.reset()
 
     def reset(self):
-        with getattr(self, "_lock", threading.Lock()):
+        # ``_lock`` is always bound before reset() can run (first
+        # statement of __init__) — a getattr fallback here would
+        # silently guard with a throwaway lock
+        with self._lock:
             self.submitted = 0
             self.completed = 0
             self.failed = 0

@@ -31,7 +31,10 @@ another tenant's prompt shares its prefix.
 Partial tail blocks (a prompt ending mid-page) are cached as
 ``partials`` entries keyed by the partial token tuple.  Consumers never
 share them in place — the engine copy-on-writes the page into a fresh
-block before writing the suffix — so partials are never pinned.
+block before writing the suffix — but the *source* entry is pinned
+from ``match`` until ``release``/``trim`` drops it: eviction recycling
+the tail block between the match and the CoW copy would hand the
+consumer another request's KV.
 
 Eviction is leaf-first LRU over entries with ``pins == 0``: partial
 entries and childless nodes.  It runs on demand (``ensure_free``) when
@@ -68,22 +71,23 @@ class _Node:
         self.pins = 0               # active consumers (NOT block refcount)
         self.last_used = 0
         # partial tail pages extending this prefix: token tuple (shorter
-        # than a page) -> [block, last_used]
+        # than a page) -> [block, last_used, pins]
         self.partials: Dict[Tuple[int, ...], List[int]] = {}
 
 
 class PrefixMatch:
     """The result of ``PrefixCache.match`` — pinned until ``release``."""
     __slots__ = ("nodes", "blocks", "partial_block", "partial_len",
-                 "partial_node", "salt", "_page")
+                 "partial_node", "partial_entry", "salt", "_page")
 
     def __init__(self, nodes, blocks, partial_block, partial_len,
-                 partial_node, salt, page):
+                 partial_node, partial_entry, salt, page):
         self.nodes: List[_Node] = nodes
         self.blocks: List[int] = blocks        # full shared blocks
         self.partial_block = partial_block     # tail block to CoW, or None
         self.partial_len = partial_len         # valid tokens in the tail
         self.partial_node = partial_node       # pinned source node, if any
+        self.partial_entry = partial_entry     # pinned partials entry, if any
         self.salt = salt
         self._page = page
 
@@ -175,10 +179,14 @@ class PrefixCache:
                 elif partial_node is not None:
                     partial_node.pins += 1
                     partial_node.last_used = self._clock
+                    best_entry = None
                 elif best_entry is not None:
+                    # pin the tail entry: eviction recycling this block
+                    # before the consumer's CoW copy would alias KV
                     best_entry[1] = self._clock
+                    best_entry[2] += 1
             m = PrefixMatch(nodes, blocks, partial_block, partial_len,
-                            partial_node, salt, self.page)
+                            partial_node, best_entry, salt, self.page)
             if m.cached_tokens > 0:
                 self.hits += 1
                 self.cached_tokens_total += m.cached_tokens
@@ -198,8 +206,11 @@ class PrefixCache:
     def _drop_partial(match: PrefixMatch):
         if match.partial_node is not None and match.partial_node.pins > 0:
             match.partial_node.pins -= 1
+        if match.partial_entry is not None and match.partial_entry[2] > 0:
+            match.partial_entry[2] -= 1
         match.partial_block, match.partial_len = None, 0
         match.partial_node = None
+        match.partial_entry = None
 
     def trim(self, match: PrefixMatch, max_tokens: int):
         """Shrink a match to at most ``max_tokens`` cached tokens
@@ -254,7 +265,7 @@ class PrefixCache:
                 if entry is None:
                     blk = int(blocks[n_full])
                     self._pool.ref_block(blk)
-                    node.partials[rem] = [blk, self._clock]
+                    node.partials[rem] = [blk, self._clock, 0]
                     self.cached_blocks += 1
                     retained += 1
                 else:
@@ -269,14 +280,16 @@ class PrefixCache:
     # ---------------------------------------------------------- eviction
     def _candidates(self):
         """(last_used, kind, node, key) for every evictable entry:
-        partial entries, and unpinned childless partial-less nodes."""
+        unpinned partial entries, and unpinned childless partial-less
+        nodes."""
         out = []
         stack = list(self._roots.values())
         while stack:
             node = stack.pop()
             stack.extend(node.children.values())
             for ptoks, entry in node.partials.items():
-                out.append((entry[1], "partial", node, ptoks))
+                if entry[2] == 0:
+                    out.append((entry[1], "partial", node, ptoks))
             if (node.block is not None and not node.children
                     and not node.partials and node.pins == 0):
                 out.append((node.last_used, "node", node, node.chunk))
@@ -288,7 +301,7 @@ class PrefixCache:
             return False
         _, kind, node, key = min(cands, key=lambda c: c[0])
         if kind == "partial":
-            blk, _ = node.partials.pop(key)
+            blk = node.partials.pop(key)[0]
         else:
             blk = node.block
             if node.parent is not None:

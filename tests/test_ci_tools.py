@@ -103,3 +103,60 @@ def test_bench_last_json_salvage():
     assert bench._last_json(full)["value"] == 2.0
     assert bench._last_json(pre)["value"] == 1.0       # salvage case
     assert bench._last_json("garbage\n{broken") is None
+
+
+def test_tpulint_repo_clean():
+    """The tpulint gate: the shipped tree must analyze clean — zero
+    non-baselined findings across every rule."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+         "--json"], capture_output=True, text=True, env=_env(),
+        timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr[-800:]
+    rep = json.loads(r.stdout)
+    assert rep["new"] == []
+    assert rep["files"] > 100          # really walked the package
+    assert len(rep["rules"]) == 8
+
+
+def test_tpulint_baseline_update_deterministic(tmp_path):
+    """--baseline-update must be reproducible: identical bytes across
+    runs, path-relative, sorted entries."""
+    # name matches the lock rule's path_scope ("serving")
+    bad = tmp_path / "serving_bad.py"
+    bad.write_text(
+        "import threading\n\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0\n\n"
+        "    def add(self):\n"
+        "        with self._lock:\n"
+        "            self.count += 1\n\n"
+        "    def peek(self):\n"
+        "        return self.count\n")
+    base = tmp_path / "baseline.json"
+
+    def update():
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+             "--baseline-update", "--baseline", str(base), str(bad)],
+            capture_output=True, text=True, env=_env(), timeout=600)
+        assert r.returncode == 0, r.stdout + r.stderr[-800:]
+        return base.read_bytes()
+
+    first, second = update(), update()
+    assert first == second
+    data = json.loads(first)
+    entries = data["entries"]
+    assert entries and entries == sorted(
+        entries, key=lambda e: (e["rule"], e["path"], e["symbol"],
+                                e["message"]))
+    assert all(not os.path.isabs(e["path"]) for e in entries)
+    # a baselined tree then gates clean...
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+         "--json", "--baseline", str(base), str(bad)],
+        capture_output=True, text=True, env=_env(), timeout=600)
+    rep = json.loads(r.stdout)
+    assert r.returncode == 0 and rep["new"] == [] and rep["baselined"]

@@ -260,3 +260,42 @@ def test_capture_bundle_writes_manifest(tmp_path):
     m2 = capture_bundle(str(tmp_path / "b2"))
     assert any("metrics" in miss for miss in m2["missing"])
     assert any("traces" in miss for miss in m2["missing"])
+
+
+# ------------------------------------------------------- lock regressions
+class _LockProbe:
+    """Wraps a real lock, recording each context-manager acquisition."""
+
+    def __init__(self, real):
+        self.real = real
+        self.entered = 0
+
+    def __enter__(self):
+        self.entered += 1
+        return self.real.__enter__()
+
+    def __exit__(self, *exc):
+        return self.real.__exit__(*exc)
+
+
+def test_recompile_storm_read_is_locked():
+    """Regression (tpulint lock-discipline): ``recompile_storm`` read
+    ``recompile_count`` without ``_lock`` while ``record`` mutates it
+    under the lock."""
+    log = CompileLog()
+    log._lock = probe = _LockProbe(log._lock)
+    assert log.recompile_storm is False
+    assert probe.entered == 1
+
+
+def test_metrics_reset_uses_instance_lock():
+    """Regression (tpulint lock-discipline): ``reset`` guarded itself
+    with ``getattr(self, "_lock", Lock())`` — a throwaway lock that
+    synchronizes with nobody when the fallback fires."""
+    m = ServingMetrics()
+    m.on_submitted(2)
+    m._lock = probe = _LockProbe(m._lock)
+    m.reset()
+    assert probe.entered == 1
+    assert m.snapshot(queue_depth=0, active=0,
+                      max_batch=1)["counters"]["submitted"] == 0

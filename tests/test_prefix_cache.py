@@ -115,6 +115,31 @@ def test_assign_takes_per_sequence_refs():
     assert pool.free_blocks == 8
 
 
+def test_matched_partial_tail_pinned_against_eviction():
+    """Regression (tpulint self-application): a matched partial tail
+    entry must be pinned from match() to release() — eviction pressure
+    in that window used to recycle the tail block while the consumer
+    still planned to CoW-copy it, aliasing another request's KV."""
+    pool = native.KVBlockPool(8, 4)
+    cache = PrefixCache(pool, page_size=4, watermark=1.0)
+    pool.reserve(0, 6)                       # 1 full page + 2-token tail
+    table = [int(x) for x in pool.block_table(0)]
+    cache.insert(list(range(6)), table)
+    pool.free(0)                             # tree holds the only refs
+    m = cache.match([0, 1, 2, 3, 4, 99])     # full page + 1-token tail
+    assert m.partial_block == table[1] and m.partial_len == 1
+    # demand more free blocks than can exist: everything unpinned would
+    # be evicted — the matched tail (and matched node) must survive
+    assert not cache.ensure_free(pool.num_blocks)
+    assert pool.block_refcount(m.partial_block) == 1
+    blk = m.partial_block
+    cache.release(m)                         # consumer left the slot
+    assert cache.ensure_free(pool.num_blocks)
+    assert pool.free_blocks == pool.num_blocks
+    with pytest.raises(ValueError):          # truly freed now
+        pool.ref_block(blk)
+
+
 # ----------------------------------------------------------------- fuzz
 def _tree_blocks(cache):
     out = []

@@ -357,3 +357,49 @@ def test_mid_decode_failure_frees_blocks(make_core, engine, monkeypatch):
     (again,) = core.submit(_prompt(72), GenerationConfig(max_new_tokens=4))
     _drive(core, [again])               # core stays usable afterwards
     assert again.state is RequestState.DONE
+
+
+def test_close_evicts_under_step_lock(make_core):
+    """Regression (tpulint lock-discipline): close() used to drain the
+    queue and evict active slots without ``_step_lock``, racing a
+    concurrent ``run_once``.  Probe that every eviction during close()
+    now happens with the lock held."""
+    core = make_core()
+    (req,) = core.submit(_prompt(50), GenerationConfig(max_new_tokens=16))
+    core.run_once()                     # admit, still active
+    assert core.active_count == 1
+    held = []
+    orig = core._evict
+
+    def probe(slot, state, err=None):
+        held.append(core._step_lock._is_owned())
+        return orig(slot, state, err)
+
+    core._evict = probe
+    core.close()
+    assert held and all(held)
+    assert req.state is RequestState.CANCELLED
+
+
+def test_active_count_acquires_step_lock(make_core):
+    """Regression (tpulint lock-discipline): ``active_count`` read the
+    slot dict without ``_step_lock`` (which is why the lock is now an
+    RLock — the locked step path reads it too)."""
+    core = make_core()
+    orig = core._step_lock
+    entered = []
+
+    class Probe:
+        def __enter__(self):
+            entered.append(True)
+            return orig.__enter__()
+
+        def __exit__(self, *exc):
+            return orig.__exit__(*exc)
+
+    core._step_lock = Probe()
+    try:
+        assert core.active_count == 0
+    finally:
+        core._step_lock = orig
+    assert entered

@@ -1,0 +1,488 @@
+"""tpulint rule tests: every rule gets at least one fixture where it
+fires and one where it stays silent (false-positive guard), plus
+suppression-comment and baseline round-trip coverage.  The repo-wide
+zero-findings gate lives in tests/test_ci_tools.py next to the other
+CI tools."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from paddle_infer_tpu.analysis import (Analyzer, all_rules,
+                                       apply_baseline, load_baseline,
+                                       write_baseline)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_rules(tmp_path, source, rules, rel="serving/mod.py",
+              config=None):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    analyzer = Analyzer(all_rules(rules), root=str(tmp_path),
+                        config=config)
+    findings, n_files = analyzer.run([str(path)])
+    assert n_files == 1
+    return findings
+
+
+# ------------------------------------------------------------ host-sync
+HOT_SYNC = """
+    import numpy as np
+
+    class Core:
+        def run_once(self):
+            self._readback()
+
+        def _readback(self):
+            toks = np.asarray(self._device_tokens())
+            return toks
+
+        def _device_tokens(self):
+            return [1, 2]
+"""
+
+
+def test_host_sync_fires_via_call_graph(tmp_path):
+    fs = run_rules(tmp_path, HOT_SYNC, ["host-sync"])
+    assert len(fs) == 1
+    assert fs[0].rule == "host-sync"
+    assert "_readback" in fs[0].symbol
+    assert "reachable from run_once()" in fs[0].message
+
+
+def test_host_sync_silent_on_literals_and_cold_code(tmp_path):
+    src = """
+        import numpy as np
+
+        class Core:
+            def run_once(self):
+                ids = np.asarray([1, 2, 3])      # literal: host data
+                return ids
+
+        class Offline:
+            def export(self, x):
+                return np.asarray(x)             # not a hot class
+    """
+    assert run_rules(tmp_path, src, ["host-sync"]) == []
+
+
+def test_host_sync_out_of_scope_path(tmp_path):
+    # path_scope: the rule only runs over serving/ code
+    fs = run_rules(tmp_path, HOT_SYNC, ["host-sync"], rel="ops/mod.py")
+    assert fs == []
+
+
+# ----------------------------------------------------- recompile-hazard
+def test_recompile_hazard_fires_on_unbounded_keys(tmp_path):
+    src = """
+        def launch(eng, ids, cache):
+            pkey = ("prefill", f"b{ids.shape[0]}", len(ids))
+            cache[f"k{len(ids)}"] = 1
+            return eng.run_paged_program(pkey, None)
+    """
+    fs = run_rules(tmp_path, src, ["recompile-hazard"])
+    kinds = sorted(f.message.split(" inside")[0] for f in fs)
+    assert len(fs) == 3
+    assert any("f-string" in k for k in kinds)
+    assert any("len()" in k for k in kinds)
+
+
+def test_recompile_hazard_silent_on_bucketed_keys(tmp_path):
+    src = """
+        def launch(eng, b, plen, max_pages):
+            dkey = ("serve-step", b, plen, max_pages)
+            return eng.run_paged_program(dkey, None)
+    """
+    assert run_rules(tmp_path, src, ["recompile-hazard"]) == []
+
+
+# ------------------------------------------------------ lock-discipline
+def test_lock_discipline_fires_on_unlocked_read(tmp_path):
+    src = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items = self._items + [x]
+
+            def size(self):
+                return len(self._items)
+    """
+    fs = run_rules(tmp_path, src, ["lock-discipline"])
+    assert len(fs) == 1
+    assert "_items" in fs[0].message and "Box.size" in fs[0].symbol
+    assert "public entry" in fs[0].message
+
+
+def test_lock_discipline_fixpoint_accepts_locked_helpers(tmp_path):
+    # the run_once-holds-the-lock / _helper-mutates pattern must NOT
+    # fire: every call site of the private helper holds the lock
+    src = """
+        import threading
+
+        class Core:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def run_once(self):
+                with self._lock:
+                    self._bump()
+
+            def _bump(self):
+                self._n += 1
+    """
+    assert run_rules(tmp_path, src, ["lock-discipline"]) == []
+
+
+def test_lock_discipline_flags_getattr_default_lock(tmp_path):
+    src = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def reset(self):
+                with getattr(self, "_lock", threading.Lock()):
+                    pass
+    """
+    fs = run_rules(tmp_path, src, ["lock-discipline"])
+    assert len(fs) == 1 and "getattr" in fs[0].message
+
+
+def test_lock_discipline_skips_self_synchronized_members(tmp_path):
+    # an attribute that is only ever method-called owns its own
+    # synchronization (RequestQueue / deque) — mutating it outside the
+    # class lock is fine
+    src = """
+        import threading
+
+        class Core:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._queue = SomeQueue()
+                self._n = 0
+
+            def put(self, x):
+                self._queue.append(x)
+                with self._lock:
+                    self._n += 1
+    """
+    assert run_rules(tmp_path, src, ["lock-discipline"]) == []
+
+
+# ---------------------------------------------------------- tracer-leak
+def test_tracer_leak_fires_on_global_and_impure(tmp_path):
+    src = """
+        import time
+        import jax
+
+        _CACHE = {}
+
+        @jax.jit
+        def f(x):
+            _CACHE["hit"] = 1
+            t = time.time()
+            return x + t
+    """
+    fs = run_rules(tmp_path, src, ["tracer-leak"])
+    assert len(fs) == 2
+    msgs = " | ".join(f.message for f in fs)
+    assert "_CACHE" in msgs and "time.time" in msgs
+
+
+def test_tracer_leak_silent_on_constants_and_jax_random(tmp_path):
+    src = """
+        import jax
+
+        _LIMIT = 8
+
+        @jax.jit
+        def f(x, key):
+            noise = jax.random.normal(key, x.shape)
+            return x[:_LIMIT] + noise
+    """
+    assert run_rules(tmp_path, src, ["tracer-leak"]) == []
+
+
+# -------------------------------------------------------- traced-branch
+def test_traced_branch_fires_on_param_branch(tmp_path):
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            while x < 4:
+                x = x + 1
+            return -x
+    """
+    fs = run_rules(tmp_path, src, ["traced-branch"])
+    assert len(fs) == 2
+    assert any("`if`" in f.message for f in fs)
+    assert any("`while`" in f.message for f in fs)
+
+
+def test_traced_branch_silent_on_static_constructs(tmp_path):
+    src = """
+        import functools
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, mask=None):
+            if mask is None:
+                mask = jnp.ones_like(x)
+            if x.shape[0] > 2:
+                x = x * 2
+            if len(x) > 4:
+                x = x[:4]
+            return x + mask
+
+        @functools.partial(jax.jit, static_argnames=("flag",))
+        def g(x, flag):
+            if flag:
+                return x * 2
+            return x
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def h(x, mode):
+            if mode == 2:
+                return x + 1
+            return x
+    """
+    assert run_rules(tmp_path, src, ["traced-branch"]) == []
+
+
+# ----------------------------------------------------- missing-donation
+def test_donation_fires_on_undonated_kv(tmp_path):
+    src = """
+        import jax
+
+        def build(model):
+            def run(params, ids, k_pages, v_pages):
+                return ids, k_pages, v_pages
+            return jax.jit(run)
+    """
+    fs = run_rules(tmp_path, src, ["missing-donation"])
+    assert len(fs) == 1
+    assert "k_pages" in fs[0].message and "donate" in fs[0].message
+
+
+def test_donation_silent_when_donated_and_resolves_lexically(tmp_path):
+    # two local functions both named `run`: the dense builder's run has
+    # no KV params and its jit must NOT inherit the paged run's params
+    src = """
+        import jax
+
+        def build_dense(model):
+            def run(params, ids, rng):
+                return ids
+            return jax.jit(run)
+
+        def build_paged(model):
+            def run(params, ids, k_pages, v_pages):
+                return ids, k_pages, v_pages
+            return jax.jit(run, donate_argnums=(2, 3))
+    """
+    assert run_rules(tmp_path, src, ["missing-donation"]) == []
+
+
+# ---------------------------------------------------------- metric-sync
+METRIC_CODE = """
+    SERIES_FAMILIES = {"ttft_s": ("serving_ttft_seconds", "ttft")}
+
+    def render(snapshot, w):
+        w.family("serving_queue_depth", "gauge", "queue")
+        w.family("made_up_total", "counter", "oops")
+        for key in sorted(snapshot):
+            name = f"serving_{key}_total"
+            w.family(name, "counter", "dynamic")
+"""
+
+METRIC_DOCS_OK = """\
+### Metric catalog
+| family | type | unit | meaning |
+|---|---|---|---|
+| `serving_queue_depth` | gauge | requests | queue |
+| `made_up_total` | counter | 1 | oops |
+| `serving_ttft_seconds` | gauge | s | ttft |
+| `serving_ttft_seconds_count` | counter | 1 | samples |
+| `serving_completed_total` | counter | 1 | wildcard-covered |
+"""
+
+
+def _metric_fixture(tmp_path, docs_text):
+    docs = tmp_path / "OBS.md"
+    docs.write_text(docs_text)
+    return run_rules(tmp_path, METRIC_CODE, ["metric-sync"],
+                     rel="observability/prom.py",
+                     config={"metric_docs": str(docs)})
+
+
+def test_metric_sync_fires_both_directions(tmp_path):
+    stale = METRIC_DOCS_OK.replace(
+        "| `made_up_total` | counter | 1 | oops |\n",
+        "| `ghost_family` | gauge | x | stale |\n")
+    fs = _metric_fixture(tmp_path, stale)
+    msgs = [f.message for f in fs]
+    assert any("made_up_total" in m and "missing from the catalog" in m
+               for m in msgs)
+    assert any("ghost_family" in m and "not emitted" in m for m in msgs)
+    # docs-side findings carry the docs file + table-row line
+    ghost = [f for f in fs if "ghost_family" in f.message][0]
+    assert ghost.path.endswith("OBS.md") and ghost.line > 1
+
+
+def test_metric_sync_silent_when_in_sync(tmp_path):
+    # exact names, SERIES_FAMILIES, the implied _count counter, and the
+    # f-string wildcard family must all count as covered
+    assert _metric_fixture(tmp_path, METRIC_DOCS_OK) == []
+
+
+# ---------------------------------------------------------- pallas-grid
+def test_pallas_grid_fires_on_out_of_range_axis(tmp_path):
+    src = """
+        from jax.experimental import pallas as pl
+
+        def _kern(x_ref, o_ref):
+            i = pl.program_id(0)
+            j = pl.program_id(2)
+            o_ref[...] = x_ref[...] + i + j
+
+        def launch(x):
+            return pl.pallas_call(_kern, grid=(4, 8))(x)
+    """
+    fs = run_rules(tmp_path, src, ["pallas-grid"], rel="ops/kern.py")
+    assert len(fs) == 1
+    assert "program_id(2)" in fs[0].message
+    assert "rank-2" in fs[0].message
+
+
+def test_pallas_grid_resolves_partial_and_grid_spec(tmp_path):
+    src = """
+        import functools
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        def _kern(s_ref, x_ref, o_ref, scale):
+            b = pl.program_id(0)
+            j = pl.program_id(1)
+            o_ref[...] = x_ref[...] * scale + b + j
+
+        def launch(x):
+            kernel = functools.partial(_kern, scale=2.0)
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=(2, 3))
+            return pl.pallas_call(kernel, grid_spec=grid_spec)(x)
+    """
+    assert run_rules(tmp_path, src, ["pallas-grid"],
+                     rel="ops/kern.py") == []
+
+
+# ----------------------------------------------------------- suppression
+def test_suppression_same_line_and_next_line(tmp_path):
+    src = HOT_SYNC.replace(
+        "toks = np.asarray(self._device_tokens())",
+        "toks = np.asarray(self._device_tokens())  "
+        "# tpulint: disable=host-sync")
+    assert run_rules(tmp_path, src, ["host-sync"]) == []
+
+    src = HOT_SYNC.replace(
+        "toks = np.asarray(self._device_tokens())",
+        "# tpulint: disable-next-line=host-sync\n"
+        "            toks = np.asarray(self._device_tokens())")
+    assert run_rules(tmp_path, src, ["host-sync"]) == []
+
+
+def test_suppression_skip_file_and_unrelated_rule(tmp_path):
+    src = "# tpulint: skip-file\n" + textwrap.dedent(HOT_SYNC)
+    assert run_rules(tmp_path, src, ["host-sync"]) == []
+
+    # suppressing a DIFFERENT rule must not silence host-sync
+    src = HOT_SYNC.replace(
+        "toks = np.asarray(self._device_tokens())",
+        "toks = np.asarray(self._device_tokens())  "
+        "# tpulint: disable=pallas-grid")
+    assert len(run_rules(tmp_path, src, ["host-sync"])) == 1
+
+
+# -------------------------------------------------------------- baseline
+def test_baseline_roundtrip_and_line_insensitivity(tmp_path):
+    fs = run_rules(tmp_path, HOT_SYNC, ["host-sync"])
+    assert fs
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), fs)
+
+    # same findings at a different line (edit above) stay baselined
+    shifted = "\n\n\n" + textwrap.dedent(HOT_SYNC)
+    (tmp_path / "serving" / "mod.py").write_text(shifted)
+    analyzer = Analyzer(all_rules(["host-sync"]), root=str(tmp_path))
+    fs2, _ = analyzer.run([str(tmp_path / "serving" / "mod.py")])
+    assert [f.line for f in fs2] != [f.line for f in fs]
+    new, old = apply_baseline(fs2, load_baseline(str(bl_path)))
+    assert new == [] and len(old) == len(fs)
+
+
+def test_baseline_write_is_deterministic(tmp_path):
+    fs = run_rules(tmp_path, HOT_SYNC, ["host-sync"])
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    write_baseline(str(a), list(reversed(fs)))
+    write_baseline(str(b), fs)
+    assert a.read_bytes() == b.read_bytes()
+    data = json.loads(a.read_text())
+    assert data["version"] == 1
+    assert all(set(e) == {"rule", "path", "symbol", "message", "count"}
+               for e in data["entries"])
+
+
+def test_unknown_rule_id_raises():
+    try:
+        all_rules(["host-sync", "no-such-rule"])
+    except ValueError as e:
+        assert "no-such-rule" in str(e)
+    else:
+        raise AssertionError("expected ValueError")
+
+
+# ------------------------------------------------------------------- CLI
+def _cli(args, cwd=None):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": ROOT})
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpulint.py")]
+        + args, capture_output=True, text=True, env=env, cwd=cwd,
+        timeout=300)
+
+
+def test_cli_json_report_on_fixture(tmp_path):
+    mod = tmp_path / "serving" / "mod.py"
+    mod.parent.mkdir(parents=True)
+    mod.write_text(textwrap.dedent(HOT_SYNC))
+    r = _cli([str(mod), "--no-baseline", "--json",
+              "--rules", "host-sync"])
+    assert r.returncode == 1, r.stdout + r.stderr
+    rep = json.loads(r.stdout)
+    assert rep["exit"] == 1 and len(rep["new"]) == 1
+    f = rep["new"][0]
+    assert f["rule"] == "host-sync" and f["line"] > 0
+    assert rep["rules"] == ["host-sync"]
+
+
+def test_cli_list_rules_covers_registry():
+    r = _cli(["--list-rules"])
+    assert r.returncode == 0
+    for rid in ("host-sync", "recompile-hazard", "lock-discipline",
+                "tracer-leak", "traced-branch", "missing-donation",
+                "metric-sync", "pallas-grid"):
+        assert rid in r.stdout

@@ -5,9 +5,11 @@ Three sync points must agree or dashboards silently break:
 
   1. the Prometheus text the server renders must be syntactically valid
      (metric/label name syntax, typed samples, no duplicate series);
-  2. every family in the exposition must appear in the metric catalog
-     in docs/OBSERVABILITY.md and vice versa (``<family>_count``
-     lifetime-sample counters are implied by their base family);
+  2. the renderer source and the metric catalog in
+     docs/OBSERVABILITY.md must agree — checked by tpulint's
+     metric-sync rule (paddle_infer_tpu/analysis/rules/metric_sync.py)
+     so each drift is reported with its file:line (the ``w.family``
+     call or the catalog table row), not as a bare name-set diff;
   3. every latency-series key in ``ServingMetrics.snapshot()`` must
      have a renderer mapping (``prometheus.SERIES_FAMILIES``) — a new
      series added to the snapshot but not the renderer would be
@@ -25,13 +27,10 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
-
-_CATALOG_ROW = re.compile(r"^\|\s*`([a-zA-Z_:][a-zA-Z0-9_:]*)`\s*\|")
 
 
 def fabricated_exposition():
@@ -82,33 +81,21 @@ def fabricated_exposition():
     return snap, summary, render_prometheus(snap, summary)
 
 
-def catalog_names(docs_path: str):
-    """Family names from the docs metric-catalog table (backticked
-    first column of ``| `name` | type | unit | meaning |`` rows).
-    Only rows after a ``Metric catalog`` heading count, up to the next
-    heading — the docs have other backticked tables (span names)."""
-    names = []
-    in_catalog = False
-    saw_heading = False
-    with open(docs_path) as f:
-        for line in f:
-            stripped = line.strip()
-            if stripped.startswith("#"):
-                in_catalog = "metric catalog" in stripped.lower()
-                saw_heading = saw_heading or in_catalog
-                continue
-            if not in_catalog:
-                continue
-            mt = _CATALOG_ROW.match(stripped)
-            if mt and mt.group(1) not in ("family",):
-                names.append(mt.group(1))
-    if not saw_heading:        # headingless doc (tests): take every row
-        with open(docs_path) as f:
-            for line in f:
-                mt = _CATALOG_ROW.match(line.strip())
-                if mt and mt.group(1) not in ("family",):
-                    names.append(mt.group(1))
-    return names
+def metric_sync_problems(docs_path: str):
+    """Code ↔ docs drift via tpulint's metric-sync rule: each problem
+    carries the file:line of the offending ``w.family`` call or catalog
+    table row (headingless docs fall back to every ``| `name` |``
+    row — the rule handles that too)."""
+    from paddle_infer_tpu.analysis import Analyzer
+    from paddle_infer_tpu.analysis.rules import MetricSyncRule
+
+    analyzer = Analyzer(
+        [MetricSyncRule()], root=ROOT,
+        config={"metric_docs": os.path.abspath(docs_path)})
+    findings, _ = analyzer.run(
+        [os.path.join(ROOT, "paddle_infer_tpu", "observability"),
+         os.path.join(ROOT, "paddle_infer_tpu", "serving")])
+    return [f"{f.path}:{f.line}: {f.message}" for f in findings]
 
 
 def run_checks(docs_path: str):
@@ -124,21 +111,7 @@ def run_checks(docs_path: str):
     families = family_names(text)
     if len(set(families)) != len(families):
         problems.append("duplicate TYPE declarations in exposition")
-    catalog = catalog_names(docs_path)
-    if not catalog:
-        problems.append(f"no metric catalog rows found in {docs_path}")
-    cat = set(catalog)
-    for fam in families:
-        if fam in cat:
-            continue
-        if fam.endswith("_count") and fam[:-len("_count")] in cat:
-            continue
-        problems.append(f"exposed family {fam} missing from the "
-                        f"catalog in {docs_path}")
-    for name in catalog:
-        if name not in families:
-            problems.append(f"catalog entry {name} not emitted by the "
-                            "renderer (stale docs?)")
+    problems += metric_sync_problems(docs_path)
 
     # snapshot <-> renderer mapping: every reservoir series in the
     # snapshot must have a SERIES_FAMILIES entry

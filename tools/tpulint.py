@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""tpulint CLI: run the paddle_infer_tpu static-analysis rules.
+
+Usage:
+    python tools/tpulint.py [paths...]          # human report, exit 1
+                                                # on non-baselined findings
+    python tools/tpulint.py --json              # machine report
+    python tools/tpulint.py --rules host-sync,lock-discipline
+    python tools/tpulint.py --list-rules
+    python tools/tpulint.py --baseline-update   # rewrite the baseline
+                                                # deterministically
+
+The analysis package is loaded straight from its files rather than
+through ``import paddle_infer_tpu`` — the parent package pulls in
+jax/numpy, and the linter must keep working (and stay fast) on a
+commit that broke those imports.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = "_tpulint_analysis"
+
+
+def _load_analysis():
+    if _PKG in sys.modules:
+        return sys.modules[_PKG]
+    pkg_dir = os.path.join(ROOT, "paddle_infer_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        _PKG, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[_PKG] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint", description="TPU/JAX hazard and lock-discipline "
+        "static analysis for paddle_infer_tpu")
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(ROOT, "paddle_infer_tpu")],
+                    help="files/directories to analyze "
+                    "(default: the package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit a JSON report instead of text")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run "
+                    "(default: all)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--baseline",
+                    default=os.path.join(ROOT, "tools",
+                                         "tpulint_baseline.json"),
+                    help="baseline file (default: "
+                    "tools/tpulint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline file")
+    ap.add_argument("--baseline-update", action="store_true",
+                    help="rewrite the baseline from the current "
+                    "findings (sorted, path-relative, deterministic)")
+    ap.add_argument("--metric-docs", default=None,
+                    help="override the metric-catalog document "
+                    "(default: docs/OBSERVABILITY.md)")
+    args = ap.parse_args(argv)
+
+    an = _load_analysis()
+
+    if args.list_rules:
+        for cls in an.RULE_CLASSES:
+            print(f"{cls.id:18s} {cls.name}")
+            print(f"{'':18s}   {cls.rationale}")
+        return 0
+
+    only = ([r.strip() for r in args.rules.split(",") if r.strip()]
+            if args.rules else None)
+    try:
+        rules = an.all_rules(only)
+    except ValueError as e:
+        print(f"tpulint: {e}", file=sys.stderr)
+        return 2
+
+    config = {}
+    if args.metric_docs:
+        config["metric_docs"] = os.path.abspath(args.metric_docs)
+    analyzer = an.Analyzer(rules, root=ROOT, config=config)
+    findings, n_files = analyzer.run(args.paths)
+
+    if args.baseline_update:
+        n = an.write_baseline(args.baseline, findings)
+        rel = os.path.relpath(args.baseline, ROOT)
+        print(f"tpulint: wrote {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} "
+              f"({len(findings)} findings) to {rel}")
+        return 0
+
+    baseline = {} if args.no_baseline else an.load_baseline(
+        args.baseline)
+    new, baselined = an.apply_baseline(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "files": n_files,
+            "rules": [r.id for r in rules],
+            "new": [f.to_dict() for f in new],
+            "baselined": [f.to_dict() for f in baselined],
+            "exit": 1 if new else 0,
+        }, indent=2, sort_keys=True))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.format())
+    tail = f", {len(baselined)} baselined" if baselined else ""
+    print(f"tpulint: {n_files} files, {len(new)} finding"
+          f"{'' if len(new) == 1 else 's'}{tail}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
