@@ -631,6 +631,13 @@ class PagedGenerationEngine(GenerationEngine):
         call (their contents — every in-flight row's KV — are gone)."""
         return self._k_pages is None
 
+    def drop_kv_state(self):
+        """Deliberately forget the device page pools — the fault-plane
+        hook modeling a failure *inside* a donated call (serving/
+        resilience/).  ``kv_state_lost()`` reports True until the next
+        dispatch rebuilds the pools zeroed via ``_ensure_pages``."""
+        self._k_pages = self._v_pages = None
+
     def _build_paged(self, batch, plen, g: GenerationConfig):
         max_new = g.max_new_tokens
         L = self._num_layers

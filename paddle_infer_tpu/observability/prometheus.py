@@ -147,6 +147,55 @@ def render_prometheus(snapshot: dict,
                  "Full-page nodes currently in the radix tree")
         w.sample("prefix_cache_nodes", px.get("nodes"))
 
+    res = snapshot.get("resilience") or {}
+    if res:
+        w.family("engine_health_state", "gauge",
+                 "Engine health state machine, one-hot by state label "
+                 "(healthy/degraded/draining/down)")
+        current = res.get("health_state", "healthy")
+        for state in ("healthy", "degraded", "draining", "down"):
+            w.sample("engine_health_state", int(state == current),
+                     {"state": state})
+        w.family("serving_effective_max_batch", "gauge",
+                 "Slots the degradation ladder currently allows "
+                 "(<= serving_max_batch)")
+        w.sample("serving_effective_max_batch",
+                 res.get("effective_max_batch"))
+        w.family("engine_restarts_total", "counter",
+                 "Engine restarts after KV state loss (pools rebuilt, "
+                 "in-flight rows replayed)")
+        w.sample("engine_restarts_total", res.get("engine_restarts", 0))
+        w.family("request_retries_total", "counter",
+                 "Requests requeued for replay after an engine failure")
+        w.sample("request_retries_total", res.get("request_retries", 0))
+        w.family("watchdog_trips_total", "counter",
+                 "Supervisor step-watchdog trips (hung or overlong "
+                 "scheduler steps)")
+        w.sample("watchdog_trips_total", res.get("watchdog_trips", 0))
+        w.family("requests_quarantined_total", "counter",
+                 "Poison requests quarantined (retry budget spent or "
+                 "non-finite logits)")
+        w.sample("requests_quarantined_total",
+                 res.get("requests_quarantined", 0))
+        w.family("requests_shed_total", "counter",
+                 "Queued requests shed by the degradation ladder "
+                 "(insufficient deadline headroom)")
+        w.sample("requests_shed_total", res.get("requests_shed", 0))
+        w.family("engine_loop_exceptions_total", "counter",
+                 "Exceptions escaping a scheduler loop iteration")
+        w.sample("engine_loop_exceptions_total",
+                 res.get("loop_exceptions", 0))
+        faults = res.get("faults_injected") or {}
+        w.family("faults_injected_total", "counter",
+                 "Faults injected by the fault plane, by site "
+                 "(0 everywhere in production)")
+        if faults:
+            for site in sorted(faults):
+                w.sample("faults_injected_total", faults[site],
+                         {"site": site})
+        else:
+            w.sample("faults_injected_total", 0, {"site": "none"})
+
     counters = snapshot.get("counters") or {}
     for key in sorted(counters):
         name = f"serving_{key}_total"

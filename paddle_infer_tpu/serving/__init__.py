@@ -21,6 +21,11 @@ Layer map:
   ``ServingMetrics``  queue depth, batch occupancy, TTFT, inter-token
                       latency p50/p99, tokens/s, rejection counts —
                       exposed by ``tools/serve.py`` as ``GET /metrics``.
+  ``resilience``      fault tolerance: deterministic fault injection
+                      (``FaultPlane``), supervised retry/replay recovery
+                      (``EngineSupervisor``) and the HEALTHY/DEGRADED/
+                      DRAINING/DOWN health state machine driving
+                      ``/healthz``/``/readyz`` and load shedding.
 
 Requests with per-request sampling configs share one decode executable:
 temperature/top-k/top-p/eos ride as *per-row arrays* (serving/programs),
@@ -28,9 +33,12 @@ so admitting a new request never recompiles the hot loop.
 """
 
 from .metrics import ServingMetrics
-from .request import (DeadlineExceededError, QueueFullError, RejectedError,
+from .request import (DeadlineExceededError, LoadShedError,
+                      QuarantinedError, QueueFullError, RejectedError,
                       Request, RequestQueue, RequestState)
 from .engine_core import EngineCore
+from .resilience import (EngineSupervisor, FaultPlane, FaultSpec,
+                         HealthMonitor, HealthState)
 
 __all__ = [
     "EngineCore",
@@ -41,4 +49,11 @@ __all__ = [
     "RejectedError",
     "QueueFullError",
     "DeadlineExceededError",
+    "QuarantinedError",
+    "LoadShedError",
+    "EngineSupervisor",
+    "FaultPlane",
+    "FaultSpec",
+    "HealthMonitor",
+    "HealthState",
 ]

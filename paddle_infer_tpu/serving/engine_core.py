@@ -32,8 +32,10 @@ where no live row's attention can see them.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
+import traceback
 from typing import List, Optional
 
 import jax
@@ -46,8 +48,13 @@ from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .programs import (build_decode, build_page_copy, build_prefill,
                        build_prefix_prefill)
-from .request import (DeadlineExceededError, QueueFullError, RejectedError,
-                      Request, RequestQueue, RequestState)
+from .request import (DeadlineExceededError, LoadShedError, QuarantinedError,
+                      QueueFullError, RejectedError, Request, RequestQueue,
+                      RequestState)
+from .resilience.faultplane import (InjectedFault, InjectedMemoryError,
+                                    NULL_PLANE)
+
+_log = logging.getLogger(__name__)
 
 _TRACE_STATE = {RequestState.DONE: "done", RequestState.FAILED: "failed",
                 RequestState.CANCELLED: "cancelled",
@@ -70,9 +77,18 @@ class EngineCore:
                  metrics: Optional[ServingMetrics] = None,
                  tracer: Optional[Tracer] = None,
                  enable_prefix_cache: bool = False,
-                 prefix_cache_watermark: float = 0.5):
+                 prefix_cache_watermark: float = 0.5,
+                 fault_plane=None):
         self._engine = engine
         self._max_batch = int(max_batch)
+        # resilience plumbing (serving/resilience/): the fault plane is
+        # the NULL no-op unless a chaos schedule is attached; a recovery
+        # protocol (EngineSupervisor) may be wired in via
+        # attach_recovery() to enable retry/replay on engine failure
+        self._fault = fault_plane if fault_plane is not None else NULL_PLANE
+        self._recovery = None
+        self._drain_evt = threading.Event()
+        self._loop_tb_seen: set = set()
         self._decode_chunk = max(1, int(decode_chunk))
         self._default_timeout = default_timeout_s
         self._metrics = metrics or ServingMetrics()
@@ -113,6 +129,9 @@ class EngineCore:
             if enable_prefix_cache else None)
 
         self._slots: List[Optional[dict]] = [None] * self._max_batch
+        # degradation ladder: memory pressure shrinks the batch the
+        # scheduler will actually fill; recovery grows it back
+        self._effective_max_batch = self._max_batch
         self.step_trace: List[dict] = []
         self._step_idx = 0
         # RLock: the locked step path reads ``active_count``, which now
@@ -148,9 +167,71 @@ class EngineCore:
     def prefix_cache(self) -> Optional[PrefixCache]:
         return self._prefix_cache
 
+    # ------------------------------------------------ resilience surface
+    @property
+    def max_batch(self) -> int:
+        return self._max_batch
+
+    @property
+    def effective_max_batch(self) -> int:
+        """Slots the scheduler will currently fill (≤ max_batch; shrunk
+        by the degradation ladder under memory pressure)."""
+        with self._step_lock:
+            return self._effective_max_batch
+
+    def set_effective_max_batch(self, n: int):
+        with self._step_lock:
+            self._effective_max_batch = max(1, min(int(n),
+                                                   self._max_batch))
+
+    @property
+    def fault_plane(self):
+        return self._fault
+
+    def attach_recovery(self, recovery):
+        """Wire a recovery protocol (resilience.EngineSupervisor) into
+        the failure paths: engine failures then replay in-flight
+        requests under a retry budget instead of failing them."""
+        self._recovery = recovery
+
+    def set_draining(self, draining: bool):
+        """While draining, ``submit`` rejects with ``LoadShedError``
+        (HTTP 503 + Retry-After); in-flight requests keep decoding."""
+        if draining:
+            self._drain_evt.set()
+        else:
+            self._drain_evt.clear()
+
+    @property
+    def draining(self) -> bool:
+        return self._drain_evt.is_set()
+
+    def shed_queued(self, min_headroom_s: float) -> int:
+        """Degradation-ladder load shedding: reject queued requests whose
+        deadline headroom is below ``min_headroom_s`` — under a degraded
+        engine they would burn a prefill and miss their deadline anyway."""
+        shed = self._queue.shed_low_headroom(time.monotonic(),
+                                             min_headroom_s)
+        for r in shed:
+            self._metrics.on_shed()
+            r._finish(RequestState.REJECTED, LoadShedError(
+                f"request {r.rid} shed: deadline headroom below "
+                f"{min_headroom_s:.2f}s under degraded engine"))
+            self._trace_queue_drop(r, RequestState.REJECTED, "load-shed")
+        return len(shed)
+
     def metrics_snapshot(self) -> dict:
         total = self._pool.num_blocks
         free = self._pool.free_blocks
+        resilience = {"effective_max_batch": self.effective_max_batch,
+                      "draining": self._drain_evt.is_set(),
+                      "faults_injected": self._fault.counts()}
+        rec = self._recovery
+        if rec is not None:
+            resilience.update(rec.health_info())
+        else:
+            resilience.update({"health_state": "healthy",
+                               "health_code": 0})
         return self._metrics.snapshot(
             queue_depth=len(self._queue),
             active=self.active_count,
@@ -160,7 +241,8 @@ class EngineCore:
                      "used_blocks": int(total - free),
                      "occupancy": (total - free) / total if total else 0.0},
             prefix_cache=(self._prefix_cache.stats_snapshot()
-                          if self._prefix_cache is not None else None))
+                          if self._prefix_cache is not None else None),
+            resilience=resilience)
 
     # ------------------------------------------------------- trace hooks
     def _trace_end(self, req: Request, state: RequestState):
@@ -185,6 +267,10 @@ class EngineCore:
         ``Request`` handles immediately — stream or ``result()`` them."""
         if self._closed:
             raise RejectedError("serving engine is closed")
+        if self._drain_evt.is_set():
+            self._metrics.on_rejected()
+            raise LoadShedError("serving engine is draining; retry "
+                                "against another replica")
         g = config or GenerationConfig()
         if not self.batchable(g):
             self._metrics.on_rejected()
@@ -229,6 +315,10 @@ class EngineCore:
         in ``req.value``."""
         if self._closed:
             raise RejectedError("serving engine is closed")
+        if self._drain_evt.is_set():
+            self._metrics.on_rejected()
+            raise LoadShedError("serving engine is draining; retry "
+                                "against another replica")
         timeout_s = self._default_timeout if timeout_s is None else timeout_s
         req = Request(None, GenerationConfig(), timeout_s=timeout_s,
                       kind="exclusive", exclusive_fn=fn)
@@ -279,7 +369,11 @@ class EngineCore:
             self._run_exclusive(self._queue.pop())
             progressed = True
 
-        while None in self._slots:
+        # admission honors the degradation ladder: under memory pressure
+        # the supervisor shrinks effective_max_batch below the physical
+        # slot count and the surplus slots stay empty
+        while (None in self._slots
+               and self.active_count < self._effective_max_batch):
             head = self._queue.peek()
             if head is None or head.kind != "batch":
                 break
@@ -328,14 +422,16 @@ class EngineCore:
             samp["pad"][i] = g.pad_token_id
         return samp
 
-    def _match_prefix(self, req: Request):
-        """Query the radix tree for ``req``'s longest cached prefix and
-        trim it until the padded suffix fits the fixed table window
+    def _match_prefix(self, req: Request, tokens: np.ndarray):
+        """Query the radix tree for the longest cached prefix of
+        ``tokens`` (the prompt; on replay, prompt + delivered tokens)
+        and trim it until the padded suffix fits the fixed table window
         (``cached + plen(length - cached) <= plen_cap``; cached == 0
         always fits because the cold plen clamps to the cap)."""
+        self._fault.fire("prefix.match", rid=req.rid)
         cache = self._prefix_cache
-        length = int(req.prompt.size)
-        match = cache.match(req.prompt, salt=req.cache_salt)
+        length = int(tokens.size)
+        match = cache.match(tokens, salt=req.cache_salt)
         while (match.cached_tokens and
                match.cached_tokens +
                self._plen(length - match.cached_tokens) > self._plen_cap):
@@ -345,6 +441,7 @@ class EngineCore:
     def _copy_page(self, src: int, dst: int):
         """Device-side copy of one physical page across every layer's
         pools (the CoW step for a shared partial tail block)."""
+        self._fault.fire("page.copy")
         eng = self._engine
         ckey = ("serve-page-copy", self._pool.num_blocks)
         eng.run_paged_program(
@@ -423,18 +520,35 @@ class EngineCore:
 
     def _admit(self, req: Request, sid: int):
         admit_t = time.monotonic()
-        self.tracer.add_span(req.rid, "queue_wait", req.arrival, admit_t)
+        self.tracer.add_span(req.rid, "queue_wait",
+                             req.requeued_at if req.retries
+                             else req.arrival, admit_t)
         g = req.config
-        length = int(req.prompt.size)
+        # replay (req.retries > 0, tokens already delivered): the row
+        # resumes from prompt + delivered tokens.  The full sequence
+        # re-prefills — with the prefix cache holding the pages retained
+        # at failure time, only the uncached suffix runs through the
+        # model — and the NEXT token samples at generation step
+        # ``already`` (same fold_in stream the lost decode would have
+        # used), so the consumer's stream continues without loss,
+        # duplication or divergence.
+        already = req.emitted
+        # req.tokens is a host-side list — no device readback here
+        full = (req.prompt if already == 0 else np.concatenate(
+            # tpulint: disable-next-line=host-sync
+            [req.prompt, np.asarray(req.tokens, np.int32)]))
+        length = int(full.size)
+        budget = g.max_new_tokens - already
         cache = self._prefix_cache
         eng = self._engine
         match = None
         try:
+            self._fault.fire("kv.alloc", rid=req.rid)
             self._pool.free(sid)
             if cache is not None:
-                match = self._match_prefix(req)
+                match = self._match_prefix(req, full)
                 cached, reserve = self._stage_prefix(
-                    sid, match, length, g.max_new_tokens)
+                    sid, match, length, budget)
                 prefill_t = time.monotonic()
                 self.tracer.add_span(
                     req.rid, "prefix_match", admit_t, prefill_t,
@@ -443,23 +557,19 @@ class EngineCore:
             else:
                 cached = 0
                 prefill_t = admit_t
-                reserve = max(self._plen(length), length + g.max_new_tokens)
+                reserve = max(self._plen(length), length + budget)
                 self._pool.reserve(sid, reserve)
         except Exception as e:
             self._release_slot_kv(sid, match)
-            self._metrics.on_failed()
-            req._finish(RequestState.FAILED, e)
             self.tracer.add_span(req.rid, "prefill", admit_t,
                                  time.monotonic(), slot=sid,
                                  outcome="failed")
-            self._trace_end(req, RequestState.FAILED)
-            if eng.kv_state_lost():
-                self._fail_all(e)
+            self._admit_failure(req, e)
             return
         suffix = length - cached
         plen = self._plen(suffix)
         ids = np.full((1, plen), g.pad_token_id, np.int32)
-        ids[0, :suffix] = req.prompt[cached:]
+        ids[0, :suffix] = full[cached:]
         table = np.full((self._max_pages,), self._scratch, np.int32)
         t = self._pool.block_table(sid)[:self._max_pages]
         # intentional host work at admission: the block table and the
@@ -469,8 +579,10 @@ class EngineCore:
         # tpulint: disable-next-line=host-sync
         key = np.asarray(
             jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))
+        steps0 = np.asarray([already], np.int32)
         span_name = "prefill" if cache is None else "suffix_prefill"
         try:
+            self._fault.fire("prefill.run", rid=req.rid)
             if cache is not None:
                 # windowed family: cold (offset 0) and warm (offset c)
                 # share one executable per plen bucket, so a hit never
@@ -482,7 +594,7 @@ class EngineCore:
                     lambda: build_prefix_prefill(eng, plen,
                                                  self._max_pages),
                     ids, np.asarray([suffix], np.int32),
-                    np.asarray([cached], np.int32), table[None],
+                    np.asarray([cached], np.int32), steps0, table[None],
                     self._samp_arrays([g]), key[None])
             else:
                 pkey = ("serve-prefill", plen, self._max_pages,
@@ -490,18 +602,14 @@ class EngineCore:
                 tok, fin = eng.run_paged_program(
                     pkey,
                     lambda: build_prefill(eng, plen, self._max_pages),
-                    ids, np.asarray([length], np.int32), table[None],
-                    self._samp_arrays([g]), key[None])
+                    ids, np.asarray([length], np.int32), steps0,
+                    table[None], self._samp_arrays([g]), key[None])
         except Exception as e:
             self._release_slot_kv(sid, match)
-            self._metrics.on_failed()
-            req._finish(RequestState.FAILED, e)
             self.tracer.add_span(req.rid, span_name, prefill_t,
                                  time.monotonic(), slot=sid, plen=plen,
                                  outcome="failed")
-            self._trace_end(req, RequestState.FAILED)
-            if eng.kv_state_lost():
-                self._fail_all(e)
+            self._admit_failure(req, e)
             return
         # the intentional once-per-admission sync: the first token and
         # finish flag drive host-side slot bookkeeping
@@ -510,7 +618,10 @@ class EngineCore:
         # tpulint: disable-next-line=host-sync
         finished = bool(np.asarray(fin)[0])
         req._mark_active()
-        self._metrics.on_prefill(time.monotonic() - req.arrival)
+        if already == 0:
+            # TTFT is a first-admission metric; a replayed request's
+            # first token was delivered long ago
+            self._metrics.on_prefill(time.monotonic() - req.arrival)
         req._emit(np.asarray([tok], np.int32))
         self._metrics.on_tokens(1)
         # the prefill span runs edge-to-edge (admission bookkeeping +
@@ -518,23 +629,143 @@ class EngineCore:
         # between queue_wait and the first decode chunk is unattributed
         span_end = time.monotonic()
         self.tracer.add_span(req.rid, span_name, prefill_t, span_end,
-                             slot=sid, plen=plen, cached_tokens=cached)
-        if finished or g.max_new_tokens <= 1:
-            # the prompt's KV is fully written — retain it even though
-            # the row never reaches a decode chunk
-            self._release_slot_kv(sid, match, retain_tokens=req.prompt,
-                                  salt=req.cache_salt)
+                             slot=sid, plen=plen, cached_tokens=cached,
+                             replay=req.retries)
+        if finished or budget <= 1:
+            # KV through the penultimate delivered token is fully
+            # written — retain it even though the row never reaches a
+            # decode chunk (cold case: that's exactly the prompt)
+            self._release_slot_kv(
+                sid, match, retain_tokens=np.concatenate(
+                    # req.tokens is a host-side list — no readback
+                    # tpulint: disable-next-line=host-sync
+                    [req.prompt, np.asarray(req.tokens[:-1], np.int32)]),
+                salt=req.cache_salt)
             req._finish(RequestState.DONE)
             self._metrics.on_completed(time.monotonic() - req.arrival)
             self._trace_end(req, RequestState.DONE)
             return
         self._slots[sid] = {"req": req, "sid": sid, "g": g,
-                            "length": length, "plen": plen,
-                            "emitted": 1, "last_tok": tok,
+                            "length": int(req.prompt.size), "plen": plen,
+                            "emitted": already + 1, "last_tok": tok,
                             "last_emit": time.monotonic(),
                             "table": table, "key": key,
                             "match": match,
                             "span_end": span_end}
+
+    # ---------------------------------------------------- failure paths
+    def _admit_failure(self, req: Request, err: BaseException):
+        """An admission (reservation/prefix/prefill) failed AFTER the
+        slot's KV was released.  Route it through the recovery protocol:
+        memory pressure feeds the degradation ladder, KV loss restarts
+        the engine and replays every in-flight row, and the request
+        itself is requeued under its retry budget or failed."""
+        rec = self._recovery
+        if getattr(err, "lose_kv", False):
+            self._engine.drop_kv_state()
+        if rec is not None:
+            if isinstance(err, MemoryError):
+                # its own ladder — not a crash-streak event
+                rec.on_memory_pressure()
+            else:
+                rec.on_engine_failure(err)
+        if self._engine.kv_state_lost():
+            self._recover_lost_state(err)
+        self._replay_or_fail(req, err)
+
+    def _recover_lost_state(self, err: BaseException):
+        """The device page pools were consumed by a failed donated call:
+        count an engine restart, drop every retained cache page (the
+        pools rebuild zeroed — their contents are garbage now) and
+        replay or fail every in-flight row."""
+        self._metrics.on_engine_restart()
+        rec = self._recovery
+        if rec is not None:
+            rec.on_engine_restart()
+        # release every slot BEFORE clearing the cache: clear() keeps
+        # nodes pinned by live match references, and a node surviving
+        # into the rebuilt (zeroed) pool would hand replayed rows stale
+        # pages — silently corrupting their token streams
+        for s in list(self._slots):
+            if s is not None:
+                self._replay_or_fail_slot(s, err, kv_intact=False)
+        if self._prefix_cache is not None:
+            self._prefix_cache.clear()
+
+    def _replay_or_fail(self, req: Request, err: BaseException):
+        """Requeue ``req`` for replay at the queue head if the recovery
+        protocol grants a retry; otherwise finish it FAILED (quarantined
+        when a retry budget existed and is spent)."""
+        rec = self._recovery
+        if req.expired():
+            self._metrics.on_deadline()
+            req._finish(RequestState.CANCELLED, DeadlineExceededError(
+                f"request {req.rid} deadline exceeded during recovery"))
+            self._trace_end(req, RequestState.CANCELLED)
+            return
+        if rec is not None and rec.request_should_replay(req, err):
+            req._requeue()
+            self._metrics.on_retry()
+            now = time.monotonic()
+            self.tracer.add_span(req.rid, "recovery", now, now,
+                                 retry=req.retries,
+                                 cause=type(err).__name__)
+            self._queue.push_front(req)
+            return
+        ferr = err
+        if rec is not None:
+            self._metrics.on_quarantined()
+            ferr = QuarantinedError(
+                f"request {req.rid} quarantined after {req.retries} "
+                f"retries: {err!r}")
+        self._metrics.on_failed()
+        req._finish(RequestState.FAILED, ferr)
+        self._trace_end(req, RequestState.FAILED)
+
+    def _replay_or_fail_slot(self, s: dict, err: BaseException,
+                             kv_intact: bool):
+        """Slot-holding variant of ``_replay_or_fail``: releases the
+        slot's KV first — retaining prompt + delivered tokens in the
+        prefix cache when the pages are still valid, so the replay
+        re-prefills only the uncached suffix."""
+        req = s["req"]
+        rec = self._recovery
+        if req.expired():
+            self._metrics.on_deadline()
+            self._evict(s, RequestState.CANCELLED, DeadlineExceededError(
+                f"request {req.rid} deadline exceeded during recovery"))
+            return
+        if rec is not None and rec.request_should_replay(req, err):
+            self._slots[s["sid"]] = None
+            retain = None
+            if kv_intact and self._prefix_cache is not None:
+                # KV for prompt + all-but-the-last delivered token is
+                # valid in the row's pages (the last token's KV is never
+                # written until its decode step runs)
+                retain = np.concatenate(
+                    # req.tokens is a host-side list — no readback
+                    # tpulint: disable-next-line=host-sync
+                    [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
+            self._release_slot_kv(s["sid"], s.get("match"),
+                                  retain_tokens=retain,
+                                  salt=req.cache_salt)
+            req._requeue()
+            self._metrics.on_retry()
+            now = time.monotonic()
+            self.tracer.add_span(req.rid, "recovery",
+                                 s.get("span_end", now), now,
+                                 retry=req.retries,
+                                 cause=type(err).__name__)
+            self._queue.push_front(req)
+            return
+        if rec is not None:
+            self._metrics.on_quarantined()
+            ferr: BaseException = QuarantinedError(
+                f"request {req.rid} quarantined after {req.retries} "
+                f"retries: {err!r}")
+        else:
+            ferr = RejectedError(f"in-flight KV state lost: {err!r}")
+        self._evict(s, RequestState.FAILED, ferr)
 
     # ------------------------------------------------------------ decode
     def _decode_step(self):
@@ -570,13 +801,33 @@ class EngineCore:
         dkey = ("serve-step", b, S, self._max_pages, self._pool.num_blocks)
         t0 = time.monotonic()
         try:
+            fault = self._fault.fire(
+                "decode.step", rids=[s["req"].rid for s in active])
             toks, fin_out, nvalid = eng.run_paged_program(
                 dkey, lambda: build_decode(eng, b, S, self._max_pages),
                 tok, fin, pos0, steps0, tables,
                 self._samp_arrays(cfgs), keys)
         except Exception as e:
             self._metrics.on_failed(0)
-            self._fail_all(e)
+            # only a fault-plane injection raised BEFORE dispatch leaves
+            # the pools provably intact; any exception out of the real
+            # donated call may have consumed them (their contents —
+            # every row's KV and every retained cache page — are then
+            # garbage), so KV-intact replay is reserved for injections
+            injected = isinstance(e, (InjectedFault, InjectedMemoryError))
+            if getattr(e, "lose_kv", False) or not injected:
+                self._engine.drop_kv_state()
+            rec = self._recovery
+            if rec is not None:
+                rec.on_engine_failure(e)
+            if self._engine.kv_state_lost():
+                self._recover_lost_state(e)
+            else:
+                # injected pre-dispatch fault: each row's KV is intact,
+                # so replays can retain their pages through the cache
+                for s in list(self._slots):
+                    if s is not None:
+                        self._replay_or_fail_slot(s, e, kv_intact=True)
             return
         wall = time.monotonic() - t0
         if not self._decode_warm:
@@ -593,6 +844,18 @@ class EngineCore:
         fin_out = np.asarray(fin_out)
         # tpulint: disable-next-line=host-sync
         nvalid = np.asarray(nvalid)
+        if fault is not None and fault.get("nan_rids"):
+            # injected NaN/inf logits: overwrite the target rows' chunk
+            # with the non-finite sampling sentinel (-1), exactly what a
+            # categorical over all-masked logits returns — the row
+            # validity check below then quarantines them.  ``toks`` was
+            # already read back above; this copy is host-only.
+            # tpulint: disable-next-line=host-sync
+            toks = np.array(toks)
+            bad = fault["nan_rids"]
+            for s in active:
+                if s["req"].rid in bad:
+                    toks[s["sid"], :] = -1
         self._step_idx += 1
         emitted_total = 0
         evicted = []
@@ -601,6 +864,17 @@ class EngineCore:
             i = s["sid"]
             n = min(int(nvalid[i]),
                     s["g"].max_new_tokens - s["emitted"])
+            if n > 0 and int(toks[i, :n].min()) < 0:
+                # non-finite logits produce the negative sampling
+                # sentinel; poison is row-local (per-row tables and
+                # masks), so quarantine ONLY this row — the rest of the
+                # batch keeps its tokens from this very chunk
+                self._metrics.on_quarantined()
+                self._evict(s, RequestState.FAILED, QuarantinedError(
+                    f"request {s['req'].rid} quarantined: non-finite "
+                    f"logits in decode chunk {self._step_idx}"))
+                evicted.append(s["req"].rid)
+                continue
             if n > 0:
                 s["req"]._emit(toks[i, :n])
                 s["last_tok"] = int(toks[i, n - 1])
@@ -625,6 +899,10 @@ class EngineCore:
             "step": self._step_idx, "batch_steps": S,
             "active": [s["req"].rid for s in active],
             "evicted": evicted})
+        if self._recovery is not None:
+            # a clean chunk resets crash/memory streaks and climbs the
+            # recovery ladder back toward full batch width
+            self._recovery.on_step_ok()
 
     # ---------------------------------------------------------- eviction
     def _evict(self, slot: dict, state: RequestState,
@@ -656,19 +934,6 @@ class EngineCore:
             self._metrics.on_completed(time.monotonic() - req.arrival)
         elif state == RequestState.FAILED:
             self._metrics.on_failed()
-
-    def _fail_all(self, err: BaseException):
-        """A failed donated call destroyed the page pools — every
-        in-flight row's KV is gone; fail them all rather than decode
-        from zeroed state."""
-        for s in list(self._slots):
-            if s is not None:
-                self._evict(s, RequestState.FAILED, RejectedError(
-                    f"in-flight KV state lost: {err!r}"))
-        if self._prefix_cache is not None:
-            # the device pools are rebuilt zeroed — every retained page's
-            # contents are gone, so cached entries must go with them
-            self._prefix_cache.clear()
 
     def _run_exclusive(self, req: Request):
         if req.expired():
@@ -705,40 +970,89 @@ class EngineCore:
         return self
 
     def _loop(self):
+        backoff = 0.01
         while not self._stop_evt.is_set():
             try:
                 self.run_once(wait_s=0.02)
+                backoff = 0.01
             except Exception:
                 # requests are failed individually; the scheduler itself
-                # must outlive any one bad program
-                time.sleep(0.01)
+                # must outlive any one bad program — but not silently:
+                # count it, log each distinct traceback once, and back
+                # off exponentially so a wedged engine can't spin hot
+                self._metrics.on_loop_exception()
+                tb = traceback.format_exc()
+                sig = hash(tb)
+                if sig not in self._loop_tb_seen \
+                        and len(self._loop_tb_seen) < 256:
+                    self._loop_tb_seen.add(sig)
+                    _log.exception(
+                        "serving loop step failed (backing off %.3fs)",
+                        backoff)
+                self._stop_evt.wait(backoff)
+                backoff = min(backoff * 2.0, 1.0)
 
-    def stop(self, timeout: float = 10.0):
-        if self._thread is not None:
-            self._stop_evt.set()
-            t, self._thread = self._thread, None
-            t.join(timeout)
+    def stop(self, timeout: float = 10.0) -> bool:
+        """Signal and join the loop thread.  Returns True when the
+        thread is down (or was never started) — False means it is still
+        wedged in a step after ``timeout`` and teardown must not assume
+        exclusive ownership of the pool."""
+        if self._thread is None:
+            return True
+        self._stop_evt.set()
+        t, self._thread = self._thread, None
+        t.join(timeout)
+        return not t.is_alive()
 
-    def close(self):
+    def close(self, timeout: float = 10.0):
         """Stop the loop, cancel everything in flight, and release every
-        pool reservation (incl. scratch) so the engine can be reused."""
+        pool reservation (incl. scratch) so the engine can be reused.
+        If the loop thread can't be joined (a step is wedged), escalate:
+        fail the queue and every in-flight request directly — without
+        touching the pool the wedged step still owns."""
         if self._closed:
             return
         self._closed = True
-        self.stop()
+        stopped = self.stop(timeout)
         # the loop thread is joined, but callers driving run_once()
         # from their own threads may still be mid-step — hold the step
-        # lock so teardown can't interleave with a decode chunk
-        with self._step_lock:
-            for r in self._queue.drain():
-                r._finish(RequestState.REJECTED,
-                          RejectedError("serving engine closed"))
-                self._trace_queue_drop(r, RequestState.REJECTED,
-                                       "engine-closed")
-            for s in list(self._slots):
-                if s is not None:
-                    self._evict(s, RequestState.CANCELLED,
-                                RejectedError("serving engine closed"))
-            if self._prefix_cache is not None:
-                self._prefix_cache.clear()
-            self._pool.free(self._max_batch)
+        # lock so teardown can't interleave with a decode chunk.  A
+        # wedged step (loop join timed out, or an external run_once()
+        # caller stuck in a device call) may hold the lock forever, so
+        # the wait is always bounded before escalating.
+        acquired = self._step_lock.acquire(
+            timeout=(max(timeout, 0.1) if stopped else 2.0))
+        if acquired:
+            try:
+                # re-entrant: already held via acquire() above — the
+                # ``with`` makes the lock scope explicit for teardown
+                with self._step_lock:
+                    for r in self._queue.drain():
+                        r._finish(RequestState.REJECTED,
+                                  RejectedError("serving engine closed"))
+                        self._trace_queue_drop(r, RequestState.REJECTED,
+                                               "engine-closed")
+                    for s in list(self._slots):
+                        if s is not None:
+                            self._evict(s, RequestState.CANCELLED,
+                                        RejectedError(
+                                            "serving engine closed"))
+                    if self._prefix_cache is not None:
+                        self._prefix_cache.clear()
+                    self._pool.free(self._max_batch)
+            finally:
+                self._step_lock.release()
+            return
+        # escalation path: no lock, no pool ops — just unblock every
+        # consumer so close() can't strand callers of result()/stream()
+        for r in self._queue.drain():
+            r._finish(RequestState.REJECTED, RejectedError(
+                "serving engine closed (scheduler wedged)"))
+            self._trace_queue_drop(r, RequestState.REJECTED,
+                                   "engine-closed")
+        # tpulint: disable-next-line=lock-discipline
+        for s in list(self._slots):
+            if s is not None:
+                s["req"]._finish(RequestState.FAILED, RejectedError(
+                    "serving engine closed while a step was wedged"))
+                self._trace_end(s["req"], RequestState.FAILED)

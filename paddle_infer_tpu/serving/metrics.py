@@ -86,6 +86,15 @@ class ServingMetrics:
             self.tokens_generated = 0
             self.prefills = 0
             self.decode_steps = 0
+            # resilience counters (serving/resilience/) — rendered as
+            # their own Prometheus families (engine_restarts_total, …),
+            # NOT through the auto-named serving_*_total counters block
+            self.engine_restarts = 0
+            self.request_retries = 0
+            self.watchdog_trips = 0
+            self.requests_quarantined = 0
+            self.requests_shed = 0
+            self.loop_exceptions = 0
             self.ttft = _Series()
             self.itl = _Series()            # inter-token latency (s)
             self.e2e = _Series()
@@ -143,6 +152,31 @@ class ServingMetrics:
             if e2e_s is not None:
                 self.e2e.add(e2e_s)
 
+    # --------------------------------------------- resilience hooks
+    def on_engine_restart(self, n: int = 1):
+        with self._lock:
+            self.engine_restarts += n
+
+    def on_retry(self, n: int = 1):
+        with self._lock:
+            self.request_retries += n
+
+    def on_watchdog_trip(self, n: int = 1):
+        with self._lock:
+            self.watchdog_trips += n
+
+    def on_quarantined(self, n: int = 1):
+        with self._lock:
+            self.requests_quarantined += n
+
+    def on_shed(self, n: int = 1):
+        with self._lock:
+            self.requests_shed += n
+
+    def on_loop_exception(self, n: int = 1):
+        with self._lock:
+            self.loop_exceptions += n
+
     # ------------------------------------------------------ rendering
     def tokens_per_second(self) -> float:
         now = time.monotonic()
@@ -157,14 +191,18 @@ class ServingMetrics:
     def snapshot(self, queue_depth: int = 0, active: int = 0,
                  max_batch: int = 0,
                  kv_pool: Optional[Dict] = None,
-                 prefix_cache: Optional[Dict] = None) -> Dict:
+                 prefix_cache: Optional[Dict] = None,
+                 resilience: Optional[Dict] = None) -> Dict:
         """Render everything to a plain dict (the ``GET /metrics`` JSON
         body).  Latency series carry lifetime ``count``/``mean`` plus
         reservoir-window ``p50_recent``/``p99_recent``/``max_recent``
         (see ``_Series``).  ``kv_pool`` is the block-pool occupancy
         gauge set supplied by ``EngineCore`` (total/used/free blocks);
         ``prefix_cache`` is ``PrefixCache.stats_snapshot()`` when the
-        core runs with prefix caching enabled."""
+        core runs with prefix caching enabled; ``resilience`` is the
+        core's health/fault context (effective batch, health state,
+        injected-fault tallies), merged here with this registry's own
+        resilience counters."""
         tps = self.tokens_per_second()
         with self._lock:
             out = {
@@ -194,6 +232,19 @@ class ServingMetrics:
                 out["kv_pool"] = dict(kv_pool)
             if prefix_cache is not None:
                 out["prefix_cache"] = dict(prefix_cache)
+            res = dict(resilience) if resilience is not None else {
+                "health_state": "healthy", "health_code": 0,
+                "effective_max_batch": max_batch,
+                "faults_injected": {}}
+            res.update({
+                "engine_restarts": self.engine_restarts,
+                "request_retries": self.request_retries,
+                "watchdog_trips": self.watchdog_trips,
+                "requests_quarantined": self.requests_quarantined,
+                "requests_shed": self.requests_shed,
+                "loop_exceptions": self.loop_exceptions,
+            })
+            out["resilience"] = res
             return out
 
     def to_prometheus(self, snapshot: Optional[Dict] = None,

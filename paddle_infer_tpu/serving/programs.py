@@ -91,12 +91,19 @@ def _pick_rows(proc, samp, steps, keys):
 
 def build_prefill(engine, plen, max_pages):
     """Prefill one request (batch of 1) into its reserved pages and pick
-    the first token.  ``run(params, ids[1,plen], lengths[1],
+    the first token.  ``run(params, ids[1,plen], lengths[1], steps0[1],
     tables[1,max_pages], samp, keys[1,2], k_pages, v_pages)`` →
-    ``(tok[1], fin[1], k_pages, v_pages)``; pools are donated."""
+    ``(tok[1], fin[1], k_pages, v_pages)``; pools are donated.
+
+    ``steps0`` is the row's generation-step index for the token this
+    prefill samples: 0 for a fresh admission, ``req.emitted`` when the
+    supervisor replays a half-served request — so the replayed token
+    draws from the SAME ``fold_in(base, step)`` stream (and the same
+    min-length window) the lost decode step would have used."""
     L = engine._num_layers
 
-    def run(params, ids, lengths, tables, samp, keys, k_pages, v_pages):
+    def run(params, ids, lengths, steps0, tables, samp, keys,
+            k_pages, v_pages):
         b = ids.shape[0]
         zero_pos = jnp.zeros((b,), jnp.int32)
         caches = [(k_pages[i], v_pages[i], tables, zero_pos)
@@ -107,14 +114,13 @@ def build_prefill(engine, plen, max_pages):
                                             caches)
         last = jnp.take_along_axis(
             logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-        steps = jnp.zeros((b,), jnp.int32)
-        proc = _process_rows(last, samp, steps)
-        tok = _pick_rows(proc, samp, steps, keys)
+        proc = _process_rows(last, samp, steps0)
+        tok = _pick_rows(proc, samp, steps0, keys)
         fin = jnp.logical_and(samp["eos"] >= 0, tok == samp["eos"])
         return (tok, fin,
                 [c[0] for c in caches], [c[1] for c in caches])
 
-    return jax.jit(run, donate_argnums=(6, 7))
+    return jax.jit(run, donate_argnums=(7, 8))
 
 
 def build_prefix_prefill(engine, plen, max_pages):
@@ -131,15 +137,17 @@ def build_prefix_prefill(engine, plen, max_pages):
     zero, and the cached KV values are the very floats the cold path
     would have recomputed.
 
-    ``run(params, ids[1,plen], lengths[1], offsets[1],
+    ``run(params, ids[1,plen], lengths[1], offsets[1], steps0[1],
     tables[1,max_pages], samp, keys[1,2], k_pages, v_pages)`` →
     ``(tok[1], fin[1], k_pages, v_pages)``; pools are donated.
     ``lengths`` counts valid suffix tokens within the padded chunk;
     cold requests (offset 0) also run through this family when the
-    prefix cache is enabled, so one executable per plen serves both."""
+    prefix cache is enabled, so one executable per plen serves both.
+    ``steps0`` is the sampled token's generation-step index (0 fresh,
+    ``req.emitted`` on supervisor replay — see ``build_prefill``)."""
     L = engine._num_layers
 
-    def run(params, ids, lengths, offsets, tables, samp, keys,
+    def run(params, ids, lengths, offsets, steps0, tables, samp, keys,
             k_pages, v_pages):
         b = ids.shape[0]
         marker = jnp.zeros((b,), jnp.int32)
@@ -151,14 +159,13 @@ def build_prefix_prefill(engine, plen, max_pages):
                                             caches)
         last = jnp.take_along_axis(
             logits, (lengths - 1)[:, None, None], axis=1)[:, 0]
-        steps = jnp.zeros((b,), jnp.int32)
-        proc = _process_rows(last, samp, steps)
-        tok = _pick_rows(proc, samp, steps, keys)
+        proc = _process_rows(last, samp, steps0)
+        tok = _pick_rows(proc, samp, steps0, keys)
         fin = jnp.logical_and(samp["eos"] >= 0, tok == samp["eos"])
         return (tok, fin,
                 [c[0] for c in caches], [c[1] for c in caches])
 
-    return jax.jit(run, donate_argnums=(7, 8))
+    return jax.jit(run, donate_argnums=(8, 9))
 
 
 def build_page_copy(engine):

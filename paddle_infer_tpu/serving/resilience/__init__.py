@@ -1,0 +1,11 @@
+"""Fault tolerance for the serving engine: deterministic fault
+injection (FaultPlane), supervised recovery (EngineSupervisor) and the
+health state machine (docs/SERVING.md "Fault tolerance")."""
+from .faultplane import (FaultPlane, FaultSpec, InjectedFault,
+                         InjectedMemoryError, NULL_PLANE, SITES)
+from .health import HealthMonitor, HealthState
+from .supervisor import EngineSupervisor
+
+__all__ = ["FaultPlane", "FaultSpec", "InjectedFault",
+           "InjectedMemoryError", "NULL_PLANE", "SITES",
+           "HealthMonitor", "HealthState", "EngineSupervisor"]

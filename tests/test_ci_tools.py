@@ -119,6 +119,34 @@ def test_tpulint_repo_clean():
     assert len(rep["rules"]) == 8
 
 
+def test_faultplane_sites_documented():
+    """Every fault-injection site the plane exposes must be documented
+    (backticked) in docs/SERVING.md's fault-tolerance section — the
+    chaos schedule is part of the operator contract."""
+    from paddle_infer_tpu.serving.resilience import SITES
+
+    assert SITES                        # the plane exports its site list
+    with open(os.path.join(ROOT, "docs", "SERVING.md")) as f:
+        doc = f.read()
+    missing = [s for s in SITES if f"`{s}`" not in doc]
+    assert not missing, f"undocumented fault sites: {missing}"
+
+
+def test_tpulint_resilience_tree_clean():
+    """The new resilience plane must gate clean on its own — zero
+    findings, no baseline entries hiding anything."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+         "--json", os.path.join(ROOT, "paddle_infer_tpu", "serving",
+                                "resilience")],
+        capture_output=True, text=True, env=_env(), timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr[-800:]
+    rep = json.loads(r.stdout)
+    assert rep["new"] == []
+    assert rep["baselined"] == []       # clean outright, not baselined
+    assert rep["files"] >= 4            # __init__, faultplane, health, sup
+
+
 def test_tpulint_baseline_update_deterministic(tmp_path):
     """--baseline-update must be reproducible: identical bytes across
     runs, path-relative, sorted entries."""

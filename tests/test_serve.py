@@ -242,6 +242,8 @@ def test_queue_full_maps_to_429(tmp_path):
             raise AssertionError("expected 429")
         except urllib.error.HTTPError as e:
             assert e.code == 429
+            # backpressure is actionable: clients get a retry hint
+            assert int(e.headers["Retry-After"]) >= 1
         with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
             snap = json.load(r)
         assert snap["counters"]["rejected_queue_full"] >= 1
@@ -283,6 +285,49 @@ def test_speculative_serving_path(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=30)
+
+
+def test_health_probes_and_drain_resume(server):
+    """/healthz (liveness) and /readyz (readiness) are wired to the
+    supervisor's state machine; POST /admin/drain flips readiness to 503
+    + Retry-After and sheds new work with 503, /admin/resume re-enters
+    service.  Runs last against the shared server: it leaves the health
+    state DEGRADED (resume never jumps straight to HEALTHY)."""
+    url, _ = server
+    with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+        body = json.load(r)
+    assert body["status"] == "ok"
+    assert body["health_state"] in ("healthy", "degraded")
+    assert "crash_streak" in body
+    with urllib.request.urlopen(url + "/readyz", timeout=30) as r:
+        assert json.load(r)["ready"] is True
+    # drain: readiness drops to 503 + Retry-After; liveness stays 200
+    with _post(url, "/admin/drain", {}) as r:
+        assert json.load(r)["status"] == "draining"
+    try:
+        urllib.request.urlopen(url + "/readyz", timeout=30)
+        raise AssertionError("expected 503")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        assert int(e.headers["Retry-After"]) >= 1
+        assert json.load(e)["ready"] is False
+    with urllib.request.urlopen(url + "/healthz", timeout=30) as r:
+        assert json.load(r)["health_state"] == "draining"
+    # a draining engine sheds new submissions: 503 + Retry-After
+    ids = [[1, 2, 3, 4]]
+    try:
+        _post(url, "/generate", {"ids": ids, "max_new_tokens": 4})
+        raise AssertionError("expected 503")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+        assert int(e.headers["Retry-After"]) >= 1
+    # resume re-enters service (via DEGRADED) and generation works again
+    with _post(url, "/admin/resume", {}) as r:
+        assert json.load(r)["status"] in ("degraded", "healthy")
+    with urllib.request.urlopen(url + "/readyz", timeout=30) as r:
+        assert json.load(r)["ready"] is True
+    with _post(url, "/generate", {"ids": ids, "max_new_tokens": 4}) as r:
+        assert np.asarray(json.load(r)["tokens"]).shape == (1, 4)
 
 
 def test_speculative_budget_falls_back(tmp_path):

@@ -3,6 +3,8 @@ EngineCore step loop, admission control, deadlines, streaming and
 metrics.  Tests drive ``run_once()`` directly on unstarted cores so the
 schedule is deterministic; only the streaming test runs the background
 thread."""
+import logging
+import threading
 import time
 
 import numpy as np
@@ -403,3 +405,104 @@ def test_active_count_acquires_step_lock(make_core):
     finally:
         core._step_lock = orig
     assert entered
+
+
+def test_stop_returns_bool_and_reports_wedged_thread(make_core):
+    """stop(timeout) -> bool: True when the loop thread is down (clean
+    join, or never started), False when it is still wedged in a step —
+    the signal close() uses to decide whether pool teardown is safe."""
+    core = make_core()
+    assert core.stop() is True          # never started: trivially down
+    core.start()
+    (req,) = core.submit(_prompt(90), GenerationConfig(max_new_tokens=4))
+    req.result(timeout=60)
+    assert core.stop() is True          # clean join
+    assert core.stop() is True          # idempotent
+
+    wedged = make_core()
+    entered = threading.Event()
+    release = threading.Event()
+
+    def stuck(wait_s=0.0):
+        entered.set()
+        release.wait(10.0)
+        return False
+
+    wedged.run_once = stuck
+    wedged.start()
+    assert entered.wait(2.0)
+    assert wedged.stop(timeout=0.2) is False   # still stuck in a "step"
+    release.set()
+
+
+def test_close_escalates_past_wedged_external_step(make_core):
+    """close() racing an in-flight external run_once(): the wedged step
+    holds ``_step_lock`` forever, so close() must time out its bounded
+    acquire and escalate — unblocking every result()/stream() consumer
+    without touching the pool the step still owns."""
+    core = make_core(max_batch=1)
+    entered = threading.Event()
+    release = threading.Event()
+    orig_decode = core._decode_step
+
+    def slow_decode():
+        entered.set()
+        release.wait(20.0)
+        return orig_decode()
+
+    core._decode_step = slow_decode
+    (ra,) = core.submit(_prompt(91), GenerationConfig(max_new_tokens=8))
+
+    def worker():
+        try:
+            while not entered.is_set():
+                core.run_once()
+        except Exception:
+            pass
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    assert entered.wait(5.0)            # ra admitted, step now wedged
+    (rb,) = core.submit(_prompt(92), GenerationConfig(max_new_tokens=8))
+
+    t0 = time.monotonic()
+    core.close(timeout=0.3)             # lock held by the wedged step
+    assert time.monotonic() - t0 < 5.0  # bounded, did not deadlock
+
+    assert rb.state is RequestState.REJECTED
+    with pytest.raises(RejectedError, match="scheduler wedged"):
+        rb.result()
+    assert ra.state is RequestState.FAILED
+    with pytest.raises(RejectedError, match="step was wedged"):
+        ra.result(timeout=5.0)          # consumer unblocked, not stranded
+    release.set()
+    t.join(10.0)
+
+
+def test_loop_exceptions_counted_logged_once_with_backoff(make_core, caplog):
+    """A scheduler-loop exception must be counted per occurrence, logged
+    once per distinct traceback (not once per spin), and spaced by an
+    exponential backoff so a wedged engine can't spin hot."""
+    core = make_core()
+    calls = []
+
+    def bad(wait_s=0.0):
+        calls.append(time.monotonic())
+        raise RuntimeError("injected loop failure")
+
+    core.run_once = bad
+    with caplog.at_level(logging.ERROR,
+                         logger="paddle_infer_tpu.serving.engine_core"):
+        core.start()
+        deadline = time.monotonic() + 5.0
+        while (core.metrics_snapshot()["resilience"]["loop_exceptions"] < 4
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert core.stop() is True
+    snap = core.metrics_snapshot()["resilience"]
+    assert snap["loop_exceptions"] >= 4
+    logged = [r for r in caplog.records
+              if "serving loop step failed" in r.getMessage()]
+    assert len(logged) == 1             # same traceback -> one log line
+    gaps = [b - a for a, b in zip(calls, calls[1:])]
+    assert gaps and gaps[-1] > gaps[0]  # backoff grew between spins

@@ -52,7 +52,18 @@ def fabricated_exposition():
     m.on_tokens(3, itl_s=0.012)
     m.on_step(3.5, active=2, max_batch=4)
     m.on_completed(0.5)
+    m.on_engine_restart()
+    m.on_retry(2)
+    m.on_watchdog_trip()
+    m.on_quarantined()
+    m.on_shed()
+    m.on_loop_exception()
     snap = m.snapshot(queue_depth=1, active=2, max_batch=4,
+                      resilience={"health_state": "degraded",
+                                  "health_code": 1, "draining": False,
+                                  "effective_max_batch": 2,
+                                  "faults_injected": {"decode.step": 3,
+                                                      "kv.alloc": 1}},
                       kv_pool={"total_blocks": 32, "used_blocks": 8,
                                "free_blocks": 24, "occupancy": 0.25},
                       prefix_cache={"queries": 6, "hits": 4,

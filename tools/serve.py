@@ -25,13 +25,29 @@ decode steps instead of serializing behind a lock.
                           mergeable with profiler captures
   GET  /traces            -> one-line summaries of the completed-trace
                           ring (id, state, duration, span coverage)
-  GET  /health            -> {"status": "ok", "model": ...}
+  GET  /health            -> {"status": "ok", "model": ...} (legacy
+                          process-liveness probe; always ok once up)
+  GET  /healthz           -> engine health (supervisor state machine):
+                          200 while HEALTHY/DEGRADED/DRAINING, 503 +
+                          Retry-After when DOWN; includes crash streak
+                          and live hung-step stall seconds
+  GET  /readyz            -> readiness: 200 only while the engine
+                          accepts new work (HEALTHY/DEGRADED), 503 +
+                          Retry-After while DRAINING/DOWN
+  POST /admin/drain       -> stop admitting (health -> DRAINING);
+                          in-flight requests finish
+  POST /admin/resume      -> leave DRAINING/DOWN back into service
 
-Admission control maps to HTTP codes: queue full -> 429, deadline
-exceeded -> 504, unbatchable/oversized -> 400.  Requests the batch
-can't host (beams, repetition penalty) and speculative-eligible
-requests run exclusively on the scheduler thread via a separate dense
-engine, FIFO with everything else.
+Admission control maps to HTTP codes: queue full -> 429 + Retry-After,
+draining/load-shed -> 503 + Retry-After, deadline exceeded -> 504,
+unbatchable/oversized -> 400.  Retry-After is derived from queue depth
+x recent step time (health state overrides while DRAINING/DOWN).
+Requests the batch can't host (beams, repetition penalty) and
+speculative-eligible requests run exclusively on the scheduler thread
+via a separate dense engine, FIFO with everything else.  The scheduler
+runs under a resilience supervisor (serving/resilience/): step
+watchdog, crash-loop backoff, bounded retry/replay of in-flight
+requests, and a seedable fault-injection plane (--fault_script).
 
 Usage:
   env PYTHONPATH=. python tools/serve.py --model_dir DIR --port 8800
@@ -52,17 +68,27 @@ _STATE = {"lock": threading.Lock()}
 
 
 def _core():
-    """The continuous-batching scheduler (owns the paged engine)."""
+    """The continuous-batching scheduler (owns the paged engine).  The
+    stepping thread belongs to the resilience supervisor, which wires
+    its recovery protocol (watchdog, retry/replay, degradation ladder)
+    into the core's failure paths."""
     with _STATE["lock"]:
         if "core" not in _STATE:
             from paddle_infer_tpu.inference.generation import (
                 PagedGenerationEngine)
-            from paddle_infer_tpu.serving import EngineCore
+            from paddle_infer_tpu.serving import (EngineCore,
+                                                  EngineSupervisor,
+                                                  FaultPlane)
 
             engine = PagedGenerationEngine(
                 _STATE["model"], page_size=_STATE["page_size"],
                 prompt_bucket=_STATE.get("prompt_bucket") or 64)
-            _STATE["core"] = EngineCore(
+            plane = None
+            script = _STATE.get("fault_script")
+            if script:
+                plane = FaultPlane.from_spec(
+                    script, seed=_STATE.get("fault_seed", 0))
+            core = EngineCore(
                 engine,
                 max_batch=_STATE["max_batch"],
                 max_queue=_STATE["max_queue"],
@@ -72,8 +98,40 @@ def _core():
                 enable_prefix_cache=_STATE.get("enable_prefix_cache",
                                                False),
                 prefix_cache_watermark=_STATE.get(
-                    "prefix_cache_watermark", 0.5)).start()
+                    "prefix_cache_watermark", 0.5),
+                fault_plane=plane)
+            _STATE["sup"] = EngineSupervisor(
+                core,
+                watchdog_s=_STATE.get("watchdog_s", 5.0),
+                max_retries=_STATE.get("max_retries", 2)).start()
+            _STATE["core"] = core
         return _STATE["core"]
+
+
+def _sup():
+    _core()
+    return _STATE["sup"]
+
+
+def _retry_after_s() -> int:
+    """Retry-After seconds for 429/503: health state overrides
+    (DRAINING -> short, DOWN -> long); otherwise the time to drain the
+    current queue at the recent per-chunk step rate."""
+    sup = _STATE.get("sup")
+    if sup is not None:
+        state = sup.health.state.value
+        if state == "down":
+            return 30
+        if state == "draining":
+            return 5
+    core = _STATE.get("core")
+    if core is None:
+        return 1
+    p50 = core.metrics.snapshot().get(
+        "decode_step_ms", {}).get("p50_recent")
+    step_s = ((p50 or 50.0) / 1000.0)
+    est = core.queue_depth * step_s / max(1, core.max_batch)
+    return max(1, min(30, int(est) + 1))
 
 
 def _dense():
@@ -122,10 +180,13 @@ def _gen_config(body):
 
 def _error_code(e) -> int:
     from paddle_infer_tpu.serving import (DeadlineExceededError,
-                                          QueueFullError, RejectedError)
+                                          LoadShedError, QueueFullError,
+                                          RejectedError)
 
     if isinstance(e, QueueFullError):
         return 429
+    if isinstance(e, LoadShedError):
+        return 503           # draining / shed — retry another replica
     if isinstance(e, (DeadlineExceededError, TimeoutError)):
         return 504
     if isinstance(e, RejectedError):
@@ -196,11 +257,13 @@ class Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):      # quiet
         pass
 
-    def _json(self, code, obj):
+    def _json(self, code, obj, headers=None):
         payload = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(payload)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(payload)
 
@@ -219,6 +282,34 @@ class Handler(BaseHTTPRequestHandler):
         if url.path == "/health":
             self._json(200, {"status": "ok",
                              "model": type(_STATE["model"]).__name__})
+        elif url.path == "/healthz":
+            # liveness: wired to the supervisor's state machine — 503
+            # only when the engine is DOWN (crash-looping).  Does not
+            # force engine init: a warming server is simply "starting".
+            sup = _STATE.get("sup")
+            if sup is None:
+                self._json(200, {"status": "starting",
+                                 "health_state": "healthy"})
+                return
+            info = sup.health_info()
+            down = info["health_state"] == "down"
+            self._json(503 if down else 200,
+                       {"status": "down" if down else "ok", **info},
+                       headers=({"Retry-After": _retry_after_s()}
+                                if down else None))
+        elif url.path == "/readyz":
+            # readiness: 200 only while new work is accepted
+            sup = _STATE.get("sup")
+            if sup is None:
+                self._json(200, {"status": "starting", "ready": True})
+                return
+            info = sup.health_info()
+            ready = sup.health.is_serving()
+            self._json(200 if ready else 503,
+                       {"status": "ready" if ready else "not-ready",
+                        "ready": ready, **info},
+                       headers=(None if ready
+                                else {"Retry-After": _retry_after_s()}))
         elif url.path == "/metrics":
             core = _core()
             snap = core.metrics_snapshot()
@@ -255,6 +346,21 @@ class Handler(BaseHTTPRequestHandler):
             self._json(404, {"error": "unknown path"})
 
     def do_POST(self):
+        if self.path in ("/admin/drain", "/admin/resume"):
+            # operator endpoints take no generation body
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    self.rfile.read(length)
+                sup = _sup()
+                if self.path == "/admin/drain":
+                    sup.drain()
+                else:
+                    sup.resume()
+                self._json(200, {"status": sup.health.state.value})
+            except Exception as e:
+                self._json(500, {"error": repr(e)[:400]})
+            return
         try:
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
@@ -323,7 +429,13 @@ class Handler(BaseHTTPRequestHandler):
                     send_chunk({"error": repr(e)[:400]})
                     self.wfile.write(b"0\r\n\r\n")
                 else:
-                    self._json(_error_code(e), {"error": repr(e)[:400]})
+                    code = _error_code(e)
+                    # backpressure responses tell the client when to come
+                    # back instead of letting it hammer a loaded server
+                    hdrs = ({"Retry-After": _retry_after_s()}
+                            if code in (429, 503) else None)
+                    self._json(code, {"error": repr(e)[:400]},
+                               headers=hdrs)
             except Exception:
                 pass
 
@@ -366,6 +478,19 @@ def main(argv=None):
                     help="optional draft model for speculative decoding "
                          "of greedy requests")
     ap.add_argument("--num_draft_tokens", type=int, default=4)
+    ap.add_argument("--watchdog_s", type=float, default=5.0,
+                    help="supervisor hung-step threshold in seconds "
+                         "(trips DEGRADED + watchdog_trips_total)")
+    ap.add_argument("--max_retries", type=int, default=2,
+                    help="per-request replay budget after engine "
+                         "failures; beyond it the request is "
+                         "quarantined")
+    ap.add_argument("--fault_script", default=None,
+                    help="chaos testing: JSON list of fault specs for "
+                         "the injection plane (or @path to a JSON "
+                         "file); see docs/SERVING.md 'Fault tolerance'")
+    ap.add_argument("--fault_seed", type=int, default=0,
+                    help="seed for probabilistic fault specs")
     args = ap.parse_args(argv)
 
     from paddle_infer_tpu.models import AutoModel
@@ -383,6 +508,14 @@ def main(argv=None):
     _STATE["draft_model"] = (AutoModel.from_pretrained(args.draft_dir)
                              if args.draft_dir else None)
     _STATE["num_draft_tokens"] = args.num_draft_tokens
+    _STATE["watchdog_s"] = args.watchdog_s
+    _STATE["max_retries"] = args.max_retries
+    fault_script = args.fault_script
+    if fault_script and fault_script.startswith("@"):
+        with open(fault_script[1:]) as f:
+            fault_script = f.read()
+    _STATE["fault_script"] = fault_script
+    _STATE["fault_seed"] = args.fault_seed
     server = ThreadingHTTPServer(("127.0.0.1", args.port), Handler)
     print(f"serving {type(_STATE['model']).__name__} on "
           f"127.0.0.1:{args.port}", flush=True)
