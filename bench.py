@@ -947,6 +947,7 @@ def _serving_bench(on_tpu: bool):
         for p in prompts[:2]:                 # compile-warm both plens
             core.submit(p, g)[0].result(timeout=600)
         core.metrics.reset()
+        core.steplog.clear()                  # drop compile-inflated steps
         reqs = [None] * n_clients
 
         def client(i):
@@ -964,9 +965,22 @@ def _serving_bench(on_tpu: bool):
         cont_s = time.perf_counter() - t0
         cont_tps = sum(r.emitted for r in reqs) / cont_s
         snap = core.metrics_snapshot()
+        steps = core.steplog.summary()
     finally:
         core.close()
-    return {
+
+    # native-histogram tails next to the reservoir percentiles, plus the
+    # steplog's analytic-vs-measured step-cost fit (ROADMAP: cost-model
+    # scheduling feeds off this error signal)
+    from paddle_infer_tpu.observability import histogram as _hist
+
+    def _hq(key, q):
+        s = (snap.get("histograms") or {}).get(key)
+        v = _hist.quantile(s, q) if s else None
+        return round(v, 5) if v is not None else None
+
+    model = steps.get("decode_model") or {}
+    out = {
         "clients": n_clients,
         "max_new_tokens": max_new,
         "sequential_tokens_per_s": round(seq_tps, 1),
@@ -976,7 +990,18 @@ def _serving_bench(on_tpu: bool):
         "ttft_p99_s": round(snap["ttft_s"]["p99_recent"], 4),
         "itl_p50_s": round(snap["inter_token_latency_s"]["p50_recent"], 5),
         "mean_batch_occupancy": round(snap["occupancy"]["mean"], 3),
+        "ttft_p99_hist_s": _hq("ttft", 0.99),
+        "step_wall_p99_hist_s": _hq("step_wall", 0.99),
+        "queue_wait_p50_hist_s": _hq("queue_wait", 0.50),
+        "steplog_records": steps.get("records", 0),
+        "step_model_n": model.get("n", 0),
     }
+    if model.get("mean_abs_rel_err") is not None:
+        out["step_model_mean_abs_rel_err"] = round(
+            model["mean_abs_rel_err"], 4)
+    if model.get("pearson_r") is not None:
+        out["step_model_pearson_r"] = round(model["pearson_r"], 4)
+    return out
 
 
 def _prefix_cache_bench(on_tpu: bool):

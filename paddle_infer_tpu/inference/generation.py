@@ -519,6 +519,10 @@ class PagedGenerationEngine(GenerationEngine):
         self._pool = None
         # per-program-key set of seen arg signatures (recompile detector)
         self._compiled_sigs = {}
+        # per-program-key abstract call shapes + cached cost_analysis()
+        # (observability.steplog's analytic bytes/FLOPs source)
+        self._program_shapes = {}
+        self._program_costs = {}
         # persistent per-layer device pools [P, h, page, d]; donated into
         # every compiled call and rebound from its outputs, so the arrays
         # genuinely stay put in HBM across requests
@@ -607,6 +611,14 @@ class PagedGenerationEngine(GenerationEngine):
         is_compile = sig not in sigs
         k_pages, v_pages = self._ensure_pages()
         args = jax.tree_util.tree_map(self._replicated, tuple(args))
+        if key not in self._program_shapes:
+            # abstract (shape, dtype) trees for program_cost(): captured
+            # before donation consumes the pools, costing only a
+            # tree_map on the first call per key
+            abstract = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                (args, k_pages, v_pages))
+            self._program_shapes[key] = abstract
         self._k_pages = self._v_pages = None
         t0 = time.perf_counter() if is_compile else 0.0
         with _MeshContext(self._mesh):
@@ -625,6 +637,46 @@ class PagedGenerationEngine(GenerationEngine):
         *rest, new_k, new_v = out
         self._k_pages, self._v_pages = new_k, new_v
         return rest
+
+    def program_cost(self, key):
+        """Static XLA cost of one serving program: ``{"flops", "bytes_
+        accessed"}`` floats from ``compiled.cost_analysis()`` at the
+        shapes the program was first dispatched with, or None when the
+        program hasn't run yet / the backend offers no analysis.
+
+        The executable is AOT-lowered from ``ShapeDtypeStruct`` trees —
+        no device buffers move — and cached per key, so the one-time
+        compile amortizes across every StepLog record.  Crucially this
+        path never goes through ``run_paged_program``'s signature
+        tracking: the CompileLog cannot see it, so querying costs can
+        never trip the zero-post-warmup-decode-compile invariant."""
+        if key in self._program_costs:
+            return self._program_costs[key]
+        fn = self._compiled.get(key)
+        shapes = self._program_shapes.get(key)
+        if fn is None or shapes is None:
+            return None
+        args_s, k_s, v_s = shapes
+        params_s = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            self._params)
+        cost = None
+        try:
+            with _MeshContext(self._mesh):
+                lowered = fn.lower(params_s, *args_s, k_s, v_s)
+                analysis = lowered.compile().cost_analysis()
+            if isinstance(analysis, (list, tuple)):
+                analysis = analysis[0] if analysis else {}
+            if analysis:
+                cost = {
+                    "flops": float(analysis.get("flops", 0.0) or 0.0),
+                    "bytes_accessed": float(
+                        analysis.get("bytes accessed", 0.0) or 0.0),
+                }
+        except Exception:
+            cost = None
+        self._program_costs[key] = cost
+        return cost
 
     def kv_state_lost(self) -> bool:
         """True when the device pools were consumed by a failed donated

@@ -15,8 +15,18 @@ The serving engine made latency the product; this package makes latency
   ``prometheus``  text-exposition renderer + validator for the serving
                   metrics snapshot (content-negotiated ``GET /metrics``
                   in tools/serve.py).
-  ``evidence``    one-shot bundle capture (device probe, compile log,
-                  kernel summary, trace sample, metrics snapshot) —
+  ``steplog``     step-level flight recorder: one schema-fixed record
+                  per scheduler step (kind, batch composition, resident
+                  KV pages, analytic bytes/FLOPs from the cached
+                  executable cost analysis, dispatch-vs-host wall) in a
+                  bounded ring, plus the rolling model-vs-measured
+                  error summary (``GET /steps``).
+  ``histogram``   log-bucketed lock-safe latency histograms rendered as
+                  native Prometheus ``_bucket``/``_sum``/``_count``
+                  families.
+  ``evidence``    one-shot bundle capture (device probe incl. allocator
+                  memory_stats, compile log, kernel summary, trace
+                  sample, step ring, metrics snapshot) —
                   ``bench.py --evidence-dir``.
 
 Related work: the reference ships a full profiler stack
@@ -29,8 +39,10 @@ invariant — measured here, not asserted.
 from .compilelog import (CompileLog, get_compile_log, instrument_jit,
                          signature_of)
 from .evidence import capture_bundle
+from .histogram import Histogram
 from .prometheus import (family_names, render_prometheus,
                          validate_exposition)
+from .steplog import StepCostModel, StepLog
 from .tracing import Span, Trace, Tracer
 
 __all__ = [
@@ -41,6 +53,9 @@ __all__ = [
     "Span",
     "Trace",
     "Tracer",
+    "Histogram",
+    "StepLog",
+    "StepCostModel",
     "render_prometheus",
     "validate_exposition",
     "family_names",

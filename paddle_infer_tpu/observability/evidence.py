@@ -33,6 +33,11 @@ def _device_probe() -> dict:
         probe["device_kind"] = getattr(devs[0], "device_kind", "")
         probe["device_count"] = len(devs)
         probe["devices"] = [str(d) for d in devs[:16]]
+        # the shared allocator probe (profiler.statistic; serving
+        # snapshots use the same one) — None on counterless backends
+        from ..profiler.statistic import memory_stats
+
+        probe["memory_stats"] = memory_stats()
     except Exception as e:
         probe["error"] = repr(e)
     return probe
@@ -80,6 +85,13 @@ def capture_bundle(out_dir: str, *, core=None, snapshot: Optional[dict] = None,
             manifest["missing"].append(f"metrics.prom: {e!r}")
     else:
         manifest["missing"].append("metrics: no core or snapshot given")
+
+    steplog = getattr(core, "steplog", None)
+    if steplog is not None:
+        write("steps.jsonl", steplog.to_jsonl(limit=512), text=True)
+        write("steps_summary.json", steplog.summary())
+    else:
+        manifest["missing"].append("steps: no steplog available")
 
     tracer = getattr(core, "tracer", None)
     if tracer is not None:

@@ -8,30 +8,46 @@ header per family, one sample line per series, reservoir stats as a
 values are dropped rather than rendered as NaN so a fresh server
 scrapes clean.
 
+Latency distributions (TTFT, ITL, e2e, step wall, queue wait) are
+exposed as *native histogram families* — cumulative ``_bucket`` lines
+with a terminal ``le="+Inf"``, plus ``_sum``/``_count`` — built from
+``observability.histogram`` snapshots under ``snapshot["histograms"]``.
+Percentile gauges for those series are gone from the exposition (the
+reservoir ``*_recent`` keys stay in the JSON snapshot for bench);
+``validate_exposition`` enforces the histogram contract: cumulative
+bucket counts, a ``+Inf`` bucket, ``_count`` consistent with it, and
+no bare-named samples on a histogram family.
+
 ``tools/check_metrics.py`` validates the output (name/label syntax, no
 duplicate series) and cross-checks the family list against the metric
 catalog in docs/OBSERVABILITY.md — keep all three in sync.
 """
 from __future__ import annotations
 
+import math
 import re
 from typing import Dict, List, Optional, Tuple
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
-# snapshot series key -> (prometheus family, help text)
+# snapshot series key -> (prometheus family, help text) — the series
+# still exposed as stat-labelled gauges (reservoir percentiles)
 SERIES_FAMILIES = {
-    "ttft_s": ("serving_ttft_seconds",
-               "Time to first token in seconds"),
-    "inter_token_latency_s": ("serving_inter_token_latency_seconds",
-                              "Per-token latency inside a fused decode "
-                              "chunk in seconds"),
-    "e2e_latency_s": ("serving_e2e_latency_seconds",
-                      "Request end-to-end latency in seconds"),
     "decode_step_ms": ("serving_decode_step_milliseconds",
                        "One fused decode chunk wall time in ms"),
     "occupancy": ("serving_step_occupancy_ratio",
                   "Active rows / max_batch per decode step"),
+}
+
+# reservoir snapshot keys whose Prometheus exposure moved to a native
+# histogram family (snapshot["histograms"][value]); the reservoir dicts
+# stay in the JSON snapshot for bench but are no longer rendered as
+# percentile gauges.  tools/check_metrics.py uses this to keep the
+# snapshot <-> exposition mapping bidirectional.
+HISTOGRAM_SERIES = {
+    "ttft_s": "ttft",
+    "inter_token_latency_s": "itl",
+    "e2e_latency_s": "e2e",
 }
 
 
@@ -73,6 +89,18 @@ class _Writer:
 
     def render(self) -> str:
         return "\n".join(self.lines) + "\n"
+
+
+def _hist_samples(w: _Writer, family: str, snap: dict):
+    """Emit one histogram snapshot (``observability.histogram``
+    cumulative-bucket form) as ``_bucket``/``_sum``/``_count`` lines.
+    The family's TYPE header must already be declared by the caller —
+    with a *literal* name, so the tpulint metric-sync rule sees it."""
+    for le, cum in snap.get("buckets") or []:
+        lab = le if isinstance(le, str) else f"{float(le):g}"
+        w.sample(family + "_bucket", cum, {"le": lab})
+    w.sample(family + "_sum", snap.get("sum", 0.0))
+    w.sample(family + "_count", snap.get("count", 0))
 
 
 def render_prometheus(snapshot: dict,
@@ -207,6 +235,74 @@ def render_prometheus(snapshot: dict,
     w.sample("serving_tokens_per_second",
              snapshot.get("tokens_per_second", 0.0))
 
+    # native histogram families — family names are literal (not looped
+    # from a dict) so the tpulint metric-sync rule can cross-check them
+    # against the docs catalog
+    hists = snapshot.get("histograms") or {}
+    if (hists.get("ttft") or {}).get("buckets"):
+        w.family("serving_ttft_seconds", "histogram",
+                 "Time to first token in seconds")
+        _hist_samples(w, "serving_ttft_seconds", hists["ttft"])
+    if (hists.get("itl") or {}).get("buckets"):
+        w.family("serving_inter_token_latency_seconds", "histogram",
+                 "Per-token latency inside a fused decode chunk in "
+                 "seconds")
+        _hist_samples(w, "serving_inter_token_latency_seconds",
+                      hists["itl"])
+    if (hists.get("e2e") or {}).get("buckets"):
+        w.family("serving_e2e_latency_seconds", "histogram",
+                 "Request end-to-end latency in seconds")
+        _hist_samples(w, "serving_e2e_latency_seconds", hists["e2e"])
+    if (hists.get("step_wall") or {}).get("buckets"):
+        w.family("serving_step_wall_seconds", "histogram",
+                 "One scheduler step (fused decode chunk or prefill) "
+                 "wall time in seconds")
+        _hist_samples(w, "serving_step_wall_seconds", hists["step_wall"])
+    if (hists.get("queue_wait") or {}).get("buckets"):
+        w.family("serving_queue_wait_seconds", "histogram",
+                 "Admission-queue wait before a slot was granted in "
+                 "seconds")
+        _hist_samples(w, "serving_queue_wait_seconds",
+                      hists["queue_wait"])
+
+    mem = snapshot.get("device_memory") or {}
+    mem_kinds = {k: v for k, v in mem.items()
+                 if isinstance(v, (int, float))
+                 and ("bytes" in k or "size" in k)}
+    if mem_kinds:
+        w.family("device_memory_bytes", "gauge",
+                 "Device allocator memory_stats(), byte-valued keys "
+                 "by kind")
+        for k in sorted(mem_kinds):
+            w.sample("device_memory_bytes", mem_kinds[k], {"kind": k})
+
+    sl = snapshot.get("steplog") or {}
+    if sl:
+        w.family("steplog_records_total", "counter",
+                 "StepLog flight-recorder records by step kind")
+        by_kind = sl.get("by_kind") or {}
+        if by_kind:
+            for kind in sorted(by_kind):
+                w.sample("steplog_records_total", by_kind[kind],
+                         {"kind": kind})
+        else:
+            w.sample("steplog_records_total", 0, {"kind": "none"})
+        w.family("steplog_bytes_estimated_total", "counter",
+                 "Analytic bytes-moved attributed across all recorded "
+                 "steps")
+        w.sample("steplog_bytes_estimated_total",
+                 sl.get("bytes_est_total", 0.0))
+        model = sl.get("decode_model") or {}
+        w.family("steplog_model_abs_rel_error", "gauge",
+                 "Mean absolute relative error of the fitted step-cost "
+                 "model over recent decode steps")
+        w.sample("steplog_model_abs_rel_error",
+                 model.get("mean_abs_rel_err"))
+        w.family("steplog_model_pearson_r", "gauge",
+                 "Pearson correlation between the analytic bytes "
+                 "estimate and measured decode step wall")
+        w.sample("steplog_model_pearson_r", model.get("pearson_r"))
+
     for key, (family, help_text) in SERIES_FAMILIES.items():
         series = snapshot.get(key)
         if not isinstance(series, dict):
@@ -256,10 +352,21 @@ def render_prometheus(snapshot: dict,
 def validate_exposition(text: str) -> List[str]:
     """Syntax check a text exposition; returns a list of problems
     (empty = valid).  Used by tools/check_metrics.py and the tests —
-    kept here so the renderer and its validator evolve together."""
+    kept here so the renderer and its validator evolve together.
+
+    Beyond name/label/value syntax and series dedup, histogram families
+    are checked semantically: every bucket group must carry a terminal
+    ``le="+Inf"`` bucket, cumulative counts must be non-decreasing in
+    ascending ``le`` order, a ``_count`` sample must equal the ``+Inf``
+    bucket, bare base-named samples are rejected, and a family declared
+    ``TYPE histogram`` with no ``_bucket`` samples at all is invalid."""
     problems = []
     seen_series = set()
     typed = set()
+    kinds: Dict[str, str] = {}
+    # (family, labels-minus-le) -> [(le_float, cum_count, line_no)]
+    hist_buckets: Dict[Tuple[str, tuple], list] = {}
+    hist_counts: Dict[Tuple[str, tuple], float] = {}
     sample_re = re.compile(
         r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s+(\S+)(\s+\d+)?$")
     label_re = re.compile(r'^([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"$')
@@ -275,6 +382,7 @@ def validate_exposition(text: str) -> List[str]:
                 problems.append(f"line {i}: bad TYPE line: {line!r}")
             else:
                 typed.add(parts[2])
+                kinds[parts[2]] = parts[3]
             continue
         if line.startswith("#"):
             problems.append(f"line {i}: unknown comment {line!r}")
@@ -291,20 +399,78 @@ def validate_exposition(text: str) -> List[str]:
                 base = name[:-len(suffix)]
         if base not in typed and name not in typed:
             problems.append(f"line {i}: sample {name} has no TYPE")
+        le_raw = None
+        other_labels = []
         if labels:
             for pair in _split_labels(labels):
-                if not label_re.match(pair):
+                lm = label_re.match(pair)
+                if not lm:
                     problems.append(f"line {i}: bad label {pair!r}")
+                elif lm.group(1) == "le":
+                    le_raw = lm.group(2)
+                else:
+                    other_labels.append(pair)
         key = (name, labels or "")
         if key in seen_series:
             problems.append(f"line {i}: duplicate series {name}{{"
                             f"{labels or ''}}}")
         seen_series.add(key)
         try:
-            float(value)
+            fval = float(value)
         except ValueError:
+            fval = None
             if value not in ("NaN", "+Inf", "-Inf"):
                 problems.append(f"line {i}: bad value {value!r}")
+        if kinds.get(base) == "histogram":
+            group = (base, tuple(sorted(other_labels)))
+            if name == base:
+                problems.append(
+                    f"line {i}: histogram {base} has a bare sample "
+                    f"(only _bucket/_sum/_count are valid)")
+            elif name.endswith("_bucket"):
+                if le_raw is None:
+                    problems.append(
+                        f"line {i}: histogram bucket {name} missing "
+                        f"le label")
+                else:
+                    try:
+                        le_v = math.inf if le_raw in ("+Inf", "Inf") \
+                            else float(le_raw)
+                    except ValueError:
+                        problems.append(
+                            f"line {i}: unparseable le={le_raw!r} on "
+                            f"{name}")
+                    else:
+                        if fval is not None:
+                            hist_buckets.setdefault(group, []).append(
+                                (le_v, fval, i))
+            elif name.endswith("_count") and fval is not None:
+                hist_counts[group] = fval
+    for fam, kind in kinds.items():
+        if kind != "histogram":
+            continue
+        groups = [g for g in hist_buckets if g[0] == fam]
+        if not groups:
+            problems.append(f"histogram {fam} declares TYPE but has no "
+                            f"_bucket samples")
+            continue
+        for g in groups:
+            pts = sorted(hist_buckets[g], key=lambda t: t[0])
+            if not math.isinf(pts[-1][0]):
+                problems.append(
+                    f'histogram {fam} is missing the le="+Inf" bucket')
+            prev = None
+            for le_v, cum, ln in pts:
+                if prev is not None and cum < prev:
+                    problems.append(
+                        f"line {ln}: histogram {fam} buckets are not "
+                        f"cumulative (count decreases at le={le_v:g})")
+                prev = cum
+            if g in hist_counts and math.isinf(pts[-1][0]) \
+                    and hist_counts[g] != pts[-1][1]:
+                problems.append(
+                    f"histogram {fam}: _count {hist_counts[g]:g} != "
+                    f"+Inf bucket {pts[-1][1]:g}")
     return problems
 
 

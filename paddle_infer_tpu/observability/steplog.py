@@ -1,0 +1,285 @@
+"""StepLog — the step-level flight recorder for the serving scheduler.
+
+Request traces (``observability/tracing``) attribute one *request's*
+wall time; nothing records what one *scheduler step* cost and why.
+That per-step view — batch composition, resident KV pages, bytes the
+step analytically must move, measured wall split into device dispatch
+vs host bookkeeping — is exactly the feature set a per-step cost model
+trains on ("A Learned Performance Model for TPUs", PAPERS.md), and the
+ROADMAP's cost-model-driven-scheduling item starts from it.
+
+``serving.EngineCore`` appends one record per step event (prefill /
+fused decode chunk / page copy / evict) into a bounded ring with a
+fixed schema (``SCHEMA_KEYS``; the table in docs/OBSERVABILITY.md).
+``GET /steps`` serves the recent ring, ``to_jsonl()`` exports it, and
+``summary()`` folds the ring into Prometheus-ready aggregates plus a
+rolling model-vs-measured error: the analytic bytes estimate is fitted
+to measured decode walls by a single least-bias scale (Σwall/Σbytes —
+the one free parameter a bandwidth model has), then scored by mean
+absolute relative error and Pearson correlation.
+
+The analytic estimate composes two sources (``StepCostModel``):
+
+  * per-executable ``compiled.cost_analysis()`` — flops and
+    "bytes accessed" of the whole program at its padded shapes, AOT
+    lowered once per program key and cached by
+    ``PagedGenerationEngine.program_cost``.  The AOT compile is
+    invisible to the CompileLog (which counts first-call signatures in
+    ``run_paged_program``), so enabling StepLog cannot trip the
+    zero-post-warmup-decode-compile invariant;
+  * per-step page counts — the static analysis assumes the worst-case
+    pool window, so its KV traffic (2 × pool bytes, read + write) is
+    rescaled to the pages actually resident this step, and the non-KV
+    remainder (weights, activations) to the occupied rows.
+
+When the backend offers no cost analysis the model falls back to an
+analytic roofline (weight bytes per scan step + resident KV page
+bytes); either way every decode/prefill record carries a nonzero
+``bytes_est``.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+# one entry per record field: (key, default).  Every record carries
+# every key — consumers (JSONL, /steps, bench) never need .get chains.
+_SCHEMA = (
+    ("seq", 0),                  # monotone record index (process-local)
+    ("ts", 0.0),                 # wall-clock capture time (time.time())
+    ("kind", ""),                # prefill | decode | page_copy | evict
+    ("wall_s", 0.0),             # whole step event, edge to edge
+    ("dispatch_s", 0.0),         # device dispatch + readback sync
+    ("host_s", 0.0),             # wall_s - dispatch_s (host bookkeeping)
+    ("active_rows", 0),          # occupied slots at capture
+    ("decode_rows", 0),          # rows in this fused decode chunk
+    ("prefill_tokens", 0),       # uncached suffix tokens prefetched
+    ("chunk_steps", 0),          # fused scan steps (decode) / 1
+    ("emitted_tokens", 0),       # tokens delivered to consumers
+    ("resident_kv_pages", 0),    # pool pages in use at capture
+    ("prefix_hit_pages", 0),     # pages served from the prefix cache
+    ("pages_freed", 0),          # pages released (evict records)
+    ("bytes_est", 0.0),          # analytic bytes-moved estimate
+    ("flops_est", 0.0),          # analytic FLOPs estimate
+    ("cost_source", "none"),     # xla+pages | analytic | none
+    ("compile_events", 0),       # CompileLog events during the step
+    ("faults", False),           # fault plane fired during the step
+    ("retries", 0),              # replayed rows involved in the step
+    ("degraded", False),         # effective_max_batch < max_batch
+    ("failed", False),           # the step raised / the row failed
+)
+SCHEMA_KEYS = tuple(k for k, _ in _SCHEMA)
+
+
+class StepCostModel:
+    """Analytic per-step cost estimates for one engine's programs.
+
+    Composes the cached per-executable ``cost_analysis()`` (static, at
+    padded shapes) with per-step page/row counts; falls back to a
+    weights+KV roofline when the backend has no cost analysis.  All
+    sizing constants come from the engine at construction time."""
+
+    def __init__(self, engine, pool):
+        self._engine = engine
+        self._pool_pages = int(pool.num_blocks)
+        try:
+            import numpy as np
+
+            itemsize = int(np.dtype(engine._cache_dtype).itemsize)
+        except Exception:
+            itemsize = 2
+        # one physical page across every layer's K and V pools
+        self._page_kv_bytes = float(
+            engine._num_layers * 2 * engine._num_heads
+            * engine.page_size * engine._head_dim * itemsize)
+        self._pool_bytes = self._page_kv_bytes * self._pool_pages
+        self._weight_bytes: Optional[float] = None
+        self._n_params: Optional[float] = None
+
+    @property
+    def page_kv_bytes(self) -> float:
+        return self._page_kv_bytes
+
+    def _weights(self):
+        if self._weight_bytes is None:
+            try:
+                import jax
+
+                leaves = jax.tree_util.tree_leaves(self._engine._params)
+                self._weight_bytes = float(
+                    sum(getattr(p, "nbytes", 0) for p in leaves))
+                self._n_params = float(
+                    sum(getattr(p, "size", 0) for p in leaves))
+            except Exception:
+                self._weight_bytes = 1.0
+                self._n_params = 1.0
+        return self._weight_bytes, self._n_params
+
+    def static_cost(self, key) -> Optional[dict]:
+        getter = getattr(self._engine, "program_cost", None)
+        if getter is None or key is None:
+            return None
+        return getter(key)
+
+    def estimate(self, kind: str, key=None, *, rows: int = 1,
+                 max_rows: int = 1, pages_touched: int = 0,
+                 chunk: int = 1, tokens: Optional[int] = None):
+        """Return ``(bytes_est, flops_est, cost_source)`` for one step
+        event.  ``pages_touched`` is the KV pages the step reads or
+        writes (resident pages for decode — every scan step re-reads
+        them; the reservation for prefill; freed pages for evict)."""
+        pages = max(0, int(pages_touched))
+        if kind == "evict":
+            # host-only: no HBM traffic, but the freed KV bytes are the
+            # memory-attribution signal the record exists to carry
+            return pages * self._page_kv_bytes, 0.0, "analytic"
+        if kind == "page_copy":
+            # one page read + one page written, across all layers
+            return 2.0 * max(pages, 1) * self._page_kv_bytes, 0.0, \
+                "analytic"
+        kv_moved = pages * self._page_kv_bytes * (chunk if kind == "decode"
+                                                  else 1)
+        frac = (rows / max_rows) if max_rows > 0 else 1.0
+        static = self.static_cost(key)
+        if static is not None:
+            # the static figure read+writes the whole pool at worst
+            # case; swap that for the pages actually touched and scale
+            # the non-KV remainder to the occupied rows
+            non_kv = max(static["bytes_accessed"] - 2.0 * self._pool_bytes,
+                         0.0)
+            bytes_est = non_kv * frac + kv_moved
+            flops_est = static["flops"] * frac
+            if bytes_est > 0.0:
+                return bytes_est, flops_est, "xla+pages"
+        wb, n_params = self._weights()
+        ntok = float(tokens if tokens is not None else rows * chunk)
+        steps = chunk if kind == "decode" else 1
+        bytes_est = wb * steps + kv_moved
+        flops_est = 2.0 * n_params * ntok
+        return bytes_est, flops_est, "analytic"
+
+
+def _model_summary(pairs: List[tuple]) -> Dict:
+    """Fit analytic bytes to measured wall with one scale and score it.
+    ``pairs`` is [(bytes_est, wall_s), ...] for clean decode steps."""
+    n = len(pairs)
+    out: Dict = {"n": n, "scale_s_per_byte": None,
+                 "mean_abs_rel_err": None, "max_abs_rel_err": None,
+                 "pearson_r": None}
+    if n < 2:
+        return out
+    xs = [p[0] for p in pairs]
+    ys = [p[1] for p in pairs]
+    sx, sy = sum(xs), sum(ys)
+    if sx <= 0.0 or sy <= 0.0:
+        return out
+    scale = sy / sx
+    errs = [abs(x * scale - y) / y for x, y in pairs if y > 0.0]
+    if errs:
+        out["scale_s_per_byte"] = scale
+        out["mean_abs_rel_err"] = sum(errs) / len(errs)
+        out["max_abs_rel_err"] = max(errs)
+    mx, my = sx / n, sy / n
+    vxy = sum((x - mx) * (y - my) for x, y in pairs)
+    vx = sum((x - mx) ** 2 for x in xs)
+    vy = sum((y - my) ** 2 for y in ys)
+    if vx > 0.0 and vy > 0.0:
+        r = vxy / math.sqrt(vx * vy)
+        out["pearson_r"] = min(1.0, max(-1.0, r))
+    return out
+
+
+class StepLog:
+    """Bounded ring of per-step records with JSONL export and a rolling
+    model-vs-measured summary.  Thread-safe: the scheduler appends from
+    its step thread while HTTP handlers read ``records()``/``summary()``.
+    """
+
+    def __init__(self, capacity: int = 4096, model_window: int = 1024):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._seq = 0
+        self._total = 0
+        self._by_kind: Dict[str, int] = {}
+        self._bytes_total = 0.0
+        self._flops_total = 0.0
+        self._compile_total = 0
+        # (bytes_est, wall_s) for clean decode chunks — the model fit
+        self._model: deque = deque(maxlen=int(model_window))
+
+    def record(self, kind: str, **fields) -> dict:
+        """Append one record; unknown fields are a programming error
+        (the schema is a contract with /steps consumers and the docs
+        table), missing fields take their schema defaults."""
+        unknown = set(fields) - set(SCHEMA_KEYS)
+        if unknown:
+            raise ValueError(f"unknown StepLog fields: {sorted(unknown)}")
+        rec = dict(_SCHEMA)
+        rec.update(fields)
+        rec["kind"] = str(kind)
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            rec["ts"] = time.time()
+            self._ring.append(rec)
+            self._total += 1
+            self._by_kind[rec["kind"]] = \
+                self._by_kind.get(rec["kind"], 0) + 1
+            self._bytes_total += float(rec["bytes_est"])
+            self._flops_total += float(rec["flops_est"])
+            self._compile_total += int(rec["compile_events"])
+            if rec["kind"] == "decode" and not rec["failed"] \
+                    and rec["bytes_est"] > 0.0 and rec["wall_s"] > 0.0:
+                self._model.append((float(rec["bytes_est"]),
+                                    float(rec["wall_s"])))
+        return rec
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def records(self, limit: Optional[int] = None) -> List[dict]:
+        """Most recent ``limit`` records, oldest first (the whole ring
+        when limit is None)."""
+        with self._lock:
+            recs = list(self._ring)
+        if limit is not None and limit >= 0:
+            recs = recs[-limit:] if limit else []
+        return [dict(r) for r in recs]
+
+    def to_jsonl(self, limit: Optional[int] = None) -> str:
+        recs = self.records(limit)
+        if not recs:
+            return ""
+        return "\n".join(json.dumps(r, sort_keys=True)
+                         for r in recs) + "\n"
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+            self._model.clear()
+            self._by_kind = {}
+            self._total = 0
+            self._bytes_total = 0.0
+            self._flops_total = 0.0
+            self._compile_total = 0
+
+    def summary(self) -> Dict:
+        with self._lock:
+            pairs = list(self._model)
+            out = {
+                "records": self._total,
+                "ring": len(self._ring),
+                "capacity": self.capacity,
+                "by_kind": dict(self._by_kind),
+                "bytes_est_total": self._bytes_total,
+                "flops_est_total": self._flops_total,
+                "compile_events_total": self._compile_total,
+            }
+        out["decode_model"] = _model_summary(pairs)
+        return out

@@ -44,6 +44,7 @@ import numpy as np
 from ..inference.generation import (GenerationConfig, PagedGenerationEngine,
                                     _round_up)
 from ..observability import Tracer, get_compile_log
+from ..observability.steplog import StepCostModel, StepLog
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .programs import (build_decode, build_page_copy, build_prefill,
@@ -78,7 +79,8 @@ class EngineCore:
                  tracer: Optional[Tracer] = None,
                  enable_prefix_cache: bool = False,
                  prefix_cache_watermark: float = 0.5,
-                 fault_plane=None):
+                 fault_plane=None,
+                 steplog: Optional[StepLog] = None):
         self._engine = engine
         self._max_batch = int(max_batch)
         # resilience plumbing (serving/resilience/): the fault plane is
@@ -127,6 +129,13 @@ class EngineCore:
         self._prefix_cache: Optional[PrefixCache] = (
             PrefixCache(self._pool, page, prefix_cache_watermark)
             if enable_prefix_cache else None)
+
+        # step-level flight recorder: every scheduler step event
+        # (prefill / fused decode chunk / page copy / evict) appends one
+        # schema-fixed record with an analytic bytes/FLOPs estimate from
+        # the cost model (observability/steplog.py; GET /steps)
+        self.steplog = steplog if steplog is not None else StepLog()
+        self._cost_model = StepCostModel(engine, self._pool)
 
         self._slots: List[Optional[dict]] = [None] * self._max_batch
         # degradation ladder: memory pressure shrinks the batch the
@@ -232,6 +241,11 @@ class EngineCore:
         else:
             resilience.update({"health_state": "healthy",
                                "health_code": 0})
+        # the one device-memory probe in the tree (profiler.statistic;
+        # evidence bundles use the same one) — None on backends whose
+        # allocator exposes no counters (CPU)
+        from ..profiler.statistic import memory_stats
+
         return self._metrics.snapshot(
             queue_depth=len(self._queue),
             active=self.active_count,
@@ -242,7 +256,9 @@ class EngineCore:
                      "occupancy": (total - free) / total if total else 0.0},
             prefix_cache=(self._prefix_cache.stats_snapshot()
                           if self._prefix_cache is not None else None),
-            resilience=resilience)
+            resilience=resilience,
+            steplog=self.steplog.summary(),
+            device_memory=memory_stats())
 
     # ------------------------------------------------------- trace hooks
     def _trace_end(self, req: Request, state: RequestState):
@@ -438,15 +454,32 @@ class EngineCore:
             cache.trim(match, match.cached_tokens - 1)
         return match
 
+    def _used_pages(self) -> int:
+        """Pool pages currently held by any sequence (slots, scratch,
+        retained cache) — the resident-KV gauge StepLog records."""
+        return int(self._pool.num_blocks - self._pool.free_blocks)
+
     def _copy_page(self, src: int, dst: int):
         """Device-side copy of one physical page across every layer's
         pools (the CoW step for a shared partial tail block)."""
         self._fault.fire("page.copy")
         eng = self._engine
         ckey = ("serve-page-copy", self._pool.num_blocks)
+        clog = get_compile_log()
+        c0 = clog.count()
+        t0 = time.monotonic()
         eng.run_paged_program(
             ckey, lambda: build_page_copy(eng),
             np.asarray([src], np.int32), np.asarray([dst], np.int32))
+        wall = time.monotonic() - t0
+        bts, fl, src_tag = self._cost_model.estimate("page_copy",
+                                                     pages_touched=1)
+        self.steplog.record(
+            "page_copy", wall_s=wall, dispatch_s=wall,
+            active_rows=self.active_count,
+            resident_kv_pages=self._used_pages(),
+            bytes_est=bts, flops_est=fl, cost_source=src_tag,
+            compile_events=clog.count() - c0)
 
     def _stage_prefix(self, sid: int, match, length: int, max_new: int):
         """Map a match onto slot ``sid``'s sequence: copy-on-write the
@@ -520,9 +553,11 @@ class EngineCore:
 
     def _admit(self, req: Request, sid: int):
         admit_t = time.monotonic()
-        self.tracer.add_span(req.rid, "queue_wait",
-                             req.requeued_at if req.retries
-                             else req.arrival, admit_t)
+        queued_at = req.requeued_at if req.retries else req.arrival
+        self.tracer.add_span(req.rid, "queue_wait", queued_at, admit_t)
+        self._metrics.on_queue_wait(admit_t - queued_at)
+        clog = get_compile_log()
+        c0 = clog.count()
         g = req.config
         # replay (req.retries > 0, tokens already delivered): the row
         # resumes from prompt + delivered tokens.  The full sequence
@@ -561,9 +596,16 @@ class EngineCore:
                 self._pool.reserve(sid, reserve)
         except Exception as e:
             self._release_slot_kv(sid, match)
-            self.tracer.add_span(req.rid, "prefill", admit_t,
-                                 time.monotonic(), slot=sid,
-                                 outcome="failed")
+            now = time.monotonic()
+            self.tracer.add_span(req.rid, "prefill", admit_t, now,
+                                 slot=sid, outcome="failed")
+            self.steplog.record(
+                "prefill", wall_s=now - admit_t, host_s=now - admit_t,
+                active_rows=self.active_count,
+                resident_kv_pages=self._used_pages(),
+                compile_events=clog.count() - c0, failed=True,
+                retries=req.retries,
+                degraded=self._effective_max_batch < self._max_batch)
             self._admit_failure(req, e)
             return
         suffix = length - cached
@@ -581,6 +623,7 @@ class EngineCore:
             jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))
         steps0 = np.asarray([already], np.int32)
         span_name = "prefill" if cache is None else "suffix_prefill"
+        t_run0 = time.monotonic()
         try:
             self._fault.fire("prefill.run", rid=req.rid)
             if cache is not None:
@@ -606,9 +649,18 @@ class EngineCore:
                     table[None], self._samp_arrays([g]), key[None])
         except Exception as e:
             self._release_slot_kv(sid, match)
-            self.tracer.add_span(req.rid, span_name, prefill_t,
-                                 time.monotonic(), slot=sid, plen=plen,
-                                 outcome="failed")
+            now = time.monotonic()
+            self.tracer.add_span(req.rid, span_name, prefill_t, now,
+                                 slot=sid, plen=plen, outcome="failed")
+            self.steplog.record(
+                "prefill", wall_s=now - admit_t,
+                dispatch_s=now - t_run0, prefill_tokens=suffix,
+                prefix_hit_pages=len(match.blocks) if match else 0,
+                active_rows=self.active_count,
+                resident_kv_pages=self._used_pages(),
+                compile_events=clog.count() - c0, failed=True,
+                retries=req.retries,
+                degraded=self._effective_max_batch < self._max_batch)
             self._admit_failure(req, e)
             return
         # the intentional once-per-admission sync: the first token and
@@ -617,6 +669,7 @@ class EngineCore:
         tok = int(np.asarray(tok)[0])
         # tpulint: disable-next-line=host-sync
         finished = bool(np.asarray(fin)[0])
+        t_sync = time.monotonic()
         req._mark_active()
         if already == 0:
             # TTFT is a first-admission metric; a replayed request's
@@ -631,6 +684,20 @@ class EngineCore:
         self.tracer.add_span(req.rid, span_name, prefill_t, span_end,
                              slot=sid, plen=plen, cached_tokens=cached,
                              replay=req.retries)
+        bts, fl, src_tag = self._cost_model.estimate(
+            "prefill", pkey, rows=1, max_rows=1,
+            pages_touched=-(-reserve // self._page), tokens=plen)
+        self.steplog.record(
+            "prefill", wall_s=span_end - admit_t,
+            dispatch_s=t_sync - t_run0,
+            host_s=(span_end - admit_t) - (t_sync - t_run0),
+            active_rows=self.active_count, prefill_tokens=suffix,
+            chunk_steps=1, emitted_tokens=1,
+            resident_kv_pages=self._used_pages(),
+            prefix_hit_pages=len(match.blocks) if match else 0,
+            bytes_est=bts, flops_est=fl, cost_source=src_tag,
+            compile_events=clog.count() - c0, retries=req.retries,
+            degraded=self._effective_max_batch < self._max_batch)
         if finished or budget <= 1:
             # KV through the penultimate delivered token is fully
             # written — retain it even though the row never reaches a
@@ -799,6 +866,8 @@ class EngineCore:
             cfgs[i] = s["g"]
         eng = self._engine
         dkey = ("serve-step", b, S, self._max_pages, self._pool.num_blocks)
+        clog = get_compile_log()
+        c0 = clog.count()
         t0 = time.monotonic()
         try:
             fault = self._fault.fire(
@@ -815,6 +884,14 @@ class EngineCore:
             # every row's KV and every retained cache page — are then
             # garbage), so KV-intact replay is reserved for injections
             injected = isinstance(e, (InjectedFault, InjectedMemoryError))
+            self.steplog.record(
+                "decode", wall_s=time.monotonic() - t0,
+                active_rows=len(active), decode_rows=len(active),
+                chunk_steps=S, resident_kv_pages=self._used_pages(),
+                compile_events=clog.count() - c0, faults=injected,
+                retries=sum(s["req"].retries for s in active),
+                failed=True,
+                degraded=self._effective_max_batch < self._max_batch)
             if getattr(e, "lose_kv", False) or not injected:
                 self._engine.drop_kv_state()
             rec = self._recovery
@@ -844,6 +921,13 @@ class EngineCore:
         fin_out = np.asarray(fin_out)
         # tpulint: disable-next-line=host-sync
         nvalid = np.asarray(nvalid)
+        t_sync = time.monotonic()
+        # capture the step's page view BEFORE evictions free anything —
+        # this is what the dispatched chunk actually ran against
+        resident = self._used_pages()
+        prefix_hits = sum(len(s["match"].blocks)
+                          if s.get("match") is not None else 0
+                          for s in active)
         if fault is not None and fault.get("nan_rids"):
             # injected NaN/inf logits: overwrite the target rows' chunk
             # with the non-finite sampling sentinel (-1), exactly what a
@@ -899,6 +983,20 @@ class EngineCore:
             "step": self._step_idx, "batch_steps": S,
             "active": [s["req"].rid for s in active],
             "evicted": evicted})
+        bts, fl, src_tag = self._cost_model.estimate(
+            "decode", dkey, rows=len(active), max_rows=b,
+            pages_touched=resident, chunk=S, tokens=len(active) * S)
+        end = time.monotonic()
+        self.steplog.record(
+            "decode", wall_s=end - t0, dispatch_s=t_sync - t0,
+            host_s=end - t_sync, active_rows=len(active),
+            decode_rows=len(active), chunk_steps=S,
+            emitted_tokens=emitted_total, resident_kv_pages=resident,
+            prefix_hit_pages=prefix_hits, bytes_est=bts, flops_est=fl,
+            cost_source=src_tag, compile_events=clog.count() - c0,
+            faults=fault is not None,
+            retries=sum(s["req"].retries for s in active),
+            degraded=self._effective_max_batch < self._max_batch)
         if self._recovery is not None:
             # a clean chunk resets crash/memory streaks and climbs the
             # recovery ladder back toward full batch width
@@ -921,9 +1019,25 @@ class EngineCore:
                 [req.prompt,
                  # tpulint: disable-next-line=host-sync
                  np.asarray(req.tokens[:-1], np.int32)])
+        try:
+            pages = len(self._pool.block_table(slot["sid"]))
+        except Exception:
+            pages = 0
+        t0 = time.monotonic()
         self._release_slot_kv(slot["sid"], slot.get("match"),
                               retain_tokens=retain,
                               salt=req.cache_salt)
+        wall = time.monotonic() - t0
+        bts, fl, src_tag = self._cost_model.estimate("evict",
+                                                     pages_touched=pages)
+        self.steplog.record(
+            "evict", wall_s=wall, host_s=wall,
+            active_rows=self.active_count, pages_freed=pages,
+            resident_kv_pages=self._used_pages(),
+            bytes_est=bts, flops_est=fl, cost_source=src_tag,
+            failed=state == RequestState.FAILED,
+            retries=req.retries,
+            degraded=self._effective_max_batch < self._max_batch)
         req._finish(state, err)
         now = time.monotonic()
         self.tracer.add_span(req.rid, "evict", slot.get("span_end", now),
@@ -945,6 +1059,7 @@ class EngineCore:
             return
         start = time.monotonic()
         self.tracer.add_span(req.rid, "queue_wait", req.arrival, start)
+        self._metrics.on_queue_wait(start - req.arrival)
         req._mark_active()
         try:
             req.value = req.exclusive_fn()

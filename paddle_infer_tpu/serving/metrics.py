@@ -12,6 +12,13 @@ a sketch) — good enough for a serving dashboard and O(1) memory.
 Token throughput is measured over a sliding window of recent
 (timestamp, count) emission events so the reported tokens/s reflects
 steady state rather than lifetime average.
+
+Each latency series the Prometheus exposition cares about (TTFT, ITL,
+e2e, step wall, queue wait) is additionally fed into a log-bucketed
+``observability.histogram.Histogram`` so ``/metrics`` can render native
+``_bucket``/``_sum``/``_count`` families — mergeable across replicas,
+re-quantileable server-side — while the reservoir ``*_recent`` keys
+stay in the JSON snapshot for bench.
 """
 from __future__ import annotations
 
@@ -19,6 +26,8 @@ import threading
 import time
 from collections import deque
 from typing import Dict, Optional
+
+from ..observability.histogram import Histogram
 
 _RESERVOIR = 2048        # samples kept per latency series
 _RATE_WINDOW_S = 30.0    # sliding window for tokens/s
@@ -101,6 +110,13 @@ class ServingMetrics:
             self.step_ms = _Series()        # one fused decode step (ms)
             self.occupancy = _Series()      # active rows / max_batch
             self._emits: deque = deque()    # (t, ntokens) rate window
+            # native-histogram twins of the latency series (seconds
+            # throughout; Histogram has its own inner lock)
+            self.ttft_hist = Histogram()
+            self.itl_hist = Histogram()
+            self.e2e_hist = Histogram()
+            self.step_wall_hist = Histogram()
+            self.queue_wait_hist = Histogram()
 
     # ------------------------------------------------ recording hooks
     def on_submitted(self, n: int = 1):
@@ -128,6 +144,7 @@ class ServingMetrics:
             self.prefills += 1
             if ttft_s is not None:
                 self.ttft.add(ttft_s)
+                self.ttft_hist.observe(ttft_s)
 
     def on_tokens(self, n: int, itl_s: Optional[float] = None):
         now = time.monotonic()
@@ -138,19 +155,27 @@ class ServingMetrics:
                 self._emits.popleft()
             if itl_s is not None and n > 0:
                 self.itl.add(itl_s)
+                self.itl_hist.observe(itl_s)
 
     def on_step(self, wall_ms: float, active: int, max_batch: int):
         with self._lock:
             self.decode_steps += 1
             self.step_ms.add(wall_ms)
+            self.step_wall_hist.observe(wall_ms / 1e3)
             if max_batch > 0:
                 self.occupancy.add(active / max_batch)
+
+    def on_queue_wait(self, wait_s: float):
+        """One request left the admission queue after ``wait_s``."""
+        with self._lock:
+            self.queue_wait_hist.observe(max(0.0, wait_s))
 
     def on_completed(self, e2e_s: Optional[float] = None):
         with self._lock:
             self.completed += 1
             if e2e_s is not None:
                 self.e2e.add(e2e_s)
+                self.e2e_hist.observe(e2e_s)
 
     # --------------------------------------------- resilience hooks
     def on_engine_restart(self, n: int = 1):
@@ -192,17 +217,22 @@ class ServingMetrics:
                  max_batch: int = 0,
                  kv_pool: Optional[Dict] = None,
                  prefix_cache: Optional[Dict] = None,
-                 resilience: Optional[Dict] = None) -> Dict:
+                 resilience: Optional[Dict] = None,
+                 steplog: Optional[Dict] = None,
+                 device_memory: Optional[Dict] = None) -> Dict:
         """Render everything to a plain dict (the ``GET /metrics`` JSON
         body).  Latency series carry lifetime ``count``/``mean`` plus
         reservoir-window ``p50_recent``/``p99_recent``/``max_recent``
-        (see ``_Series``).  ``kv_pool`` is the block-pool occupancy
-        gauge set supplied by ``EngineCore`` (total/used/free blocks);
-        ``prefix_cache`` is ``PrefixCache.stats_snapshot()`` when the
-        core runs with prefix caching enabled; ``resilience`` is the
-        core's health/fault context (effective batch, health state,
-        injected-fault tallies), merged here with this registry's own
-        resilience counters."""
+        (see ``_Series``); ``histograms`` carries their native
+        cumulative-bucket twins.  ``kv_pool`` is the block-pool
+        occupancy gauge set supplied by ``EngineCore`` (total/used/free
+        blocks); ``prefix_cache`` is ``PrefixCache.stats_snapshot()``
+        when the core runs with prefix caching enabled; ``resilience``
+        is the core's health/fault context (effective batch, health
+        state, injected-fault tallies), merged here with this
+        registry's own resilience counters; ``steplog`` is
+        ``StepLog.summary()`` and ``device_memory`` the device
+        allocator's ``memory_stats()`` dict when available."""
         tps = self.tokens_per_second()
         with self._lock:
             out = {
@@ -227,7 +257,18 @@ class ServingMetrics:
                 "e2e_latency_s": self.e2e.summary(),
                 "decode_step_ms": self.step_ms.summary(),
                 "occupancy": self.occupancy.summary(),
+                "histograms": {
+                    "ttft": self.ttft_hist.snapshot(),
+                    "itl": self.itl_hist.snapshot(),
+                    "e2e": self.e2e_hist.snapshot(),
+                    "step_wall": self.step_wall_hist.snapshot(),
+                    "queue_wait": self.queue_wait_hist.snapshot(),
+                },
             }
+            if steplog is not None:
+                out["steplog"] = dict(steplog)
+            if device_memory:
+                out["device_memory"] = dict(device_memory)
             if kv_pool is not None:
                 out["kv_pool"] = dict(kv_pool)
             if prefix_cache is not None:

@@ -89,6 +89,42 @@ def test_check_metrics_detects_stale_docs(tmp_path):
     assert any("missing from the catalog" in p for p in problems)
 
 
+def test_bench_diff_flags_regressions(tmp_path):
+    """tools/bench_diff.py: direction-aware >10% regressions exit
+    nonzero; improvements and unknown-direction metrics never do."""
+    import bench_diff
+
+    old = {"parsed": {"continuous_tokens_per_s": 100.0,
+                      "ttft_p99_s": 0.10, "speedup": 2.0,
+                      "clients": 8, "bench_wall_s": 30.0}}
+    new_bad = {"parsed": {"continuous_tokens_per_s": 80.0,   # -20% thpt
+                          "ttft_p99_s": 0.15,                # +50% lat
+                          "speedup": 2.1, "clients": 8,
+                          "bench_wall_s": 400.0}}            # skipped
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(old))
+    b.write_text(json.dumps(new_bad))
+    assert bench_diff.main([str(a), str(b)]) == 1
+    res = bench_diff.diff(old["parsed"], new_bad["parsed"])
+    flagged = {r[0] for r in res["regressions"]}
+    assert flagged == {"continuous_tokens_per_s", "ttft_p99_s"}
+    assert "bench_wall_s" not in {r[0] for r in res["rows"]}
+    # same numbers both sides -> clean exit; small drift under the
+    # threshold too
+    assert bench_diff.main([str(a), str(a)]) == 0
+    assert bench_diff.diff(old["parsed"], old["parsed"])["regressions"] \
+        == []
+    near = {"parsed": dict(old["parsed"],
+                           continuous_tokens_per_s=95.0)}    # -5% < 10%
+    b.write_text(json.dumps(near))
+    assert bench_diff.main([str(a), str(b)]) == 0
+    # tighter threshold flips it
+    assert bench_diff.main([str(a), str(b), "--threshold", "0.02"]) == 1
+    # a metric that disappeared is reported but not fatal
+    res = bench_diff.diff(old["parsed"], {"clients": 8})
+    assert "ttft_p99_s" in res["removed"]
+
+
 def test_bench_last_json_salvage():
     """bench.py parent salvage: _last_json must return the LAST complete
     metric line (preliminary headline lines count when nothing later

@@ -8,7 +8,7 @@ import time
 import numpy as np
 import pytest
 
-from paddle_infer_tpu.observability import (Span, Trace, Tracer,
+from paddle_infer_tpu.observability import (Span, StepLog, Trace, Tracer,
                                             capture_bundle, family_names,
                                             render_prometheus,
                                             signature_of,
@@ -198,7 +198,13 @@ def test_render_prometheus_valid_and_complete():
     assert "serving_ttft_seconds" in fams
     assert "serving_kv_pool_blocks" in fams
     assert "post_warmup_decode_compiles_total" in fams
-    assert 'serving_ttft_seconds{stat="p50_recent"}' in text
+    # ttft is a native histogram family now: cumulative buckets with a
+    # +Inf terminal and _sum/_count, no bare stat-gauge samples
+    assert "# TYPE serving_ttft_seconds histogram" in text
+    assert 'serving_ttft_seconds_bucket{le="+Inf"} 1' in text
+    assert "serving_ttft_seconds_count 1" in text
+    assert 'serving_ttft_seconds{stat=' not in text
+    assert 'serving_decode_step_milliseconds{stat="p50_recent"}' in text
     assert 'compile_count_by_site{site="serving-decode"} 1' in text
     assert "serving_submitted_total 2" in text
 
@@ -235,9 +241,13 @@ def test_capture_bundle_writes_manifest(tmp_path):
     tracer.add_span(1, "queue_wait", 0.0, 0.5)
     tracer.end(1)
 
+    steplog = StepLog()
+    steplog.record("decode", wall_s=0.01, bytes_est=1e6)
+
     class FakeCore:
         def __init__(self):
             self.tracer = tracer
+            self.steplog = steplog
 
         def metrics_snapshot(self):
             return _fabricated_snapshot()
@@ -248,10 +258,13 @@ def test_capture_bundle_writes_manifest(tmp_path):
                               extra={"note": "test"})
     for name in ("manifest.json", "device_probe.json", "compile_log.json",
                  "metrics.json", "metrics.prom", "traces.json",
-                 "traces.chrome.json", "kernel_summary.txt", "extra.json"):
+                 "traces.chrome.json", "kernel_summary.txt", "extra.json",
+                 "steps.jsonl", "steps_summary.json"):
         assert (out / name).exists(), name
         assert name in manifest["files"]
     assert manifest["missing"] == []
+    assert json.loads((out / "steps.jsonl").read_text()
+                      .splitlines()[0])["kind"] == "decode"
     with open(out / "traces.json") as f:
         traces = json.load(f)
     assert traces["traces"][0]["request_id"] == 1

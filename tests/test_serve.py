@@ -172,6 +172,40 @@ def test_trace_endpoint_covers_request(server):
         assert e.code == 404
 
 
+def test_steps_endpoint_flight_recorder(server):
+    """GET /steps returns the StepLog ring: schema-complete records with
+    nonzero analytic cost on prefill/decode, plus the model summary;
+    ?format=jsonl streams the same records as NDJSON."""
+    url, _ = server
+    ids = np.random.RandomState(4).randint(0, 96, (1, 8)).astype(np.int32)
+    with _post(url, "/generate", {"ids": ids.tolist(),
+                                  "max_new_tokens": 6}) as r:
+        json.load(r)
+    with urllib.request.urlopen(url + "/steps", timeout=30) as r:
+        body = json.load(r)
+    steps, summary = body["steps"], body["summary"]
+    kinds = {s["kind"] for s in steps}
+    assert "prefill" in kinds and "decode" in kinds
+    for s in steps:
+        if s["kind"] in ("prefill", "decode"):
+            assert s["bytes_est"] > 0, s
+            assert s["cost_source"] in ("xla+pages", "analytic")
+    assert summary["records"] >= len(steps)
+    assert "decode_model" in summary
+    with urllib.request.urlopen(url + "/steps?format=jsonl&limit=4",
+                                timeout=30) as r:
+        assert r.headers["Content-Type"].startswith("application/x-ndjson")
+        lines = r.read().decode().strip().splitlines()
+    assert 0 < len(lines) <= 4
+    assert all("kind" in json.loads(ln) for ln in lines)
+    # bad limit -> 400
+    try:
+        urllib.request.urlopen(url + "/steps?limit=banana", timeout=30)
+        raise AssertionError("expected 400")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+
+
 def test_metrics_content_negotiation(server):
     """Accept: text/plain renders Prometheus 0.0.4 exposition; the JSON
     default gains kv_pool gauges and the compile-log section."""

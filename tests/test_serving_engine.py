@@ -320,6 +320,19 @@ def test_decode_loop_compile_free_after_warmup(make_core, ref):
     assert log.summary()["post_warmup_decode_compiles"] == 0
     snap = core.metrics_snapshot()
     assert snap["counters"]["completed"] == 7
+    # the StepLog flight recorder observed every step — including its
+    # per-executable cost_analysis capture — without tripping the
+    # compile-free invariant above
+    records = core.steplog.records()
+    kinds = {r["kind"] for r in records}
+    assert {"prefill", "decode", "evict"} <= kinds
+    post_warm = [r for r in records
+                 if r["kind"] == "decode" and r["seq"] > records[0]["seq"]]
+    assert all(r["compile_events"] == 0 for r in post_warm[1:]), \
+        "StepLog saw compile events on warmed decode steps"
+    assert all(r["bytes_est"] > 0 for r in records
+               if r["kind"] in ("prefill", "decode"))
+    assert snap["steplog"]["records"] == len(records)
 
 
 def test_close_rejects_queued_and_cancels_active(make_core):

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Compare two bench result files and flag regressions.
+
+Bench rounds land as ``BENCH_r*.json`` (``{"n", "cmd", "rc", "tail",
+"parsed"}``; the numbers live under ``parsed``).  This tool diffs the
+numeric leaves of two such files, classifies each metric's *good*
+direction by name, and exits nonzero when anything moved more than the
+threshold (default 10%) the wrong way — so a round that quietly halves
+decode throughput fails CI instead of scrolling past.
+
+Usage:
+  python tools/bench_diff.py OLD.json NEW.json [--threshold 0.10]
+
+Metrics with no recognizable direction are reported informationally and
+never flagged.  Bookkeeping keys (``n``, ``rc``, wall clocks of the
+bench harness itself, ``vs_baseline``) are skipped.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, Tuple
+
+# substrings that mark a metric as higher-is-better / lower-is-better;
+# first match in this order wins, so throughput-ish names beat the
+# generic "_s" suffix ("tokens_per_sec" is not a latency)
+_HIGHER = ("per_s", "per_sec", "speedup", "mfu", "acceptance",
+           "hit_rate", "tps", "throughput", "tokens_per", "pearson")
+_LOWER = ("_ms", "latency", "ttft", "itl", "err", "wall", "p50",
+          "p99", "_s")
+# harness bookkeeping, not workload performance
+_SKIP = ("vs_baseline", "child_wall_s", "bench_wall_s", "n", "rc")
+
+
+def _direction(key: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 unknown."""
+    low = key.lower()
+    for pat in _HIGHER:
+        if pat in low:
+            return 1
+    for pat in _LOWER:
+        if pat in low:
+            return -1
+    return 0
+
+
+def _numeric_leaves(obj, prefix: str = "") -> Dict[str, float]:
+    """Flatten nested dicts to dotted keys, numeric leaves only
+    (bools are flags, not measurements)."""
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            out.update(_numeric_leaves(v, key))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        if prefix.split(".")[-1] not in _SKIP:
+            out[prefix] = float(obj)
+    return out
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    parsed = data.get("parsed") if isinstance(data, dict) else None
+    return parsed if isinstance(parsed, dict) else data
+
+
+def diff(old: dict, new: dict, threshold: float = 0.10) -> dict:
+    """Compare two parsed bench dicts.  Returns ``{"rows", "regressions",
+    "improvements", "added", "removed"}``; each row is
+    ``(key, old, new, rel_change, verdict)`` where rel_change is
+    ``(new - old) / |old|`` and verdict is one of
+    ``regression/improvement/ok/info``."""
+    a, b = _numeric_leaves(old), _numeric_leaves(new)
+    rows = []
+    regressions, improvements = [], []
+    for key in sorted(set(a) & set(b)):
+        ov, nv = a[key], b[key]
+        if ov == 0.0:
+            rel = 0.0 if nv == 0.0 else float("inf")
+        else:
+            rel = (nv - ov) / abs(ov)
+        d = _direction(key)
+        verdict = "info"
+        if d != 0:
+            moved_bad = (d > 0 and rel < -threshold) or \
+                        (d < 0 and rel > threshold)
+            moved_good = (d > 0 and rel > threshold) or \
+                         (d < 0 and rel < -threshold)
+            verdict = ("regression" if moved_bad
+                       else "improvement" if moved_good else "ok")
+        row = (key, ov, nv, rel, verdict)
+        rows.append(row)
+        if verdict == "regression":
+            regressions.append(row)
+        elif verdict == "improvement":
+            improvements.append(row)
+    return {"rows": rows, "regressions": regressions,
+            "improvements": improvements,
+            "added": sorted(set(b) - set(a)),
+            "removed": sorted(set(a) - set(b))}
+
+
+def _fmt(v: float) -> str:
+    return f"{v:.6g}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two BENCH_r*.json files, flag >threshold "
+                    "regressions")
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="relative change that counts as a regression "
+                         "(default 0.10 = 10%%)")
+    args = ap.parse_args(argv)
+
+    result = diff(_load(args.old), _load(args.new), args.threshold)
+    width = max((len(r[0]) for r in result["rows"]), default=3)
+    for key, ov, nv, rel, verdict in result["rows"]:
+        mark = {"regression": "!!", "improvement": "++",
+                "ok": "  ", "info": " ?"}[verdict]
+        pct = "inf" if rel == float("inf") else f"{rel * 100:+.1f}%"
+        print(f"{mark} {key:<{width}}  {_fmt(ov):>12} -> "
+              f"{_fmt(nv):>12}  ({pct})")
+    for key in result["added"]:
+        print(f" + {key} (new metric)")
+    for key in result["removed"]:
+        print(f" - {key} (metric disappeared)")
+    n_reg = len(result["regressions"])
+    print(f"{len(result['rows'])} compared, {n_reg} regression(s), "
+          f"{len(result['improvements'])} improvement(s) "
+          f"at {args.threshold * 100:.0f}% threshold")
+    return 1 if n_reg else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

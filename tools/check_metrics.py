@@ -11,9 +11,13 @@ Three sync points must agree or dashboards silently break:
      so each drift is reported with its file:line (the ``w.family``
      call or the catalog table row), not as a bare name-set diff;
   3. every latency-series key in ``ServingMetrics.snapshot()`` must
-     have a renderer mapping (``prometheus.SERIES_FAMILIES``) — a new
-     series added to the snapshot but not the renderer would be
-     invisible to scrapers.
+     have a renderer mapping (``prometheus.SERIES_FAMILIES`` for the
+     stat-gauge series, ``prometheus.HISTOGRAM_SERIES`` for the ones
+     whose exposure moved to native histogram families) — a new series
+     added to the snapshot but not the renderer would be invisible to
+     scrapers.  Histogram families must count once: ``_bucket``/
+     ``_sum``/``_count`` are samples of the one typed family, never
+     families of their own.
 
 Runs on a FABRICATED snapshot (every counter/series/gauge populated,
 plus a compile-log summary with a recompile) so the exposition exercises
@@ -40,6 +44,21 @@ def fabricated_exposition():
     from paddle_infer_tpu.observability.prometheus import render_prometheus
     from paddle_infer_tpu.serving.metrics import ServingMetrics
 
+    from paddle_infer_tpu.observability.steplog import StepLog
+
+    steplog = StepLog()
+    steplog.record("prefill", wall_s=0.08, dispatch_s=0.07,
+                   bytes_est=2.0e6, flops_est=5.0e6,
+                   cost_source="xla+pages", emitted_tokens=1)
+    steplog.record("decode", wall_s=0.010, dispatch_s=0.008,
+                   bytes_est=1.0e6, flops_est=3.0e6,
+                   cost_source="xla+pages", decode_rows=2, chunk_steps=4)
+    steplog.record("decode", wall_s=0.021, dispatch_s=0.017,
+                   bytes_est=2.1e6, flops_est=6.0e6,
+                   cost_source="xla+pages", decode_rows=4, chunk_steps=4)
+    steplog.record("evict", pages_freed=3, bytes_est=3.0e5,
+                   cost_source="analytic")
+
     m = ServingMetrics()
     m.on_submitted(4)
     m.on_rejected()
@@ -51,6 +70,8 @@ def fabricated_exposition():
     m.on_tokens(4, itl_s=0.010)
     m.on_tokens(3, itl_s=0.012)
     m.on_step(3.5, active=2, max_batch=4)
+    m.on_queue_wait(0.004)
+    m.on_queue_wait(0.020)
     m.on_completed(0.5)
     m.on_engine_restart()
     m.on_retry(2)
@@ -72,7 +93,13 @@ def fabricated_exposition():
                                     "prompt_tokens": 160,
                                     "token_ratio": 0.6, "inserts": 5,
                                     "evicted_blocks": 2, "cow_copies": 1,
-                                    "cached_blocks": 7, "nodes": 6})
+                                    "cached_blocks": 7, "nodes": 6},
+                      steplog=steplog.summary(),
+                      device_memory={"bytes_in_use": 1 << 20,
+                                     "peak_bytes_in_use": 1 << 21,
+                                     "bytes_limit": 1 << 30,
+                                     "largest_alloc_size": 1 << 18,
+                                     "num_allocs": 12})
 
     # local CompileLog (not the process singleton): one prefill, one
     # warmed decode, one post-warmup recompile so the recompile/storm
@@ -110,9 +137,9 @@ def metric_sync_problems(docs_path: str):
 
 
 def run_checks(docs_path: str):
-    from paddle_infer_tpu.observability.prometheus import (SERIES_FAMILIES,
-                                                           family_names,
-                                                           validate_exposition)
+    from paddle_infer_tpu.observability.prometheus import (
+        HISTOGRAM_SERIES, SERIES_FAMILIES, family_names,
+        validate_exposition)
 
     problems = []
     snap, summary, text = fabricated_exposition()
@@ -122,19 +149,49 @@ def run_checks(docs_path: str):
     families = family_names(text)
     if len(set(families)) != len(families):
         problems.append("duplicate TYPE declarations in exposition")
+    # count-once: a histogram's _bucket/_sum/_count are samples, not
+    # families — a TYPE line for "<family>_bucket" (etc.) when
+    # "<family>" is TYPE'd histogram means the same metric counts
+    # twice.  (Stat-gauge series legitimately ship a separate
+    # "<family>_count" gauge family, so only histogram bases count.)
+    kinds = {}
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 4:
+                kinds[parts[2]] = parts[3]
+    for fam in families:
+        for suffix in ("_bucket", "_sum", "_count"):
+            if fam.endswith(suffix) \
+                    and kinds.get(fam[:-len(suffix)]) == "histogram":
+                problems.append(
+                    f"family {fam!r} shadows histogram family "
+                    f"{fam[:-len(suffix)]!r} — suffixed names are "
+                    "samples, not families")
     problems += metric_sync_problems(docs_path)
 
     # snapshot <-> renderer mapping: every reservoir series in the
-    # snapshot must have a SERIES_FAMILIES entry
+    # snapshot must be rendered either as a stat gauge
+    # (SERIES_FAMILIES) or as a native histogram (HISTOGRAM_SERIES)
     for key, val in snap.items():
         if isinstance(val, dict) and "p50_recent" in val \
-                and key not in SERIES_FAMILIES:
+                and key not in SERIES_FAMILIES \
+                and key not in HISTOGRAM_SERIES:
             problems.append(f"snapshot series {key!r} has no renderer "
-                            "mapping in prometheus.SERIES_FAMILIES")
+                            "mapping in prometheus.SERIES_FAMILIES / "
+                            "HISTOGRAM_SERIES")
     for key in SERIES_FAMILIES:
         if key not in snap:
             problems.append(f"SERIES_FAMILIES key {key!r} absent from "
                             "ServingMetrics.snapshot()")
+    hist_snap = snap.get("histograms") or {}
+    for key, hist_key in HISTOGRAM_SERIES.items():
+        if key not in snap:
+            problems.append(f"HISTOGRAM_SERIES key {key!r} absent from "
+                            "ServingMetrics.snapshot()")
+        if hist_key not in hist_snap:
+            problems.append(f"HISTOGRAM_SERIES target {hist_key!r} "
+                            "absent from snapshot['histograms']")
     return problems, len(families)
 
 

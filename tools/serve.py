@@ -25,6 +25,13 @@ decode steps instead of serializing behind a lock.
                           mergeable with profiler captures
   GET  /traces            -> one-line summaries of the completed-trace
                           ring (id, state, duration, span coverage)
+  GET  /steps             -> recent StepLog flight-recorder ring (one
+                          record per scheduler step: kind, batch
+                          composition, resident KV pages, analytic
+                          bytes/FLOPs, dispatch-vs-host wall) plus the
+                          model-vs-measured summary; ``?limit=N``
+                          bounds the ring slice, ``?format=jsonl``
+                          streams raw JSONL for offline analysis
   GET  /health            -> {"status": "ok", "model": ...} (legacy
                           process-liveness probe; always ok once up)
   GET  /healthz           -> engine health (supervisor state machine):
@@ -326,6 +333,20 @@ class Handler(BaseHTTPRequestHandler):
                 self._json(200, snap)
         elif url.path == "/traces":
             self._json(200, {"traces": _core().tracer.summaries()})
+        elif url.path == "/steps":
+            core = _core()
+            q = parse_qs(url.query)
+            try:
+                limit = int(q.get("limit", ["128"])[0])
+            except ValueError:
+                self._json(400, {"error": "limit must be an integer"})
+                return
+            if q.get("format", ["json"])[0] == "jsonl":
+                self._text(200, core.steplog.to_jsonl(limit=limit),
+                           "application/x-ndjson")
+            else:
+                self._json(200, {"steps": core.steplog.records(limit),
+                                 "summary": core.steplog.summary()})
         elif url.path.startswith("/trace/"):
             try:
                 rid = int(url.path[len("/trace/"):])
