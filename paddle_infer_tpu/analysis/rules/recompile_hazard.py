@@ -20,12 +20,24 @@ Flagged elements: f-strings, ``len(...)``, ``str(...)`` / ``repr(...)``.
 Bare names are deliberately NOT flagged — ``plen`` is fine precisely
 because ``_plen()`` bucketed it — so the rule stays quiet on
 disciplined keys and loud on raw ones.
+
+Program BUILDERS are also checked: a ``def build_*`` whose signature
+takes a shape-valued parameter (``plen`` / ``batch`` / ``chunk``)
+closes one executable over every distinct value — the per-shape program
+family the ragged mixed step exists to collapse.  Legacy builders that
+are deliberately kept (behind ``ragged=False``) carry a reasoned
+``# tpulint: disable-next-line=recompile-hazard`` suppression.
 """
 from __future__ import annotations
 
 import ast
 
 from ..core import FileContext, Rule, dotted
+
+# parameter names that key an executable to traffic shape (exact match:
+# config-sized names like max_batch / token_budget are bounded by
+# construction and deliberately not flagged)
+_SHAPE_VALUED = frozenset({"plen", "batch", "chunk"})
 
 
 def _element_label(el: ast.AST) -> str:
@@ -53,6 +65,26 @@ class RecompileHazardRule(Rule):
                 yield from self._check_assign(ctx, node)
             elif isinstance(node, ast.Call):
                 yield from self._check_call(ctx, node)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                yield from self._check_builder(ctx, node)
+
+    def _check_builder(self, ctx: FileContext, node: ast.AST):
+        if not node.name.startswith("build_"):
+            return
+        args = node.args
+        names = [a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)]
+        hazards = [n for n in names if n in _SHAPE_VALUED]
+        if hazards:
+            yield ctx.finding(
+                self.id, node,
+                f"shape-keyed program builder {node.name}"
+                f"({', '.join(hazards)}) compiles one executable per "
+                "distinct value — fold the shape into a "
+                "composition-keyed executable (ragged mixed step) or "
+                "suppress with the reason the per-shape family must "
+                "stay")
 
     def _check_assign(self, ctx: FileContext, node: ast.Assign):
         key_target = any(isinstance(t, ast.Name)

@@ -690,6 +690,16 @@ class PagedGenerationEngine(GenerationEngine):
         dispatch rebuilds the pools zeroed via ``_ensure_pages``."""
         self._k_pages = self._v_pages = None
 
+    def rebuild_kv_state(self):
+        """Eagerly rebuild the (zeroed) device page pools once serving
+        recovery has replayed every in-flight row, so
+        ``kv_state_lost()`` stops reporting a loss that was already
+        serviced.  Schedulers whose admission only stages host-side
+        state (the ragged mixed step) may not dispatch between the
+        restart and the next failure — a stale lost flag there would
+        re-enter recovery and double-count the restart."""
+        self._ensure_pages()
+
     def _build_paged(self, batch, plen, g: GenerationConfig):
         max_new = g.max_new_tokens
         L = self._num_layers

@@ -175,12 +175,35 @@ class ParallelSelfAttention(Layer):
         suffix-prefill variant: the chunk starts at position
         ``positions[b]`` (cached-prefix length, possibly mid-page) and
         attends over the row's whole gathered page window so cached
-        prefix KV participates — the prefix-cache warm path."""
+        prefix KV participates — the prefix-cache warm path.
+
+        A SIX-element cache ``(k_pages, v_pages, tables, positions,
+        query_lens, scratch_page)`` selects the ragged mixed-batch
+        variant (serving/programs.build_mixed_step): every row carries
+        its own ``(query_len, context_len)``, decode rows have
+        ``query_len == 1`` and chunk rows a prompt slice, all in one
+        launch — positions past a row's ``query_len`` write to the
+        scratch page and are never attended."""
         from ..core.tensor import Tensor
         from ..ops.pallas import paged_attention as PA
 
         b, s = x.shape[0], x.shape[1]
         k_pages, v_pages, tables, positions = (c._data for c in cache[:4])
+        if len(cache) == 6:
+            from ..ops.pallas import ragged_paged_attention as RPA
+
+            qlens = cache[4]._data
+            scratch = cache[5]._data
+            k_pages = RPA.write_ragged_pages(k_pages, tables, k._data,
+                                             positions, qlens, scratch)
+            v_pages = RPA.write_ragged_pages(v_pages, tables, v._data,
+                                             positions, qlens, scratch)
+            out = Tensor(RPA.ragged_paged_attention(
+                q._data, k_pages, v_pages, tables, positions, qlens))
+            out = D("reshape", out, shape=(b, s, self.hidden))
+            out = self.out_proj(out)
+            return out, (Tensor(k_pages), Tensor(v_pages), Tensor(tables),
+                         Tensor(positions + qlens), cache[4], cache[5])
         windowed = len(cache) == 5
         if s > 1 and windowed:
             k_pages = PA.write_chunk_pages(k_pages, tables, k._data,

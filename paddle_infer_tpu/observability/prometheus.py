@@ -287,6 +287,22 @@ def render_prometheus(snapshot: dict,
                          {"kind": kind})
         else:
             w.sample("steplog_records_total", 0, {"kind": "none"})
+        w.family("steplog_steps_by_kernel_total", "counter",
+                 "StepLog scheduler-step records by serving kernel "
+                 "(ragged mixed step vs legacy per-shape programs)")
+        by_kernel = sl.get("by_kernel") or {}
+        if by_kernel:
+            for kernel in sorted(by_kernel):
+                w.sample("steplog_steps_by_kernel_total",
+                         by_kernel[kernel], {"kernel": kernel})
+        else:
+            w.sample("steplog_steps_by_kernel_total", 0,
+                     {"kernel": "none"})
+        w.family("steplog_prefill_chunk_tokens_total", "counter",
+                 "Prompt tokens prefilled through ragged mixed-step "
+                 "chunks (chunked-prefill progress)")
+        w.sample("steplog_prefill_chunk_tokens_total",
+                 sl.get("prefill_chunk_tokens_total", 0))
         w.family("steplog_bytes_estimated_total", "counter",
                  "Analytic bytes-moved attributed across all recorded "
                  "steps")

@@ -51,13 +51,17 @@ from typing import Dict, List, Optional
 _SCHEMA = (
     ("seq", 0),                  # monotone record index (process-local)
     ("ts", 0.0),                 # wall-clock capture time (time.time())
-    ("kind", ""),                # prefill | decode | page_copy | evict
+    ("kind", ""),                # prefill | decode | mixed | page_copy
+                                 # | evict
+    ("kernel", ""),              # ragged | legacy (step-serving records)
     ("wall_s", 0.0),             # whole step event, edge to edge
     ("dispatch_s", 0.0),         # device dispatch + readback sync
     ("host_s", 0.0),             # wall_s - dispatch_s (host bookkeeping)
     ("active_rows", 0),          # occupied slots at capture
     ("decode_rows", 0),          # rows in this fused decode chunk
     ("prefill_tokens", 0),       # uncached suffix tokens prefetched
+    ("prefill_chunk_tokens", 0),  # prompt tokens chunked into this
+                                  # ragged mixed step
     ("chunk_steps", 0),          # fused scan steps (decode) / 1
     ("emitted_tokens", 0),       # tokens delivered to consumers
     ("resident_kv_pages", 0),    # pool pages in use at capture
@@ -141,8 +145,18 @@ class StepCostModel:
             # one page read + one page written, across all layers
             return 2.0 * max(pages, 1) * self._page_kv_bytes, 0.0, \
                 "analytic"
-        kv_moved = pages * self._page_kv_bytes * (chunk if kind == "decode"
-                                                  else 1)
+        if kind == "mixed":
+            # ragged mixed launch: every query token (decode rows
+            # contribute 1, prefill rows their chunk) streams its row's
+            # resident page window once — price it as query tokens ×
+            # per-row resident pages (the even split of the step's
+            # resident set across occupied rows)
+            per_row_pages = pages / max(rows, 1)
+            kv_moved = (max(int(tokens if tokens is not None else rows), 1)
+                        * per_row_pages * self._page_kv_bytes)
+        else:
+            kv_moved = pages * self._page_kv_bytes * (
+                chunk if kind == "decode" else 1)
         frac = (rows / max_rows) if max_rows > 0 else 1.0
         static = self.static_cost(key)
         if static is not None:
@@ -209,6 +223,8 @@ class StepLog:
         self._bytes_total = 0.0
         self._flops_total = 0.0
         self._compile_total = 0
+        self._chunk_tokens_total = 0
+        self._by_kernel: Dict[str, int] = {}
         # (bytes_est, wall_s) for clean decode chunks — the model fit
         self._model: deque = deque(maxlen=int(model_window))
 
@@ -233,6 +249,10 @@ class StepLog:
             self._bytes_total += float(rec["bytes_est"])
             self._flops_total += float(rec["flops_est"])
             self._compile_total += int(rec["compile_events"])
+            self._chunk_tokens_total += int(rec["prefill_chunk_tokens"])
+            if rec["kernel"]:
+                self._by_kernel[rec["kernel"]] = \
+                    self._by_kernel.get(rec["kernel"], 0) + 1
             if rec["kind"] == "decode" and not rec["failed"] \
                     and rec["bytes_est"] > 0.0 and rec["wall_s"] > 0.0:
                 self._model.append((float(rec["bytes_est"]),
@@ -268,6 +288,8 @@ class StepLog:
             self._bytes_total = 0.0
             self._flops_total = 0.0
             self._compile_total = 0
+            self._chunk_tokens_total = 0
+            self._by_kernel = {}
 
     def summary(self) -> Dict:
         with self._lock:
@@ -277,9 +299,11 @@ class StepLog:
                 "ring": len(self._ring),
                 "capacity": self.capacity,
                 "by_kind": dict(self._by_kind),
+                "by_kernel": dict(self._by_kernel),
                 "bytes_est_total": self._bytes_total,
                 "flops_est_total": self._flops_total,
                 "compile_events_total": self._compile_total,
+                "prefill_chunk_tokens_total": self._chunk_tokens_total,
             }
         out["decode_model"] = _model_summary(pairs)
         return out

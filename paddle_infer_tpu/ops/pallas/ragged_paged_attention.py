@@ -1,0 +1,218 @@
+"""Ragged mixed-batch paged attention (cf. PAPERS.md "Ragged Paged
+Attention: A High-Performance and Flexible LLM Inference Kernel for
+TPU").
+
+One launch serves a batch where every row carries its own
+``(query_len, context_len, block_table_row)``: decode rows have
+``query_len == 1``, prefill rows carry a token chunk, inactive rows
+carry ``query_len == 0``.  Each row's queries sit at absolute positions
+``context_len + i`` and attend over the row's paged KV window under an
+absolute-position causal mask — so there is no prompt bucketing and no
+per-plen executable: the executable shape depends only on
+``(batch, query_capacity, max_pages)``.
+
+Two implementations share the public entry point:
+
+* ``_ragged_reference`` (the default) — the exactness path the serving
+  engine runs.  Chunk positions go through the dense constant-window
+  ``prefix_prefill_attention`` math and decode rows (``query_len == 1``)
+  through the ``paged_attention_decode`` kernel — i.e. PRECISELY the two
+  computations the legacy per-program serving path ran, selected per
+  row.  That is what makes mixed-step logits bitwise-identical to the
+  legacy cold prefill + fused decode path on every backend (PR 4's
+  constant-window argument extends row-wise: masked slots contribute
+  exactly zero and the reduce shapes are per-core constants).
+* ``_ragged_kernel_call`` (``use_kernel=True``) — the single-launch
+  Pallas kernel: grid ``(batch, max_pages)`` with the page walk
+  innermost, block tables and per-row lengths in scalar-prefetch SMEM,
+  online-softmax state in VMEM scratch.  One kernel launch covers every
+  row type; decode rows simply have a one-row query block.  Numerically
+  it is an online-softmax reassociation of the reference (allclose, not
+  bitwise), so serving keeps it opt-in until TPU parity runs pin it.
+
+``write_ragged_pages`` is the matching scatter: valid positions
+(``i < query_len``) land at the row's absolute slots, everything else
+is routed to the scratch page no live row ever reads.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .paged_attention import (NEG_INF, _CompilerParams, _interpret,
+                              paged_attention_decode,
+                              prefix_prefill_attention)
+
+
+def write_ragged_pages(pages, block_tables, kv, context_lens, query_lens,
+                       scratch_page):
+    """Scatter a ragged batch's K or V ``[B, C, H, D]`` into the
+    head-major pool.  Row ``b``'s token ``i`` lands at absolute position
+    ``context_lens[b] + i`` when ``i < query_lens[b]``; pad positions
+    (``i >= query_lens[b]``, including whole inactive rows) are routed
+    to ``scratch_page`` — garbage the attention mask never exposes, so
+    rows near the window edge can never clamp into their own live
+    pages.  The caller guarantees ``context_lens + query_lens`` stays
+    inside each row's reserved table window."""
+    b, c, h, d = kv.shape
+    page = pages.shape[2]
+    max_pages = block_tables.shape[1]
+    i = jnp.arange(c, dtype=jnp.int32)[None]                 # [1, C]
+    pos = context_lens[:, None] + i                          # [B, C]
+    valid = i < query_lens[:, None]
+    safe_pos = jnp.where(valid, pos, 0)
+    page_idx = jnp.take_along_axis(
+        block_tables, jnp.clip(safe_pos // page, 0, max_pages - 1), axis=1)
+    page_idx = jnp.where(valid, page_idx,
+                         jnp.asarray(scratch_page, jnp.int32))
+    slot = jnp.where(valid, safe_pos % page, i % page)
+    return pages.at[page_idx, :, slot].set(kv.astype(pages.dtype))
+
+
+def _ragged_reference(q, k_pages, v_pages, block_tables, context_lens,
+                      query_lens, scale=None):
+    """Per-row-type exact composition (see module docstring): the row's
+    first query position is replaced by the decode kernel's output when
+    ``query_lens == 1``, all other positions keep the dense
+    constant-window prefix math.  Positions ``i >= query_lens`` hold
+    garbage the caller must never read (it samples at
+    ``query_lens - 1``)."""
+    out = prefix_prefill_attention(q, k_pages, v_pages, block_tables,
+                                   context_lens, scale=scale)
+    dec = paged_attention_decode(q[:, 0], k_pages, v_pages, block_tables,
+                                 context_lens + 1, scale=scale)
+    is_decode = (query_lens == 1)[:, None, None]
+    first = jnp.where(is_decode, dec, out[:, 0])
+    return out.at[:, 0].set(first)
+
+
+# ------------------------------------------------------------------ kernel
+
+def _ragged_kernel(ctx_ref, qlen_ref, tables_ref,    # scalar prefetch
+                   q_ref, k_ref, v_ref,              # blocks (VMEM)
+                   o_ref,                            # output block
+                   m_ref, l_ref, acc_ref,            # VMEM scratch
+                   *, scale, page_size, max_pages):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    ctx = ctx_ref[b]
+    qlen = qlen_ref[b]
+
+    # the row's window after this step's writes is ctx + qlen tokens;
+    # pages past it (and whole rows with qlen == 0) are skipped — the
+    # ragged win: the DMA walk stops at the row's own length
+    @pl.when(jnp.logical_and(qlen > 0, j * page_size < ctx + qlen))
+    def _():
+        q = q_ref[0].astype(jnp.float32)             # [C, H, D]
+        k = k_ref[0].astype(jnp.float32)             # [H, page, D]
+        v = v_ref[0].astype(jnp.float32)             # [H, page, D]
+        # scores for every (query, head, slot): [C, H, page]
+        s = jnp.sum(q[:, :, None, :] * k[None], axis=3) * scale
+        # absolute-position causal mask: slot w visible to query i when
+        # w <= ctx + i (the same predicate the reference path uses)
+        slot = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 2)
+        qpos = ctx + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        s = jnp.where(slot <= qpos, s, NEG_INF)
+
+        m_prev = m_ref[:][:, :, None]                # [C, H, 1]
+        l_prev = l_ref[:][:, :, None]
+        m_cur = jnp.max(s, axis=2, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                       # [C, H, page]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=2, keepdims=True)
+        pv = jnp.sum(p[:, :, :, None] * v[None], axis=2)   # [C, H, D]
+        acc_ref[:] = acc_ref[:] * alpha[:, :, 0][:, :, None] + pv
+        m_ref[:] = m_new[:, :, 0]
+        l_ref[:] = l_new[:, :, 0]
+
+    @pl.when(j == max_pages - 1)
+    def _():
+        l = jnp.maximum(l_ref[:], 1e-20)             # [C, H]
+        o_ref[0] = (acc_ref[:] / l[:, :, None]).astype(o_ref.dtype)
+
+
+def _ragged_kernel_call(q, k_pages, v_pages, block_tables, context_lens,
+                        query_lens, scale=None, interpret=None):
+    interpret = _interpret() if interpret is None else interpret
+    b, c, h, d = q.shape
+    num_pages, kh, page_size, kd = k_pages.shape
+    assert (kh, kd) == (h, d), (k_pages.shape, q.shape)
+    max_pages = block_tables.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    context_lens = context_lens.astype(jnp.int32)
+    query_lens = query_lens.astype(jnp.int32)
+    block_tables = block_tables.astype(jnp.int32)
+
+    def q_map(b_, j_, ctx_s, qlen_s, tables_s):
+        return (b_, 0, 0, 0)
+
+    def kv_map(b_, j_, ctx_s, qlen_s, tables_s):
+        return (tables_s[b_, j_], 0, 0, 0)
+
+    kernel = functools.partial(
+        _ragged_kernel, scale=scale, page_size=page_size,
+        max_pages=max_pages)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, c, h, d), q_map),
+            pl.BlockSpec((1, h, page_size, d), kv_map),
+            pl.BlockSpec((1, h, page_size, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, c, h, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((c, h), jnp.float32),
+            pltpu.VMEM((c, h), jnp.float32),
+            pltpu.VMEM((c, h, d), jnp.float32),
+        ],
+    )
+    fn = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, c, h, d), q.dtype),
+        interpret=interpret,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )
+    return fn(context_lens, query_lens, block_tables, q, k_pages, v_pages)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, block_tables,
+                           context_lens, query_lens, scale=None,
+                           use_kernel=False, interpret=None):
+    """Mixed-batch ragged attention over paged KV.
+
+    q            [B, C, H, D]   — per-row query chunk (C = capacity;
+                                  row b uses positions 0..query_lens[b])
+    k_pages      [P, H, page, D] — shared head-major pool
+    v_pages      [P, H, page, D]
+    block_tables [B, max_pages] int32
+    context_lens [B] int32      — tokens already cached per row
+    query_lens   [B] int32      — 1 = decode, >1 = prefill chunk,
+                                  0 = inactive row
+    → [B, C, H, D]; positions past ``query_lens`` hold garbage.
+
+    ``use_kernel=False`` (default) runs the bitwise-exact reference
+    composition the serving engine's parity guarantee rests on;
+    ``use_kernel=True`` runs the single-launch Pallas kernel (allclose
+    to the reference — the TPU fast path)."""
+    if use_kernel:
+        return _ragged_kernel_call(q, k_pages, v_pages, block_tables,
+                                   context_lens, query_lens, scale=scale,
+                                   interpret=interpret)
+    return _ragged_reference(q, k_pages, v_pages, block_tables,
+                             context_lens, query_lens, scale=scale)

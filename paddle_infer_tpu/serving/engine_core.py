@@ -25,6 +25,17 @@ There is no stop-the-world: admission, decode and eviction interleave
 at chunk granularity, and per-row sampling parameters live in arrays
 (serving/programs.py) so none of it ever recompiles the hot loop.
 
+Ragged mode (the default): steps 3–4 collapse into ONE mixed-step
+launch.  Admission only stages KV and queues the prompt as ``pending``
+token slices; every scheduler step then packs live decode rows (one
+token each, packed first) plus up to ``prefill_chunk`` pending prompt
+tokens per row under a per-step ``token_budget`` into a single ragged
+executable (serving/programs.build_mixed_step, backed by
+ops/pallas/ragged_paged_attention), so a long prompt interleaves with
+decode instead of stalling it and one executable serves every batch
+composition.  ``ragged=False`` restores the legacy per-plen /
+per-chunk program families.
+
 Slot/pool layout: slot ``s`` (0..max_batch-1) reserves native-pool
 sequence id ``s``; a one-page scratch reservation (seq id max_batch)
 backs every table entry of inactive rows, so their garbage writes land
@@ -47,8 +58,8 @@ from ..observability import Tracer, get_compile_log
 from ..observability.steplog import StepCostModel, StepLog
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
-from .programs import (build_decode, build_page_copy, build_prefill,
-                       build_prefix_prefill)
+from .programs import (build_decode, build_mixed_step, build_page_copy,
+                       build_prefill, build_prefix_prefill)
 from .request import (DeadlineExceededError, LoadShedError, QuarantinedError,
                       QueueFullError, RejectedError, Request, RequestQueue,
                       RequestState)
@@ -80,7 +91,10 @@ class EngineCore:
                  enable_prefix_cache: bool = False,
                  prefix_cache_watermark: float = 0.5,
                  fault_plane=None,
-                 steplog: Optional[StepLog] = None):
+                 steplog: Optional[StepLog] = None,
+                 ragged: bool = True,
+                 prefill_chunk: Optional[int] = None,
+                 token_budget: Optional[int] = None):
         self._engine = engine
         self._max_batch = int(max_batch)
         # resilience plumbing (serving/resilience/): the fault plane is
@@ -110,6 +124,30 @@ class EngineCore:
         # worst-case reservation (page-padded prompt or prompt+max_new)
         self._max_pages = _round_up(self._max_model_len, page) // page
         self._plen_cap = self._max_pages * page
+
+        # ragged mixed-step scheduling (the default): ONE executable
+        # keyed by (max_batch, token_budget, max_pages) serves every
+        # batch composition — each row of a step carries its own
+        # (query_len, context_len), so decode rows and prompt chunks
+        # share a launch and nothing is ever padded to a prompt bucket.
+        # Prompts longer than ``prefill_chunk`` are admitted as token
+        # slices spread over successive steps under the per-step
+        # ``token_budget``, so a long prompt arrival no longer stalls
+        # streaming decode rows (docs/SERVING.md "Ragged attention and
+        # chunked prefill").  ``ragged=False`` keeps the legacy
+        # per-(plen|batch,chunk) program zoo.
+        self._ragged = bool(ragged)
+        if self._ragged:
+            budget = int(token_budget or min(self._plen_cap,
+                                             max(4 * page, 32)))
+            # every active row must at least fit its decode token
+            budget = max(2, self._max_batch, min(budget, self._plen_cap))
+            self._token_budget = budget
+            chunk = int(prefill_chunk or budget)
+            self._prefill_chunk = max(1, min(chunk, budget))
+        else:
+            self._token_budget = 0
+            self._prefill_chunk = 0
 
         engine.refresh_params()
         self._pool = engine.serving_pool(
@@ -405,7 +443,10 @@ class EngineCore:
             progressed = True
 
         if self.active_count:
-            self._decode_step()
+            if self._ragged:
+                self._mixed_step()
+            else:
+                self._decode_step()
             progressed = True
         elif not progressed and wait_s > 0:
             self._queue.wait(wait_s)
@@ -413,6 +454,11 @@ class EngineCore:
 
     # --------------------------------------------------------- admission
     def _plen(self, length: int) -> int:
+        if self._ragged:
+            # ragged mode pads nothing: the mixed step's shape depends
+            # only on (max_batch, token_budget), so the "padded" suffix
+            # IS the suffix and reservations are exact
+            return max(int(length), 1)
         plen = _round_up(max(length, 1), self._engine._prompt_bucket)
         plen = _round_up(min(plen, self._plen_cap), self._page)
         return max(plen, _round_up(length, self._page))
@@ -601,6 +647,7 @@ class EngineCore:
                                  slot=sid, outcome="failed")
             self.steplog.record(
                 "prefill", wall_s=now - admit_t, host_s=now - admit_t,
+                kernel="ragged" if self._ragged else "legacy",
                 active_rows=self.active_count,
                 resident_kv_pages=self._used_pages(),
                 compile_events=clog.count() - c0, failed=True,
@@ -609,9 +656,6 @@ class EngineCore:
             self._admit_failure(req, e)
             return
         suffix = length - cached
-        plen = self._plen(suffix)
-        ids = np.full((1, plen), g.pad_token_id, np.int32)
-        ids[0, :suffix] = full[cached:]
         table = np.full((self._max_pages,), self._scratch, np.int32)
         t = self._pool.block_table(sid)[:self._max_pages]
         # intentional host work at admission: the block table and the
@@ -621,6 +665,47 @@ class EngineCore:
         # tpulint: disable-next-line=host-sync
         key = np.asarray(
             jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))
+        if self._ragged:
+            # ragged admission stages KV only: the uncached suffix waits
+            # in ``pending`` and enters the NEXT mixed steps as
+            # prefill_chunk-sized slices sharing launches with live
+            # decode rows.  The prefill.run fault site still fires at
+            # admission so injected prefill faults keep routing through
+            # the admission-failure/replay path.
+            try:
+                self._fault.fire("prefill.run", rid=req.rid)
+            except Exception as e:
+                self._release_slot_kv(sid, match)
+                now = time.monotonic()
+                self.tracer.add_span(req.rid, "prefill", admit_t, now,
+                                     slot=sid, outcome="failed")
+                self.steplog.record(
+                    "prefill", wall_s=now - admit_t, host_s=now - admit_t,
+                    prefill_tokens=suffix, kernel="ragged",
+                    active_rows=self.active_count,
+                    resident_kv_pages=self._used_pages(),
+                    prefix_hit_pages=len(match.blocks) if match else 0,
+                    compile_events=clog.count() - c0, failed=True,
+                    retries=req.retries,
+                    degraded=self._effective_max_batch < self._max_batch)
+                self._admit_failure(req, e)
+                return
+            req._mark_active()
+            self._slots[sid] = {
+                "req": req, "sid": sid, "g": g,
+                "length": int(req.prompt.size), "plen": suffix,
+                "emitted": already, "steps_base": already,
+                "last_tok": 0, "last_emit": admit_t,
+                "table": table, "key": key, "match": match,
+                "span_end": prefill_t, "full": full,
+                # host-side numpy slice of the staged prompt, no device sync
+                # tpulint: disable-next-line=host-sync
+                "pending": np.asarray(full[cached:], np.int32),
+                "ctx": int(cached)}
+            return
+        plen = self._plen(suffix)
+        ids = np.full((1, plen), g.pad_token_id, np.int32)
+        ids[0, :suffix] = full[cached:]
         steps0 = np.asarray([already], np.int32)
         span_name = "prefill" if cache is None else "suffix_prefill"
         t_run0 = time.monotonic()
@@ -653,7 +738,7 @@ class EngineCore:
             self.tracer.add_span(req.rid, span_name, prefill_t, now,
                                  slot=sid, plen=plen, outcome="failed")
             self.steplog.record(
-                "prefill", wall_s=now - admit_t,
+                "prefill", wall_s=now - admit_t, kernel="legacy",
                 dispatch_s=now - t_run0, prefill_tokens=suffix,
                 prefix_hit_pages=len(match.blocks) if match else 0,
                 active_rows=self.active_count,
@@ -688,7 +773,7 @@ class EngineCore:
             "prefill", pkey, rows=1, max_rows=1,
             pages_touched=-(-reserve // self._page), tokens=plen)
         self.steplog.record(
-            "prefill", wall_s=span_end - admit_t,
+            "prefill", wall_s=span_end - admit_t, kernel="legacy",
             dispatch_s=t_sync - t_run0,
             host_s=(span_end - admit_t) - (t_sync - t_run0),
             active_rows=self.active_count, prefill_tokens=suffix,
@@ -758,6 +843,11 @@ class EngineCore:
                 self._replay_or_fail_slot(s, err, kv_intact=False)
         if self._prefix_cache is not None:
             self._prefix_cache.clear()
+        # the loss is serviced: rebuild the pools (zeroed) NOW so a
+        # later admission failure doesn't read the stale lost flag and
+        # re-enter recovery (ragged admissions stage host-side state
+        # only, so no dispatch clears it in between)
+        self._engine.rebuild_kv_state()
 
     def _replay_or_fail(self, req: Request, err: BaseException):
         """Requeue ``req`` for replay at the queue head if the recovery
@@ -805,14 +895,23 @@ class EngineCore:
         if rec is not None and rec.request_should_replay(req, err):
             self._slots[s["sid"]] = None
             retain = None
+            pending = s.get("pending")
+            mid_prefill = pending is not None and len(pending) > 0
             if kv_intact and self._prefix_cache is not None:
-                # KV for prompt + all-but-the-last delivered token is
-                # valid in the row's pages (the last token's KV is never
-                # written until its decode step runs)
-                retain = np.concatenate(
-                    # req.tokens is a host-side list — no readback
-                    # tpulint: disable-next-line=host-sync
-                    [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
+                if mid_prefill:
+                    # ragged row mid-prefill: only the cached prefix plus
+                    # the chunks consumed so far have valid KV — the
+                    # pending suffix was never written
+                    retain = (s["full"][:s["ctx"]]
+                              if s.get("ctx", 0) > 0 else None)
+                else:
+                    # KV for prompt + all-but-the-last delivered token is
+                    # valid in the row's pages (the last token's KV is
+                    # never written until its decode step runs)
+                    retain = np.concatenate(
+                        # req.tokens is a host-side list — no readback
+                        # tpulint: disable-next-line=host-sync
+                        [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
             self._release_slot_kv(s["sid"], s.get("match"),
                                   retain_tokens=retain,
                                   salt=req.cache_salt)
@@ -833,6 +932,225 @@ class EngineCore:
         else:
             ferr = RejectedError(f"in-flight KV state lost: {err!r}")
         self._evict(s, RequestState.FAILED, ferr)
+
+    # -------------------------------------------------- ragged mixed step
+    def _mixed_step(self):
+        """ONE ragged launch per scheduler step, whatever the batch
+        composition: decode rows feed their last token (query_len 1),
+        prompt rows feed their next ``prefill_chunk``-sized slice, all
+        under the per-step ``token_budget``.  Decode rows are packed
+        first so a long prompt arrival can never starve streaming
+        clients — the prompt takes whatever budget is left each step.
+        The executable key is composition-independent, so after one
+        warmup compile every mix of cold chunks, warm-prefix suffixes
+        and decode rows reuses it (CompileLog proves it in the
+        composition fuzz)."""
+        active = [s for s in self._slots if s is not None]
+        b = self._max_batch
+        C = self._token_budget
+        ids = np.zeros((b, C), np.int32)
+        qlens = np.zeros((b,), np.int32)
+        ctx = np.zeros((b,), np.int32)
+        steps0 = np.zeros((b,), np.int32)
+        sample_now = np.zeros((b,), bool)
+        tables = np.full((b, self._max_pages), self._scratch, np.int32)
+        keys = np.zeros((b,) + active[0]["key"].shape,
+                        active[0]["key"].dtype)
+        cfgs: List[Optional[GenerationConfig]] = [None] * b
+        decode_rows = [s for s in active if s["pending"].size == 0]
+        chunk_rows = [s for s in active if s["pending"].size > 0]
+        budget = C
+        chunk_taken = {}
+        for s in decode_rows:
+            i = s["sid"]
+            ids[i, 0] = s["last_tok"]
+            qlens[i] = 1
+            # same position algebra as the legacy fused decode: the fed
+            # token's KV lands at length + emitted - 1
+            ctx[i] = s["length"] + s["emitted"] - 1
+            steps0[i] = s["emitted"]
+            sample_now[i] = True
+            tables[i] = s["table"]
+            keys[i] = s["key"]
+            cfgs[i] = s["g"]
+            budget -= 1
+        for s in chunk_rows:
+            i = s["sid"]
+            n = min(self._prefill_chunk, budget, int(s["pending"].size))
+            if n <= 0:
+                continue        # budget spent: the row waits this step
+            ids[i, :n] = s["pending"][:n]
+            qlens[i] = n
+            ctx[i] = s["ctx"]
+            steps0[i] = s["emitted"]
+            # only the chunk holding the prompt's last token samples;
+            # mid-prompt chunks return the pad id and emit nothing
+            sample_now[i] = n == int(s["pending"].size)
+            tables[i] = s["table"]
+            keys[i] = s["key"]
+            cfgs[i] = s["g"]
+            budget -= n
+            chunk_taken[i] = n
+        prefill_tokens_step = sum(chunk_taken.values())
+        n_decode = len(decode_rows)
+        eng = self._engine
+        mkey = ("serve-step", b, C, self._max_pages,
+                self._pool.num_blocks)
+        clog = get_compile_log()
+        c0 = clog.count()
+        t0 = time.monotonic()
+        try:
+            fault = self._fault.fire(
+                "decode.step", rids=[s["req"].rid for s in active])
+            tok, fin_out = eng.run_paged_program(
+                mkey, lambda: build_mixed_step(eng, b, C,
+                                               self._max_pages),
+                ids, qlens, ctx, steps0, sample_now, tables,
+                self._samp_arrays(cfgs), keys,
+                # scratch page id is a host int, no device sync
+                # tpulint: disable-next-line=host-sync
+                np.asarray(self._scratch, np.int32))
+        except Exception as e:
+            self._metrics.on_failed(0)
+            # same contract as the legacy chunk: only a pre-dispatch
+            # injection provably leaves the donated pools intact
+            injected = isinstance(e, (InjectedFault, InjectedMemoryError))
+            self.steplog.record(
+                "mixed" if chunk_taken and n_decode else
+                ("prefill" if chunk_taken else "decode"),
+                wall_s=time.monotonic() - t0,
+                active_rows=len(active), decode_rows=n_decode,
+                chunk_steps=1, prefill_tokens=prefill_tokens_step,
+                prefill_chunk_tokens=prefill_tokens_step,
+                kernel="ragged",
+                resident_kv_pages=self._used_pages(),
+                compile_events=clog.count() - c0, faults=injected,
+                retries=sum(s["req"].retries for s in active),
+                failed=True,
+                degraded=self._effective_max_batch < self._max_batch)
+            if getattr(e, "lose_kv", False) or not injected:
+                self._engine.drop_kv_state()
+            rec = self._recovery
+            if rec is not None:
+                rec.on_engine_failure(e)
+            if self._engine.kv_state_lost():
+                self._recover_lost_state(e)
+            else:
+                for s in list(self._slots):
+                    if s is not None:
+                        self._replay_or_fail_slot(s, e, kv_intact=True)
+            return
+        wall = time.monotonic() - t0
+        if not self._decode_warm:
+            # one executable for EVERY composition: after this, any
+            # compile on the serving-decode site is a recompile
+            get_compile_log().mark_warm("serving-decode", mkey)
+            self._decode_warm = True
+        # the one designed sync per step
+        # tpulint: disable-next-line=host-sync
+        tok = np.asarray(tok)
+        # tpulint: disable-next-line=host-sync
+        fin_out = np.asarray(fin_out)
+        t_sync = time.monotonic()
+        resident = self._used_pages()
+        prefix_hits = sum(len(s["match"].blocks)
+                          if s.get("match") is not None else 0
+                          for s in active)
+        poisoned = set()
+        if fault is not None and fault.get("nan_rids"):
+            # injected NaN/inf logits poison the whole row (sampled or
+            # mid-chunk) — quarantine it below, exactly like the legacy
+            # path's non-finite sentinel
+            poisoned = set(fault["nan_rids"])
+        self._step_idx += 1
+        emitted_decode = 0
+        emitted_prefill = 0
+        evicted = []
+        now = time.monotonic()
+        span_name = ("prefill" if self._prefix_cache is None
+                     else "suffix_prefill")
+        for s in active:
+            i = s["sid"]
+            req = s["req"]
+            if qlens[i] == 0:
+                continue            # starved chunk row: untouched
+            was_chunk = i in chunk_taken
+            if was_chunk:
+                n = chunk_taken[i]
+                s["pending"] = s["pending"][n:]
+                s["ctx"] += n
+            sampled = bool(sample_now[i])
+            t = int(tok[i]) if sampled else 0
+            if req.rid in poisoned or (sampled and t < 0):
+                self._metrics.on_quarantined()
+                self._evict(s, RequestState.FAILED, QuarantinedError(
+                    f"request {req.rid} quarantined: non-finite logits "
+                    f"in mixed step {self._step_idx}"))
+                evicted.append(req.rid)
+                continue
+            if was_chunk:
+                self.tracer.add_span(
+                    req.rid, span_name, s.get("span_end", t0), now,
+                    slot=i, plen=chunk_taken[i],
+                    cached_tokens=int(s["ctx"]) - chunk_taken[i],
+                    replay=req.retries)
+                s["span_end"] = now
+                if sampled:
+                    # prefill complete: this chunk held the prompt's
+                    # last token and sampled the row's next token
+                    if s["steps_base"] == 0:
+                        self._metrics.on_prefill(now - req.arrival)
+                    req._emit(np.asarray([t], np.int32))
+                    self._metrics.on_tokens(1)
+                    s["emitted"] += 1
+                    s["last_tok"] = t
+                    s["last_emit"] = now
+                    emitted_prefill += 1
+            else:
+                req._emit(np.asarray([t], np.int32))
+                s["emitted"] += 1
+                s["last_tok"] = t
+                s["last_emit"] = now
+                emitted_decode += 1
+                self.tracer.add_span(req.rid, "decode",
+                                     s.get("span_end", t0), now,
+                                     step=self._step_idx, chunk_steps=1,
+                                     tokens=1)
+                s["span_end"] = now
+            if sampled and (bool(fin_out[i])
+                            or s["emitted"] >= s["g"].max_new_tokens):
+                self._evict(s, RequestState.DONE)
+                evicted.append(req.rid)
+        if emitted_decode:
+            self._metrics.on_tokens(emitted_decode, itl_s=wall)
+        self._metrics.on_step(wall * 1e3, len(active), b)
+        self.step_trace.append({
+            "step": self._step_idx, "batch_steps": 1,
+            "active": [s["req"].rid for s in active],
+            "evicted": evicted})
+        kind = ("mixed" if chunk_taken and n_decode else
+                ("prefill" if chunk_taken else "decode"))
+        bts, fl, src_tag = self._cost_model.estimate(
+            kind, mkey, rows=len(active), max_rows=b,
+            pages_touched=resident, chunk=1,
+            tokens=n_decode + prefill_tokens_step)
+        end = time.monotonic()
+        self.steplog.record(
+            kind, wall_s=end - t0, dispatch_s=t_sync - t0,
+            host_s=end - t_sync, active_rows=len(active),
+            decode_rows=n_decode, chunk_steps=1,
+            prefill_tokens=prefill_tokens_step,
+            prefill_chunk_tokens=prefill_tokens_step,
+            kernel="ragged",
+            emitted_tokens=emitted_decode + emitted_prefill,
+            resident_kv_pages=resident,
+            prefix_hit_pages=prefix_hits, bytes_est=bts, flops_est=fl,
+            cost_source=src_tag, compile_events=clog.count() - c0,
+            faults=fault is not None,
+            retries=sum(s["req"].retries for s in active),
+            degraded=self._effective_max_batch < self._max_batch)
+        if self._recovery is not None:
+            self._recovery.on_step_ok()
 
     # ------------------------------------------------------------ decode
     def _decode_step(self):
@@ -885,7 +1203,7 @@ class EngineCore:
             # garbage), so KV-intact replay is reserved for injections
             injected = isinstance(e, (InjectedFault, InjectedMemoryError))
             self.steplog.record(
-                "decode", wall_s=time.monotonic() - t0,
+                "decode", wall_s=time.monotonic() - t0, kernel="legacy",
                 active_rows=len(active), decode_rows=len(active),
                 chunk_steps=S, resident_kv_pages=self._used_pages(),
                 compile_events=clog.count() - c0, faults=injected,
@@ -990,7 +1308,7 @@ class EngineCore:
         self.steplog.record(
             "decode", wall_s=end - t0, dispatch_s=t_sync - t0,
             host_s=end - t_sync, active_rows=len(active),
-            decode_rows=len(active), chunk_steps=S,
+            kernel="legacy", decode_rows=len(active), chunk_steps=S,
             emitted_tokens=emitted_total, resident_kv_pages=resident,
             prefix_hit_pages=prefix_hits, bytes_est=bts, flops_est=fl,
             cost_source=src_tag, compile_events=clog.count() - c0,

@@ -89,6 +89,69 @@ def _pick_rows(proc, samp, steps, keys):
     return jnp.where(samp["do_sample"], sampled, greedy).astype(jnp.int32)
 
 
+def build_mixed_step(engine, max_batch, token_budget, max_pages):
+    """THE ragged serving executable: one launch per scheduler step,
+    whatever the batch composition.  Row ``b`` carries ``qlens[b]``
+    query tokens starting at absolute position ``ctx[b]`` — 1 for a
+    decode row (``ids[b, 0]`` is its last emitted token), >1 for a
+    prefill chunk (a slice of the prompt), 0 for an inactive row (all
+    table entries at the scratch page).  The executable's shape depends
+    only on ``(max_batch, token_budget, max_pages, pool)``: no plen
+    buckets, no per-(batch, chunk) decode family, so after ONE warmup
+    compile every mix of cold chunks, warm-prefix suffixes and decode
+    rows reuses it.
+
+    ``run(params, ids[b, C], qlens[b], ctx[b], steps0[b],
+    sample_now[b], tables[b, max_pages], samp, keys[b, 2], scratch[],
+    k_pages, v_pages)`` → ``(tok[b], fin[b], k_pages, v_pages)``;
+    pools are donated.
+
+    Sampling: each row's next-token logits sit at chunk position
+    ``qlens - 1`` (for decode rows that is position 0 — exactly the
+    legacy fused-decode read).  ``sample_now`` is False for
+    non-final prefill chunks: their row emits no token this step (the
+    pad id is returned and the engine ignores it).  ``steps0`` is the
+    sampled token's generation-step index, so the ``fold_in`` RNG
+    stream and the min-length window are IDENTICAL to the legacy
+    per-program path — that, plus the attention composition in
+    ``ops/pallas/ragged_paged_attention.py`` reusing the legacy paths'
+    exact math per row type, is the bitwise-parity guarantee."""
+    L = engine._num_layers
+    C = token_budget
+
+    def run(params, ids, qlens, ctx, steps0, sample_now, tables, samp,
+            keys, scratch, k_pages, v_pages):
+        b = ids.shape[0]
+        caches = [(k_pages[i], v_pages[i], tables, ctx, qlens, scratch)
+                  for i in range(L)]
+        i2d = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32)[None],
+                               (b, C))
+        # pad positions pin to 0: a replayed decode row near the window
+        # edge would push ``ctx + i`` past max_position_embeddings,
+        # where the embedding gather fills NaN — the pad K/V then plants
+        # NaN in the scratch page and 0-weight * NaN poisons every row
+        # whose table carries scratch filler.  Pad K/V is never
+        # attended, so valid logits are bitwise unchanged.
+        pos2d = jnp.where(i2d < qlens[:, None], ctx[:, None] + i2d, 0)
+        logits, caches = engine._model_step(params, ids, pos2d, None,
+                                            caches)
+        last = jnp.take_along_axis(
+            logits, jnp.maximum(qlens - 1, 0)[:, None, None], axis=1)[:, 0]
+        proc = _process_rows(last, samp, steps0)
+        tok = _pick_rows(proc, samp, steps0, keys)
+        tok = jnp.where(sample_now, tok, samp["pad"])
+        fin = jnp.logical_and(
+            sample_now,
+            jnp.logical_and(samp["eos"] >= 0, tok == samp["eos"]))
+        return (tok, fin,
+                [c[0] for c in caches], [c[1] for c in caches])
+
+    return jax.jit(run, donate_argnums=(10, 11))
+
+
+# legacy ragged=False path: one executable per plen bucket is the
+# pre-ragged contract, bounded by the bucketing in EngineCore._plen
+# tpulint: disable-next-line=recompile-hazard
 def build_prefill(engine, plen, max_pages):
     """Prefill one request (batch of 1) into its reserved pages and pick
     the first token.  ``run(params, ids[1,plen], lengths[1], steps0[1],
@@ -123,6 +186,9 @@ def build_prefill(engine, plen, max_pages):
     return jax.jit(run, donate_argnums=(7, 8))
 
 
+# legacy ragged=False path: the per-plen windowed family is kept as
+# the bitwise-parity anchor the ragged reference composes against
+# tpulint: disable-next-line=recompile-hazard
 def build_prefix_prefill(engine, plen, max_pages):
     """Windowed suffix prefill for prefix-cache hits: the row's first
     ``offsets[0]`` positions already hold cached KV (shared blocks mapped
@@ -182,6 +248,9 @@ def build_page_copy(engine):
     return jax.jit(run, donate_argnums=(3, 4))
 
 
+# legacy ragged=False path: batch/chunk are fixed core config here,
+# so the family stays a single executable per core
+# tpulint: disable-next-line=recompile-hazard
 def build_decode(engine, batch, chunk, max_pages):
     """One fused decode chunk over ALL batch rows: a ``lax.scan`` of
     ``chunk`` steps (amortizing host dispatch), each feeding every row's

@@ -396,20 +396,21 @@ def test_mid_decode_failure_releases_all_blocks(make_core, engine,
     assert again.error is None
 
 
-def test_prefill_failure_releases_match(make_core, engine, monkeypatch):
-    core = make_core()
+@pytest.mark.parametrize("ragged", [True, False])
+def test_prefill_failure_releases_match(make_core, ragged):
+    """A prefill failure on a warm-hit admission must release the
+    request's pins while leaving the tree intact.  Injected via the
+    ``prefill.run`` fault site — the one prefill hook both serving
+    kernels share (the legacy path fires it before the suffix-prefill
+    dispatch, the ragged path at KV staging)."""
+    from paddle_infer_tpu.serving import FaultPlane, FaultSpec
+
+    core = make_core(ragged=ragged, fault_plane=FaultPlane(
+        [FaultSpec("prefill.run", at=2)]))
     prompt = _prompt(8, 20)
     (warm,) = core.submit(prompt, GenerationConfig(max_new_tokens=4))
     _drive(core, [warm])
     held = core.prefix_cache.stats_snapshot()["cached_blocks"]
-    real = engine.run_paged_program
-
-    def boom(key, builder, *args):
-        if isinstance(key, tuple) and key and key[0] == "serve-prefill-px":
-            raise RuntimeError("injected prefill failure")
-        return real(key, builder, *args)
-
-    monkeypatch.setattr(engine, "run_paged_program", boom)
     (req,) = core.submit(prompt, GenerationConfig(max_new_tokens=4))
     core.run_once()
     assert req.done and req.error is not None

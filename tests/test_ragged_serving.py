@@ -1,0 +1,419 @@
+"""Ragged mixed-batch paged attention + chunked prefill scheduling
+(paddle_infer_tpu/ops/pallas/ragged_paged_attention.py + the ragged
+EngineCore scheduler).
+
+Three layers of coverage:
+
+* kernel level — ``write_ragged_pages`` scratch routing, and the
+  single-launch Pallas kernel vs the exact reference composition
+  (allclose: the online softmax reassociates);
+* parity — ragged serving streams bitwise-equal to the legacy
+  per-program path for greedy AND seeded-sampled requests, including
+  warm prefix-cache hits and supervisor replay after KV loss.  Sampled
+  comparisons pin the request-id counter: per-request sampling keys are
+  ``fold_in(PRNGKey(seed), rid)``, so the two runs must hand out the
+  same rids;
+* composition fuzz — 160+ scheduler steps of random arrivals (chunked
+  long prompts, decode, mixed, drained-idle) with pool invariants
+  checked every step and ZERO new XLA compiles after the one-step
+  warmup: the whole point of the ragged executable is that batch
+  composition is data, not shape.
+"""
+import itertools
+import random
+
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.inference.generation import (GenerationConfig,
+                                                   PagedGenerationEngine)
+from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_infer_tpu.serving import (EngineCore, EngineSupervisor,
+                                      FaultPlane, FaultSpec, RequestState)
+from paddle_infer_tpu.serving import request as request_mod
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _meshless():
+    """Ragged-vs-legacy parity compares tokens across differently-shaped
+    executables, which is bitwise only when both run unsharded — clear
+    any hybrid mesh a failing test in another module leaked behind
+    (ops consult ``topology.get_current_mesh()`` at call time)."""
+    from paddle_infer_tpu.parallel import topology
+
+    prev = topology.get_current_mesh()
+    topology.set_current_mesh(None)
+    yield
+    topology.set_current_mesh(prev)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _isolated_compile_log():
+    """Process-singleton CompileLog: warm marks left by other modules'
+    cores (same site/key shapes, different engines) would count this
+    module's first compiles as post-warmup recompiles — and vice
+    versa."""
+    from paddle_infer_tpu.observability import get_compile_log
+    get_compile_log().reset()
+    yield
+    get_compile_log().reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    pit.seed(0)
+    m = GPTForCausalLM(GPTConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0))
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    return PagedGenerationEngine(model, page_size=8)
+
+
+@pytest.fixture(scope="module")
+def ref(model):
+    """Separate reference engine — direct generate() on a core-owned
+    engine would corrupt its slot reservations."""
+    return PagedGenerationEngine(model, page_size=8)
+
+
+# Every core in this module runs the same (max_batch, max_model_len,
+# token_budget) so the handful of serving executables (and the one page
+# pool size) compile once and every later test reuses them — the module
+# exercises scheduling and parity, not shape coverage.
+CORE_SHAPE = dict(max_batch=3, max_model_len=48, token_budget=16,
+                  prefill_chunk=16)
+
+
+@pytest.fixture
+def make_core(engine):
+    cores = []
+
+    def make(**kw):
+        for k, v in CORE_SHAPE.items():
+            kw.setdefault(k, v)
+        kw.setdefault("decode_chunk", 4)
+        core = EngineCore(engine, **kw)
+        cores.append(core)
+        return core
+
+    yield make
+    for c in cores:
+        c.close()
+
+
+def _drive(core, reqs, max_iters=400):
+    for _ in range(max_iters):
+        if all(r.done for r in reqs):
+            return
+        core.run_once()
+    raise AssertionError("requests did not finish")
+
+
+def _prompt(seed, n=8):
+    return np.random.RandomState(seed).randint(0, 96, (n,)).astype(np.int32)
+
+
+# ------------------------------------------------------------------ kernel
+
+def test_write_ragged_pages_routes_pads_to_scratch():
+    """Valid positions land at each row's absolute slots; pad positions
+    (i >= query_len, including whole inactive rows) go to the scratch
+    page — never clamped into a live page."""
+    import jax.numpy as jnp
+
+    from paddle_infer_tpu.ops.pallas.ragged_paged_attention import (
+        write_ragged_pages)
+
+    page, h, d, c = 4, 1, 2, 6
+    pages = jnp.zeros((6, h, page, d), jnp.float32)
+    tables = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+    scratch = 5
+    ctx = jnp.asarray([2, 0], jnp.int32)
+    qlens = jnp.asarray([3, 0], jnp.int32)
+    kv = jnp.arange(2 * c * h * d, dtype=jnp.float32).reshape(2, c, h, d)
+
+    out = np.asarray(write_ragged_pages(pages, tables, kv, ctx, qlens,
+                                        scratch))
+    # row 0 positions 2, 3, 4 -> page 0 slots 2, 3 then page 1 slot 0
+    np.testing.assert_array_equal(out[0, 0, 2], np.asarray(kv[0, 0, 0]))
+    np.testing.assert_array_equal(out[0, 0, 3], np.asarray(kv[0, 1, 0]))
+    np.testing.assert_array_equal(out[1, 0, 0], np.asarray(kv[0, 2, 0]))
+    # no other live page/slot was touched
+    live = out[:4].copy()
+    live[0, 0, 2] = live[0, 0, 3] = live[1, 0, 0] = 0.0
+    assert not live.any(), "pad tokens leaked into live pages"
+    assert not out[4].any()               # unmapped page untouched
+    assert out[5].any()                   # pads parked on the scratch page
+
+
+def test_ragged_kernel_allclose_reference():
+    """The single-launch Pallas kernel (online softmax, page-walk skip)
+    vs the bitwise reference composition, on a batch mixing decode
+    (qlen 1), chunk (qlen > 1), and inactive (qlen 0) rows."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_infer_tpu.ops.pallas import ragged_paged_attention as RPA
+
+    b, c, h, d, page, max_pages = 4, 8, 2, 8, 4, 4
+    num_pages = b * max_pages + 1
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, c, h, d), jnp.float32)
+    k_pages = jnp.zeros((num_pages, h, page, d), jnp.float32)
+    v_pages = jnp.zeros((num_pages, h, page, d), jnp.float32)
+    tables = jnp.arange(b * max_pages, dtype=jnp.int32).reshape(
+        b, max_pages)
+    scratch = num_pages - 1
+    ctx = jnp.asarray([7, 3, 0, 0], jnp.int32)
+    qlens = jnp.asarray([1, 5, 0, 8], jnp.int32)
+    # context KV that was already resident before this step
+    kc = jax.random.normal(kk, (b, max_pages * page, h, d), jnp.float32)
+    span = jnp.arange(max_pages * page, dtype=jnp.int32)[None]
+    k_pages = RPA.write_ragged_pages(
+        k_pages, tables, kc, jnp.zeros((b,), jnp.int32),
+        jnp.minimum(ctx, max_pages * page), scratch)
+    v_pages = RPA.write_ragged_pages(
+        v_pages, tables, kc[..., ::-1], jnp.zeros((b,), jnp.int32),
+        jnp.minimum(ctx, max_pages * page), scratch)
+    del span
+    # this step's own chunk KV at positions ctx .. ctx+qlen-1
+    kn = jax.random.normal(kv_, (b, c, h, d), jnp.float32)
+    k_pages = RPA.write_ragged_pages(k_pages, tables, kn, ctx, qlens,
+                                     scratch)
+    v_pages = RPA.write_ragged_pages(v_pages, tables, kn[..., ::-1], ctx,
+                                     qlens, scratch)
+
+    want = RPA.ragged_paged_attention(q, k_pages, v_pages, tables, ctx,
+                                      qlens)
+    got = RPA.ragged_paged_attention(q, k_pages, v_pages, tables, ctx,
+                                     qlens, use_kernel=True,
+                                     interpret=True)
+    valid = (np.arange(c)[None] < np.asarray(qlens)[:, None])
+    np.testing.assert_allclose(
+        np.asarray(got)[valid], np.asarray(want)[valid],
+        rtol=2e-5, atol=2e-5)
+
+
+# ------------------------------------------------------------------ parity
+
+def _serve(engine, prompts, cfgs, ragged, rid_base, **kw):
+    """Run one batch of requests through a fresh core with the rid
+    counter pinned, returning the emitted streams."""
+    for k, v in CORE_SHAPE.items():
+        kw.setdefault(k, v)
+    request_mod._rid_counter = itertools.count(rid_base)
+    core = EngineCore(engine, ragged=ragged, **kw)
+    try:
+        reqs = [core.submit(p, g)[0] for p, g in zip(prompts, cfgs)]
+        _drive(core, reqs)
+        assert all(r.state is RequestState.DONE for r in reqs)
+        return [np.asarray(r.padded_result()) for r in reqs]
+    finally:
+        core.close()
+
+
+@pytest.mark.parametrize("sampled", [False, True],
+                         ids=["greedy", "sampled"])
+def test_ragged_stream_bitwise_equals_legacy(engine, sampled):
+    """Acceptance bar: for the same admissions (same rids), the ragged
+    mixed-step path emits EXACTLY the token streams the legacy cold
+    prefill + fused decode path does — greedy and seeded-sampled."""
+    prompts = [_prompt(1, 11), _prompt(2, 21), _prompt(3, 5)]
+    if sampled:
+        cfgs = [GenerationConfig(max_new_tokens=8, do_sample=True,
+                                 temperature=0.8, top_k=12, top_p=0.9,
+                                 seed=7),
+                GenerationConfig(max_new_tokens=6, do_sample=True,
+                                 temperature=1.2, seed=11),
+                GenerationConfig(max_new_tokens=7, do_sample=True,
+                                 top_k=5, seed=3)]
+    else:
+        cfgs = [GenerationConfig(max_new_tokens=8),
+                GenerationConfig(max_new_tokens=6),
+                GenerationConfig(max_new_tokens=7)]
+    legacy = _serve(engine, prompts, cfgs, ragged=False, rid_base=5000,
+                    decode_chunk=4)
+    ragged = _serve(engine, prompts, cfgs, ragged=True, rid_base=5000)
+    for lg, rg in zip(legacy, ragged):
+        np.testing.assert_array_equal(rg, lg)
+
+
+def test_ragged_chunked_long_prompt_matches_legacy_and_ref(engine, ref):
+    """A prompt longer than the prefill chunk crosses several mixed
+    steps; the stream must still equal both the legacy path and a
+    direct paged generate()."""
+    ids = _prompt(4, 40)
+    g = GenerationConfig(max_new_tokens=8)
+    (legacy,) = _serve(engine, [ids], [g], ragged=False, rid_base=5100,
+                       decode_chunk=4)
+    (ragged,) = _serve(engine, [ids], [g], ragged=True, rid_base=5100)
+    np.testing.assert_array_equal(ragged, legacy)
+    np.testing.assert_array_equal(ragged, ref.generate(ids[None], g)[0])
+
+
+@pytest.mark.parametrize("sampled", [False, True],
+                         ids=["greedy", "sampled"])
+def test_ragged_warm_prefix_hit_bitwise_equals_legacy(engine, sampled):
+    """Warm prefix-cache hits (full and partial-tail) stay bitwise equal
+    across kernels: the ragged path stages the matched pages and chunks
+    only the uncached suffix."""
+    base = _prompt(5, 24)
+    tail = np.concatenate([base[:16], _prompt(6, 6)])
+    if sampled:
+        g = GenerationConfig(max_new_tokens=6, do_sample=True,
+                             temperature=0.8, top_k=12, seed=13)
+    else:
+        g = GenerationConfig(max_new_tokens=6)
+
+    def run(ragged):
+        request_mod._rid_counter = itertools.count(5200)
+        core = EngineCore(engine, ragged=ragged, decode_chunk=4,
+                          enable_prefix_cache=True, **CORE_SHAPE)
+        try:
+            outs = []
+            for ids in (base, base, tail):   # cold, full hit, partial
+                (r,) = core.submit(ids, g)
+                _drive(core, [r])
+                outs.append(np.asarray(r.padded_result()))
+            stats = core.prefix_cache.stats_snapshot()
+            assert stats["hits"] >= 2, "warm admissions never hit"
+            return outs
+        finally:
+            core.close()
+
+    legacy, ragged = run(False), run(True)
+    for lg, rg in zip(legacy, ragged):
+        np.testing.assert_array_equal(rg, lg)
+
+
+@pytest.mark.parametrize("sampled", [False, True],
+                         ids=["greedy", "sampled"])
+def test_ragged_replay_after_kv_loss_equals_legacy_stream(engine, sampled):
+    """Supervisor replay parity: a mid-decode crash that loses the KV
+    pools replays the in-flight row; the recovered ragged stream equals
+    the legacy path's uninterrupted one (same rid, so sampled rows
+    resume at the original fold_in offsets)."""
+    ids = _prompt(7, 10)
+    if sampled:
+        g = GenerationConfig(max_new_tokens=12, do_sample=True,
+                             temperature=0.8, top_k=12, seed=17)
+    else:
+        g = GenerationConfig(max_new_tokens=12)
+    (want,) = _serve(engine, [ids], [g], ragged=False, rid_base=5300,
+                     decode_chunk=4)
+
+    request_mod._rid_counter = itertools.count(5300)
+    plane = FaultPlane([FaultSpec("decode.step", at=4, lose_kv=True)])
+    core = EngineCore(engine, ragged=True, fault_plane=plane,
+                      **CORE_SHAPE)
+    sup = EngineSupervisor(core)
+    try:
+        (req,) = core.submit(ids, g)
+        for _ in range(400):
+            if req.done:
+                break
+            sup.run_once()
+        assert req.state is RequestState.DONE
+        assert req.retries == 1
+        np.testing.assert_array_equal(req.padded_result(), want)
+    finally:
+        sup.close()
+
+
+# -------------------------------------------------------------------- fuzz
+
+def test_composition_fuzz_invariants_and_zero_compiles(engine, ref):
+    """160+ scheduler steps of random mixed traffic: long chunked
+    prompts, decode-only stretches, mixed steps, idle drains.  Pool
+    conservation holds at every step, every greedy stream matches a
+    direct generate(), and — after a one-request warmup — the whole run
+    performs ZERO new XLA compilations: composition is data."""
+    from paddle_infer_tpu.observability import get_compile_log
+
+    log = get_compile_log()
+    core = EngineCore(engine, ragged=True, **CORE_SHAPE)
+    try:
+        total = core._pool.num_blocks
+        (w,) = core.submit(_prompt(900, 20), GenerationConfig(
+            max_new_tokens=4))
+        _drive(core, [w])
+        warm_compiles = log.summary()["compile_count"]
+
+        rng = random.Random(0)
+        live, finished = [], []
+        steps = 0
+        arrivals = 0
+        while steps < 160 or any(not r.done for r, _ in live):
+            if (arrivals < 32 and core.queue_depth < 3
+                    and rng.random() < 0.4):
+                n = rng.choice([3, 5, 11, 17, 26, 40])
+                if rng.random() < 0.4:
+                    g = GenerationConfig(
+                        max_new_tokens=rng.randint(2, 8), do_sample=True,
+                        temperature=0.9, top_k=20,
+                        seed=rng.randint(0, 999))
+                else:
+                    g = GenerationConfig(
+                        max_new_tokens=rng.randint(2, 8))
+                ids = _prompt(100 + arrivals, n)
+                (r,) = core.submit(ids, g)
+                live.append((r, (ids, g)))
+                arrivals += 1
+            core.run_once()
+            steps += 1
+            used = total - core._pool.free_blocks
+            assert 0 <= used <= total, "pool accounting broke mid-run"
+            assert steps < 3000, "fuzz traffic never drained"
+        finished = [(r, meta) for r, meta in live]
+
+        assert steps >= 160 and arrivals >= 16
+        for r, _ in finished:
+            assert r.state is RequestState.DONE, (r.rid, r.error)
+        # greedy rows are rid-independent: each must match generate()
+        greedy = [(r, ids, g) for r, (ids, g) in finished
+                  if not g.do_sample]
+        assert greedy
+        for r, ids, g in greedy:
+            np.testing.assert_array_equal(
+                r.padded_result(), ref.generate(ids[None], g)[0])
+        # every row drained: only the scratch page stays resident
+        assert total - core._pool.free_blocks == 1
+        # the tentpole invariant: nothing compiled after warmup
+        assert log.summary()["compile_count"] == warm_compiles, \
+            "batch composition leaked into executable shapes"
+        assert log.summary()["post_warmup_decode_compiles"] == 0
+        summary = core.steplog.summary()
+        kinds = set(summary["by_kind"])
+        assert {"mixed", "prefill", "decode"} & kinds
+        assert summary["by_kernel"].get("ragged", 0) > 0
+        assert summary["prefill_chunk_tokens_total"] > 0
+    finally:
+        core.close()
+
+
+def test_steplog_records_kernel_and_chunk_fields(make_core):
+    """StepLog satellite: ragged steps record kernel="ragged" and
+    chunked-prefill token counts; the summary aggregates both."""
+    core = make_core(ragged=True, prefill_chunk=8)
+    (r,) = core.submit(_prompt(8, 20), GenerationConfig(max_new_tokens=4))
+    _drive(core, [r])
+    records = core.steplog.records()
+    assert records and all(rec["kernel"] == "ragged" for rec in records
+                           if rec["kind"] in ("mixed", "prefill",
+                                              "decode"))
+    chunked = [rec for rec in records if rec["prefill_chunk_tokens"] > 0]
+    assert len(chunked) >= 3              # 20-token prompt, chunk 8
+    assert sum(rec["prefill_chunk_tokens"] for rec in chunked) == 20
+    summary = core.steplog.summary()
+    assert summary["prefill_chunk_tokens_total"] == 20
+    assert summary["by_kernel"]["ragged"] == len(
+        [rec for rec in records if rec["kind"] != "evict"])

@@ -292,7 +292,8 @@ def test_decode_loop_compile_free_after_warmup(make_core, ref):
     warm = GenerationConfig(max_new_tokens=4)
     (r0,) = core.submit(_prompt(50), warm)
     _drive(core, [r0])                   # warmup: compiles are expected
-    dkey = ("serve-step", core._max_batch, core._decode_chunk,
+    dkey = ("serve-step", core._max_batch,
+            core._token_budget if core._ragged else core._decode_chunk,
             core._max_pages, core._pool.num_blocks)
     assert log.is_warm("serving-decode", dkey)
     baseline = log.count("serving-decode")
@@ -456,14 +457,15 @@ def test_close_escalates_past_wedged_external_step(make_core):
     core = make_core(max_batch=1)
     entered = threading.Event()
     release = threading.Event()
-    orig_decode = core._decode_step
+    step_attr = "_mixed_step" if core._ragged else "_decode_step"
+    orig_step = getattr(core, step_attr)
 
-    def slow_decode():
+    def slow_step():
         entered.set()
         release.wait(20.0)
-        return orig_decode()
+        return orig_step()
 
-    core._decode_step = slow_decode
+    setattr(core, step_attr, slow_step)
     (ra,) = core.submit(_prompt(91), GenerationConfig(max_new_tokens=8))
 
     def worker():

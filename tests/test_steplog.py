@@ -241,7 +241,12 @@ def test_steplog_records_every_bench_style_step(core):
             assert r["cost_source"] in ("xla+pages", "analytic")
         if r["kind"] == "decode":
             assert r["dispatch_s"] <= r["wall_s"] + 1e-9
-            assert r["chunk_steps"] == 4
+            # ragged mixed steps emit one token per decode row per
+            # scheduler step; the legacy fused chunk runs decode_chunk
+            assert r["chunk_steps"] == (1 if r["kernel"] == "ragged"
+                                        else 4)
+    assert {r["kernel"] for r in recs
+            if r["kind"] in ("prefill", "decode")} == {"ragged"}
     model = core.steplog.summary()["decode_model"]
     assert model["n"] >= 2 and model["scale_s_per_byte"] > 0
     assert model["mean_abs_rel_err"] is not None

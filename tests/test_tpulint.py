@@ -99,6 +99,43 @@ def test_recompile_hazard_silent_on_bucketed_keys(tmp_path):
     assert run_rules(tmp_path, src, ["recompile-hazard"]) == []
 
 
+def test_recompile_hazard_fires_on_shape_keyed_builder(tmp_path):
+    src = """
+        def build_decode(engine, batch, chunk, max_pages):
+            return engine.compile(batch, chunk)
+
+        def build_prefill(engine, plen):
+            return engine.compile(plen)
+    """
+    fs = run_rules(tmp_path, src, ["recompile-hazard"])
+    assert len(fs) == 2
+    assert "build_decode(batch, chunk)" in fs[0].message
+    assert "build_prefill(plen)" in fs[1].message
+    assert all("one executable per distinct value" in f.message
+               for f in fs)
+
+
+def test_recompile_hazard_silent_on_composition_keyed_builder(tmp_path):
+    # config-sized params (max_batch / token_budget / max_pages) are
+    # bounded by construction: one executable per deployment, not per
+    # traffic shape — the ragged mixed-step builder must stay clean.
+    src = """
+        def build_mixed_step(engine, max_batch, token_budget, max_pages):
+            return engine.compile(max_batch, token_budget, max_pages)
+    """
+    assert run_rules(tmp_path, src, ["recompile-hazard"]) == []
+
+
+def test_recompile_hazard_builder_suppressible(tmp_path):
+    src = """
+        # legacy per-shape family kept behind ragged=False
+        # tpulint: disable-next-line=recompile-hazard
+        def build_decode(engine, batch, chunk):
+            return engine.compile(batch, chunk)
+    """
+    assert run_rules(tmp_path, src, ["recompile-hazard"]) == []
+
+
 # ------------------------------------------------------ lock-discipline
 def test_lock_discipline_fires_on_unlocked_read(tmp_path):
     src = """

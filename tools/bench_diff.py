@@ -26,7 +26,8 @@ from typing import Dict, Tuple
 # first match in this order wins, so throughput-ish names beat the
 # generic "_s" suffix ("tokens_per_sec" is not a latency)
 _HIGHER = ("per_s", "per_sec", "speedup", "mfu", "acceptance",
-           "hit_rate", "tps", "throughput", "tokens_per", "pearson")
+           "hit_rate", "tps", "throughput", "tokens_per", "pearson",
+           "improvement")
 _LOWER = ("_ms", "latency", "ttft", "itl", "err", "wall", "p50",
           "p99", "_s")
 # harness bookkeeping, not workload performance

@@ -49,13 +49,21 @@ def fabricated_exposition():
     steplog = StepLog()
     steplog.record("prefill", wall_s=0.08, dispatch_s=0.07,
                    bytes_est=2.0e6, flops_est=5.0e6,
-                   cost_source="xla+pages", emitted_tokens=1)
+                   cost_source="xla+pages", emitted_tokens=1,
+                   kernel="legacy")
     steplog.record("decode", wall_s=0.010, dispatch_s=0.008,
                    bytes_est=1.0e6, flops_est=3.0e6,
-                   cost_source="xla+pages", decode_rows=2, chunk_steps=4)
+                   cost_source="xla+pages", decode_rows=2, chunk_steps=4,
+                   kernel="legacy")
     steplog.record("decode", wall_s=0.021, dispatch_s=0.017,
                    bytes_est=2.1e6, flops_est=6.0e6,
-                   cost_source="xla+pages", decode_rows=4, chunk_steps=4)
+                   cost_source="xla+pages", decode_rows=4, chunk_steps=4,
+                   kernel="legacy")
+    steplog.record("mixed", wall_s=0.015, dispatch_s=0.012,
+                   bytes_est=1.6e6, flops_est=4.5e6,
+                   cost_source="xla+pages", decode_rows=3,
+                   prefill_chunk_tokens=16, emitted_tokens=4,
+                   kernel="ragged")
     steplog.record("evict", pages_freed=3, bytes_est=3.0e5,
                    cost_source="analytic")
 

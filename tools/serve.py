@@ -88,8 +88,7 @@ def _core():
                                                   FaultPlane)
 
             engine = PagedGenerationEngine(
-                _STATE["model"], page_size=_STATE["page_size"],
-                prompt_bucket=_STATE.get("prompt_bucket") or 64)
+                _STATE["model"], page_size=_STATE["page_size"])
             plane = None
             script = _STATE.get("fault_script")
             if script:
@@ -106,6 +105,9 @@ def _core():
                                                False),
                 prefix_cache_watermark=_STATE.get(
                     "prefix_cache_watermark", 0.5),
+                ragged=_STATE.get("ragged", True),
+                prefill_chunk=_STATE.get("prefill_chunk"),
+                token_budget=_STATE.get("token_budget"),
                 fault_plane=plane)
             _STATE["sup"] = EngineSupervisor(
                 core,
@@ -489,12 +491,27 @@ def main(argv=None):
                     help="retained cache blocks are LRU-evicted down to "
                          "this fraction of the KV pool after each "
                          "request release")
-    ap.add_argument("--prompt_bucket", type=int, default=64,
-                    help="prefill length rounds up to this multiple (one "
-                         "executable per bucket); keep it well below "
-                         "max_model_len or prefix-cache hits degrade to "
-                         "cold prefills (the padded suffix must still "
-                         "fit the slot window)")
+    ap.add_argument("--prompt_bucket", type=int, default=None,
+                    help="DEPRECATED no-op: ragged mixed-batch attention "
+                         "removed prompt bucketing (prompts are chunked "
+                         "under --token_budget instead); the flag is "
+                         "still parsed so old launch scripts keep "
+                         "working")
+    ap.add_argument("--token_budget", type=int, default=None,
+                    help="per-step token budget for the ragged mixed "
+                         "step: decode rows take one token each, the "
+                         "remainder goes to prefill chunks (default "
+                         "min(slot window, max(4*page_size, 32)))")
+    ap.add_argument("--prefill_chunk", type=int, default=None,
+                    help="max prompt tokens a single request contributes "
+                         "to one mixed step (defaults to the token "
+                         "budget); smaller chunks tighten decode ITL "
+                         "under long-prompt arrivals at the cost of "
+                         "prefill latency")
+    ap.add_argument("--legacy_programs", action="store_true",
+                    help="run the pre-ragged per-shape program family "
+                         "(bucketed prefill + fused decode) instead of "
+                         "the single ragged mixed-step executable")
     ap.add_argument("--draft_dir", default=None,
                     help="optional draft model for speculative decoding "
                          "of greedy requests")
@@ -525,7 +542,14 @@ def main(argv=None):
     _STATE["max_model_len"] = args.max_model_len
     _STATE["enable_prefix_cache"] = args.enable_prefix_cache
     _STATE["prefix_cache_watermark"] = args.prefix_cache_watermark
-    _STATE["prompt_bucket"] = args.prompt_bucket
+    if args.prompt_bucket is not None:
+        print("warning: --prompt_bucket is deprecated and ignored — "
+              "ragged mixed-batch attention schedules prompts under "
+              "--token_budget instead of padding them to buckets",
+              file=sys.stderr, flush=True)
+    _STATE["ragged"] = not args.legacy_programs
+    _STATE["token_budget"] = args.token_budget
+    _STATE["prefill_chunk"] = args.prefill_chunk
     _STATE["draft_model"] = (AutoModel.from_pretrained(args.draft_dir)
                              if args.draft_dir else None)
     _STATE["num_draft_tokens"] = args.num_draft_tokens
