@@ -16,8 +16,18 @@ What is *safe* to branch on (and therefore exempt):
     ``static_argnames``.
 
 Flagged: a branch test that reads a (non-static) parameter directly,
-or that calls into ``jnp.`` / ``jax.`` (the result of which is always
-traced).
+that calls into ``jnp.`` / ``jax.`` (the result of which is always
+traced), or that reads a LOCAL previously assigned from a traced
+expression — the classic speculative-decoding port bug::
+
+    n = jnp.argmin(accept_mask, axis=0)   # per-row accept count
+    if n > 0:                             # traced! freezes one branch
+        ...
+
+Taint is tracked per local in statement order: an assignment from a
+traced expression taints the target, a later assignment from a host
+expression clears it.  Static reads (``x.shape``, ``len(x)``, ...)
+never taint.
 """
 from __future__ import annotations
 
@@ -65,6 +75,18 @@ def _static_params(tree: ast.AST,
                 and node.args[0].id in jitted:
             absorb(node.args[0].id, node)
     return out
+
+
+def _bound_names(t: ast.AST):
+    """Names an assignment target BINDS (tuple/list/star destructuring
+    included); subscript and attribute targets bind nothing."""
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _bound_names(e)
+    elif isinstance(t, ast.Starred):
+        yield from _bound_names(t.value)
 
 
 def _parents(root: ast.AST) -> Dict[int, ast.AST]:
@@ -119,27 +141,99 @@ class TracedBranchRule(Rule):
     def _check_fn(self, ctx: FileContext, fn: ast.FunctionDef,
                   static: Set[str]):
         traced = {p for p in param_names(fn) if p not in static}
-        for node in ast.walk(fn):
-            if not isinstance(node, (ast.If, ast.While)):
-                continue
-            reason = self._hazard(node.test, traced)
-            if reason:
-                kind = "if" if isinstance(node, ast.If) else "while"
-                yield ctx.finding(
-                    self.id, node,
-                    f"Python `{kind}` on {reason} inside a jitted "
-                    "function — use jnp.where / lax.cond / "
-                    "lax.while_loop")
+        findings: List = []
+        self._visit(ctx, fn.body, set(traced), set(), findings)
+        yield from findings
+
+    def _visit(self, ctx: FileContext, stmts, params: Set[str],
+               tainted: Set[str], findings: List):
+        """Statement-order walk: branch checks interleave with taint
+        updates so ``n = jnp.argmin(...); if n:`` is caught but
+        ``n = jnp.argmax(x); n = 3; if n:`` is not."""
+        for st in stmts:
+            if isinstance(st, (ast.If, ast.While)):
+                reason = self._hazard(st.test, params, tainted)
+                if reason:
+                    kind = "if" if isinstance(st, ast.If) else "while"
+                    findings.append(ctx.finding(
+                        self.id, st,
+                        f"Python `{kind}` on {reason} inside a jitted "
+                        "function — use jnp.where / lax.cond / "
+                        "lax.while_loop"))
+                self._visit(ctx, st.body, params, tainted, findings)
+                self._visit(ctx, st.orelse, params, tainted, findings)
+            elif isinstance(st, ast.Assign):
+                hazard = self._hazard(st.value, params, tainted)
+                # only names the statement BINDS — a subscript or
+                # attribute target (``named[n]._data = arr``) reads its
+                # inner names, it does not rebind them
+                names = set()
+                for t in st.targets:
+                    names |= set(_bound_names(t))
+                if hazard:
+                    tainted |= names
+                else:
+                    tainted -= names
+            elif isinstance(st, ast.AnnAssign) \
+                    and isinstance(st.target, ast.Name) \
+                    and st.value is not None:
+                if self._hazard(st.value, params, tainted):
+                    tainted.add(st.target.id)
+                else:
+                    tainted.discard(st.target.id)
+            elif isinstance(st, ast.AugAssign) \
+                    and isinstance(st.target, ast.Name):
+                if self._hazard(st.value, params, tainted):
+                    tainted.add(st.target.id)
+            elif isinstance(st, ast.For):
+                if self._hazard(st.iter, params, tainted):
+                    names = {n.id for n in ast.walk(st.target)
+                             if isinstance(n, ast.Name)}
+                    it = st.iter
+                    # pytree mapping KEYS are trace-time static even
+                    # when the mapping itself is traced: iterating
+                    # ``traced.keys()`` taints nothing, and for
+                    # ``traced.items()`` only the value element of a
+                    # tuple target carries the taint
+                    if isinstance(it, ast.Call) \
+                            and isinstance(it.func, ast.Attribute):
+                        if it.func.attr == "keys":
+                            names = set()
+                        elif it.func.attr == "items" \
+                                and isinstance(st.target, ast.Tuple) \
+                                and st.target.elts:
+                            names -= {n.id
+                                      for n in ast.walk(st.target.elts[0])
+                                      if isinstance(n, ast.Name)}
+                    tainted |= names
+                self._visit(ctx, st.body, params, tainted, findings)
+                self._visit(ctx, st.orelse, params, tainted, findings)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                self._visit(ctx, st.body, params, tainted, findings)
+            elif isinstance(st, ast.Try):
+                for blk in (st.body, st.orelse, st.finalbody):
+                    self._visit(ctx, blk, params, tainted, findings)
+                for h in st.handlers:
+                    self._visit(ctx, h.body, params, tainted, findings)
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a closure sees the outer taint; its own params shadow
+                shadow = set(param_names(st))
+                self._visit(ctx, st.body, params - shadow,
+                            tainted - shadow, findings)
 
     @staticmethod
-    def _hazard(test: ast.AST, traced: Set[str]) -> str:
+    def _hazard(test: ast.AST, traced: Set[str],
+                tainted: Set[str] = frozenset()) -> str:
         parents = _parents(test)
         for node in ast.walk(test):
             if isinstance(node, ast.Name) \
                     and isinstance(node.ctx, ast.Load) \
-                    and node.id in traced \
                     and not _exempt(node, parents, test):
-                return f"traced parameter '{node.id}'"
+                if node.id in traced:
+                    return f"traced parameter '{node.id}'"
+                if node.id in tainted:
+                    return (f"local '{node.id}' holding a traced "
+                            "value")
             if isinstance(node, ast.Call):
                 d = dotted(node.func)
                 if d.startswith(("jnp.", "jax.numpy.", "lax.",

@@ -1,0 +1,85 @@
+"""Shared speculative-decoding acceptance rule.
+
+ONE implementation of the accept algebra, used by both speculation
+paths:
+
+  * the standalone two-model ``inference/speculative.py`` engine
+    (deprecated front door), and
+  * the in-engine draft/verify rows inside ``EngineCore``'s ragged
+    mixed step (``serving/programs.build_mixed_step`` with
+    ``spec_window > 1``).
+
+The rule (Leviathan et al., see PAPERS.md):
+
+  greedy   — accept the longest prefix of drafts matching the target's
+             per-position argmax; the target's own choice at the first
+             mismatch is the correction, its choice after a full accept
+             is the bonus.  Output is token-identical to running the
+             target alone.
+  sampling — accept draft ``d_j`` with probability
+             ``min(1, p_j(d_j) / q_j(d_j))``; on the first rejection
+             resample from ``norm(max(p - q, 0))``.  The emitted
+             marginal is EXACTLY ``p`` whatever the proposal ``q``.
+             For a deterministic proposal (``q = one_hot(d)`` — the
+             ngram/prefix-tree draft sources) the residual reduces to
+             ``p`` with the draft token masked out, renormalized.
+
+Everything here is plain traceable jnp on ``[batch, k]``-shaped
+arrays — per-row acceptance counts stay DEVICE values end to end; a
+Python-level ``if`` on them inside a jitted verify helper is the
+classic porting bug (tpulint's traced-branch rule flags it).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import sampling
+
+
+def accepted_prefix_len(accept_mask):
+    """Length of the accepted prefix per row.
+
+    ``accept_mask`` is ``[batch, k]`` bool — True where the draft at
+    that position passed its accept test.  Returns ``[batch]`` int32 in
+    ``0..k``: the index of the first False (argmin over the mask with a
+    sentinel False column, so a fully-True row yields ``k``)."""
+    b = accept_mask.shape[0]
+    return jnp.argmin(
+        jnp.concatenate([accept_mask.astype(jnp.int32),
+                         jnp.zeros((b, 1), jnp.int32)], axis=1),
+        axis=1).astype(jnp.int32)
+
+
+def rejection_accept(u, p_draft, q_draft, eps=1e-20):
+    """Elementwise accept test: ``u < p(d) / q(d)`` (clamped q).
+
+    ``u`` uniform [0,1) draws, ``p_draft``/``q_draft`` the target/draft
+    probabilities OF the proposed token, all ``[batch, k]``.  For a
+    point-mass proposal pass ``q_draft = 1``: the test degrades to
+    ``u < p(d)`` and acceptance probability is exactly ``p(d)``."""
+    return u < p_draft / jnp.maximum(q_draft, eps)
+
+
+def residual_probs(p, q, eps=1e-20):
+    """Correction distribution ``norm(max(p - q, 0))`` on rejection.
+
+    ``p``/``q`` are probability rows ``[..., vocab]``.  Falls back to
+    ``p`` when the residual mass vanishes (p == q everywhere, only
+    reachable when the accept test could never have rejected)."""
+    resid = jnp.maximum(p - q, 0.0)
+    has = jnp.sum(resid, axis=-1, keepdims=True) > eps
+    return jnp.where(has, resid, p)
+
+
+def residual_logits_point_mass(proc_logits, draft):
+    """Correction logits for a POINT-MASS proposal, in logit space.
+
+    With ``q = one_hot(draft)`` the residual ``norm(max(p - q, 0))`` is
+    exactly ``p`` with the draft token's mass removed and renormalized
+    — i.e. the processed logits with the draft id masked to NEG_INF
+    (renormalization is implicit in ``jax.random.categorical``).
+    ``proc_logits`` is ``[batch, vocab]``, ``draft`` ``[batch]``."""
+    vocab = proc_logits.shape[-1]
+    hit = jax.nn.one_hot(draft, vocab, dtype=jnp.bool_)
+    return jnp.where(hit, sampling.NEG_INF, proc_logits)

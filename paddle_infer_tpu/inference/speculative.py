@@ -35,13 +35,14 @@ tracks the target; correctness never does.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from . import sampling
+from . import sampling, spec_accept
 from .generation import (GenerationConfig, GenerationEngine,
                          _MeshContext)
 
@@ -53,6 +54,13 @@ class SpeculativeEngine:
     def __init__(self, target_model, draft_model, num_draft_tokens: int = 4,
                  cache_bucket: int = 128, prompt_bucket: int = 64,
                  mesh=None):
+        warnings.warn(
+            "SpeculativeEngine's standalone draft/verify loop is "
+            "deprecated: serve speculation rides the ragged mixed step "
+            "via EngineCore(speculate=True) (same accept rule, shared "
+            "in inference/spec_accept.py, continuous batching, paged "
+            "KV).  This class remains for offline two-model runs only.",
+            DeprecationWarning, stacklevel=2)
         if num_draft_tokens < 1:
             raise ValueError("num_draft_tokens must be >= 1")
         self.gamma = int(num_draft_tokens)
@@ -163,7 +171,8 @@ class SpeculativeEngine:
 
                 if do_sample:
                     # rejection sampling: accept d_j iff
-                    # u < p_j(d_j)/q_j(d_j)
+                    # u < p_j(d_j)/q_j(d_j) — accept rule shared with
+                    # the in-engine path (inference/spec_accept.py)
                     p = jax.nn.softmax(plg[:, :gamma], axis=-1)
                     q = jax.nn.softmax(
                         jnp.moveaxis(qlgs[:gamma], 0, 1), axis=-1)
@@ -173,12 +182,9 @@ class SpeculativeEngine:
                         q, props[:, :, None], axis=2)[:, :, 0]
                     u = jax.random.uniform(jax.random.fold_in(kit, 7001),
                                            (batch, gamma))
-                    ok = u < pd / jnp.maximum(qd, 1e-20)      # [B, g]
-                    # first rejection per row (gamma = none)
-                    n = jnp.argmin(jnp.concatenate(
-                        [ok.astype(jnp.int32),
-                         jnp.zeros((batch, 1), jnp.int32)], axis=1),
-                        axis=1)
+                    ok = spec_accept.rejection_accept(u, pd, qd)  # [B, g]
+                    # n = longest accepted prefix per row (gamma = all)
+                    n = spec_accept.accepted_prefix_len(ok)
                     # correction: resample from norm(max(p - q, 0)) at
                     # the rejected position; bonus: sample p[gamma]
                     p_n = jnp.take_along_axis(
@@ -187,10 +193,7 @@ class SpeculativeEngine:
                     q_n = jnp.take_along_axis(
                         q, jnp.minimum(n, gamma - 1)[:, None, None],
                         axis=1)[:, 0]
-                    resid = jnp.maximum(p_n - q_n, 0.0)
-                    has_resid = jnp.sum(resid, axis=-1,
-                                        keepdims=True) > 1e-20
-                    resid = jnp.where(has_resid, resid, p_n)
+                    resid = spec_accept.residual_probs(p_n, q_n)
                     corr = jax.random.categorical(
                         jax.random.fold_in(kit, 7002),
                         jnp.log(jnp.maximum(resid, 1e-30)), axis=-1)
@@ -203,10 +206,7 @@ class SpeculativeEngine:
                     a = jnp.argmax(plg, axis=-1).astype(
                         jnp.int32)                             # [B, g+1]
                     match = props == a[:, :gamma]              # [B, g]
-                    n = jnp.argmin(jnp.concatenate(
-                        [match.astype(jnp.int32),
-                         jnp.zeros((batch, 1), jnp.int32)], axis=1),
-                        axis=1)
+                    n = spec_accept.accepted_prefix_len(match)
                     # correction a[n] on mismatch; bonus a[gamma] on
                     # full accept — one gather covers both
                     pick = jnp.take_along_axis(

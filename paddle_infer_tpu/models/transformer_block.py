@@ -183,27 +183,41 @@ class ParallelSelfAttention(Layer):
         its own ``(query_len, context_len)``, decode rows have
         ``query_len == 1`` and chunk rows a prompt slice, all in one
         launch — positions past a row's ``query_len`` write to the
-        scratch page and are never attended."""
+        scratch page and are never attended.
+
+        A SEVEN-element cache appends ``verify [b, W] bool`` (per-row
+        speculative-verify flag broadcast over the draft window — the
+        STATIC window size W rides in the array's shape, because every
+        cache element is Tensor-wrapped on the way through
+        ``_model_step``): flagged rows route their first W query
+        positions through per-position decode-kernel math so draft
+        verification stays bitwise-identical to sequential decode
+        (serving/programs.build_mixed_step with ``spec_window > 1``)."""
         from ..core.tensor import Tensor
         from ..ops.pallas import paged_attention as PA
 
         b, s = x.shape[0], x.shape[1]
         k_pages, v_pages, tables, positions = (c._data for c in cache[:4])
-        if len(cache) == 6:
+        if len(cache) >= 6:
             from ..ops.pallas import ragged_paged_attention as RPA
 
             qlens = cache[4]._data
             scratch = cache[5]._data
+            verify = cache[6]._data if len(cache) == 7 else None
             k_pages = RPA.write_ragged_pages(k_pages, tables, k._data,
                                              positions, qlens, scratch)
             v_pages = RPA.write_ragged_pages(v_pages, tables, v._data,
                                              positions, qlens, scratch)
             out = Tensor(RPA.ragged_paged_attention(
-                q._data, k_pages, v_pages, tables, positions, qlens))
+                q._data, k_pages, v_pages, tables, positions, qlens,
+                verify_rows=None if verify is None else verify[:, 0],
+                verify_window=None if verify is None
+                else verify.shape[1]))
             out = D("reshape", out, shape=(b, s, self.hidden))
             out = self.out_proj(out)
-            return out, (Tensor(k_pages), Tensor(v_pages), Tensor(tables),
-                         Tensor(positions + qlens), cache[4], cache[5])
+            new = (Tensor(k_pages), Tensor(v_pages), Tensor(tables),
+                   Tensor(positions + qlens), cache[4], cache[5])
+            return out, (new + (cache[6],) if len(cache) == 7 else new)
         windowed = len(cache) == 5
         if s > 1 and windowed:
             k_pages = PA.write_chunk_pages(k_pages, tables, k._data,

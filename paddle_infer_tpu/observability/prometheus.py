@@ -235,6 +235,19 @@ def render_prometheus(snapshot: dict,
     w.sample("serving_tokens_per_second",
              snapshot.get("tokens_per_second", 0.0))
 
+    spec = snapshot.get("speculation") or {}
+    if spec:
+        w.family("serving_spec_acceptance_rate", "gauge",
+                 "Accepted / proposed draft tokens over the process "
+                 "lifetime (in-engine speculative decoding)")
+        w.sample("serving_spec_acceptance_rate",
+                 spec.get("acceptance_rate", 0.0))
+        w.family("serving_spec_wasted_ratio", "gauge",
+                 "Rejected / proposed draft tokens — verify-lane work "
+                 "that produced no emitted tokens")
+        w.sample("serving_spec_wasted_ratio",
+                 spec.get("wasted_ratio", 0.0))
+
     # native histogram families — family names are literal (not looped
     # from a dict) so the tpulint metric-sync rule can cross-check them
     # against the docs catalog
@@ -308,6 +321,16 @@ def render_prometheus(snapshot: dict,
                  "steps")
         w.sample("steplog_bytes_estimated_total",
                  sl.get("bytes_est_total", 0.0))
+        w.family("steplog_draft_tokens_total", "counter",
+                 "Draft tokens packed into verify rows across recorded "
+                 "mixed steps")
+        w.sample("steplog_draft_tokens_total",
+                 sl.get("draft_tokens_total", 0))
+        w.family("steplog_draft_accepted_total", "counter",
+                 "Draft tokens accepted by the verify pass across "
+                 "recorded mixed steps")
+        w.sample("steplog_draft_accepted_total",
+                 sl.get("draft_accepted_total", 0))
         model = sl.get("decode_model") or {}
         w.family("steplog_model_abs_rel_error", "gauge",
                  "Mean absolute relative error of the fitted step-cost "

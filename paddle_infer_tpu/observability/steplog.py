@@ -75,6 +75,9 @@ _SCHEMA = (
     ("retries", 0),              # replayed rows involved in the step
     ("degraded", False),         # effective_max_batch < max_batch
     ("failed", False),           # the step raised / the row failed
+    ("draft_tokens", 0),         # speculative draft tokens verified
+    ("draft_accepted", 0),       # drafts accepted (extra tokens won)
+    ("spec_rows", 0),            # rows that carried drafts this step
 )
 SCHEMA_KEYS = tuple(k for k, _ in _SCHEMA)
 
@@ -154,9 +157,20 @@ class StepCostModel:
             per_row_pages = pages / max(rows, 1)
             kv_moved = (max(int(tokens if tokens is not None else rows), 1)
                         * per_row_pages * self._page_kv_bytes)
+        elif kind == "decode":
+            # every query token re-streams its row's page window, so
+            # decode is priced per token: tokens / rows positions per
+            # row.  Legacy fused chunks pass tokens = rows × chunk and
+            # reduce exactly to the old pages × chunk product; ragged
+            # speculative steps pass decode + draft tokens, pricing a
+            # verify row at its true query_len instead of the old
+            # query_len == 1 assumption.
+            ntok_kv = float(tokens if tokens is not None
+                            else rows * chunk)
+            kv_moved = (pages * self._page_kv_bytes
+                        * max(ntok_kv, 1.0) / max(rows, 1))
         else:
-            kv_moved = pages * self._page_kv_bytes * (
-                chunk if kind == "decode" else 1)
+            kv_moved = pages * self._page_kv_bytes
         frac = (rows / max_rows) if max_rows > 0 else 1.0
         static = self.static_cost(key)
         if static is not None:
@@ -224,6 +238,8 @@ class StepLog:
         self._flops_total = 0.0
         self._compile_total = 0
         self._chunk_tokens_total = 0
+        self._draft_tokens_total = 0
+        self._draft_accepted_total = 0
         self._by_kernel: Dict[str, int] = {}
         # (bytes_est, wall_s) for clean decode chunks — the model fit
         self._model: deque = deque(maxlen=int(model_window))
@@ -250,6 +266,8 @@ class StepLog:
             self._flops_total += float(rec["flops_est"])
             self._compile_total += int(rec["compile_events"])
             self._chunk_tokens_total += int(rec["prefill_chunk_tokens"])
+            self._draft_tokens_total += int(rec["draft_tokens"])
+            self._draft_accepted_total += int(rec["draft_accepted"])
             if rec["kernel"]:
                 self._by_kernel[rec["kernel"]] = \
                     self._by_kernel.get(rec["kernel"], 0) + 1
@@ -289,6 +307,8 @@ class StepLog:
             self._flops_total = 0.0
             self._compile_total = 0
             self._chunk_tokens_total = 0
+            self._draft_tokens_total = 0
+            self._draft_accepted_total = 0
             self._by_kernel = {}
 
     def summary(self) -> Dict:
@@ -304,6 +324,8 @@ class StepLog:
                 "flops_est_total": self._flops_total,
                 "compile_events_total": self._compile_total,
                 "prefill_chunk_tokens_total": self._chunk_tokens_total,
+                "draft_tokens_total": self._draft_tokens_total,
+                "draft_accepted_total": self._draft_accepted_total,
             }
         out["decode_model"] = _model_summary(pairs)
         return out

@@ -194,6 +194,153 @@ def _decode_local(q, k_pages, v_pages, block_tables, lengths,
     return fn(lengths, block_tables, q, k_pages, v_pages)
 
 
+def _verify_kernel(lengths_ref, tables_ref,      # scalar prefetch (SMEM)
+                   q_ref, k_ref, v_ref,          # blocks (VMEM)
+                   o_ref,                        # output block
+                   m_ref, l_ref, acc_ref,        # VMEM scratch
+                   *, scale, page_size, max_pages, window):
+    """W-query decode: ``_decode_kernel`` with an extra leading query
+    lane.  Each lane ``w`` masks by its OWN length ``lengths[b, w]``;
+    the per-page online-softmax update is the decode kernel's math per
+    lane, so lane ``w`` accumulates bit-for-bit what a separate
+    single-query launch at ``lengths[b, w]`` would have — one page walk
+    per row instead of one per (row, position)."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # lane lengths are nondecreasing over w (position j attends
+    # ctx + j + 1, clamped by a bound that is itself nondecreasing), so
+    # the last lane gates the page walk for the whole row.  Pages past
+    # a SHORTER lane's length are an exact no-op for that lane: the
+    # masked page contributes m_cur = NEG_INF, alpha = 1, p = 0, which
+    # leaves (m, l, acc) bitwise untouched — the same identity the
+    # single-query kernel's own gate relies on.
+    last = lengths_ref[b, window - 1]
+
+    @pl.when(j * page_size < last)
+    def _():
+        q = q_ref[0].astype(jnp.float32)            # [W, H, D]
+        k = k_ref[0].astype(jnp.float32)            # [H, page, D]
+        v = v_ref[0].astype(jnp.float32)            # [H, page, D]
+        # scores over this page's slots, per lane: [W, H, page]
+        s = jnp.sum(q[:, :, None, :] * k[None], axis=3) * scale
+        slot = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 2)
+        lens = lengths_ref[b]                        # [W]
+        s = jnp.where(slot < lens[:, None, None], s, NEG_INF)
+
+        m_prev = m_ref[:][:, :, None]                # [W, H, 1]
+        l_prev = l_ref[:][:, :, None]
+        m_cur = jnp.max(s, axis=2, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                       # [W, H, page]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=2, keepdims=True)
+        pv = jnp.sum(p[:, :, :, None] * v[None], axis=2)   # [W, H, D]
+        acc_ref[:] = acc_ref[:] * alpha[:, :, 0][:, :, None] + pv
+        m_ref[:] = m_new[:, :, 0]
+        l_ref[:] = l_new[:, :, 0]
+
+    @pl.when(j == max_pages - 1)
+    def _():
+        l = jnp.maximum(l_ref[:], 1e-20)             # [W, H]
+        o_ref[0] = (acc_ref[:] / l[:, :, None]).astype(o_ref.dtype)
+
+
+def paged_attention_verify(q, k_pages, v_pages, block_tables, lengths,
+                           scale=None, interpret=None):
+    """Batched draft/verify decode attention over paged KV.
+
+    q            [B, W, H, D]   — W query positions per row (last
+                                  emitted token + W-1 drafts)
+    lengths      [B, W] int32   — per-position window, nondecreasing
+                                  over W (position j sees ctx + j + 1)
+    → [B, W, H, D]
+
+    Lane (b, w) is bitwise-identical to
+    ``paged_attention_decode(q[:, w], ..., lengths[:, w])[b]`` — the
+    verify step reproduces W sequential decode steps exactly, in ONE
+    page walk per row instead of W (the flattened ``B*W`` construction
+    multiplies grid cells by W; this kernel multiplies only the per-page
+    VPU work, which decode never bottlenecks on).
+    """
+    mesh = _current_mesh()
+    if mesh is not None:
+        from ...parallel.topology import axis_if_divides
+
+        bax = axis_if_divides(mesh, "dp", q.shape[0])
+        hax = axis_if_divides(mesh, "mp", q.shape[2])
+        if bax or hax:
+            from jax.sharding import PartitionSpec as P
+
+            from ...parallel.topology import shard_map_norep
+            inner = functools.partial(_verify_local, scale=scale,
+                                      interpret=interpret)
+            return shard_map_norep(
+                inner, mesh,
+                in_specs=(P(bax, None, hax, None),
+                          P(None, hax, None, None),
+                          P(None, hax, None, None), P(bax, None),
+                          P(bax, None)),
+                out_specs=P(bax, None, hax, None),
+            )(q, k_pages, v_pages, block_tables, lengths)
+    return _verify_local(q, k_pages, v_pages, block_tables, lengths,
+                         scale=scale, interpret=interpret)
+
+
+def _verify_local(q, k_pages, v_pages, block_tables, lengths,
+                  scale=None, interpret=None):
+    """The single-shard kernel launch (see paged_attention_verify)."""
+    interpret = _interpret() if interpret is None else interpret
+    b, w, h, d = q.shape
+    num_pages, kh, page_size, kd = k_pages.shape
+    assert (kh, kd) == (h, d), (k_pages.shape, q.shape)
+    assert lengths.shape == (b, w), (lengths.shape, q.shape)
+    max_pages = block_tables.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    lengths = lengths.astype(jnp.int32)
+    block_tables = block_tables.astype(jnp.int32)
+
+    def q_map(b_, j_, lengths_s, tables_s):
+        return (b_, 0, 0, 0)
+
+    def kv_map(b_, j_, lengths_s, tables_s):
+        return (tables_s[b_, j_], 0, 0, 0)
+
+    kernel = functools.partial(
+        _verify_kernel, scale=scale, page_size=page_size,
+        max_pages=max_pages, window=w)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, w, h, d), q_map),
+            pl.BlockSpec((1, h, page_size, d), kv_map),
+            pl.BlockSpec((1, h, page_size, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, w, h, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((w, h), jnp.float32),
+            pltpu.VMEM((w, h), jnp.float32),
+            pltpu.VMEM((w, h, d), jnp.float32),
+        ],
+    )
+    fn = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, w, h, d), q.dtype),
+        interpret=interpret,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+    )
+    return fn(lengths, block_tables, q, k_pages, v_pages)
+
+
 # --------------------------------------------------------- page utilities
 # Pure-XLA writes: scatters into the pool compile to dynamic-update fusions;
 # the per-token bookkeeping (which page/slot) is the native allocator's job.

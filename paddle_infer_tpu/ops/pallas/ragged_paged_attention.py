@@ -46,6 +46,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .paged_attention import (NEG_INF, _CompilerParams, _interpret,
                               paged_attention_decode,
+                              paged_attention_verify,
                               prefix_prefill_attention)
 
 
@@ -75,20 +76,49 @@ def write_ragged_pages(pages, block_tables, kv, context_lens, query_lens,
 
 
 def _ragged_reference(q, k_pages, v_pages, block_tables, context_lens,
-                      query_lens, scale=None):
+                      query_lens, scale=None, verify_rows=None,
+                      verify_window=None):
     """Per-row-type exact composition (see module docstring): the row's
     first query position is replaced by the decode kernel's output when
     ``query_lens == 1``, all other positions keep the dense
     constant-window prefix math.  Positions ``i >= query_lens`` hold
     garbage the caller must never read (it samples at
-    ``query_lens - 1``)."""
+    ``query_lens - 1``).
+
+    ``verify_rows`` [B] bool marks speculative draft/verify rows: a
+    verify row carries ``query_lens = k + 1`` tokens (last emitted +
+    ``k`` drafts) whose first ``verify_window`` positions each go
+    through DECODE-kernel math at their own length — position ``j``
+    attends exactly the window ``context_lens + j + 1`` a sequential
+    decode step would have seen, over KV ``write_ragged_pages`` just
+    scattered.  K/V at a position is a function of (token, position)
+    only, so every verify lane reproduces the sequential step's inputs
+    bit-for-bit and the verify logits are bitwise equal to the
+    non-speculative stream — the greedy-parity guarantee.  The lanes
+    ride ``paged_attention_verify``: ONE page walk per row (the decode
+    kernel per lane) rather than a ``B*W``-row flattened launch."""
     out = prefix_prefill_attention(q, k_pages, v_pages, block_tables,
                                    context_lens, scale=scale)
     dec = paged_attention_decode(q[:, 0], k_pages, v_pages, block_tables,
                                  context_lens + 1, scale=scale)
     is_decode = (query_lens == 1)[:, None, None]
     first = jnp.where(is_decode, dec, out[:, 0])
-    return out.at[:, 0].set(first)
+    out = out.at[:, 0].set(first)
+    if verify_rows is None:
+        return out
+    w = int(verify_window)
+    # one W-lane decode-kernel launch covers every (row, position) pair
+    # in a SINGLE page walk per row (paged_attention_verify lane (b, j)
+    # is bitwise paged_attention_decode at ctx + j + 1); clamping keeps
+    # non-verify / short rows inside their valid KV (lanes discarded)
+    j = jnp.arange(w, dtype=jnp.int32)[None]                  # [1, W]
+    ctxv = context_lens[:, None] + j + 1                      # [B, W]
+    ctxv = jnp.minimum(ctxv, (context_lens
+                              + jnp.maximum(query_lens, 1))[:, None])
+    decv = paged_attention_verify(q[:, :w], k_pages, v_pages,
+                                  block_tables, ctxv, scale=scale)
+    sel = verify_rows[:, None, None, None]
+    return out.at[:, :w].set(jnp.where(sel, decv, out[:, :w]))
 
 
 # ------------------------------------------------------------------ kernel
@@ -193,7 +223,8 @@ def _ragged_kernel_call(q, k_pages, v_pages, block_tables, context_lens,
 
 def ragged_paged_attention(q, k_pages, v_pages, block_tables,
                            context_lens, query_lens, scale=None,
-                           use_kernel=False, interpret=None):
+                           use_kernel=False, interpret=None,
+                           verify_rows=None, verify_window=None):
     """Mixed-batch ragged attention over paged KV.
 
     q            [B, C, H, D]   — per-row query chunk (C = capacity;
@@ -204,6 +235,11 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables,
     context_lens [B] int32      — tokens already cached per row
     query_lens   [B] int32      — 1 = decode, >1 = prefill chunk,
                                   0 = inactive row
+    verify_rows  [B] bool       — optional: speculative verify rows
+                                  whose first ``verify_window`` (static
+                                  int) positions take per-position
+                                  decode-kernel math (see
+                                  ``_ragged_reference``)
     → [B, C, H, D]; positions past ``query_lens`` hold garbage.
 
     ``use_kernel=False`` (default) runs the bitwise-exact reference
@@ -211,8 +247,14 @@ def ragged_paged_attention(q, k_pages, v_pages, block_tables,
     ``use_kernel=True`` runs the single-launch Pallas kernel (allclose
     to the reference — the TPU fast path)."""
     if use_kernel:
+        if verify_rows is not None:
+            raise NotImplementedError(
+                "speculative verify rows require the reference "
+                "composition (per-position decode-kernel parity)")
         return _ragged_kernel_call(q, k_pages, v_pages, block_tables,
                                    context_lens, query_lens, scale=scale,
                                    interpret=interpret)
     return _ragged_reference(q, k_pages, v_pages, block_tables,
-                             context_lens, query_lens, scale=scale)
+                             context_lens, query_lens, scale=scale,
+                             verify_rows=verify_rows,
+                             verify_window=verify_window)

@@ -90,11 +90,15 @@ class EngineCore:
                  tracer: Optional[Tracer] = None,
                  enable_prefix_cache: bool = False,
                  prefix_cache_watermark: float = 0.5,
+                 prefix_cache_headroom_pages: int = 0,
                  fault_plane=None,
                  steplog: Optional[StepLog] = None,
                  ragged: bool = True,
                  prefill_chunk: Optional[int] = None,
-                 token_budget: Optional[int] = None):
+                 token_budget: Optional[int] = None,
+                 speculate: bool = False,
+                 num_draft_tokens: int = 4,
+                 draft_source="auto"):
         self._engine = engine
         self._max_batch = int(max_batch)
         # resilience plumbing (serving/resilience/): the fault plane is
@@ -150,8 +154,17 @@ class EngineCore:
             self._prefill_chunk = 0
 
         engine.refresh_params()
+        # prefix_cache_headroom_pages widens the pool BEYOND the
+        # worst-case live reservations (slots x max_pages) without
+        # widening any slot's page table: live rows can never reach the
+        # extra pages, so they exist purely as retention room for the
+        # prefix-cache radix tree.  Without headroom a fully occupied
+        # batch evicts retained sequences on admission, which blinds
+        # prefix hits AND the tree-backed speculative draft source.
+        headroom = max(0, int(prefix_cache_headroom_pages)) \
+            if enable_prefix_cache else 0
         self._pool = engine.serving_pool(
-            self._max_batch * self._max_pages + 1)
+            self._max_batch * self._max_pages + 1 + headroom)
         # scratch page: inactive rows' writes land here, reads of live
         # rows never reach it (attention masks by per-row position)
         self._pool.free(self._max_batch)
@@ -167,6 +180,30 @@ class EngineCore:
         self._prefix_cache: Optional[PrefixCache] = (
             PrefixCache(self._pool, page, prefix_cache_watermark)
             if enable_prefix_cache else None)
+
+        # in-engine speculative decoding (docs/SERVING.md "Speculative
+        # decoding"): each decode row may pack up to num_draft_tokens
+        # proposed continuation tokens and ride the SAME mixed step as a
+        # query_len = k+1 verify row under the token budget — drafts
+        # spend only budget LEFT OVER after decode and prefill-chunk
+        # packing, so scheduling and prefill pacing are unchanged.  One
+        # executable (keyed with the static window) serves every
+        # composition, exactly like the plain mixed step.
+        self._speculate = bool(speculate)
+        if self._speculate:
+            if not self._ragged:
+                raise ValueError("speculate=True requires ragged=True "
+                                 "(drafts ride the mixed step)")
+            if int(num_draft_tokens) < 1:
+                raise ValueError("num_draft_tokens must be >= 1")
+            self._spec_window = max(
+                2, min(int(num_draft_tokens) + 1, self._token_budget))
+            from .speculation import resolve_draft_source
+            self._draft_source = resolve_draft_source(
+                draft_source, cache=self._prefix_cache)
+        else:
+            self._spec_window = 1
+            self._draft_source = None
 
         # step-level flight recorder: every scheduler step event
         # (prefill / fused decode chunk / page copy / evict) appends one
@@ -991,25 +1028,82 @@ class EngineCore:
             cfgs[i] = s["g"]
             budget -= n
             chunk_taken[i] = n
+        # speculative drafts: ONLY leftover budget, so decode packing
+        # and prefill pacing are byte-identical to speculate=False.  A
+        # row's drafts stay inside its pool reservation
+        # (k <= remaining - 1) and inside the window (k <= W - 1);
+        # sampled rows take deterministic-by-history proposals only, so
+        # supervisor replay regenerates the identical stream.
+        spec = np.zeros((b,), bool)
+        drafted = {}
+        W = self._spec_window
+        if self._speculate and budget > 0:
+            for s in decode_rows:
+                if budget <= 0:
+                    break
+                i = s["sid"]
+                req = s["req"]
+                remaining = s["g"].max_new_tokens - s["emitted"]
+                k_cap = min(W - 1, remaining - 1, budget)
+                if k_cap <= 0:
+                    continue
+                # host-side history (prompt + delivered tokens) feeds
+                # the draft source; req.tokens is a host list
+                tok_hist = req.tokens
+                # tpulint: disable-next-line=host-sync
+                history = np.concatenate(
+                    # tpulint: disable-next-line=host-sync
+                    [req.prompt, np.asarray(tok_hist, np.int32)])
+                proposal = self._draft_source.propose(
+                    history, k_cap, salt=req.cache_salt,
+                    deterministic_only=bool(s["g"].do_sample))
+                k_row = min(len(proposal), k_cap)
+                if k_row <= 0:
+                    continue
+                # proposals are host ints from the draft source
+                # tpulint: disable-next-line=host-sync
+                ids[i, 1:1 + k_row] = np.asarray(proposal[:k_row],
+                                                 np.int32)
+                qlens[i] = 1 + k_row
+                spec[i] = True
+                budget -= k_row
+                drafted[i] = k_row
+        draft_tokens_step = sum(drafted.values())
         prefill_tokens_step = sum(chunk_taken.values())
         n_decode = len(decode_rows)
         eng = self._engine
         mkey = ("serve-step", b, C, self._max_pages,
                 self._pool.num_blocks)
+        if W > 1:
+            # the speculative executable has its own static window in
+            # the key — still ONE executable per core, warmed once
+            mkey = mkey + (W,)
         clog = get_compile_log()
         c0 = clog.count()
         t0 = time.monotonic()
+        n_emit = None
         try:
             fault = self._fault.fire(
                 "decode.step", rids=[s["req"].rid for s in active])
-            tok, fin_out = eng.run_paged_program(
-                mkey, lambda: build_mixed_step(eng, b, C,
-                                               self._max_pages),
-                ids, qlens, ctx, steps0, sample_now, tables,
-                self._samp_arrays(cfgs), keys,
-                # scratch page id is a host int, no device sync
-                # tpulint: disable-next-line=host-sync
-                np.asarray(self._scratch, np.int32))
+            if W > 1:
+                tok, n_emit, fin_out = eng.run_paged_program(
+                    mkey, lambda: build_mixed_step(eng, b, C,
+                                                   self._max_pages,
+                                                   spec_window=W),
+                    ids, qlens, ctx, steps0, sample_now, spec, tables,
+                    self._samp_arrays(cfgs), keys,
+                    # scratch page id is a host int, no device sync
+                    # tpulint: disable-next-line=host-sync
+                    np.asarray(self._scratch, np.int32))
+            else:
+                tok, fin_out = eng.run_paged_program(
+                    mkey, lambda: build_mixed_step(eng, b, C,
+                                                   self._max_pages),
+                    ids, qlens, ctx, steps0, sample_now, tables,
+                    self._samp_arrays(cfgs), keys,
+                    # scratch page id is a host int, no device sync
+                    # tpulint: disable-next-line=host-sync
+                    np.asarray(self._scratch, np.int32))
         except Exception as e:
             self._metrics.on_failed(0)
             # same contract as the legacy chunk: only a pre-dispatch
@@ -1027,7 +1121,8 @@ class EngineCore:
                 compile_events=clog.count() - c0, faults=injected,
                 retries=sum(s["req"].retries for s in active),
                 failed=True,
-                degraded=self._effective_max_batch < self._max_batch)
+                degraded=self._effective_max_batch < self._max_batch,
+                draft_tokens=draft_tokens_step, spec_rows=len(drafted))
             if getattr(e, "lose_kv", False) or not injected:
                 self._engine.drop_kv_state()
             rec = self._recovery
@@ -1051,6 +1146,9 @@ class EngineCore:
         tok = np.asarray(tok)
         # tpulint: disable-next-line=host-sync
         fin_out = np.asarray(fin_out)
+        if n_emit is not None:
+            # tpulint: disable-next-line=host-sync
+            n_emit = np.asarray(n_emit)
         t_sync = time.monotonic()
         resident = self._used_pages()
         prefix_hits = sum(len(s["match"].blocks)
@@ -1065,6 +1163,7 @@ class EngineCore:
         self._step_idx += 1
         emitted_decode = 0
         emitted_prefill = 0
+        draft_accepted_step = 0
         evicted = []
         now = time.monotonic()
         span_name = ("prefill" if self._prefix_cache is None
@@ -1080,8 +1179,17 @@ class EngineCore:
                 s["pending"] = s["pending"][n:]
                 s["ctx"] += n
             sampled = bool(sample_now[i])
-            t = int(tok[i]) if sampled else 0
-            if req.rid in poisoned or (sampled and t < 0):
+            if n_emit is None:
+                t_row = (np.asarray([int(tok[i])], np.int32) if sampled
+                         else np.zeros((0,), np.int32))
+            else:
+                # speculative step: row i emits its accepted window
+                # prefix (always >= 1 token when it sampled) — the one
+                # intended host readback of this step's tokens
+                # tpulint: disable-next-line=host-sync
+                t_row = np.asarray(tok[i, :int(n_emit[i])], np.int32)
+            bad = t_row.size > 0 and int(t_row.min()) < 0
+            if req.rid in poisoned or (sampled and bad):
                 self._metrics.on_quarantined()
                 self._evict(s, RequestState.FAILED, QuarantinedError(
                     f"request {req.rid} quarantined: non-finite logits "
@@ -1100,22 +1208,24 @@ class EngineCore:
                     # last token and sampled the row's next token
                     if s["steps_base"] == 0:
                         self._metrics.on_prefill(now - req.arrival)
-                    req._emit(np.asarray([t], np.int32))
-                    self._metrics.on_tokens(1)
-                    s["emitted"] += 1
-                    s["last_tok"] = t
+                    req._emit(t_row)
+                    self._metrics.on_tokens(int(t_row.size))
+                    s["emitted"] += int(t_row.size)
+                    s["last_tok"] = int(t_row[-1])
                     s["last_emit"] = now
-                    emitted_prefill += 1
+                    emitted_prefill += int(t_row.size)
             else:
-                req._emit(np.asarray([t], np.int32))
-                s["emitted"] += 1
-                s["last_tok"] = t
+                req._emit(t_row)
+                s["emitted"] += int(t_row.size)
+                s["last_tok"] = int(t_row[-1])
                 s["last_emit"] = now
-                emitted_decode += 1
+                emitted_decode += int(t_row.size)
+                if i in drafted:
+                    draft_accepted_step += max(int(t_row.size) - 1, 0)
                 self.tracer.add_span(req.rid, "decode",
                                      s.get("span_end", t0), now,
                                      step=self._step_idx, chunk_steps=1,
-                                     tokens=1)
+                                     tokens=int(t_row.size))
                 s["span_end"] = now
             if sampled and (bool(fin_out[i])
                             or s["emitted"] >= s["g"].max_new_tokens):
@@ -1130,10 +1240,16 @@ class EngineCore:
             "evicted": evicted})
         kind = ("mixed" if chunk_taken and n_decode else
                 ("prefill" if chunk_taken else "decode"))
+        # verify rows are priced at their true query_len: each draft
+        # token is one more processed position (KV walk + weight pass)
         bts, fl, src_tag = self._cost_model.estimate(
             kind, mkey, rows=len(active), max_rows=b,
             pages_touched=resident, chunk=1,
-            tokens=n_decode + prefill_tokens_step)
+            tokens=n_decode + prefill_tokens_step + draft_tokens_step)
+        if drafted:
+            self._metrics.on_spec(rows=len(drafted),
+                                  proposed=draft_tokens_step,
+                                  accepted=draft_accepted_step)
         end = time.monotonic()
         self.steplog.record(
             kind, wall_s=end - t0, dispatch_s=t_sync - t0,
@@ -1148,7 +1264,10 @@ class EngineCore:
             cost_source=src_tag, compile_events=clog.count() - c0,
             faults=fault is not None,
             retries=sum(s["req"].retries for s in active),
-            degraded=self._effective_max_batch < self._max_batch)
+            degraded=self._effective_max_batch < self._max_batch,
+            draft_tokens=draft_tokens_step,
+            draft_accepted=draft_accepted_step,
+            spec_rows=len(drafted))
         if self._recovery is not None:
             self._recovery.on_step_ok()
 

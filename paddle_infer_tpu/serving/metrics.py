@@ -95,6 +95,10 @@ class ServingMetrics:
             self.tokens_generated = 0
             self.prefills = 0
             self.decode_steps = 0
+            # in-engine speculative decoding (EngineCore speculate=True)
+            self.spec_rows = 0              # row-steps that carried drafts
+            self.spec_drafts_proposed = 0
+            self.spec_drafts_accepted = 0
             # resilience counters (serving/resilience/) — rendered as
             # their own Prometheus families (engine_restarts_total, …),
             # NOT through the auto-named serving_*_total counters block
@@ -164,6 +168,14 @@ class ServingMetrics:
             self.step_wall_hist.observe(wall_ms / 1e3)
             if max_batch > 0:
                 self.occupancy.add(active / max_batch)
+
+    def on_spec(self, rows: int, proposed: int, accepted: int):
+        """One mixed step verified ``proposed`` draft tokens across
+        ``rows`` speculating rows and accepted ``accepted`` of them."""
+        with self._lock:
+            self.spec_rows += rows
+            self.spec_drafts_proposed += proposed
+            self.spec_drafts_accepted += accepted
 
     def on_queue_wait(self, wait_s: float):
         """One request left the admission queue after ``wait_s``."""
@@ -250,6 +262,23 @@ class ServingMetrics:
                     "tokens_generated": self.tokens_generated,
                     "prefills": self.prefills,
                     "decode_steps": self.decode_steps,
+                    "spec_rows": self.spec_rows,
+                    "spec_drafts_proposed": self.spec_drafts_proposed,
+                    "spec_drafts_accepted": self.spec_drafts_accepted,
+                },
+                "speculation": {
+                    "rows": self.spec_rows,
+                    "drafts_proposed": self.spec_drafts_proposed,
+                    "drafts_accepted": self.spec_drafts_accepted,
+                    "acceptance_rate": (
+                        self.spec_drafts_accepted
+                        / self.spec_drafts_proposed
+                        if self.spec_drafts_proposed else 0.0),
+                    "wasted_ratio": (
+                        (self.spec_drafts_proposed
+                         - self.spec_drafts_accepted)
+                        / self.spec_drafts_proposed
+                        if self.spec_drafts_proposed else 0.0),
                 },
                 "tokens_per_second": tps,
                 "ttft_s": self.ttft.summary(),

@@ -192,6 +192,50 @@ class PrefixCache:
                 self.cached_tokens_total += m.cached_tokens
             return m
 
+    def lookahead(self, tokens, k, salt=None):
+        """Read-only draft proposal: the tree is a free suffix index, so
+        a row whose history ``tokens`` is a cached prefix can read the
+        next up-to-``k`` cached continuation tokens straight out of the
+        chunk keys (token ids live in the dict keys — no device reads,
+        no pins, no LRU clock movement).  Returns a possibly-empty list;
+        ties between sibling continuations resolve in insertion order.
+        Proposals are only as good as the cache — acceptance, never
+        correctness, depends on them."""
+        if k <= 0:
+            return []
+        toks = [int(t) for t in tokens]
+        with self._lock:
+            node = self._roots.get(salt)
+            depth = 0
+            while node is not None and (depth + 1) * self.page <= len(toks):
+                chunk = tuple(toks[depth * self.page:
+                                   (depth + 1) * self.page])
+                node = node.children.get(chunk)
+                depth += 1
+            if node is None:
+                return []
+            rem = tuple(toks[depth * self.page:])
+            out: List[int] = []
+            while len(out) < k:
+                nxt = None
+                for chunk, child in node.children.items():
+                    if len(chunk) > len(rem) and chunk[:len(rem)] == rem:
+                        out.extend(chunk[len(rem):])
+                        nxt = child
+                        break
+                if nxt is None:
+                    best = None
+                    for ptoks in node.partials:
+                        if (len(ptoks) > len(rem)
+                                and ptoks[:len(rem)] == rem
+                                and (best is None or len(ptoks) > len(best))):
+                            best = ptoks
+                    if best is not None:
+                        out.extend(best[len(rem):])
+                    break
+                node, rem = nxt, ()
+            return out[:k]
+
     def release(self, match: PrefixMatch):
         """Unpin a match's nodes (request left its slot)."""
         with self._lock:

@@ -89,7 +89,8 @@ def _pick_rows(proc, samp, steps, keys):
     return jnp.where(samp["do_sample"], sampled, greedy).astype(jnp.int32)
 
 
-def build_mixed_step(engine, max_batch, token_budget, max_pages):
+def build_mixed_step(engine, max_batch, token_budget, max_pages,
+                     spec_window=1):
     """THE ragged serving executable: one launch per scheduler step,
     whatever the batch composition.  Row ``b`` carries ``qlens[b]``
     query tokens starting at absolute position ``ctx[b]`` — 1 for a
@@ -115,7 +116,37 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages):
     stream and the min-length window are IDENTICAL to the legacy
     per-program path — that, plus the attention composition in
     ``ops/pallas/ragged_paged_attention.py`` reusing the legacy paths'
-    exact math per row type, is the bitwise-parity guarantee."""
+    exact math per row type, is the bitwise-parity guarantee.
+
+    ``spec_window = W > 1`` builds the speculative draft/verify variant
+    instead (EngineCore ``speculate=True``; the non-speculative
+    executable above is returned VERBATIM for ``W == 1`` so existing
+    cores are untouched).  A speculating decode row packs
+    ``[last_tok, d_1..d_k]`` (``k <= W - 1`` drafts, ``qlens = k + 1``)
+    and its ``spec`` flag routes the first W query positions through
+    per-position decode-kernel attention (the 7-element cache /
+    ``verify_rows`` path), so position ``j``'s logits are bitwise what
+    sequential step ``steps0 + j`` would compute.  Acceptance is the
+    shared rule in ``inference/spec_accept.py``: greedy rows accept the
+    longest draft prefix matching the per-position argmax chain —
+    token-identical to ``speculate=False``; sampled rows accept ``d_j``
+    with probability ``p_j(d_j)`` (point-mass proposal) and resample
+    the first rejection from the draft-masked residual, so the emitted
+    marginal is exactly the non-speculative sampling distribution.
+    Accepted positions reuse the SAME ``fold_in(base, steps0 + j)``
+    stream as sequential decode (accept tests / rejection resamples
+    draw from the disjoint ``fold_in(fold_in(base, step), 1|2)``
+    streams), so a non-spec row reproduces the plain step bit-for-bit.
+
+    Spec signature: ``run(params, ids[b, C], qlens, ctx, steps0,
+    sample_now, spec[b] bool, tables, samp, keys, scratch, k_pages,
+    v_pages)`` → ``(out[b, W], n_emit[b], fin[b], k_pages, v_pages)``
+    — row ``i`` emits ``out[i, :n_emit[i]]`` (truncated at its first
+    eos; 0 when ``sample_now`` is off).  Rejected-tail KV needs NO pool
+    ops: stale entries at positions ``>= ctx + n_emit`` sit inside the
+    row's reservation, are never attended (every read masks by the
+    row's true length) and are overwritten before they become
+    visible."""
     L = engine._num_layers
     C = token_budget
 
@@ -146,7 +177,110 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages):
         return (tok, fin,
                 [c[0] for c in caches], [c[1] for c in caches])
 
-    return jax.jit(run, donate_argnums=(10, 11))
+    W = int(spec_window)
+    if W <= 1:
+        return jax.jit(run, donate_argnums=(10, 11))
+
+    from ..inference import spec_accept
+
+    def run_spec(params, ids, qlens, ctx, steps0, sample_now, spec,
+                 tables, samp, keys, scratch, k_pages, v_pages):
+        b = ids.shape[0]
+        spec2d = jnp.broadcast_to(spec[:, None], (b, W))
+        caches = [(k_pages[i], v_pages[i], tables, ctx, qlens, scratch,
+                   spec2d) for i in range(L)]
+        i2d = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32)[None],
+                               (b, C))
+        pos2d = jnp.where(i2d < qlens[:, None], ctx[:, None] + i2d, 0)
+        logits, caches = engine._model_step(params, ids, pos2d, None,
+                                            caches)
+
+        # per-window-position logits: spec rows read positions 0..W-1
+        # (clamped to their qlen), plain rows replicate qlens-1 so
+        # their column 0 is exactly the non-spec gather
+        base = jnp.maximum(qlens - 1, 0)                       # [b]
+        j = jnp.arange(W, dtype=jnp.int32)[None]               # [1, W]
+        gidx = jnp.where(spec[:, None], jnp.minimum(j, base[:, None]),
+                         base[:, None])                        # [b, W]
+        lg_w = jnp.take_along_axis(logits, gidx[:, :, None], axis=1)
+        steps_w = steps0[:, None] + jnp.where(spec[:, None], j, 0)
+        proc_w = jax.vmap(_process_rows, in_axes=(1, None, 1),
+                          out_axes=1)(lg_w, samp, steps_w)     # [b, W, V]
+        chosen_w = jax.vmap(
+            lambda p, st: _pick_rows(p, samp, st, keys),
+            in_axes=(1, 1), out_axes=1)(proc_w, steps_w)       # [b, W]
+
+        # drafts ride at ids[:, 1 + j]; position j carries one only on
+        # spec rows with j < qlens - 1
+        didx = jnp.broadcast_to(jnp.minimum(j[:, :W - 1] + 1, C - 1),
+                                (b, W - 1))
+        drafts = jnp.take_along_axis(ids, didx, axis=1)        # [b, W-1]
+        has_draft = jnp.logical_and(spec[:, None],
+                                    j[:, :W - 1] < base[:, None])
+
+        # greedy accept: draft matches the per-position argmax chain;
+        # sampled accept (point-mass proposal): u < p_j(d_j) under the
+        # row's processed distribution, u from the disjoint
+        # fold_in(fold_in(base, step), 1) stream
+        greedy_acc = drafts == chosen_w[:, :W - 1]
+        p_w = jax.nn.softmax(proc_w[:, :W - 1], axis=-1)
+        p_draft = jnp.take_along_axis(
+            p_w, drafts[:, :, None], axis=2)[:, :, 0]          # [b, W-1]
+        u = jax.vmap(jax.vmap(
+            lambda k, st: jax.random.uniform(
+                jax.random.fold_in(jax.random.fold_in(k, st), 1)),
+            in_axes=(None, 0)))(keys, steps_w[:, :W - 1])
+        samp_acc = spec_accept.rejection_accept(
+            u, p_draft, jnp.ones_like(p_draft))
+        acc = jnp.where(samp["do_sample"][:, None], samp_acc,
+                        greedy_acc)
+        acc = jnp.logical_and(acc, has_draft)
+        a = spec_accept.accepted_prefix_len(acc)               # [b]
+
+        # token at the cut: greedy correction / bonus / plain token all
+        # reuse the chain's own choice at position a; a sampled
+        # REJECTION instead resamples from the residual (processed
+        # logits with the draft masked — exact for a point mass)
+        proc_a = jnp.take_along_axis(
+            proc_w, a[:, None, None], axis=1)[:, 0]            # [b, V]
+        draft_a = jnp.take_along_axis(
+            drafts, jnp.minimum(a, W - 2)[:, None], axis=1)[:, 0]
+        resid = spec_accept.residual_logits_point_mass(proc_a, draft_a)
+        rkeys = jax.vmap(
+            lambda k, st: jax.random.fold_in(
+                jax.random.fold_in(k, st), 2))(keys, steps0 + a)
+        resample = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row))(
+                rkeys, resid).astype(jnp.int32)
+        chain_a = jnp.take_along_axis(chosen_w, a[:, None], axis=1)[:, 0]
+        rejected = jnp.logical_and(
+            samp["do_sample"],
+            jnp.logical_and(spec, a < base))                   # [b]
+        pick = jnp.where(rejected, resample, chain_a)
+
+        # window emit: accepted drafts, then the cut token, truncated
+        # at the row's first eos
+        jf = jnp.arange(W, dtype=jnp.int32)[None]              # [1, W]
+        drafts_full = jnp.pad(drafts, ((0, 0), (0, 1)))        # [b, W]
+        pad = samp["pad"][:, None]
+        out = jnp.where(jf < a[:, None], drafts_full,
+                        jnp.where(jf == a[:, None], pick[:, None], pad))
+        r = a + 1
+        is_eos = jnp.logical_and(
+            jnp.logical_and(samp["eos"][:, None] >= 0,
+                            out == samp["eos"][:, None]),
+            jf < r[:, None])
+        any_eos = jnp.any(is_eos, axis=1)
+        r = jnp.where(any_eos, jnp.argmax(is_eos, axis=1) + 1, r)
+        out = jnp.where(
+            jnp.logical_and(sample_now[:, None], jf < r[:, None]),
+            out, pad).astype(jnp.int32)
+        n_emit = jnp.where(sample_now, r, 0).astype(jnp.int32)
+        fin = jnp.logical_and(sample_now, any_eos)
+        return (out, n_emit, fin,
+                [c[0] for c in caches], [c[1] for c in caches])
+
+    return jax.jit(run_spec, donate_argnums=(11, 12))
 
 
 # legacy ragged=False path: one executable per plen bucket is the

@@ -301,6 +301,100 @@ def test_traced_branch_silent_on_static_constructs(tmp_path):
     assert run_rules(tmp_path, src, ["traced-branch"]) == []
 
 
+def test_traced_branch_fires_on_tainted_local(tmp_path):
+    """The speculative-decoding port bug: a per-row acceptance count
+    computed with jnp lands in a local, then Python branches on it."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def verify(accept_mask, drafts):
+            n = jnp.argmin(accept_mask, axis=1)
+            if n > 0:
+                return drafts[:n]
+            while n < 4:
+                n = n + 1
+            return drafts
+    """
+    fs = run_rules(tmp_path, src, ["traced-branch"])
+    assert len(fs) == 2
+    assert any("`if`" in f.message and "local 'n'" in f.message
+               for f in fs)
+    assert any("`while`" in f.message for f in fs)
+
+
+def test_traced_branch_taint_cleared_by_host_reassignment(tmp_path):
+    """Reassigning the local from a host expression clears its taint;
+    static reads (shape/len) never taint in the first place."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            n = jnp.argmax(x)
+            n = 3
+            if n > 0:
+                x = x * 2
+            b = x.shape[0]
+            if b > 1:
+                x = x + 1
+            k = len(x)
+            if k > 2:
+                x = x - 1
+            return x
+    """
+    assert run_rules(tmp_path, src, ["traced-branch"]) == []
+
+
+def test_traced_branch_taint_propagates_through_locals(tmp_path):
+    """Taint flows local-to-local: y = n + 1 keeps the hazard alive."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            n = jnp.sum(x)
+            y = n + 1
+            if y > 0:
+                return x * 2
+            return x
+    """
+    fs = run_rules(tmp_path, src, ["traced-branch"])
+    assert len(fs) == 1
+    assert "local 'y'" in fs[0].message
+
+
+def test_traced_branch_mapping_keys_stay_static(tmp_path):
+    """Iterating a traced pytree mapping yields trace-time-static KEYS:
+    branching on the key is clean, branching on the value fires."""
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(params, other):
+            acc = 0.0
+            for name in params.keys():
+                if name == "bias":
+                    acc = acc + 1.0
+            for name, arr in params.items():
+                if name.startswith("w"):
+                    acc = acc + 1.0
+                if arr is None:
+                    continue
+            for name, arr in params.items():
+                if arr > 0:
+                    acc = acc + 1.0
+            return acc
+    """
+    fs = run_rules(tmp_path, src, ["traced-branch"])
+    assert len(fs) == 1
+    assert "local 'arr'" in fs[0].message
+
+
 # ----------------------------------------------------- missing-donation
 def test_donation_fires_on_undonated_kv(tmp_path):
     src = """

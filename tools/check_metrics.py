@@ -64,6 +64,11 @@ def fabricated_exposition():
                    cost_source="xla+pages", decode_rows=3,
                    prefill_chunk_tokens=16, emitted_tokens=4,
                    kernel="ragged")
+    steplog.record("mixed", wall_s=0.017, dispatch_s=0.013,
+                   bytes_est=1.8e6, flops_est=5.0e6,
+                   cost_source="xla+pages", decode_rows=3,
+                   emitted_tokens=7, draft_tokens=6, draft_accepted=4,
+                   spec_rows=2, kernel="ragged")
     steplog.record("evict", pages_freed=3, bytes_est=3.0e5,
                    cost_source="analytic")
 
@@ -78,6 +83,7 @@ def fabricated_exposition():
     m.on_tokens(4, itl_s=0.010)
     m.on_tokens(3, itl_s=0.012)
     m.on_step(3.5, active=2, max_batch=4)
+    m.on_spec(rows=2, proposed=6, accepted=4)
     m.on_queue_wait(0.004)
     m.on_queue_wait(0.020)
     m.on_completed(0.5)

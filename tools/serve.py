@@ -105,9 +105,14 @@ def _core():
                                                False),
                 prefix_cache_watermark=_STATE.get(
                     "prefix_cache_watermark", 0.5),
+                prefix_cache_headroom_pages=_STATE.get(
+                    "prefix_cache_headroom_pages", 0),
                 ragged=_STATE.get("ragged", True),
                 prefill_chunk=_STATE.get("prefill_chunk"),
                 token_budget=_STATE.get("token_budget"),
+                speculate=_STATE.get("speculate", False),
+                num_draft_tokens=_STATE.get("num_draft_tokens", 4),
+                draft_source=_STATE.get("draft_source", "auto"),
                 fault_plane=plane)
             _STATE["sup"] = EngineSupervisor(
                 core,
@@ -491,6 +496,12 @@ def main(argv=None):
                     help="retained cache blocks are LRU-evicted down to "
                          "this fraction of the KV pool after each "
                          "request release")
+    ap.add_argument("--prefix_cache_headroom_pages", type=int, default=0,
+                    help="extra KV pool pages beyond the live-slot "
+                         "reservations, reachable only by prefix-cache "
+                         "retention — keeps the radix tree (and the "
+                         "tree-backed speculative draft source) resident "
+                         "under a full batch (docs/SERVING.md)")
     ap.add_argument("--prompt_bucket", type=int, default=None,
                     help="DEPRECATED no-op: ragged mixed-batch attention "
                          "removed prompt bucketing (prompts are chunked "
@@ -515,7 +526,20 @@ def main(argv=None):
     ap.add_argument("--draft_dir", default=None,
                     help="optional draft model for speculative decoding "
                          "of greedy requests")
-    ap.add_argument("--num_draft_tokens", type=int, default=4)
+    ap.add_argument("--num_draft_tokens", type=int, default=4,
+                    help="draft tokens proposed per speculating row "
+                         "(verify rows ride the mixed step with "
+                         "query_len up to num_draft_tokens+1)")
+    ap.add_argument("--speculate", action="store_true",
+                    help="in-engine speculative decoding: draft/verify "
+                         "rows inside the ragged mixed step (requires "
+                         "the ragged scheduler, i.e. not "
+                         "--legacy_programs)")
+    ap.add_argument("--draft_source", default="auto",
+                    choices=("auto", "ngram", "prefix_cache"),
+                    help="where draft tokens come from: prompt-lookup "
+                         "ngrams, the prefix-cache radix tree, or auto "
+                         "(tree when cached, ngram fallback)")
     ap.add_argument("--watchdog_s", type=float, default=5.0,
                     help="supervisor hung-step threshold in seconds "
                          "(trips DEGRADED + watchdog_trips_total)")
@@ -542,6 +566,7 @@ def main(argv=None):
     _STATE["max_model_len"] = args.max_model_len
     _STATE["enable_prefix_cache"] = args.enable_prefix_cache
     _STATE["prefix_cache_watermark"] = args.prefix_cache_watermark
+    _STATE["prefix_cache_headroom_pages"] = args.prefix_cache_headroom_pages
     if args.prompt_bucket is not None:
         print("warning: --prompt_bucket is deprecated and ignored — "
               "ragged mixed-batch attention schedules prompts under "
@@ -553,6 +578,8 @@ def main(argv=None):
     _STATE["draft_model"] = (AutoModel.from_pretrained(args.draft_dir)
                              if args.draft_dir else None)
     _STATE["num_draft_tokens"] = args.num_draft_tokens
+    _STATE["speculate"] = args.speculate
+    _STATE["draft_source"] = args.draft_source
     _STATE["watchdog_s"] = args.watchdog_s
     _STATE["max_retries"] = args.max_retries
     fault_script = args.fault_script
