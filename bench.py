@@ -609,6 +609,12 @@ def _child_main():
                              lambda: _resilience_bench(on_tpu),
                              tpu_only=False)
 
+    # mp=2 sharded serving: stream parity + interconnect bytes with and
+    # without the int8 all-reduce wire format (subprocess: the section
+    # needs its own 2-virtual-device backend)
+    sharded_serving = run_section("sharded_serving", 500,
+                                  _sharded_serving_bench, tpu_only=False)
+
     result = {
         **headline,
         "tokens_per_sec_single_block": round(tokens_per_sec_single, 1),
@@ -665,6 +671,8 @@ def _child_main():
         result["prefix_cache"] = prefix_cache
     if resilience is not None:
         result["resilience"] = resilience
+    if sharded_serving is not None:
+        result["sharded_serving"] = sharded_serving
     if skipped_sections:
         result["skipped_sections"] = skipped_sections
     result["child_wall_s"] = round(time.monotonic() - child_t0, 1)
@@ -1427,6 +1435,46 @@ def _resilience_bench(on_tpu: bool):
         "recovery_overhead": round(fault_wall / base_wall, 2),
         "health_state_final": res["health_state"],
     }
+
+
+def _sharded_serving_bench():
+    """mp=2 tensor-parallel serving evidence (docs/SERVING.md 'Sharded
+    serving'): bitwise stream parity vs single-device, tokens/s, and
+    the per-step interconnect bytes with exact vs int8-quantized mp
+    all-reduces (plus the quantized format's measured error next to its
+    analytic bound).  Runs ``tools/bench_sharded_child.py`` in a
+    subprocess with two forced CPU host devices — the same
+    ``XLA_FLAGS`` pattern as ``__graft_entry__.dryrun_multichip`` —
+    because this process's backend is already initialized single-
+    device."""
+    env = os.environ.copy()
+    env.pop("PALLAS_AXON_POOL_IPS", None)      # axon shim hangs CPU
+    env.pop("PIT_BENCH_REQUIRE_TPU", None)
+    env.pop("PIT_BENCH_CHILD", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (flags
+                        + " --xla_force_host_platform_device_count=2") \
+        .strip()
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "bench_sharded_child.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    for ln in reversed(proc.stdout.strip().splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                out = json.loads(ln)
+            except ValueError:
+                continue
+            if "error" in out:
+                raise RuntimeError(out["error"])
+            return out
+    tail = (proc.stderr.strip().splitlines() or ["no output"])[-1][:300]
+    raise RuntimeError(f"sharded child rc={proc.returncode}: {tail}")
 
 
 def _kernel_summary() -> str:

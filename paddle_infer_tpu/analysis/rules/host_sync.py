@@ -14,6 +14,13 @@ property reads) to the full set of hot methods, then flags sync
 constructs inside them.  Intentional chunk-boundary syncs stay, with a
 ``# tpulint: disable=host-sync`` comment saying why — the suppression
 is the documentation.
+
+Eager collectives count too: a ``parallel.collective.all_reduce`` (or
+any sibling from that module) issued from host serving code dispatches
+a standalone collective program and blocks every mesh participant at a
+rendezvous — a cross-device sync strictly worse than a local readback.
+Collectives belong *inside* traced step programs (GSPMD inserts them)
+or behind the quantized shard_map ops, never in the scheduler loop.
 """
 from __future__ import annotations
 
@@ -26,6 +33,12 @@ HOT_ROOTS = {"run_once", "_run_once_locked", "step", "_decode_step",
              "decode_step"}
 
 _SYNC_DOTTED = {"jax.device_get", "jax.block_until_ready"}
+# Eager collective entry points (parallel/collective.py): each call from
+# host code is a standalone dispatched program plus a cross-device
+# rendezvous — every mesh participant stalls, not just this host thread.
+_COLLECTIVE_FNS = {"all_reduce", "all_gather", "reduce_scatter",
+                   "broadcast", "alltoall", "ppermute", "p2p_transfer",
+                   "barrier", "reduce"}
 _NP_CONVERT = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
                "np.copy", "numpy.copy"}
 _LITERALS = (ast.Constant, ast.List, ast.Tuple, ast.Dict, ast.Set,
@@ -102,6 +115,12 @@ class HostSyncRule(Rule):
         d = dotted(func)
         if d in _SYNC_DOTTED:
             return f"{d}()"
+        if "." in d:
+            prefix, _, last = d.rpartition(".")
+            if last in _COLLECTIVE_FNS and "collective" in prefix:
+                return (f"eager collective {d}() (cross-device "
+                        "rendezvous; belongs inside the traced step "
+                        "program)")
         if d in _NP_CONVERT and call.args \
                 and not isinstance(call.args[0], _LITERALS):
             return f"{d}() on a possibly-device value"

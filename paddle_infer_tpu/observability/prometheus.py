@@ -342,6 +342,47 @@ def render_prometheus(snapshot: dict,
                  "estimate and measured decode step wall")
         w.sample("steplog_model_pearson_r", model.get("pearson_r"))
 
+    sh = snapshot.get("sharding") or {}
+    if sh:
+        axes = sh.get("mesh_axes") or {}
+        w.family("serving_mesh_info", "gauge",
+                 "Serving mesh topology as labels (constant 1): "
+                 "mp/dp degrees, device count, quantized-allreduce "
+                 "wire format")
+        w.sample("serving_mesh_info", 1, {
+            "mp": axes.get("mp", 1), "dp": axes.get("dp", 1),
+            "devices": sh.get("devices", 1),
+            "quantized_allreduce": sh.get("quantized_allreduce") or "off"})
+        w.family("serving_shard_sharded_params", "gauge",
+                 "Served parameters placed with at least one "
+                 "mesh-sharded dimension")
+        w.sample("serving_shard_sharded_params",
+                 sh.get("sharded_params", 0))
+        w.family("serving_shard_replicated_params", "gauge",
+                 "Served parameters silently replicated because a "
+                 "stamped TP axis does not divide their dimension "
+                 "(TP-coverage regressions)")
+        w.sample("serving_shard_replicated_params",
+                 sh.get("replicated_params", 0))
+        col = sh.get("collectives") or {}
+        w.family("collective_bytes_total", "counter",
+                 "Analytic interconnect bytes moved by collectives, "
+                 "by op and wire dtype (ring model)")
+        by_op = col.get("by_op_dtype") or {}
+        if by_op:
+            for op in sorted(by_op):
+                for dt in sorted(by_op[op]):
+                    w.sample("collective_bytes_total", by_op[op][dt],
+                             {"op": op, "dtype": dt})
+        else:
+            w.sample("collective_bytes_total", 0,
+                     {"op": "none", "dtype": "none"})
+        w.family("collective_bytes_saved_total", "counter",
+                 "Interconnect bytes saved by quantized collective "
+                 "wire formats vs their full-precision equivalent")
+        w.sample("collective_bytes_saved_total",
+                 col.get("bytes_saved_total", 0.0))
+
     for key, (family, help_text) in SERIES_FAMILIES.items():
         series = snapshot.get(key)
         if not isinstance(series, dict):

@@ -69,6 +69,10 @@ _SCHEMA = (
     ("pages_freed", 0),          # pages released (evict records)
     ("bytes_est", 0.0),          # analytic bytes-moved estimate
     ("flops_est", 0.0),          # analytic FLOPs estimate
+    ("ici_bytes_est", 0.0),      # analytic interconnect bytes (mp
+                                 # all-reduces; 0 single-device)
+    ("ici_bytes_saved_est", 0.0),  # interconnect bytes the quantized
+                                   # wire format saved vs fp
     ("cost_source", "none"),     # xla+pages | analytic | none
     ("compile_events", 0),       # CompileLog events during the step
     ("faults", False),           # fault plane fired during the step
@@ -106,6 +110,29 @@ class StepCostModel:
         self._pool_bytes = self._page_kv_bytes * self._pool_pages
         self._weight_bytes: Optional[float] = None
         self._n_params: Optional[float] = None
+        # interconnect model: tensor-parallel serving runs 2 mp
+        # all-reduces per layer (attention out-proj + MLP fc2), each
+        # moving one [tokens, hidden] activation over the ring
+        self._hidden = int(engine._num_heads * engine._head_dim)
+        self._layers = int(engine._num_layers)
+        self._quant = getattr(engine, "_quant_allreduce", None)
+        self._mp = 1
+        mesh = getattr(engine, "_mesh", None)
+        if mesh is not None:
+            try:
+                from ..parallel.topology import axis_if_divides
+
+                if axis_if_divides(mesh, "mp", self._hidden):
+                    self._mp = int(dict(mesh.shape).get("mp", 1))
+            except Exception:
+                pass
+        try:
+            import numpy as np
+
+            self._act_itemsize = int(np.dtype(next(
+                iter(engine._params.values())).dtype).itemsize)
+        except Exception:
+            self._act_itemsize = 4
 
     @property
     def page_kv_bytes(self) -> float:
@@ -125,6 +152,35 @@ class StepCostModel:
                 self._weight_bytes = 1.0
                 self._n_params = 1.0
         return self._weight_bytes, self._n_params
+
+    def interconnect(self, tokens: int):
+        """``(ici_bytes_est, ici_bytes_saved_est)`` for one step that
+        computed ``tokens`` query tokens: 2 mp all-reduces per layer of
+        a [tokens, hidden] activation, ring model 2(r-1)/r of the
+        payload per rank.  Saved is the fp-vs-int8 wire delta when the
+        engine serves with the quantized format; (0, 0) single-device.
+
+        The estimate is also fed into the collective-bytes ledger under
+        op "mp_allreduce" — these reductions are GSPMD-inserted (or
+        hidden inside the mp_quant_matmul shard_map), so no explicit
+        ``collective.*`` call ever accounts for them."""
+        if self._mp <= 1 or tokens is None or tokens <= 0:
+            return 0.0, 0.0
+        from ..parallel.collective import LEDGER, quantized_wire_bytes
+
+        n_elems = int(tokens) * self._hidden
+        per_reduce_q, per_reduce_fp = quantized_wire_bytes(
+            n_elems, self._mp, self._act_itemsize)
+        n_reduces = 2.0 * self._layers
+        if self._quant:
+            moved = n_reduces * per_reduce_q
+            saved = n_reduces * max(per_reduce_fp - per_reduce_q, 0.0)
+            LEDGER.record("mp_allreduce", "int8", moved, saved=saved)
+            return moved, saved
+        moved = n_reduces * per_reduce_fp
+        LEDGER.record("mp_allreduce", f"float{8 * self._act_itemsize}",
+                      moved)
+        return moved, 0.0
 
     def static_cost(self, key) -> Optional[dict]:
         getter = getattr(self._engine, "program_cost", None)
@@ -236,6 +292,8 @@ class StepLog:
         self._by_kind: Dict[str, int] = {}
         self._bytes_total = 0.0
         self._flops_total = 0.0
+        self._ici_bytes_total = 0.0
+        self._ici_saved_total = 0.0
         self._compile_total = 0
         self._chunk_tokens_total = 0
         self._draft_tokens_total = 0
@@ -264,6 +322,8 @@ class StepLog:
                 self._by_kind.get(rec["kind"], 0) + 1
             self._bytes_total += float(rec["bytes_est"])
             self._flops_total += float(rec["flops_est"])
+            self._ici_bytes_total += float(rec["ici_bytes_est"])
+            self._ici_saved_total += float(rec["ici_bytes_saved_est"])
             self._compile_total += int(rec["compile_events"])
             self._chunk_tokens_total += int(rec["prefill_chunk_tokens"])
             self._draft_tokens_total += int(rec["draft_tokens"])
@@ -305,6 +365,8 @@ class StepLog:
             self._total = 0
             self._bytes_total = 0.0
             self._flops_total = 0.0
+            self._ici_bytes_total = 0.0
+            self._ici_saved_total = 0.0
             self._compile_total = 0
             self._chunk_tokens_total = 0
             self._draft_tokens_total = 0
@@ -322,6 +384,8 @@ class StepLog:
                 "by_kernel": dict(self._by_kernel),
                 "bytes_est_total": self._bytes_total,
                 "flops_est_total": self._flops_total,
+                "ici_bytes_est_total": self._ici_bytes_total,
+                "ici_bytes_saved_total": self._ici_saved_total,
                 "compile_events_total": self._compile_total,
                 "prefill_chunk_tokens_total": self._chunk_tokens_total,
                 "draft_tokens_total": self._draft_tokens_total,

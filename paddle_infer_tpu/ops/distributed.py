@@ -102,6 +102,50 @@ def _sharding_constraint_grad(ctx, g):
     return (Tensor(_constrain(g._data, spec)),)
 
 
+# ------------------------------------------- quantized row-parallel matmul
+
+@register_op("mp_quant_matmul", save_inputs=False, jit=False)
+def _mp_quant_matmul(x, w, block=None):
+    """Row-parallel matmul (``x @ w`` with ``w`` sharded ("mp", None))
+    whose partial-sum all-reduce uses the blockwise-int8 wire format.
+
+    GSPMD owns the all-reduce on the default path, so there is no seam
+    to swap the wire format there; this op instead computes the partial
+    matmul explicitly under shard_map (same pattern as
+    ``ops.attention._mesh_sharded_attn``) and reduces it with
+    ``collective.quantized_psum``.  Falls back to a plain matmul +
+    replicated constraint when no divisible mp axis is active, so the
+    op is safe to trace on any mesh."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.collective import _Q8_BLOCK, quantized_psum
+    from ..parallel.topology import shard_map_norep
+
+    block = int(block) if block else _Q8_BLOCK
+    mesh = topology.get_current_mesh()
+    mp = dict(mesh.shape).get("mp", 1) if mesh is not None else 1
+    if (mesh is None or mp <= 1 or x.shape[-1] % mp
+            or w.shape[0] % mp or x.shape[-1] != w.shape[0]):
+        y = jnp.matmul(x, w)
+        return _constrain(y, ("data",) + (None,) * (y.ndim - 1))
+
+    xspec = P(*([None] * (x.ndim - 1) + ["mp"]))
+
+    def body(xs, ws):
+        return quantized_psum(jnp.matmul(xs, ws), "mp", mp, block)
+
+    return shard_map_norep(body, mesh, in_specs=(xspec, P("mp", None)),
+                           out_specs=P())(x, w)
+
+
+@register_grad("mp_quant_matmul")
+def _mp_quant_matmul_grad(ctx, g):
+    raise NotImplementedError(
+        "mp_quant_matmul is a serving-only (inference) op; train with the "
+        "exact GSPMD row-parallel path instead")
+
+
 # -------------------------------------------------- sequence parallelism
 # (new design — absent from the reference, SURVEY.md §5.7)
 

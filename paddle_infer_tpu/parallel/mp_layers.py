@@ -87,6 +87,14 @@ class RowParallelLinear(Layer):
             if has_bias else None
 
     def forward(self, x):
+        if self._quantized_allreduce_active(x):
+            # opt-in serving path: explicit partial matmul + blockwise
+            # int8 all-reduce instead of the GSPMD-inserted exact one
+            y = D("mp_quant_matmul", x, self.weight)
+            if self.bias is not None:
+                y = D("add", y, self.bias)
+            return D("sharding_constraint", y,
+                     spec=("data",) + (None,) * (y.ndim - 1))
         if self.input_is_parallel:
             spec = ("data",) + (None,) * (x.ndim - 2) + ("mp",)
             x = D("sharding_constraint", x, spec=spec)
@@ -96,6 +104,18 @@ class RowParallelLinear(Layer):
         if self.bias is not None:
             y = D("add", y, self.bias)
         return y
+
+    def _quantized_allreduce_active(self, x) -> bool:
+        """Trace-time check: quantized mode is set, and the active mesh
+        has an mp axis that divides the reduction dim."""
+        from . import topology
+        if topology.get_quantized_allreduce() is None:
+            return False
+        mesh = topology.get_current_mesh()
+        if mesh is None or getattr(x, "ndim", 0) < 2:
+            return False
+        return topology.axis_if_divides(
+            mesh, "mp", self.in_features) is not None
 
 
 class VocabParallelEmbedding(Layer):

@@ -288,6 +288,26 @@ def get_current_mesh() -> Optional[Mesh]:
     return _CURRENT_MESH
 
 
+# Opt-in quantized wire format for the mp all-reduces traced while the
+# flag is set (row-parallel serving matmuls check it at trace time).
+# Scoped, not sticky: generation._MeshContext sets it for the engine that
+# owns the trace and restores the previous value on exit.
+_QUANTIZED_ALLREDUCE: Optional[str] = None
+
+
+def set_quantized_allreduce(mode: Optional[str]):
+    if mode not in (None, "int8"):
+        raise ValueError(
+            f"unsupported quantized all-reduce mode {mode!r}; "
+            "expected None or 'int8'")
+    global _QUANTIZED_ALLREDUCE
+    _QUANTIZED_ALLREDUCE = mode
+
+
+def get_quantized_allreduce() -> Optional[str]:
+    return _QUANTIZED_ALLREDUCE
+
+
 def named_sharding(*spec) -> Optional[NamedSharding]:
     mesh = get_current_mesh()
     if mesh is None:

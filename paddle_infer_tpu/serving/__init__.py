@@ -26,6 +26,11 @@ Layer map:
                       (``EngineSupervisor``) and the HEALTHY/DEGRADED/
                       DRAINING/DOWN health state machine driving
                       ``/healthz``/``/readyz`` and load shedding.
+  ``sharded``         the tensor-parallel serving plane: ``ServingMesh``
+                      (mp × dp topology + quantized-allreduce wire
+                      format), ``build_sharded_engine`` and the
+                      config validation EngineCore re-runs against its
+                      feature flags (docs/SERVING.md "Sharded serving").
 
 Requests with per-request sampling configs share one decode executable:
 temperature/top-k/top-p/eos ride as *per-row arrays* (serving/programs),
@@ -39,8 +44,14 @@ from .request import (DeadlineExceededError, LoadShedError,
 from .engine_core import EngineCore
 from .resilience import (EngineSupervisor, FaultPlane, FaultSpec,
                          HealthMonitor, HealthState)
+from .sharded import (ServingMesh, ShardedConfigError,
+                      build_sharded_engine, validate_serving_config)
 
 __all__ = [
+    "ServingMesh",
+    "ShardedConfigError",
+    "build_sharded_engine",
+    "validate_serving_config",
     "EngineCore",
     "Request",
     "RequestQueue",

@@ -98,7 +98,37 @@ class EngineCore:
                  token_budget: Optional[int] = None,
                  speculate: bool = False,
                  num_draft_tokens: int = 4,
-                 draft_source="auto"):
+                 draft_source="auto",
+                 serving_mesh=None):
+        # sharded serving plane (serving/sharded/): when a ServingMesh is
+        # handed in, re-validate it against THIS core's feature flags so
+        # incompatible combos (quantized wire + speculation/prefix cache)
+        # die here with an actionable message, never mid-step; also catch
+        # an engine whose mesh/quantization disagrees with the config
+        from .sharded import ShardedConfigError, validate_serving_config
+
+        engine_quant = getattr(engine, "_quant_allreduce", None)
+        if serving_mesh is not None:
+            validate_serving_config(
+                serving_mesh, speculate=speculate,
+                enable_prefix_cache=enable_prefix_cache,
+                max_batch=int(max_batch), num_heads=engine._num_heads)
+            if serving_mesh.n_devices > 1 and engine._mesh is None:
+                raise ShardedConfigError(
+                    f"{serving_mesh.describe()} given but the engine has "
+                    "no mesh — build it with "
+                    "serving.sharded.build_sharded_engine")
+            if (serving_mesh.quantized_allreduce or None) != engine_quant:
+                raise ShardedConfigError(
+                    f"{serving_mesh.describe()} disagrees with the "
+                    f"engine's quantized_allreduce={engine_quant!r}")
+        elif engine_quant and (speculate or enable_prefix_cache):
+            raise ShardedConfigError(
+                "engine serves with quantized_allreduce="
+                f"{engine_quant!r}, which is incompatible with "
+                "speculate/prefix-cache (exact-logit invariants); see "
+                "serving.sharded.validate_serving_config")
+        self._serving_mesh = serving_mesh
         self._engine = engine
         self._max_batch = int(max_batch)
         # resilience plumbing (serving/resilience/): the fault plane is
@@ -321,6 +351,8 @@ class EngineCore:
         # allocator exposes no counters (CPU)
         from ..profiler.statistic import memory_stats
 
+        from .sharded import sharding_snapshot
+
         return self._metrics.snapshot(
             queue_depth=len(self._queue),
             active=self.active_count,
@@ -333,7 +365,8 @@ class EngineCore:
                           if self._prefix_cache is not None else None),
             resilience=resilience,
             steplog=self.steplog.summary(),
-            device_memory=memory_stats())
+            device_memory=memory_stats(),
+            sharding=sharding_snapshot(self._engine))
 
     # ------------------------------------------------------- trace hooks
     def _trace_end(self, req: Request, state: RequestState):
@@ -809,6 +842,7 @@ class EngineCore:
         bts, fl, src_tag = self._cost_model.estimate(
             "prefill", pkey, rows=1, max_rows=1,
             pages_touched=-(-reserve // self._page), tokens=plen)
+        ici, ici_saved = self._cost_model.interconnect(plen)
         self.steplog.record(
             "prefill", wall_s=span_end - admit_t, kernel="legacy",
             dispatch_s=t_sync - t_run0,
@@ -818,6 +852,7 @@ class EngineCore:
             resident_kv_pages=self._used_pages(),
             prefix_hit_pages=len(match.blocks) if match else 0,
             bytes_est=bts, flops_est=fl, cost_source=src_tag,
+            ici_bytes_est=ici, ici_bytes_saved_est=ici_saved,
             compile_events=clog.count() - c0, retries=req.retries,
             degraded=self._effective_max_batch < self._max_batch)
         if finished or budget <= 1:
@@ -1246,6 +1281,8 @@ class EngineCore:
             kind, mkey, rows=len(active), max_rows=b,
             pages_touched=resident, chunk=1,
             tokens=n_decode + prefill_tokens_step + draft_tokens_step)
+        ici, ici_saved = self._cost_model.interconnect(
+            n_decode + prefill_tokens_step + draft_tokens_step)
         if drafted:
             self._metrics.on_spec(rows=len(drafted),
                                   proposed=draft_tokens_step,
@@ -1261,6 +1298,7 @@ class EngineCore:
             emitted_tokens=emitted_decode + emitted_prefill,
             resident_kv_pages=resident,
             prefix_hit_pages=prefix_hits, bytes_est=bts, flops_est=fl,
+            ici_bytes_est=ici, ici_bytes_saved_est=ici_saved,
             cost_source=src_tag, compile_events=clog.count() - c0,
             faults=fault is not None,
             retries=sum(s["req"].retries for s in active),
@@ -1423,6 +1461,7 @@ class EngineCore:
         bts, fl, src_tag = self._cost_model.estimate(
             "decode", dkey, rows=len(active), max_rows=b,
             pages_touched=resident, chunk=S, tokens=len(active) * S)
+        ici, ici_saved = self._cost_model.interconnect(len(active) * S)
         end = time.monotonic()
         self.steplog.record(
             "decode", wall_s=end - t0, dispatch_s=t_sync - t0,
@@ -1430,6 +1469,7 @@ class EngineCore:
             kernel="legacy", decode_rows=len(active), chunk_steps=S,
             emitted_tokens=emitted_total, resident_kv_pages=resident,
             prefix_hit_pages=prefix_hits, bytes_est=bts, flops_est=fl,
+            ici_bytes_est=ici, ici_bytes_saved_est=ici_saved,
             cost_source=src_tag, compile_events=clog.count() - c0,
             faults=fault is not None,
             retries=sum(s["req"].retries for s in active),

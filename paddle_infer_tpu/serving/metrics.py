@@ -231,7 +231,8 @@ class ServingMetrics:
                  prefix_cache: Optional[Dict] = None,
                  resilience: Optional[Dict] = None,
                  steplog: Optional[Dict] = None,
-                 device_memory: Optional[Dict] = None) -> Dict:
+                 device_memory: Optional[Dict] = None,
+                 sharding: Optional[Dict] = None) -> Dict:
         """Render everything to a plain dict (the ``GET /metrics`` JSON
         body).  Latency series carry lifetime ``count``/``mean`` plus
         reservoir-window ``p50_recent``/``p99_recent``/``max_recent``
@@ -244,7 +245,10 @@ class ServingMetrics:
         state, injected-fault tallies), merged here with this
         registry's own resilience counters; ``steplog`` is
         ``StepLog.summary()`` and ``device_memory`` the device
-        allocator's ``memory_stats()`` dict when available."""
+        allocator's ``memory_stats()`` dict when available;
+        ``sharding`` is ``serving.sharded.sharding_snapshot`` (mesh
+        shape, param placement tallies, collective-bytes ledger) when
+        the core serves over a mesh."""
         tps = self.tokens_per_second()
         with self._lock:
             out = {
@@ -296,6 +300,8 @@ class ServingMetrics:
             }
             if steplog is not None:
                 out["steplog"] = dict(steplog)
+            if sharding is not None:
+                out["sharding"] = dict(sharding)
             if device_memory:
                 out["device_memory"] = dict(device_memory)
             if kv_pool is not None:

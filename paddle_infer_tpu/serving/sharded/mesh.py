@@ -1,0 +1,152 @@
+"""ServingMesh config, validation, and engine construction.
+
+The mesh layout reuses ``parallel.topology.create_hybrid_mesh`` so the
+serving axes carry the same names the training stack uses ("mp" for the
+tensor-parallel head/column/row splits, "dp" for batch replica groups)
+and every existing ``sharding_constraint`` / ``axis_if_divides`` site in
+the model and paged kernel picks them up unmodified.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+
+class ShardedConfigError(ValueError):
+    """A ServingMesh / feature combination that cannot serve correctly.
+    Raised at configuration time with an actionable message — never from
+    inside the step loop."""
+
+
+@dataclass(frozen=True)
+class ServingMesh:
+    """Topology of the sharded serving plane.
+
+    ``mp``: tensor-parallel degree — attention heads and MLP
+    column/row splits sharded over this axis, KV page pools sharded on
+    the head dim, one all-reduce per row-parallel matmul.
+    ``dp_replicas``: data-parallel replica groups — batch rows split
+    across replicas, weights replicated across them.
+    ``quantized_allreduce``: ``"int8"`` switches the mp all-reduces to
+    the blockwise-int8 wire format (EQuARX); approximate logits, so it
+    is rejected together with features whose invariants need exact
+    arithmetic (speculation's acceptance rule, prefix-cache warm/cold
+    stream identity).
+    """
+
+    mp: int = 1
+    dp_replicas: int = 1
+    quantized_allreduce: Optional[str] = None
+
+    @property
+    def n_devices(self) -> int:
+        return int(self.mp) * int(self.dp_replicas)
+
+    def describe(self) -> str:
+        parts = [f"mp={self.mp}"]
+        if self.dp_replicas > 1:
+            parts.append(f"dp={self.dp_replicas}")
+        if self.quantized_allreduce:
+            parts.append(f"quantized_allreduce={self.quantized_allreduce}")
+        return "ServingMesh(" + ", ".join(parts) + ")"
+
+    def build(self, devices: Optional[Sequence] = None):
+        """The hybrid mesh for this config (axes [pp, dp, sharding, sep,
+        ep, mp]; only dp/mp exceed 1 here)."""
+        from ...parallel.topology import create_hybrid_mesh
+
+        return create_hybrid_mesh(dp=self.dp_replicas, mp=self.mp,
+                                  devices=devices)
+
+
+def validate_serving_config(cfg: ServingMesh, *, speculate: bool = False,
+                            enable_prefix_cache: bool = False,
+                            max_batch: Optional[int] = None,
+                            num_heads: Optional[int] = None,
+                            available_devices: Optional[int] = None):
+    """Raise :class:`ShardedConfigError` for combos that would serve
+    incorrectly or crash mid-step; silent on valid configs."""
+    if cfg.mp < 1 or cfg.dp_replicas < 1:
+        raise ShardedConfigError(
+            f"mesh degrees must be >= 1, got mp={cfg.mp} "
+            f"dp_replicas={cfg.dp_replicas}")
+    q = cfg.quantized_allreduce
+    if q not in (None, "int8"):
+        raise ShardedConfigError(
+            f"unsupported quantized_allreduce={q!r}; expected 'int8' "
+            "(or None for exact fp all-reduces)")
+    if q and cfg.mp <= 1:
+        raise ShardedConfigError(
+            "quantized_allreduce only applies to the mp partial-sum "
+            f"all-reduces; mp={cfg.mp} has none — raise --mp or drop "
+            "--quantized_allreduce")
+    if q and speculate:
+        raise ShardedConfigError(
+            "quantized_allreduce is incompatible with speculative "
+            "decoding: the verify lane's acceptance rule assumes exact "
+            "target logits, and quantized wire error would silently "
+            "shift acceptance decisions — drop --speculate or serve "
+            "with exact all-reduces")
+    if q and enable_prefix_cache:
+        raise ShardedConfigError(
+            "quantized_allreduce is incompatible with prefix caching: "
+            "warm (suffix-only) and cold (full-prompt) prefills "
+            "quantize over different block boundaries, so a cache hit "
+            "would change the token stream — drop --prefix_cache or "
+            "serve with exact all-reduces")
+    if available_devices is not None and cfg.n_devices > available_devices:
+        raise ShardedConfigError(
+            f"{cfg.describe()} needs {cfg.n_devices} devices but only "
+            f"{available_devices} are visible (set "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=N for a "
+            "CPU dryrun)")
+    if max_batch is not None and cfg.dp_replicas > 1 \
+            and max_batch % cfg.dp_replicas:
+        raise ShardedConfigError(
+            f"max_batch={max_batch} does not divide across "
+            f"dp_replicas={cfg.dp_replicas}; the batch dim must split "
+            "evenly over the replica groups")
+    if num_heads is not None and cfg.mp > 1 and num_heads % cfg.mp:
+        raise ShardedConfigError(
+            f"mp={cfg.mp} does not divide num_attention_heads="
+            f"{num_heads}: attention heads and the KV page pool cannot "
+            "shard — pick an mp degree that divides the head count")
+
+
+def build_sharded_engine(model, cfg: ServingMesh, *, page_size: int = 16,
+                         num_pages: Optional[int] = None,
+                         prompt_bucket: int = 64, cache_dtype=None,
+                         devices: Optional[Sequence] = None):
+    """A ``PagedGenerationEngine`` serving over ``cfg``'s mesh.
+
+    Validation here covers only what the engine itself needs (device
+    count, head divisibility); EngineCore re-validates against its own
+    feature flags when the engine is handed to it with
+    ``serving_mesh=cfg``."""
+    import jax
+
+    from ...inference.generation import PagedGenerationEngine
+
+    avail = len(list(devices) if devices is not None else jax.devices())
+    validate_serving_config(
+        cfg, num_heads=model.config.num_attention_heads,
+        available_devices=avail)
+    mesh = cfg.build(devices) if cfg.n_devices > 1 else None
+    return PagedGenerationEngine(
+        model, page_size=page_size, num_pages=num_pages,
+        prompt_bucket=prompt_bucket, cache_dtype=cache_dtype, mesh=mesh,
+        quantized_allreduce=cfg.quantized_allreduce)
+
+
+def sharding_snapshot(engine) -> Optional[dict]:
+    """The ``sharding`` section of the serving metrics snapshot: the
+    engine's placement report plus the global collective-bytes ledger.
+    None when the engine serves single-device (section omitted)."""
+    report = getattr(engine, "shard_report", lambda: None)()
+    if report is None:
+        return None
+    from ...parallel.collective import LEDGER
+
+    out = dict(report)
+    out["collectives"] = LEDGER.snapshot()
+    return out

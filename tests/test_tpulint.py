@@ -75,6 +75,53 @@ def test_host_sync_out_of_scope_path(tmp_path):
     assert fs == []
 
 
+HOT_COLLECTIVE = """
+    from paddle_infer_tpu.parallel import collective
+
+    class Core:
+        def run_once(self):
+            self._merge_pool()
+
+        def _merge_pool(self):
+            return collective.all_reduce(self._pool)
+"""
+
+
+def test_host_sync_fires_on_eager_collective(tmp_path):
+    # an eager collective from host serving code is a cross-device
+    # rendezvous — worse than a local readback, same rule
+    fs = run_rules(tmp_path, HOT_COLLECTIVE, ["host-sync"])
+    assert len(fs) == 1
+    assert "eager collective collective.all_reduce()" in fs[0].message
+    assert "reachable from run_once()" in fs[0].message
+
+
+def test_host_sync_silent_on_non_collective_lookalikes(tmp_path):
+    # functools.reduce / an unrelated .all_gather(): the collective-fn
+    # name alone must not fire — the dotted prefix has to be the
+    # collective plane
+    src = """
+        import functools
+
+        class Core:
+            def run_once(self):
+                total = functools.reduce(max, self._counts)
+                rows = self.registry.all_gather(total)
+                return rows
+    """
+    assert run_rules(tmp_path, src, ["host-sync"]) == []
+
+
+def test_host_sync_collective_suppressible(tmp_path):
+    # chunk-boundary collectives that ARE intentional document
+    # themselves through the suppression comment, like any other sync
+    src = HOT_COLLECTIVE.replace(
+        "return collective.all_reduce(self._pool)",
+        "return collective.all_reduce(self._pool)  "
+        "# tpulint: disable=host-sync")
+    assert run_rules(tmp_path, src, ["host-sync"]) == []
+
+
 # ----------------------------------------------------- recompile-hazard
 def test_recompile_hazard_fires_on_unbounded_keys(tmp_path):
     src = """

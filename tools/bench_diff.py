@@ -27,9 +27,9 @@ from typing import Dict, Tuple
 # generic "_s" suffix ("tokens_per_sec" is not a latency)
 _HIGHER = ("per_s", "per_sec", "speedup", "mfu", "acceptance",
            "hit_rate", "tps", "throughput", "tokens_per", "pearson",
-           "improvement", "spec_decode")
+           "improvement", "spec_decode", "bytes_saved")
 _LOWER = ("_ms", "latency", "ttft", "itl", "err", "wall", "p50",
-          "p99", "wasted", "_s")
+          "p99", "wasted", "ici_bytes", "_s")
 # harness bookkeeping, not workload performance
 _SKIP = ("vs_baseline", "child_wall_s", "bench_wall_s", "n", "rc")
 

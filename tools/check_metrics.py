@@ -61,6 +61,7 @@ def fabricated_exposition():
                    kernel="legacy")
     steplog.record("mixed", wall_s=0.015, dispatch_s=0.012,
                    bytes_est=1.6e6, flops_est=4.5e6,
+                   ici_bytes_est=4.0e4, ici_bytes_saved_est=1.2e5,
                    cost_source="xla+pages", decode_rows=3,
                    prefill_chunk_tokens=16, emitted_tokens=4,
                    kernel="ragged")
@@ -113,7 +114,21 @@ def fabricated_exposition():
                                      "peak_bytes_in_use": 1 << 21,
                                      "bytes_limit": 1 << 30,
                                      "largest_alloc_size": 1 << 18,
-                                     "num_allocs": 12})
+                                     "num_allocs": 12},
+                      sharding={"mesh_axes": {"mp": 2, "dp": 2},
+                                "devices": 4,
+                                "params_total": 26,
+                                "sharded_params": 16,
+                                "replicated_params": 1,
+                                "replicated_names": ["lm_head.weight"],
+                                "quantized_allreduce": "int8",
+                                "collectives": {
+                                    "calls": 9,
+                                    "by_op_dtype": {
+                                        "mp_allreduce": {"int8": 5.1e5},
+                                        "all_gather": {"float32": 2.0e5}},
+                                    "bytes_total": 7.1e5,
+                                    "bytes_saved_total": 1.4e6}})
 
     # local CompileLog (not the process singleton): one prefill, one
     # warmed decode, one post-warmup recompile so the recompile/storm

@@ -81,14 +81,14 @@ def _core():
     into the core's failure paths."""
     with _STATE["lock"]:
         if "core" not in _STATE:
-            from paddle_infer_tpu.inference.generation import (
-                PagedGenerationEngine)
             from paddle_infer_tpu.serving import (EngineCore,
                                                   EngineSupervisor,
-                                                  FaultPlane)
+                                                  FaultPlane, ServingMesh,
+                                                  build_sharded_engine)
 
-            engine = PagedGenerationEngine(
-                _STATE["model"], page_size=_STATE["page_size"])
+            smesh = _STATE.get("serving_mesh") or ServingMesh()
+            engine = build_sharded_engine(
+                _STATE["model"], smesh, page_size=_STATE["page_size"])
             plane = None
             script = _STATE.get("fault_script")
             if script:
@@ -113,7 +113,9 @@ def _core():
                 speculate=_STATE.get("speculate", False),
                 num_draft_tokens=_STATE.get("num_draft_tokens", 4),
                 draft_source=_STATE.get("draft_source", "auto"),
-                fault_plane=plane)
+                fault_plane=plane,
+                serving_mesh=(smesh if smesh.n_devices > 1
+                              or smesh.quantized_allreduce else None))
             _STATE["sup"] = EngineSupervisor(
                 core,
                 watchdog_s=_STATE.get("watchdog_s", 5.0),
@@ -553,9 +555,43 @@ def main(argv=None):
                          "file); see docs/SERVING.md 'Fault tolerance'")
     ap.add_argument("--fault_seed", type=int, default=0,
                     help="seed for probabilistic fault specs")
+    ap.add_argument("--mp", type=int, default=1,
+                    help="tensor-parallel degree: attention heads / MLP "
+                         "splits and the KV page pool shard over an "
+                         "'mp' mesh axis (docs/SERVING.md 'Sharded "
+                         "serving')")
+    ap.add_argument("--dp_replicas", type=int, default=1,
+                    help="data-parallel replica groups; batch rows "
+                         "split across replicas (needs mp*dp_replicas "
+                         "visible devices)")
+    ap.add_argument("--quantized_allreduce", default=None,
+                    choices=["int8"],
+                    help="blockwise-int8 wire format for the mp "
+                         "all-reduces (~4x fewer interconnect bytes, "
+                         "approximate logits); incompatible with "
+                         "--speculate and --enable_prefix_cache")
     args = ap.parse_args(argv)
 
     from paddle_infer_tpu.models import AutoModel
+    from paddle_infer_tpu.serving import (ServingMesh, ShardedConfigError,
+                                          validate_serving_config)
+
+    serving_mesh = ServingMesh(
+        mp=args.mp, dp_replicas=args.dp_replicas,
+        quantized_allreduce=args.quantized_allreduce)
+    try:
+        import jax
+
+        validate_serving_config(
+            serving_mesh, speculate=args.speculate,
+            enable_prefix_cache=args.enable_prefix_cache,
+            max_batch=args.max_batch,
+            available_devices=len(jax.devices()))
+    except ShardedConfigError as e:
+        print(f"error: invalid sharded-serving config: {e}",
+              file=sys.stderr, flush=True)
+        return 2
+    _STATE["serving_mesh"] = serving_mesh
 
     _STATE["model"] = AutoModel.from_pretrained(args.model_dir)
     _STATE["page_size"] = args.page_size
