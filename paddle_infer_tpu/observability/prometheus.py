@@ -158,6 +158,10 @@ def render_prometheus(snapshot: dict,
         w.family("prefix_cache_token_ratio", "gauge",
                  "cached_tokens / prompt_tokens (cached-token ratio)")
         w.sample("prefix_cache_token_ratio", px.get("token_ratio"))
+        w.family("prefix_cache_peeks_total", "counter",
+                 "Read-only longest-match probes (fleet router "
+                 "affinity; no pins, no LRU movement)")
+        w.sample("prefix_cache_peeks_total", px.get("peeks"))
         w.family("prefix_cache_inserts_total", "counter",
                  "Finished sequences retained into the radix tree")
         w.sample("prefix_cache_inserts_total", px.get("inserts"))
@@ -382,6 +386,106 @@ def render_prometheus(snapshot: dict,
                  "wire formats vs their full-precision equivalent")
         w.sample("collective_bytes_saved_total",
                  col.get("bytes_saved_total", 0.0))
+
+    rt = snapshot.get("router") or {}
+    if rt:
+        reps = rt.get("replicas") or []
+        w.family("router_replica_info", "gauge",
+                 "Fleet replica topology as labels (constant 1): "
+                 "live and configured role per replica")
+        for rep in reps:
+            w.sample("router_replica_info", 1, {
+                "replica": rep.get("name", "?"),
+                "role": rep.get("role", "mixed"),
+                "configured_role": rep.get("configured_role", "mixed")})
+        w.family("router_dispatched_total", "counter",
+                 "Requests dispatched by the fleet router, by replica")
+        for rep in reps:
+            w.sample("router_dispatched_total", rep.get("dispatched", 0),
+                     {"replica": rep.get("name", "?")})
+        w.family("router_affinity_hits_total", "counter",
+                 "Dispatches placed by a confirmed prefix-affinity "
+                 "match, by replica")
+        for rep in reps:
+            w.sample("router_affinity_hits_total",
+                     rep.get("affinity_hits", 0),
+                     {"replica": rep.get("name", "?")})
+        w.family("router_affinity_hit_rate", "gauge",
+                 "affinity_hits / dispatched over the fleet lifetime")
+        w.sample("router_affinity_hit_rate",
+                 rt.get("affinity_hit_rate", 0.0))
+        w.family("router_handoffs_total", "counter",
+                 "Cross-replica KV page handoffs completed "
+                 "(prefill -> decode migrations)")
+        w.sample("router_handoffs_total", rt.get("handoffs", 0))
+        w.family("router_replica_handoffs_total", "counter",
+                 "Handoffs by replica and direction (in = imported KV, "
+                 "out = exported KV)")
+        for rep in reps:
+            name = rep.get("name", "?")
+            w.sample("router_replica_handoffs_total",
+                     rep.get("handoffs_out", 0),
+                     {"replica": name, "direction": "out"})
+            w.sample("router_replica_handoffs_total",
+                     rep.get("handoffs_in", 0),
+                     {"replica": name, "direction": "in"})
+        w.family("router_requeued_total", "counter",
+                 "Admissions reclaimed from non-serving replicas and "
+                 "rerouted (health-gated drain rerouting)")
+        w.sample("router_requeued_total", rt.get("requeued", 0))
+        w.family("router_no_replica_rejects_total", "counter",
+                 "Submissions rejected because no replica was serving")
+        w.sample("router_no_replica_rejects_total",
+                 rt.get("no_replica_rejects", 0))
+        w.family("router_pending_handoffs", "gauge",
+                 "Requests registered for prefill -> decode handoff "
+                 "whose chunk boundary has not arrived yet")
+        w.sample("router_pending_handoffs",
+                 rt.get("pending_handoffs", 0))
+        w.family("router_inflight_requests", "gauge",
+                 "Requests the router currently tracks across all "
+                 "replicas")
+        w.sample("router_inflight_requests", rt.get("inflight", 0))
+        w.family("router_replica_health_code", "gauge",
+                 "Replica health state code (0 healthy, 1 degraded, "
+                 "2 draining, 3 down)")
+        for rep in reps:
+            w.sample("router_replica_health_code",
+                     (rep.get("health") or {}).get("code", 0),
+                     {"replica": rep.get("name", "?")})
+        w.family("router_replica_active_requests", "gauge",
+                 "Requests occupying a KV slot, by replica")
+        for rep in reps:
+            w.sample("router_replica_active_requests",
+                     rep.get("active", 0),
+                     {"replica": rep.get("name", "?")})
+        w.family("router_replica_queue_depth", "gauge",
+                 "Admission-queue depth, by replica")
+        for rep in reps:
+            w.sample("router_replica_queue_depth", rep.get("queued", 0),
+                     {"replica": rep.get("name", "?")})
+        w.family("router_replica_predicted_load_bytes", "gauge",
+                 "Analytic bytes the replica's next scheduler step "
+                 "would move (StepCostModel; the load-balance signal)")
+        for rep in reps:
+            w.sample("router_replica_predicted_load_bytes",
+                     rep.get("predicted_load_bytes", 0.0),
+                     {"replica": rep.get("name", "?")})
+        w.family("router_role_flips_total", "counter",
+                 "Elastic role flips applied, by replica")
+        for rep in reps:
+            w.sample("router_role_flips_total", rep.get("role_flips", 0),
+                     {"replica": rep.get("name", "?")})
+        w.family("router_shadow_nodes", "gauge",
+                 "Full-page nodes in the router's shadow prefix index "
+                 "across all replicas")
+        w.sample("router_shadow_nodes",
+                 (rt.get("shadow") or {}).get("nodes", 0))
+        w.family("router_prefill_fraction", "gauge",
+                 "Windowed prefill-token fraction the elastic role "
+                 "policy observes (absent until the window fills)")
+        w.sample("router_prefill_fraction",
+                 (rt.get("elastic") or {}).get("prefill_fraction"))
 
     for key, (family, help_text) in SERIES_FAMILIES.items():
         series = snapshot.get(key)

@@ -31,6 +31,11 @@ Layer map:
                       format), ``build_sharded_engine`` and the
                       config validation EngineCore re-runs against its
                       feature flags (docs/SERVING.md "Sharded serving").
+  ``fleet``           the disaggregated tier: ``FleetRouter`` over N
+                      replicas with prefill/decode/mixed roles,
+                      prefix-affinity dispatch (``PrefixCache.peek``),
+                      cross-replica KV page handoff and elastic role
+                      flips (docs/SERVING.md "Disaggregated serving").
 
 Requests with per-request sampling configs share one decode executable:
 temperature/top-k/top-p/eos ride as *per-row arrays* (serving/programs),
@@ -38,7 +43,7 @@ so admitting a new request never recompiles the hot loop.
 """
 
 from .metrics import ServingMetrics
-from .request import (DeadlineExceededError, LoadShedError,
+from .request import (DeadlineExceededError, HandoffError, LoadShedError,
                       QuarantinedError, QueueFullError, RejectedError,
                       Request, RequestQueue, RequestState)
 from .engine_core import EngineCore
@@ -46,8 +51,16 @@ from .resilience import (EngineSupervisor, FaultPlane, FaultSpec,
                          HealthMonitor, HealthState)
 from .sharded import (ServingMesh, ShardedConfigError,
                       build_sharded_engine, validate_serving_config)
+from .fleet import (ElasticRolePolicy, FleetRouter, ReplicaHandle,
+                    ReplicaRole, parse_fleet_roles)
 
 __all__ = [
+    "ElasticRolePolicy",
+    "FleetRouter",
+    "HandoffError",
+    "ReplicaHandle",
+    "ReplicaRole",
+    "parse_fleet_roles",
     "ServingMesh",
     "ShardedConfigError",
     "build_sharded_engine",

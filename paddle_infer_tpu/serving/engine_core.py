@@ -60,9 +60,9 @@ from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .programs import (build_decode, build_mixed_step, build_page_copy,
                        build_prefill, build_prefix_prefill)
-from .request import (DeadlineExceededError, LoadShedError, QuarantinedError,
-                      QueueFullError, RejectedError, Request, RequestQueue,
-                      RequestState)
+from .request import (DeadlineExceededError, HandoffError, LoadShedError,
+                      QuarantinedError, QueueFullError, RejectedError,
+                      Request, RequestQueue, RequestState)
 from .resilience.faultplane import (InjectedFault, InjectedMemoryError,
                                     NULL_PLANE)
 
@@ -248,6 +248,11 @@ class EngineCore:
         self._effective_max_batch = self._max_batch
         self.step_trace: List[dict] = []
         self._step_idx = 0
+        # chunk-boundary notification (fleet handoff): called with the
+        # Request, by the stepping thread under the step lock, the step
+        # its prompt finishes prefilling.  Must be fast and reentrant-
+        # safe with respect to THIS core's step lock (it is an RLock).
+        self.on_prefill_complete = None
         # RLock: the locked step path reads ``active_count``, which now
         # takes the lock itself so unlocked readers (HTTP metrics
         # threads) see a consistent slot table
@@ -453,6 +458,43 @@ class EngineCore:
             raise
         self._metrics.on_submitted()
         self.tracer.begin(req.rid, kind="exclusive")
+        return req
+
+    def enqueue(self, req: Request) -> Request:
+        """Admit an EXISTING ``Request`` into this core's queue — the
+        fleet router's requeue path when the replica that originally
+        accepted the request drains or goes down before slotting it.
+        The request keeps its rid (per-request sampling keys are
+        ``fold_in(PRNGKey(seed), rid)``, so the stream is bitwise the
+        same wherever it lands) and its original arrival clock, so
+        queue-wait spans the whole journey, not just the last hop."""
+        if self._closed:
+            raise RejectedError("serving engine is closed")
+        if self._drain_evt.is_set():
+            self._metrics.on_rejected()
+            raise LoadShedError("serving engine is draining; retry "
+                                "against another replica")
+        if req.kind != "batch":
+            raise RejectedError("only batch requests can be rerouted")
+        g = req.config
+        if not self.batchable(g):
+            self._metrics.on_rejected()
+            raise RejectedError(
+                "config not batchable (beams/repetition_penalty); route "
+                "through submit_exclusive")
+        if int(req.prompt.size) + g.max_new_tokens > self._max_model_len:
+            self._metrics.on_rejected()
+            raise RejectedError(
+                f"prompt {int(req.prompt.size)} + max_new "
+                f"{g.max_new_tokens} exceeds max_model_len "
+                f"{self._max_model_len}")
+        req._requeue()
+        self._queue.submit(req)
+        self._metrics.on_submitted()
+        if self.tracer.get(req.rid) is None:
+            self.tracer.begin(req.rid, kind="batch",
+                              prompt_len=int(req.prompt.size),
+                              max_new_tokens=g.max_new_tokens)
         return req
 
     # ------------------------------------------------------ the step loop
@@ -1200,6 +1242,7 @@ class EngineCore:
         emitted_prefill = 0
         draft_accepted_step = 0
         evicted = []
+        prefill_done: List[Request] = []
         now = time.monotonic()
         span_name = ("prefill" if self._prefix_cache is None
                      else "suffix_prefill")
@@ -1249,6 +1292,7 @@ class EngineCore:
                     s["last_tok"] = int(t_row[-1])
                     s["last_emit"] = now
                     emitted_prefill += int(t_row.size)
+                    prefill_done.append(req)
             else:
                 req._emit(t_row)
                 s["emitted"] += int(t_row.size)
@@ -1308,6 +1352,21 @@ class EngineCore:
             spec_rows=len(drafted))
         if self._recovery is not None:
             self._recovery.on_step_ok()
+        # chunk-boundary hook: fired by the stepping thread itself (still
+        # under the step RLock) the step a row's prompt finishes
+        # prefilling.  The fleet router migrates here synchronously — an
+        # external thread polling for this moment loses the step-lock
+        # race on a busy core and can miss the whole decode phase.
+        if self.on_prefill_complete is not None:
+            for _req in prefill_done:
+                if _req.done:
+                    continue
+                try:
+                    self.on_prefill_complete(_req)
+                except Exception:       # pragma: no cover - hook safety
+                    _log.exception(
+                        "on_prefill_complete hook failed for rid=%d",
+                        _req.rid)
 
     # ------------------------------------------------------------ decode
     def _decode_step(self):
@@ -1551,6 +1610,195 @@ class EngineCore:
             self.tracer.add_span(req.rid, "exclusive", start,
                                  time.monotonic(), outcome="failed")
             self._trace_end(req, RequestState.FAILED)
+
+    # ---------------------------------------------- cross-replica handoff
+    # Disaggregated serving (serving/fleet/): a prefill replica runs a
+    # prompt's chunked prefill, then streams the row's KV pages to a
+    # decode replica at the chunk boundary.  Export serializes the
+    # slot's scheduler state plus the physical page contents and
+    # releases the slot (retaining the prefix in this replica's radix
+    # tree — that is what keeps prefix-affinity routing warm); import
+    # reserves pages in the TARGET pool, writes the contents back and
+    # reconstructs the slot bitwise: the per-request sampling key
+    # depends only on (seed, rid), decode positions only on
+    # (length, emitted), and attention only on the page CONTENTS the
+    # table maps — none of which change across the move.
+
+    def export_handoff(self, req: Request) -> dict:
+        """Serialize ``req``'s in-flight KV state out of this core and
+        release its slot.  Legal at any point between scheduler steps
+        (the step lock serializes against a running step); the natural
+        call site is the chunk boundary where the prompt finished
+        prefilling.  Returns the handoff packet ``import_handoff``
+        consumes.  Raises ``HandoffError`` without side effects when
+        the request holds no slot here."""
+        with self._step_lock:
+            if not self._ragged:
+                raise HandoffError("KV handoff requires ragged=True")
+            s = None
+            for cand in self._slots:
+                if cand is not None and cand["req"] is req:
+                    s = cand
+                    break
+            if s is None:
+                raise HandoffError(
+                    f"request {req.rid} holds no slot on this replica")
+            t0 = time.monotonic()
+            sid = s["sid"]
+            page = self._page
+            if s["pending"].size:
+                # mid-prefill boundary: KV covers the consumed prompt
+                kv_len = int(s["ctx"])
+                kv_tokens = np.asarray(s["full"][:kv_len], np.int32)
+            else:
+                # decode phase: prompt + emitted tokens minus the last
+                # (its KV is written by the NEXT step, wherever it runs)
+                kv_len = int(s["length"]) + int(s["emitted"]) - 1
+                kv_tokens = np.concatenate(
+                    # req.tokens is a host-side list — no device readback
+                    # tpulint: disable-next-line=host-sync
+                    [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
+            n_pages = -(-kv_len // page) if kv_len > 0 else 0
+            blocks = np.asarray(
+                self._pool.block_table(sid)[:n_pages], np.int32)
+            k_pages, v_pages = self._engine._ensure_pages()
+            # the intended bulk sync of a handoff: one gather per layer
+            # pulls the row's pages off the device (a real deployment
+            # DMAs pool-to-pool over ICI; the host hop keeps this exact)
+            # tpulint: disable-next-line=host-sync
+            k_host = [np.asarray(kp[blocks]) for kp in k_pages]
+            # tpulint: disable-next-line=host-sync
+            v_host = [np.asarray(vp[blocks]) for vp in v_pages]
+            packet = {
+                "req": req, "g": s["g"], "full": s["full"],
+                "pending": s["pending"], "ctx": int(s["ctx"]),
+                "emitted": int(s["emitted"]),
+                "steps_base": int(s["steps_base"]),
+                "last_tok": int(s["last_tok"]), "plen": int(s["plen"]),
+                "kv_len": kv_len, "kv_tokens": kv_tokens,
+                "k_host": k_host, "v_host": v_host, "page": page,
+                "salt": req.cache_salt,
+            }
+            self._slots[sid] = None
+            # retain the exported prefix here: the whole point of role
+            # disaggregation is that the PREFILL replica's radix tree
+            # accumulates the fleet's prompt prefixes
+            self._release_slot_kv(
+                sid, s.get("match"),
+                retain_tokens=kv_tokens if kv_tokens.size else None,
+                salt=req.cache_salt)
+            wall = time.monotonic() - t0
+            bts, fl, src_tag = self._cost_model.estimate(
+                "page_copy", pages_touched=n_pages)
+            self.steplog.record(
+                "handoff", wall_s=wall, host_s=wall,
+                active_rows=self.active_count, pages_freed=n_pages,
+                resident_kv_pages=self._used_pages(),
+                bytes_est=bts, flops_est=fl, cost_source=src_tag,
+                retries=req.retries,
+                degraded=self._effective_max_batch < self._max_batch)
+            now = time.monotonic()
+            self.tracer.add_span(req.rid, "handoff",
+                                 s.get("span_end", t0), now,
+                                 direction="export", pages=n_pages,
+                                 kv_tokens=kv_len)
+            return packet
+
+    def import_handoff(self, packet: dict) -> Request:
+        """Install an exported request into this core: reserve pages in
+        this pool, write the packet's page contents into them and
+        reconstruct the slot so the next scheduler step continues the
+        stream bitwise-identically to the replica it left.  Raises
+        ``HandoffError`` (target untouched) when no slot/pages are
+        available or the pool geometry differs."""
+        req: Request = packet["req"]
+        g = packet["g"]
+        with self._step_lock:
+            if self._closed:
+                raise HandoffError("serving engine is closed")
+            if self._drain_evt.is_set():
+                raise HandoffError("target replica is draining")
+            if not self._ragged:
+                raise HandoffError("KV handoff requires ragged=True")
+            if int(packet["page"]) != self._page:
+                raise HandoffError(
+                    f"page-size mismatch: source {packet['page']} vs "
+                    f"target {self._page}")
+            eng = self._engine
+            k_pages, v_pages = eng._ensure_pages()
+            if (len(packet["k_host"]) != len(k_pages)
+                    or (packet["k_host"]
+                        and packet["k_host"][0].shape[1:]
+                        != k_pages[0].shape[1:])):
+                raise HandoffError("KV pool geometry mismatch between "
+                                   "replicas")
+            kv_len = int(packet["kv_len"])
+            n_pages = -(-kv_len // self._page) if kv_len > 0 else 0
+            length = int(req.prompt.size)
+            full = packet["full"]
+            if length + g.max_new_tokens > self._max_model_len:
+                raise HandoffError(
+                    f"prompt {length} + max_new {g.max_new_tokens} "
+                    f"exceeds target max_model_len {self._max_model_len}")
+            if self.active_count >= self._effective_max_batch:
+                raise HandoffError("no batch capacity on target replica")
+            sid = next((i for i, sl in enumerate(self._slots)
+                        if sl is None), None)
+            if sid is None:
+                raise HandoffError("no free slot on target replica")
+            t0 = time.monotonic()
+            reserve = max(self._plen(int(np.size(full))),
+                          length + g.max_new_tokens)
+            self._pool.free(sid)
+            try:
+                if self._prefix_cache is not None:
+                    self._prefix_cache.ensure_free(-(-reserve // self._page))
+                self._pool.reserve(sid, reserve)
+            except MemoryError as e:
+                self._pool.free(sid)
+                raise HandoffError(
+                    "target pool has no pages for the handoff") from e
+            table = np.full((self._max_pages,), self._scratch, np.int32)
+            t = self._pool.block_table(sid)[:self._max_pages]
+            # host-side table/key bookkeeping, once per import
+            # tpulint: disable-next-line=host-sync
+            table[:len(t)] = np.asarray(t, np.int32)
+            if n_pages:
+                dst = table[:n_pages]
+                # one scatter per layer lands the imported pages in this
+                # pool; .at[].set is out-of-place, so the rebound arrays
+                # replace the engine's pools atomically
+                eng._k_pages = [kp.at[dst].set(h) for kp, h
+                                in zip(k_pages, packet["k_host"])]
+                eng._v_pages = [vp.at[dst].set(h) for vp, h
+                                in zip(v_pages, packet["v_host"])]
+            # tpulint: disable-next-line=host-sync
+            key = np.asarray(
+                jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))
+            now = time.monotonic()
+            self._slots[sid] = {
+                "req": req, "sid": sid, "g": g, "length": length,
+                "plen": int(packet["plen"]),
+                "emitted": int(packet["emitted"]),
+                "steps_base": int(packet["steps_base"]),
+                "last_tok": int(packet["last_tok"]), "last_emit": now,
+                "table": table, "key": key, "match": None,
+                "span_end": now, "full": full,
+                "pending": packet["pending"], "ctx": int(packet["ctx"])}
+            wall = now - t0
+            bts, fl, src_tag = self._cost_model.estimate(
+                "page_copy", pages_touched=n_pages)
+            self.steplog.record(
+                "handoff", wall_s=wall, host_s=wall,
+                active_rows=self.active_count,
+                resident_kv_pages=self._used_pages(),
+                bytes_est=bts, flops_est=fl, cost_source=src_tag,
+                retries=req.retries,
+                degraded=self._effective_max_batch < self._max_batch)
+            self.tracer.add_span(req.rid, "handoff", t0, now,
+                                 direction="import", pages=n_pages,
+                                 kv_tokens=kv_len)
+            return req
 
     # ---------------------------------------------------- thread control
     def start(self) -> "EngineCore":

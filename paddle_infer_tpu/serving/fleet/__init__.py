@@ -1,0 +1,39 @@
+"""Disaggregated serving fleet (docs/SERVING.md "Disaggregated
+serving"): N in-process ``EngineCore`` replicas — each owning its own
+engine and KV pool — behind one ``FleetRouter``.
+
+  ``roles``    ``ReplicaRole`` (prefill / decode / mixed) and
+               ``ReplicaHandle`` (core + health + live role + dispatch
+               counters); ``parse_fleet_roles`` for ``--fleet_roles``.
+  ``shadow``   ``ShadowPrefixIndex`` — the router's belief about which
+               replica retains which prefixes, confirmed against the
+               authoritative trees via the read-only
+               ``PrefixCache.peek()``.
+  ``handoff``  cross-replica KV migration choreography over
+               ``EngineCore.export_handoff`` / ``import_handoff``:
+               prefill replicas stream a request's KV pages to a decode
+               replica at the chunk boundary, continuation bitwise.
+  ``elastic``  ``ElasticRolePolicy`` — hysteretic role flips for
+               ``mixed``-configured replicas as the prefill/decode
+               token ratio drifts.
+  ``router``   ``FleetRouter`` — health-gated, role-aware,
+               prefix-affinity dispatch with a least-predicted-load
+               fallback (StepCostModel analytic bytes).
+"""
+
+from .elastic import ElasticRolePolicy
+from .handoff import migrate, ready_for_handoff
+from .roles import ReplicaHandle, ReplicaRole, parse_fleet_roles
+from .router import FleetRouter
+from .shadow import ShadowPrefixIndex
+
+__all__ = [
+    "ElasticRolePolicy",
+    "FleetRouter",
+    "ReplicaHandle",
+    "ReplicaRole",
+    "ShadowPrefixIndex",
+    "migrate",
+    "parse_fleet_roles",
+    "ready_for_handoff",
+]

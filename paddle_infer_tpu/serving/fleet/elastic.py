@@ -1,0 +1,80 @@
+"""Elastic role policy: flip a ``mixed`` replica toward prefill or
+decode when the observed traffic mix drifts.
+
+The router feeds the policy one observation per tick: prompt tokens
+admitted fleet-wide (prefill demand) vs tokens emitted (decode demand).
+The policy keeps a sliding window of both and reports the prefill
+fraction.  Role flips are hysteretic — a flip toward PREFILL needs the
+fraction above ``high`` AND a flip back needs it below ``low`` — with a
+minimum dwell between flips, so an oscillating mix near the boundary
+doesn't thrash roles (each flip redirects traffic away from the
+replica's warm radix tree, so thrash has a real affinity cost).
+
+Only replicas *configured* ``mixed`` are elastic; explicit
+prefill/decode roles are operator intent the policy never overrides.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Optional
+
+from .roles import ReplicaRole
+
+
+class ElasticRolePolicy:
+    """Hysteresis bands over the windowed prefill-token fraction."""
+
+    def __init__(self, high: float = 0.65, low: float = 0.25,
+                 window: int = 32, min_dwell_s: float = 2.0,
+                 min_tokens: int = 64):
+        if not 0.0 <= low < high <= 1.0:
+            raise ValueError(f"need 0 <= low < high <= 1, got "
+                             f"low={low} high={high}")
+        self.high = float(high)
+        self.low = float(low)
+        self.min_dwell_s = float(min_dwell_s)
+        # below this many windowed tokens the mix is noise, not signal
+        self.min_tokens = int(min_tokens)
+        self._obs = deque(maxlen=int(window))
+        self._last_flip = 0.0
+
+    def observe(self, prefill_tokens: int, decode_tokens: int):
+        if prefill_tokens or decode_tokens:
+            self._obs.append((int(prefill_tokens), int(decode_tokens)))
+
+    @property
+    def prefill_fraction(self) -> Optional[float]:
+        p = sum(o[0] for o in self._obs)
+        d = sum(o[1] for o in self._obs)
+        if p + d < self.min_tokens:
+            return None
+        return p / (p + d)
+
+    def decide(self, current: ReplicaRole,
+               now: Optional[float] = None) -> Optional[ReplicaRole]:
+        """The role a mixed-configured replica should run, or None to
+        stay put.  MIXED is the rest state between the bands."""
+        frac = self.prefill_fraction
+        if frac is None:
+            return None
+        now = time.monotonic() if now is None else now
+        if now - self._last_flip < self.min_dwell_s:
+            return None
+        target = None
+        if frac > self.high and current is not ReplicaRole.PREFILL:
+            target = ReplicaRole.PREFILL
+        elif frac < self.low and current is not ReplicaRole.DECODE:
+            target = ReplicaRole.DECODE
+        elif (self.low <= frac <= self.high
+                and current is not ReplicaRole.MIXED):
+            target = ReplicaRole.MIXED
+        if target is not None:
+            self._last_flip = now
+        return target
+
+    def snapshot(self) -> dict:
+        frac = self.prefill_fraction
+        return {"prefill_fraction": frac,
+                "window": len(self._obs),
+                "high": self.high, "low": self.low}

@@ -1,0 +1,71 @@
+"""Cross-replica KV migration: move an in-flight request from a prefill
+replica to a decode replica at its chunk boundary.
+
+The heavy lifting lives in ``EngineCore.export_handoff`` /
+``import_handoff`` (engine_core.py): export serializes the slot's
+scheduler state plus its physical KV pages and releases the slot
+(retaining the prefix in the source's radix tree); import reserves
+pages in the TARGET pool, writes the contents back and reconstructs the
+slot bitwise.  This module is the fleet-side choreography: pick the
+moment (prompt fully prefilled, request still streaming), pick the
+destination, and make the move atomic-or-recovered — an import failure
+re-imports into the source (the slot it just vacated is still free), and
+if even that fails the request replays through the source's queue (the
+replay path regenerates KV from prompt + delivered tokens, so tokens
+are never lost, merely re-prefilled).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..request import HandoffError, Request
+from .roles import ReplicaHandle
+
+_log = logging.getLogger(__name__)
+
+
+def ready_for_handoff(core, req: Request) -> bool:
+    """A request is a handoff candidate once its prompt is fully
+    prefilled (the natural chunk boundary — the KV to move stops
+    growing by whole chunks) and it still has decode budget left."""
+    with core._step_lock:
+        for s in core._slots:
+            if s is not None and s["req"] is req:
+                return (s["pending"].size == 0
+                        and s["emitted"] >= 1
+                        and not req.done)
+    return False
+
+
+def migrate(req: Request, src: ReplicaHandle,
+            dst: ReplicaHandle) -> bool:
+    """Move ``req`` from ``src`` to ``dst``.  Returns True on success,
+    False when the move could not START (no slot on the source — the
+    request finished or was evicted meanwhile).  Failures AFTER export
+    are recovered: first re-import into the source's just-freed slot,
+    then (last resort) requeue on the source for replay."""
+    try:
+        packet = src.core.export_handoff(req)
+    except HandoffError:
+        return False
+    try:
+        dst.core.import_handoff(packet)
+        src.handoffs_out += 1
+        dst.handoffs_in += 1
+        return True
+    except HandoffError as e:
+        _log.warning("handoff of rid=%d to %s refused (%s); "
+                     "re-importing into %s", req.rid, dst.name, e,
+                     src.name)
+    try:
+        src.core.import_handoff(packet)
+        return False
+    except HandoffError:
+        # both imports refused (e.g. the source started draining
+        # between export and re-import): replay through the source
+        # queue — _admit regenerates KV from prompt + delivered tokens
+        _log.warning("re-import of rid=%d into %s refused; requeueing "
+                     "for replay", req.rid, src.name)
+        src.core.enqueue(req)
+        return False

@@ -1,0 +1,153 @@
+"""Replica roles and the per-replica handle the fleet router holds.
+
+A fleet is N independent ``EngineCore`` replicas (each owning its own
+``PagedGenerationEngine`` and KV pool — pools are strictly per-engine)
+behind one ``FleetRouter``.  Every replica carries a role:
+
+  ``prefill``  admits long prompts, runs their chunked prefill, then
+               hands the KV pages to a decode replica at the chunk
+               boundary.  Its radix tree accumulates the fleet's prompt
+               prefixes (handoff retains the exported prefix), so
+               prefix-affinity keeps steering related prompts here.
+  ``decode``   runs short prompts and the decode phase of handed-off
+               requests; its steps stay dominated by qlen-1 rows, which
+               is what protects ITL from long-prompt interference.
+  ``mixed``    both, like a single-plane core.  The elastic policy
+               (elastic.py) may flip a mixed replica toward whichever
+               side the observed traffic ratio says is starved.
+
+Roles are routing *policy*, not capability — every core can execute
+every request, so role changes and drain re-routing never strand work.
+"""
+from __future__ import annotations
+
+import enum
+import threading
+from typing import List, Optional
+
+
+class ReplicaRole(enum.Enum):
+    PREFILL = "prefill"
+    DECODE = "decode"
+    MIXED = "mixed"
+
+
+def parse_fleet_roles(spec: str) -> List[ReplicaRole]:
+    """Parse a ``--fleet_roles`` value like ``"prefill,decode,decode"``
+    into roles, one per replica.  Raises ValueError on unknown names."""
+    roles = []
+    for part in str(spec).split(","):
+        name = part.strip().lower()
+        if not name:
+            continue
+        try:
+            roles.append(ReplicaRole(name))
+        except ValueError:
+            raise ValueError(
+                f"unknown replica role {name!r}; expected one of "
+                f"{[r.value for r in ReplicaRole]}") from None
+    if not roles:
+        raise ValueError("fleet role spec is empty")
+    return roles
+
+
+class ReplicaHandle:
+    """One fleet member: a core, its health monitor, and its CURRENT
+    role (mutable — the elastic policy flips mixed replicas).  The
+    handle also keeps the router-side dispatch counters that feed the
+    least-predicted-load fallback and the ``router_*`` gauges."""
+
+    def __init__(self, name: str, core, role: ReplicaRole = ReplicaRole.MIXED,
+                 health=None, supervisor=None):
+        from ..resilience.health import HealthMonitor
+
+        self.name = str(name)
+        self.core = core
+        self.supervisor = supervisor
+        if health is None:
+            health = (supervisor.health if supervisor is not None
+                      else HealthMonitor())
+        self.health = health
+        self._lock = threading.Lock()
+        self._role = ReplicaRole(role)
+        self._configured_role = self._role
+        # dispatch accounting (router-side, monotonic)
+        self.dispatched = 0
+        self.affinity_hits = 0
+        self.handoffs_out = 0
+        self.handoffs_in = 0
+        self.role_flips = 0
+
+    # ------------------------------------------------------------- role
+    @property
+    def role(self) -> ReplicaRole:
+        with self._lock:
+            return self._role
+
+    def set_role(self, role: ReplicaRole) -> bool:
+        """Flip the live role (elastic policy).  Returns True when the
+        role actually changed."""
+        role = ReplicaRole(role)
+        with self._lock:
+            if role is self._role:
+                return False
+            self._role = role
+            self.role_flips += 1
+            return True
+
+    @property
+    def configured_role(self) -> ReplicaRole:
+        return self._configured_role
+
+    def accepts_prefill(self) -> bool:
+        return self.role in (ReplicaRole.PREFILL, ReplicaRole.MIXED)
+
+    def accepts_decode(self) -> bool:
+        return self.role in (ReplicaRole.DECODE, ReplicaRole.MIXED)
+
+    # ----------------------------------------------------------- health
+    def is_serving(self) -> bool:
+        return self.health.is_serving()
+
+    # ------------------------------------------------------------- load
+    def predicted_load_bytes(self) -> float:
+        """Analytic bytes the replica's NEXT scheduler step would move,
+        per the core's StepCostModel: its resident pages re-streamed by
+        the occupied rows, plus one chunk of every queued prompt.  The
+        router's load-balance fallback picks the minimum — predicted
+        cost, not queue length, is what actually prices a long-prompt
+        backlog correctly (ROADMAP: analytic first, learned model
+        later)."""
+        core = self.core
+        rows = core.active_count
+        queued = core.queue_depth
+        model = core._cost_model
+        pages = core._used_pages()
+        if rows == 0 and queued == 0:
+            return 0.0
+        step_bytes, _fl, _src = model.estimate(
+            "mixed", rows=max(rows, 1), max_rows=core.max_batch,
+            pages_touched=pages,
+            tokens=rows + queued * max(1, core._prefill_chunk))
+        return float(step_bytes)
+
+    def snapshot(self) -> dict:
+        """One ``router_*``-ready row for this replica."""
+        core = self.core
+        with self._lock:
+            role = self._role.value
+            role_flips = self.role_flips
+        return {
+            "name": self.name,
+            "role": role,
+            "configured_role": self._configured_role.value,
+            "health": self.health.snapshot(),
+            "active": core.active_count,
+            "queued": core.queue_depth,
+            "predicted_load_bytes": self.predicted_load_bytes(),
+            "dispatched": self.dispatched,
+            "affinity_hits": self.affinity_hits,
+            "handoffs_out": self.handoffs_out,
+            "handoffs_in": self.handoffs_in,
+            "role_flips": role_flips,
+        }

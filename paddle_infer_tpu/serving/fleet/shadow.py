@@ -1,0 +1,114 @@
+"""Shadow radix index: the router's belief about which replica retains
+which prompt prefixes.
+
+The authoritative state lives in each replica's ``PrefixCache`` radix
+tree, but probing every replica's tree for every candidate prefix on
+every dispatch would serialize the router on N tree locks.  Instead the
+router keeps a page-granular shadow trie per (replica, salt), fed by
+what it *observed*: prompts it dispatched, prefixes retained by handoff
+exports, and the answers of the read-only ``PrefixCache.peek()`` probes
+it does issue.  The shadow answers "who probably holds the longest
+prefix" instantly; the router then confirms the top candidates with
+``peek()`` (no pins, no LRU movement — see tree.py) before committing,
+so a stale shadow can cost a probe, never a wrong pin.
+
+The shadow is deliberately forgetful: entries are advisory (eviction on
+the replica can only shrink a match, exactly like the gap between
+``peek`` and ``match``), a per-replica node budget clears the whole
+replica trie on overflow (it repopulates from traffic), and
+``forget()`` drops a replica wholesale when it drains, goes DOWN, or
+flips role.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class _Node:
+    __slots__ = ("children",)
+
+    def __init__(self):
+        self.children: Dict[tuple, "_Node"] = {}
+
+
+class ShadowPrefixIndex:
+    """Per-replica page-granular prefix tries with a node budget."""
+
+    def __init__(self, page_size: int, max_nodes_per_replica: int = 4096):
+        self.page = int(page_size)
+        self.max_nodes = int(max_nodes_per_replica)
+        self._lock = threading.Lock()
+        # (replica, salt) -> root node; replica -> node count
+        self._roots: Dict[Tuple[str, Optional[str]], _Node] = {}
+        self._counts: Dict[str, int] = {}
+
+    # ----------------------------------------------------------- writes
+    def observe(self, replica: str, tokens, salt: Optional[str] = None):
+        """Record that ``replica`` plausibly retains ``tokens``'s full
+        pages (dispatched prompt, handoff-retained prefix, or a peek
+        answer).  Only whole pages are indexed — partial tails churn too
+        fast to be worth shadowing."""
+        toks = [int(t) for t in tokens]
+        n_pages = len(toks) // self.page
+        if n_pages == 0:
+            return
+        with self._lock:
+            if self._counts.get(replica, 0) >= self.max_nodes:
+                self._forget_locked(replica)
+            node = self._roots.setdefault((replica, salt), _Node())
+            for i in range(n_pages):
+                chunk = tuple(toks[i * self.page:(i + 1) * self.page])
+                child = node.children.get(chunk)
+                if child is None:
+                    child = _Node()
+                    node.children[chunk] = child
+                    self._counts[replica] = self._counts.get(replica, 0) + 1
+                node = child
+
+    def forget(self, replica: str):
+        """Drop every shadow entry for ``replica`` (drain, DOWN, role
+        flip away from prefill)."""
+        with self._lock:
+            self._forget_locked(replica)
+
+    def _forget_locked(self, replica: str):
+        for key in [k for k in self._roots if k[0] == replica]:
+            del self._roots[key]
+        self._counts.pop(replica, None)
+
+    # ------------------------------------------------------------ reads
+    def predict(self, replica: str, tokens,
+                salt: Optional[str] = None) -> int:
+        """Predicted longest-match length (full pages) for ``tokens`` on
+        ``replica`` — the shadow's answer, unverified."""
+        toks = [int(t) for t in tokens]
+        with self._lock:
+            node = self._roots.get((replica, salt))
+            depth = 0
+            while node is not None:
+                chunk = tuple(toks[depth * self.page:
+                                   (depth + 1) * self.page])
+                if len(chunk) < self.page:
+                    break
+                child = node.children.get(chunk)
+                if child is None:
+                    break
+                node = child
+                depth += 1
+            return depth * self.page
+
+    def rank(self, replicas: List[str], tokens,
+             salt: Optional[str] = None) -> List[Tuple[str, int]]:
+        """``(replica, predicted_match)`` for each candidate, best
+        first; ties keep the caller's order (stable sort) so the router
+        can pre-order by load."""
+        scored = [(name, self.predict(name, tokens, salt))
+                  for name in replicas]
+        scored.sort(key=lambda it: -it[1])
+        return scored
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"replicas": len({k[0] for k in self._roots}),
+                    "nodes": sum(self._counts.values())}

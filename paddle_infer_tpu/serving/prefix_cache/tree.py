@@ -117,6 +117,7 @@ class PrefixCache:
         # counters (rendered under snapshot["prefix_cache"])
         self.queries = 0
         self.hits = 0
+        self.peeks = 0              # read-only router probes (peek())
         self.cached_tokens_total = 0
         self.prompt_tokens_total = 0
         self.inserts = 0
@@ -191,6 +192,40 @@ class PrefixCache:
                 self.hits += 1
                 self.cached_tokens_total += m.cached_tokens
             return m
+
+    def peek(self, tokens, salt=None) -> int:
+        """Read-only longest-match probe: how many tokens of ``tokens``
+        a ``match`` would serve right now (full shared pages plus the
+        best partial tail, capped at ``len(tokens) - 1`` exactly like
+        ``match``), with NONE of match's side effects — no pins, no LRU
+        clock movement, no hit/query counters.  The fleet router calls
+        this against every replica per dispatch decision, so the probe
+        must never perturb eviction order or inflate the hit-rate
+        gauges; probes are tallied separately under ``peeks``.  The
+        answer is advisory — blocks are not pinned, so eviction between
+        peek and the eventual ``match`` can only shrink it."""
+        toks = [int(t) for t in tokens]
+        with self._lock:
+            self.peeks += 1
+            usable = len(toks) - 1
+            node = self._roots.get(salt)
+            depth = 0
+            while node is not None and (depth + 1) * self.page <= usable:
+                chunk = tuple(toks[depth * self.page:
+                                   (depth + 1) * self.page])
+                child = node.children.get(chunk)
+                if child is None:
+                    break
+                node = child
+                depth += 1
+            best = 0
+            if node is not None:
+                rem = toks[depth * self.page:usable]
+                for ptoks in node.partials:
+                    best = max(best, _common(ptoks, rem))
+                for chunk in node.children:
+                    best = max(best, _common(chunk, rem))
+            return depth * self.page + best
 
     def lookahead(self, tokens, k, salt=None):
         """Read-only draft proposal: the tree is a free suffix index, so
@@ -389,6 +424,7 @@ class PrefixCache:
                 "hits": self.hits,
                 "hit_rate": (self.hits / self.queries
                              if self.queries else 0.0),
+                "peeks": self.peeks,
                 "cached_tokens": self.cached_tokens_total,
                 "prompt_tokens": self.prompt_tokens_total,
                 "token_ratio": (self.cached_tokens_total /

@@ -46,6 +46,13 @@ class LoadShedError(RejectedError):
     for the degraded engine) or refused while DRAINING (HTTP 503)."""
 
 
+class HandoffError(RejectedError):
+    """A cross-replica KV handoff could not run (no free slot on the
+    target, draining/closed replica, or incompatible pool geometry).
+    The request is untouched: export fails before the source slot is
+    released, import before the target reserves anything."""
+
+
 class RequestState(Enum):
     QUEUED = "queued"
     ACTIVE = "active"

@@ -108,3 +108,13 @@ class HealthMonitor:
         with self._lock:
             return [{"t": t, "from": a, "to": b, "reason": r}
                     for (t, a, b, r) in self._log]
+
+    def snapshot(self) -> dict:
+        """One consistent read of (state, code, serving, transition
+        count) — the per-replica health row the fleet router publishes
+        without taking this lock four times."""
+        with self._lock:
+            return {"state": self._state.value,
+                    "code": _CODES[self._state],
+                    "serving": self._state in _SERVING,
+                    "transitions": len(self._log)}

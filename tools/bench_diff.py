@@ -29,7 +29,7 @@ _HIGHER = ("per_s", "per_sec", "speedup", "mfu", "acceptance",
            "hit_rate", "tps", "throughput", "tokens_per", "pearson",
            "improvement", "spec_decode", "bytes_saved")
 _LOWER = ("_ms", "latency", "ttft", "itl", "err", "wall", "p50",
-          "p99", "wasted", "ici_bytes", "_s")
+          "p99", "wasted", "ici_bytes", "compile", "_s")
 # harness bookkeeping, not workload performance
 _SKIP = ("vs_baseline", "child_wall_s", "bench_wall_s", "n", "rc")
 

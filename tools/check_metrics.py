@@ -103,7 +103,7 @@ def fabricated_exposition():
                       kv_pool={"total_blocks": 32, "used_blocks": 8,
                                "free_blocks": 24, "occupancy": 0.25},
                       prefix_cache={"queries": 6, "hits": 4,
-                                    "hit_rate": 4 / 6,
+                                    "hit_rate": 4 / 6, "peeks": 12,
                                     "cached_tokens": 96,
                                     "prompt_tokens": 160,
                                     "token_ratio": 0.6, "inserts": 5,
@@ -129,6 +129,36 @@ def fabricated_exposition():
                                         "all_gather": {"float32": 2.0e5}},
                                     "bytes_total": 7.1e5,
                                     "bytes_saved_total": 1.4e6}})
+
+    # fleet router section (FleetRouter.snapshot() shape): two replicas
+    # so every per-replica family renders multiple label values
+    snap["router"] = {
+        "replicas": [
+            {"name": "prefill0", "role": "prefill",
+             "configured_role": "prefill",
+             "health": {"state": "healthy", "code": 0, "serving": True,
+                        "transitions": 0},
+             "active": 1, "queued": 2,
+             "predicted_load_bytes": 2.5e6, "dispatched": 9,
+             "affinity_hits": 4, "handoffs_out": 3, "handoffs_in": 0,
+             "role_flips": 0},
+            {"name": "decode1", "role": "decode",
+             "configured_role": "mixed",
+             "health": {"state": "draining", "code": 2,
+                        "serving": False, "transitions": 1},
+             "active": 2, "queued": 0,
+             "predicted_load_bytes": 1.1e6, "dispatched": 14,
+             "affinity_hits": 2, "handoffs_out": 0, "handoffs_in": 3,
+             "role_flips": 1},
+        ],
+        "dispatched": 23, "affinity_hits": 6,
+        "affinity_hit_rate": 6 / 23, "handoffs": 3, "requeued": 2,
+        "no_replica_rejects": 1, "pending_handoffs": 1, "inflight": 3,
+        "prefill_threshold": 25,
+        "shadow": {"replicas": 2, "nodes": 11},
+        "elastic": {"prefill_fraction": 0.41, "window": 12,
+                    "high": 0.65, "low": 0.25},
+    }
 
     # local CompileLog (not the process singleton): one prefill, one
     # warmed decode, one post-warmup recompile so the recompile/storm

@@ -42,8 +42,16 @@ decode steps instead of serializing behind a lock.
                           accepts new work (HEALTHY/DEGRADED), 503 +
                           Retry-After while DRAINING/DOWN
   POST /admin/drain       -> stop admitting (health -> DRAINING);
-                          in-flight requests finish
+                          in-flight requests finish; the JSON response
+                          reports {"in_flight", "queued"} so operators
+                          (and the fleet router) can poll drain progress
   POST /admin/resume      -> leave DRAINING/DOWN back into service
+
+With ``--fleet_roles prefill,decode,...`` the process runs a
+disaggregated fleet: one supervised EngineCore per role behind a
+prefix-affinity FleetRouter with cross-replica KV page handoff
+(docs/SERVING.md "Disaggregated serving"); admin endpoints then act
+fleet-wide and /metrics carries the ``router_*`` families.
 
 Admission control maps to HTTP codes: queue full -> 429 + Retry-After,
 draining/load-shed -> 503 + Retry-After, deadline exceeded -> 504,
@@ -74,11 +82,66 @@ import numpy as np
 _STATE = {"lock": threading.Lock()}
 
 
+def _build_fleet(roles):
+    """Disaggregated fleet (--fleet_roles): one EngineCore + supervisor
+    per role, each owning its OWN engine and KV pool (pools are strictly
+    per-engine), all sharing one tracer and one StepLog so /trace and
+    /steps stay fleet-wide, behind a FleetRouter.  The router thread
+    only routes — supervisors own the scheduler threads."""
+    from paddle_infer_tpu.inference.generation import PagedGenerationEngine
+    from paddle_infer_tpu.observability import Tracer
+    from paddle_infer_tpu.observability.steplog import StepLog
+    from paddle_infer_tpu.serving import (EngineCore, EngineSupervisor,
+                                          FleetRouter, ReplicaHandle)
+
+    tracer = Tracer()
+    steplog = StepLog()
+    handles, sups = [], []
+    for i, role in enumerate(roles):
+        engine = PagedGenerationEngine(
+            _STATE["model"], page_size=_STATE["page_size"])
+        core = EngineCore(
+            engine,
+            max_batch=_STATE["max_batch"],
+            max_queue=_STATE["max_queue"],
+            decode_chunk=_STATE["decode_chunk"],
+            default_timeout_s=_STATE["request_timeout"],
+            max_model_len=_STATE["max_model_len"],
+            tracer=tracer, steplog=steplog,
+            enable_prefix_cache=_STATE.get("enable_prefix_cache", False),
+            prefix_cache_watermark=_STATE.get(
+                "prefix_cache_watermark", 0.5),
+            prefix_cache_headroom_pages=_STATE.get(
+                "prefix_cache_headroom_pages", 0),
+            ragged=True,
+            prefill_chunk=_STATE.get("prefill_chunk"),
+            token_budget=_STATE.get("token_budget"))
+        sup = EngineSupervisor(
+            core,
+            watchdog_s=_STATE.get("watchdog_s", 5.0),
+            max_retries=_STATE.get("max_retries", 2)).start()
+        handles.append(ReplicaHandle(f"{role.value}{i}", core, role,
+                                     supervisor=sup))
+        sups.append(sup)
+    router = FleetRouter(
+        handles,
+        prefix_affinity=_STATE.get("prefix_affinity", True))
+    router.start(start_cores=False)
+    _STATE["handles"] = handles
+    _STATE["sups"] = sups
+    _STATE["sup"] = sups[0]
+    _STATE["router"] = router
+    _STATE["core"] = handles[0].core
+
+
 def _core():
     """The continuous-batching scheduler (owns the paged engine).  The
     stepping thread belongs to the resilience supervisor, which wires
     its recovery protocol (watchdog, retry/replay, degradation ladder)
-    into the core's failure paths."""
+    into the core's failure paths.  In fleet mode (--fleet_roles) this
+    is the PRIMARY replica's core — exclusives and the trace/step
+    surfaces go through it; batchable generation routes via
+    ``_STATE["router"]``."""
     with _STATE["lock"]:
         if "core" not in _STATE:
             from paddle_infer_tpu.serving import (EngineCore,
@@ -86,6 +149,9 @@ def _core():
                                                   FaultPlane, ServingMesh,
                                                   build_sharded_engine)
 
+            if _STATE.get("fleet_roles"):
+                _build_fleet(_STATE["fleet_roles"])
+                return _STATE["core"]
             smesh = _STATE.get("serving_mesh") or ServingMesh()
             engine = build_sharded_engine(
                 _STATE["model"], smesh, page_size=_STATE["page_size"])
@@ -210,6 +276,21 @@ def _error_code(e) -> int:
     return 500
 
 
+def _submit_batch(core, ids, g, timeout_s, cache_salt):
+    """Batchable admission: per-row through the fleet router when one
+    is up (role/affinity/health-aware placement), else the single
+    core's all-or-nothing submit."""
+    router = _STATE.get("router")
+    if router is None:
+        return core.submit(ids, g, timeout_s=timeout_s,
+                           cache_salt=cache_salt)
+    ids = np.asarray(ids, np.int32)
+    if ids.ndim == 1:
+        ids = ids[None, :]
+    return [router.submit(row, g, timeout_s=timeout_s,
+                          cache_salt=cache_salt) for row in ids]
+
+
 def _generate(ids, g, timeout_s, cache_salt=None):
     """Route one /generate body; returns (tokens [b, max_new], extra).
     ``extra["request_ids"]`` always carries the engine request ids so
@@ -227,8 +308,7 @@ def _generate(ids, g, timeout_s, cache_salt=None):
         return toks, {"speculative": True, "acceptance": acceptance,
                       "request_ids": [req.rid]}
     if core.batchable(g):
-        reqs = core.submit(ids, g, timeout_s=timeout_s,
-                           cache_salt=cache_salt)
+        reqs = _submit_batch(core, ids, g, timeout_s, cache_salt)
         return (np.stack([r.padded_result(timeout=None) for r in reqs]),
                 {"request_ids": [r.rid for r in reqs]})
     # beams / repetition penalty: exclusive dense-engine call
@@ -329,6 +409,9 @@ class Handler(BaseHTTPRequestHandler):
         elif url.path == "/metrics":
             core = _core()
             snap = core.metrics_snapshot()
+            router = _STATE.get("router")
+            if router is not None:
+                snap["router"] = router.snapshot()
             compile_summary = get_compile_log().summary()
             accept = self.headers.get("Accept", "")
             # content negotiation: Prometheus scrapers say text/plain
@@ -383,11 +466,20 @@ class Handler(BaseHTTPRequestHandler):
                 if length:
                     self.rfile.read(length)
                 sup = _sup()
-                if self.path == "/admin/drain":
-                    sup.drain()
-                else:
-                    sup.resume()
-                self._json(200, {"status": sup.health.state.value})
+                sups = _STATE.get("sups") or [sup]
+                for s in sups:
+                    if self.path == "/admin/drain":
+                        s.drain()
+                    else:
+                        s.resume()
+                # drain progress: operators (and the fleet router) poll
+                # this count down to zero before taking the node out
+                cores = ([h.core for h in _STATE.get("handles", [])]
+                         or [_core()])
+                self._json(200, {
+                    "status": sup.health.state.value,
+                    "in_flight": sum(c.active_count for c in cores),
+                    "queued": sum(c.queue_depth for c in cores)})
             except Exception as e:
                 self._json(500, {"error": repr(e)[:400]})
             return
@@ -435,8 +527,8 @@ class Handler(BaseHTTPRequestHandler):
                     return
                 # submit BEFORE headers so admission errors (429/504/400)
                 # still map to status codes
-                reqs = _core().submit(ids, g, timeout_s=timeout_s,
-                                      cache_salt=cache_salt)
+                reqs = _submit_batch(_core(), ids, g, timeout_s,
+                                     cache_salt)
                 chunks = _stream_chunks(
                     reqs, g, chunk_size=int(body.get("chunk_size", 8)))
                 self.send_response(200)
@@ -570,11 +662,51 @@ def main(argv=None):
                          "all-reduces (~4x fewer interconnect bytes, "
                          "approximate logits); incompatible with "
                          "--speculate and --enable_prefix_cache")
+    ap.add_argument("--fleet_roles", default=None,
+                    help="disaggregated fleet: comma-separated replica "
+                         "roles, e.g. 'prefill,decode,mixed' — one "
+                         "EngineCore + supervisor per role behind a "
+                         "prefix-affinity FleetRouter with KV page "
+                         "handoff at chunk boundaries (docs/SERVING.md "
+                         "'Disaggregated serving'); incompatible with "
+                         "--mp/--dp_replicas/--legacy_programs/"
+                         "--speculate/--fault_script")
+    ap.add_argument("--prefix_affinity", default="on",
+                    choices=("on", "off"),
+                    help="fleet routing: steer each request to the "
+                         "replica whose radix tree holds its longest "
+                         "prefix (confirmed via the read-only "
+                         "PrefixCache.peek); 'off' leaves pure "
+                         "least-predicted-load dispatch")
     args = ap.parse_args(argv)
 
     from paddle_infer_tpu.models import AutoModel
     from paddle_infer_tpu.serving import (ServingMesh, ShardedConfigError,
+                                          parse_fleet_roles,
                                           validate_serving_config)
+
+    fleet_roles = None
+    if args.fleet_roles:
+        try:
+            fleet_roles = parse_fleet_roles(args.fleet_roles)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr, flush=True)
+            return 2
+        incompatible = [name for name, on in (
+            ("--mp > 1", args.mp > 1),
+            ("--dp_replicas > 1", args.dp_replicas > 1),
+            ("--quantized_allreduce", bool(args.quantized_allreduce)),
+            ("--legacy_programs", args.legacy_programs),
+            ("--speculate", args.speculate),
+            ("--fault_script", bool(args.fault_script))) if on]
+        if incompatible:
+            print("error: --fleet_roles is incompatible with "
+                  + ", ".join(incompatible)
+                  + " (fleet replicas are single-device ragged cores)",
+                  file=sys.stderr, flush=True)
+            return 2
+    _STATE["fleet_roles"] = fleet_roles
+    _STATE["prefix_affinity"] = args.prefix_affinity == "on"
 
     serving_mesh = ServingMesh(
         mp=args.mp, dp_replicas=args.dp_replicas,
