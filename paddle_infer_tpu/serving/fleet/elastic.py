@@ -54,24 +54,29 @@ class ElasticRolePolicy:
     def decide(self, current: ReplicaRole,
                now: Optional[float] = None) -> Optional[ReplicaRole]:
         """The role a mixed-configured replica should run, or None to
-        stay put.  MIXED is the rest state between the bands."""
+        stay put.  MIXED is the rest state between the bands.  Pure
+        query: the dwell clock only restarts when the router reports
+        the flip actually happened (``committed``), so a decision the
+        router's coverage guard rejects doesn't suppress later flips."""
         frac = self.prefill_fraction
         if frac is None:
             return None
         now = time.monotonic() if now is None else now
         if now - self._last_flip < self.min_dwell_s:
             return None
-        target = None
         if frac > self.high and current is not ReplicaRole.PREFILL:
-            target = ReplicaRole.PREFILL
-        elif frac < self.low and current is not ReplicaRole.DECODE:
-            target = ReplicaRole.DECODE
-        elif (self.low <= frac <= self.high
+            return ReplicaRole.PREFILL
+        if frac < self.low and current is not ReplicaRole.DECODE:
+            return ReplicaRole.DECODE
+        if (self.low <= frac <= self.high
                 and current is not ReplicaRole.MIXED):
-            target = ReplicaRole.MIXED
-        if target is not None:
-            self._last_flip = now
-        return target
+            return ReplicaRole.MIXED
+        return None
+
+    def committed(self, now: Optional[float] = None):
+        """The router applied a decided flip (``set_role`` succeeded);
+        start the dwell period."""
+        self._last_flip = time.monotonic() if now is None else now
 
     def snapshot(self) -> dict:
         frac = self.prefill_fraction

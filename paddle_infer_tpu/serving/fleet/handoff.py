@@ -64,8 +64,15 @@ def migrate(req: Request, src: ReplicaHandle,
     except HandoffError:
         # both imports refused (e.g. the source started draining
         # between export and re-import): replay through the source
-        # queue — _admit regenerates KV from prompt + delivered tokens
+        # queue — _admit regenerates KV from prompt + delivered tokens.
+        # push_front, NOT enqueue: enqueue's drain/backpressure gates
+        # reject exactly the states this path exists for, and the
+        # exported slot is already freed, so a rejection here would
+        # strand the consumer until its deadline.  push_front bypasses
+        # both gates, like the supervisor's replay path — a DRAINING
+        # core keeps stepping, so the replayed request still finishes.
         _log.warning("re-import of rid=%d into %s refused; requeueing "
                      "for replay", req.rid, src.name)
-        src.core.enqueue(req)
+        req._requeue()
+        src.core._queue.push_front(req)
         return False

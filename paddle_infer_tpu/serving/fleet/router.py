@@ -40,7 +40,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ...inference.generation import GenerationConfig
-from ..request import LoadShedError, Request
+from ..request import LoadShedError, RejectedError, Request
 from .elastic import ElasticRolePolicy
 from .handoff import migrate, ready_for_handoff
 from .roles import ReplicaHandle, ReplicaRole
@@ -78,6 +78,10 @@ class FleetRouter:
         # rid set registered for prefill->decode handoff
         self._want_handoff: Dict[int, None] = {}
         self._emitted_seen: Dict[int, int] = {}
+        # last-observed serving state per replica, so the tick can drop
+        # a replica's shadow entries the moment it stops serving
+        self._was_serving: Dict[str, bool] = {
+            h.name: h.is_serving() for h in self._replicas}
         self._tick_prefill_tokens = 0
         # fleet-wide counters for the router_* families
         self.requeued = 0
@@ -157,12 +161,20 @@ class FleetRouter:
         if self._affinity and ids.size > 1:
             ranked = self._shadow.rank([h.name for h in by_load], ids,
                                        salt)
-            best_h, best_len = None, 0
-            for name, _pred in ranked:
+            # confirm only replicas the shadow predicts hold at least
+            # one page, and at most the top two — peek() takes the
+            # candidate's tree lock, and probing every replica per
+            # dispatch would serialize the router on N locks (the exact
+            # cost the shadow exists to avoid)
+            best_h, best_len, probed = None, 0, 0
+            for name, pred in ranked:
+                if pred < self._page or probed >= 2:
+                    break
                 h = self._by_name[name]
                 cache = h.core.prefix_cache
                 if cache is None:
                     continue
+                probed += 1
                 confirmed = cache.peek(ids, salt=salt)
                 if confirmed > best_len:
                     best_h, best_len = h, confirmed
@@ -189,6 +201,7 @@ class FleetRouter:
                 progressed |= bool(h.core.run_once(wait_s=0.0))
         progressed |= self._do_handoffs()
         progressed |= self._reroute_stranded()
+        self._forget_unserving()
         self._apply_elastic()
         self._prune_and_observe()
         if not progressed and wait_s > 0.0:
@@ -282,7 +295,16 @@ class FleetRouter:
                 if target is None:
                     h.core._queue.push_front(r)
                     continue
-                target.core.enqueue(r)
+                try:
+                    target.core.enqueue(r)
+                except RejectedError:
+                    # the target filled or started draining between the
+                    # _serving() check and the enqueue; back to the
+                    # source HEAD (push_front bypasses the depth bound)
+                    # so a drained request is never lost — the next
+                    # tick retries against a fresh target
+                    h.core._queue.push_front(r)
+                    continue
                 target.dispatched += 1
                 self.requeued += 1
                 with self._lock:
@@ -300,6 +322,18 @@ class FleetRouter:
                 else ReplicaHandle.accepts_decode)
         cands = [h for h in serving if want(h)] or serving
         return min(cands, key=lambda h: h.predicted_load_bytes())
+
+    def _forget_unserving(self):
+        """Drop shadow entries for replicas that stopped serving.  A
+        DRAINING/DOWN replica's retained prefixes are unroutable, and a
+        restarted core comes back with an EMPTY tree — stale shadow
+        entries would keep attracting affinity probes (wasted peeks,
+        skewed routing) until the node budget happened to clear them."""
+        for h in self._replicas:
+            serving = h.is_serving()
+            if self._was_serving.get(h.name, True) and not serving:
+                self._shadow.forget(h.name)
+            self._was_serving[h.name] = serving
 
     def _apply_elastic(self):
         if self._elastic is None:
@@ -331,6 +365,15 @@ class FleetRouter:
                     and not any(o.accepts_prefill() for o in others)):
                 continue
             h.set_role(target)
+            # the dwell clock starts at the COMMITTED flip, not at
+            # decide() — a coverage-guard rejection above must not
+            # suppress later flips for min_dwell_s
+            self._elastic.committed()
+            if not h.accepts_prefill():
+                # flipped away from prefill: the tree stops
+                # accumulating the fleet's prefixes, so the shadow
+                # re-learns this replica from live traffic
+                self._shadow.forget(h.name)
             break
 
     def _prune_and_observe(self):

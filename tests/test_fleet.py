@@ -188,6 +188,40 @@ def test_migrate_refuses_cleanly_when_not_slotted(make_core):
     assert dst.core.active_count == 0
 
 
+def test_migrate_replay_fallback_bypasses_drain_gate(make_core):
+    """Worst-case recovery: BOTH imports refused (the source started
+    draining between export and re-import).  The replay fallback must
+    not go through ``enqueue`` — its drain gate raises LoadShedError in
+    exactly this state, which would escape migrate and strand the
+    request with its exported slot already freed.  It must land at the
+    source queue's head, replay there (a draining core keeps stepping),
+    and still finish bitwise-identical to a single-core run."""
+    g = GenerationConfig(max_new_tokens=10)
+    prompt = _prompt(43, n=24)
+    ref = make_core()
+    want_req = ref.submit(prompt, g)[0]
+    _drive(ref, [want_req])
+    want = np.asarray(want_req.result(timeout=60))
+
+    src = ReplicaHandle("p0", make_core(), ReplicaRole.PREFILL)
+    dst = ReplicaHandle("d0", make_core(), ReplicaRole.DECODE)
+    req = src.core.submit(prompt, g)[0]
+    for _ in range(400):
+        if ready_for_handoff(src.core, req):
+            break
+        src.core.run_once()
+    else:
+        raise AssertionError("request never became handoff-ready")
+    dst.core.set_draining(True)             # import refused
+    src.core.set_draining(True)             # re-import refused too
+    assert not migrate(req, src, dst)       # must NOT raise
+    assert src.core.queue_depth == 1        # requeued at the source
+    assert not req.done
+    _drive(src.core, [req])
+    np.testing.assert_array_equal(np.asarray(req.result(timeout=60)),
+                                  want)
+
+
 # ---------------------------------------------------------------- peek
 
 def test_peek_is_read_only_after_1000_probes():
@@ -253,6 +287,11 @@ def test_router_prefix_affinity_routes_to_warm_replica(make_core):
     assert warm.dispatched == 2             # routed back to the warm tree
     assert warm.affinity_hits == 1
     assert warm.core.prefix_cache.peeks >= 1
+    # the cold replica's shadow predicts no match, so it must never be
+    # probed — peek() takes its tree lock, and probing every candidate
+    # per dispatch is the serialization the shadow exists to avoid
+    cold = b if warm is a else a
+    assert cold.core.prefix_cache.peeks == 0
     _drive_router(router, [r2])
     np.testing.assert_array_equal(np.asarray(r2.result(timeout=60)),
                                   np.asarray(r1.result(timeout=60)))
@@ -331,6 +370,50 @@ def test_router_role_gate_and_health_gate(make_core):
     _drive_router(router, [r])
 
 
+def test_reroute_survives_target_refusal(make_core):
+    """The target replica can fill (or start draining) between the
+    reroute's ``_serving()`` check and the enqueue.  The refusal must
+    not abort the reroute loop or drop requests: everything the drained
+    source queue held goes back to its head and retries next tick."""
+    a = ReplicaHandle("a0", make_core())
+    b = ReplicaHandle("b0", make_core())
+    router = FleetRouter([a, b])
+    g = GenerationConfig(max_new_tokens=4)
+    n = CORE_SHAPE["max_batch"] + 2
+    reqs = [a.core.submit(_prompt(60 + i, n=8), g)[0] for i in range(n)]
+    router.run_once()                       # a slots max_batch, 2 queue
+    stranded = a.core.queue_depth
+    assert stranded == 2
+    a.health.to_draining("test drain")
+    depth, b.core._queue.max_depth = b.core._queue.max_depth, 0
+    router.run_once()                       # b refuses every enqueue
+    assert router.requeued == 0
+    assert a.core.queue_depth == stranded   # nothing lost
+    b.core._queue.max_depth = depth
+    router.run_once()
+    assert router.requeued == stranded      # retried and rerouted
+    _drive_router(router, reqs)
+    for r in reqs:
+        assert len(r.result(timeout=60)) > 0
+
+
+def test_shadow_forgets_replica_that_stops_serving(make_core):
+    """A replica that drains (or goes DOWN) must be dropped from the
+    shadow index: a restarted core comes back with an EMPTY tree, so
+    stale entries would keep attracting affinity probes."""
+    a = ReplicaHandle("a0", make_core(enable_prefix_cache=True))
+    b = ReplicaHandle("b0", make_core(enable_prefix_cache=True))
+    router = FleetRouter([a, b], prefix_affinity=True)
+    r1 = router.submit(_prompt(31, n=20), GenerationConfig(max_new_tokens=4))
+    _drive_router(router, [r1])
+    warm = a if a.dispatched else b
+    assert router.snapshot()["shadow"]["nodes"] >= 1
+    warm.health.to_draining("maintenance")
+    router.run_once()
+    snap = router.snapshot()["shadow"]
+    assert snap["nodes"] == 0 and snap["replicas"] == 0
+
+
 def test_router_rejects_when_no_replica_serving(make_core):
     h = ReplicaHandle("only", make_core())
     router = FleetRouter([h])
@@ -350,11 +433,17 @@ def test_elastic_policy_hysteresis_and_dwell():
     pol.observe(100, 0)
     assert pol.prefill_fraction == 1.0
     assert pol.decide(ReplicaRole.MIXED, now=100.0) is ReplicaRole.PREFILL
-    # dwell guard: no second flip inside min_dwell_s
+    # decide() is a pure query: until the router COMMITS the flip, the
+    # dwell clock must not start — a coverage-guard rejection would
+    # otherwise suppress every later flip for min_dwell_s
+    assert pol.decide(ReplicaRole.MIXED, now=101.0) is ReplicaRole.PREFILL
+    pol.committed(101.0)
+    # dwell guard: no second flip inside min_dwell_s of the commit
     for _ in range(4):
         pol.observe(0, 100)
     assert pol.decide(ReplicaRole.PREFILL, now=105.0) is None
     assert pol.decide(ReplicaRole.PREFILL, now=120.0) is ReplicaRole.DECODE
+    pol.committed(120.0)
     # mid-band pulls back to MIXED (the rest state)
     for _ in range(4):
         pol.observe(50, 50)
