@@ -603,6 +603,12 @@ def _child_main():
                                lambda: _prefix_cache_bench(on_tpu),
                                tpu_only=False)
 
+    # int8 paged KV vs fp: resident concurrency at equal pool bytes,
+    # decode throughput, measured quantization error vs analytic bound
+    quantized_kv = run_section("quantized_kv", 500,
+                               lambda: _quantized_kv_bench(on_tpu),
+                               tpu_only=False)
+
     # fault tolerance: goodput + token integrity under a seeded fault
     # schedule (engine crashes, KV loss, injected OOM)
     resilience = run_section("resilience", 420,
@@ -676,6 +682,8 @@ def _child_main():
         result["mixed_traffic"] = mixed_traffic
     if prefix_cache is not None:
         result["prefix_cache"] = prefix_cache
+    if quantized_kv is not None:
+        result["quantized_kv"] = quantized_kv
     if resilience is not None:
         result["resilience"] = resilience
     if sharded_serving is not None:
@@ -1341,6 +1349,205 @@ def _prefix_cache_bench(on_tpu: bool):
         "evicted_blocks": after["evicted_blocks"],
         "cached_blocks": after["cached_blocks"],
     }
+
+
+def _kv_logit_amplification(model, cfg) -> float:
+    """Loose first-order operator-norm amplification of a KV-domain
+    perturbation into the logit domain.  Sound ingredients only —
+    LayerNorm output is elementwise bounded by ``sqrt(d)*max|γ| +
+    max|β|``, its Lipschitz constant by ``2*max|γ|/sqrt(eps)`` (the eps
+    floor bounds 1/σ), softmax weights move at most ``2*max|Δlogit|``
+    in total variation, attention output is a convex combination of V
+    rows, GELU is 1.13-Lipschitz — so the product DOMINATES the true
+    sensitivity but is loose by orders of magnitude (the 1/sqrt(eps)
+    factor per LN).  The tight per-element bound lives in the KV domain
+    (``kv_dequant_error_bound``); this factor only translates it to a
+    formally-sound logit-domain ceiling for the bench gate."""
+    params = {n: np.asarray(p._data, np.float64)
+              for n, p in model.named_parameters()}
+    d = cfg.hidden_size
+    dh = d // cfg.num_attention_heads
+
+    def opn(w):
+        # ∞-operator norm of x -> x @ w for [in, out] weights
+        return float(np.max(np.sum(np.abs(w), axis=0)))
+
+    layers = []
+    for l in range(cfg.num_hidden_layers):
+        p = f"gpt.layers.{l}."
+        eps1 = float(model.gpt.layers[l].norm1.epsilon)
+        eps2 = float(model.gpt.layers[l].norm2.epsilon)
+        g1 = float(np.max(np.abs(params[p + "norm1.weight"])))
+        g2 = float(np.max(np.abs(params[p + "norm2.weight"])))
+        b1 = float(np.max(np.abs(params[p + "norm1.bias"])))
+        B1 = np.sqrt(d) * g1 + b1
+        wq, _, wv = np.split(params[p + "self_attn.qkv_proj.weight"],
+                             3, axis=1)
+        bq, _, bv = np.split(params[p + "self_attn.qkv_proj.bias"], 3)
+        qmax = B1 * opn(wq) + float(np.max(np.abs(bq)))
+        vmax = B1 * opn(wv) + float(np.max(np.abs(bv)))
+        no = opn(params[p + "self_attn.out_proj.weight"])
+        # eps_kv lands twice: V rows (convex combination, factor 1) and
+        # K rows (softmax total-variation, first order 2*sqrt(dh)*qmax,
+        # weighted by the V magnitude)
+        inject = no * (1.0 + 2.0 * np.sqrt(dh) * qmax * vmax)
+        lln1 = 2.0 * g1 / np.sqrt(eps1)
+        lln2 = 2.0 * g2 / np.sqrt(eps2)
+        attn_lip = lln1 * no * (opn(wq) * 2.0 * np.sqrt(dh) * vmax
+                                + opn(wv))
+        mlp_lip = lln2 * 1.13 * opn(params[p + "mlp.fc1.weight"]) \
+            * opn(params[p + "mlp.fc2.weight"])
+        layers.append((inject, (1.0 + attn_lip) * (1.0 + mlp_lip)))
+    gf = float(np.max(np.abs(params["gpt.final_norm.weight"])))
+    llnf = 2.0 * gf / np.sqrt(float(model.gpt.final_norm.epsilon))
+    nlm = opn(params["gpt.word_embeddings.weight"].T)
+    total = 0.0
+    for l, (inject, _) in enumerate(layers):
+        down = 1.0
+        for m in range(l + 1, len(layers)):
+            down *= layers[m][1]
+        total += inject * down
+    return total * llnf * nlm
+
+
+def _quantized_kv_bench(on_tpu: bool):
+    """Quantized paged-KV evidence (docs/SERVING.md 'Quantized KV cache
+    & weight-only serving'): the same model and workload served from
+    the fp pool and from int8 pages with per-(page, head) scales.
+    (a) resident concurrency at EQUAL pool bytes, from the allocated
+        pools' actual per-page bytes (payload + scales);
+    (b) bs=1 decode throughput fp vs int8 through engine.generate;
+    (c) measured KV dequant error vs the analytic slot-0-protocol
+        bound, and measured prefill logit max-abs error vs that bound
+        amplified by the loose operator-norm factor;
+    (d) zero post-warmup decode compiles while serving int8."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_infer_tpu as pit
+    from paddle_infer_tpu.inference import (GenerationConfig,
+                                            PagedGenerationEngine)
+    from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_infer_tpu.observability.compilelog import get_compile_log
+    from paddle_infer_tpu.ops.pallas.paged_attention import (
+        dequantize_pages, kv_dequant_error_bound)
+
+    pit.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    intermediate_size=256, max_position_embeddings=256,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    plen, max_new, page = 48, 32, 16
+    prompt = rng.randint(0, cfg.vocab_size, (plen,)).astype(np.int32)
+    g = GenerationConfig(max_new_tokens=max_new)
+
+    fp_eng = PagedGenerationEngine(model, page_size=page,
+                                   prompt_bucket=64)
+    q_eng = PagedGenerationEngine(model, page_size=page, prompt_bucket=64,
+                                  kv_dtype="int8")
+
+    # ---- (b) decode throughput, compile-warmed, plus (d) compile gate
+    def toks_per_s(eng, reps=3):
+        eng.generate(prompt[None], g)                  # compile
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            eng.generate(prompt[None], g)
+            best = min(best, time.perf_counter() - t0)
+        return max_new / best
+
+    fp_tps = toks_per_s(fp_eng)
+    compiles0 = get_compile_log().summary()["post_warmup_decode_compiles"]
+    q_tps = toks_per_s(q_eng)
+    post_warmup = get_compile_log().summary()[
+        "post_warmup_decode_compiles"] - compiles0
+
+    # ---- (a) per-page pool bytes, measured from the live arrays
+    kf, vf = fp_eng._ensure_pages()
+    kq, vq = q_eng._ensure_pages()
+    n_fp = kf[0].shape[0]
+    n_q = kq[0][0].shape[0]
+    fp_page_bytes = sum(x.nbytes for x in kf + vf) / n_fp
+    q_page_bytes = sum(p.nbytes + s.nbytes for p, s in kq + vq) / n_q
+    resident_ratio = fp_page_bytes / q_page_bytes
+
+    # ---- (c) error accounting on an identical context: one windowed
+    # prefill over serving pools, same block table on both engines
+    plen_pad = 64
+    max_pages = plen_pad // page
+
+    def prefill_logits(eng):
+        L = eng._num_layers
+        pool = eng.serving_pool(max_pages + 1)
+        pool.reserve(0, plen_pad)
+        table = np.full((1, max_pages), max_pages, np.int32)
+        t = pool.block_table(0)
+        table[0, :len(t)] = np.asarray(t, np.int32)
+        ids = np.zeros((1, plen_pad), np.int32)
+        ids[0, :plen] = prompt
+
+        def build():
+            def run(params, ids, offsets, tables, k_pages, v_pages):
+                marker = jnp.zeros((1,), jnp.int32)
+                caches = [(k_pages[i], v_pages[i], tables, offsets,
+                           marker) for i in range(L)]
+                pos2d = offsets[:, None] + jnp.broadcast_to(
+                    jnp.arange(plen_pad, dtype=jnp.int32)[None],
+                    (1, plen_pad))
+                logits, caches = eng._model_step(params, ids, pos2d,
+                                                 None, caches)
+                return (logits, [c[0] for c in caches],
+                        [c[1] for c in caches])
+            return jax.jit(run, donate_argnums=(4, 5))
+
+        (lg,) = eng.run_paged_program(("qkv-bench-prefill", plen_pad),
+                                      build, ids,
+                                      np.zeros((1,), np.int32), table)
+        return np.asarray(lg)[0, :plen], table[0]
+
+    fp_logits, blocks = prefill_logits(fp_eng)
+    q_logits, _ = prefill_logits(q_eng)
+    logit_err = float(np.max(np.abs(q_logits - fp_logits)))
+
+    kv_err = 0.0
+    kv_bound = 0.0
+    for fp_pool, q_pool in zip(fp_eng._k_pages + fp_eng._v_pages,
+                               q_eng._k_pages + q_eng._v_pages):
+        ref = np.asarray(fp_pool)[blocks]
+        deq = np.asarray(dequantize_pages(q_pool))[blocks]
+        kv_err = max(kv_err, float(np.max(np.abs(deq - ref))))
+        kv_bound = max(kv_bound, kv_dequant_error_bound(
+            ref, np.asarray(q_pool[1])[blocks]))
+    logit_bound = kv_bound * _kv_logit_amplification(model, cfg)
+
+    out = {
+        "kv_dtype": "int8",
+        "fp_page_bytes": int(fp_page_bytes),
+        "int8_page_bytes": int(q_page_bytes),
+        "resident_pages_ratio_equal_bytes": round(resident_ratio, 2),
+        "decode_tok_s_fp": round(fp_tps, 1),
+        "decode_tok_s_int8": round(q_tps, 1),
+        "decode_tok_s_ratio": round(q_tps / fp_tps, 3),
+        "kv_dequant_err_max": round(kv_err, 6),
+        "kv_dequant_err_bound": round(kv_bound, 6),
+        "logit_err_max": round(logit_err, 6),
+        "logit_err_bound_first_order": float(f"{logit_bound:.3g}"),
+        "post_warmup_decode_compiles": int(post_warmup),
+    }
+    # gates: error containment and compile stability hold anywhere; the
+    # throughput gate only binds on the hardware the targets are for
+    out["kv_err_within_bound"] = bool(kv_err <= kv_bound)
+    out["logit_err_within_bound"] = bool(logit_err <= logit_bound)
+    out["resident_ratio_target_met"] = bool(resident_ratio >= 1.9)
+    if on_tpu:
+        out["decode_within_10pct"] = bool(q_tps >= 0.9 * fp_tps)
+    else:
+        out["gate_skipped"] = "cpu-fallback"
+    return out
 
 
 def _resilience_bench(on_tpu: bool):

@@ -172,20 +172,45 @@ class GenerationEngine:
         self._cache_bucket = cache_bucket
         self._prompt_bucket = prompt_bucket
         self._params = self._snapshot_params()
+        # first FLOATING param decides the cache dtype: weight-only
+        # serving checkpoints put int8 payloads in the snapshot, which
+        # must never become the KV dtype
         self._cache_dtype = cache_dtype or next(
-            iter(self._params.values())).dtype
+            (v.dtype for v in self._params.values()
+             if jnp.issubdtype(v.dtype, jnp.floating)), jnp.float32)
         self._compiled = {}
+
+    def _weight_only_buffers(self):
+        """Serving-checkpoint buffers that must ride the param snapshot:
+        weight-only layers register their (qweight, scale, bias) payloads
+        as buffers, not Parameters — left out of the snapshot they would
+        be traced as jit constants (re-uploaded per executable, invisible
+        to refresh_params, unplaceable under a mesh)."""
+        from ..quantization.moe import WeightOnlyMoELayer
+        from ..quantization.weight_only import WeightOnlyLinear
+
+        out = {}
+        for lname, layer in self._model.named_sublayers():
+            if isinstance(layer, (WeightOnlyLinear, WeightOnlyMoELayer)):
+                for bn, buf in layer.named_buffers(
+                        prefix=lname, include_sublayers=False):
+                    out[bn] = buf
+        return out
 
     def _snapshot_params(self):
         """Re-snapshot parameters (honoring set_state_dict/dtype casts
-        after construction); under a mesh, place each by its dist_attr
-        spec, caching placements so repeat calls don't re-transfer."""
+        after construction) plus weight-only serving buffers; under a
+        mesh, place each by its dist_attr spec, caching placements so
+        repeat calls don't re-transfer."""
+        bufs = self._weight_only_buffers()
+        self._buffer_names = frozenset(bufs)
+        named = list(self._model.named_parameters()) + list(bufs.items())
         if self._mesh is None:
-            return {n: p._data for n, p in self._model.named_parameters()}
+            return {n: p._data for n, p in named}
         from jax.sharding import NamedSharding
 
         out = {}
-        for n, p in self._model.named_parameters():
+        for n, p in named:
             cached = self._placed.get(n)
             if cached is not None and cached[0] is p._data:
                 out[n] = cached[1]
@@ -253,15 +278,35 @@ class GenerationEngine:
     def _model_step(self, params, ids, position_ids, pad_mask_add, caches):
         """One forward over the Layer with traced arrays; returns raw
         logits + cache arrays.  The Layer runs under no_grad so dispatch
-        skips tape recording inside the trace."""
-        tcaches = [tuple(Tensor(a) for a in c) for c in caches]
+        skips tape recording inside the trace.
+
+        Quantized paged pools ride as plain ``(payload, scales)`` tuples
+        inside the cache — wrapped/unwrapped element-wise so the pytree
+        shape is preserved.  Weight-only quantized payloads (registered
+        as buffers, not Parameters) ride inside ``params`` and are split
+        back out here so ``functional_call`` swaps them as buffers —
+        without this they would be baked into the trace as constants."""
+        def wrap(a):
+            return tuple(Tensor(x) for x in a) if isinstance(a, tuple) \
+                else Tensor(a)
+
+        def unwrap(x):
+            return tuple(t._data for t in x) if isinstance(x, tuple) \
+                else x._data
+
+        bnames = getattr(self, "_buffer_names", None)
+        bufs = None
+        if bnames:
+            bufs = {n: params[n] for n in bnames if n in params}
+            params = {n: a for n, a in params.items() if n not in bnames}
+        tcaches = [tuple(wrap(a) for a in c) for c in caches]
         mask_t = Tensor(pad_mask_add) if pad_mask_add is not None else None
         with no_grad():
             logits, new = self._model.functional_call(
                 params, Tensor(ids),
                 position_ids=Tensor(position_ids),
-                attention_mask=mask_t, caches=tcaches)
-        return logits._data, [tuple(x._data for x in c) for c in new]
+                attention_mask=mask_t, caches=tcaches, buffers=bufs)
+        return logits._data, [tuple(unwrap(x) for x in c) for c in new]
 
     def _pad_mask_add(self, prompt_mask, cache_len):
         """[b, plen] 0/1 prompt mask → additive [b, 1, 1, cache_len] over
@@ -585,7 +630,22 @@ class PagedGenerationEngine(GenerationEngine):
     def __init__(self, model, page_size: int = 16,
                  num_pages: Optional[int] = None, prompt_bucket: int = 64,
                  cache_dtype=None, mesh=None,
-                 quantized_allreduce: Optional[str] = None):
+                 quantized_allreduce: Optional[str] = None,
+                 kv_dtype: Optional[str] = None):
+        """``kv_dtype="int8"`` stores KV pages as int8 payloads with
+        per-page-per-head float32 scales (see the scale protocol in
+        ops/pallas/paged_attention.py) — half the page bytes, so ~2x
+        resident sequences per pool byte.  None keeps full-precision
+        pages."""
+        if kv_dtype not in (None, "int8"):
+            if kv_dtype == "int4":
+                raise NotImplementedError(
+                    "kv_dtype='int4' is recognized by "
+                    "validate_serving_config but the pool stores int8 "
+                    "payloads only")
+            raise ValueError(
+                f"kv_dtype must be None or 'int8', got {kv_dtype!r}")
+        self._kv_dtype = kv_dtype
         super().__init__(model, cache_bucket=page_size,
                          prompt_bucket=prompt_bucket,
                          cache_dtype=cache_dtype, mesh=mesh,
@@ -618,9 +678,22 @@ class PagedGenerationEngine(GenerationEngine):
     def _ensure_pages(self):
         pshape = (self._pool.num_blocks, self._num_heads, self.page_size,
                   self._head_dim)
-        if self._k_pages is None or self._k_pages[0].shape != pshape:
+
+        def shape_of(p):            # quantized pools are (payload, scales)
+            return p[0].shape if isinstance(p, tuple) else p.shape
+
+        if self._k_pages is None or shape_of(self._k_pages[0]) != pshape:
+            from ..ops.pallas.paged_attention import KV_SCALE_EPS
+
             def alloc():
-                z = jnp.zeros(pshape, self._cache_dtype)
+                quant = self._kv_dtype == "int8"
+                z = jnp.zeros(pshape, jnp.int8 if quant
+                              else self._cache_dtype)
+                # scales start at the eps floor (never zero): dequant of
+                # a zeroed pool is zero and the scale > 0 invariant the
+                # masked-max writer relies on holds from the first step
+                sc = jnp.full(pshape[:2], KV_SCALE_EPS, jnp.float32) \
+                    if quant else None
                 if self._mesh is not None:
                     # head-sharded pool: each mp shard owns its heads'
                     # pages; replicated over every other serving axis
@@ -634,7 +707,10 @@ class PagedGenerationEngine(GenerationEngine):
                     z = jax.device_put(
                         z, NamedSharding(self._mesh,
                                          P(None, hax, None, None)))
-                return z
+                    if sc is not None:
+                        sc = jax.device_put(
+                            sc, NamedSharding(self._mesh, P(None, hax)))
+                return (z, sc) if quant else z
 
             self._k_pages = [alloc() for _ in range(self._num_layers)]
             self._v_pages = [alloc() for _ in range(self._num_layers)]
@@ -1001,6 +1077,11 @@ class PagedGenerationEngine(GenerationEngine):
         """Pool choreography for the paged beam program: prompt rows own
         the shared pages; every beam is a KVBlockPool.fork of its row plus
         a reservation that appends its private decode pages."""
+        if self._kv_dtype is not None:
+            raise ValueError(
+                "beam search over quantized KV pools is not supported "
+                "(the fork/permute page choreography moves fp pages; "
+                "the serving plane never batches beam requests)")
         b = ids.shape[0]
         W = g.num_beams
         page = self.page_size

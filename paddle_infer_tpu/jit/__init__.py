@@ -8,6 +8,7 @@ import os
 import pickle
 
 import jax
+import jax.export  # noqa: F401  (lazy submodule; jax.export.* below needs it)
 import jax.numpy as jnp
 import numpy as np
 

@@ -196,8 +196,19 @@ class ParallelSelfAttention(Layer):
         from ..core.tensor import Tensor
         from ..ops.pallas import paged_attention as PA
 
+        # quantized pools ride as (payload, scales) Tensor pairs — unwrap
+        # and rewrap per element so the cache pytree shape round-trips
+        # through _model_step unchanged
+        def raw(c):
+            return tuple(t._data for t in c) if isinstance(c, tuple) \
+                else c._data
+
+        def wrap(a):
+            return tuple(Tensor(x) for x in a) if isinstance(a, tuple) \
+                else Tensor(a)
+
         b, s = x.shape[0], x.shape[1]
-        k_pages, v_pages, tables, positions = (c._data for c in cache[:4])
+        k_pages, v_pages, tables, positions = (raw(c) for c in cache[:4])
         if len(cache) >= 6:
             from ..ops.pallas import ragged_paged_attention as RPA
 
@@ -215,7 +226,7 @@ class ParallelSelfAttention(Layer):
                 else verify.shape[1]))
             out = D("reshape", out, shape=(b, s, self.hidden))
             out = self.out_proj(out)
-            new = (Tensor(k_pages), Tensor(v_pages), Tensor(tables),
+            new = (wrap(k_pages), wrap(v_pages), Tensor(tables),
                    Tensor(positions + qlens), cache[4], cache[5])
             return out, (new + (cache[6],) if len(cache) == 7 else new)
         windowed = len(cache) == 5
@@ -233,6 +244,14 @@ class ParallelSelfAttention(Layer):
             # at every later read
             k_pages = PA.write_prompt_pages(k_pages, tables, k._data)
             v_pages = PA.write_prompt_pages(v_pages, tables, v._data)
+            if PA.is_quantized(k_pages):
+                # quantized-domain prefill: attend over the bytes just
+                # written, not the in-flight fp K/V — every other page
+                # consumer dequantizes on read, and a near-tie argmax
+                # would otherwise diverge between generate() and the
+                # serving plane's chunked/ragged prefill
+                k = Tensor(PA.gather_prompt_pages(k_pages, tables, s))
+                v = Tensor(PA.gather_prompt_pages(v_pages, tables, s))
             out = F.scaled_dot_product_attention(
                 q, k, v, attn_mask=attn_mask, dropout_p=0.0, is_causal=True)
             new_pos = positions + s
@@ -247,7 +266,7 @@ class ParallelSelfAttention(Layer):
             new_pos = positions + 1
         out = D("reshape", out, shape=(b, s, self.hidden))
         out = self.out_proj(out)
-        return out, (Tensor(k_pages), Tensor(v_pages), Tensor(tables),
+        return out, (wrap(k_pages), wrap(v_pages), Tensor(tables),
                      Tensor(new_pos))
 
 

@@ -135,6 +135,58 @@ def render_prometheus(snapshot: dict,
         w.family("serving_kv_pool_occupancy", "gauge",
                  "used_blocks / total_blocks")
         w.sample("serving_kv_pool_occupancy", kv.get("occupancy"))
+        w.family("serving_kv_pool_headroom_pages", "gauge",
+                 "Pool pages reserved beyond worst-case live rows, in "
+                 "PAGES (prefix-cache retention room; capacity gauges "
+                 "are page-denominated so KV quantization cannot skew "
+                 "them)")
+        w.sample("serving_kv_pool_headroom_pages",
+                 kv.get("headroom_pages"))
+
+    kq = snapshot.get("kv_quant") or {}
+    if kq:
+        w.family("kv_quant_info", "gauge",
+                 "Quantized KV pool config as labels (constant 1): "
+                 "storage dtype of the paged KV payload")
+        w.sample("kv_quant_info", 1, {"kv_dtype": kq.get("kv_dtype",
+                                                         "none")})
+        w.family("kv_quant_bytes_per_page", "gauge",
+                 "HBM bytes per KV page (all layers, payload + scales) "
+                 "by pool representation")
+        w.sample("kv_quant_bytes_per_page", kq.get("bytes_per_page"),
+                 {"repr": "quantized"})
+        w.sample("kv_quant_bytes_per_page", kq.get("fp_bytes_per_page"),
+                 {"repr": "fp"})
+        w.family("kv_quant_scale_bytes_per_page", "gauge",
+                 "Per-page float32 scale overhead in bytes (all "
+                 "layers, k+v, one scale per page per head)")
+        w.sample("kv_quant_scale_bytes_per_page",
+                 kq.get("scale_bytes_per_page"))
+        w.family("kv_quant_resident_page_ratio", "gauge",
+                 "fp_bytes_per_page / bytes_per_page — how many more "
+                 "pages fit in the same pool bytes vs the fp pool")
+        w.sample("kv_quant_resident_page_ratio",
+                 kq.get("resident_page_ratio"))
+
+    wo = snapshot.get("weight_only") or {}
+    if wo:
+        w.family("weight_only_layers", "gauge",
+                 "Linear/MoE sublayers served from weight-only "
+                 "quantized payloads")
+        w.sample("weight_only_layers", wo.get("layers"))
+        w.family("weight_only_qweight_bytes", "gauge",
+                 "Resident bytes of quantized weight payloads plus "
+                 "their scales")
+        w.sample("weight_only_qweight_bytes", wo.get("qweight_bytes"))
+        w.family("weight_only_fp_equiv_bytes", "gauge",
+                 "Bytes the same weights would occupy at float32")
+        w.sample("weight_only_fp_equiv_bytes", wo.get("fp_equiv_bytes"))
+        w.family("weight_only_hbm_traffic_ratio", "gauge",
+                 "qweight_bytes / fp_equiv_bytes — per-step weight "
+                 "HBM traffic relative to the fp checkpoint (bounds "
+                 "bs=1 decode)")
+        w.sample("weight_only_hbm_traffic_ratio",
+                 wo.get("hbm_traffic_ratio"))
 
     px = snapshot.get("prefix_cache") or {}
     if px:

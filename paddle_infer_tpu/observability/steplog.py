@@ -103,10 +103,22 @@ class StepCostModel:
             itemsize = int(np.dtype(engine._cache_dtype).itemsize)
         except Exception:
             itemsize = 2
-        # one physical page across every layer's K and V pools
+        # one physical page across every layer's K and V pools.  A
+        # quantized pool prices the CONFIGURED payload width (int8 = 1
+        # byte) plus the per-page float32 scales (one per page per head,
+        # k and v) — pricing fp bytes would overstate decode-step HBM
+        # traffic ~2-4x and skew the router's load-balance signal.
+        kv_dtype = getattr(engine, "_kv_dtype", None)
+        if kv_dtype is not None:
+            payload_itemsize = int(np.dtype(kv_dtype).itemsize)
+            scale_bytes = engine._num_layers * 2 * engine._num_heads * 4
+        else:
+            payload_itemsize = itemsize
+            scale_bytes = 0
         self._page_kv_bytes = float(
             engine._num_layers * 2 * engine._num_heads
-            * engine.page_size * engine._head_dim * itemsize)
+            * engine.page_size * engine._head_dim * payload_itemsize
+            + scale_bytes)
         self._pool_bytes = self._page_kv_bytes * self._pool_pages
         self._weight_bytes: Optional[float] = None
         self._n_params: Optional[float] = None

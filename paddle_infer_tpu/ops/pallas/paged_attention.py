@@ -46,13 +46,105 @@ def _interpret() -> bool:
         return True
 
 
+# ------------------------------------------------------------ quantized KV
+# A quantized pool is a plain ``(payload, scales)`` tuple — payload int8
+# [P, H, page, D], scales float32 [P, H] (one per page per head).  The pair
+# is a pytree, so program signatures, donation argnums and cache-tuple
+# arities are unchanged; every page consumer below branches on
+# ``is_quantized``.
+#
+# Scale protocol: a page's scale is set ONLY by the write landing in slot 0
+# (the page's lowest position) — amax over that token's D, divided by 127,
+# floored at KV_SCALE_EPS.  Every later write into the page quantizes with
+# the inherited scale (clipping at ±127).  Slot 0 is the lowest position,
+# so a slot-0 rewrite can only happen when no earlier token of the page is
+# live — which makes the (scales, payload) bits a pure function of the
+# token stream, independent of how writes were chunked.  That write-order
+# invariance is what keeps warm prefix hits, speculative re-writes and
+# fleet handoffs bitwise-identical in the quantized domain.
+
+KV_SCALE_EPS = 1e-8
+_QMAX = 127.0
+
+
+def is_quantized(pages) -> bool:
+    """True when ``pages`` is an (int8 payload, float32 scales) pair."""
+    return isinstance(pages, (tuple, list)) and len(pages) == 2
+
+
+def quantize_pages(pages):
+    """fp pool [P, H, page, D] → (int8 payload, [P, H] scales) under the
+    slot-0 scale protocol (offline/test construction of quantized pools;
+    matches what the incremental writers below would have produced)."""
+    f = pages.astype(jnp.float32)
+    tok0 = jnp.abs(f[:, :, 0, :])                       # [P, H, D]
+    scales = jnp.maximum(jnp.max(tok0, axis=-1) / _QMAX, KV_SCALE_EPS)
+    payload = jnp.clip(jnp.round(f / scales[:, :, None, None]),
+                       -_QMAX, _QMAX).astype(jnp.int8)
+    return payload, scales
+
+
+def dequantize_pages(pages):
+    """(payload, scales) → float32 pool; fp pools pass through."""
+    if not is_quantized(pages):
+        return pages
+    payload, scales = pages
+    return payload.astype(jnp.float32) * scales[:, :, None, None]
+
+
+def kv_dequant_error_bound(fp_pages, scales) -> float:
+    """Worst-case elementwise |dequantize(quantize(x)) - x| over a pool,
+    from the REALIZED per-(page, head) scales the slot-0 protocol chose:
+    scale/2 covers rounding, plus the clipping excess wherever a
+    non-slot-0 token exceeds the representable range ``_QMAX * scale``.
+    Both inputs are host-side ([P, H, page, D] fp reference, [P, H]
+    scales); analytic in the same sense as
+    ``parallel.collective.quantization_error_bound`` — exact given the
+    data, no fitted constants."""
+    import numpy as np
+
+    fp = np.asarray(fp_pages, np.float32)
+    sc = np.asarray(scales, np.float32)[:, :, None, None]
+    clip = np.maximum(np.abs(fp) - _QMAX * sc, 0.0)
+    return float(np.max(sc / 2.0 + clip)) if fp.size else 0.0
+
+
+def _quantized_scatter(pages, page_idx, slot, kv):
+    """Shared int8 token scatter: slot-0 landings re-seed their page's
+    scale from the landing token, everything quantizes with the updated
+    scales and writes the payload.  ``page_idx``/``slot`` are [B] or
+    [B, S] int32 and ``kv`` carries matching leading dims + [H, D].
+
+    The scale update is a masked-max scatter, NOT ``.set``: pad rows may
+    alias a live physical page (table filler points at page 0 / the
+    scratch page), and duplicate-index ``.set`` order is unspecified.
+    Candidates are -1.0 except at genuine slot-0 landings; ``.at[].max``
+    over the -1 sentinel is order-independent, and scales are > 0 by the
+    eps floor, so surviving -1 means "keep the old scale"."""
+    payload, scales = pages
+    kvf = kv.astype(jnp.float32)
+    tok = jnp.maximum(jnp.max(jnp.abs(kvf), axis=-1) / _QMAX,
+                      KV_SCALE_EPS)                      # [..., H]
+    cand = jnp.where((slot == 0)[..., None], tok, -1.0)
+    fresh = jnp.full(scales.shape, -1.0, jnp.float32) \
+        .at[page_idx].max(cand)
+    scales = jnp.where(fresh > 0, fresh, scales)
+    sc = scales[page_idx]                                # [..., H]
+    q = jnp.clip(jnp.round(kvf / sc[..., None]), -_QMAX, _QMAX) \
+        .astype(jnp.int8)
+    return payload.at[page_idx, :, slot].set(q), scales
+
+
 # ------------------------------------------------------------------ kernel
 
 def _decode_kernel(lengths_ref, tables_ref,      # scalar prefetch (SMEM)
                    q_ref, k_ref, v_ref,          # blocks (VMEM)
-                   o_ref,                        # output block
-                   m_ref, l_ref, acc_ref,        # VMEM scratch
-                   *, scale, page_size, max_pages):
+                   *rest,                        # [ks, vs,] o + scratch
+                   scale, page_size, max_pages, quantized=False):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -73,6 +165,11 @@ def _decode_kernel(lengths_ref, tables_ref,      # scalar prefetch (SMEM)
         q = q_ref[0].astype(jnp.float32)            # [H, D]
         k = k_ref[0].astype(jnp.float32)            # [H, page, D]
         v = v_ref[0].astype(jnp.float32)            # [H, page, D]
+        if quantized:
+            # per-(page, head) dequant rides the VPU feed — the int8
+            # payload is what the DMA streamed, halving page bytes
+            k = k * ks_ref[0][:, None, None]
+            v = v * vs_ref[0][:, None, None]
         # scores over this page's slots: [H, page]
         s = jnp.sum(q[:, None, :] * k, axis=2) * scale
         # mask slots beyond the sequence length
@@ -110,6 +207,11 @@ def paged_attention_decode(q, k_pages, v_pages, block_tables, lengths,
     lengths      [B] int32      — tokens already in cache (incl. current)
     → [B, H, D]
 
+    Quantized pools: ``k_pages``/``v_pages`` may each be an
+    ``(int8 payload, [P, H] float32 scales)`` pair — the kernel DMAs the
+    int8 page plus its scale row and dequantizes per (page, head) on the
+    VPU feed, halving the page bytes decode is bound by.
+
     Mesh-sharded serving: when a hybrid mesh with mp>1 is active (the
     engines set it — parallel.topology), the kernel runs under shard_map
     with heads split over "mp" and (when divisible) batch over "dp".
@@ -132,10 +234,15 @@ def paged_attention_decode(q, k_pages, v_pages, block_tables, lengths,
             from ...parallel.topology import shard_map_norep
             inner = functools.partial(_decode_local, scale=scale,
                                       interpret=interpret)
+            # pair pools shard as a pytree: payload over heads like the
+            # fp pool, the [P, H] scale row over the same head axis
+            pspec = ((P(None, hax, None, None), P(None, hax))
+                     if is_quantized(k_pages)
+                     else P(None, hax, None, None))
             return shard_map_norep(
                 inner, mesh,
-                in_specs=(P(bax, hax, None), P(None, hax, None, None),
-                          P(None, hax, None, None), P(bax, None), P(bax)),
+                in_specs=(P(bax, hax, None), pspec, pspec,
+                          P(bax, None), P(bax)),
                 out_specs=P(bax, hax, None),
             )(q, k_pages, v_pages, block_tables, lengths)
     return _decode_local(q, k_pages, v_pages, block_tables, lengths,
@@ -152,6 +259,10 @@ def _decode_local(q, k_pages, v_pages, block_tables, lengths,
                   scale=None, interpret=None):
     """The single-shard kernel launch (see paged_attention_decode)."""
     interpret = _interpret() if interpret is None else interpret
+    quantized = is_quantized(k_pages)
+    if quantized:
+        k_pages, k_scales = k_pages
+        v_pages, v_scales = v_pages
     b, h, d = q.shape
     num_pages, kh, page_size, kd = k_pages.shape
     assert (kh, kd) == (h, d), (k_pages.shape, q.shape)
@@ -166,17 +277,26 @@ def _decode_local(q, k_pages, v_pages, block_tables, lengths,
     def kv_map(b_, j_, lengths_s, tables_s):
         return (tables_s[b_, j_], 0, 0, 0)
 
+    def sc_map(b_, j_, lengths_s, tables_s):
+        return (tables_s[b_, j_], 0)
+
     kernel = functools.partial(
         _decode_kernel, scale=scale, page_size=page_size,
-        max_pages=max_pages)
+        max_pages=max_pages, quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, h, d), q_map),
+        pl.BlockSpec((1, h, page_size, d), kv_map),
+        pl.BlockSpec((1, h, page_size, d), kv_map),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, h), sc_map),
+                     pl.BlockSpec((1, h), sc_map)]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, h, d), q_map),
-            pl.BlockSpec((1, h, page_size, d), kv_map),
-            pl.BlockSpec((1, h, page_size, d), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, h, d), q_map),
         scratch_shapes=[
             pltpu.VMEM((h, 1), jnp.float32),
@@ -191,20 +311,23 @@ def _decode_local(q, k_pages, v_pages, block_tables, lengths,
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
     )
-    return fn(lengths, block_tables, q, k_pages, v_pages)
+    return fn(lengths, block_tables, *operands)
 
 
 def _verify_kernel(lengths_ref, tables_ref,      # scalar prefetch (SMEM)
                    q_ref, k_ref, v_ref,          # blocks (VMEM)
-                   o_ref,                        # output block
-                   m_ref, l_ref, acc_ref,        # VMEM scratch
-                   *, scale, page_size, max_pages, window):
+                   *rest,                        # [ks, vs,] o + scratch
+                   scale, page_size, max_pages, window, quantized=False):
     """W-query decode: ``_decode_kernel`` with an extra leading query
     lane.  Each lane ``w`` masks by its OWN length ``lengths[b, w]``;
     the per-page online-softmax update is the decode kernel's math per
     lane, so lane ``w`` accumulates bit-for-bit what a separate
     single-query launch at ``lengths[b, w]`` would have — one page walk
     per row instead of one per (row, position)."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -228,6 +351,11 @@ def _verify_kernel(lengths_ref, tables_ref,      # scalar prefetch (SMEM)
         q = q_ref[0].astype(jnp.float32)            # [W, H, D]
         k = k_ref[0].astype(jnp.float32)            # [H, page, D]
         v = v_ref[0].astype(jnp.float32)            # [H, page, D]
+        if quantized:
+            # same per-(page, head) dequant as the decode kernel — lane
+            # (b, w) stays bitwise a single-query quantized decode
+            k = k * ks_ref[0][:, None, None]
+            v = v * vs_ref[0][:, None, None]
         # scores over this page's slots, per lane: [W, H, page]
         s = jnp.sum(q[:, :, None, :] * k[None], axis=3) * scale
         slot = j * page_size + jax.lax.broadcasted_iota(
@@ -282,12 +410,13 @@ def paged_attention_verify(q, k_pages, v_pages, block_tables, lengths,
             from ...parallel.topology import shard_map_norep
             inner = functools.partial(_verify_local, scale=scale,
                                       interpret=interpret)
+            pspec = ((P(None, hax, None, None), P(None, hax))
+                     if is_quantized(k_pages)
+                     else P(None, hax, None, None))
             return shard_map_norep(
                 inner, mesh,
-                in_specs=(P(bax, None, hax, None),
-                          P(None, hax, None, None),
-                          P(None, hax, None, None), P(bax, None),
-                          P(bax, None)),
+                in_specs=(P(bax, None, hax, None), pspec, pspec,
+                          P(bax, None), P(bax, None)),
                 out_specs=P(bax, None, hax, None),
             )(q, k_pages, v_pages, block_tables, lengths)
     return _verify_local(q, k_pages, v_pages, block_tables, lengths,
@@ -298,6 +427,10 @@ def _verify_local(q, k_pages, v_pages, block_tables, lengths,
                   scale=None, interpret=None):
     """The single-shard kernel launch (see paged_attention_verify)."""
     interpret = _interpret() if interpret is None else interpret
+    quantized = is_quantized(k_pages)
+    if quantized:
+        k_pages, k_scales = k_pages
+        v_pages, v_scales = v_pages
     b, w, h, d = q.shape
     num_pages, kh, page_size, kd = k_pages.shape
     assert (kh, kd) == (h, d), (k_pages.shape, q.shape)
@@ -313,17 +446,26 @@ def _verify_local(q, k_pages, v_pages, block_tables, lengths,
     def kv_map(b_, j_, lengths_s, tables_s):
         return (tables_s[b_, j_], 0, 0, 0)
 
+    def sc_map(b_, j_, lengths_s, tables_s):
+        return (tables_s[b_, j_], 0)
+
     kernel = functools.partial(
         _verify_kernel, scale=scale, page_size=page_size,
-        max_pages=max_pages, window=w)
+        max_pages=max_pages, window=w, quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, w, h, d), q_map),
+        pl.BlockSpec((1, h, page_size, d), kv_map),
+        pl.BlockSpec((1, h, page_size, d), kv_map),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, h), sc_map),
+                     pl.BlockSpec((1, h), sc_map)]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, w, h, d), q_map),
-            pl.BlockSpec((1, h, page_size, d), kv_map),
-            pl.BlockSpec((1, h, page_size, d), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, w, h, d), q_map),
         scratch_shapes=[
             pltpu.VMEM((w, h), jnp.float32),
@@ -338,7 +480,7 @@ def _verify_local(q, k_pages, v_pages, block_tables, lengths,
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
     )
-    return fn(lengths, block_tables, q, k_pages, v_pages)
+    return fn(lengths, block_tables, *operands)
 
 
 # --------------------------------------------------------- page utilities
@@ -351,6 +493,16 @@ def write_prompt_pages(pages, block_tables, kv):
     sequence's true length hold garbage — the decode kernel masks by
     length at read time."""
     b, s, h, d = kv.shape
+    if is_quantized(pages):
+        # route through the shared token scatter so the slot-0 scale
+        # protocol is byte-identical to the chunked/decode writers
+        # (write-order invariance is the warm/cold parity guarantee)
+        page = pages[0].shape[2]
+        assert s % page == 0, (s, page)
+        pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None],
+                               (b, s))
+        page_idx = jnp.take_along_axis(block_tables, pos // page, axis=1)
+        return _quantized_scatter(pages, page_idx, pos % page, kv)
     page = pages.shape[2]
     assert s % page == 0, (s, page)
     n = s // page
@@ -358,6 +510,30 @@ def write_prompt_pages(pages, block_tables, kv):
     idx = block_tables[:, :n].reshape(-1)
     flat = chunks.reshape(b * n, h, page, d)
     return pages.at[idx].set(flat.astype(pages.dtype))
+
+
+def gather_prompt_pages(pages, block_tables, s):
+    """Read an aligned prompt's K or V back out of the pool as
+    [B, S, H, D] — the read-your-writes companion of
+    ``write_prompt_pages``.  On a quantized pool this dequantizes the
+    page bytes, which is the whole point: monolithic prefill attention
+    must consume exactly the values every later page reader (chunked
+    prefill, ragged serving, decode) will see, or near-tie argmaxes
+    diverge between generate() and the serving plane."""
+    quantized = is_quantized(pages)
+    page = pages[0].shape[2] if quantized else pages.shape[2]
+    assert s % page == 0, (s, page)
+    n = s // page
+    idx = block_tables[:, :n]                          # [B, n]
+    if quantized:
+        payload, scales = pages
+        g = payload[idx].astype(jnp.float32) \
+            * scales[idx][:, :, :, None, None]
+    else:
+        g = pages[idx]
+    # [B, n, H, page, D] -> [B, n, page, H, D] -> [B, S, H, D]
+    return jnp.transpose(g, (0, 1, 3, 2, 4)).reshape(
+        idx.shape[0], n * page, g.shape[2], g.shape[4])
 
 
 def write_chunk_pages(pages, block_tables, kv, offsets):
@@ -369,10 +545,12 @@ def write_chunk_pages(pages, block_tables, kv, offsets):
     (page, slot).  The caller guarantees ``offsets + S`` stays inside
     the table window."""
     b, s, h, d = kv.shape
-    page = pages.shape[2]
+    page = pages[0].shape[2] if is_quantized(pages) else pages.shape[2]
     pos = offsets[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
     page_idx = jnp.take_along_axis(block_tables, pos // page, axis=1)
     slot = pos % page
+    if is_quantized(pages):
+        return _quantized_scatter(pages, page_idx, slot, kv)
     # advanced indices (page_idx, slot) around the head slice: result
     # dims [B, S, H, D] match kv
     return pages.at[page_idx, :, slot].set(kv.astype(pages.dtype))
@@ -401,14 +579,28 @@ def prefix_prefill_attention(q, k_pages, v_pages, block_tables, offsets,
     is the TPU follow-up.
     """
     b, s, h, d = q.shape
-    page = k_pages.shape[2]
     max_pages = block_tables.shape[1]
-    W = max_pages * page
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
-    kw = k_pages[block_tables].transpose(0, 1, 3, 2, 4) \
-        .reshape(b, W, h, d).astype(jnp.float32)
-    vw = v_pages[block_tables].transpose(0, 1, 3, 2, 4) \
-        .reshape(b, W, h, d).astype(jnp.float32)
+    if is_quantized(k_pages):
+        # gather int8 pages + their scale rows and dequantize in the
+        # gathered window — the values every query sees are exactly
+        # (payload * scale), the same floats the decode kernel reads
+        (kp, ks), (vp, vs) = k_pages, v_pages
+        page = kp.shape[2]
+        W = max_pages * page
+        kw = (kp[block_tables].astype(jnp.float32)
+              * ks[block_tables][:, :, :, None, None]) \
+            .transpose(0, 1, 3, 2, 4).reshape(b, W, h, d)
+        vw = (vp[block_tables].astype(jnp.float32)
+              * vs[block_tables][:, :, :, None, None]) \
+            .transpose(0, 1, 3, 2, 4).reshape(b, W, h, d)
+    else:
+        page = k_pages.shape[2]
+        W = max_pages * page
+        kw = k_pages[block_tables].transpose(0, 1, 3, 2, 4) \
+            .reshape(b, W, h, d).astype(jnp.float32)
+        vw = v_pages[block_tables].transpose(0, 1, 3, 2, 4) \
+            .reshape(b, W, h, d).astype(jnp.float32)
     pos = offsets[:, None] + jnp.arange(s, dtype=jnp.int32)[None]  # [b, s]
     mask = jnp.arange(W, dtype=jnp.int32)[None, None, :] <= pos[:, :, None]
     scores = jnp.einsum("bshd,bwhd->bhsw", q.astype(jnp.float32),
@@ -422,10 +614,13 @@ def prefix_prefill_attention(q, k_pages, v_pages, block_tables, offsets,
 def write_token_page(pages, block_tables, kv, positions):
     """Write one new token's K or V [B, H, D] at its (page, slot):
     positions [B] is the 0-based token index in each sequence."""
-    page_size = pages.shape[2]
+    page_size = pages[0].shape[2] if is_quantized(pages) else \
+        pages.shape[2]
     page_idx = jnp.take_along_axis(
         block_tables, (positions // page_size)[:, None], axis=1)[:, 0]
     slot = positions % page_size
+    if is_quantized(pages):
+        return _quantized_scatter(pages, page_idx, slot, kv)
     # advanced indices (page_idx, slot) around the head slice: result dims
     # [B, H, D] match kv
     return pages.at[page_idx, :, slot].set(kv.astype(pages.dtype))

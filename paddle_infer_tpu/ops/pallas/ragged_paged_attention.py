@@ -45,6 +45,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from .paged_attention import (NEG_INF, _CompilerParams, _interpret,
+                              _quantized_scatter, is_quantized,
                               paged_attention_decode,
                               paged_attention_verify,
                               prefix_prefill_attention)
@@ -61,7 +62,7 @@ def write_ragged_pages(pages, block_tables, kv, context_lens, query_lens,
     pages.  The caller guarantees ``context_lens + query_lens`` stays
     inside each row's reserved table window."""
     b, c, h, d = kv.shape
-    page = pages.shape[2]
+    page = pages[0].shape[2] if is_quantized(pages) else pages.shape[2]
     max_pages = block_tables.shape[1]
     i = jnp.arange(c, dtype=jnp.int32)[None]                 # [1, C]
     pos = context_lens[:, None] + i                          # [B, C]
@@ -72,6 +73,11 @@ def write_ragged_pages(pages, block_tables, kv, context_lens, query_lens,
     page_idx = jnp.where(valid, page_idx,
                          jnp.asarray(scratch_page, jnp.int32))
     slot = jnp.where(valid, safe_pos % page, i % page)
+    if is_quantized(pages):
+        # pad tokens landing at scratch slot 0 only re-seed the scratch
+        # page's scale (deterministically — masked max), which no live
+        # row ever reads
+        return _quantized_scatter(pages, page_idx, slot, kv)
     return pages.at[page_idx, :, slot].set(kv.astype(pages.dtype))
 
 
@@ -125,9 +131,12 @@ def _ragged_reference(q, k_pages, v_pages, block_tables, context_lens,
 
 def _ragged_kernel(ctx_ref, qlen_ref, tables_ref,    # scalar prefetch
                    q_ref, k_ref, v_ref,              # blocks (VMEM)
-                   o_ref,                            # output block
-                   m_ref, l_ref, acc_ref,            # VMEM scratch
-                   *, scale, page_size, max_pages):
+                   *rest,                            # [ks, vs,] o + scratch
+                   scale, page_size, max_pages, quantized=False):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -148,6 +157,9 @@ def _ragged_kernel(ctx_ref, qlen_ref, tables_ref,    # scalar prefetch
         q = q_ref[0].astype(jnp.float32)             # [C, H, D]
         k = k_ref[0].astype(jnp.float32)             # [H, page, D]
         v = v_ref[0].astype(jnp.float32)             # [H, page, D]
+        if quantized:
+            k = k * ks_ref[0][:, None, None]
+            v = v * vs_ref[0][:, None, None]
         # scores for every (query, head, slot): [C, H, page]
         s = jnp.sum(q[:, :, None, :] * k[None], axis=3) * scale
         # absolute-position causal mask: slot w visible to query i when
@@ -178,6 +190,10 @@ def _ragged_kernel(ctx_ref, qlen_ref, tables_ref,    # scalar prefetch
 def _ragged_kernel_call(q, k_pages, v_pages, block_tables, context_lens,
                         query_lens, scale=None, interpret=None):
     interpret = _interpret() if interpret is None else interpret
+    quantized = is_quantized(k_pages)
+    if quantized:
+        k_pages, k_scales = k_pages
+        v_pages, v_scales = v_pages
     b, c, h, d = q.shape
     num_pages, kh, page_size, kd = k_pages.shape
     assert (kh, kd) == (h, d), (k_pages.shape, q.shape)
@@ -193,17 +209,26 @@ def _ragged_kernel_call(q, k_pages, v_pages, block_tables, context_lens,
     def kv_map(b_, j_, ctx_s, qlen_s, tables_s):
         return (tables_s[b_, j_], 0, 0, 0)
 
+    def sc_map(b_, j_, ctx_s, qlen_s, tables_s):
+        return (tables_s[b_, j_], 0)
+
     kernel = functools.partial(
         _ragged_kernel, scale=scale, page_size=page_size,
-        max_pages=max_pages)
+        max_pages=max_pages, quantized=quantized)
+    in_specs = [
+        pl.BlockSpec((1, c, h, d), q_map),
+        pl.BlockSpec((1, h, page_size, d), kv_map),
+        pl.BlockSpec((1, h, page_size, d), kv_map),
+    ]
+    operands = [q, k_pages, v_pages]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, h), sc_map),
+                     pl.BlockSpec((1, h), sc_map)]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(b, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, c, h, d), q_map),
-            pl.BlockSpec((1, h, page_size, d), kv_map),
-            pl.BlockSpec((1, h, page_size, d), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, c, h, d), q_map),
         scratch_shapes=[
             pltpu.VMEM((c, h), jnp.float32),
@@ -218,7 +243,7 @@ def _ragged_kernel_call(q, k_pages, v_pages, block_tables, context_lens,
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
     )
-    return fn(context_lens, query_lens, block_tables, q, k_pages, v_pages)
+    return fn(context_lens, query_lens, block_tables, *operands)
 
 
 def ragged_paged_attention(q, k_pages, v_pages, block_tables,

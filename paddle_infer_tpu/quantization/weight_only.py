@@ -177,6 +177,44 @@ class WeightOnlyLinear(Layer):
                 f"algo={self.algo}, group={self.group_size}")
 
 
+def weight_only_summary(model):
+    """The ``weight_only`` section of the serving metrics snapshot:
+    swapped-layer count, algo mix, quantized payload bytes and the fp32
+    bytes the same weights would have cost.  ``None`` (section omitted)
+    when the model has no weight-only layers."""
+    from .moe import WeightOnlyMoELayer
+
+    layers = 0
+    qweight_bytes = 0
+    fp_equiv_bytes = 0
+    algos = set()
+    for _, sub in model.named_sublayers():
+        if isinstance(sub, WeightOnlyLinear):
+            layers += 1
+            algos.add(sub.algo)
+            qweight_bytes += (sub.qweight._data.nbytes
+                              + sub.scale._data.nbytes)
+            fp_equiv_bytes += sub.in_features * sub.out_features * 4
+        elif isinstance(sub, WeightOnlyMoELayer):
+            layers += 1
+            algos.add(sub.algo)
+            per = 2 if sub.algo.endswith("int4") else 1
+            for name in ("qw1", "qw2", "s1", "s2"):
+                buf = getattr(sub, name)
+                qweight_bytes += buf._data.nbytes
+                if name.startswith("q"):
+                    # stacked expert payloads: fp32 equivalent is one
+                    # float per quantized nibble/byte
+                    fp_equiv_bytes += buf._data.size * per * 4
+    if not layers:
+        return None
+    return {"layers": int(layers), "algos": sorted(algos),
+            "qweight_bytes": int(qweight_bytes),
+            "fp_equiv_bytes": int(fp_equiv_bytes),
+            "hbm_traffic_ratio": (qweight_bytes / fp_equiv_bytes
+                                  if fp_equiv_bytes else 0.0)}
+
+
 def quantize_model(model, algo="weight_only_int8", group_size=-1,
                    skip=None):
     """In-place weight-only quantization pass: swap every linear-like

@@ -50,7 +50,8 @@ from .engine_core import EngineCore
 from .resilience import (EngineSupervisor, FaultPlane, FaultSpec,
                          HealthMonitor, HealthState)
 from .sharded import (ServingMesh, ShardedConfigError,
-                      build_sharded_engine, validate_serving_config)
+                      build_sharded_engine, validate_kv_quant_combo,
+                      validate_serving_config)
 from .fleet import (ElasticRolePolicy, FleetRouter, ReplicaHandle,
                     ReplicaRole, parse_fleet_roles)
 
@@ -64,6 +65,7 @@ __all__ = [
     "ServingMesh",
     "ShardedConfigError",
     "build_sharded_engine",
+    "validate_kv_quant_combo",
     "validate_serving_config",
     "EngineCore",
     "Request",

@@ -99,20 +99,38 @@ class EngineCore:
                  speculate: bool = False,
                  num_draft_tokens: int = 4,
                  draft_source="auto",
+                 kv_dtype: Optional[str] = None,
+                 spec_accept_threshold: Optional[float] = None,
                  serving_mesh=None):
         # sharded serving plane (serving/sharded/): when a ServingMesh is
         # handed in, re-validate it against THIS core's feature flags so
         # incompatible combos (quantized wire + speculation/prefix cache)
         # die here with an actionable message, never mid-step; also catch
         # an engine whose mesh/quantization disagrees with the config
-        from .sharded import ShardedConfigError, validate_serving_config
+        from .sharded import (ShardedConfigError, validate_kv_quant_combo,
+                              validate_serving_config)
+
+        # KV-pool quantization rides in on the ENGINE (it owns the
+        # pools); the kwarg here is a config affordance that must agree
+        # with what the engine was built with
+        engine_kv = getattr(engine, "_kv_dtype", None)
+        if kv_dtype is not None and kv_dtype != engine_kv:
+            raise ShardedConfigError(
+                f"EngineCore kv_dtype={kv_dtype!r} disagrees with the "
+                f"engine's kv_dtype={engine_kv!r} — pass kv_dtype to "
+                "PagedGenerationEngine (it owns the pools) or drop it "
+                "here")
+        self._kv_dtype = engine_kv
+        self._spec_accept_threshold = spec_accept_threshold
 
         engine_quant = getattr(engine, "_quant_allreduce", None)
         if serving_mesh is not None:
             validate_serving_config(
                 serving_mesh, speculate=speculate,
                 enable_prefix_cache=enable_prefix_cache,
-                max_batch=int(max_batch), num_heads=engine._num_heads)
+                max_batch=int(max_batch), num_heads=engine._num_heads,
+                kv_dtype=engine_kv,
+                spec_accept_threshold=spec_accept_threshold)
             if serving_mesh.n_devices > 1 and engine._mesh is None:
                 raise ShardedConfigError(
                     f"{serving_mesh.describe()} given but the engine has "
@@ -128,6 +146,12 @@ class EngineCore:
                 f"{engine_quant!r}, which is incompatible with "
                 "speculate/prefix-cache (exact-logit invariants); see "
                 "serving.sharded.validate_serving_config")
+        else:
+            # single-device path: the kv-quant matrix still applies
+            validate_kv_quant_combo(
+                engine_kv, speculate=speculate,
+                enable_prefix_cache=enable_prefix_cache,
+                spec_accept_threshold=spec_accept_threshold)
         self._serving_mesh = serving_mesh
         self._engine = engine
         self._max_batch = int(max_batch)
@@ -193,6 +217,7 @@ class EngineCore:
         # prefix hits AND the tree-backed speculative draft source.
         headroom = max(0, int(prefix_cache_headroom_pages)) \
             if enable_prefix_cache else 0
+        self._headroom_pages = headroom
         self._pool = engine.serving_pool(
             self._max_batch * self._max_pages + 1 + headroom)
         # scratch page: inactive rows' writes land here, reads of live
@@ -339,6 +364,28 @@ class EngineCore:
             self._trace_queue_drop(r, RequestState.REJECTED, "load-shed")
         return len(shed)
 
+    def _kv_quant_info(self) -> Optional[dict]:
+        """The ``kv_quant`` section of the metrics snapshot: per-page
+        byte accounting for the quantized pool vs the fp pool the same
+        config would have allocated.  None (section omitted) on fp
+        pools."""
+        if self._kv_dtype is None:
+            return None
+        eng = self._engine
+        H, D, page, L = (eng._num_heads, eng._head_dim, self._page,
+                        eng._num_layers)
+        fp_item = np.dtype(eng._cache_dtype).itemsize
+        # k+v per layer: int8 payload plus one f32 scale per (page, head)
+        payload = 2 * H * page * D
+        scale = 2 * H * 4
+        q_page = L * (payload + scale)
+        fp_page = L * 2 * H * page * D * fp_item
+        return {"kv_dtype": self._kv_dtype,
+                "bytes_per_page": int(q_page),
+                "fp_bytes_per_page": int(fp_page),
+                "scale_bytes_per_page": int(L * scale),
+                "resident_page_ratio": fp_page / q_page}
+
     def metrics_snapshot(self) -> dict:
         total = self._pool.num_blocks
         free = self._pool.free_blocks
@@ -356,18 +403,25 @@ class EngineCore:
         # allocator exposes no counters (CPU)
         from ..profiler.statistic import memory_stats
 
+        from ..quantization.weight_only import weight_only_summary
         from .sharded import sharding_snapshot
 
         return self._metrics.snapshot(
             queue_depth=len(self._queue),
             active=self.active_count,
             max_batch=self._max_batch,
+            # capacity is reported in PAGES (the pool's native unit) —
+            # bytes-derived counts would silently halve under kv_dtype
+            # int8 and lie about admission headroom
             kv_pool={"total_blocks": int(total),
                      "free_blocks": int(free),
                      "used_blocks": int(total - free),
+                     "headroom_pages": int(self._headroom_pages),
                      "occupancy": (total - free) / total if total else 0.0},
             prefix_cache=(self._prefix_cache.stats_snapshot()
                           if self._prefix_cache is not None else None),
+            kv_quant=self._kv_quant_info(),
+            weight_only=weight_only_summary(self._engine._model),
             resilience=resilience,
             steplog=self.steplog.summary(),
             device_memory=memory_stats(),
@@ -1662,13 +1716,23 @@ class EngineCore:
             blocks = np.asarray(
                 self._pool.block_table(sid)[:n_pages], np.int32)
             k_pages, v_pages = self._engine._ensure_pages()
+
             # the intended bulk sync of a handoff: one gather per layer
             # pulls the row's pages off the device (a real deployment
-            # DMAs pool-to-pool over ICI; the host hop keeps this exact)
-            # tpulint: disable-next-line=host-sync
-            k_host = [np.asarray(kp[blocks]) for kp in k_pages]
-            # tpulint: disable-next-line=host-sync
-            v_host = [np.asarray(vp[blocks]) for vp in v_pages]
+            # DMAs pool-to-pool over ICI; the host hop keeps this exact).
+            # Quantized pools gather (payload rows, scale rows) pairs so
+            # the importer reconstructs the pages bitwise.
+            def gather(pages):
+                if isinstance(pages, tuple):
+                    payload, scales = pages
+                    # tpulint: disable-next-line=host-sync
+                    return (np.asarray(payload[blocks]),
+                            np.asarray(scales[blocks]))
+                # tpulint: disable-next-line=host-sync
+                return np.asarray(pages[blocks])
+
+            k_host = [gather(kp) for kp in k_pages]
+            v_host = [gather(vp) for vp in v_pages]
             packet = {
                 "req": req, "g": s["g"], "full": s["full"],
                 "pending": s["pending"], "ctx": int(s["ctx"]),
@@ -1726,10 +1790,19 @@ class EngineCore:
                     f"target {self._page}")
             eng = self._engine
             k_pages, v_pages = eng._ensure_pages()
+
+            def geom(entry):
+                """Page geometry net of the pool axis; (payload, scale)
+                geometries for quantized entries so a quantized<->fp
+                replica pair can never silently exchange pages."""
+                if isinstance(entry, tuple):
+                    return (entry[0].shape[1:], entry[1].shape[1:])
+                return entry.shape[1:]
+
             if (len(packet["k_host"]) != len(k_pages)
                     or (packet["k_host"]
-                        and packet["k_host"][0].shape[1:]
-                        != k_pages[0].shape[1:])):
+                        and geom(packet["k_host"][0])
+                        != geom(k_pages[0]))):
                 raise HandoffError("KV pool geometry mismatch between "
                                    "replicas")
             kv_len = int(packet["kv_len"])
@@ -1765,12 +1838,22 @@ class EngineCore:
             table[:len(t)] = np.asarray(t, np.int32)
             if n_pages:
                 dst = table[:n_pages]
+
                 # one scatter per layer lands the imported pages in this
                 # pool; .at[].set is out-of-place, so the rebound arrays
-                # replace the engine's pools atomically
-                eng._k_pages = [kp.at[dst].set(h) for kp, h
+                # replace the engine's pools atomically.  Quantized
+                # entries scatter payload and scale rows together.
+                def scatter(pages, h):
+                    if isinstance(pages, tuple):
+                        payload, scales = pages
+                        hp, hs = h
+                        return (payload.at[dst].set(hp),
+                                scales.at[dst].set(hs))
+                    return pages.at[dst].set(h)
+
+                eng._k_pages = [scatter(kp, h) for kp, h
                                 in zip(k_pages, packet["k_host"])]
-                eng._v_pages = [vp.at[dst].set(h) for vp, h
+                eng._v_pages = [scatter(vp, h) for vp, h
                                 in zip(v_pages, packet["v_host"])]
             # tpulint: disable-next-line=host-sync
             key = np.asarray(

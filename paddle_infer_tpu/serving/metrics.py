@@ -229,6 +229,8 @@ class ServingMetrics:
                  max_batch: int = 0,
                  kv_pool: Optional[Dict] = None,
                  prefix_cache: Optional[Dict] = None,
+                 kv_quant: Optional[Dict] = None,
+                 weight_only: Optional[Dict] = None,
                  resilience: Optional[Dict] = None,
                  steplog: Optional[Dict] = None,
                  device_memory: Optional[Dict] = None,
@@ -240,7 +242,10 @@ class ServingMetrics:
         cumulative-bucket twins.  ``kv_pool`` is the block-pool
         occupancy gauge set supplied by ``EngineCore`` (total/used/free
         blocks); ``prefix_cache`` is ``PrefixCache.stats_snapshot()``
-        when the core runs with prefix caching enabled; ``resilience``
+        when the core runs with prefix caching enabled; ``kv_quant`` is
+        the core's quantized-KV-pool byte accounting and
+        ``weight_only`` the model's weight-only payload summary, each
+        present only when the feature is active; ``resilience``
         is the core's health/fault context (effective batch, health
         state, injected-fault tallies), merged here with this
         registry's own resilience counters; ``steplog`` is
@@ -308,6 +313,10 @@ class ServingMetrics:
                 out["kv_pool"] = dict(kv_pool)
             if prefix_cache is not None:
                 out["prefix_cache"] = dict(prefix_cache)
+            if kv_quant is not None:
+                out["kv_quant"] = dict(kv_quant)
+            if weight_only is not None:
+                out["weight_only"] = dict(weight_only)
             res = dict(resilience) if resilience is not None else {
                 "health_state": "healthy", "health_code": 0,
                 "effective_max_batch": max_batch,

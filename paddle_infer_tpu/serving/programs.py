@@ -373,10 +373,18 @@ def build_page_copy(engine):
     copy-on-write step for a shared partial tail block):
     ``run(params, src[1], dst[1], k_pages, v_pages)`` →
     ``(src, k_pages, v_pages)``; pools are donated.  One executable per
-    pool shape, reused for every CoW."""
+    pool shape, reused for every CoW.  Quantized pools copy the page's
+    scale row along with its payload — the copy stays bitwise."""
+    def copy(pages, src, dst):
+        if isinstance(pages, tuple):
+            payload, scales = pages
+            return (payload.at[dst].set(payload[src]),
+                    scales.at[dst].set(scales[src]))
+        return pages.at[dst].set(pages[src])
+
     def run(params, src, dst, k_pages, v_pages):
-        k_pages = [kp.at[dst[0]].set(kp[src[0]]) for kp in k_pages]
-        v_pages = [vp.at[dst[0]].set(vp[src[0]]) for vp in v_pages]
+        k_pages = [copy(kp, src[0], dst[0]) for kp in k_pages]
+        v_pages = [copy(vp, src[0], dst[0]) for vp in v_pages]
         return (src, k_pages, v_pages)
 
     return jax.jit(run, donate_argnums=(3, 4))

@@ -59,13 +59,58 @@ class ServingMesh:
                                   devices=devices)
 
 
+def validate_kv_quant_combo(kv_dtype: Optional[str], *,
+                            speculate: bool = False,
+                            enable_prefix_cache: bool = False,
+                            spec_accept_threshold: Optional[float] = None):
+    """The KV-cache-quantization feature matrix, one rule per row.
+
+    * ``kv_dtype=None`` — fp pool, everything allowed (trivially).
+    * ``"int8"`` + prefix cache — ALLOWED: pages quantize at write time
+      under the slot-0 scale protocol, so a warm (suffix-only) prefill
+      reads exactly the bytes a cold prefill wrote and the warm/cold
+      stream identity holds *within the quantized domain*.
+    * ``"int8"`` + speculation — ALLOWED: the verify lane's target
+      logits are computed in the same quantized domain the decode lane
+      would have used, so greedy acceptance stays self-consistent and
+      the emitted stream equals quantized-domain target-only decoding.
+    * ``"int4"`` + speculation — REJECTED unless an explicit
+      ``spec_accept_threshold`` is set: 4-bit dequant error is large
+      enough to flip near-tie argmax comparisons in the verify lane,
+      so the operator must opt in with a margin below which drafts are
+      rejected outright.
+    """
+    if kv_dtype not in (None, "int8", "int4"):
+        raise ShardedConfigError(
+            f"unsupported kv_dtype={kv_dtype!r}; expected 'int8' or "
+            "'int4' (or None for the fp KV pool)")
+    if spec_accept_threshold is not None:
+        t = float(spec_accept_threshold)
+        if not 0.0 < t < 1.0:
+            raise ShardedConfigError(
+                f"spec_accept_threshold={spec_accept_threshold!r} out "
+                "of range: expected a margin in (0, 1)")
+    if kv_dtype == "int4" and speculate and spec_accept_threshold is None:
+        raise ShardedConfigError(
+            "kv_dtype='int4' is incompatible with speculative decoding "
+            "unless spec_accept_threshold is set: 4-bit KV dequant "
+            "error can flip near-tie verify-lane acceptance "
+            "comparisons — set an explicit acceptance margin (e.g. "
+            "spec_accept_threshold=0.1) or serve with kv_dtype='int8'")
+
+
 def validate_serving_config(cfg: ServingMesh, *, speculate: bool = False,
                             enable_prefix_cache: bool = False,
                             max_batch: Optional[int] = None,
                             num_heads: Optional[int] = None,
-                            available_devices: Optional[int] = None):
+                            available_devices: Optional[int] = None,
+                            kv_dtype: Optional[str] = None,
+                            spec_accept_threshold: Optional[float] = None):
     """Raise :class:`ShardedConfigError` for combos that would serve
     incorrectly or crash mid-step; silent on valid configs."""
+    validate_kv_quant_combo(kv_dtype, speculate=speculate,
+                            enable_prefix_cache=enable_prefix_cache,
+                            spec_accept_threshold=spec_accept_threshold)
     if cfg.mp < 1 or cfg.dp_replicas < 1:
         raise ShardedConfigError(
             f"mesh degrees must be >= 1, got mp={cfg.mp} "
@@ -116,6 +161,7 @@ def validate_serving_config(cfg: ServingMesh, *, speculate: bool = False,
 def build_sharded_engine(model, cfg: ServingMesh, *, page_size: int = 16,
                          num_pages: Optional[int] = None,
                          prompt_bucket: int = 64, cache_dtype=None,
+                         kv_dtype: Optional[str] = None,
                          devices: Optional[Sequence] = None):
     """A ``PagedGenerationEngine`` serving over ``cfg``'s mesh.
 
@@ -130,11 +176,12 @@ def build_sharded_engine(model, cfg: ServingMesh, *, page_size: int = 16,
     avail = len(list(devices) if devices is not None else jax.devices())
     validate_serving_config(
         cfg, num_heads=model.config.num_attention_heads,
-        available_devices=avail)
+        available_devices=avail, kv_dtype=kv_dtype)
     mesh = cfg.build(devices) if cfg.n_devices > 1 else None
     return PagedGenerationEngine(
         model, page_size=page_size, num_pages=num_pages,
         prompt_bucket=prompt_bucket, cache_dtype=cache_dtype, mesh=mesh,
+        kv_dtype=kv_dtype,
         quantized_allreduce=cfg.quantized_allreduce)
 
 

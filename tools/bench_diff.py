@@ -26,8 +26,13 @@ from typing import Dict, Tuple
 # first match in this order wins, so throughput-ish names beat the
 # generic "_s" suffix ("tokens_per_sec" is not a latency)
 _HIGHER = ("per_s", "per_sec", "speedup", "mfu", "acceptance",
-           "hit_rate", "tps", "throughput", "tokens_per", "pearson",
-           "improvement", "spec_decode", "bytes_saved")
+           "hit_rate", "tps", "tok_s", "throughput", "tokens_per",
+           "pearson", "improvement", "spec_decode", "bytes_saved",
+           "resident_pages_ratio")
+# quality direction: the quantized_kv section's *_err_* keys fall under
+# the "err" rule below, so a round where int8 serving drifts further
+# from the fp logits (or past its analytic bound) fails the diff the
+# same way a latency regression would
 _LOWER = ("_ms", "latency", "ttft", "itl", "err", "wall", "p50",
           "p99", "wasted", "ici_bytes", "compile", "_s")
 # harness bookkeeping, not workload performance

@@ -101,7 +101,18 @@ def fabricated_exposition():
                                   "faults_injected": {"decode.step": 3,
                                                       "kv.alloc": 1}},
                       kv_pool={"total_blocks": 32, "used_blocks": 8,
-                               "free_blocks": 24, "occupancy": 0.25},
+                               "free_blocks": 24, "occupancy": 0.25,
+                               "headroom_pages": 6},
+                      kv_quant={"kv_dtype": "int8",
+                                "bytes_per_page": 8256,
+                                "fp_bytes_per_page": 32768,
+                                "scale_bytes_per_page": 64,
+                                "resident_page_ratio": 3.97},
+                      weight_only={"layers": 8,
+                                   "algos": ["weight_only_int8"],
+                                   "qweight_bytes": 5.4e6,
+                                   "fp_equiv_bytes": 2.1e7,
+                                   "hbm_traffic_ratio": 0.257},
                       prefix_cache={"queries": 6, "hits": 4,
                                     "hit_rate": 4 / 6, "peeks": 12,
                                     "cached_tokens": 96,

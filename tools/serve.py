@@ -99,7 +99,8 @@ def _build_fleet(roles):
     handles, sups = [], []
     for i, role in enumerate(roles):
         engine = PagedGenerationEngine(
-            _STATE["model"], page_size=_STATE["page_size"])
+            _STATE["model"], page_size=_STATE["page_size"],
+            kv_dtype=_STATE.get("kv_dtype"))
         core = EngineCore(
             engine,
             max_batch=_STATE["max_batch"],
@@ -154,7 +155,8 @@ def _core():
                 return _STATE["core"]
             smesh = _STATE.get("serving_mesh") or ServingMesh()
             engine = build_sharded_engine(
-                _STATE["model"], smesh, page_size=_STATE["page_size"])
+                _STATE["model"], smesh, page_size=_STATE["page_size"],
+                kv_dtype=_STATE.get("kv_dtype"))
             plane = None
             script = _STATE.get("fault_script")
             if script:
@@ -179,6 +181,7 @@ def _core():
                 speculate=_STATE.get("speculate", False),
                 num_draft_tokens=_STATE.get("num_draft_tokens", 4),
                 draft_source=_STATE.get("draft_source", "auto"),
+                spec_accept_threshold=_STATE.get("spec_accept_threshold"),
                 fault_plane=plane,
                 serving_mesh=(smesh if smesh.n_devices > 1
                               or smesh.quantized_allreduce else None))
@@ -662,6 +665,26 @@ def main(argv=None):
                          "all-reduces (~4x fewer interconnect bytes, "
                          "approximate logits); incompatible with "
                          "--speculate and --enable_prefix_cache")
+    ap.add_argument("--kv_dtype", default=None, choices=["int8", "int4"],
+                    help="paged-KV pool storage dtype: pages hold "
+                         "quantized payloads with per-page-per-head "
+                         "float32 scales, dequantized on read by every "
+                         "page consumer (docs/SERVING.md 'Quantized KV "
+                         "cache'); int8 roughly doubles resident "
+                         "concurrency at equal pool bytes, int4 is "
+                         "config-validated but not yet served")
+    ap.add_argument("--weight_only", default=None,
+                    choices=["int8", "int4"],
+                    help="serve the checkpoint through weight-only "
+                         "quantization: linear/MoE weights stored "
+                         "int8/int4 and dequantized inline into the "
+                         "matmul, halving (quartering) weight HBM "
+                         "traffic for bs=1 decode")
+    ap.add_argument("--spec_accept_threshold", type=float, default=None,
+                    help="explicit speculative-acceptance margin in "
+                         "(0, 1); required to combine kv_dtype=int4 "
+                         "with --speculate (4-bit KV dequant error can "
+                         "flip near-tie verify comparisons)")
     ap.add_argument("--fleet_roles", default=None,
                     help="disaggregated fleet: comma-separated replica "
                          "roles, e.g. 'prefill,decode,mixed' — one "
@@ -718,14 +741,29 @@ def main(argv=None):
             serving_mesh, speculate=args.speculate,
             enable_prefix_cache=args.enable_prefix_cache,
             max_batch=args.max_batch,
-            available_devices=len(jax.devices()))
+            available_devices=len(jax.devices()),
+            kv_dtype=args.kv_dtype,
+            spec_accept_threshold=args.spec_accept_threshold)
     except ShardedConfigError as e:
         print(f"error: invalid sharded-serving config: {e}",
               file=sys.stderr, flush=True)
         return 2
     _STATE["serving_mesh"] = serving_mesh
+    if args.kv_dtype == "int4":
+        print("error: kv_dtype=int4 validates at config level but the "
+              "engine does not serve int4 pools yet — use kv_dtype=int8",
+              file=sys.stderr, flush=True)
+        return 2
+    _STATE["kv_dtype"] = args.kv_dtype
+    _STATE["spec_accept_threshold"] = args.spec_accept_threshold
 
     _STATE["model"] = AutoModel.from_pretrained(args.model_dir)
+    if args.weight_only:
+        from paddle_infer_tpu.quantization.weight_only import \
+            quantize_model
+
+        quantize_model(_STATE["model"],
+                       algo=f"weight_only_{args.weight_only}")
     _STATE["page_size"] = args.page_size
     _STATE["max_batch"] = args.max_batch
     _STATE["max_queue"] = args.max_queue
