@@ -5,8 +5,12 @@ The framework (``core``) knows nothing about TPUs; the rules
 (``rules/``) encode this codebase's real failure modes — host syncs in
 the decode hot path, recompile-storm cache keys, lock-undisciplined
 attributes, trace-time state capture, missing KV-buffer donation,
-metric-catalog drift, and Pallas grid-rank mismatches.  The CLI lives
-in ``tools/tpulint.py``; the rule catalog is documented in
+metric-catalog drift, Pallas grid-rank mismatches, and cross-file
+lock-order cycles / blocking-under-lock (the whole-program tier in
+``interproc``).  ``lockcheck`` is the dynamic counterpart: an opt-in
+runtime checker that observes real lock acquisition order under test
+and cross-checks it against the static graph.  The CLI lives in
+``tools/tpulint.py``; the rule catalog is documented in
 ``docs/ANALYSIS.md``.
 
 The package is import-light on purpose (stdlib only, no jax/numpy) so
@@ -17,8 +21,12 @@ from __future__ import annotations
 
 from .core import (Analyzer, FileContext, Finding, ProjectContext,
                    Rule, apply_baseline, load_baseline, write_baseline)
+from .interproc import LockGraph, ProjectIndex, build_lock_graph
+from .lockcheck import LockChecker, instrument_locks
 from .rules import RULE_CLASSES, all_rules
 
-__all__ = ["Analyzer", "FileContext", "Finding", "ProjectContext",
-           "Rule", "RULE_CLASSES", "all_rules", "apply_baseline",
-           "load_baseline", "write_baseline"]
+__all__ = ["Analyzer", "FileContext", "Finding", "LockChecker",
+           "LockGraph", "ProjectContext", "ProjectIndex", "Rule",
+           "RULE_CLASSES", "all_rules", "apply_baseline",
+           "build_lock_graph", "instrument_locks", "load_baseline",
+           "write_baseline"]

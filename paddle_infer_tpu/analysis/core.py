@@ -10,9 +10,13 @@ Structure:
     checks (e.g. code ↔ docs metric sync);
   * ``Analyzer`` walks the target paths, parses each file once, runs
     every rule, and applies per-line suppression comments
-    (``# tpulint: disable=<rule>[,<rule>...]`` on the offending line,
-    ``# tpulint: disable-next-line=<rule>`` on the line above, or
-    ``# tpulint: skip-file`` anywhere in the file);
+    (``# tpulint: disable=<rule>[,<rule>...] -- <why>`` on the
+    offending line, ``# tpulint: disable-next-line=<rule> -- <why>``
+    on the line above, or ``# tpulint: skip-file`` anywhere in the
+    file).  The ``-- <why>`` reason is required: a suppression without
+    one still suppresses, but the analyzer reports it as a
+    ``bare-suppression`` finding so undocumented opt-outs can't
+    accumulate;
   * baselines (``load_baseline`` / ``apply_baseline`` /
     ``write_baseline``) let a repo adopt a new rule without fixing
     every legacy finding at once.  Fingerprints deliberately exclude
@@ -31,7 +35,8 @@ from typing import Dict, Iterable, List, Optional, Tuple
 
 _SUPPRESS_RE = re.compile(
     r"#\s*tpulint:\s*(disable|disable-next-line)\s*=\s*"
-    r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)")
+    r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+    r"(?:\s*--\s*(\S.*))?")
 _SKIP_FILE_RE = re.compile(r"#\s*tpulint:\s*skip-file\b")
 
 
@@ -83,6 +88,10 @@ class FileContext:
         self.lines = source.splitlines()
         self.skip_file = bool(_SKIP_FILE_RE.search(source))
         self._suppress: Dict[int, set] = {}
+        # (comment_line, rules) for suppressions missing the required
+        # ``-- <why>`` reason: the Analyzer turns these into
+        # ``bare-suppression`` findings.
+        self.bare_suppressions: List[Tuple[int, str]] = []
         for i, line in enumerate(self.lines, 1):
             m = _SUPPRESS_RE.search(line)
             if m is None:
@@ -90,6 +99,9 @@ class FileContext:
             rules = {r.strip() for r in m.group(2).split(",")}
             target = i + 1 if m.group(1) == "disable-next-line" else i
             self._suppress.setdefault(target, set()).update(rules)
+            if not m.group(3):
+                self.bare_suppressions.append(
+                    (i, ",".join(sorted(rules))))
         self._parents: Dict[int, ast.AST] = {}
         for node in ast.walk(tree):
             for child in ast.iter_child_nodes(node):
@@ -203,6 +215,13 @@ class Analyzer:
                 for f in rule.check_file(ctx):
                     if not ctx.suppressed(f.line, f.rule):
                         findings.append(f)
+            for line, rules_txt in ctx.bare_suppressions:
+                f = Finding(
+                    "bare-suppression", relpath, line, 1,
+                    f"suppression of [{rules_txt}] has no reason; "
+                    f"use '# tpulint: disable=<rule> -- <why>'")
+                if not ctx.suppressed(f.line, f.rule):
+                    findings.append(f)
         ctx_by_rel = {c.relpath: c for c in project.files}
         for rule in self.rules:
             for f in rule.finalize(project):

@@ -1,0 +1,1331 @@
+"""Whole-program layer for tpulint: project index, attribute-resolved
+call graph, per-function lock summaries, and the lock-order walk.
+
+Everything here stays stdlib-``ast`` only (same contract as ``core``):
+the analyzed modules are never imported.  The layer has three stages:
+
+  1. **ProjectIndex** — one pass over every ``FileContext``: classes,
+     methods, properties, attribute types (``self.x = Cls(...)``,
+     ``self.x: Cls = ...``, annotated ctor params, ``a or Cls()``),
+     lock attributes (``self._lock = threading.Lock()`` and module
+     globals), and callback bindings (``obj.attr = lambda: self.m()``).
+  2. **Function scan** — each function body becomes a tree of events:
+     lock acquisitions (``with lock:`` scopes, bounded
+     ``lock.acquire(timeout=...)`` + ``try/finally release`` scopes),
+     resolved call sites (methods via receiver-type inference,
+     properties, module functions, callback bindings) and blocking
+     operations (device dispatch, ``block_until_ready``, ``join``,
+     ``queue.get``, ``wait``, ``sleep``, raw ``acquire``).
+  3. **Lock walk** — a depth-bounded interprocedural replay of those
+     events that tracks the set of locks held (with *receiver-chain
+     instance identity*, so ``src.core._step_lock`` and
+     ``dst.core._step_lock`` are different instances of the same lock
+     class while a reentrant ``with self._step_lock`` is not an edge),
+     producing the static lock-order graph, potential-deadlock cycles,
+     non-reentrant re-acquisitions, and blocking-under-lock findings,
+     each with a call-path witness.
+
+Instance identity is syntactic (receiver chains resolved through
+argument substitution) plus *alias facts* — canonicalization rules like
+``X._recovery._core == X`` (the supervisor attached to a core IS that
+core's recovery) that collapse chains which provably denote the same
+object.  Unknown receivers are frame-tagged so distinct locals never
+compare equal by accident: the walk over-approximates toward
+cross-instance (reporting a possible edge) rather than silently merging
+instances.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import FileContext, dotted
+
+_LOCK_CTORS = {
+    "threading.Lock": "Lock", "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+    "Lock": "Lock", "RLock": "RLock", "Condition": "Condition",
+}
+
+# default alias facts for this codebase (config key
+# ``lock_order.alias_rules`` extends/overrides): attach_recovery wires
+# the supervisor whose ``_core`` is the attaching core, and a replica
+# handle built around a supervisor shares its core.
+DEFAULT_ALIAS_RULES: Tuple[Tuple[str, str], ...] = (
+    ("._recovery._core", ""),
+    (".supervisor._core", ".core"),
+)
+
+# attribute types that cannot be derived from annotations/ctor calls
+# (duck-typed seams); config key ``lock_order.type_hints``.
+DEFAULT_TYPE_HINTS: Dict[str, str] = {
+    "EngineCore._recovery": "EngineSupervisor",
+    # duck-typed against _NullPlane when injection is off; the locked
+    # implementation is what chaos runs exercise
+    "EngineCore._fault": "FaultPlane",
+}
+
+# locks that BY DESIGN serialize device work: dispatch / host-sync
+# under them is the architecture, not a finding (EngineCore's step
+# lock serializes whole scheduler steps).
+DEFAULT_DISPATCH_LOCKS = ("EngineCore._step_lock",)
+DEFAULT_DISPATCH_CALLS = ("run_paged_program",)
+
+_MAX_DEPTH = 10
+
+
+def _parse_ann(node: Optional[ast.AST]) -> Optional[str]:
+    """Annotation AST -> type string: ``"EngineCore"``,
+    ``"list[ReplicaHandle]"``, ``"dict[ReplicaHandle]"`` (value type).
+    ``Optional[X]`` unwraps to ``X``; unknown shapes -> None."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return None
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Subscript):
+        base = _parse_ann(node.value)
+        sl = node.slice
+        if base == "Optional":
+            return _parse_ann(sl)
+        if base in ("List", "Sequence", "Iterable", "Tuple", "Set",
+                    "FrozenSet", "list", "set", "tuple"):
+            if base in ("Tuple", "tuple") and isinstance(sl, ast.Tuple):
+                return None     # heterogeneous tuples: give up
+            inner = _parse_ann(sl)
+            return f"list[{inner}]" if inner else None
+        if base in ("Dict", "Mapping", "dict"):
+            if isinstance(sl, ast.Tuple) and len(sl.elts) == 2:
+                inner = _parse_ann(sl.elts[1])
+                return f"dict[{inner}]" if inner else None
+    return None
+
+
+def _elem(t: Optional[str]) -> Optional[str]:
+    if t and (t.startswith("list[") or t.startswith("dict[")):
+        return t[5:-1]
+    return None
+
+
+class ClassInfo:
+    __slots__ = ("name", "relpath", "node", "methods", "properties",
+                 "attr_types", "lock_attrs", "bases")
+
+    def __init__(self, name: str, relpath: str, node: ast.ClassDef):
+        self.name = name
+        self.relpath = relpath
+        self.node = node
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        self.properties: Dict[str, ast.FunctionDef] = {}
+        self.attr_types: Dict[str, str] = {}
+        self.lock_attrs: Dict[str, str] = {}
+        self.bases: List[str] = []
+
+
+class FuncInfo:
+    __slots__ = ("key", "qualname", "node", "ctx", "cls", "events",
+                 "interesting")
+
+    def __init__(self, key: str, qualname: str, node: ast.FunctionDef,
+                 ctx: FileContext, cls: Optional[ClassInfo]):
+        self.key = key
+        self.qualname = qualname
+        self.node = node
+        self.ctx = ctx
+        self.cls = cls
+        self.events: List[object] = []
+        self.interesting = False
+
+
+class Binding:
+    """``obj.attr = lambda ...: self.m(...)`` — a callback wired onto
+    ``attr`` of ``owner_class``.  ``param_suffix[p] = ".core"`` records
+    the alias fact that at fire time ``resolve(p) + ".core"`` is the
+    object the callback was attached on (the caller's ``self``)."""
+    __slots__ = ("owner_class", "attr", "target", "param_suffix")
+
+    def __init__(self, owner_class: str, attr: str, target: str,
+                 param_suffix: Dict[str, Optional[str]]):
+        self.owner_class = owner_class
+        self.attr = attr
+        self.target = target            # FuncInfo key
+        self.param_suffix = param_suffix
+
+
+# ------------------------------------------------------------- events
+class Acquire:
+    """A lock acquisition.  ``body`` is the event list of the held
+    scope (``with`` block or recognized bounded-acquire/try pattern);
+    ``None`` for a bare ``.acquire()`` call (edge only, no scope)."""
+    __slots__ = ("lock", "kind", "recv", "bounded", "line", "body")
+
+    def __init__(self, lock: str, kind: str, recv: str, bounded: bool,
+                 line: int, body: Optional[list]):
+        self.lock, self.kind, self.recv = lock, kind, recv
+        self.bounded, self.line, self.body = bounded, line, body
+
+
+class Call:
+    __slots__ = ("target", "recv", "args", "line")
+
+    def __init__(self, target, recv: Optional[str],
+                 args: Dict[str, Optional[str]], line: int):
+        # target: FuncInfo key, or ("cb", class_name, attr_name)
+        self.target, self.recv, self.args, self.line = \
+            target, recv, args, line
+
+
+class Blocking:
+    __slots__ = ("bkind", "bounded", "line", "detail")
+
+    def __init__(self, bkind: str, bounded: bool, line: int,
+                 detail: Optional[Tuple[str, str]] = None):
+        # detail (cond-wait only): (lock_name, recv) being waited on
+        self.bkind, self.bounded = bkind, bounded
+        self.line, self.detail = line, detail
+
+
+# ------------------------------------------------------ project index
+class ProjectIndex:
+    """Classes, functions, lock attributes and callback bindings over a
+    set of parsed files, plus the per-function event scan."""
+
+    def __init__(self, files: Iterable[FileContext],
+                 config: Optional[dict] = None):
+        cfg = config or {}
+        self.type_hints = dict(DEFAULT_TYPE_HINTS)
+        self.type_hints.update(cfg.get("lock_order.type_hints", {}))
+        self.dispatch_calls = set(cfg.get("lock_order.dispatch_calls",
+                                          DEFAULT_DISPATCH_CALLS))
+        self.alias_rules = tuple(cfg.get("lock_order.alias_rules",
+                                         DEFAULT_ALIAS_RULES))
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FuncInfo] = {}
+        self.module_funcs: Dict[str, str] = {}      # name -> key
+        self.module_locks: Dict[Tuple[str, str], str] = {}
+        self.bindings: Dict[Tuple[str, str], Binding] = {}
+        self._files = list(files)
+        for ctx in self._files:
+            self._index_file(ctx)
+        for ctx in self._files:
+            self._collect_functions(ctx)
+        for fi in list(self.functions.values()):
+            _Scan(self, fi).run()
+        self._mark_interesting()
+
+    # ------------------------------------------------------- indexing
+    def _index_file(self, ctx: FileContext):
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._index_class(ctx, node)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                kind = self._lock_ctor_kind(node.value)
+                if kind:
+                    self.module_locks[(ctx.relpath,
+                                       node.targets[0].id)] = kind
+
+    @staticmethod
+    def _lock_ctor_kind(value: ast.AST) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            return _LOCK_CTORS.get(dotted(value.func))
+        return None
+
+    def _index_class(self, ctx: FileContext, node: ast.ClassDef):
+        ci = ClassInfo(node.name, ctx.relpath, node)
+        ci.bases = [dotted(b).split(".")[-1] for b in node.bases
+                    if dotted(b)]
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            is_prop = any(dotted(d) == "property"
+                          for d in item.decorator_list)
+            if is_prop:
+                ci.properties[item.name] = item
+            else:
+                ci.methods[item.name] = item
+            self._scan_attr_assigns(ci, item)
+        self.classes[node.name] = ci
+
+    def _scan_attr_assigns(self, ci: ClassInfo, fn: ast.FunctionDef):
+        """``self.x = ...`` attribute types and lock attrs, in any
+        method (not just __init__ — restarts rebuild locks too)."""
+        ann: Dict[str, Optional[str]] = {}
+        a = fn.args
+        for p in (a.posonlyargs + a.args + a.kwonlyargs):
+            ann[p.arg] = _parse_ann(p.annotation)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Attribute) and \
+                    isinstance(node.target.value, ast.Name) and \
+                    node.target.value.id == "self":
+                t = _parse_ann(node.annotation)
+                if t:
+                    ci.attr_types.setdefault(node.target.attr, t)
+                if node.value is not None:
+                    kind = self._lock_ctor_kind(node.value)
+                    if kind:
+                        ci.lock_attrs[node.target.attr] = kind
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                kind = self._lock_ctor_kind(node.value)
+                if kind:
+                    ci.lock_attrs[tgt.attr] = kind
+                    continue
+                t = self._rhs_type(node.value, ann)
+                if t:
+                    ci.attr_types.setdefault(tgt.attr, t)
+
+    def _rhs_type(self, value: ast.AST,
+                  ann: Dict[str, Optional[str]]) -> Optional[str]:
+        """Best-effort type of a ctor-time RHS: class calls, annotated
+        params, ``x or Cls()``, ``Cls() if c else None``."""
+        if isinstance(value, ast.Call):
+            name = dotted(value.func).split(".")[-1]
+            if name and (name in self.classes or name[:1].isupper()):
+                return name
+        if isinstance(value, ast.Name):
+            return ann.get(value.id)
+        if isinstance(value, ast.BoolOp):
+            for v in value.values:
+                t = self._rhs_type(v, ann)
+                if t:
+                    return t
+        if isinstance(value, ast.IfExp):
+            return (self._rhs_type(value.body, ann)
+                    or self._rhs_type(value.orelse, ann))
+        return None
+
+    def _collect_functions(self, ctx: FileContext):
+        for node in ctx.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                key = f"{ctx.relpath}::{node.name}"
+                fi = FuncInfo(key, node.name, node, ctx, None)
+                self.functions[key] = fi
+                self.module_funcs.setdefault(node.name, key)
+            elif isinstance(node, ast.ClassDef):
+                ci = self.classes.get(node.name)
+                if ci is None:
+                    continue
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        key = f"{ctx.relpath}::{node.name}.{item.name}"
+                        self.functions[key] = FuncInfo(
+                            key, f"{node.name}.{item.name}", item,
+                            ctx, ci)
+
+    # ----------------------------------------------------- resolution
+    def attr_type(self, cls_name: str, attr: str,
+                  _seen: Optional[set] = None) -> Optional[str]:
+        hint = self.type_hints.get(f"{cls_name}.{attr}")
+        if hint:
+            return hint
+        seen = _seen or set()
+        if cls_name in seen:
+            return None
+        seen.add(cls_name)
+        ci = self.classes.get(cls_name)
+        if ci is None:
+            return None
+        t = ci.attr_types.get(attr)
+        if t:
+            return t
+        prop = ci.properties.get(attr)
+        if prop is not None:
+            return _parse_ann(prop.returns)
+        for base in ci.bases:
+            t = self.attr_type(base, attr, seen)
+            if t:
+                return t
+        return None
+
+    def find_method(self, cls_name: str, name: str,
+                    _seen: Optional[set] = None
+                    ) -> Optional[Tuple[str, str, bool]]:
+        """(owner_class, kind, is_property) for ``cls.name`` walking
+        bases; kind distinguishes method vs property."""
+        seen = _seen or set()
+        if cls_name in seen:
+            return None
+        seen.add(cls_name)
+        ci = self.classes.get(cls_name)
+        if ci is None:
+            return None
+        if name in ci.methods:
+            return (cls_name, f"{ci.relpath}::{cls_name}.{name}", False)
+        if name in ci.properties:
+            return (cls_name, f"{ci.relpath}::{cls_name}.{name}", True)
+        for base in ci.bases:
+            r = self.find_method(base, name, seen)
+            if r:
+                return r
+        return None
+
+    def lock_kind(self, cls_name: str, attr: str) -> Optional[str]:
+        ci = self.classes.get(cls_name)
+        while ci is not None:
+            if attr in ci.lock_attrs:
+                return ci.lock_attrs[attr]
+            ci = self.classes.get(ci.bases[0]) if ci.bases else None
+        return None
+
+    def _mark_interesting(self):
+        """Fixpoint: a function is *interesting* (worth walking into)
+        when it — or anything it can call — acquires a lock or blocks."""
+        callees: Dict[str, Set[str]] = {}
+
+        def seed(fi: FuncInfo):
+            direct = False
+            outs: Set[str] = set()
+
+            def visit(evs):
+                nonlocal direct
+                for ev in evs:
+                    if isinstance(ev, (Acquire, Blocking)):
+                        direct = True
+                        if isinstance(ev, Acquire) and ev.body:
+                            visit(ev.body)
+                    elif isinstance(ev, Call):
+                        t = ev.target
+                        if isinstance(t, tuple):    # callback: assume yes
+                            direct = True
+                        elif t:
+                            outs.add(t)
+            visit(fi.events)
+            fi.interesting = direct
+            callees[fi.key] = outs
+
+        for fi in self.functions.values():
+            seed(fi)
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.functions.values():
+                if fi.interesting:
+                    continue
+                if any(self.functions[k].interesting
+                       for k in callees[fi.key] if k in self.functions):
+                    fi.interesting = True
+                    changed = True
+
+
+# ------------------------------------------------------ function scan
+class _Scan:
+    """One function body -> event tree, with a forward-flow local type
+    environment (``env``) and pure-attribute-chain aliases
+    (``env_expr``: ``rec = self._recovery`` makes ``rec`` resolve as
+    ``self._recovery`` in receiver chains)."""
+
+    def __init__(self, index: ProjectIndex, fi: FuncInfo):
+        self.ix = index
+        self.fi = fi
+        self.env: Dict[str, Optional[str]] = {}
+        self.env_expr: Dict[str, str] = {}
+        a = fi.node.args
+        for p in (a.posonlyargs + a.args + a.kwonlyargs):
+            self.env[p.arg] = _parse_ann(p.annotation)
+        if fi.cls is not None and (a.posonlyargs + a.args):
+            self.env[(a.posonlyargs + a.args)[0].arg] = fi.cls.name
+
+    def run(self):
+        self.fi.events = self._body(self.fi.node.body)
+
+    # ------------------------------------------------------ type info
+    def _type_of(self, node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id)
+        if isinstance(node, ast.Attribute):
+            base = self._type_of(node.value)
+            if base:
+                return self.ix.attr_type(base, node.attr)
+            return None
+        if isinstance(node, ast.Call):
+            name = dotted(node.func).split(".")[-1]
+            if name in ("min", "max",) and node.args:
+                return _elem(self._type_of(node.args[0]))
+            if name in ("sorted", "list"):
+                return self._type_of(node.args[0]) if node.args else None
+            if name in self.ix.classes:
+                return name
+            r = self._resolve_call_target(node)
+            if r is not None and not isinstance(r[0], tuple):
+                fi = self.ix.functions.get(r[0])
+                if fi is not None:
+                    return _parse_ann(fi.node.returns)
+            return None
+        if isinstance(node, ast.Subscript):
+            return _elem(self._type_of(node.value))
+        if isinstance(node, ast.BoolOp):
+            for v in node.values:
+                t = self._type_of(v)
+                if t:
+                    return t
+            return None
+        if isinstance(node, ast.IfExp):
+            return self._type_of(node.body) or self._type_of(node.orelse)
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                             ast.SetComp)):
+            if len(node.generators) == 1 and \
+                    isinstance(node.elt, ast.Name):
+                g = node.generators[0]
+                et = _elem(self._type_of(g.iter))
+                if et and isinstance(g.target, ast.Name) \
+                        and g.target.id == node.elt.id:
+                    return f"list[{et}]"
+        return None
+
+    def _chain(self, node: ast.AST) -> str:
+        """Receiver chain with local pure-alias expansion."""
+        d = dotted(node)
+        if not d:
+            return ""
+        head, _, rest = d.partition(".")
+        alias = self.env_expr.get(head)
+        if alias:
+            d = alias + ("." + rest if rest else "")
+        return d
+
+    # ------------------------------------------------- lock detection
+    def _as_lock(self, node: ast.AST
+                 ) -> Optional[Tuple[str, str, str]]:
+        """(lock_name, kind, recv) when ``node`` denotes a known lock."""
+        if isinstance(node, ast.Name):
+            kind = self.ix.module_locks.get(
+                (self.fi.ctx.relpath, node.id))
+            if kind:
+                stem = self.fi.ctx.relpath.rsplit("/", 1)[-1]
+                stem = stem[:-3] if stem.endswith(".py") else stem
+                return (f"{stem}.{node.id}", kind,
+                        f"g:{self.fi.ctx.relpath}")
+            return None
+        if not isinstance(node, ast.Attribute):
+            return None
+        base_t = self._type_of(node.value)
+        if not base_t:
+            return None
+        kind = self.ix.lock_kind(base_t, node.attr)
+        if kind is None:
+            return None
+        recv = self._chain(node.value) or "?"
+        return (f"{base_t}.{node.attr}", kind, recv)
+
+    # ---------------------------------------------------- statements
+    def _body(self, stmts: List[ast.stmt]) -> list:
+        out: list = []
+        i = 0
+        while i < len(stmts):
+            st = stmts[i]
+            consumed = self._try_bounded_pattern(stmts, i, out)
+            if consumed:
+                i += consumed
+                continue
+            self._stmt(st, out)
+            i += 1
+        return out
+
+    def _try_bounded_pattern(self, stmts, i, out) -> int:
+        """Recognize the bounded-acquire idiom and turn it into a held
+        scope::
+
+            if not X.acquire(timeout=...):     acquired = X.acquire(..)
+                return/continue                if acquired:
+            try:                                   try: BODY
+                BODY                               finally: X.release()
+            finally:
+                X.release()
+        """
+        st = stmts[i]
+        # form 1: if not acquire -> bail; try/finally release next
+        if isinstance(st, ast.If) and isinstance(st.test, ast.UnaryOp) \
+                and isinstance(st.test.op, ast.Not) \
+                and isinstance(st.test.operand, ast.Call) \
+                and i + 1 < len(stmts) \
+                and isinstance(stmts[i + 1], ast.Try):
+            acq = self._acquire_call(st.test.operand)
+            if acq and self._releases(stmts[i + 1].finalbody, acq[4]):
+                lock, kind, recv, bounded, chain = acq
+                body = self._body(stmts[i + 1].body)
+                out.append(Acquire(lock, kind, recv, bounded,
+                                   st.lineno, body))
+                for s in st.body:       # the bail-out branch
+                    self._stmt(s, out)
+                return 2
+        # form 2: acquired = X.acquire(..); if acquired: try/finally
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 \
+                and isinstance(st.targets[0], ast.Name) \
+                and isinstance(st.value, ast.Call) \
+                and i + 1 < len(stmts) \
+                and isinstance(stmts[i + 1], ast.If):
+            acq = self._acquire_call(st.value)
+            nxt = stmts[i + 1]
+            if acq and isinstance(nxt.test, ast.Name) \
+                    and nxt.test.id == st.targets[0].id \
+                    and len(nxt.body) == 1 \
+                    and isinstance(nxt.body[0], ast.Try) \
+                    and self._releases(nxt.body[0].finalbody, acq[4]):
+                lock, kind, recv, bounded, chain = acq
+                body = self._body(nxt.body[0].body)
+                out.append(Acquire(lock, kind, recv, bounded,
+                                   st.lineno, body))
+                for s in nxt.orelse:
+                    self._stmt(s, out)
+                return 2
+        return 0
+
+    def _acquire_call(self, call: ast.Call):
+        """(lock, kind, recv, bounded, chain) for ``X.acquire(...)``."""
+        if not (isinstance(call.func, ast.Attribute)
+                and call.func.attr == "acquire"):
+            return None
+        lk = self._as_lock(call.func.value)
+        if lk is None:
+            return None
+        lock, kind, recv = lk
+        return (lock, kind, recv, self._acquire_bounded(call),
+                self._chain(call.func.value))
+
+    @staticmethod
+    def _acquire_bounded(call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                return True
+            if kw.arg == "blocking" and \
+                    isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                return True
+        if len(call.args) >= 2:
+            return True         # acquire(blocking, timeout)
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and call.args[0].value is False:
+            return True
+        return False
+
+    def _releases(self, finalbody, chain: str) -> bool:
+        for st in finalbody:
+            for node in ast.walk(st):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "release" \
+                        and self._chain(node.func.value) == chain:
+                    return True
+        return False
+
+    def _stmt(self, st: ast.stmt, out: list):
+        if isinstance(st, ast.With):
+            inner = out
+            scopes: List[Acquire] = []
+            for item in st.items:
+                lk = self._as_lock(item.context_expr)
+                if lk is not None:
+                    lock, kind, recv = lk
+                    acq = Acquire(lock, kind, recv, False,
+                                  st.lineno, [])
+                    inner.append(acq)
+                    scopes.append(acq)
+                    inner = acq.body
+                else:
+                    self._expr(item.context_expr, inner)
+            inner.extend(self._body(st.body))
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs are closures used inline in this codebase
+            # (gather/scatter under the step lock): treat their bodies
+            # as executed at the definition point.
+            out.extend(self._body(st.body))
+        elif isinstance(st, ast.ClassDef):
+            pass
+        elif isinstance(st, ast.Assign):
+            self._expr(st.value, out)
+            if len(st.targets) == 1 and \
+                    isinstance(st.targets[0], ast.Name):
+                name = st.targets[0].id
+                t = self._type_of(st.value)
+                if t:
+                    self.env[name] = t
+                chain = dotted(st.value)
+                if chain and "." in chain:
+                    self.env_expr[name] = self._chain(st.value)
+                else:
+                    self.env_expr.pop(name, None)
+            elif len(st.targets) == 1 and \
+                    isinstance(st.targets[0], ast.Tuple):
+                t = self._type_of(st.value)
+                # tuple-unpack of uniform containers is not tracked
+                del t
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self._expr(st.value, out)
+            if isinstance(st.target, ast.Name):
+                t = _parse_ann(st.annotation)
+                if t:
+                    self.env[st.target.id] = t
+        elif isinstance(st, ast.AugAssign):
+            self._expr(st.value, out)
+        elif isinstance(st, ast.For):
+            self._expr(st.iter, out)
+            et = _elem(self._type_of(st.iter))
+            if isinstance(st.target, ast.Name) and et:
+                self.env[st.target.id] = et
+            out.extend(self._body(st.body))
+            out.extend(self._body(st.orelse))
+        elif isinstance(st, ast.While):
+            self._expr(st.test, out)
+            out.extend(self._body(st.body))
+            out.extend(self._body(st.orelse))
+        elif isinstance(st, ast.If):
+            self._expr(st.test, out)
+            out_body = self._body(st.body)
+            out.extend(out_body)
+            out.extend(self._body(st.orelse))
+        elif isinstance(st, ast.Try):
+            out.extend(self._body(st.body))
+            for h in st.handlers:
+                out.extend(self._body(h.body))
+            out.extend(self._body(st.orelse))
+            out.extend(self._body(st.finalbody))
+        elif isinstance(st, (ast.Return, ast.Expr)):
+            if st.value is not None:
+                self._expr(st.value, out)
+        elif isinstance(st, ast.Raise):
+            if st.exc is not None:
+                self._expr(st.exc, out)
+        elif isinstance(st, (ast.Assert, ast.Delete, ast.Pass,
+                             ast.Break, ast.Continue, ast.Import,
+                             ast.ImportFrom, ast.Global,
+                             ast.Nonlocal)):
+            pass
+        else:
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._expr(child, out)
+                elif isinstance(child, ast.stmt):
+                    self._stmt(child, out)
+
+    # --------------------------------------------------- expressions
+    def _expr(self, node: ast.AST, out: list):
+        if isinstance(node, ast.Call):
+            self._call(node, out)
+            return
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, ast.Load):
+            self._attr_load(node, out)
+            self._expr(node.value, out)
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            self._comp(node, out)
+            return
+        if isinstance(node, ast.Lambda):
+            return      # not executed at evaluation site
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, out)
+
+    def _comp(self, node, out: list):
+        saved_env = dict(self.env)
+        for g in node.generators:
+            self._expr(g.iter, out)
+            et = _elem(self._type_of(g.iter))
+            if isinstance(g.target, ast.Name) and et:
+                self.env[g.target.id] = et
+            for cond in g.ifs:
+                self._expr(cond, out)
+        if isinstance(node, ast.DictComp):
+            self._expr(node.key, out)
+            self._expr(node.value, out)
+        else:
+            self._expr(node.elt, out)
+        self.env = saved_env
+
+    def _attr_load(self, node: ast.Attribute, out: list):
+        """Property reads execute code: emit a Call."""
+        base_t = self._type_of(node.value)
+        if not base_t:
+            return
+        r = self.ix.find_method(base_t, node.attr)
+        if r is not None and r[2]:
+            out.append(Call(r[1], self._chain(node.value), {},
+                            node.lineno))
+
+    def _call(self, call: ast.Call, out: list):
+        d = dotted(call.func)
+        tail = d.split(".")[-1] if d else ""
+        handled_args = False
+
+        if tail == "acquire" and isinstance(call.func, ast.Attribute):
+            acq = self._acquire_call(call)
+            if acq is not None:
+                lock, kind, recv, bounded, _chain = acq
+                out.append(Acquire(lock, kind, recv, bounded,
+                                   call.lineno, None))
+            elif not self._acquire_bounded(call):
+                out.append(Blocking("acquire", False, call.lineno))
+        elif tail in ("block_until_ready", "device_get"):
+            out.append(Blocking("host-sync", False, call.lineno))
+        elif tail in self.ix.dispatch_calls:
+            out.append(Blocking("dispatch", False, call.lineno))
+        elif tail == "join" and isinstance(call.func, ast.Attribute) \
+                and not isinstance(call.func.value, ast.Constant):
+            b = self._join_bounded(call)
+            if b is not None:
+                out.append(Blocking("join", b, call.lineno))
+        elif tail == "get" and isinstance(call.func, ast.Attribute):
+            b = self._get_bounded(call)
+            if b is not None:
+                out.append(Blocking("queue-get", b, call.lineno))
+        elif tail == "wait" and isinstance(call.func, ast.Attribute):
+            detail = None
+            lk = self._as_lock(call.func.value)
+            if lk is not None and lk[1] == "Condition":
+                detail = (lk[0], lk[2])
+            bounded = bool(call.args or call.keywords)
+            out.append(Blocking("wait", bounded, call.lineno, detail))
+        elif d == "time.sleep":
+            out.append(Blocking("sleep", True, call.lineno))
+        elif tail == "release":
+            pass
+        else:
+            target = self._resolve_call_target(call)
+            if target is not None:
+                key, recv = target
+                args = self._arg_map(call, key)
+                out.append(Call(key, recv, args, call.lineno))
+            self._minmax_key_lambda(call, out)
+
+        for a in call.args:
+            self._expr(a, out)
+        for kw in call.keywords:
+            if not isinstance(kw.value, ast.Lambda):
+                self._expr(kw.value, out)
+        del handled_args
+
+    def _minmax_key_lambda(self, call: ast.Call, out: list):
+        """``min(xs, key=lambda h: ...)``: the lambda runs per element
+        — bind its param to the element type and inline its body."""
+        name = dotted(call.func).split(".")[-1]
+        if name not in ("min", "max", "sorted") or not call.args:
+            return
+        et = _elem(self._type_of(call.args[0]))
+        for kw in call.keywords:
+            if kw.arg == "key" and isinstance(kw.value, ast.Lambda):
+                lam = kw.value
+                params = [p.arg for p in lam.args.args]
+                saved = dict(self.env)
+                if params and et:
+                    self.env[params[0]] = et
+                self._expr(lam.body, out)
+                self.env = saved
+
+    @staticmethod
+    def _join_bounded(call: ast.Call) -> Optional[bool]:
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                return not (isinstance(kw.value, ast.Constant)
+                            and kw.value.value is None)
+        if not call.args:
+            return False            # t.join() — unbounded
+        a0 = call.args[0]
+        if isinstance(a0, ast.Constant) and \
+                isinstance(a0.value, (int, float)):
+            return True
+        if isinstance(a0, ast.Name) and "timeout" in a0.id.lower():
+            return True
+        return None                 # probably str.join(iterable)
+
+    @staticmethod
+    def _get_bounded(call: ast.Call) -> Optional[bool]:
+        for kw in call.keywords:
+            if kw.arg == "timeout":
+                if isinstance(kw.value, ast.Constant) and \
+                        kw.value.value is None:
+                    return False
+                return True
+            if kw.arg == "block" and isinstance(kw.value, ast.Constant):
+                return kw.value.value is False
+        if not call.args and not call.keywords:
+            return False            # q.get() — blocking, unbounded
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and isinstance(call.args[0].value, bool):
+            return call.args[0].value is False
+        return None                 # dict.get(...) etc.
+
+    def _resolve_call_target(self, call: ast.Call):
+        """-> (FuncInfo key | ("cb", cls, attr), recv_chain) or None."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            key = self.ix.module_funcs.get(f.id)
+            if key is not None and f.id not in self.ix.classes:
+                return (key, None)
+            return None
+        if isinstance(f, ast.Attribute):
+            base_t = self._type_of(f.value)
+            if not base_t:
+                return None
+            r = self.ix.find_method(base_t, f.attr)
+            if r is not None and not r[2]:
+                return (r[1], self._chain(f.value))
+            if r is None and self.ix.attr_type(base_t, f.attr) is None:
+                # unknown callable attribute: maybe a wired callback
+                return (("cb", base_t, f.attr), self._chain(f.value))
+        return None
+
+    def _arg_map(self, call: ast.Call, key) -> Dict[str, Optional[str]]:
+        if isinstance(key, tuple):
+            return {}
+        fi = self.ix.functions.get(key)
+        if fi is None:
+            return {}
+        a = fi.node.args
+        params = [p.arg for p in (a.posonlyargs + a.args)]
+        if fi.cls is not None and params:
+            params = params[1:]     # drop self
+        out: Dict[str, Optional[str]] = {}
+        for p, arg in zip(params, call.args):
+            c = self._chain(arg) if isinstance(
+                arg, (ast.Name, ast.Attribute)) else ""
+            out[p] = c or None
+        for kw in call.keywords:
+            if kw.arg:
+                c = self._chain(kw.value) if isinstance(
+                    kw.value, (ast.Name, ast.Attribute)) else ""
+                out[kw.arg] = c or None
+        return out
+
+
+# ------------------------------------------------- callback bindings
+def extract_bindings(index: ProjectIndex):
+    """``obj.attr = lambda ...: self.m(...)`` / ``obj.attr = self.m``
+    assignments anywhere in the project become Binding records keyed by
+    (owner_class_of_obj, attr)."""
+    for fi in index.functions.values():
+        scan = _Scan(index, fi)     # fresh env for receiver typing
+        for node in ast.walk(fi.node):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)):
+                continue
+            tgt = node.targets[0]
+            # run env forward to the assignment line: cheap approx —
+            # re-scan preceding simple assigns for loop-var types
+            _prime_env(scan, fi.node, node.lineno)
+            owner_t = scan._type_of(tgt.value)
+            if not owner_t:
+                continue
+            attach_recv = scan._chain(tgt.value)
+            binding = _binding_from_value(
+                index, scan, owner_t, tgt.attr, attach_recv, node.value)
+            if binding is not None:
+                index.bindings[(owner_t, tgt.attr)] = binding
+
+
+def _prime_env(scan: _Scan, fn: ast.FunctionDef, upto_line: int):
+    for node in ast.walk(fn):
+        if getattr(node, "lineno", upto_line + 1) >= upto_line:
+            continue
+        if isinstance(node, ast.For) and \
+                isinstance(node.target, ast.Name):
+            et = _elem(scan._type_of(node.iter))
+            if et:
+                scan.env[node.target.id] = et
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            t = scan._type_of(node.value)
+            if t:
+                scan.env[node.targets[0].id] = t
+
+
+def _binding_from_value(index, scan, owner_t, attr, attach_recv, value
+                        ) -> Optional[Binding]:
+    if isinstance(value, ast.Lambda) and \
+            isinstance(value.body, ast.Call):
+        call = value.body
+        tr = scan._resolve_call_target(call)
+        if tr is None or isinstance(tr[0], tuple):
+            return None
+        key = tr[0]
+        fi = index.functions.get(key)
+        if fi is None:
+            return None
+        lam_params = [p.arg for p in value.args.args]
+        defaults = {}
+        dn = len(value.args.defaults)
+        for p, d in zip(value.args.args[-dn:] if dn else [],
+                        value.args.defaults):
+            if isinstance(d, (ast.Name, ast.Attribute)):
+                defaults[p.arg] = scan._chain(d)
+        a = fi.node.args
+        params = [p.arg for p in (a.posonlyargs + a.args)]
+        if fi.cls is not None and params:
+            params = params[1:]
+        suffix: Dict[str, Optional[str]] = {}
+        for p, arg in zip(params, call.args):
+            expr = None
+            if isinstance(arg, ast.Name):
+                expr = defaults.get(arg.id)
+                if expr is None and arg.id in lam_params:
+                    expr = None     # runtime argument, no alias fact
+                elif expr is None:
+                    expr = scan._chain(arg)
+            elif isinstance(arg, ast.Attribute):
+                expr = scan._chain(arg)
+            if expr and attach_recv.startswith(expr):
+                rest = attach_recv[len(expr):]
+                if rest == "" or rest.startswith("."):
+                    suffix[p] = rest
+                    continue
+            suffix[p] = None
+        return Binding(owner_t, attr, key, suffix)
+    if isinstance(value, ast.Attribute) and \
+            isinstance(value.value, ast.Name):
+        base_t = scan._type_of(value.value)
+        if base_t:
+            r = index.find_method(base_t, value.attr)
+            if r is not None and not r[2]:
+                return Binding(owner_t, attr, r[1], {})
+    return None
+
+
+# ----------------------------------------------------------- the walk
+class Held:
+    __slots__ = ("lock", "kind", "recv", "bounded", "frame")
+
+    def __init__(self, lock, kind, recv, bounded, frame):
+        self.lock, self.kind, self.recv = lock, kind, recv
+        self.bounded, self.frame = bounded, frame
+
+
+class LockGraph:
+    """Static lock-order graph plus the findings the walk produced."""
+
+    def __init__(self):
+        self.nodes: Set[str] = set()
+        # (src, dst) -> dict(bounded_only, cross, witness, count)
+        self.edges: Dict[Tuple[str, str], dict] = {}
+        self.blocking: List[dict] = []
+        self.reacquires: List[dict] = []
+        self._block_seen: Set[Tuple[str, int, str]] = set()
+
+    def add_edge(self, src: str, dst: str, bounded: bool, cross: bool,
+                 witness: List[str]):
+        self.nodes.update((src, dst))
+        e = self.edges.get((src, dst))
+        if e is None:
+            self.edges[(src, dst)] = {
+                "bounded_only": bounded, "cross": cross,
+                "witness": list(witness), "count": 1}
+            return
+        e["count"] += 1
+        e["cross"] = e["cross"] or cross
+        if e["bounded_only"] and not bounded:
+            # an unbounded witness outranks a bounded one
+            e["bounded_only"] = False
+            e["witness"] = list(witness)
+
+    def cycles(self) -> List[dict]:
+        """SCCs (and self-loops) over the UNBOUNDED edges — a bounded
+        acquire backs off instead of deadlocking, so it breaks the
+        cycle it participates in."""
+        adj: Dict[str, Set[str]] = {}
+        for (src, dst), e in self.edges.items():
+            if e["bounded_only"]:
+                continue
+            if src == dst and not e["cross"]:
+                continue
+            adj.setdefault(src, set()).add(dst)
+        out: List[dict] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        for scc in _tarjan(adj):
+            is_cycle = len(scc) > 1 or (
+                len(scc) == 1 and scc[0] in adj.get(scc[0], ()))
+            if not is_cycle:
+                continue
+            key = tuple(sorted(scc))
+            if key in seen_cycles:
+                continue
+            seen_cycles.add(key)
+            members = sorted(scc)
+            edges = [
+                {"src": s, "dst": d, **self.edges[(s, d)]}
+                for (s, d), e in sorted(self.edges.items())
+                if s in scc and d in scc and not e["bounded_only"]]
+            out.append({"nodes": members, "edges": edges})
+        return out
+
+    def add_blocking(self, fkey: str, line: int, bkind: str,
+                     locks: List[str], path: str, symbol: str,
+                     witness: List[str]):
+        k = (fkey, line, bkind)
+        if k in self._block_seen:
+            return
+        self._block_seen.add(k)
+        self.blocking.append({
+            "kind": bkind, "locks": sorted(set(locks)), "path": path,
+            "line": line, "symbol": symbol, "witness": list(witness)})
+
+    def to_stable_dict(self) -> dict:
+        """Line-number-free view for the committed baseline: edits that
+        move code must not churn the gate file."""
+        edges = sorted(
+            {(s, d, e["bounded_only"], e["cross"])
+             for (s, d), e in self.edges.items()})
+        return {
+            "version": 1,
+            "nodes": sorted(self.nodes),
+            "edges": [{"src": s, "dst": d, "bounded": b, "cross": c}
+                      for (s, d, b, c) in edges],
+            "cycles": [list(c["nodes"]) for c in self.cycles()],
+            "blocking": [
+                {"kind": k, "path": p, "symbol": sym, "locks": lk}
+                for (k, p, sym, lk) in sorted(
+                    {(b["kind"], b["path"], b["symbol"],
+                      ",".join(b["locks"])) for b in self.blocking})],
+        }
+
+    def to_dot(self) -> str:
+        lines = ["digraph lock_order {", "  rankdir=LR;"]
+        for n in sorted(self.nodes):
+            lines.append(f'  "{n}";')
+        for (s, d), e in sorted(self.edges.items()):
+            style = "dashed" if e["bounded_only"] else "solid"
+            color = "red" if (s == d and e["cross"]
+                              and not e["bounded_only"]) else "black"
+            lines.append(f'  "{s}" -> "{d}" '
+                         f'[style={style}, color={color}, '
+                         f'label="{e["count"]}"];')
+        lines.append("}")
+        return "\n".join(lines)
+
+
+def _tarjan(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str):
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                out.append(scc)
+
+    nodes = set(adj)
+    for vs in adj.values():
+        nodes |= vs
+    for v in sorted(nodes):
+        if v not in index:
+            strongconnect(v)
+    return out
+
+
+class LockWalk:
+    """Replays every function's event tree interprocedurally."""
+
+    def __init__(self, index: ProjectIndex,
+                 dispatch_locks: Iterable[str] = DEFAULT_DISPATCH_LOCKS):
+        self.ix = index
+        self.dispatch_locks = set(dispatch_locks)
+        self.graph = LockGraph()
+        self._marker = [0]
+
+    def run(self) -> LockGraph:
+        extract_bindings(self.ix)
+        # Bound callbacks are walked from their fire sites, where the
+        # binding's alias facts hold (e.g. the boundary-handoff hook
+        # always runs under the attaching core's step RLock, so its
+        # source-side acquires are reentrant).  Walking them as bare
+        # roots would fabricate call contexts the wiring rules out.
+        bound_targets = {b.target for b in self.ix.bindings.values()}
+        for fi in sorted(self.ix.functions.values(),
+                         key=lambda f: f.key):
+            if fi.key in bound_targets:
+                continue
+            self._walk(fi, {"self": f"root:{fi.key}.self"}, [],
+                       [], 0, {fi.key}, self.ix.alias_rules)
+        return self.graph
+
+    # ------------------------------------------------------ plumbing
+    def _resolve(self, expr: str, subst: Dict[str, str], depth: int,
+                 rules) -> str:
+        head, _, rest = expr.partition(".")
+        if head in subst:
+            resolved = subst[head] + ("." + rest if rest else "")
+        else:
+            resolved = f"%{depth}.{expr}"
+        return self._canon(resolved, rules)
+
+    @staticmethod
+    def _canon(s: str, rules) -> str:
+        for _ in range(4):
+            before = s
+            for pat, repl in rules:
+                if pat in s:
+                    s = s.replace(pat, repl)
+            if s == before:
+                break
+        return s
+
+    def _frame(self, fi: FuncInfo, line: int) -> str:
+        return f"{fi.ctx.relpath}:{line} in {fi.qualname}"
+
+    # ---------------------------------------------------------- walk
+    def _walk(self, fi: FuncInfo, subst, held: List[Held], path,
+              depth: int, stack: Set[str], rules):
+        for ev in fi.events:
+            self._event(fi, ev, subst, held, path, depth, stack, rules)
+
+    def _event(self, fi, ev, subst, held, path, depth, stack, rules):
+        if isinstance(ev, Acquire):
+            recv = self._resolve(ev.recv, subst, depth, rules)
+            same = [h for h in held
+                    if h.lock == ev.lock and h.recv == recv]
+            if same:
+                if ev.kind == "Lock":
+                    self.graph.reacquires.append({
+                        "lock": ev.lock, "path": fi.ctx.relpath,
+                        "line": ev.line, "symbol": fi.qualname,
+                        "witness": path + [self._frame(fi, ev.line)]})
+                # RLock/Condition re-entry: not an edge
+            else:
+                for h in held:
+                    self.graph.add_edge(
+                        h.lock, ev.lock, ev.bounded,
+                        h.lock == ev.lock,
+                        [f"[{h.lock} held since {h.frame}]"] + path
+                        + [self._frame(fi, ev.line)])
+                self.graph.nodes.add(ev.lock)
+            if ev.body is not None:
+                held.append(Held(ev.lock, ev.kind, recv, ev.bounded,
+                                 self._frame(fi, ev.line)))
+                for sub in ev.body:
+                    self._event(fi, sub, subst, held, path, depth,
+                                stack, rules)
+                held.pop()
+            return
+
+        if isinstance(ev, Blocking):
+            if not held:
+                return
+            snapshot = list(held)
+            if ev.detail is not None:       # cond.wait releases its own
+                recv = self._resolve(ev.detail[1], subst, depth, rules)
+                snapshot = [h for h in snapshot
+                            if not (h.lock == ev.detail[0]
+                                    and h.recv == recv)]
+            if not snapshot:
+                return
+            if ev.bkind in ("host-sync", "dispatch"):
+                flagged = [h for h in snapshot
+                           if h.lock not in self.dispatch_locks]
+            elif ev.bkind == "sleep":
+                flagged = [h for h in snapshot
+                           if h.lock not in self.dispatch_locks]
+            elif ev.bkind in ("join", "queue-get", "wait", "acquire"):
+                flagged = snapshot if not ev.bounded else []
+            else:
+                flagged = []
+            if flagged:
+                self.graph.add_blocking(
+                    fi.key, ev.line, ev.bkind,
+                    [h.lock for h in flagged], fi.ctx.relpath,
+                    fi.qualname, path + [self._frame(fi, ev.line)])
+            return
+
+        if isinstance(ev, Call):
+            target = ev.target
+            child_rules = rules
+            child_subst: Dict[str, str] = {}
+            if isinstance(target, tuple):       # callback attr
+                binding = self.ix.bindings.get((target[1], target[2]))
+                if binding is None:
+                    return
+                tfi = self.ix.functions.get(binding.target)
+                if tfi is None:
+                    return
+                caller_obj = self._resolve(ev.recv or "self", subst,
+                                           depth, rules)
+                self._marker[0] += 1
+                extra = []
+                for p, sfx in binding.param_suffix.items():
+                    m = f"%cb{self._marker[0]}.{p}"
+                    child_subst[p] = m
+                    if sfx is not None:
+                        # resolve(p) + sfx denotes the attach object
+                        extra.append((m + sfx, caller_obj))
+                child_subst["self"] = f"%cb{self._marker[0]}.__owner__"
+                if extra:
+                    child_rules = tuple(extra) + tuple(rules)
+            else:
+                tfi = self.ix.functions.get(target)
+                if tfi is None:
+                    return
+                if tfi.cls is not None:
+                    child_subst["self"] = self._resolve(
+                        ev.recv or "self", subst, depth, rules)
+                for p, argexpr in ev.args.items():
+                    if argexpr:
+                        child_subst[p] = self._resolve(
+                            argexpr, subst, depth, rules)
+            if not tfi.interesting:
+                return
+            if not held:
+                return      # covered when tfi is walked as a root
+            if depth >= _MAX_DEPTH or tfi.key in stack:
+                return
+            stack.add(tfi.key)
+            path.append(self._frame(fi, ev.line))
+            self._walk(tfi, child_subst, held, path, depth + 1,
+                       stack, child_rules)
+            path.pop()
+            stack.discard(tfi.key)
+
+
+def build_lock_graph(files: Iterable[FileContext],
+                     config: Optional[dict] = None) -> LockGraph:
+    """Convenience: index + walk in one call (the CLI entry point)."""
+    cfg = config or {}
+    index = ProjectIndex(files, cfg)
+    walk = LockWalk(index, set(cfg.get("lock_order.dispatch_locks",
+                                       DEFAULT_DISPATCH_LOCKS)))
+    return walk.run()

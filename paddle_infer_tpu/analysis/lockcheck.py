@@ -1,0 +1,421 @@
+"""Runtime lock checker: the dynamic counterpart of the static
+``lock-order`` rule.
+
+``instrument_locks()`` patches ``threading.Lock`` / ``RLock`` /
+``Condition`` (and ``jax.block_until_ready`` when jax is importable)
+for the duration of a ``with`` block.  Locks *constructed* by package
+code while instrumentation is active come back wrapped; everything
+else (pytest internals, logging, executors) passes through untouched.
+The wrapper records, per thread:
+
+  * the acquisition-order edges between held locks (class-level names
+    like ``EngineCore._step_lock``, derived from the construction
+    site), each tagged bounded/unbounded;
+  * per-instance directed pairs — observing ``a -> b`` and ``b -> a``
+    on the same two INSTANCES with both directions unbounded is a
+    lock-order inversion, reported with the two acquisition stacks
+    (the classic two-witness TSan shape);
+  * hold durations (count / total / max per lock name);
+  * host-syncs under a held lock that is not in the allowed set
+    (``EngineCore._step_lock`` serializes device work by design);
+  * same-thread re-acquisition of a non-reentrant ``Lock`` — reported
+    AND raised as ``RuntimeError`` instead of deadlocking the test.
+
+``LockChecker.graph()`` exports the observed lock graph in the same
+shape as the static ``LockGraph.to_stable_dict()`` edges, and
+``gap_report(static)`` lists observed edges the static analyzer missed
+— the acceptance gate is that this list is empty (dynamic ⊆ static).
+
+The checker's own bookkeeping uses the ORIGINAL lock factory saved at
+patch time, so it never traces itself.  Locks created before
+instrumentation (module globals, already-running engines) are simply
+unobserved; that only ever shrinks the dynamic graph, never the gate.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_ATTR_RE = re.compile(r"self\.(\w+)\s*=")
+_VAR_RE = re.compile(r"(\w+)\s*=")
+
+DEFAULT_ALLOW_HOST_SYNC = ("EngineCore._step_lock",)
+
+
+def _stack_summary(skip: int = 2, limit: int = 8) -> List[str]:
+    """Cheap ``file:line in func`` frames, innermost last."""
+    out: List[str] = []
+    try:
+        f = sys._getframe(skip)
+    except ValueError:
+        return out
+    while f is not None and len(out) < limit:
+        out.append(f"{os.path.basename(f.f_code.co_filename)}:"
+                   f"{f.f_lineno} in {f.f_code.co_name}")
+        f = f.f_back
+    out.reverse()
+    return out
+
+
+class _Held:
+    __slots__ = ("wrapper", "bounded", "t0", "stack")
+
+    def __init__(self, wrapper, bounded, t0, stack):
+        self.wrapper, self.bounded = wrapper, bounded
+        self.t0, self.stack = t0, stack
+
+
+class LockChecker:
+    """Collected state for one instrumentation window."""
+
+    def __init__(self, paths: Optional[List[str]] = None,
+                 allow_host_sync_under=DEFAULT_ALLOW_HOST_SYNC):
+        self.paths = [os.path.abspath(p)
+                      for p in (paths or [_PKG_ROOT])]
+        self.allow_host_sync_under = set(allow_host_sync_under)
+        self.violations: List[dict] = []
+        self.hold_stats: Dict[str, dict] = {}
+        # class-level edges: (src_name, dst_name) -> {"bounded_only"}
+        self._edges: Dict[Tuple[str, str], dict] = {}
+        # instance-level direction records:
+        # (id_a, id_b) -> {"names", "unbounded", "witness"}
+        self._pairs: Dict[Tuple[int, int], dict] = {}
+        # every wrapper ever constructed, held strongly: _pairs keys on
+        # id(), so a freed wrapper's address must never be reused for a
+        # new lock within this window (a stale reverse-pair record
+        # would fabricate an inversion between unrelated locks).
+        self._wrappers: List = []
+        self._tls = threading.local()
+        # bookkeeping mutex from the ORIGINAL factory (set by
+        # instrument_locks before any wrapping happens).
+        self._mu = None
+        self._orig_lock = None
+
+    # ------------------------------------------------------ plumbing
+    def _held(self) -> List[_Held]:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = self._tls.held = []
+        return h
+
+    def _in_paths(self, filename: str) -> bool:
+        try:
+            fn = os.path.abspath(filename)
+        except (TypeError, ValueError):
+            return False
+        return any(fn.startswith(p) for p in self.paths)
+
+    def _name_from_site(self) -> Optional[str]:
+        """Derive ``Class._attr`` / ``modstem._var`` from the DIRECT
+        constructing frame — only when that frame is under the
+        instrumented paths.  Deliberately not a frame walk: stdlib and
+        jax internals construct locks on behalf of package calls
+        (``queue.Queue``'s mutex, compile caches), and naming those
+        after the package frame below them would flood the observed
+        graph with edges no package source line owns."""
+        import linecache
+        try:
+            f = sys._getframe(2)
+        except ValueError:
+            return None
+        fn = f.f_code.co_filename
+        if not self._in_paths(fn) or \
+                os.path.abspath(fn) == os.path.abspath(__file__):
+            return None
+        line = linecache.getline(fn, f.f_lineno)
+        stem = os.path.basename(fn)
+        stem = stem[:-3] if stem.endswith(".py") else stem
+        slf = f.f_locals.get("self")
+        if slf is not None:
+            m = _ATTR_RE.search(line)
+            if m:
+                return f"{type(slf).__name__}.{m.group(1)}"
+            return f"{type(slf).__name__}.<anon@{f.f_lineno}>"
+        m = _VAR_RE.search(line)
+        if m:
+            return f"{stem}.{m.group(1)}"
+        return f"{stem}.<anon@{f.f_lineno}>"
+
+    # ------------------------------------------------------ recording
+    def _record_acquired(self, wrapper, bounded: bool):
+        held = self._held()
+        stack = _stack_summary(skip=3)
+        now = time.monotonic()
+        with self._mu:
+            for h in held:
+                if h.wrapper is wrapper:
+                    continue
+                key = (h.wrapper.name, wrapper.name)
+                e = self._edges.get(key)
+                if e is None:
+                    self._edges[key] = {"bounded_only": bounded}
+                elif not bounded:
+                    e["bounded_only"] = False
+                self._check_inversion(h, wrapper, bounded, stack)
+        held.append(_Held(wrapper, bounded, now, stack))
+
+    def _check_inversion(self, h: _Held, wrapper, bounded, stack):
+        a, b = id(h.wrapper), id(wrapper)
+        rec = self._pairs.get((a, b))
+        if rec is None:
+            rec = self._pairs[(a, b)] = {
+                "names": (h.wrapper.name, wrapper.name),
+                "unbounded": not bounded,
+                "witness": (list(h.stack), list(stack))}
+        elif not bounded:
+            rec["unbounded"] = True
+            rec["witness"] = (list(h.stack), list(stack))
+        rev = self._pairs.get((b, a))
+        if rev is not None and rec["unbounded"] and rev["unbounded"]:
+            names = rec["names"]
+            if not any(v["kind"] == "inversion"
+                       and set(v["locks"]) == set(names)
+                       for v in self.violations):
+                self.violations.append({
+                    "kind": "inversion",
+                    "locks": list(names),
+                    "thread": threading.current_thread().name,
+                    "witness_forward": rev["witness"],
+                    "witness_backward": rec["witness"],
+                })
+
+    def _record_released(self, wrapper):
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].wrapper is wrapper:
+                h = held.pop(i)
+                dur = time.monotonic() - h.t0
+                with self._mu:
+                    st = self.hold_stats.setdefault(
+                        wrapper.name,
+                        {"count": 0, "total_s": 0.0, "max_s": 0.0})
+                    st["count"] += 1
+                    st["total_s"] += dur
+                    st["max_s"] = max(st["max_s"], dur)
+                return
+
+    def note_host_sync(self):
+        held = self._held()
+        flagged = [h for h in held
+                   if h.wrapper.name not in self.allow_host_sync_under]
+        if flagged:
+            self.violations.append({
+                "kind": "host-sync-under-lock",
+                "locks": [h.wrapper.name for h in flagged],
+                "thread": threading.current_thread().name,
+                "witness_forward": (list(flagged[0].stack),
+                                    _stack_summary(skip=3)),
+                "witness_backward": None,
+            })
+
+    def self_deadlock(self, wrapper):
+        self.violations.append({
+            "kind": "self-deadlock",
+            "locks": [wrapper.name],
+            "thread": threading.current_thread().name,
+            "witness_forward": (list(self._owner_stack(wrapper)),
+                                _stack_summary(skip=3)),
+            "witness_backward": None,
+        })
+
+    def _owner_stack(self, wrapper) -> List[str]:
+        for h in self._held():
+            if h.wrapper is wrapper:
+                return h.stack
+        return []
+
+    # -------------------------------------------------------- export
+    def graph(self) -> dict:
+        with self._mu:
+            edges = sorted((s, d, e["bounded_only"])
+                           for (s, d), e in self._edges.items())
+        nodes = sorted({n for s, d, _ in edges for n in (s, d)}
+                       | set(self.hold_stats))
+        return {
+            "version": 1,
+            "nodes": nodes,
+            "edges": [{"src": s, "dst": d, "bounded": b}
+                      for (s, d, b) in edges],
+        }
+
+    def gap_report(self, static: dict) -> List[Tuple[str, str]]:
+        """Observed edges absent from the static graph — each one is
+        an analyzer blind spot.  Compared name-level, direction-aware;
+        the static ``bounded`` flag is ignored (a static bounded edge
+        still proves the analyzer saw the ordering)."""
+        static_edges = {(e["src"], e["dst"])
+                        for e in static.get("edges", [])}
+        gaps = []
+        for e in self.graph()["edges"]:
+            if (e["src"], e["dst"]) not in static_edges:
+                gaps.append((e["src"], e["dst"]))
+        return gaps
+
+
+# ------------------------------------------------------------ wrappers
+class _LockWrapper:
+    """Wraps Lock/RLock.  Reentrant bookkeeping is tracked here so the
+    checker's held-stack holds each instance at most once per thread."""
+
+    def __init__(self, inner, name: str, kind: str,
+                 checker: LockChecker):
+        self._inner = inner
+        self.name = name
+        self.kind = kind            # "Lock" | "RLock"
+        self._checker = checker
+        self._tls = threading.local()
+
+    # depth of this thread's ownership (RLock reentrancy)
+    def _depth(self) -> int:
+        return getattr(self._tls, "depth", 0)
+
+    def _set_depth(self, n: int):
+        self._tls.depth = n
+
+    @staticmethod
+    def _bounded(blocking=True, timeout=-1) -> bool:
+        return (blocking is False) or (timeout is not None
+                                       and timeout >= 0)
+
+    def acquire(self, blocking=True, timeout=-1):
+        bounded = self._bounded(blocking, timeout)
+        depth = self._depth()
+        if depth > 0:
+            if self.kind == "Lock":
+                # a plain Lock re-acquired by its owner never returns:
+                # surface the bug instead of hanging the suite.
+                self._checker.self_deadlock(self)
+                raise RuntimeError(
+                    f"lockcheck: non-reentrant {self.name} "
+                    f"re-acquired by owning thread")
+            ok = self._inner.acquire(blocking, timeout) \
+                if bounded else self._inner.acquire()
+            if ok:
+                self._set_depth(depth + 1)
+            return ok
+        ok = self._inner.acquire(blocking, timeout) \
+            if bounded else self._inner.acquire()
+        if ok:
+            self._set_depth(1)
+            self._checker._record_acquired(self, bounded)
+        return ok
+
+    def release(self):
+        depth = self._depth()
+        self._inner.release()
+        if depth <= 1:
+            self._set_depth(0)
+            self._checker._record_released(self)
+        else:
+            self._set_depth(depth - 1)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked() \
+            if hasattr(self._inner, "locked") else False
+
+    # --- Condition integration (threading.Condition probes these) ---
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        return self._depth() > 0
+
+    def _release_save(self):
+        depth = self._depth()
+        if hasattr(self._inner, "_release_save"):
+            token = self._inner._release_save()
+        else:
+            self._inner.release()
+            token = None
+        self._set_depth(0)
+        self._checker._record_released(self)
+        return (token, depth)
+
+    def _acquire_restore(self, state):
+        token, depth = state
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(token)
+        else:
+            self._inner.acquire()
+        self._set_depth(depth)
+        # restore is a re-entry to a previously-held state, not a
+        # fresh ordering decision: keep hold-time bookkeeping but do
+        # not record new edges.
+        self._checker._held().append(
+            _Held(self, False, time.monotonic(), _stack_summary()))
+
+
+def _wrap_factory(checker: LockChecker, orig, kind: str):
+    def factory(*a, **kw):
+        inner = orig(*a, **kw)
+        name = checker._name_from_site()
+        if name is None:
+            return inner
+        w = _LockWrapper(inner, name, kind, checker)
+        checker._wrappers.append(w)
+        return w
+    return factory
+
+
+def _wrap_condition_factory(checker: LockChecker, orig_cond,
+                            orig_rlock):
+    def factory(lock=None):
+        if lock is not None:
+            return orig_cond(lock)
+        name = checker._name_from_site()
+        if name is None:
+            return orig_cond()
+        inner = _LockWrapper(orig_rlock(), name, "RLock", checker)
+        checker._wrappers.append(inner)
+        return orig_cond(inner)
+    return factory
+
+
+@contextmanager
+def instrument_locks(paths: Optional[List[str]] = None,
+                     allow_host_sync_under=DEFAULT_ALLOW_HOST_SYNC):
+    """Instrument serving-plane lock construction for the duration of
+    the ``with`` block; yields the ``LockChecker``.
+
+    ``paths`` limits wrapping to locks constructed by files under the
+    given directories (default: the ``paddle_infer_tpu`` package).
+    """
+    checker = LockChecker(paths, allow_host_sync_under)
+    orig_lock = threading.Lock
+    orig_rlock = threading.RLock
+    orig_cond = threading.Condition
+    checker._orig_lock = orig_lock
+    checker._mu = orig_lock()
+    threading.Lock = _wrap_factory(checker, orig_lock, "Lock")
+    threading.RLock = _wrap_factory(checker, orig_rlock, "RLock")
+    threading.Condition = _wrap_condition_factory(
+        checker, orig_cond, orig_rlock)
+    jax_mod = sys.modules.get("jax")
+    orig_bur = getattr(jax_mod, "block_until_ready", None) \
+        if jax_mod is not None else None
+    if orig_bur is not None:
+        def traced_bur(x):
+            checker.note_host_sync()
+            return orig_bur(x)
+        jax_mod.block_until_ready = traced_bur
+    try:
+        yield checker
+    finally:
+        threading.Lock = orig_lock
+        threading.RLock = orig_rlock
+        threading.Condition = orig_cond
+        if orig_bur is not None:
+            jax_mod.block_until_ready = orig_bur

@@ -12,6 +12,7 @@ from ..core import Rule
 from .donation import DonationRule
 from .host_sync import HostSyncRule
 from .lock_discipline import LockDisciplineRule
+from .lock_order import LockOrderRule
 from .metric_sync import MetricSyncRule
 from .pallas_grid import PallasGridRule
 from .recompile_hazard import RecompileHazardRule
@@ -27,6 +28,7 @@ RULE_CLASSES = [
     DonationRule,
     MetricSyncRule,
     PallasGridRule,
+    LockOrderRule,
 ]
 
 
@@ -47,5 +49,6 @@ def all_rules(only=None) -> List[Rule]:
 
 
 __all__ = ["RULE_CLASSES", "all_rules", "DonationRule", "HostSyncRule",
-           "LockDisciplineRule", "MetricSyncRule", "PallasGridRule",
-           "RecompileHazardRule", "TracedBranchRule", "TracerLeakRule"]
+           "LockDisciplineRule", "LockOrderRule", "MetricSyncRule",
+           "PallasGridRule", "RecompileHazardRule", "TracedBranchRule",
+           "TracerLeakRule"]

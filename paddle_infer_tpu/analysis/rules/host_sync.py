@@ -12,8 +12,8 @@ owns a scheduler entry point (``run_once`` / ``step`` /
 ``_decode_step``), the rule BFS-walks ``self.<method>`` calls (and
 property reads) to the full set of hot methods, then flags sync
 constructs inside them.  Intentional chunk-boundary syncs stay, with a
-``# tpulint: disable=host-sync`` comment saying why — the suppression
-is the documentation.
+``# tpulint: disable=host-sync -- <why>`` comment — the reason is
+mandatory, and the suppression is the documentation.
 
 Eager collectives count too: a ``parallel.collective.all_reduce`` (or
 any sibling from that module) issued from host serving code dispatches

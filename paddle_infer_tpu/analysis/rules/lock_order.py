@@ -1,0 +1,147 @@
+"""lock-order: whole-program lock-order graph over the serving plane.
+
+Built on ``analysis.interproc``: every function is walked
+interprocedurally with the set of held locks (receiver-resolved, so two
+replicas' ``_step_lock`` are distinct instances), producing the static
+lock-order graph.  Three finding shapes come out of it:
+
+  * **cycle** — a strongly-connected component of *unbounded* acquire
+    edges (``A held -> acquire B`` and somewhere ``B held -> acquire
+    A``), including the single-node case of acquiring a DIFFERENT
+    instance of the lock you already hold (two replicas handing off to
+    each other).  Bounded acquires (``acquire(timeout=...)``) back off
+    instead of deadlocking, so they never participate.
+  * **blocking-under-lock** — device dispatch / ``block_until_ready``
+    under a lock that is not a configured dispatch lock, unbounded
+    ``join()`` / ``queue.get()`` / ``wait()`` / raw ``acquire()`` or a
+    ``sleep`` while any lock is held.
+  * **non-reentrant re-acquire** — taking a plain ``Lock`` the current
+    thread already holds: a guaranteed self-deadlock.
+
+Findings carry a call-path witness (``file:line in qualname`` frames)
+so the report explains HOW the analyzer got the lock held, not just
+where the acquire is.  Scope: findings are emitted only for files under
+``serving/`` (the threaded plane); the graph itself spans the project
+and is exported via ``tools/tpulint.py --lock-graph``.
+
+Config keys (``ProjectContext.config``):
+  * ``lock_order.dispatch_locks`` — locks allowed to cover dispatch /
+    host sync (default: ``EngineCore._step_lock``, which serializes
+    whole scheduler steps BY DESIGN).
+  * ``lock_order.dispatch_calls`` — call names counted as device
+    dispatch (default: ``run_paged_program``).
+  * ``lock_order.type_hints`` — ``"Class.attr" -> "Type"`` for seams
+    annotations can't express (default: ``EngineCore._recovery`` is an
+    ``EngineSupervisor``).
+  * ``lock_order.alias_rules`` — receiver-chain rewrites encoding
+    object-identity facts (default: ``X._recovery._core == X``,
+    ``X.supervisor._core == X.core``).
+"""
+from __future__ import annotations
+
+import re
+from typing import Iterable, List, Optional, Tuple
+
+from ..core import Finding, ProjectContext, Rule
+from ..interproc import (DEFAULT_DISPATCH_LOCKS, LockGraph,
+                         ProjectIndex, LockWalk)
+
+_FRAME_RE = re.compile(r"^(?P<path>.+?):(?P<line>\d+) in (?P<sym>.+)$")
+
+_SCOPE = "serving/"
+
+
+def _frame_loc(frame: str) -> Tuple[str, int, str]:
+    m = _FRAME_RE.match(frame)
+    if m is None:
+        return ("", 1, "")
+    return (m.group("path"), int(m.group("line")), m.group("sym"))
+
+
+def _witness_text(witness: List[str], limit: int = 6) -> str:
+    frames = witness[-limit:]
+    return " -> ".join(frames)
+
+
+class LockOrderRule(Rule):
+    id = "lock-order"
+    name = "lock-order graph / blocking-under-lock"
+    rationale = (
+        "Threaded serving code must acquire locks in a consistent "
+        "global order and never block indefinitely while holding one; "
+        "cycles in the cross-file lock-order graph are potential "
+        "deadlocks and blocking calls under a lock stall every thread "
+        "behind it.")
+    # finalize-only rule; scope filtering happens on finding paths.
+    path_scope = ()
+
+    def __init__(self):
+        self.graph: Optional[LockGraph] = None
+        self.index: Optional[ProjectIndex] = None
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        cfg = project.config
+        self.index = ProjectIndex(project.files, cfg)
+        walk = LockWalk(
+            self.index,
+            set(cfg.get("lock_order.dispatch_locks",
+                        DEFAULT_DISPATCH_LOCKS)))
+        self.graph = walk.run()
+        out: List[Finding] = []
+        out.extend(self._cycle_findings(self.graph))
+        out.extend(self._blocking_findings(self.graph))
+        out.extend(self._reacquire_findings(self.graph))
+        return out
+
+    # ------------------------------------------------------- shaping
+    def _cycle_findings(self, graph: LockGraph) -> List[Finding]:
+        out: List[Finding] = []
+        for cyc in graph.cycles():
+            edges = cyc["edges"]
+            if not edges:
+                continue
+            anchor = None
+            for e in edges:
+                if e["witness"]:
+                    path, line, sym = _frame_loc(e["witness"][-1])
+                    if _SCOPE in path:
+                        anchor = (path, line, sym, e)
+                        break
+            if anchor is None:
+                continue
+            path, line, sym, e = anchor
+            ring = " <-> ".join(cyc["nodes"])
+            msg = (f"lock-order cycle: {ring}; e.g. {e['src']} held "
+                   f"while acquiring {e['dst']} "
+                   f"(witness: {_witness_text(e['witness'])})")
+            out.append(Finding(self.id, path, line, 1, msg, sym))
+        return out
+
+    def _blocking_findings(self, graph: LockGraph) -> List[Finding]:
+        out: List[Finding] = []
+        for b in graph.blocking:
+            if _SCOPE not in b["path"]:
+                continue
+            locks = ", ".join(b["locks"])
+            msg = (f"blocking call ({b['kind']}) while holding "
+                   f"{locks} (witness: {_witness_text(b['witness'])})")
+            out.append(Finding(self.id, b["path"], b["line"], 1, msg,
+                               b["symbol"]))
+        return out
+
+    def _reacquire_findings(self, graph: LockGraph) -> List[Finding]:
+        out: List[Finding] = []
+        seen = set()
+        for r in graph.reacquires:
+            if _SCOPE not in r["path"]:
+                continue
+            key = (r["path"], r["line"], r["lock"])
+            if key in seen:
+                continue
+            seen.add(key)
+            msg = (f"re-acquiring non-reentrant Lock {r['lock']} "
+                   f"already held by this thread: guaranteed deadlock "
+                   f"(witness: {_witness_text(r['witness'])})")
+            out.append(Finding(self.id, r["path"], r["line"], 1, msg,
+                               r["symbol"]))
+        return out

@@ -26,7 +26,8 @@ takes a shape-valued parameter (``plen`` / ``batch`` / ``chunk``)
 closes one executable over every distinct value — the per-shape program
 family the ragged mixed step exists to collapse.  Legacy builders that
 are deliberately kept (behind ``ragged=False``) carry a reasoned
-``# tpulint: disable-next-line=recompile-hazard`` suppression.
+``# tpulint: disable-next-line=recompile-hazard -- <why>``
+suppression.
 """
 from __future__ import annotations
 

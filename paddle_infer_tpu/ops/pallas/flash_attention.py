@@ -613,7 +613,7 @@ def _tune_run(kernel, b, h, sq, sk, d, dtype, causal, segmented,
 
     # bounded key: str(dtype) ranges over jnp's closed dtype set, and
     # this caches autotune dummy operands, not compiled executables
-    # tpulint: disable-next-line=recompile-hazard
+    # tpulint: disable-next-line=recompile-hazard -- bounded key over jnp's closed dtype set; caches autotune operands, not executables
     key = (b, h, sq, sk, d, str(dtype), segmented)
     ops = _TUNE_OPERANDS.get(key)
     if ops is None:

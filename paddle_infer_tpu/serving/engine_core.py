@@ -307,6 +307,20 @@ class EngineCore:
         with self._step_lock:
             return sum(s is not None for s in self._slots)
 
+    def approx_active_count(self) -> int:
+        """Lock-free occupancy estimate for CROSS-core readers.  The
+        fleet's handoff paths run on one core's stepping thread while
+        scanning OTHER cores as candidates; taking each candidate's
+        step lock there (as the exact ``active_count`` property does)
+        makes two cores handing off to each other acquire each other's
+        step locks — a lock-order cycle.  Slot-list reads are atomic
+        under the GIL; a one-step-stale count only mis-ranks a
+        candidate, which the bounded destination-lock acquire already
+        tolerates."""
+        # tpulint: disable-next-line=lock-discipline -- lock-free by design: cross-core readers on the handoff path must not take another core's step lock (lock-order cycle); staleness only mis-ranks a candidate
+        slots = self._slots
+        return sum(s is not None for s in list(slots))
+
     @property
     def prefix_cache(self) -> Optional[PrefixCache]:
         return self._prefix_cache
@@ -782,7 +796,7 @@ class EngineCore:
         already = req.emitted
         # req.tokens is a host-side list — no device readback here
         full = (req.prompt if already == 0 else np.concatenate(
-            # tpulint: disable-next-line=host-sync
+            # tpulint: disable-next-line=host-sync -- host-side prompt/token-history assembly; req.tokens are already-emitted Python ints, not device arrays
             [req.prompt, np.asarray(req.tokens, np.int32)]))
         length = int(full.size)
         budget = g.max_new_tokens - already
@@ -826,9 +840,9 @@ class EngineCore:
         t = self._pool.block_table(sid)[:self._max_pages]
         # intentional host work at admission: the block table and the
         # per-request fold_in key are tiny, fetched once per admit
-        # tpulint: disable-next-line=host-sync
+        # tpulint: disable-next-line=host-sync -- host-side page-table/cache-key staging buffer, built before dispatch
         table[:len(t)] = np.asarray(t, np.int32)
-        # tpulint: disable-next-line=host-sync
+        # tpulint: disable-next-line=host-sync -- host-side page-table/cache-key staging buffer, built before dispatch
         key = np.asarray(
             jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))
         if self._ragged:
@@ -865,7 +879,7 @@ class EngineCore:
                 "table": table, "key": key, "match": match,
                 "span_end": prefill_t, "full": full,
                 # host-side numpy slice of the staged prompt, no device sync
-                # tpulint: disable-next-line=host-sync
+                # tpulint: disable-next-line=host-sync -- host-side prompt/token-history assembly; req.tokens are already-emitted Python ints, not device arrays
                 "pending": np.asarray(full[cached:], np.int32),
                 "ctx": int(cached)}
             return
@@ -916,9 +930,9 @@ class EngineCore:
             return
         # the intentional once-per-admission sync: the first token and
         # finish flag drive host-side slot bookkeeping
-        # tpulint: disable-next-line=host-sync
+        # tpulint: disable-next-line=host-sync -- the sampled step output must reach Python for emission; this is the deliberate per-step sync point
         tok = int(np.asarray(tok)[0])
-        # tpulint: disable-next-line=host-sync
+        # tpulint: disable-next-line=host-sync -- the sampled step output must reach Python for emission; this is the deliberate per-step sync point
         finished = bool(np.asarray(fin)[0])
         t_sync = time.monotonic()
         req._mark_active()
@@ -958,7 +972,7 @@ class EngineCore:
             self._release_slot_kv(
                 sid, match, retain_tokens=np.concatenate(
                     # req.tokens is a host-side list — no readback
-                    # tpulint: disable-next-line=host-sync
+                    # tpulint: disable-next-line=host-sync -- host-side prompt/token-history assembly; req.tokens are already-emitted Python ints, not device arrays
                     [req.prompt, np.asarray(req.tokens[:-1], np.int32)]),
                 salt=req.cache_salt)
             req._finish(RequestState.DONE)
@@ -1078,7 +1092,7 @@ class EngineCore:
                     # never written until its decode step runs)
                     retain = np.concatenate(
                         # req.tokens is a host-side list — no readback
-                        # tpulint: disable-next-line=host-sync
+                        # tpulint: disable-next-line=host-sync -- host-side prompt/token-history assembly; req.tokens are already-emitted Python ints, not device arrays
                         [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
             self._release_slot_kv(s["sid"], s.get("match"),
                                   retain_tokens=retain,
@@ -1181,9 +1195,9 @@ class EngineCore:
                 # host-side history (prompt + delivered tokens) feeds
                 # the draft source; req.tokens is a host list
                 tok_hist = req.tokens
-                # tpulint: disable-next-line=host-sync
+                # tpulint: disable-next-line=host-sync -- host-side prompt/token-history assembly; req.tokens are already-emitted Python ints, not device arrays
                 history = np.concatenate(
-                    # tpulint: disable-next-line=host-sync
+                    # tpulint: disable-next-line=host-sync -- host-side prompt/token-history assembly; req.tokens are already-emitted Python ints, not device arrays
                     [req.prompt, np.asarray(tok_hist, np.int32)])
                 proposal = self._draft_source.propose(
                     history, k_cap, salt=req.cache_salt,
@@ -1192,7 +1206,7 @@ class EngineCore:
                 if k_row <= 0:
                     continue
                 # proposals are host ints from the draft source
-                # tpulint: disable-next-line=host-sync
+                # tpulint: disable-next-line=host-sync -- speculative scratch readback at the verification boundary; verification is a host decision
                 ids[i, 1:1 + k_row] = np.asarray(proposal[:k_row],
                                                  np.int32)
                 qlens[i] = 1 + k_row
@@ -1224,7 +1238,7 @@ class EngineCore:
                     ids, qlens, ctx, steps0, sample_now, spec, tables,
                     self._samp_arrays(cfgs), keys,
                     # scratch page id is a host int, no device sync
-                    # tpulint: disable-next-line=host-sync
+                    # tpulint: disable-next-line=host-sync -- speculative scratch readback at the verification boundary; verification is a host decision
                     np.asarray(self._scratch, np.int32))
             else:
                 tok, fin_out = eng.run_paged_program(
@@ -1233,7 +1247,7 @@ class EngineCore:
                     ids, qlens, ctx, steps0, sample_now, tables,
                     self._samp_arrays(cfgs), keys,
                     # scratch page id is a host int, no device sync
-                    # tpulint: disable-next-line=host-sync
+                    # tpulint: disable-next-line=host-sync -- speculative scratch readback at the verification boundary; verification is a host decision
                     np.asarray(self._scratch, np.int32))
         except Exception as e:
             self._metrics.on_failed(0)
@@ -1273,12 +1287,12 @@ class EngineCore:
             get_compile_log().mark_warm("serving-decode", mkey)
             self._decode_warm = True
         # the one designed sync per step
-        # tpulint: disable-next-line=host-sync
+        # tpulint: disable-next-line=host-sync -- the sampled step output must reach Python for emission; this is the deliberate per-step sync point
         tok = np.asarray(tok)
-        # tpulint: disable-next-line=host-sync
+        # tpulint: disable-next-line=host-sync -- the sampled step output must reach Python for emission; this is the deliberate per-step sync point
         fin_out = np.asarray(fin_out)
         if n_emit is not None:
-            # tpulint: disable-next-line=host-sync
+            # tpulint: disable-next-line=host-sync -- the sampled step output must reach Python for emission; this is the deliberate per-step sync point
             n_emit = np.asarray(n_emit)
         t_sync = time.monotonic()
         resident = self._used_pages()
@@ -1318,7 +1332,7 @@ class EngineCore:
                 # speculative step: row i emits its accepted window
                 # prefix (always >= 1 token when it sampled) — the one
                 # intended host readback of this step's tokens
-                # tpulint: disable-next-line=host-sync
+                # tpulint: disable-next-line=host-sync -- the sampled step output must reach Python for emission; this is the deliberate per-step sync point
                 t_row = np.asarray(tok[i, :int(n_emit[i])], np.int32)
             bad = t_row.size > 0 and int(t_row.min()) < 0
             if req.rid in poisoned or (sampled and bad):
@@ -1503,11 +1517,11 @@ class EngineCore:
             self._decode_warm = True
         # the one designed sync per fused chunk: the whole chunk's
         # tokens/finish/valid-counts come back in a single readback
-        # tpulint: disable-next-line=host-sync
+        # tpulint: disable-next-line=host-sync -- the sampled step output must reach Python for emission; this is the deliberate per-step sync point
         toks = np.asarray(toks)
-        # tpulint: disable-next-line=host-sync
+        # tpulint: disable-next-line=host-sync -- the sampled step output must reach Python for emission; this is the deliberate per-step sync point
         fin_out = np.asarray(fin_out)
-        # tpulint: disable-next-line=host-sync
+        # tpulint: disable-next-line=host-sync -- the sampled step output must reach Python for emission; this is the deliberate per-step sync point
         nvalid = np.asarray(nvalid)
         t_sync = time.monotonic()
         # capture the step's page view BEFORE evictions free anything —
@@ -1522,7 +1536,7 @@ class EngineCore:
             # categorical over all-masked logits returns — the row
             # validity check below then quarantines them.  ``toks`` was
             # already read back above; this copy is host-only.
-            # tpulint: disable-next-line=host-sync
+            # tpulint: disable-next-line=host-sync -- the sampled step output must reach Python for emission; this is the deliberate per-step sync point
             toks = np.array(toks)
             bad = fault["nan_rids"]
             for s in active:
@@ -1607,7 +1621,7 @@ class EngineCore:
             # req.tokens is a host-side list — no device readback here
             retain = np.concatenate(
                 [req.prompt,
-                 # tpulint: disable-next-line=host-sync
+                 # tpulint: disable-next-line=host-sync -- host-side prompt/token-history assembly; req.tokens are already-emitted Python ints, not device arrays
                  np.asarray(req.tokens[:-1], np.int32)])
         try:
             pages = len(self._pool.block_table(slot["sid"]))
@@ -1710,7 +1724,7 @@ class EngineCore:
                 kv_len = int(s["length"]) + int(s["emitted"]) - 1
                 kv_tokens = np.concatenate(
                     # req.tokens is a host-side list — no device readback
-                    # tpulint: disable-next-line=host-sync
+                    # tpulint: disable-next-line=host-sync -- host-side prompt/token-history assembly; req.tokens are already-emitted Python ints, not device arrays
                     [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
             n_pages = -(-kv_len // page) if kv_len > 0 else 0
             blocks = np.asarray(
@@ -1725,10 +1739,10 @@ class EngineCore:
             def gather(pages):
                 if isinstance(pages, tuple):
                     payload, scales = pages
-                    # tpulint: disable-next-line=host-sync
+                    # tpulint: disable-next-line=host-sync -- handoff export serializes KV to host bytes; the request is off the hot path by definition
                     return (np.asarray(payload[blocks]),
                             np.asarray(scales[blocks]))
-                # tpulint: disable-next-line=host-sync
+                # tpulint: disable-next-line=host-sync -- handoff export serializes KV to host bytes; the request is off the hot path by definition
                 return np.asarray(pages[blocks])
 
             k_host = [gather(kp) for kp in k_pages]
@@ -1834,7 +1848,7 @@ class EngineCore:
             table = np.full((self._max_pages,), self._scratch, np.int32)
             t = self._pool.block_table(sid)[:self._max_pages]
             # host-side table/key bookkeeping, once per import
-            # tpulint: disable-next-line=host-sync
+            # tpulint: disable-next-line=host-sync -- host-side page-table/cache-key staging buffer, built before dispatch
             table[:len(t)] = np.asarray(t, np.int32)
             if n_pages:
                 dst = table[:n_pages]
@@ -1855,7 +1869,7 @@ class EngineCore:
                                 in zip(k_pages, packet["k_host"])]
                 eng._v_pages = [scatter(vp, h) for vp, h
                                 in zip(v_pages, packet["v_host"])]
-            # tpulint: disable-next-line=host-sync
+            # tpulint: disable-next-line=host-sync -- host-side page-table/cache-key staging buffer, built before dispatch
             key = np.asarray(
                 jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))
             now = time.monotonic()
@@ -1973,7 +1987,7 @@ class EngineCore:
                 "serving engine closed (scheduler wedged)"))
             self._trace_queue_drop(r, RequestState.REJECTED,
                                    "engine-closed")
-        # tpulint: disable-next-line=lock-discipline
+        # tpulint: disable-next-line=lock-discipline -- close() escalation after a bounded step-lock acquire timed out: the stepping thread is wedged, last-resort cleanup reads slots lock-free on purpose
         for s in list(self._slots):
             if s is not None:
                 s["req"]._finish(RequestState.FAILED, RejectedError(

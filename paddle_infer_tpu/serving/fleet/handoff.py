@@ -17,15 +17,18 @@ are never lost, merely re-prefilled).
 from __future__ import annotations
 
 import logging
-from typing import Optional
+from typing import Optional, TYPE_CHECKING
 
 from ..request import HandoffError, Request
 from .roles import ReplicaHandle
 
+if TYPE_CHECKING:   # pragma: no cover - typing only
+    from ..engine_core import EngineCore
+
 _log = logging.getLogger(__name__)
 
 
-def ready_for_handoff(core, req: Request) -> bool:
+def ready_for_handoff(core: "EngineCore", req: Request) -> bool:
     """A request is a handoff candidate once its prompt is fully
     prefilled (the natural chunk boundary — the KV to move stops
     growing by whole chunks) and it still has decode budget left."""

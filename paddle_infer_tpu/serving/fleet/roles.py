@@ -23,7 +23,12 @@ from __future__ import annotations
 
 import enum
 import threading
-from typing import List, Optional
+from typing import List, Optional, TYPE_CHECKING
+
+if TYPE_CHECKING:   # pragma: no cover - typing only
+    from ..engine_core import EngineCore
+    from ..resilience.health import HealthMonitor
+    from ..resilience.supervisor import EngineSupervisor
 
 
 class ReplicaRole(enum.Enum):
@@ -57,12 +62,14 @@ class ReplicaHandle:
     handle also keeps the router-side dispatch counters that feed the
     least-predicted-load fallback and the ``router_*`` gauges."""
 
-    def __init__(self, name: str, core, role: ReplicaRole = ReplicaRole.MIXED,
-                 health=None, supervisor=None):
+    def __init__(self, name: str, core: "EngineCore",
+                 role: ReplicaRole = ReplicaRole.MIXED,
+                 health: Optional["HealthMonitor"] = None,
+                 supervisor: Optional["EngineSupervisor"] = None):
         from ..resilience.health import HealthMonitor
 
         self.name = str(name)
-        self.core = core
+        self.core: "EngineCore" = core
         self.supervisor = supervisor
         if health is None:
             health = (supervisor.health if supervisor is not None
@@ -117,9 +124,15 @@ class ReplicaHandle:
         router's load-balance fallback picks the minimum — predicted
         cost, not queue length, is what actually prices a long-prompt
         backlog correctly (ROADMAP: analytic first, learned model
-        later)."""
+        later).
+
+        Uses ``approx_active_count`` (lock-free): this runs on the
+        chunk-boundary handoff hook, i.e. on ANOTHER core's stepping
+        thread under that core's step lock — taking this core's step
+        lock there is the two-replica deadlock the lock-order rule
+        flags."""
         core = self.core
-        rows = core.active_count
+        rows = core.approx_active_count()
         queued = core.queue_depth
         model = core._cost_model
         pages = core._used_pages()

@@ -240,6 +240,8 @@ class FleetRouter:
                    for rid in list(self._want_handoff)
                    if rid in self._inflight]
         moved = False
+        req: Request
+        src: ReplicaHandle
         for rid, req, src in due:
             if req.done:
                 with self._lock:
@@ -252,11 +254,24 @@ class FleetRouter:
             # (RLock): the source's stepping thread holds this lock
             # nearly back-to-back, so a second acquisition can land
             # many steps later — or after the request finished, turning
-            # a due handoff into a silent miss
-            with src.core._step_lock:
+            # a due handoff into a silent miss.  BOTH step locks are
+            # taken with a bound: the tick thread ordering src-then-dst
+            # against stepping threads ordering own-then-other is a
+            # lock-order cycle, and a contended boundary just means the
+            # next tick retries the move.
+            if not src.core._step_lock.acquire(timeout=0.1):
+                continue
+            try:
                 if not ready_for_handoff(src.core, req):
                     continue
-                ok = migrate(req, src, dst)
+                if not dst.core._step_lock.acquire(timeout=0.1):
+                    continue
+                try:
+                    ok = migrate(req, src, dst)
+                finally:
+                    dst.core._step_lock.release()
+            finally:
+                src.core._step_lock.release()
             with self._lock:
                 self._want_handoff.pop(rid, None)
                 if ok:
@@ -267,9 +282,15 @@ class FleetRouter:
 
     def _handoff_target(self,
                         src: ReplicaHandle) -> Optional[ReplicaHandle]:
+        # approx_active_count / raw _effective_max_batch on purpose:
+        # this scan runs on src's stepping thread (boundary hook) under
+        # src's step lock — the exact, LOCKED ``active_count`` property
+        # here would acquire every candidate's step lock, and two cores
+        # hooking into each other at the same instant would deadlock.
         cands = [h for h in self._serving()
                  if h is not src and h.accepts_decode()
-                 and h.core.active_count < h.core._effective_max_batch]
+                 and h.core.approx_active_count()
+                 < h.core._effective_max_batch]
         if not cands:
             return None
         return min(cands, key=lambda h: h.predicted_load_bytes())

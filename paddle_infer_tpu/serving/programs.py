@@ -285,7 +285,7 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
 
 # legacy ragged=False path: one executable per plen bucket is the
 # pre-ragged contract, bounded by the bucketing in EngineCore._plen
-# tpulint: disable-next-line=recompile-hazard
+# tpulint: disable-next-line=recompile-hazard -- bounded family: one executable per plen bucket is the pre-ragged contract
 def build_prefill(engine, plen, max_pages):
     """Prefill one request (batch of 1) into its reserved pages and pick
     the first token.  ``run(params, ids[1,plen], lengths[1], steps0[1],
@@ -322,7 +322,7 @@ def build_prefill(engine, plen, max_pages):
 
 # legacy ragged=False path: the per-plen windowed family is kept as
 # the bitwise-parity anchor the ragged reference composes against
-# tpulint: disable-next-line=recompile-hazard
+# tpulint: disable-next-line=recompile-hazard -- bounded family: per-plen windowed executables are the bitwise-parity anchor
 def build_prefix_prefill(engine, plen, max_pages):
     """Windowed suffix prefill for prefix-cache hits: the row's first
     ``offsets[0]`` positions already hold cached KV (shared blocks mapped
@@ -392,7 +392,7 @@ def build_page_copy(engine):
 
 # legacy ragged=False path: batch/chunk are fixed core config here,
 # so the family stays a single executable per core
-# tpulint: disable-next-line=recompile-hazard
+# tpulint: disable-next-line=recompile-hazard -- batch/chunk are fixed core config, one executable per core
 def build_decode(engine, batch, chunk, max_pages):
     """One fused decode chunk over ALL batch rows: a ``lax.scan`` of
     ``chunk`` steps (amortizing host dispatch), each feeding every row's

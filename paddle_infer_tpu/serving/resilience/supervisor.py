@@ -35,15 +35,18 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Optional
+from typing import Optional, TYPE_CHECKING
 
 from .health import HealthMonitor, HealthState
+
+if TYPE_CHECKING:   # pragma: no cover - typing only
+    from ..engine_core import EngineCore
 
 
 class EngineSupervisor:
     """Supervises one ``EngineCore`` (see module docstring)."""
 
-    def __init__(self, core, watchdog_s: float = 5.0,
+    def __init__(self, core: "EngineCore", watchdog_s: float = 5.0,
                  max_retries: int = 2, crash_threshold: int = 5,
                  backoff_base_s: float = 0.05, backoff_cap_s: float = 2.0,
                  recover_after: int = 20, shed_headroom_s: float = 1.0,
@@ -73,7 +76,7 @@ class EngineSupervisor:
         core.attach_recovery(self)
 
     @property
-    def core(self):
+    def core(self) -> "EngineCore":
         return self._core
 
     # -------------------------------------------------- stepping + watchdog

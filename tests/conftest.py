@@ -30,6 +30,44 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from the tier-1 "
+        "gate (-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "lockcheck: spawns an instrumented-lock subprocess "
+        "run of the serving suites (see analysis/lockcheck.py)")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockcheck_session():
+    """PIT_LOCKCHECK=1 wraps the whole session in the runtime lock
+    checker: serving-plane locks constructed during the run are
+    instrumented, and at session end the run FAILS on any lock-order
+    inversion / self-deadlock / host-sync-under-lock, or on any
+    observed edge missing from the committed static lock graph
+    (tools/lock_graph_baseline.json) — dynamic must be a subset of
+    static, else the analyzer has a blind spot."""
+    if os.environ.get("PIT_LOCKCHECK") != "1":
+        yield
+        return
+    import json
+
+    from paddle_infer_tpu.analysis.lockcheck import instrument_locks
+
+    with instrument_locks() as chk:
+        yield
+    assert chk.violations == [], (
+        f"lockcheck violations: {json.dumps(chk.violations, indent=2)}")
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir, "tools", "lock_graph_baseline.json")
+    with open(base) as f:
+        static = json.load(f)
+    gaps = chk.gap_report(static)
+    assert gaps == [], (
+        f"dynamic lock edges missing from the static graph: {gaps}")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_infer_tpu as pit

@@ -6,6 +6,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(ROOT, "tools"))
 
@@ -152,7 +154,7 @@ def test_tpulint_repo_clean():
     rep = json.loads(r.stdout)
     assert rep["new"] == []
     assert rep["files"] > 100          # really walked the package
-    assert len(rep["rules"]) == 8
+    assert len(rep["rules"]) == 9
 
 
 def test_faultplane_sites_documented():
@@ -181,6 +183,69 @@ def test_tpulint_resilience_tree_clean():
     assert rep["new"] == []
     assert rep["baselined"] == []       # clean outright, not baselined
     assert rep["files"] >= 4            # __init__, faultplane, health, sup
+
+
+def test_tpulint_lock_graph_gate():
+    """The lock-graph gate: zero unsuppressed cycles, zero
+    blocking-under-lock over serving/, and a graph byte-identical to
+    the committed baseline (drift means a concurrency-relevant change
+    shipped without re-reviewing the lock order)."""
+    def run():
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+             "--lock-graph"], capture_output=True, text=True,
+            env=_env(), timeout=600)
+        return r, json.loads(r.stdout)
+
+    r, rep = run()
+    assert r.returncode == 0, r.stdout + r.stderr[-800:]
+    assert rep["exit"] == 0 and rep["drift"] == []
+    assert rep["findings"] == []
+    g = rep["graph"]
+    assert g["cycles"] == [] and g["blocking"] == []
+    # the graph is real: the step lock orders ahead of the leaf locks
+    edges = {(e["src"], e["dst"]) for e in g["edges"]}
+    assert ("EngineCore._step_lock", "ServingMetrics._lock") in edges
+    assert ("FleetRouter._lock", "ReplicaHandle._lock") in edges
+    # the cross-replica handoff ordering survives only as bounded
+    cross = [e for e in g["edges"]
+             if e["src"] == e["dst"] == "EngineCore._step_lock"]
+    assert cross and all(e["bounded"] and e["cross"] for e in cross)
+    # deterministic: two runs, identical graph JSON
+    _, rep2 = run()
+    assert json.dumps(rep2["graph"], sort_keys=True) \
+        == json.dumps(g, sort_keys=True)
+
+
+def test_tpulint_lock_graph_dot():
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+         "--lock-graph", "--dot"], capture_output=True, text=True,
+        env=_env(), timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr[-800:]
+    assert r.stdout.startswith("digraph")
+    assert "EngineCore._step_lock" in r.stdout
+
+
+@pytest.mark.slow
+@pytest.mark.lockcheck
+def test_serving_suites_instrumented_clean():
+    """The dynamic gate: the serving / fleet / resilience suites run
+    under the instrumented-lock checker (PIT_LOCKCHECK=1 arms the
+    session fixture in conftest.py) and must finish with zero
+    violations and every observed lock edge present in the static
+    graph."""
+    env = _env()
+    env["PIT_LOCKCHECK"] = "1"
+    r = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
+         "-p", "no:cacheprovider",
+         os.path.join(ROOT, "tests", "test_serving_engine.py"),
+         os.path.join(ROOT, "tests", "test_resilience.py"),
+         os.path.join(ROOT, "tests", "test_fleet.py")],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+        timeout=3000)
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-800:]
 
 
 def test_tpulint_baseline_update_deterministic(tmp_path):

@@ -118,7 +118,7 @@ def test_host_sync_collective_suppressible(tmp_path):
     src = HOT_COLLECTIVE.replace(
         "return collective.all_reduce(self._pool)",
         "return collective.all_reduce(self._pool)  "
-        "# tpulint: disable=host-sync")
+        "# tpulint: disable=host-sync -- chunk-boundary merge")
     assert run_rules(tmp_path, src, ["host-sync"]) == []
 
 
@@ -175,8 +175,7 @@ def test_recompile_hazard_silent_on_composition_keyed_builder(tmp_path):
 
 def test_recompile_hazard_builder_suppressible(tmp_path):
     src = """
-        # legacy per-shape family kept behind ragged=False
-        # tpulint: disable-next-line=recompile-hazard
+        # tpulint: disable-next-line=recompile-hazard -- legacy family kept behind ragged=False
         def build_decode(engine, batch, chunk):
             return engine.compile(batch, chunk)
     """
@@ -573,14 +572,46 @@ def test_suppression_same_line_and_next_line(tmp_path):
     src = HOT_SYNC.replace(
         "toks = np.asarray(self._device_tokens())",
         "toks = np.asarray(self._device_tokens())  "
-        "# tpulint: disable=host-sync")
+        "# tpulint: disable=host-sync -- deliberate chunk readback")
     assert run_rules(tmp_path, src, ["host-sync"]) == []
 
     src = HOT_SYNC.replace(
         "toks = np.asarray(self._device_tokens())",
-        "# tpulint: disable-next-line=host-sync\n"
+        "# tpulint: disable-next-line=host-sync -- deliberate readback\n"
         "            toks = np.asarray(self._device_tokens())")
     assert run_rules(tmp_path, src, ["host-sync"]) == []
+
+
+def test_suppression_without_reason_is_flagged(tmp_path):
+    # a bare suppression still suppresses, but the analyzer reports it
+    # as a bare-suppression finding so undocumented opt-outs can't pile
+    # up silently
+    src = HOT_SYNC.replace(
+        "toks = np.asarray(self._device_tokens())",
+        "toks = np.asarray(self._device_tokens())  "
+        "# tpulint: disable=host-sync")
+    fs = run_rules(tmp_path, src, ["host-sync"])
+    assert [f.rule for f in fs] == ["bare-suppression"]
+    assert "has no reason" in fs[0].message
+    assert "host-sync" in fs[0].message
+
+
+def test_suppression_reason_survives_multi_rule_list(tmp_path):
+    # one reason covers the whole comma-list; none → one finding
+    # naming every listed rule
+    src = HOT_SYNC.replace(
+        "toks = np.asarray(self._device_tokens())",
+        "toks = np.asarray(self._device_tokens())  "
+        "# tpulint: disable=host-sync,metric-sync -- one sync per chunk")
+    assert run_rules(tmp_path, src, ["host-sync"]) == []
+
+    src = HOT_SYNC.replace(
+        "toks = np.asarray(self._device_tokens())",
+        "toks = np.asarray(self._device_tokens())  "
+        "# tpulint: disable=host-sync,metric-sync")
+    fs = run_rules(tmp_path, src, ["host-sync"])
+    assert [f.rule for f in fs] == ["bare-suppression"]
+    assert "host-sync,metric-sync" in fs[0].message
 
 
 def test_suppression_skip_file_and_unrelated_rule(tmp_path):
@@ -591,8 +622,9 @@ def test_suppression_skip_file_and_unrelated_rule(tmp_path):
     src = HOT_SYNC.replace(
         "toks = np.asarray(self._device_tokens())",
         "toks = np.asarray(self._device_tokens())  "
-        "# tpulint: disable=pallas-grid")
-    assert len(run_rules(tmp_path, src, ["host-sync"])) == 1
+        "# tpulint: disable=pallas-grid -- unrelated")
+    fs = run_rules(tmp_path, src, ["host-sync"])
+    assert [f.rule for f in fs] == ["host-sync"]
 
 
 # -------------------------------------------------------------- baseline
@@ -662,5 +694,5 @@ def test_cli_list_rules_covers_registry():
     assert r.returncode == 0
     for rid in ("host-sync", "recompile-hazard", "lock-discipline",
                 "tracer-leak", "traced-branch", "missing-donation",
-                "metric-sync", "pallas-grid"):
+                "metric-sync", "pallas-grid", "lock-order"):
         assert rid in r.stdout

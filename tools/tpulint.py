@@ -9,6 +9,12 @@ Usage:
     python tools/tpulint.py --list-rules
     python tools/tpulint.py --baseline-update   # rewrite the baseline
                                                 # deterministically
+    python tools/tpulint.py --lock-graph        # whole-program lock-
+                                                # order graph (stable
+                                                # JSON), diffed against
+                                                # tools/lock_graph_baseline.json
+    python tools/tpulint.py --lock-graph --dot  # Graphviz view
+    python tools/tpulint.py --lock-graph-update # rewrite that baseline
 
 The analysis package is loaded straight from its files rather than
 through ``import paddle_infer_tpu`` — the parent package pulls in
@@ -68,6 +74,21 @@ def main(argv=None) -> int:
     ap.add_argument("--metric-docs", default=None,
                     help="override the metric-catalog document "
                     "(default: docs/OBSERVABILITY.md)")
+    ap.add_argument("--lock-graph", action="store_true",
+                    help="emit the whole-program lock-order graph "
+                    "(stable JSON) and diff it against the committed "
+                    "lock-graph baseline")
+    ap.add_argument("--lock-graph-baseline",
+                    default=os.path.join(ROOT, "tools",
+                                         "lock_graph_baseline.json"),
+                    help="lock-graph baseline file (default: "
+                    "tools/lock_graph_baseline.json)")
+    ap.add_argument("--lock-graph-update", action="store_true",
+                    help="rewrite the lock-graph baseline from the "
+                    "current graph")
+    ap.add_argument("--dot", action="store_true",
+                    help="with --lock-graph: emit Graphviz DOT "
+                    "instead of JSON (no baseline diff)")
     args = ap.parse_args(argv)
 
     an = _load_analysis()
@@ -77,6 +98,9 @@ def main(argv=None) -> int:
             print(f"{cls.id:18s} {cls.name}")
             print(f"{'':18s}   {cls.rationale}")
         return 0
+
+    if args.lock_graph or args.lock_graph_update:
+        return _lock_graph_mode(an, args)
 
     only = ([r.strip() for r in args.rules.split(",") if r.strip()]
             if args.rules else None)
@@ -120,6 +144,60 @@ def main(argv=None) -> int:
     print(f"tpulint: {n_files} files, {len(new)} finding"
           f"{'' if len(new) == 1 else 's'}{tail}")
     return 1 if new else 0
+
+
+def _lock_graph_mode(an, args) -> int:
+    """Run only the lock-order rule, export the graph, and (unless
+    updating or emitting DOT) diff the stable JSON against the
+    committed baseline.  Exit 1 on unsuppressed findings OR drift."""
+    rules = an.all_rules(["lock-order"])
+    analyzer = an.Analyzer(rules, root=ROOT, config={})
+    findings, n_files = analyzer.run(args.paths)
+    findings = [f for f in findings if f.rule == "lock-order"]
+    rule = rules[0]
+    graph = rule.graph
+    # json round-trip normalizes tuples to lists so the comparison
+    # against the loaded baseline is exact
+    stable = json.loads(json.dumps(graph.to_stable_dict(),
+                                   sort_keys=True))
+
+    if args.dot:
+        print(graph.to_dot())
+        return 0
+
+    if args.lock_graph_update:
+        with open(args.lock_graph_baseline, "w",
+                  encoding="utf-8") as f:
+            json.dump(stable, f, indent=2, sort_keys=True)
+            f.write("\n")
+        rel = os.path.relpath(args.lock_graph_baseline, ROOT)
+        print(f"tpulint: wrote lock graph ({len(stable['nodes'])} "
+              f"nodes, {len(stable['edges'])} edges, "
+              f"{len(stable['cycles'])} cycles, "
+              f"{len(stable['blocking'])} blocking) to {rel}")
+        return 0
+
+    drift = []
+    if os.path.exists(args.lock_graph_baseline):
+        with open(args.lock_graph_baseline, encoding="utf-8") as f:
+            committed = json.load(f)
+        if committed != stable:
+            drift.append("lock graph drifted from committed baseline "
+                         "(run --lock-graph-update and review)")
+    else:
+        drift.append(f"missing baseline "
+                     f"{os.path.relpath(args.lock_graph_baseline, ROOT)}"
+                     f" (run --lock-graph-update)")
+
+    report = {
+        "files": n_files,
+        "graph": stable,
+        "findings": [f.to_dict() for f in findings],
+        "drift": drift,
+        "exit": 1 if (findings or drift) else 0,
+    }
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return report["exit"]
 
 
 if __name__ == "__main__":
