@@ -628,6 +628,13 @@ def _child_main():
                                 lambda: _disaggregated_bench(on_tpu),
                                 tpu_only=False)
 
+    # expert-parallel MoE serving: dense vs MoE decode tok/s, ep=2
+    # stream parity, utilization skew, dispatch bytes exact vs
+    # int8-activation experts (subprocess: needs its own 2-virtual-
+    # device backend)
+    moe_serving = run_section("moe_serving", 500,
+                              _moe_serving_bench, tpu_only=False)
+
     result = {
         **headline,
         "tokens_per_sec_single_block": round(tokens_per_sec_single, 1),
@@ -690,6 +697,8 @@ def _child_main():
         result["sharded_serving"] = sharded_serving
     if disaggregated is not None:
         result["disaggregated"] = disaggregated
+    if moe_serving is not None:
+        result["moe_serving"] = moe_serving
     if skipped_sections:
         result["skipped_sections"] = skipped_sections
     result["child_wall_s"] = round(time.monotonic() - child_t0, 1)
@@ -1691,6 +1700,45 @@ def _sharded_serving_bench():
             return out
     tail = (proc.stderr.strip().splitlines() or ["no output"])[-1][:300]
     raise RuntimeError(f"sharded child rc={proc.returncode}: {tail}")
+
+
+def _moe_serving_bench():
+    """Expert-parallel MoE serving evidence (docs/SERVING.md 'MoE
+    serving'): decode tokens/s dense vs MoE and ep=1 vs ep=2 with
+    bitwise stream parity, expert utilization skew and dropped-token
+    ratio, per-step dispatch bytes with fp vs int8-activation experts,
+    and the weight-only expert dequant/logit error next to its analytic
+    bound.  Runs ``tools/bench_moe_child.py`` in a subprocess with two
+    forced CPU host devices (the ``sharded_serving`` pattern) because
+    this process's backend is already initialized single-device."""
+    env = os.environ.copy()
+    env.pop("PALLAS_AXON_POOL_IPS", None)      # axon shim hangs CPU
+    env.pop("PIT_BENCH_REQUIRE_TPU", None)
+    env.pop("PIT_BENCH_CHILD", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (flags
+                        + " --xla_force_host_platform_device_count=2") \
+        .strip()
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "tools", "bench_moe_child.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    for ln in reversed(proc.stdout.strip().splitlines()):
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                out = json.loads(ln)
+            except ValueError:
+                continue
+            if "error" in out:
+                raise RuntimeError(out["error"])
+            return out
+    tail = (proc.stderr.strip().splitlines() or ["no output"])[-1][:300]
+    raise RuntimeError(f"moe child rc={proc.returncode}: {tail}")
 
 
 def _disaggregated_bench(on_tpu: bool):

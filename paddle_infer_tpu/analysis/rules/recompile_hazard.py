@@ -40,6 +40,17 @@ from ..core import FileContext, Rule, dotted
 # construction and deliberately not flagged)
 _SHAPE_VALUED = frozenset({"plen", "batch", "chunk"})
 
+# serving-path builders additionally must not key on MoE routing sizes:
+# expert count and per-expert capacity are DEPLOYMENT config there (one
+# (E, C) per config, baked into the converted layers), so a build_*
+# signature taking them re-opens a per-routing-shape program family —
+# precisely what the static-capacity serving plane exists to prevent.
+# Scoped to serving/ because training-side builders legitimately
+# parameterize over experts.
+_MOE_SHAPE_VALUED = frozenset({"num_experts", "n_experts", "experts",
+                               "capacity", "expert_capacity",
+                               "moe_capacity"})
+
 
 def _element_label(el: ast.AST) -> str:
     if isinstance(el, ast.JoinedStr):
@@ -86,6 +97,17 @@ class RecompileHazardRule(Rule):
                 "composition-keyed executable (ragged mixed step) or "
                 "suppress with the reason the per-shape family must "
                 "stay")
+        if "serving" in ctx.relpath.replace("\\", "/").split("/"):
+            moe_hazards = [n for n in names if n in _MOE_SHAPE_VALUED]
+            if moe_hazards:
+                yield ctx.finding(
+                    self.id, node,
+                    f"MoE-shape-keyed serving builder {node.name}"
+                    f"({', '.join(moe_hazards)}) re-opens a per-"
+                    "routing-shape program family — expert count and "
+                    "capacity are deployment config: bake them into "
+                    "the converted layers (prepare_moe_serving) and "
+                    "key the ONE executable on the config tuple")
 
     def _check_assign(self, ctx: FileContext, node: ast.Assign):
         key_target = any(isinstance(t, ast.Name)

@@ -186,12 +186,13 @@ class GenerationEngine:
         as buffers, not Parameters — left out of the snapshot they would
         be traced as jit constants (re-uploaded per executable, invisible
         to refresh_params, unplaceable under a mesh)."""
-        from ..quantization.moe import WeightOnlyMoELayer
+        from ..quantization.moe import Int8MoELayer, WeightOnlyMoELayer
         from ..quantization.weight_only import WeightOnlyLinear
 
         out = {}
         for lname, layer in self._model.named_sublayers():
-            if isinstance(layer, (WeightOnlyLinear, WeightOnlyMoELayer)):
+            if isinstance(layer, (WeightOnlyLinear, WeightOnlyMoELayer,
+                                  Int8MoELayer)):
                 for bn, buf in layer.named_buffers(
                         prefix=lname, include_sublayers=False):
                     out[bn] = buf

@@ -188,6 +188,63 @@ def render_prometheus(snapshot: dict,
         w.sample("weight_only_hbm_traffic_ratio",
                  wo.get("hbm_traffic_ratio"))
 
+    moe = snapshot.get("moe") or {}
+    if moe:
+        w.family("moe_info", "gauge",
+                 "MoE serving plane config as labels (constant 1): "
+                 "expert count, routed top-k, gate kind, static "
+                 "per-expert capacity, ep degree, expert arithmetic")
+        w.sample("moe_info", 1, {
+            "experts": moe.get("num_experts", 0),
+            "top_k": moe.get("top_k", 0),
+            "gate": moe.get("gate", "?"),
+            "capacity": moe.get("capacity", 0),
+            "ep": moe.get("ep", 1),
+            "algo": moe.get("algo", "fp")})
+        w.family("moe_expert_hbm_bytes", "gauge",
+                 "Resident bytes of the stacked expert payloads across "
+                 "all MoE layers (what the ep axis shards)")
+        w.sample("moe_expert_hbm_bytes", moe.get("expert_hbm_bytes"))
+        w.family("moe_expert_tokens_total", "counter",
+                 "Valid token-expert assignments kept, by expert "
+                 "(summed over MoE layers)")
+        tokens = moe.get("expert_tokens") or []
+        if tokens:
+            for e, n in enumerate(tokens):
+                w.sample("moe_expert_tokens_total", n, {"expert": e})
+        else:
+            w.sample("moe_expert_tokens_total", 0, {"expert": "none"})
+        w.family("moe_tokens_routed_total", "counter",
+                 "Valid token-expert assignments kept across all "
+                 "experts")
+        w.sample("moe_tokens_routed_total", moe.get("tokens_routed", 0))
+        w.family("moe_tokens_dropped_total", "counter",
+                 "Valid assignments lost to capacity overflow (the "
+                 "quality signal behind --capacity_factor)")
+        w.sample("moe_tokens_dropped_total",
+                 moe.get("tokens_dropped", 0))
+        w.family("moe_dropped_ratio", "gauge",
+                 "dropped / (routed + dropped) over the process "
+                 "lifetime")
+        w.sample("moe_dropped_ratio", moe.get("dropped_ratio", 0.0))
+        w.family("moe_expert_utilization", "gauge",
+                 "Share of routed assignments each expert received")
+        util = moe.get("expert_utilization") or []
+        if util:
+            for e, u in enumerate(util):
+                w.sample("moe_expert_utilization", u, {"expert": e})
+        else:
+            w.sample("moe_expert_utilization", 0.0, {"expert": "none"})
+        w.family("moe_utilization_skew", "gauge",
+                 "max expert share x num_experts (1.0 = perfectly "
+                 "balanced, num_experts = total collapse)")
+        w.sample("moe_utilization_skew",
+                 moe.get("utilization_skew", 0.0))
+        w.family("moe_gate_aux_loss", "gauge",
+                 "Gate load-balance auxiliary loss from the most "
+                 "recent mixed step (mean across MoE layers)")
+        w.sample("moe_gate_aux_loss", moe.get("gate_aux_loss", 0.0))
+
     px = snapshot.get("prefix_cache") or {}
     if px:
         w.family("prefix_cache_queries_total", "counter",
@@ -387,6 +444,16 @@ def render_prometheus(snapshot: dict,
                  "recorded mixed steps")
         w.sample("steplog_draft_accepted_total",
                  sl.get("draft_accepted_total", 0))
+        w.family("steplog_moe_tokens_routed_total", "counter",
+                 "Valid token-expert assignments kept across recorded "
+                 "mixed steps (StepLog view of the MoE plane)")
+        w.sample("steplog_moe_tokens_routed_total",
+                 sl.get("moe_tokens_routed_total", 0))
+        w.family("steplog_moe_tokens_dropped_total", "counter",
+                 "Valid assignments lost to capacity overflow across "
+                 "recorded mixed steps")
+        w.sample("steplog_moe_tokens_dropped_total",
+                 sl.get("moe_tokens_dropped_total", 0))
         model = sl.get("decode_model") or {}
         w.family("steplog_model_abs_rel_error", "gauge",
                  "Mean absolute relative error of the fitted step-cost "
@@ -403,10 +470,11 @@ def render_prometheus(snapshot: dict,
         axes = sh.get("mesh_axes") or {}
         w.family("serving_mesh_info", "gauge",
                  "Serving mesh topology as labels (constant 1): "
-                 "mp/dp degrees, device count, quantized-allreduce "
+                 "mp/dp/ep degrees, device count, quantized-allreduce "
                  "wire format")
         w.sample("serving_mesh_info", 1, {
             "mp": axes.get("mp", 1), "dp": axes.get("dp", 1),
+            "ep": axes.get("ep", 1),
             "devices": sh.get("devices", 1),
             "quantized_allreduce": sh.get("quantized_allreduce") or "off"})
         w.family("serving_shard_sharded_params", "gauge",

@@ -82,6 +82,12 @@ _SCHEMA = (
     ("draft_tokens", 0),         # speculative draft tokens verified
     ("draft_accepted", 0),       # drafts accepted (extra tokens won)
     ("spec_rows", 0),            # rows that carried drafts this step
+    ("moe_tokens_routed", 0),    # valid token-expert assignments kept
+                                 # this step (summed over moe layers)
+    ("moe_tokens_dropped", 0),   # valid assignments lost to capacity
+                                 # overflow (NEVER silent)
+    ("moe_aux_loss", 0.0),       # gate load-balance aux loss (mean
+                                 # across moe layers)
 )
 SCHEMA_KEYS = tuple(k for k, _ in _SCHEMA)
 
@@ -145,6 +151,38 @@ class StepCostModel:
                 iter(engine._params.values())).dtype).itemsize)
         except Exception:
             self._act_itemsize = 4
+        # expert-parallel interconnect: each serving MoE layer moves its
+        # [E, C, d] dispatched buffer over the ep axis twice per step
+        # (dispatch + combine all-to-all), (ep-1)/ep of the payload
+        # leaving each rank.  Sized at construction — EngineCore builds
+        # the cost model after prepare_moe_serving, so the converted
+        # layers' static capacity is what gets priced.
+        self._moe_a2a = None
+        model = getattr(engine, "_model", None)
+        if model is not None:
+            try:
+                from ..serving.moe import ServingMoELayer
+                from ..serving.moe.layer import _algo_of
+
+                moes = [lay for _, lay in model.named_sublayers()
+                        if isinstance(lay, ServingMoELayer)]
+                if moes:
+                    ep = 1
+                    if mesh is not None:
+                        from ..parallel.topology import axis_if_divides
+
+                        if axis_if_divides(mesh, "ep",
+                                           moes[0].num_experts):
+                            ep = int(dict(mesh.shape).get("ep", 1))
+                    self._moe_a2a = {
+                        "layers": len(moes),
+                        "elems": int(moes[0].num_experts
+                                     * moes[0].capacity * self._hidden),
+                        "algo": _algo_of(moes[0].inner),
+                        "ep": ep,
+                    }
+            except Exception:
+                self._moe_a2a = None
 
     @property
     def page_kv_bytes(self) -> float:
@@ -175,24 +213,55 @@ class StepCostModel:
         The estimate is also fed into the collective-bytes ledger under
         op "mp_allreduce" — these reductions are GSPMD-inserted (or
         hidden inside the mp_quant_matmul shard_map), so no explicit
-        ``collective.*`` call ever accounts for them."""
-        if self._mp <= 1 or tokens is None or tokens <= 0:
+        ``collective.*`` call ever accounts for them.  Under expert
+        parallelism each serving MoE layer adds its dispatch + combine
+        all-to-alls (ledger op "ep_alltoall"): the payload is the fixed
+        [E, C, d] routing buffer, so the term is per-STEP, not
+        per-token — int8-activation experts move 1-byte dispatch
+        payloads and the fp-vs-int8 delta lands in ``saved``."""
+        if tokens is None or tokens <= 0:
             return 0.0, 0.0
         from ..parallel.collective import LEDGER, quantized_wire_bytes
 
-        n_elems = int(tokens) * self._hidden
-        per_reduce_q, per_reduce_fp = quantized_wire_bytes(
-            n_elems, self._mp, self._act_itemsize)
-        n_reduces = 2.0 * self._layers
-        if self._quant:
-            moved = n_reduces * per_reduce_q
-            saved = n_reduces * max(per_reduce_fp - per_reduce_q, 0.0)
-            LEDGER.record("mp_allreduce", "int8", moved, saved=saved)
-            return moved, saved
-        moved = n_reduces * per_reduce_fp
-        LEDGER.record("mp_allreduce", f"float{8 * self._act_itemsize}",
-                      moved)
-        return moved, 0.0
+        moved_total = 0.0
+        saved_total = 0.0
+        if self._mp > 1:
+            n_elems = int(tokens) * self._hidden
+            per_reduce_q, per_reduce_fp = quantized_wire_bytes(
+                n_elems, self._mp, self._act_itemsize)
+            n_reduces = 2.0 * self._layers
+            if self._quant:
+                moved = n_reduces * per_reduce_q
+                saved = n_reduces * max(per_reduce_fp - per_reduce_q,
+                                        0.0)
+                LEDGER.record("mp_allreduce", "int8", moved, saved=saved)
+            else:
+                moved = n_reduces * per_reduce_fp
+                saved = 0.0
+                LEDGER.record("mp_allreduce",
+                              f"float{8 * self._act_itemsize}", moved)
+            moved_total += moved
+            saved_total += saved
+        a2a = self._moe_a2a
+        if a2a is not None and a2a["ep"] > 1:
+            off_rank = a2a["elems"] * (a2a["ep"] - 1) / a2a["ep"]
+            fp_leg = off_rank * self._act_itemsize
+            if a2a["algo"] == "int8_act":
+                # dispatch leg carries the quantized buffer (1 byte per
+                # element); the combine leg returns fp expert outputs
+                per_layer = off_rank + fp_leg
+                saved = fp_leg - off_rank
+                dtype = "int8"
+            else:
+                per_layer = 2.0 * fp_leg
+                saved = 0.0
+                dtype = f"float{8 * self._act_itemsize}"
+            moved = per_layer * a2a["layers"]
+            saved = saved * a2a["layers"]
+            LEDGER.record("ep_alltoall", dtype, moved, saved=saved)
+            moved_total += moved
+            saved_total += saved
+        return moved_total, saved_total
 
     def static_cost(self, key) -> Optional[dict]:
         getter = getattr(self._engine, "program_cost", None)
@@ -310,6 +379,8 @@ class StepLog:
         self._chunk_tokens_total = 0
         self._draft_tokens_total = 0
         self._draft_accepted_total = 0
+        self._moe_routed_total = 0
+        self._moe_dropped_total = 0
         self._by_kernel: Dict[str, int] = {}
         # (bytes_est, wall_s) for clean decode chunks — the model fit
         self._model: deque = deque(maxlen=int(model_window))
@@ -340,6 +411,8 @@ class StepLog:
             self._chunk_tokens_total += int(rec["prefill_chunk_tokens"])
             self._draft_tokens_total += int(rec["draft_tokens"])
             self._draft_accepted_total += int(rec["draft_accepted"])
+            self._moe_routed_total += int(rec["moe_tokens_routed"])
+            self._moe_dropped_total += int(rec["moe_tokens_dropped"])
             if rec["kernel"]:
                 self._by_kernel[rec["kernel"]] = \
                     self._by_kernel.get(rec["kernel"], 0) + 1
@@ -383,6 +456,8 @@ class StepLog:
             self._chunk_tokens_total = 0
             self._draft_tokens_total = 0
             self._draft_accepted_total = 0
+            self._moe_routed_total = 0
+            self._moe_dropped_total = 0
             self._by_kernel = {}
 
     def summary(self) -> Dict:
@@ -402,6 +477,8 @@ class StepLog:
                 "prefill_chunk_tokens_total": self._chunk_tokens_total,
                 "draft_tokens_total": self._draft_tokens_total,
                 "draft_accepted_total": self._draft_accepted_total,
+                "moe_tokens_routed_total": self._moe_routed_total,
+                "moe_tokens_dropped_total": self._moe_dropped_total,
             }
         out["decode_model"] = _model_summary(pairs)
         return out

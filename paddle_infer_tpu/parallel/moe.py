@@ -50,12 +50,23 @@ def switch_gate(logits, capacity):
     (reference moe/gate/switch_gate.py).  logits [N, E] →
     (combine [N, E, C], dispatch bool [N, E, C], aux scalar)."""
     n, e = logits.shape
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    idx = jnp.argmax(probs, axis=-1)                       # [N]
+    lg = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(lg, axis=-1)
+    # route on the RAW logits: softmax is order-preserving in exact
+    # arithmetic, but its f32 rounding can collapse two distinct logits
+    # into equal probs — an argmax tie whose winner would then depend on
+    # the backend's reduction order.  The logits carry the unrounded
+    # preference, so the pick (and with it the cumsum position
+    # assignment and the capacity-overflow drop set) is stable across
+    # reruns, eager vs jit, and device counts.
+    idx = jnp.argmax(lg, axis=-1)                          # [N]
     gate = jnp.take_along_axis(probs, idx[:, None], axis=1)[:, 0]
     mask = _one_hot(idx, e)                                # [N, E]
-    # position of each token within its expert's buffer
-    pos = jnp.cumsum(mask, axis=0) * mask - mask           # [N, E] 0-based
+    # position of each token within its expert's buffer — integer
+    # cumsum: exact for any N, where an f32 running sum loses integer
+    # exactness past 2^24 accumulated assignments
+    mi = mask.astype(jnp.int32)
+    pos = jnp.cumsum(mi, axis=0) * mi - mi                 # [N, E] 0-based
     pos_tok = jnp.sum(pos, axis=1).astype(jnp.int32)       # [N]
     keep = pos_tok < capacity
     # aux: E * Σ_e fraction_tokens_e · mean_prob_e (Switch eq. 4)
@@ -73,20 +84,29 @@ def gshard_gate(logits, capacity):
     weighted by its renormalized prob, same capacity bookkeeping, aux on
     the top-1 assignment."""
     n, e = logits.shape
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    idx1 = jnp.argmax(probs, axis=-1)
+    lg = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(lg, axis=-1)
+    # both picks route on the raw logits (see switch_gate).  The second
+    # pick masks the winner's LOGIT to -inf rather than zeroing its
+    # prob: with prob-zeroing, a row whose tail probs underflow to 0.0
+    # ties every non-winner at zero and the "second expert" collapses
+    # to argmax index order instead of preference order.
+    idx1 = jnp.argmax(lg, axis=-1)
     mask1 = _one_hot(idx1, e)
-    probs2 = probs * (1.0 - mask1)
-    idx2 = jnp.argmax(probs2, axis=-1)
+    lg2 = jnp.where(mask1 > 0, -jnp.inf, lg)
+    idx2 = jnp.argmax(lg2, axis=-1)
     mask2 = _one_hot(idx2, e)
     g1 = jnp.take_along_axis(probs, idx1[:, None], axis=1)[:, 0]
     g2 = jnp.take_along_axis(probs, idx2[:, None], axis=1)[:, 0]
     denom = jnp.maximum(g1 + g2, 1e-9)
     g1, g2 = g1 / denom, g2 / denom
-    # capacity: expert-1 tokens first, expert-2 fills what remains
-    pos1 = jnp.cumsum(mask1, axis=0) * mask1 - mask1
-    used1 = jnp.sum(mask1, axis=0, keepdims=True)          # [1, E]
-    pos2 = (jnp.cumsum(mask2, axis=0) * mask2 - mask2) + used1 * mask2
+    # capacity: expert-1 tokens first, expert-2 fills what remains —
+    # integer position bookkeeping, exact for any N (see switch_gate)
+    m1 = mask1.astype(jnp.int32)
+    m2 = mask2.astype(jnp.int32)
+    pos1 = jnp.cumsum(m1, axis=0) * m1 - m1
+    used1 = jnp.sum(m1, axis=0, keepdims=True)             # [1, E]
+    pos2 = (jnp.cumsum(m2, axis=0) * m2 - m2) + used1 * m2
     p1 = jnp.sum(pos1, axis=1).astype(jnp.int32)
     p2 = jnp.sum(pos2, axis=1).astype(jnp.int32)
     keep1 = p1 < capacity
@@ -114,8 +134,10 @@ def naive_gate(logits, capacity, top_k=2):
     occupancy = jnp.zeros((e,), jnp.int32)
     for j in range(top_k):
         mask = _one_hot(idxs[:, j], e)
-        pos = jnp.cumsum(mask, axis=0) * mask - mask + occupancy[None, :]
-        p = jnp.sum(pos * mask, axis=1).astype(jnp.int32)
+        # integer position bookkeeping, exact for any N (see switch_gate)
+        mi = mask.astype(jnp.int32)
+        pos = jnp.cumsum(mi, axis=0) * mi - mi + occupancy[None, :]
+        p = jnp.sum(pos * mi, axis=1).astype(jnp.int32)
         keep = p < capacity
         dj = (mask * keep[:, None])[:, :, None] \
             * _one_hot(p, capacity)[:, None, :]

@@ -27,10 +27,16 @@ Layer map:
                       DRAINING/DOWN health state machine driving
                       ``/healthz``/``/readyz`` and load shedding.
   ``sharded``         the tensor-parallel serving plane: ``ServingMesh``
-                      (mp × dp topology + quantized-allreduce wire
+                      (mp × dp × ep topology + quantized-allreduce wire
                       format), ``build_sharded_engine`` and the
                       config validation EngineCore re-runs against its
                       feature flags (docs/SERVING.md "Sharded serving").
+  ``moe``             the expert-parallel MoE plane: static-capacity
+                      serving MoE layers (float or quantized experts),
+                      in-place conversion (``prepare_moe_serving``) and
+                      the thread-local stats side-channel feeding the
+                      mixed step's routed/dropped/aux outputs
+                      (docs/SERVING.md "MoE serving").
   ``fleet``           the disaggregated tier: ``FleetRouter`` over N
                       replicas with prefill/decode/mixed roles,
                       prefix-affinity dispatch (``PrefixCache.peek``),
@@ -51,7 +57,9 @@ from .resilience import (EngineSupervisor, FaultPlane, FaultSpec,
                          HealthMonitor, HealthState)
 from .sharded import (ServingMesh, ShardedConfigError,
                       build_sharded_engine, validate_kv_quant_combo,
-                      validate_serving_config)
+                      validate_moe_quant_combo, validate_serving_config)
+from .moe import (MoETransformerLayer, ServingMoELayer, moe_serving_info,
+                  prepare_moe_serving, serving_capacity)
 from .fleet import (ElasticRolePolicy, FleetRouter, ReplicaHandle,
                     ReplicaRole, parse_fleet_roles)
 
@@ -66,7 +74,13 @@ __all__ = [
     "ShardedConfigError",
     "build_sharded_engine",
     "validate_kv_quant_combo",
+    "validate_moe_quant_combo",
     "validate_serving_config",
+    "MoETransformerLayer",
+    "ServingMoELayer",
+    "moe_serving_info",
+    "prepare_moe_serving",
+    "serving_capacity",
     "EngineCore",
     "Request",
     "RequestQueue",

@@ -108,7 +108,10 @@ class EngineCore:
         # die here with an actionable message, never mid-step; also catch
         # an engine whose mesh/quantization disagrees with the config
         from .sharded import (ShardedConfigError, validate_kv_quant_combo,
+                              validate_moe_quant_combo,
                               validate_serving_config)
+        from .moe import (moe_serving_info, prepare_moe_serving,
+                          serving_capacity)
 
         # KV-pool quantization rides in on the ENGINE (it owns the
         # pools); the kwarg here is a config affordance that must agree
@@ -123,6 +126,19 @@ class EngineCore:
         self._kv_dtype = engine_kv
         self._spec_accept_threshold = spec_accept_threshold
 
+        # MoE serving plane (serving/moe/): detect the model's MoE
+        # layers up front — the expert config feeds the validation
+        # matrix (ep divisibility, quantized experts × speculation) and,
+        # further down, the in-place conversion to static-capacity
+        # serving layers that must precede the engine's param snapshot
+        self._moe = moe_serving_info(engine._model)
+        if self._moe is not None and not ragged:
+            raise ShardedConfigError(
+                "MoE serving requires ragged=True: the static-capacity "
+                "routing buffers are sized from the mixed step's fixed "
+                "token budget, and the legacy per-(plen|batch,chunk) "
+                "program zoo would need one capacity per shape")
+
         engine_quant = getattr(engine, "_quant_allreduce", None)
         if serving_mesh is not None:
             validate_serving_config(
@@ -130,7 +146,10 @@ class EngineCore:
                 enable_prefix_cache=enable_prefix_cache,
                 max_batch=int(max_batch), num_heads=engine._num_heads,
                 kv_dtype=engine_kv,
-                spec_accept_threshold=spec_accept_threshold)
+                spec_accept_threshold=spec_accept_threshold,
+                num_experts=(self._moe["num_experts"]
+                             if self._moe else None),
+                moe_quant=self._moe["algo"] if self._moe else None)
             if serving_mesh.n_devices > 1 and engine._mesh is None:
                 raise ShardedConfigError(
                     f"{serving_mesh.describe()} given but the engine has "
@@ -147,10 +166,14 @@ class EngineCore:
                 "speculate/prefix-cache (exact-logit invariants); see "
                 "serving.sharded.validate_serving_config")
         else:
-            # single-device path: the kv-quant matrix still applies
+            # single-device path: the quantization matrices still apply
             validate_kv_quant_combo(
                 engine_kv, speculate=speculate,
                 enable_prefix_cache=enable_prefix_cache,
+                spec_accept_threshold=spec_accept_threshold)
+            validate_moe_quant_combo(
+                self._moe["algo"] if self._moe else None,
+                speculate=speculate,
                 spec_accept_threshold=spec_accept_threshold)
         self._serving_mesh = serving_mesh
         self._engine = engine
@@ -206,6 +229,21 @@ class EngineCore:
         else:
             self._token_budget = 0
             self._prefill_chunk = 0
+
+        if self._moe is not None:
+            # convert the MoE FFNs in place BEFORE the param snapshot so
+            # the serving wrappers' (unchanged) params/buffers are what
+            # the engine captures.  The capacity is fixed from
+            # deployment config — part of the executable's config key,
+            # never of the data — and with the default capacity_factor
+            # the routing is bitwise the unconverted fused path over the
+            # same max_batch × token_budget token block.
+            cap = serving_capacity(self._max_batch, self._token_budget,
+                                   self._moe)
+            prepare_moe_serving(engine._model, cap)
+            self._moe = dict(
+                self._moe, capacity=int(cap),
+                ep=int(getattr(serving_mesh, "ep", 1) or 1))
 
         engine.refresh_params()
         # prefix_cache_headroom_pages widens the pool BEYOND the
@@ -439,7 +477,8 @@ class EngineCore:
             resilience=resilience,
             steplog=self.steplog.summary(),
             device_memory=memory_stats(),
-            sharding=sharding_snapshot(self._engine))
+            sharding=sharding_snapshot(self._engine),
+            moe=self._moe)
 
     # ------------------------------------------------------- trace hooks
     def _trace_end(self, req: Request, state: RequestState):
@@ -1223,6 +1262,11 @@ class EngineCore:
             # the speculative executable has its own static window in
             # the key — still ONE executable per core, warmed once
             mkey = mkey + (W,)
+        moe = self._moe
+        if moe is not None:
+            # the [E, C_cap] routing buffers are deployment config, so
+            # they join the key — routing changes data, never shapes
+            mkey = mkey + (moe["num_experts"], moe["capacity"])
         clog = get_compile_log()
         c0 = clog.count()
         t0 = time.monotonic()
@@ -1230,25 +1274,38 @@ class EngineCore:
         try:
             fault = self._fault.fire(
                 "decode.step", rids=[s["req"].rid for s in active])
+            moe_out = ()
             if W > 1:
-                tok, n_emit, fin_out = eng.run_paged_program(
+                res = eng.run_paged_program(
                     mkey, lambda: build_mixed_step(eng, b, C,
                                                    self._max_pages,
-                                                   spec_window=W),
+                                                   spec_window=W,
+                                                   moe_stats=moe
+                                                   is not None),
                     ids, qlens, ctx, steps0, sample_now, spec, tables,
                     self._samp_arrays(cfgs), keys,
                     # scratch page id is a host int, no device sync
                     # tpulint: disable-next-line=host-sync -- speculative scratch readback at the verification boundary; verification is a host decision
                     np.asarray(self._scratch, np.int32))
+                if moe is not None:
+                    tok, n_emit, fin_out, *moe_out = res
+                else:
+                    tok, n_emit, fin_out = res
             else:
-                tok, fin_out = eng.run_paged_program(
+                res = eng.run_paged_program(
                     mkey, lambda: build_mixed_step(eng, b, C,
-                                                   self._max_pages),
+                                                   self._max_pages,
+                                                   moe_stats=moe
+                                                   is not None),
                     ids, qlens, ctx, steps0, sample_now, tables,
                     self._samp_arrays(cfgs), keys,
                     # scratch page id is a host int, no device sync
                     # tpulint: disable-next-line=host-sync -- speculative scratch readback at the verification boundary; verification is a host decision
                     np.asarray(self._scratch, np.int32))
+                if moe is not None:
+                    tok, fin_out, *moe_out = res
+                else:
+                    tok, fin_out = res
         except Exception as e:
             self._metrics.on_failed(0)
             # same contract as the legacy chunk: only a pre-dispatch
@@ -1294,6 +1351,21 @@ class EngineCore:
         if n_emit is not None:
             # tpulint: disable-next-line=host-sync -- the sampled step output must reach Python for emission; this is the deliberate per-step sync point
             n_emit = np.asarray(n_emit)
+        moe_kw = {}
+        if moe_out:
+            # moe routing stats ride the same per-step sync: the step's
+            # outputs are already host-bound for emission above
+            # tpulint: disable-next-line=host-sync -- the sampled step output must reach Python for emission; this is the deliberate per-step sync point
+            m_routed = np.asarray(moe_out[0])
+            # tpulint: disable-next-line=host-sync -- the sampled step output must reach Python for emission; this is the deliberate per-step sync point
+            m_dropped = int(np.asarray(moe_out[1]))
+            # tpulint: disable-next-line=host-sync -- the sampled step output must reach Python for emission; this is the deliberate per-step sync point
+            m_aux = float(np.asarray(moe_out[2]))
+            moe_kw = dict(moe_tokens_routed=int(m_routed.sum()),
+                          moe_tokens_dropped=m_dropped,
+                          moe_aux_loss=m_aux)
+            self._metrics.on_moe([int(x) for x in m_routed],
+                                 m_dropped, m_aux)
         t_sync = time.monotonic()
         resident = self._used_pages()
         prefix_hits = sum(len(s["match"].blocks)
@@ -1417,7 +1489,7 @@ class EngineCore:
             degraded=self._effective_max_batch < self._max_batch,
             draft_tokens=draft_tokens_step,
             draft_accepted=draft_accepted_step,
-            spec_rows=len(drafted))
+            spec_rows=len(drafted), **moe_kw)
         if self._recovery is not None:
             self._recovery.on_step_ok()
         # chunk-boundary hook: fired by the stepping thread itself (still

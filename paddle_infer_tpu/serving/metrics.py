@@ -99,6 +99,12 @@ class ServingMetrics:
             self.spec_rows = 0              # row-steps that carried drafts
             self.spec_drafts_proposed = 0
             self.spec_drafts_accepted = 0
+            # MoE routing counters (serving/moe/): per-expert valid
+            # token-expert assignments kept, capacity-overflow drops,
+            # and the latest gate aux loss — fed once per mixed step
+            self.moe_expert_tokens: list = []
+            self.moe_tokens_dropped = 0
+            self.moe_aux_loss_last = 0.0
             # resilience counters (serving/resilience/) — rendered as
             # their own Prometheus families (engine_restarts_total, …),
             # NOT through the auto-named serving_*_total counters block
@@ -177,6 +183,21 @@ class ServingMetrics:
             self.spec_drafts_proposed += proposed
             self.spec_drafts_accepted += accepted
 
+    def on_moe(self, routed_per_expert, dropped: int, aux_loss: float):
+        """One mixed step routed ``routed_per_expert[e]`` valid
+        token-expert assignments into expert ``e`` (summed over MoE
+        layers), dropped ``dropped`` to capacity overflow, and measured
+        gate aux loss ``aux_loss``."""
+        with self._lock:
+            if len(self.moe_expert_tokens) < len(routed_per_expert):
+                self.moe_expert_tokens.extend(
+                    [0] * (len(routed_per_expert)
+                           - len(self.moe_expert_tokens)))
+            for e, n in enumerate(routed_per_expert):
+                self.moe_expert_tokens[e] += int(n)
+            self.moe_tokens_dropped += int(dropped)
+            self.moe_aux_loss_last = float(aux_loss)
+
     def on_queue_wait(self, wait_s: float):
         """One request left the admission queue after ``wait_s``."""
         with self._lock:
@@ -234,7 +255,8 @@ class ServingMetrics:
                  resilience: Optional[Dict] = None,
                  steplog: Optional[Dict] = None,
                  device_memory: Optional[Dict] = None,
-                 sharding: Optional[Dict] = None) -> Dict:
+                 sharding: Optional[Dict] = None,
+                 moe: Optional[Dict] = None) -> Dict:
         """Render everything to a plain dict (the ``GET /metrics`` JSON
         body).  Latency series carry lifetime ``count``/``mean`` plus
         reservoir-window ``p50_recent``/``p99_recent``/``max_recent``
@@ -253,7 +275,11 @@ class ServingMetrics:
         allocator's ``memory_stats()`` dict when available;
         ``sharding`` is ``serving.sharded.sharding_snapshot`` (mesh
         shape, param placement tallies, collective-bytes ledger) when
-        the core serves over a mesh."""
+        the core serves over a mesh; ``moe`` is the core's MoE plane
+        info dict (``moe_serving_info`` + capacity/ep) — the section
+        merges it with this registry's routing counters (per-expert
+        utilization shares, skew = max share × E so 1.0 is perfectly
+        balanced, dropped ratio over routed+dropped)."""
         tps = self.tokens_per_second()
         with self._lock:
             out = {
@@ -303,6 +329,26 @@ class ServingMetrics:
                     "queue_wait": self.queue_wait_hist.snapshot(),
                 },
             }
+            if moe is not None:
+                tokens = list(self.moe_expert_tokens)
+                n_exp = int(moe.get("num_experts", len(tokens)) or 0)
+                if len(tokens) < n_exp:
+                    tokens.extend([0] * (n_exp - len(tokens)))
+                routed = sum(tokens)
+                dropped = self.moe_tokens_dropped
+                util = [t / routed if routed else 0.0 for t in tokens]
+                out["moe"] = dict(moe)
+                out["moe"].update({
+                    "expert_tokens": tokens,
+                    "tokens_routed": routed,
+                    "tokens_dropped": dropped,
+                    "dropped_ratio": (dropped / (routed + dropped)
+                                      if routed + dropped else 0.0),
+                    "expert_utilization": util,
+                    "utilization_skew": (max(util) * len(util)
+                                         if util and routed else 0.0),
+                    "gate_aux_loss": self.moe_aux_loss_last,
+                })
             if steplog is not None:
                 out["steplog"] = dict(steplog)
             if sharding is not None:

@@ -90,7 +90,7 @@ def _pick_rows(proc, samp, steps, keys):
 
 
 def build_mixed_step(engine, max_batch, token_budget, max_pages,
-                     spec_window=1):
+                     spec_window=1, moe_stats=False):
     """THE ragged serving executable: one launch per scheduler step,
     whatever the batch composition.  Row ``b`` carries ``qlens[b]``
     query tokens starting at absolute position ``ctx[b]`` — 1 for a
@@ -146,9 +146,33 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
     ops: stale entries at positions ``>= ctx + n_emit`` sit inside the
     row's reservation, are never attended (every read masks by the
     row's true length) and are overwritten before they become
-    visible."""
+    visible.
+
+    ``moe_stats = True`` (EngineCore sets it when the model's FFNs were
+    converted by ``serving.moe.prepare_moe_serving``) threads the
+    step's valid-slot mask through the MoE stats side-channel
+    (serving/moe/stats.py) and returns three extra outputs BEFORE the
+    pools — ``(…, moe_routed[E] i32, moe_dropped i32, moe_aux f32, …)``
+    — so capacity-overflow drops are surfaced per step, never silent.
+    The stats ride the same trace (data outputs, no shape impact), so
+    the one-executable invariant is untouched."""
     L = engine._num_layers
     C = token_budget
+
+    def _model_step_with_stats(params, ids, pos2d, caches, qlens, i2d):
+        """One model step, optionally collecting MoE routing stats
+        masked to the step's valid (non-pad) token slots."""
+        if not moe_stats:
+            logits, caches = engine._model_step(params, ids, pos2d,
+                                                None, caches)
+            return logits, caches, ()
+        from .moe import stats as moe_stats_mod
+
+        vmask = (i2d < qlens[:, None]).reshape(-1)
+        with moe_stats_mod.collect(vmask) as col:
+            logits, caches = engine._model_step(params, ids, pos2d,
+                                                None, caches)
+        return logits, caches, col.totals()
 
     def run(params, ids, qlens, ctx, steps0, sample_now, tables, samp,
             keys, scratch, k_pages, v_pages):
@@ -164,8 +188,8 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
         # whose table carries scratch filler.  Pad K/V is never
         # attended, so valid logits are bitwise unchanged.
         pos2d = jnp.where(i2d < qlens[:, None], ctx[:, None] + i2d, 0)
-        logits, caches = engine._model_step(params, ids, pos2d, None,
-                                            caches)
+        logits, caches, moe_out = _model_step_with_stats(
+            params, ids, pos2d, caches, qlens, i2d)
         last = jnp.take_along_axis(
             logits, jnp.maximum(qlens - 1, 0)[:, None, None], axis=1)[:, 0]
         proc = _process_rows(last, samp, steps0)
@@ -174,7 +198,7 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
         fin = jnp.logical_and(
             sample_now,
             jnp.logical_and(samp["eos"] >= 0, tok == samp["eos"]))
-        return (tok, fin,
+        return (tok, fin, *moe_out,
                 [c[0] for c in caches], [c[1] for c in caches])
 
     W = int(spec_window)
@@ -192,8 +216,8 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
         i2d = jnp.broadcast_to(jnp.arange(C, dtype=jnp.int32)[None],
                                (b, C))
         pos2d = jnp.where(i2d < qlens[:, None], ctx[:, None] + i2d, 0)
-        logits, caches = engine._model_step(params, ids, pos2d, None,
-                                            caches)
+        logits, caches, moe_out = _model_step_with_stats(
+            params, ids, pos2d, caches, qlens, i2d)
 
         # per-window-position logits: spec rows read positions 0..W-1
         # (clamped to their qlen), plain rows replicate qlens-1 so
@@ -277,7 +301,7 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
             out, pad).astype(jnp.int32)
         n_emit = jnp.where(sample_now, r, 0).astype(jnp.int32)
         fin = jnp.logical_and(sample_now, any_eos)
-        return (out, n_emit, fin,
+        return (out, n_emit, fin, *moe_out,
                 [c[0] for c in caches], [c[1] for c in caches])
 
     return jax.jit(run_spec, donate_argnums=(11, 12))

@@ -23,7 +23,7 @@ error for ~4x fewer mp interconnect bytes (see
 """
 from .mesh import (ServingMesh, ShardedConfigError, build_sharded_engine,
                    sharding_snapshot, validate_kv_quant_combo,
-                   validate_serving_config)
+                   validate_moe_quant_combo, validate_serving_config)
 
 __all__ = [
     "ServingMesh",
@@ -31,5 +31,6 @@ __all__ = [
     "build_sharded_engine",
     "sharding_snapshot",
     "validate_kv_quant_combo",
+    "validate_moe_quant_combo",
     "validate_serving_config",
 ]

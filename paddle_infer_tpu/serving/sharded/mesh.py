@@ -32,31 +32,40 @@ class ServingMesh:
     is rejected together with features whose invariants need exact
     arithmetic (speculation's acceptance rule, prefix-cache warm/cold
     stream identity).
+    ``ep``: expert-parallel degree — a MoE model's stacked expert
+    parameters ([E, ...], dist_attr ("ep", ...)) shard their expert dim
+    over this axis, and the serving MoE ops' sharding constraints make
+    GSPMD emit the dispatch/combine all-to-alls inside the step
+    program.  The axis reuses the training stack's "ep" name, so every
+    existing constraint composes unmodified.
     """
 
     mp: int = 1
     dp_replicas: int = 1
     quantized_allreduce: Optional[str] = None
+    ep: int = 1
 
     @property
     def n_devices(self) -> int:
-        return int(self.mp) * int(self.dp_replicas)
+        return int(self.mp) * int(self.dp_replicas) * int(self.ep)
 
     def describe(self) -> str:
         parts = [f"mp={self.mp}"]
         if self.dp_replicas > 1:
             parts.append(f"dp={self.dp_replicas}")
+        if self.ep > 1:
+            parts.append(f"ep={self.ep}")
         if self.quantized_allreduce:
             parts.append(f"quantized_allreduce={self.quantized_allreduce}")
         return "ServingMesh(" + ", ".join(parts) + ")"
 
     def build(self, devices: Optional[Sequence] = None):
         """The hybrid mesh for this config (axes [pp, dp, sharding, sep,
-        ep, mp]; only dp/mp exceed 1 here)."""
+        ep, mp]; only dp/ep/mp exceed 1 here)."""
         from ...parallel.topology import create_hybrid_mesh
 
         return create_hybrid_mesh(dp=self.dp_replicas, mp=self.mp,
-                                  devices=devices)
+                                  ep=self.ep, devices=devices)
 
 
 def validate_kv_quant_combo(kv_dtype: Optional[str], *,
@@ -99,22 +108,74 @@ def validate_kv_quant_combo(kv_dtype: Optional[str], *,
             "spec_accept_threshold=0.1) or serve with kv_dtype='int8'")
 
 
+def validate_moe_quant_combo(moe_quant: Optional[str], *,
+                             speculate: bool = False,
+                             spec_accept_threshold: Optional[float] = None):
+    """The quantized-expert feature matrix (the MoE analog of
+    :func:`validate_kv_quant_combo`).
+
+    * ``moe_quant=None`` / ``"fp"`` — float experts, everything allowed.
+    * ``"weight_only_int8"`` / ``"weight_only_int4"`` + speculation —
+      ALLOWED: weight-only dequant is deterministic per checkpoint, so
+      the verify lane's target logits live in the same (quantized-
+      weight) domain the decode lane would have used; greedy acceptance
+      stays self-consistent.
+    * ``"int8_act"`` + speculation — REJECTED unless an explicit
+      ``spec_accept_threshold`` is set: activation quantization error
+      depends on the routed batch contents, so draft-lane and verify-
+      lane logits for the same token can disagree enough to flip
+      near-tie acceptance comparisons — the operator must opt in with a
+      rejection margin.
+    """
+    if moe_quant not in (None, "fp", "weight_only_int8",
+                         "weight_only_int4", "int8_act"):
+        raise ShardedConfigError(
+            f"unsupported moe_quant={moe_quant!r}; expected "
+            "'weight_only_int8', 'weight_only_int4' or 'int8_act' (or "
+            "None for float experts)")
+    if moe_quant == "int8_act" and speculate \
+            and spec_accept_threshold is None:
+        raise ShardedConfigError(
+            "int8-activation experts are incompatible with speculative "
+            "decoding unless spec_accept_threshold is set: activation "
+            "quantization error varies with routed batch contents, so "
+            "verify-lane logits can flip near-tie acceptance "
+            "comparisons — set an explicit acceptance margin (e.g. "
+            "spec_accept_threshold=0.1) or serve weight-only experts")
+
+
 def validate_serving_config(cfg: ServingMesh, *, speculate: bool = False,
                             enable_prefix_cache: bool = False,
                             max_batch: Optional[int] = None,
                             num_heads: Optional[int] = None,
                             available_devices: Optional[int] = None,
                             kv_dtype: Optional[str] = None,
-                            spec_accept_threshold: Optional[float] = None):
+                            spec_accept_threshold: Optional[float] = None,
+                            num_experts: Optional[int] = None,
+                            moe_quant: Optional[str] = None):
     """Raise :class:`ShardedConfigError` for combos that would serve
     incorrectly or crash mid-step; silent on valid configs."""
     validate_kv_quant_combo(kv_dtype, speculate=speculate,
                             enable_prefix_cache=enable_prefix_cache,
                             spec_accept_threshold=spec_accept_threshold)
-    if cfg.mp < 1 or cfg.dp_replicas < 1:
+    validate_moe_quant_combo(moe_quant, speculate=speculate,
+                             spec_accept_threshold=spec_accept_threshold)
+    if cfg.mp < 1 or cfg.dp_replicas < 1 or cfg.ep < 1:
         raise ShardedConfigError(
             f"mesh degrees must be >= 1, got mp={cfg.mp} "
-            f"dp_replicas={cfg.dp_replicas}")
+            f"dp_replicas={cfg.dp_replicas} ep={cfg.ep}")
+    if cfg.ep > 1:
+        if num_experts is None:
+            raise ShardedConfigError(
+                f"ep={cfg.ep} needs a MoE model: no stacked expert "
+                "parameters to shard over the ep axis — drop --ep or "
+                "serve a model with num_experts > 1")
+        if num_experts % cfg.ep:
+            raise ShardedConfigError(
+                f"ep={cfg.ep} does not divide num_experts="
+                f"{num_experts}: the stacked expert dim must split "
+                "evenly over the ep axis — pick an ep degree that "
+                "divides the expert count")
     q = cfg.quantized_allreduce
     if q not in (None, "int8"):
         raise ShardedConfigError(
@@ -172,11 +233,15 @@ def build_sharded_engine(model, cfg: ServingMesh, *, page_size: int = 16,
     import jax
 
     from ...inference.generation import PagedGenerationEngine
+    from ..moe import moe_serving_info
 
     avail = len(list(devices) if devices is not None else jax.devices())
+    moe = moe_serving_info(model)
     validate_serving_config(
         cfg, num_heads=model.config.num_attention_heads,
-        available_devices=avail, kv_dtype=kv_dtype)
+        available_devices=avail, kv_dtype=kv_dtype,
+        num_experts=moe["num_experts"] if moe else None,
+        moe_quant=moe["algo"] if moe else None)
     mesh = cfg.build(devices) if cfg.n_devices > 1 else None
     return PagedGenerationEngine(
         model, page_size=page_size, num_pages=num_pages,

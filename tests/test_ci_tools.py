@@ -91,6 +91,40 @@ def test_check_metrics_detects_stale_docs(tmp_path):
     assert any("missing from the catalog" in p for p in problems)
 
 
+def test_check_metrics_covers_moe_families():
+    """The MoE serving families must be exercised by the fabricated
+    snapshot (3-way sync: renderer ↔ docs catalog ↔ check_metrics) —
+    a moe family dropped from any leg fails here, not on a dashboard."""
+    import check_metrics
+
+    _, _, text = check_metrics.fabricated_exposition()
+    for fam in ("moe_info", "moe_expert_tokens_total",
+                "moe_tokens_dropped_total", "moe_utilization_skew",
+                "steplog_moe_tokens_routed_total"):
+        assert f"# TYPE {fam} " in text, f"{fam} not rendered"
+    problems, _ = check_metrics.run_checks(
+        os.path.join(ROOT, "docs", "OBSERVABILITY.md"))
+    assert problems == []
+
+
+@pytest.mark.slow
+def test_moe_bench_child_imports_clean_without_mesh():
+    """tools/bench_moe_child.py must import and fail soft on a
+    single-device backend (CPU fallback prints a JSON error line, no
+    traceback) — the bench parent relies on that contract."""
+    env = _env()
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "bench_moe_child.py")],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 1, r.stdout + r.stderr[-800:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert "devices" in out["error"]
+
+
 def test_bench_diff_flags_regressions(tmp_path):
     """tools/bench_diff.py: direction-aware >10% regressions exit
     nonzero; improvements and unknown-direction metrics never do."""

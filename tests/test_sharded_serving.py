@@ -442,7 +442,7 @@ class TestShardReportAndMetrics:
         finally:
             core.close()
         assert validate_exposition(text) == []
-        assert ('serving_mesh_info{devices="2",dp="1",mp="2",'
+        assert ('serving_mesh_info{devices="2",dp="1",ep="1",mp="2",'
                 'quantized_allreduce="int8"}') in text
         assert "serving_shard_sharded_params" in text
         assert 'collective_bytes_total{dtype="int8",op="mp_allreduce"}' \

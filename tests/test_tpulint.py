@@ -182,6 +182,31 @@ def test_recompile_hazard_builder_suppressible(tmp_path):
     assert run_rules(tmp_path, src, ["recompile-hazard"]) == []
 
 
+MOE_BUILDER = """
+    def build_moe_step(engine, num_experts, expert_capacity):
+        return engine.compile(num_experts, expert_capacity)
+"""
+
+
+def test_recompile_hazard_fires_on_moe_keyed_serving_builder(tmp_path):
+    # expert count / capacity are deployment config in serving/ — a
+    # builder signature taking them re-opens a per-routing-shape
+    # program family
+    fs = run_rules(tmp_path, MOE_BUILDER, ["recompile-hazard"],
+                   rel="serving/moe/mod.py")
+    assert len(fs) == 1
+    assert "build_moe_step(num_experts, expert_capacity)" \
+        in fs[0].message
+    assert "prepare_moe_serving" in fs[0].message
+
+
+def test_recompile_hazard_moe_names_allowed_outside_serving(tmp_path):
+    # training-side builders legitimately parameterize over experts;
+    # the MoE name set only binds under serving/
+    assert run_rules(tmp_path, MOE_BUILDER, ["recompile-hazard"],
+                     rel="parallel/mod.py") == []
+
+
 # ------------------------------------------------------ lock-discipline
 def test_lock_discipline_fires_on_unlocked_read(tmp_path):
     src = """

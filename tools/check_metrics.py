@@ -70,6 +70,13 @@ def fabricated_exposition():
                    cost_source="xla+pages", decode_rows=3,
                    emitted_tokens=7, draft_tokens=6, draft_accepted=4,
                    spec_rows=2, kernel="ragged")
+    steplog.record("mixed", wall_s=0.016, dispatch_s=0.012,
+                   bytes_est=1.7e6, flops_est=4.8e6,
+                   ici_bytes_est=9.0e4, ici_bytes_saved_est=5.0e4,
+                   cost_source="xla+pages", decode_rows=3,
+                   emitted_tokens=3, moe_tokens_routed=24,
+                   moe_tokens_dropped=2, moe_aux_loss=1.02,
+                   kernel="ragged")
     steplog.record("evict", pages_freed=3, bytes_est=3.0e5,
                    cost_source="analytic")
 
@@ -85,6 +92,7 @@ def fabricated_exposition():
     m.on_tokens(3, itl_s=0.012)
     m.on_step(3.5, active=2, max_batch=4)
     m.on_spec(rows=2, proposed=6, accepted=4)
+    m.on_moe([14, 6, 3, 1], dropped=2, aux_loss=1.02)
     m.on_queue_wait(0.004)
     m.on_queue_wait(0.020)
     m.on_completed(0.5)
@@ -121,13 +129,18 @@ def fabricated_exposition():
                                     "evicted_blocks": 2, "cow_copies": 1,
                                     "cached_blocks": 7, "nodes": 6},
                       steplog=steplog.summary(),
+                      moe={"num_experts": 4, "top_k": 2,
+                           "gate": "gshard", "capacity_factor": 1.0,
+                           "capacity": 8, "ep": 2,
+                           "algo": "weight_only_int8", "layers": 2,
+                           "expert_hbm_bytes": 3.2e6},
                       device_memory={"bytes_in_use": 1 << 20,
                                      "peak_bytes_in_use": 1 << 21,
                                      "bytes_limit": 1 << 30,
                                      "largest_alloc_size": 1 << 18,
                                      "num_allocs": 12},
-                      sharding={"mesh_axes": {"mp": 2, "dp": 2},
-                                "devices": 4,
+                      sharding={"mesh_axes": {"mp": 2, "dp": 2, "ep": 2},
+                                "devices": 8,
                                 "params_total": 26,
                                 "sharded_params": 16,
                                 "replicated_params": 1,
@@ -137,6 +150,7 @@ def fabricated_exposition():
                                     "calls": 9,
                                     "by_op_dtype": {
                                         "mp_allreduce": {"int8": 5.1e5},
+                                        "ep_alltoall": {"int8": 3.2e5},
                                         "all_gather": {"float32": 2.0e5}},
                                     "bytes_total": 7.1e5,
                                     "bytes_saved_total": 1.4e6}})

@@ -680,6 +680,38 @@ def main(argv=None):
                          "int8/int4 and dequantized inline into the "
                          "matmul, halving (quartering) weight HBM "
                          "traffic for bs=1 decode")
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel degree: stacked MoE expert "
+                         "payloads shard over an 'ep' mesh axis (needs "
+                         "a MoE checkpoint with num_experts divisible "
+                         "by ep, and mp*dp_replicas*ep visible devices; "
+                         "docs/SERVING.md 'MoE serving')")
+    ap.add_argument("--num_experts", type=int, default=None,
+                    help="deploy-time assertion on the checkpoint's "
+                         "expert count (the value itself comes from the "
+                         "model config) — a mismatch aborts startup "
+                         "instead of serving the wrong model")
+    ap.add_argument("--moe_top_k", type=int, default=None,
+                    help="override the routing top_k baked into the "
+                         "checkpoint config for this deployment "
+                         "(routing changes data, never shapes — the "
+                         "mixed-step executable is unaffected)")
+    ap.add_argument("--capacity_factor", type=float, default=None,
+                    help="override the MoE capacity factor for this "
+                         "deployment: scales the fixed per-expert "
+                         "buffer C = capacity(max_batch*token_budget); "
+                         "lower trades dropped tokens for less padding "
+                         "FLOPs/HBM (docs/SERVING.md 'MoE serving')")
+    ap.add_argument("--moe_weight_only", default=None,
+                    choices=["int8", "int4", "act_int8"],
+                    help="quantize ONLY the stacked expert payloads: "
+                         "int8/int4 weight-only (dequantized inline "
+                         "into the expert einsum), or act_int8 "
+                         "(int8 weights AND activations — also shrinks "
+                         "the ep all-to-all dispatch leg; requires "
+                         "--spec_accept_threshold under --speculate); "
+                         "composes with --weight_only for the dense "
+                         "linears")
     ap.add_argument("--spec_accept_threshold", type=float, default=None,
                     help="explicit speculative-acceptance margin in "
                          "(0, 1); required to combine kv_dtype=int4 "
@@ -718,6 +750,7 @@ def main(argv=None):
         incompatible = [name for name, on in (
             ("--mp > 1", args.mp > 1),
             ("--dp_replicas > 1", args.dp_replicas > 1),
+            ("--ep > 1", args.ep > 1),
             ("--quantized_allreduce", bool(args.quantized_allreduce)),
             ("--legacy_programs", args.legacy_programs),
             ("--speculate", args.speculate),
@@ -731,9 +764,69 @@ def main(argv=None):
     _STATE["fleet_roles"] = fleet_roles
     _STATE["prefix_affinity"] = args.prefix_affinity == "on"
 
+    # model first: the MoE validation inputs (expert count, expert
+    # arithmetic) come from the loaded checkpoint, not from flags
+    _STATE["model"] = AutoModel.from_pretrained(args.model_dir)
+    if args.moe_weight_only:
+        # expert stacks only, BEFORE --weight_only so the dense pass
+        # below finds no bare MoELayer left to double-convert
+        from paddle_infer_tpu.parallel.moe import MoELayer
+        from paddle_infer_tpu.quantization.moe import (Int8MoELayer,
+                                                       WeightOnlyMoELayer)
+        from paddle_infer_tpu.quantization.slim import _swap
+
+        def _make(sub):
+            if args.moe_weight_only == "act_int8":
+                return Int8MoELayer.from_moe(sub)
+            return WeightOnlyMoELayer.from_moe(
+                sub, algo=f"weight_only_{args.moe_weight_only}")
+
+        _swap(_STATE["model"], (MoELayer,), _make, None)
+    if args.weight_only:
+        from paddle_infer_tpu.quantization.weight_only import \
+            quantize_model
+
+        quantize_model(_STATE["model"],
+                       algo=f"weight_only_{args.weight_only}")
+
+    from paddle_infer_tpu.serving import moe_serving_info
+
+    try:
+        moe = moe_serving_info(_STATE["model"])
+    except ShardedConfigError as e:
+        print(f"error: unservable MoE checkpoint: {e}",
+              file=sys.stderr, flush=True)
+        return 2
+    if moe is None and (args.moe_weight_only or args.num_experts
+                        or args.moe_top_k or args.capacity_factor):
+        print("error: --moe_* / --num_experts / --capacity_factor need "
+              "a MoE checkpoint; this model has no MoE layers",
+              file=sys.stderr, flush=True)
+        return 2
+    if moe is not None:
+        if args.legacy_programs:
+            print("error: MoE serving requires the ragged mixed step; "
+                  "drop --legacy_programs", file=sys.stderr, flush=True)
+            return 2
+        if args.num_experts and args.num_experts != moe["num_experts"]:
+            print(f"error: --num_experts {args.num_experts} does not "
+                  f"match the checkpoint ({moe['num_experts']} experts)",
+                  file=sys.stderr, flush=True)
+            return 2
+        if args.moe_top_k or args.capacity_factor:
+            from paddle_infer_tpu.serving.moe.layer import \
+                _iter_moe_layers
+
+            for lay in _iter_moe_layers(_STATE["model"]):
+                if args.moe_top_k:
+                    lay.top_k = int(args.moe_top_k)
+                if args.capacity_factor:
+                    lay.capacity_factor = float(args.capacity_factor)
+            moe = moe_serving_info(_STATE["model"])
+
     serving_mesh = ServingMesh(
         mp=args.mp, dp_replicas=args.dp_replicas,
-        quantized_allreduce=args.quantized_allreduce)
+        quantized_allreduce=args.quantized_allreduce, ep=args.ep)
     try:
         import jax
 
@@ -743,7 +836,9 @@ def main(argv=None):
             max_batch=args.max_batch,
             available_devices=len(jax.devices()),
             kv_dtype=args.kv_dtype,
-            spec_accept_threshold=args.spec_accept_threshold)
+            spec_accept_threshold=args.spec_accept_threshold,
+            num_experts=moe["num_experts"] if moe else None,
+            moe_quant=moe["algo"] if moe else None)
     except ShardedConfigError as e:
         print(f"error: invalid sharded-serving config: {e}",
               file=sys.stderr, flush=True)
@@ -756,14 +851,6 @@ def main(argv=None):
         return 2
     _STATE["kv_dtype"] = args.kv_dtype
     _STATE["spec_accept_threshold"] = args.spec_accept_threshold
-
-    _STATE["model"] = AutoModel.from_pretrained(args.model_dir)
-    if args.weight_only:
-        from paddle_infer_tpu.quantization.weight_only import \
-            quantize_model
-
-        quantize_model(_STATE["model"],
-                       algo=f"weight_only_{args.weight_only}")
     _STATE["page_size"] = args.page_size
     _STATE["max_batch"] = args.max_batch
     _STATE["max_queue"] = args.max_queue
