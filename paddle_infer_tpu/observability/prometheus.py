@@ -465,6 +465,45 @@ def render_prometheus(snapshot: dict,
                  "estimate and measured decode step wall")
         w.sample("steplog_model_pearson_r", model.get("pearson_r"))
 
+    sc = snapshot.get("sched") or {}
+    if sc:
+        w.family("sched_policy_info", "gauge",
+                 "Active SLO admission policy as labels (constant 1)")
+        w.sample("sched_policy_info", 1, {
+            "policy": sc.get("policy", "fifo"),
+            "reorders": str(bool(sc.get("reorders"))).lower()})
+        w.family("sched_predictive_sheds_total", "counter",
+                 "Queued requests shed because their predicted "
+                 "completion already missed the deadline")
+        w.sample("sched_predictive_sheds_total",
+                 sc.get("predictive_sheds", 0))
+        planner = sc.get("planner") or {}
+        w.family("sched_planner_plans_total", "counter",
+                 "Mixed steps planned by the StepPlanner")
+        w.sample("sched_planner_plans_total", planner.get("plans", 0))
+        w.family("sched_planner_chunk_limited_total", "counter",
+                 "Planned steps whose prompt-chunk cap was shrunk "
+                 "below the static prefill_chunk to fit the ITL SLO")
+        w.sample("sched_planner_chunk_limited_total",
+                 planner.get("chunk_limited_steps", 0))
+        pm = (snapshot.get("steplog") or {}).get("planner_model") or {}
+        w.family("sched_planner_pred_wall_abs_rel_err", "gauge",
+                 "Mean absolute relative error of the planner's "
+                 "predicted step wall vs measured, recent steps")
+        w.sample("sched_planner_pred_wall_abs_rel_err",
+                 pm.get("mean_abs_rel_err"))
+        slack = sc.get("slack_err") or {}
+        w.family("sched_slack_pred_err_seconds", "gauge",
+                 "Mean absolute error of the slack policy's predicted "
+                 "completion time vs actual, recent completed requests")
+        w.sample("sched_slack_pred_err_seconds",
+                 slack.get("mean_abs_err_s"))
+        w.family("sched_last_min_slack_seconds", "gauge",
+                 "Smallest predicted deadline slack among queued "
+                 "requests at the last admission-policy pass")
+        w.sample("sched_last_min_slack_seconds",
+                 sc.get("last_min_slack_s"))
+
     sh = snapshot.get("sharding") or {}
     if sh:
         axes = sh.get("mesh_axes") or {}

@@ -88,6 +88,10 @@ _SCHEMA = (
                                  # overflow (NEVER silent)
     ("moe_aux_loss", 0.0),       # gate load-balance aux loss (mean
                                  # across moe layers)
+    ("planned_tokens", 0),       # tokens the StepPlanner chose to pack
+    ("planned_chunk_cap", 0),    # per-row prompt-chunk cap this step
+    ("predicted_wall_s", 0.0),   # planner's predicted step wall (0.0
+                                 # while the fit is cold)
 )
 SCHEMA_KEYS = tuple(k for k, _ in _SCHEMA)
 
@@ -384,6 +388,12 @@ class StepLog:
         self._by_kernel: Dict[str, int] = {}
         # (bytes_est, wall_s) for clean decode chunks — the model fit
         self._model: deque = deque(maxlen=int(model_window))
+        # (predicted_wall_s, wall_s) for clean planned steps — scores
+        # the StepPlanner's per-step wall prediction
+        self._planner: deque = deque(maxlen=int(model_window))
+        # (prefill_chunk_tokens, wall_s) for clean prefill-carrying
+        # steps — calibrates prefill s/token for admission predictions
+        self._prefill: deque = deque(maxlen=int(model_window))
 
     def record(self, kind: str, **fields) -> dict:
         """Append one record; unknown fields are a programming error
@@ -420,6 +430,14 @@ class StepLog:
                     and rec["bytes_est"] > 0.0 and rec["wall_s"] > 0.0:
                 self._model.append((float(rec["bytes_est"]),
                                     float(rec["wall_s"])))
+            if not rec["failed"] and rec["predicted_wall_s"] > 0.0 \
+                    and rec["wall_s"] > 0.0:
+                self._planner.append((float(rec["predicted_wall_s"]),
+                                      float(rec["wall_s"])))
+            if not rec["failed"] and rec["prefill_chunk_tokens"] > 0 \
+                    and rec["wall_s"] > 0.0:
+                self._prefill.append((int(rec["prefill_chunk_tokens"]),
+                                      float(rec["wall_s"])))
         return rec
 
     def __len__(self) -> int:
@@ -446,6 +464,8 @@ class StepLog:
         with self._lock:
             self._ring.clear()
             self._model.clear()
+            self._planner.clear()
+            self._prefill.clear()
             self._by_kind = {}
             self._total = 0
             self._bytes_total = 0.0
@@ -460,9 +480,35 @@ class StepLog:
             self._moe_dropped_total = 0
             self._by_kernel = {}
 
+    def calibration(self) -> Dict:
+        """Rolling fits the scheduler plans and admits from: the decode
+        Σwall/Σbytes scale, the mean clean decode step wall, and
+        prefill seconds per chunked prompt token.  Keys are None until
+        there are samples; the scheduler's readiness gates (see
+        ``serving.sched.StepCalibration``) decide when to trust them."""
+        with self._lock:
+            model = list(self._model)
+            prefill = list(self._prefill)
+        out: Dict = {"scale_s_per_byte": None, "decode_step_s": None,
+                     "prefill_s_per_token": None,
+                     "n_decode": len(model), "n_prefill": len(prefill)}
+        if model:
+            sx = sum(p[0] for p in model)
+            sy = sum(p[1] for p in model)
+            if sx > 0.0 and sy > 0.0:
+                out["scale_s_per_byte"] = sy / sx
+            out["decode_step_s"] = sy / len(model)
+        if prefill:
+            st = sum(p[0] for p in prefill)
+            sw = sum(p[1] for p in prefill)
+            if st > 0 and sw > 0.0:
+                out["prefill_s_per_token"] = sw / st
+        return out
+
     def summary(self) -> Dict:
         with self._lock:
             pairs = list(self._model)
+            planner = list(self._planner)
             out = {
                 "records": self._total,
                 "ring": len(self._ring),
@@ -481,4 +527,11 @@ class StepLog:
                 "moe_tokens_dropped_total": self._moe_dropped_total,
             }
         out["decode_model"] = _model_summary(pairs)
+        # predicted-vs-measured step wall for planner-annotated steps
+        errs = [abs(p - w) / w for p, w in planner if w > 0.0]
+        out["planner_model"] = {
+            "n": len(errs),
+            "mean_abs_rel_err": (sum(errs) / len(errs)) if errs else None,
+            "max_abs_rel_err": max(errs) if errs else None,
+        }
         return out

@@ -157,9 +157,29 @@ class WeightOnlyLinear(Layer):
         lay.scale.set_value(scale.numpy())
         if linear.bias is not None:
             lay.bias.set_value(linear.bias.numpy())
+        # buffer-aware placement: carry the source layer's dist_attr
+        # onto the quantized payload so the engine's param snapshot
+        # places it like the fp weight it replaces — in fleet mode
+        # every replica builds its own snapshot from the SAME model, so
+        # unstamped buffers would silently replicate the int8 payload
+        # per replica and forfeit the mp sharding the fp plan had.
+        src_attr = getattr(linear.weight, "dist_attr", None)
+        if src_attr is not None:
+            # qweight rows follow the weight's in-dim (int4 halves the
+            # row count; serving_param_spec re-checks divisibility and
+            # falls back to replicate when the packed dim no longer
+            # divides the mesh axis)
+            lay.qweight.dist_attr = tuple(src_attr)
+            # per-group scales shard only on the out-dim: the group
+            # axis is a reduction over in-features, not a layout match
+            lay.scale.dist_attr = (None, tuple(src_attr)[1] if
+                                   len(src_attr) > 1 else None)
+        if lay.bias is not None:
+            bias_attr = getattr(linear.bias, "dist_attr", None)
+            if bias_attr is not None:
+                lay.bias.dist_attr = tuple(bias_attr)
         # preserve a ColumnParallelLinear(gather_output=False) output
-        # constraint; weight payloads stay replicated for now (sharded
-        # int8 buffers need buffer-aware placement in fleet — TODO)
+        # constraint
         if getattr(linear, "gather_output", None) is False:
             lay._out_spec = "mp"
         return lay

@@ -42,6 +42,12 @@ Layer map:
                       prefix-affinity dispatch (``PrefixCache.peek``),
                       cross-replica KV page handoff and elastic role
                       flips (docs/SERVING.md "Disaggregated serving").
+  ``sched``           SLO-aware scheduling: ``StepPlanner`` (cost-model
+                      per-step chunk planning calibrated by the steplog
+                      fit) and pluggable admission policies — ``fifo``
+                      (bitwise-compat default) and ``slack`` (EDF over
+                      predicted completion with predictive shedding);
+                      docs/SERVING.md "SLO-aware scheduling".
 
 Requests with per-request sampling configs share one decode executable:
 temperature/top-k/top-p/eos ride as *per-row arrays* (serving/programs),
@@ -62,8 +68,15 @@ from .moe import (MoETransformerLayer, ServingMoELayer, moe_serving_info,
                   prepare_moe_serving, serving_capacity)
 from .fleet import (ElasticRolePolicy, FleetRouter, ReplicaHandle,
                     ReplicaRole, parse_fleet_roles)
+from .sched import (AdmissionPolicy, FifoPolicy, SlackPolicy,
+                    StepPlanner, make_policy)
 
 __all__ = [
+    "AdmissionPolicy",
+    "FifoPolicy",
+    "SlackPolicy",
+    "StepPlanner",
+    "make_policy",
     "ElasticRolePolicy",
     "FleetRouter",
     "HandoffError",

@@ -47,6 +47,7 @@ import logging
 import threading
 import time
 import traceback
+from collections import deque
 from typing import List, Optional
 
 import jax
@@ -101,7 +102,10 @@ class EngineCore:
                  draft_source="auto",
                  kv_dtype: Optional[str] = None,
                  spec_accept_threshold: Optional[float] = None,
-                 serving_mesh=None):
+                 serving_mesh=None,
+                 sched_policy: str = "fifo",
+                 slo_ttft_s: Optional[float] = None,
+                 slo_itl_s: Optional[float] = None):
         # sharded serving plane (serving/sharded/): when a ServingMesh is
         # handed in, re-validate it against THIS core's feature flags so
         # incompatible combos (quantized wire + speculation/prefix cache)
@@ -305,6 +309,32 @@ class EngineCore:
         self.steplog = steplog if steplog is not None else StepLog()
         self._cost_model = StepCostModel(engine, self._pool)
 
+        # SLO-aware scheduling (serving/sched/): the admission policy
+        # reorders/sheds the queue from predicted completion; the step
+        # planner caps prompt chunking from predicted step wall.  Both
+        # are pure data decisions calibrated by the steplog fit — the
+        # fifo default keeps admission and packing byte-identical to
+        # the pre-sched engine.
+        from .sched import StepPlanner, make_policy
+        self._sched = make_policy(sched_policy, slo_ttft_s=slo_ttft_s,
+                                  slo_itl_s=slo_itl_s)
+        if self._sched.reorders and not self._ragged:
+            raise ValueError(
+                f"sched_policy={sched_policy!r} requires ragged=True "
+                "(the planner prices the mixed step's token budget)")
+        self._planner = (StepPlanner(
+            self._cost_model, self.steplog,
+            max_batch=self._max_batch,
+            token_budget=self._token_budget,
+            prefill_chunk=self._prefill_chunk,
+            slo_itl_s=slo_itl_s,
+            dynamic=self._sched.reorders) if self._ragged else None)
+        self._predictive_sheds = 0
+        # rolling |predicted - actual| completion error for requests
+        # the slack policy scored (reads/writes under the step lock)
+        self._slack_err: deque = deque(maxlen=256)
+        self._last_min_slack_s: Optional[float] = None
+
         self._slots: List[Optional[dict]] = [None] * self._max_batch
         # degradation ladder: memory pressure shrinks the batch the
         # scheduler will actually fill; recovery grows it back
@@ -416,6 +446,73 @@ class EngineCore:
             self._trace_queue_drop(r, RequestState.REJECTED, "load-shed")
         return len(shed)
 
+    def _schedule_admission(self, now: float) -> int:
+        """Run the admission policy over the queued batch requests:
+        reorder by predicted deadline slack and finish predictive
+        sheds.  Called on the stepping thread under the step lock; the
+        queue transaction itself is atomic under the queue condition."""
+        if not len(self._queue):
+            return 0
+        cal = self._planner.calibration()
+        if not cal.admission_ready:
+            return 0        # cold fit: stay FIFO, never mispredict
+        # prefill work still pending on already-active rows delays
+        # every queued request's first chunk
+        backlog = 0
+        for s in self._slots:
+            if s is not None:
+                backlog += int(s["pending"].size)
+        captured = {}
+
+        def fn(batch):
+            kept, shed = self._sched.schedule(batch, now, cal, backlog)
+            captured["kept"] = kept
+            return kept, shed
+
+        shed = self._queue.schedule(fn)
+        kept = captured.get("kept", [])
+        slacks = [r.sched_predicted_slack for r in kept
+                  if r.sched_predicted_slack is not None]
+        self._last_min_slack_s = min(slacks) if slacks else None
+        for r in shed:
+            self._predictive_sheds += 1
+            self._metrics.on_predictive_shed()
+            miss = ((r.sched_predicted_done - r.deadline)
+                    if (r.sched_predicted_done is not None
+                        and r.deadline is not None) else 0.0)
+            r._finish(RequestState.REJECTED, LoadShedError(
+                f"request {r.rid} shed predictively: predicted "
+                f"completion misses its deadline by {miss:.3f}s"))
+            self._trace_queue_drop(r, RequestState.REJECTED,
+                                   "predictive-shed")
+        return len(shed)
+
+    def _sched_snapshot(self) -> dict:
+        """The ``sched`` section of the metrics snapshot — always
+        present so dashboards can tell "fifo by choice" from "engine
+        predates the scheduler"."""
+        with self._step_lock:
+            errs = list(self._slack_err)
+            sheds = self._predictive_sheds
+            min_slack = self._last_min_slack_s
+        out = {
+            "policy": self._sched.name,
+            "reorders": self._sched.reorders,
+            "slo_ttft_s": self._sched.slo_ttft_s,
+            "slo_itl_s": self._sched.slo_itl_s,
+            "predictive_sheds": sheds,
+            "last_min_slack_s": min_slack,
+            "slack_err": {
+                "n": len(errs),
+                "mean_abs_err_s": (sum(errs) / len(errs)) if errs
+                else None,
+                "max_abs_err_s": max(errs) if errs else None,
+            },
+        }
+        if self._planner is not None:
+            out["planner"] = self._planner.snapshot()
+        return out
+
     def _kv_quant_info(self) -> Optional[dict]:
         """The ``kv_quant`` section of the metrics snapshot: per-page
         byte accounting for the quantized pool vs the fp pool the same
@@ -478,7 +575,8 @@ class EngineCore:
             steplog=self.steplog.summary(),
             device_memory=memory_stats(),
             sharding=sharding_snapshot(self._engine),
-            moe=self._moe)
+            moe=self._moe,
+            sched=self._sched_snapshot())
 
     # ------------------------------------------------------- trace hooks
     def _trace_end(self, req: Request, state: RequestState):
@@ -641,6 +739,14 @@ class EngineCore:
                 break
             self._run_exclusive(self._queue.pop())
             progressed = True
+
+        # SLO admission policy: reorder the queued batch requests by
+        # predicted slack and finish predictive sheds BEFORE the FIFO
+        # pop loop below consumes the (possibly re-ordered) head.  The
+        # fifo policy never reorders, so this is a no-op on the
+        # default path.
+        if self._sched.reorders:
+            progressed = bool(self._schedule_admission(now)) or progressed
 
         # admission honors the degradation ladder: under memory pressure
         # the supervisor shrinks effective_max_batch below the physical
@@ -1180,6 +1286,27 @@ class EngineCore:
         cfgs: List[Optional[GenerationConfig]] = [None] * b
         decode_rows = [s for s in active if s["pending"].size == 0]
         chunk_rows = [s for s in active if s["pending"].size > 0]
+        eng = self._engine
+        W = self._spec_window
+        mkey = ("serve-step", b, C, self._max_pages,
+                self._pool.num_blocks)
+        if W > 1:
+            # the speculative executable has its own static window in
+            # the key — still ONE executable per core, warmed once
+            mkey = mkey + (W,)
+        moe = self._moe
+        if moe is not None:
+            # the [E, C_cap] routing buffers are deployment config, so
+            # they join the key — routing changes data, never shapes
+            mkey = mkey + (moe["num_experts"], moe["capacity"])
+        # StepPlanner: this step's per-row prompt-chunk cap + predicted
+        # wall.  Static plans (fifo policy, cold fit, or no ITL SLO)
+        # return cap == self._prefill_chunk, keeping the packing below
+        # byte-identical to the pre-sched engine.
+        plan = self._planner.plan(
+            n_decode=len(decode_rows),
+            pending=[int(s["pending"].size) for s in chunk_rows],
+            pages=self._used_pages(), key=mkey)
         budget = C
         chunk_taken = {}
         for s in decode_rows:
@@ -1197,7 +1324,7 @@ class EngineCore:
             budget -= 1
         for s in chunk_rows:
             i = s["sid"]
-            n = min(self._prefill_chunk, budget, int(s["pending"].size))
+            n = min(plan.chunk_cap, budget, int(s["pending"].size))
             if n <= 0:
                 continue        # budget spent: the row waits this step
             ids[i, :n] = s["pending"][:n]
@@ -1255,18 +1382,6 @@ class EngineCore:
         draft_tokens_step = sum(drafted.values())
         prefill_tokens_step = sum(chunk_taken.values())
         n_decode = len(decode_rows)
-        eng = self._engine
-        mkey = ("serve-step", b, C, self._max_pages,
-                self._pool.num_blocks)
-        if W > 1:
-            # the speculative executable has its own static window in
-            # the key — still ONE executable per core, warmed once
-            mkey = mkey + (W,)
-        moe = self._moe
-        if moe is not None:
-            # the [E, C_cap] routing buffers are deployment config, so
-            # they join the key — routing changes data, never shapes
-            mkey = mkey + (moe["num_experts"], moe["capacity"])
         clog = get_compile_log()
         c0 = clog.count()
         t0 = time.monotonic()
@@ -1489,7 +1604,13 @@ class EngineCore:
             degraded=self._effective_max_batch < self._max_batch,
             draft_tokens=draft_tokens_step,
             draft_accepted=draft_accepted_step,
-            spec_rows=len(drafted), **moe_kw)
+            spec_rows=len(drafted),
+            planned_tokens=plan.planned_tokens,
+            planned_chunk_cap=plan.chunk_cap,
+            # price the composition actually packed (drafts included),
+            # not the planner's pre-packing simulation
+            predicted_wall_s=self._planner.predict_wall(bts),
+            **moe_kw)
         if self._recovery is not None:
             self._recovery.on_step_ok()
         # chunk-boundary hook: fired by the stepping thread itself (still
@@ -1722,6 +1843,11 @@ class EngineCore:
         self._trace_end(req, state)
         if state == RequestState.DONE:
             self._metrics.on_completed(time.monotonic() - req.arrival)
+            if req.sched_predicted_done is not None:
+                # score the slack policy's completion prediction against
+                # the actual finish (both on the monotonic clock)
+                self._slack_err.append(
+                    abs(req.finished_at - req.sched_predicted_done))
         elif state == RequestState.FAILED:
             self._metrics.on_failed()
 

@@ -113,6 +113,10 @@ class ServingMetrics:
             self.watchdog_trips = 0
             self.requests_quarantined = 0
             self.requests_shed = 0
+            # SLO scheduler (serving/sched/): queued requests shed
+            # because their PREDICTED completion missed the deadline
+            # (distinct from requests_shed, the headroom ladder)
+            self.requests_shed_predicted = 0
             self.loop_exceptions = 0
             self.ttft = _Series()
             self.itl = _Series()            # inter-token latency (s)
@@ -231,6 +235,10 @@ class ServingMetrics:
         with self._lock:
             self.requests_shed += n
 
+    def on_predictive_shed(self, n: int = 1):
+        with self._lock:
+            self.requests_shed_predicted += n
+
     def on_loop_exception(self, n: int = 1):
         with self._lock:
             self.loop_exceptions += n
@@ -256,7 +264,8 @@ class ServingMetrics:
                  steplog: Optional[Dict] = None,
                  device_memory: Optional[Dict] = None,
                  sharding: Optional[Dict] = None,
-                 moe: Optional[Dict] = None) -> Dict:
+                 moe: Optional[Dict] = None,
+                 sched: Optional[Dict] = None) -> Dict:
         """Render everything to a plain dict (the ``GET /metrics`` JSON
         body).  Latency series carry lifetime ``count``/``mean`` plus
         reservoir-window ``p50_recent``/``p99_recent``/``max_recent``
@@ -279,7 +288,10 @@ class ServingMetrics:
         info dict (``moe_serving_info`` + capacity/ep) — the section
         merges it with this registry's routing counters (per-expert
         utilization shares, skew = max share × E so 1.0 is perfectly
-        balanced, dropped ratio over routed+dropped)."""
+        balanced, dropped ratio over routed+dropped); ``sched`` is the
+        core's SLO-scheduler section (policy, planner calibration,
+        predictive sheds, predicted-vs-actual slack error), merged
+        with this registry's predictive-shed counter."""
         tps = self.tokens_per_second()
         with self._lock:
             out = {
@@ -349,6 +361,15 @@ class ServingMetrics:
                                          if util and routed else 0.0),
                     "gate_aux_loss": self.moe_aux_loss_last,
                 })
+            if sched is not None:
+                # the core's scheduler section (policy, planner,
+                # predicted-vs-actual slack), plus this registry's
+                # predictive-shed counter so the Prometheus renderer
+                # reads one self-contained dict
+                out["sched"] = dict(sched)
+                out["sched"].setdefault(
+                    "requests_shed_predicted",
+                    self.requests_shed_predicted)
             if steplog is not None:
                 out["steplog"] = dict(steplog)
             if sharding is not None:

@@ -97,6 +97,11 @@ class Request:
         # last requeued for replay
         self.retries = 0
         self.requeued_at: Optional[float] = None
+        # SLO scheduler predictions (serving/sched/): stamped by the
+        # slack admission policy when it last scored this request, read
+        # back at completion for predicted-vs-actual slack error
+        self.sched_predicted_done: Optional[float] = None
+        self.sched_predicted_slack: Optional[float] = None
         self._chunks: _queue.Queue = _queue.Queue()
         self._done = threading.Event()
 
@@ -246,6 +251,37 @@ class RequestQueue:
             if shed:
                 self._q = [r for r in self._q if not low(r)]
             return shed
+
+    def schedule(self, fn) -> List[Request]:
+        """Run one admission-policy transaction over the queued batch
+        requests.  ``fn(batch)`` receives the batch-kind entries in
+        queue order and returns ``(kept, shed)`` — a reordering of them
+        minus the requests to shed.  Kept requests take over the batch
+        positions in the queue (exclusive entries keep their absolute
+        positions); shed requests leave the queue and are returned for
+        the caller to finish.  Atomic under the queue condition."""
+        with self._cond:
+            batch = [r for r in self._q if r.kind == "batch"]
+            if not batch:
+                return []
+            kept, shed = fn(batch)
+            if len(kept) + len(shed) != len(batch):
+                raise RuntimeError(
+                    "admission policy lost requests: %d in, %d kept + "
+                    "%d shed" % (len(batch), len(kept), len(shed)))
+            if not shed and kept == batch:
+                return []          # no-op schedule: queue untouched
+            it = iter(kept)
+            out: List[Request] = []
+            for r in self._q:
+                if r.kind != "batch":
+                    out.append(r)
+                    continue
+                nxt = next(it, None)
+                if nxt is not None:
+                    out.append(nxt)
+            self._q = out
+            return list(shed)
 
     def remove_expired(self, now: float) -> List[Request]:
         """Drop and return every queued request past its deadline."""

@@ -1,0 +1,51 @@
+"""SLO-aware scheduling (paddle_infer_tpu/serving/sched/).
+
+The pluggable policy layer between the admission queue and the ragged
+mixed step.  EngineCore historically admitted FIFO with a static token
+budget and a fixed prefill chunk; deadlines only rejected at the door
+or shed on raw headroom.  This package closes the ROADMAP's
+cost-model loop: the StepLog flight recorder already scores the
+analytic ``StepCostModel`` bytes estimate against measured wall with a
+rolling one-parameter fit (Σwall/Σbytes), so the scheduler can PREDICT
+what a step or a queued request will cost and decide from that.
+
+Layer map:
+
+  ``StepPlanner``       per-step planning: how much of the compiled
+                        ``token_budget`` to fill and how to split it
+                        between decode rows and prompt chunks, from
+                        cost-model predictions calibrated by the
+                        steplog fit.  Decisions are DATA-ONLY — row
+                        packing changes, shapes never do, so the
+                        one-executable / zero-recompile invariant
+                        holds by construction.
+  ``AdmissionPolicy``   queue ordering + predictive shedding.
+                        ``fifo`` (default) is a strict no-op — byte-
+                        identical admission to the pre-sched engine.
+                        ``slack`` orders queued requests by predicted
+                        deadline slack (EDF over predicted completion:
+                        queued prefill tokens ÷ calibrated prefill
+                        tok/s, plus max_new × calibrated step wall)
+                        and sheds requests whose predicted completion
+                        already misses their deadline, instead of
+                        burning prefill on doomed work.
+
+Both run on the engine's stepping thread under the existing step lock
+and hold NO locks of their own (the lock-graph gate stays at 0 cycles /
+0 blocking-under-lock).  All calibration state is read from the shared
+``StepLog``; before the fit has enough samples every policy degrades to
+FIFO-and-never-shed, so a cold engine cannot mispredict.
+"""
+from .planner import StepCalibration, StepPlan, StepPlanner
+from .policy import (AdmissionPolicy, FifoPolicy, SlackPolicy,
+                     make_policy)
+
+__all__ = [
+    "AdmissionPolicy",
+    "FifoPolicy",
+    "SlackPolicy",
+    "StepCalibration",
+    "StepPlan",
+    "StepPlanner",
+    "make_policy",
+]

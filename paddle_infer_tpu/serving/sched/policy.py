@@ -1,0 +1,124 @@
+"""Admission policies — queue ordering and predictive shedding.
+
+A policy sees the queued (batch-kind) requests once per engine sweep,
+just before the FIFO admission loop, and returns (a) the order they
+should be offered free slots in and (b) the requests to shed NOW
+because their predicted completion already misses their deadline.
+
+``fifo`` is the default and a strict no-op: requests keep arrival
+order and nothing is ever shed predictively, so admission is
+byte-identical to the pre-sched engine.  ``slack`` is EDF over
+*predicted* completion:
+
+    predicted_ttft  = (prefill tokens queued ahead + own prompt)
+                      × calibrated prefill s/token
+                      + active-row backlog drain
+    predicted_done  = now + predicted_ttft
+                      + max_new_tokens × calibrated decode step wall
+
+Requests whose ``predicted_done`` exceeds their deadline are shed
+instead of burning prefill budget on doomed work; requests without a
+deadline are never shed and sort last (+inf deadline, arrival order
+preserved among them — the sort is stable).
+
+Policies run on the stepping thread under the engine's step lock and
+inside the queue's condition (``RequestQueue.schedule``); they hold no
+locks of their own and never touch engine state.  Until the steplog
+fit is admission-ready (see ``StepCalibration.admission_ready``) the
+slack policy degrades to FIFO-and-never-shed, so a cold engine cannot
+mispredict a request to death.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Tuple
+
+from .planner import StepCalibration
+
+
+class AdmissionPolicy:
+    """Base policy: FIFO order, never sheds.
+
+    Subclasses override ``schedule``.  ``reorders`` lets the engine
+    skip the queue transaction entirely for the fifo policy, keeping
+    the default hot path identical to the pre-sched engine.
+    """
+
+    name = "fifo"
+    reorders = False
+
+    def __init__(self, slo_ttft_s: Optional[float] = None,
+                 slo_itl_s: Optional[float] = None):
+        self.slo_ttft_s = slo_ttft_s
+        self.slo_itl_s = slo_itl_s
+
+    def schedule(self, queued: List, now: float, cal: StepCalibration,
+                 backlog_tokens: int) -> Tuple[List, List]:
+        """Return (kept_in_admission_order, shed).  ``queued`` is the
+        batch-kind queue contents in arrival order; ``backlog_tokens``
+        is the prefill work still pending on already-active rows."""
+        return list(queued), []
+
+    def snapshot(self) -> dict:
+        return {"name": self.name, "reorders": self.reorders,
+                "slo_ttft_s": self.slo_ttft_s,
+                "slo_itl_s": self.slo_itl_s}
+
+
+class FifoPolicy(AdmissionPolicy):
+    """Arrival order, no predictive shedding (bitwise-compat default)."""
+
+
+class SlackPolicy(AdmissionPolicy):
+    """EDF over predicted completion, with predictive shedding."""
+
+    name = "slack"
+    reorders = True
+
+    def schedule(self, queued: List, now: float, cal: StepCalibration,
+                 backlog_tokens: int) -> Tuple[List, List]:
+        if not queued or cal is None or not cal.admission_ready:
+            return list(queued), []
+        s_tok = float(cal.prefill_s_per_token)
+        s_step = float(cal.decode_step_s)
+        # stable sort: deadline-less requests keep arrival order at
+        # the back, equal deadlines keep arrival order
+        ordered = sorted(
+            queued,
+            key=lambda r: r.deadline if r.deadline is not None
+            else math.inf)
+        kept, shed = [], []
+        cum = int(backlog_tokens)
+        for r in ordered:
+            plen = int(r.prompt.size)
+            ttft = (cum + plen) * s_tok
+            done = now + ttft + int(r.config.max_new_tokens) * s_step
+            if r.deadline is not None:
+                # stash the prediction for predicted-vs-actual slack
+                # scoring when the request finishes (or is shed)
+                r.sched_predicted_done = done
+                r.sched_predicted_slack = float(r.deadline) - done
+                if done > float(r.deadline):
+                    shed.append(r)
+                    continue
+            kept.append(r)
+            cum += plen
+        return kept, shed
+
+
+_POLICIES = {
+    "fifo": FifoPolicy,
+    "slack": SlackPolicy,
+}
+
+
+def make_policy(name: str, *, slo_ttft_s: Optional[float] = None,
+                slo_itl_s: Optional[float] = None) -> AdmissionPolicy:
+    """Build an admission policy by name (``fifo`` or ``slack``)."""
+    try:
+        cls = _POLICIES[str(name)]
+    except KeyError:
+        raise ValueError(
+            "unknown sched policy %r (choices: %s)"
+            % (name, ", ".join(sorted(_POLICIES))))
+    return cls(slo_ttft_s=slo_ttft_s, slo_itl_s=slo_itl_s)

@@ -107,6 +107,36 @@ def test_check_metrics_covers_moe_families():
     assert problems == []
 
 
+def test_check_metrics_covers_sched_families():
+    """The SLO-scheduler families must be exercised by the fabricated
+    snapshot (3-way sync: renderer ↔ docs catalog ↔ check_metrics)."""
+    import check_metrics
+
+    _, _, text = check_metrics.fabricated_exposition()
+    for fam in ("sched_policy_info", "sched_predictive_sheds_total",
+                "sched_planner_plans_total",
+                "sched_planner_chunk_limited_total",
+                "sched_planner_pred_wall_abs_rel_err",
+                "sched_slack_pred_err_seconds",
+                "sched_last_min_slack_seconds"):
+        assert f"# TYPE {fam} " in text, f"{fam} not rendered"
+    problems, _ = check_metrics.run_checks(
+        os.path.join(ROOT, "docs", "OBSERVABILITY.md"))
+    assert problems == []
+
+
+def test_bench_diff_multi_tenant_directions():
+    """multi_tenant keys carry a direction: attainment/goodput up,
+    shed rate and deadline misses down, planner diagnostics neutral."""
+    import bench_diff
+
+    assert bench_diff._direction("slo_attainment_slack") == 1
+    assert bench_diff._direction("goodput_tok_per_s_fifo") == 1
+    assert bench_diff._direction("shed_rate_slack") == -1
+    assert bench_diff._direction("deadline_misses_fifo") == -1
+    assert bench_diff._direction("planner_chunk_limited") == 0
+
+
 @pytest.mark.slow
 def test_moe_bench_child_imports_clean_without_mesh():
     """tools/bench_moe_child.py must import and fail soft on a

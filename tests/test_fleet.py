@@ -591,3 +591,88 @@ def test_fleet_server_routes_and_drains(tmp_path, model):
     finally:
         proc.terminate()
         proc.wait(timeout=30)
+
+
+# -------------------------------------------------- weight-only serving
+
+def test_weight_only_dist_attr_placement():
+    """Quantizing a TP layer must carry the fp weight's dist_attr onto
+    the int8 payload: qweight follows the weight spec, scales shard
+    only on the out-dim (the group axis is a reduction), bias keeps its
+    own spec.  Unstamped buffers would silently replicate the payload
+    per replica in fleet mode and forfeit the fp plan's mp sharding."""
+    from paddle_infer_tpu.parallel.mp_layers import (ColumnParallelLinear,
+                                                     RowParallelLinear)
+    from paddle_infer_tpu.quantization.weight_only import WeightOnlyLinear
+
+    col = ColumnParallelLinear(16, 32, gather_output=False)
+    q = WeightOnlyLinear.from_linear(col)
+    assert q.qweight.dist_attr == (None, "mp")
+    assert q.scale.dist_attr == (None, "mp")
+    assert q.bias.dist_attr == ("mp",)
+    assert q._out_spec == "mp"       # gather_output=False constraint
+
+    row = RowParallelLinear(32, 16)
+    q = WeightOnlyLinear.from_linear(row)
+    assert q.qweight.dist_attr == ("mp", None)
+    assert q.scale.dist_attr == (None, None)   # never on the group axis
+    assert q._out_spec is None
+
+    from paddle_infer_tpu.nn import Linear
+    plain = Linear(8, 8)
+    q = WeightOnlyLinear.from_linear(plain)
+    assert getattr(q.qweight, "dist_attr", None) is None
+
+
+def test_weight_only_fleet_handoff_parity(model):
+    """Regression for serving a weight-only checkpoint across the
+    fleet: prefill on one replica, decode on another, stream bitwise
+    equal to a single-replica run of the same quantized model."""
+    from paddle_infer_tpu.quantization.weight_only import quantize_model
+
+    pit.seed(0)
+    qm = GPTForCausalLM(GPTConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0))
+    qm.eval()
+    quantize_model(qm, algo="weight_only_int8")
+
+    # two engines only: the decode replica doubles as the single-core
+    # reference (its pool drains fully before the handoff run), saving
+    # a third executable compile for the quantized model
+    cores = [EngineCore(PagedGenerationEngine(qm, page_size=8),
+                        decode_chunk=4, **CORE_SHAPE) for _ in range(2)]
+    try:
+        g = GenerationConfig(max_new_tokens=8, do_sample=True,
+                             temperature=0.9, top_p=0.9, seed=3)
+        prompt = _prompt(43, n=24)          # 2 prefill chunks
+
+        request_mod._rid_counter = itertools.count(5400)
+        req_ref = cores[1].submit(prompt, g)[0]
+        _drive(cores[1], [req_ref])
+        want = np.asarray(req_ref.result(timeout=60))
+
+        request_mod._rid_counter = itertools.count(5400)   # same rid
+        src = ReplicaHandle("p0", cores[0], ReplicaRole.PREFILL)
+        dst = ReplicaHandle("d0", cores[1], ReplicaRole.DECODE)
+        req = src.core.submit(prompt, g)[0]
+        for _ in range(400):
+            if ready_for_handoff(src.core, req):
+                break
+            src.core.run_once()
+        else:
+            raise AssertionError("request never became handoff-ready")
+        assert migrate(req, src, dst)
+        _drive(dst.core, [req])
+        np.testing.assert_array_equal(
+            np.asarray(req.result(timeout=60)), want)
+        # the quantized sections survive into each replica's snapshot
+        for c in cores:
+            wo = c.metrics_snapshot()["weight_only"]
+            assert wo["algos"] == ["weight_only_int8"]
+            assert wo["layers"] >= 1
+    finally:
+        for c in cores:
+            c.close()

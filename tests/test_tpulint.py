@@ -721,3 +721,18 @@ def test_cli_list_rules_covers_registry():
                 "tracer-leak", "traced-branch", "missing-donation",
                 "metric-sync", "pallas-grid", "lock-order"):
         assert rid in r.stdout
+
+
+# ------------------------------------------------------- real-tree sweep
+def test_host_sync_clean_over_serving_sched():
+    """The SLO scheduler runs on the stepping thread between device
+    steps: planner/policy code must never force a host sync (the plan
+    is priced from analytic bytes, not materialized activations)."""
+    sched_dir = os.path.join(ROOT, "paddle_infer_tpu", "serving", "sched")
+    files = sorted(os.path.join(sched_dir, f)
+                   for f in os.listdir(sched_dir) if f.endswith(".py"))
+    assert files
+    analyzer = Analyzer(all_rules(["host-sync"]), root=ROOT)
+    findings, n_files = analyzer.run(files)
+    assert n_files == len(files)
+    assert findings == [], [f.message for f in findings]

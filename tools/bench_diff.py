@@ -28,17 +28,20 @@ from typing import Dict, Tuple
 _HIGHER = ("per_s", "per_sec", "speedup", "mfu", "acceptance",
            "hit_rate", "tps", "tok_s", "throughput", "tokens_per",
            "pearson", "improvement", "spec_decode", "bytes_saved",
-           "resident_pages_ratio")
+           "resident_pages_ratio", "attainment", "goodput")
 # quality direction: the quantized_kv section's *_err_* keys fall under
 # the "err" rule below, so a round where int8 serving drifts further
 # from the fp logits (or past its analytic bound) fails the diff the
 # same way a latency regression would.  moe_serving: rising expert
 # utilization skew (routing collapse) and dropped-token ratio are
 # regressions, as are dispatch (all-to-all) bytes per step — while
-# dispatch_bytes_saved lands under the bytes_saved rule above
+# dispatch_bytes_saved lands under the bytes_saved rule above.
+# multi_tenant: attainment/goodput up (rules above); shed rate,
+# deadline misses and slack violations down — a scheduler round that
+# sheds or misses more at equal offered load regressed
 _LOWER = ("_ms", "latency", "ttft", "itl", "err", "wall", "p50",
           "p99", "wasted", "ici_bytes", "compile", "skew", "dropped",
-          "dispatch_bytes", "_s")
+          "dispatch_bytes", "shed", "misses", "violation", "_s")
 # harness bookkeeping, not workload performance
 _SKIP = ("vs_baseline", "child_wall_s", "bench_wall_s", "n", "rc")
 

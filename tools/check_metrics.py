@@ -64,7 +64,8 @@ def fabricated_exposition():
                    ici_bytes_est=4.0e4, ici_bytes_saved_est=1.2e5,
                    cost_source="xla+pages", decode_rows=3,
                    prefill_chunk_tokens=16, emitted_tokens=4,
-                   kernel="ragged")
+                   planned_tokens=19, planned_chunk_cap=16,
+                   predicted_wall_s=0.014, kernel="ragged")
     steplog.record("mixed", wall_s=0.017, dispatch_s=0.013,
                    bytes_est=1.8e6, flops_est=5.0e6,
                    cost_source="xla+pages", decode_rows=3,
@@ -101,8 +102,33 @@ def fabricated_exposition():
     m.on_watchdog_trip()
     m.on_quarantined()
     m.on_shed()
+    m.on_predictive_shed(2)
     m.on_loop_exception()
     snap = m.snapshot(queue_depth=1, active=2, max_batch=4,
+                      # EngineCore._sched_snapshot() shape: policy +
+                      # planner + predicted-vs-actual slack error
+                      sched={"policy": "slack", "reorders": True,
+                             "slo_ttft_s": 0.5, "slo_itl_s": 0.05,
+                             "predictive_sheds": 2,
+                             "last_min_slack_s": 0.31,
+                             "slack_err": {"n": 3,
+                                           "mean_abs_err_s": 0.04,
+                                           "max_abs_err_s": 0.09},
+                             "planner": {"plans": 40,
+                                         "chunk_limited_steps": 5,
+                                         "dynamic": True,
+                                         "slo_itl_s": 0.05,
+                                         "token_budget": 64,
+                                         "prefill_chunk": 16,
+                                         "calibration": {
+                                             "fit_ready": True,
+                                             "admission_ready": True,
+                                             "scale_s_per_byte": 9e-9,
+                                             "decode_step_s": 0.015,
+                                             "prefill_s_per_token":
+                                                 9.4e-4,
+                                             "n_decode": 12,
+                                             "n_prefill": 3}}},
                       resilience={"health_state": "degraded",
                                   "health_code": 1, "draining": False,
                                   "effective_max_batch": 2,

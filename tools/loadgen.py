@@ -1,0 +1,216 @@
+"""Multi-tenant trace-replay load generator for the serving engine.
+
+Produces the OFFERED LOAD for scheduler A/B runs: a seeded, bursty,
+multi-tenant arrival trace that can be written to JSONL and replayed
+byte-identically, so ``fifo`` vs ``slack`` policy runs (bench.py
+``multi_tenant`` section, tests/test_sched.py) compare scheduling
+decisions — never workload noise.
+
+Trace model:
+
+  * arrivals — per-tenant renewal process with Gamma-distributed
+    interarrivals: ``shape = 1/burstiness`` at fixed mean ``1/rate``,
+    so ``burstiness=1`` is Poisson and larger values clump arrivals
+    into bursts separated by silence (the regime that separates EDF
+    from FIFO).
+  * tenants — each tenant class draws prompt length, ``max_new`` and a
+    deadline class (``timeout_s``; None = no deadline) from its own
+    ranges, and may carry a shared prompt prefix: all of a tenant's
+    requests repeat the same leading tokens and the tenant's
+    ``cache_salt``, so replays ride the prefix cache exactly like a
+    fleet of users sharing a system prompt.
+  * determinism — everything is drawn from one ``numpy`` RandomState
+    seeded by the caller.  The same seed yields the same event list,
+    and ``write_trace``/``read_trace`` round-trip it losslessly, so a
+    recorded trace IS the workload.
+
+Replay: ``request_from_event`` builds the engine-side ``Request`` for
+one event.  Per-row sampling keys are ``fold_in(PRNGKey(seed), rid)``,
+so two replays that pin the rid counter to the same base (see
+tests/test_kv_quant.py) produce bitwise-identical token streams no
+matter how the scheduler interleaves them.
+
+Also runnable as a script:
+    python tools/loadgen.py --seed 0 --duration_s 10 --out trace.jsonl
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict, List
+
+import numpy as np
+
+# default tenant mix: one latency-sensitive interactive class, one
+# shared-prefix RAG-style class with moderate deadlines, one
+# deadline-less batch class with long prompts (the class FIFO burns
+# everyone else's slack on)
+DEFAULT_TENANTS = (
+    {"name": "chat", "weight": 3.0, "prompt_len": (4, 12),
+     "max_new": (8, 16), "timeout_s": (0.8, 1.6),
+     "shared_prefix_len": 0, "cache_salt": None},
+    {"name": "rag", "weight": 2.0, "prompt_len": (10, 20),
+     "max_new": (8, 16), "timeout_s": (1.5, 3.0),
+     "shared_prefix_len": 8, "cache_salt": "tenant-rag"},
+    {"name": "batch", "weight": 1.0, "prompt_len": (24, 40),
+     "max_new": (16, 32), "timeout_s": None,
+     "shared_prefix_len": 0, "cache_salt": None},
+)
+
+
+def generate_trace(seed: int, duration_s: float, rate_per_s: float,
+                   tenants=DEFAULT_TENANTS, vocab_size: int = 96,
+                   burstiness: float = 4.0,
+                   do_sample: bool = False) -> List[Dict]:
+    """Seeded bursty multi-tenant trace: a time-sorted list of event
+    dicts ``{t, i, tenant, prompt, max_new, timeout_s, cache_salt,
+    seed, do_sample}``.  ``rate_per_s`` is the TOTAL offered rate,
+    split across tenants by weight."""
+    rng = np.random.RandomState(int(seed))
+    burstiness = max(float(burstiness), 1e-6)
+    total_w = sum(float(t["weight"]) for t in tenants)
+    prefixes = {}
+    for t in tenants:
+        n = int(t.get("shared_prefix_len") or 0)
+        prefixes[t["name"]] = (
+            rng.randint(0, vocab_size, (n,)).astype(np.int32)
+            if n else np.zeros((0,), np.int32))
+    events: List[Dict] = []
+    for t in tenants:
+        rate = rate_per_s * float(t["weight"]) / total_w
+        if rate <= 0.0:
+            continue
+        shape = 1.0 / burstiness
+        scale = burstiness / rate        # keeps the mean at 1/rate
+        now = float(rng.gamma(shape, scale))
+        while now < duration_s:
+            lo, hi = t["prompt_len"]
+            plen = int(rng.randint(lo, hi + 1))
+            prefix = prefixes[t["name"]]
+            suffix = rng.randint(
+                0, vocab_size,
+                (max(plen - prefix.size, 1),)).astype(np.int32)
+            lo, hi = t["max_new"]
+            max_new = int(rng.randint(lo, hi + 1))
+            tmo = t["timeout_s"]
+            if tmo is not None:
+                tmo = float(rng.uniform(tmo[0], tmo[1]))
+            events.append({
+                "t": round(now, 6),
+                "tenant": t["name"],
+                "prompt": [int(x) for x in prefix] +
+                          [int(x) for x in suffix],
+                "max_new": max_new,
+                "timeout_s": (round(tmo, 6) if tmo is not None
+                              else None),
+                "cache_salt": t.get("cache_salt"),
+                "seed": int(rng.randint(0, 2 ** 31 - 1)),
+                "do_sample": bool(do_sample),
+            })
+            now += float(rng.gamma(shape, scale))
+    events.sort(key=lambda e: (e["t"], e["tenant"]))
+    for i, e in enumerate(events):
+        e["i"] = i
+    return events
+
+
+def write_trace(path: str, events: List[Dict]) -> None:
+    """One JSON object per line, key-sorted — byte-stable for a given
+    event list, so identical seeds produce identical files."""
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e, sort_keys=True) + "\n")
+
+
+def read_trace(path: str) -> List[Dict]:
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    return events
+
+
+def request_from_event(event: Dict):
+    """Build the engine-side ``Request`` for one trace event.  The
+    request's arrival clock starts NOW — construct it at its replay
+    time, not up front, or deadlines measure trace generation."""
+    from paddle_infer_tpu.inference import GenerationConfig
+    from paddle_infer_tpu.serving import Request
+
+    g = GenerationConfig(max_new_tokens=int(event["max_new"]),
+                         do_sample=bool(event.get("do_sample", False)),
+                         seed=int(event.get("seed", 0)))
+    return Request(np.asarray(event["prompt"], np.int32), g,
+                   timeout_s=event.get("timeout_s"),
+                   cache_salt=event.get("cache_salt"))
+
+
+def replay(core, events: List[Dict], time_scale: float = 1.0,
+           step_wait_s: float = 0.001,
+           timeout_s: float = 600.0) -> Dict[int, object]:
+    """Drive ``core.run_once`` while submitting each event at
+    ``event["t"] * time_scale`` seconds of wall clock.  Returns
+    ``{event_i: Request}`` (rejected/shed requests included — their
+    state says what happened).  The core must NOT be started: replay
+    owns the stepping, so the schedule is single-threaded and
+    reproducible."""
+    import time as _time
+
+    from paddle_infer_tpu.serving import RejectedError, RequestState
+
+    handles: Dict[int, object] = {}
+    t0 = _time.monotonic()
+    i = 0
+    deadline = t0 + timeout_s
+    while True:
+        now = _time.monotonic()
+        if now > deadline:
+            raise TimeoutError(
+                f"trace replay exceeded {timeout_s}s "
+                f"({i}/{len(events)} submitted)")
+        while i < len(events) and events[i]["t"] * time_scale <= now - t0:
+            req = request_from_event(events[i])
+            try:
+                core.enqueue(req)
+            except RejectedError as e:
+                # enqueue refuses BEFORE the request enters the queue,
+                # so nothing ever finishes it — close the handle here or
+                # result() would hang
+                req._finish(RequestState.REJECTED, e)
+            handles[events[i]["i"]] = req
+            i += 1
+        busy = core.run_once(wait_s=0.0)
+        if i >= len(events) and not busy and not core.active_count \
+                and not len(core._queue):
+            break
+        if not busy:
+            _time.sleep(step_wait_s)
+    return handles
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--duration_s", type=float, default=10.0)
+    ap.add_argument("--rate_per_s", type=float, default=8.0)
+    ap.add_argument("--burstiness", type=float, default=4.0,
+                    help="interarrival Gamma burstiness (1 = Poisson)")
+    ap.add_argument("--vocab_size", type=int, default=96)
+    ap.add_argument("--out", required=True, help="output trace JSONL")
+    args = ap.parse_args(argv)
+    events = generate_trace(args.seed, args.duration_s, args.rate_per_s,
+                            vocab_size=args.vocab_size,
+                            burstiness=args.burstiness)
+    write_trace(args.out, events)
+    tenants = {}
+    for e in events:
+        tenants[e["tenant"]] = tenants.get(e["tenant"], 0) + 1
+    print(json.dumps({"events": len(events), "by_tenant": tenants,
+                      "out": args.out}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

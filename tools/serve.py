@@ -116,7 +116,10 @@ def _build_fleet(roles):
                 "prefix_cache_headroom_pages", 0),
             ragged=True,
             prefill_chunk=_STATE.get("prefill_chunk"),
-            token_budget=_STATE.get("token_budget"))
+            token_budget=_STATE.get("token_budget"),
+            sched_policy=_STATE.get("sched_policy", "fifo"),
+            slo_ttft_s=_STATE.get("slo_ttft_s"),
+            slo_itl_s=_STATE.get("slo_itl_s"))
         sup = EngineSupervisor(
             core,
             watchdog_s=_STATE.get("watchdog_s", 5.0),
@@ -178,6 +181,9 @@ def _core():
                 ragged=_STATE.get("ragged", True),
                 prefill_chunk=_STATE.get("prefill_chunk"),
                 token_budget=_STATE.get("token_budget"),
+                sched_policy=_STATE.get("sched_policy", "fifo"),
+                slo_ttft_s=_STATE.get("slo_ttft_s"),
+                slo_itl_s=_STATE.get("slo_itl_s"),
                 speculate=_STATE.get("speculate", False),
                 num_draft_tokens=_STATE.get("num_draft_tokens", 4),
                 draft_source=_STATE.get("draft_source", "auto"),
@@ -616,6 +622,23 @@ def main(argv=None):
                          "budget); smaller chunks tighten decode ITL "
                          "under long-prompt arrivals at the cost of "
                          "prefill latency")
+    ap.add_argument("--sched_policy", default="fifo",
+                    choices=["fifo", "slack"],
+                    help="admission policy (serving/sched/): fifo keeps "
+                         "arrival order (bitwise-compat default); slack "
+                         "orders queued requests by predicted deadline "
+                         "slack and predictively sheds requests whose "
+                         "predicted completion already misses their "
+                         "deadline (docs/SERVING.md \"SLO-aware "
+                         "scheduling\")")
+    ap.add_argument("--slo_ttft_ms", type=float, default=None,
+                    help="target time-to-first-token (ms) the slack "
+                         "policy budgets admission against")
+    ap.add_argument("--slo_itl_ms", type=float, default=None,
+                    help="target inter-token latency (ms): the step "
+                         "planner shrinks per-step prompt chunking so "
+                         "the predicted mixed-step wall stays under it "
+                         "when decode rows share the step")
     ap.add_argument("--legacy_programs", action="store_true",
                     help="run the pre-ragged per-shape program family "
                          "(bucketed prefill + fused decode) instead of "
@@ -868,6 +891,11 @@ def main(argv=None):
     _STATE["ragged"] = not args.legacy_programs
     _STATE["token_budget"] = args.token_budget
     _STATE["prefill_chunk"] = args.prefill_chunk
+    _STATE["sched_policy"] = args.sched_policy
+    _STATE["slo_ttft_s"] = (args.slo_ttft_ms / 1e3
+                            if args.slo_ttft_ms is not None else None)
+    _STATE["slo_itl_s"] = (args.slo_itl_ms / 1e3
+                           if args.slo_itl_ms is not None else None)
     _STATE["draft_model"] = (AutoModel.from_pretrained(args.draft_dir)
                              if args.draft_dir else None)
     _STATE["num_draft_tokens"] = args.num_draft_tokens
