@@ -663,6 +663,13 @@ def _child_main():
                                lambda: _multi_tenant_bench(on_tpu),
                                tpu_only=False)
 
+    # multi-LoRA tenancy: one Zipf popularity draw served at 1 / 32 /
+    # 256 addressable adapters over 8 device slots — tok/s + ITL p99
+    # scaling, and the zero-recompile-under-churn gate
+    adapter_tenancy = run_section("adapter_tenancy", 500,
+                                  lambda: _adapter_tenancy_bench(on_tpu),
+                                  tpu_only=False)
+
     result = {
         **headline,
         "tokens_per_sec_single_block": round(tokens_per_sec_single, 1),
@@ -731,6 +738,8 @@ def _child_main():
         result["moe_serving"] = moe_serving
     if multi_tenant is not None:
         result["multi_tenant"] = multi_tenant
+    if adapter_tenancy is not None:
+        result["adapter_tenancy"] = adapter_tenancy
     if skipped_sections:
         result["skipped_sections"] = skipped_sections
     result["child_wall_s"] = round(time.monotonic() - child_t0, 1)
@@ -1333,6 +1342,113 @@ def _multi_tenant_bench(on_tpu: bool):
             planner["mean_abs_rel_err"], 4)
         out["planner_pred_wall_max_abs_rel_err"] = round(
             planner["max_abs_rel_err"], 4)
+    return out
+
+
+def _adapter_tenancy_bench(on_tpu: bool):
+    """Multi-LoRA tenancy scaling: the SAME offered load (48 requests
+    whose adapter ids follow one recorded Zipf popularity draw) served
+    with 1, 32 and 256 of the registered adapters addressable, over a
+    fixed S=8 device-slot pool.  Residency churn (hundreds of tenants
+    over 7 usable slots) must stay DATA — uploads are ``.at[slot].set``
+    payload rebinds into fixed-shape pools, so the decode executable
+    compiles once in warmup and every config must report ZERO
+    post-warmup compiles; the cost of tenancy shows up as upload
+    traffic and cache hit rate, never as recompiles."""
+    import itertools
+
+    import paddle_infer_tpu as pit
+    from paddle_infer_tpu.inference import (GenerationConfig,
+                                            PagedGenerationEngine)
+    from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_infer_tpu.observability.compilelog import get_compile_log
+    from paddle_infer_tpu.serving import EngineCore
+    from paddle_infer_tpu.serving import request as request_mod
+    from paddle_infer_tpu.serving.adapters import (AdapterStore,
+                                                   adapter_layer_spec)
+
+    pit.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    intermediate_size=256, max_position_embeddings=128,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    spec = adapter_layer_spec(model)
+    rank, slots, n_req, max_new = 8, 8, 48, 8
+
+    # one arena with all 256 tenants registered up front: the 1- and
+    # 32-adapter configs address a prefix of the SAME store, so host
+    # registration cost is identical and only residency churn varies
+    frng = np.random.RandomState(7)
+    store = AdapterStore(spec, rank=rank)
+    for j in range(256):
+        store.add(f"bench-{j}", {
+            p: (frng.randn(d_in, rank).astype(np.float32) * 0.05,
+                frng.randn(rank, d_out).astype(np.float32) * 0.05)
+            for p, (d_in, d_out) in spec.items()})
+
+    g = GenerationConfig(max_new_tokens=max_new)
+    prng = np.random.RandomState(11)
+    prompts = [prng.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
+               for n in prng.randint(6, 14, size=n_req)]
+    # one popularity draw shared by every config: folding it modulo the
+    # addressable-adapter count keeps the request sequence identical
+    # while widening the tenant tail from 1 to 256 distinct ids
+    draws = [int(z) - 1 for z in
+             np.random.RandomState(23).zipf(1.5, size=n_req)]
+
+    def run(n_adapters):
+        request_mod._rid_counter = itertools.count(70_000)
+        core = EngineCore(
+            PagedGenerationEngine(model, page_size=16),
+            max_batch=8, max_model_len=32, token_budget=32,
+            prefill_chunk=16,
+            adapter_store=store, adapter_slots=slots)
+        try:
+            warm = [core.submit(prompts[0], g)[0],
+                    core.submit(prompts[1], g, adapter_id="bench-0")[0]]
+            while not all(r.done for r in warm):
+                core.run_once()
+            core.metrics.reset()
+            compiles0 = get_compile_log().summary()[
+                "post_warmup_decode_compiles"]
+            c0 = core._adapters.summary()
+            t0 = time.perf_counter()
+            reqs = [core.submit(
+                p, g, adapter_id=f"bench-{draws[k] % n_adapters}")[0]
+                for k, p in enumerate(prompts)]
+            while not all(r.done for r in reqs):
+                core.run_once()
+            wall = time.perf_counter() - t0
+            toks = sum(r.emitted for r in reqs)
+            compiles = get_compile_log().summary()[
+                "post_warmup_decode_compiles"] - compiles0
+            snap = core.metrics_snapshot()
+            c1 = core._adapters.summary()
+        finally:
+            core.close()
+        hits = c1["hits"] - c0["hits"]
+        lookups = hits + c1["misses"] - c0["misses"]
+        itl_p99 = snap["inter_token_latency_s"]["p99_recent"]
+        return {
+            "tok_per_s": round(toks / wall, 1),
+            "itl_p99_s": round(itl_p99, 5) if itl_p99 else None,
+            "hit_rate": round(hits / max(lookups, 1), 3),
+            "uploads": c1["uploads"] - c0["uploads"],
+            "evictions": c1["evictions"] - c0["evictions"],
+            "post_warmup_decode_compiles": int(compiles),
+        }
+
+    out = {"device_slots": slots, "rank": rank, "requests": n_req,
+           "registered_adapters": 256}
+    total_compiles = 0
+    for n in (1, 32, 256):
+        r = run(n)
+        total_compiles += r["post_warmup_decode_compiles"]
+        out[f"adapters_{n}"] = r
+    out["churn_zero_recompiles"] = bool(total_compiles == 0)
     return out
 
 

@@ -51,6 +51,16 @@ _MOE_SHAPE_VALUED = frozenset({"num_experts", "n_experts", "experts",
                                "capacity", "expert_capacity",
                                "moe_capacity"})
 
+# likewise for the multi-LoRA plane: the stacked pool shapes
+# [slots, d, r] are DEPLOYMENT config (one (slots, rank) per config,
+# baked into the converted LoRAServingLinear layers), so a serving
+# build_* signature taking rank or slot count re-opens a
+# per-adapter-shape program family — residency churn would then
+# compile instead of riding as per-row slot data.
+_ADAPTER_SHAPE_VALUED = frozenset({"rank", "lora_rank", "adapter_rank",
+                                   "adapter_slots", "num_adapters",
+                                   "n_adapters", "slot_count"})
+
 
 def _element_label(el: ast.AST) -> str:
     if isinstance(el, ast.JoinedStr):
@@ -108,6 +118,17 @@ class RecompileHazardRule(Rule):
                     "capacity are deployment config: bake them into "
                     "the converted layers (prepare_moe_serving) and "
                     "key the ONE executable on the config tuple")
+            lora_hazards = [n for n in names
+                            if n in _ADAPTER_SHAPE_VALUED]
+            if lora_hazards:
+                yield ctx.finding(
+                    self.id, node,
+                    f"adapter-shape-keyed serving builder {node.name}"
+                    f"({', '.join(lora_hazards)}) re-opens a per-"
+                    "adapter-shape program family — rank and slot "
+                    "count are deployment config: bake them into the "
+                    "converted layers (prepare_lora_serving) and pass "
+                    "which adapter each row runs as per-row slot DATA")
 
     def _check_assign(self, ctx: FileContext, node: ast.Assign):
         key_target = any(isinstance(t, ast.Name)

@@ -185,14 +185,19 @@ class GenerationEngine:
         weight-only layers register their (qweight, scale, bias) payloads
         as buffers, not Parameters — left out of the snapshot they would
         be traced as jit constants (re-uploaded per executable, invisible
-        to refresh_params, unplaceable under a mesh)."""
+        to refresh_params, unplaceable under a mesh).  LoRA serving
+        wrappers register their stacked slot pools the same way: the
+        AdapterCache swaps slot contents between steps by rebinding the
+        buffer payload, which only reaches the executable because the
+        pools ride here as jit ARGUMENTS, not trace constants."""
         from ..quantization.moe import Int8MoELayer, WeightOnlyMoELayer
         from ..quantization.weight_only import WeightOnlyLinear
+        from ..serving.adapters.layer import LoRAServingLinear
 
         out = {}
         for lname, layer in self._model.named_sublayers():
             if isinstance(layer, (WeightOnlyLinear, WeightOnlyMoELayer,
-                                  Int8MoELayer)):
+                                  Int8MoELayer, LoRAServingLinear)):
                 for bn, buf in layer.named_buffers(
                         prefix=lname, include_sublayers=False):
                     out[bn] = buf

@@ -245,6 +245,62 @@ def render_prometheus(snapshot: dict,
                  "recent mixed step (mean across MoE layers)")
         w.sample("moe_gate_aux_loss", moe.get("gate_aux_loss", 0.0))
 
+    ad = snapshot.get("adapters") or {}
+    if ad:
+        w.family("adapter_info", "gauge",
+                 "Multi-LoRA serving plane config as labels (constant "
+                 "1): device slot count (slot 0 = identity), the "
+                 "deployment's fixed rank, converted target "
+                 "projections")
+        w.sample("adapter_info", 1, {
+            "slots": ad.get("slots", 0),
+            "rank": ad.get("rank", 0),
+            "layers": ad.get("layers", 0)})
+        w.family("adapter_pool_hbm_bytes", "gauge",
+                 "Resident bytes of the stacked adapter slot pools "
+                 "(A/B factors + scales across all converted layers)")
+        w.sample("adapter_pool_hbm_bytes", ad.get("pool_hbm_bytes"))
+        w.family("adapter_slots_resident", "gauge",
+                 "Device slots currently holding an adapter")
+        w.sample("adapter_slots_resident", ad.get("resident", 0))
+        w.family("adapter_slots_pinned", "gauge",
+                 "Device slots pinned by in-flight rows (unpinned "
+                 "residents are the LRU-evictable set)")
+        w.sample("adapter_slots_pinned", ad.get("pinned", 0))
+        w.family("adapter_cache_hits_total", "counter",
+                 "Admission-time acquires served by an already-resident "
+                 "slot")
+        w.sample("adapter_cache_hits_total", ad.get("hits", 0))
+        w.family("adapter_cache_misses_total", "counter",
+                 "Acquires that required a host -> device upload "
+                 "(free slot or LRU eviction)")
+        w.sample("adapter_cache_misses_total", ad.get("misses", 0))
+        w.family("adapter_cache_hit_rate", "gauge",
+                 "hits / (hits + misses) over the process lifetime")
+        w.sample("adapter_cache_hit_rate", ad.get("hit_rate", 0.0))
+        w.family("adapter_uploads_total", "counter",
+                 "Host -> device adapter uploads (one per miss that "
+                 "won a slot)")
+        w.sample("adapter_uploads_total", ad.get("uploads", 0))
+        w.family("adapter_upload_bytes_total", "counter",
+                 "Factor bytes moved host -> device by adapter uploads")
+        w.sample("adapter_upload_bytes_total", ad.get("upload_bytes", 0))
+        w.family("adapter_evictions_total", "counter",
+                 "Resident adapters displaced by the slot LRU")
+        w.sample("adapter_evictions_total", ad.get("evictions", 0))
+        st = ad.get("store") or {}
+        w.family("adapter_store_adapters", "gauge",
+                 "Tenant adapters registered in the host-side paged "
+                 "store")
+        w.sample("adapter_store_adapters", st.get("adapters", 0))
+        w.family("adapter_store_pages", "gauge",
+                 "Host arena pages by state (the store's KV-pool-style "
+                 "residency bound)")
+        w.sample("adapter_store_pages", st.get("pages_total"),
+                 {"state": "total"})
+        w.sample("adapter_store_pages", st.get("pages_used"),
+                 {"state": "used"})
+
     px = snapshot.get("prefix_cache") or {}
     if px:
         w.family("prefix_cache_queries_total", "counter",
@@ -454,6 +510,11 @@ def render_prometheus(snapshot: dict,
                  "recorded mixed steps")
         w.sample("steplog_moe_tokens_dropped_total",
                  sl.get("moe_tokens_dropped_total", 0))
+        w.family("steplog_adapter_rows_total", "counter",
+                 "Batch rows that ran with a non-identity LoRA adapter "
+                 "slot across recorded mixed steps")
+        w.sample("steplog_adapter_rows_total",
+                 sl.get("adapter_rows_total", 0))
         model = sl.get("decode_model") or {}
         w.family("steplog_model_abs_rel_error", "gauge",
                  "Mean absolute relative error of the fitted step-cost "

@@ -82,6 +82,8 @@ _SCHEMA = (
     ("draft_tokens", 0),         # speculative draft tokens verified
     ("draft_accepted", 0),       # drafts accepted (extra tokens won)
     ("spec_rows", 0),            # rows that carried drafts this step
+    ("adapter_rows", 0),         # rows decoding under a non-identity
+                                 # LoRA adapter slot this step
     ("moe_tokens_routed", 0),    # valid token-expert assignments kept
                                  # this step (summed over moe layers)
     ("moe_tokens_dropped", 0),   # valid assignments lost to capacity
@@ -187,6 +189,21 @@ class StepCostModel:
                     }
             except Exception:
                 self._moe_a2a = None
+        # multi-LoRA adapter pricing: a row bound to a non-identity
+        # slot gathers its per-layer (A, B) factors — 4*r*(d_in+d_out)
+        # bytes per converted layer — on top of the base weight pass.
+        # Sized at construction like the MoE term: EngineCore builds
+        # the cost model after prepare_lora_serving.
+        self._lora_row_bytes = 0.0
+        if model is not None:
+            try:
+                from ..serving.adapters.layer import lora_layers
+
+                self._lora_row_bytes = float(sum(
+                    4 * lay.rank * (lay.in_features + lay.out_features)
+                    for _, lay in lora_layers(model)))
+            except Exception:
+                self._lora_row_bytes = 0.0
 
     @property
     def page_kv_bytes(self) -> float:
@@ -275,11 +292,14 @@ class StepCostModel:
 
     def estimate(self, kind: str, key=None, *, rows: int = 1,
                  max_rows: int = 1, pages_touched: int = 0,
-                 chunk: int = 1, tokens: Optional[int] = None):
+                 chunk: int = 1, tokens: Optional[int] = None,
+                 adapter_rows: int = 0):
         """Return ``(bytes_est, flops_est, cost_source)`` for one step
         event.  ``pages_touched`` is the KV pages the step reads or
         writes (resident pages for decode — every scan step re-reads
-        them; the reservation for prefill; freed pages for evict)."""
+        them; the reservation for prefill; freed pages for evict).
+        ``adapter_rows`` prices the per-row LoRA factor gathers of the
+        multi-adapter mixed step on top of the base weight pass."""
         pages = max(0, int(pages_touched))
         if kind == "evict":
             # host-only: no HBM traffic, but the freed KV bytes are the
@@ -312,6 +332,10 @@ class StepCostModel:
                         * max(ntok_kv, 1.0) / max(rows, 1))
         else:
             kv_moved = pages * self._page_kv_bytes
+        # adapter-bound rows stream their slot's stacked (A, B) factors
+        # in addition to the shared base weights — count it with the KV
+        # term so both cost sources carry it
+        kv_moved += max(0, int(adapter_rows)) * self._lora_row_bytes
         frac = (rows / max_rows) if max_rows > 0 else 1.0
         static = self.static_cost(key)
         if static is not None:
@@ -385,6 +409,7 @@ class StepLog:
         self._draft_accepted_total = 0
         self._moe_routed_total = 0
         self._moe_dropped_total = 0
+        self._adapter_rows_total = 0
         self._by_kernel: Dict[str, int] = {}
         # (bytes_est, wall_s) for clean decode chunks — the model fit
         self._model: deque = deque(maxlen=int(model_window))
@@ -423,6 +448,7 @@ class StepLog:
             self._draft_accepted_total += int(rec["draft_accepted"])
             self._moe_routed_total += int(rec["moe_tokens_routed"])
             self._moe_dropped_total += int(rec["moe_tokens_dropped"])
+            self._adapter_rows_total += int(rec["adapter_rows"])
             if rec["kernel"]:
                 self._by_kernel[rec["kernel"]] = \
                     self._by_kernel.get(rec["kernel"], 0) + 1
@@ -478,6 +504,7 @@ class StepLog:
             self._draft_accepted_total = 0
             self._moe_routed_total = 0
             self._moe_dropped_total = 0
+            self._adapter_rows_total = 0
             self._by_kernel = {}
 
     def calibration(self) -> Dict:
@@ -525,6 +552,7 @@ class StepLog:
                 "draft_accepted_total": self._draft_accepted_total,
                 "moe_tokens_routed_total": self._moe_routed_total,
                 "moe_tokens_dropped_total": self._moe_dropped_total,
+                "adapter_rows_total": self._adapter_rows_total,
             }
         out["decode_model"] = _model_summary(pairs)
         # predicted-vs-measured step wall for planner-annotated steps

@@ -48,6 +48,12 @@ Layer map:
                       (bitwise-compat default) and ``slack`` (EDF over
                       predicted completion with predictive shedding);
                       docs/SERVING.md "SLO-aware scheduling".
+  ``adapters``        multi-LoRA tenancy: paged host ``AdapterStore``,
+                      device-resident slot-LRU ``AdapterCache`` with
+                      pin refcounts, and the in-place conversion
+                      (``prepare_lora_serving``) adding per-row ragged
+                      LoRA gathers inside the one mixed-step executable
+                      (docs/SERVING.md "Multi-LoRA serving").
 
 Requests with per-request sampling configs share one decode executable:
 temperature/top-k/top-p/eos ride as *per-row arrays* (serving/programs),
@@ -57,7 +63,12 @@ so admitting a new request never recompiles the hot loop.
 from .metrics import ServingMetrics
 from .request import (DeadlineExceededError, HandoffError, LoadShedError,
                       QuarantinedError, QueueFullError, RejectedError,
-                      Request, RequestQueue, RequestState)
+                      Request, RequestQueue, RequestState,
+                      effective_salt)
+from .adapters import (AdapterCache, AdapterError, AdapterStore,
+                       LoRAServingLinear, UnknownAdapterError,
+                       adapter_layer_spec, lora_serving_info,
+                       make_random_adapter, prepare_lora_serving)
 from .engine_core import EngineCore
 from .resilience import (EngineSupervisor, FaultPlane, FaultSpec,
                          HealthMonitor, HealthState)
@@ -72,6 +83,16 @@ from .sched import (AdmissionPolicy, FifoPolicy, SlackPolicy,
                     StepPlanner, make_policy)
 
 __all__ = [
+    "AdapterCache",
+    "AdapterError",
+    "AdapterStore",
+    "LoRAServingLinear",
+    "UnknownAdapterError",
+    "adapter_layer_spec",
+    "effective_salt",
+    "lora_serving_info",
+    "make_random_adapter",
+    "prepare_lora_serving",
     "AdmissionPolicy",
     "FifoPolicy",
     "SlackPolicy",

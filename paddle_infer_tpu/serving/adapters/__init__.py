@@ -1,0 +1,30 @@
+"""Multi-LoRA adapter tenancy for the serving engine.
+
+Three pieces, mirroring the KV plane's host/device split:
+
+* :class:`AdapterStore` (store.py) — paged host-side registry of
+  validated per-tenant LoRA checkpoints at the deployment's fixed rank.
+* :class:`AdapterCache` (cache.py) — device-resident stacked slot pools
+  with slot-granular LRU eviction and pin refcounts for in-flight rows.
+* :class:`LoRAServingLinear` + :func:`prepare_lora_serving` (layer.py)
+  — in-place conversion adding the batched ragged LoRA delta
+  ``y += scale[slot] * ((x @ A[slot]) @ B[slot])`` to every target
+  projection, slot-selected per row via the thread-local side-channel
+  (slots.py) inside the ONE mixed-step executable.
+
+Shapes in the executable key are deployment constants only
+``(adapter_slots, rank)``; which adapter a row runs is data.
+"""
+from .cache import AdapterCache
+from .layer import (DEFAULT_TARGETS, LoRAServingLinear,
+                    adapter_layer_spec, lora_layers, lora_serving_info,
+                    prepare_lora_serving)
+from .store import (AdapterError, AdapterStore, UnknownAdapterError,
+                    make_random_adapter)
+
+__all__ = [
+    "AdapterCache", "AdapterError", "AdapterStore", "DEFAULT_TARGETS",
+    "LoRAServingLinear", "UnknownAdapterError", "adapter_layer_spec",
+    "lora_layers", "lora_serving_info", "make_random_adapter",
+    "prepare_lora_serving",
+]

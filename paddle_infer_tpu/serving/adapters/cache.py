@@ -1,0 +1,216 @@
+"""Device-resident LRU cache of adapter slots over the stacked pools.
+
+One cache per EngineCore: it owns slots ``1..S-1`` of every converted
+layer's ``[slots, ...]`` pool buffers (slot 0 is the reserved all-zero
+identity) and maps ``adapter_id -> slot`` with slot-granular LRU
+eviction and per-slot pin refcounts — the KV radix-tree refcount
+discipline applied to adapters.  Admission pins a request's slot before
+the row enters the batch; eviction of a pinned slot is impossible, and
+``pin`` raises ``MemoryError`` when every slot is pinned, which the
+scheduler routes through the same degradation ladder as KV pressure.
+
+Uploads rebind the pool buffers' payloads with ``.at[slot].set`` —
+fixed shapes, so the mixed-step executable never recompiles; jax
+dispatches the host→device copies asynchronously and the follow-up
+``engine.refresh_params()`` re-snapshots (and re-places, under a mesh)
+only the rebound buffers.  Slot selection stays per-row DATA in the
+step, so residency churn is invisible to the compile log.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .layer import lora_layers, lora_serving_info
+from .store import AdapterError, AdapterStore
+
+
+class AdapterCache:
+    """Slot-granular LRU over the engine's stacked LoRA pools."""
+
+    def __init__(self, engine, store: AdapterStore):
+        info = lora_serving_info(engine._model)
+        if info is None:
+            raise AdapterError(
+                "model has no LoRA serving layers — call "
+                "prepare_lora_serving first")
+        if int(store.rank) != int(info["rank"]):
+            raise AdapterError(
+                f"store rank {store.rank} != converted pool rank "
+                f"{info['rank']}")
+        self._engine = engine
+        self._store = store
+        self._layers = list(lora_layers(engine._model))
+        missing = [p for p in store.spec if p not in
+                   {path for path, _ in self._layers}]
+        if missing:
+            raise AdapterError(
+                f"store spec names layers the converted model lacks: "
+                f"{missing[:4]}")
+        for path, lay in self._layers:
+            if path in store.spec \
+                    and store.spec[path] != (lay.in_features,
+                                             lay.out_features):
+                raise AdapterError(
+                    f"layer {path!r}: store spec "
+                    f"{store.spec[path]} != pool "
+                    f"{(lay.in_features, lay.out_features)}")
+        self.slots = int(info["slots"])
+        self.rank = int(info["rank"])
+        self.pool_bytes = int(info["pool_hbm_bytes"])
+        self._lock = threading.RLock()
+        # slot 0 is the identity: never owned, never pinned, never LRU
+        self._owner: List[Optional[str]] = [None] * self.slots
+        self._resident: Dict[str, int] = {}
+        self._pins = [0] * self.slots
+        self._last_used = [0] * self.slots
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+        self.uploads = 0
+        self.upload_bytes = 0
+        self.evictions = 0
+
+    # --------------------------------------------------------- residency
+    def _upload(self, slot: int, adapter_id: str) -> None:
+        factors, scale = self._store.get(adapter_id)
+        nbytes = 0
+        for path, lay in self._layers:
+            pair = factors.get(path)
+            if pair is None:
+                a = np.zeros((lay.in_features, self.rank), np.float32)
+                b = np.zeros((self.rank, lay.out_features), np.float32)
+            else:
+                a, b = pair
+            buf = lay.lora_a
+            buf._data = buf._data.at[slot].set(jnp.asarray(a))
+            buf = lay.lora_b
+            buf._data = buf._data.at[slot].set(jnp.asarray(b))
+            buf = lay.lora_scale
+            buf._data = buf._data.at[slot].set(
+                jnp.float32(scale if pair is not None else 0.0))
+            nbytes += a.nbytes + b.nbytes
+        self._engine.refresh_params()
+        self.uploads += 1
+        self.upload_bytes += int(nbytes)
+
+    def pin(self, adapter_id: Optional[str]) -> int:
+        """Make ``adapter_id`` resident, pin its slot and return the
+        slot index.  ``None`` is the identity: slot 0, never pinned.
+        Raises ``UnknownAdapterError`` for an unregistered id and
+        ``MemoryError`` when every slot is resident AND pinned (the
+        degradation-ladder signal)."""
+        if adapter_id is None:
+            return 0
+        with self._lock:
+            slot = self._resident.get(adapter_id)
+            if slot is not None:
+                self.hits += 1
+            else:
+                self.misses += 1
+                # store lookup BEFORE slot selection: an unknown id
+                # must not evict anything
+                self._store.get(adapter_id)
+                slot = next((i for i in range(1, self.slots)
+                             if self._owner[i] is None), None)
+                if slot is None:
+                    victim = None
+                    for i in range(1, self.slots):
+                        if self._pins[i]:
+                            continue
+                        if victim is None or (self._last_used[i]
+                                              < self._last_used[victim]):
+                            victim = i
+                    if victim is None:
+                        raise MemoryError(
+                            f"all {self.slots - 1} adapter slots are "
+                            f"pinned by in-flight rows; cannot make "
+                            f"{adapter_id!r} resident")
+                    self.evictions += 1
+                    del self._resident[self._owner[victim]]
+                    slot = victim
+                self._owner[slot] = adapter_id
+                self._resident[adapter_id] = slot
+                self._upload(slot, adapter_id)
+            self._pins[slot] += 1
+            self._tick += 1
+            self._last_used[slot] = self._tick
+            return slot
+
+    def unpin(self, slot: int) -> None:
+        """Drop one pin on ``slot`` (no-op for the identity slot 0).
+        The slot stays resident — only unpinned slots are LRU
+        candidates."""
+        if slot == 0:
+            return
+        with self._lock:
+            if not 0 < slot < self.slots:
+                raise AdapterError(f"slot {slot} out of range")
+            if self._pins[slot] <= 0:
+                raise AdapterError(
+                    f"unpin of unpinned slot {slot} "
+                    f"(owner={self._owner[slot]!r}) — refcount "
+                    f"discipline violated")
+            self._pins[slot] -= 1
+
+    def slot_of(self, adapter_id: str) -> Optional[int]:
+        with self._lock:
+            return self._resident.get(adapter_id)
+
+    def has(self, adapter_id: str) -> bool:
+        """Registered in the backing store (resident or not) — the
+        submit-time validation probe: unknown ids must die at the HTTP
+        boundary (400), never burn a queue slot."""
+        return self._store.has(adapter_id)
+
+    # ----------------------------------------------------- observability
+    def check_invariants(self) -> None:
+        """Fuzz-harness assertions over the full cache state."""
+        with self._lock:
+            assert self._owner[0] is None and self._pins[0] == 0, \
+                "identity slot 0 must stay unowned and unpinned"
+            for aid, slot in self._resident.items():
+                assert 0 < slot < self.slots, (aid, slot)
+                assert self._owner[slot] == aid, (aid, slot,
+                                                  self._owner[slot])
+            owned = [i for i in range(self.slots)
+                     if self._owner[i] is not None]
+            assert len(owned) == len(self._resident), \
+                (owned, self._resident)
+            for i in range(self.slots):
+                assert self._pins[i] >= 0, (i, self._pins[i])
+                if self._pins[i] > 0:
+                    assert self._owner[i] is not None, \
+                        f"pinned slot {i} has no owner"
+
+    @property
+    def resident_count(self) -> int:
+        with self._lock:
+            return len(self._resident)
+
+    @property
+    def pinned_count(self) -> int:
+        with self._lock:
+            return sum(1 for p in self._pins if p > 0)
+
+    def summary(self) -> dict:
+        """The ``adapters`` section of the serving metrics snapshot."""
+        with self._lock:
+            lookups = self.hits + self.misses
+            out = {
+                "slots": self.slots, "rank": self.rank,
+                "layers": len(self._layers),
+                "pool_hbm_bytes": self.pool_bytes,
+                "resident": len(self._resident),
+                "pinned": sum(1 for p in self._pins if p > 0),
+                "hits": self.hits, "misses": self.misses,
+                "hit_rate": (self.hits / lookups) if lookups else 0.0,
+                "uploads": self.uploads,
+                "upload_bytes": self.upload_bytes,
+                "evictions": self.evictions,
+            }
+            out["store"] = self._store.stats()
+            return out

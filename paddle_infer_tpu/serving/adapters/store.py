@@ -1,0 +1,206 @@
+"""Paged host-side store of validated per-tenant LoRA checkpoints.
+
+The store is the fleet's adapter registry: tenants register
+``{layer_path: (A, B)}`` factor dicts plus a per-adapter scaling, the
+store validates every factor against the deployment's layer-shape
+contract (``adapter_layer_spec``) and its fixed rank, and packs the
+fp32 payload into a fixed-geometry paged arena — a host-side mirror of
+the KV page pool's discipline, so adapter residency is bounded,
+fragmentation-free and observable in pages, not mallocs.  The device
+``AdapterCache`` pulls factors out of the store on a slot miss.
+
+Registration is strict by design: a factor dict naming an unknown
+layer, the wrong rank, or the wrong (d_in, d_out) is a checkpoint for a
+DIFFERENT deployment and is rejected before it can corrupt a resident
+slot.  Lookup of an id that was never registered raises
+:class:`UnknownAdapterError`, a ``RejectedError`` subclass — serve.py's
+error mapping turns that into HTTP 400, not 500.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..request import RejectedError
+
+
+class AdapterError(Exception):
+    """Invalid adapter checkpoint or store misconfiguration."""
+
+
+class UnknownAdapterError(RejectedError):
+    """Request named an adapter_id the store has never seen — a client
+    error (HTTP 400), never an engine fault."""
+
+
+def make_random_adapter(spec: Dict[str, Tuple[int, int]], rank: int,
+                        seed: int, scale: float = 1.0,
+                        amplitude: float = 0.05):
+    """Seeded random factors for every layer in ``spec`` — the test and
+    bench helper.  Returns ``(factors, scale)`` ready for
+    :meth:`AdapterStore.add`."""
+    rng = np.random.RandomState(int(seed))
+    factors = {}
+    for path, (d_in, d_out) in spec.items():
+        a = (rng.standard_normal((d_in, rank)) * amplitude).astype(
+            np.float32)
+        b = (rng.standard_normal((rank, d_out)) * amplitude).astype(
+            np.float32)
+        factors[path] = (a, b)
+    return factors, float(scale)
+
+
+class AdapterStore:
+    """Fixed-rank, paged host arena of LoRA checkpoints.
+
+    ``spec`` is the deployment's layer contract
+    (:func:`..adapters.layer.adapter_layer_spec`); ``rank`` is the ONE
+    rank every adapter of this deployment carries (a per-adapter rank
+    would put shapes back in the executable key).  ``page_bytes`` /
+    ``capacity_pages`` bound the arena; ``add`` raises MemoryError when
+    the freelist is dry, exactly like the KV pool."""
+
+    def __init__(self, spec: Dict[str, Tuple[int, int]], rank: int,
+                 page_bytes: int = 1 << 16,
+                 capacity_pages: int = 4096):
+        if not spec:
+            raise AdapterError(
+                "empty layer spec: the model exposes no LoRA target "
+                "projections")
+        if int(rank) < 1:
+            raise AdapterError(f"rank must be >= 1, got {rank}")
+        self.spec = {str(k): (int(v[0]), int(v[1]))
+                     for k, v in spec.items()}
+        self.rank = int(rank)
+        self.page_bytes = int(page_bytes)
+        self.capacity_pages = int(capacity_pages)
+        if self.page_bytes < 64 or self.capacity_pages < 1:
+            raise AdapterError(
+                f"degenerate arena geometry: page_bytes={page_bytes}, "
+                f"capacity_pages={capacity_pages}")
+        self._arena = np.zeros((self.capacity_pages, self.page_bytes),
+                               np.uint8)
+        self._free = list(range(self.capacity_pages - 1, -1, -1))
+        # adapter_id -> {pages, layout, scale, nbytes}; layout is
+        # [(path, shape_a, shape_b)] in registration order — offsets
+        # are implied by the fixed shapes, so unpack is pure arithmetic
+        self._adapters: Dict[str, dict] = {}
+
+    # ------------------------------------------------------------ intern
+    def _adapter_nbytes(self, factors) -> int:
+        return sum(a.nbytes + b.nbytes for a, b in factors.values())
+
+    def _validate(self, adapter_id: str, factors) -> None:
+        if not isinstance(adapter_id, str) or not adapter_id:
+            raise AdapterError(
+                f"adapter_id must be a non-empty string, got "
+                f"{adapter_id!r}")
+        if not factors:
+            raise AdapterError(
+                f"adapter {adapter_id!r}: empty factor dict")
+        for path, pair in factors.items():
+            if path not in self.spec:
+                raise AdapterError(
+                    f"adapter {adapter_id!r}: unknown target layer "
+                    f"{path!r} (not in the deployment's spec)")
+            d_in, d_out = self.spec[path]
+            a, b = pair
+            a = np.asarray(a)
+            b = np.asarray(b)
+            if a.shape != (d_in, self.rank):
+                raise AdapterError(
+                    f"adapter {adapter_id!r} layer {path!r}: A has "
+                    f"shape {tuple(a.shape)}, deployment expects "
+                    f"{(d_in, self.rank)}")
+            if b.shape != (self.rank, d_out):
+                raise AdapterError(
+                    f"adapter {adapter_id!r} layer {path!r}: B has "
+                    f"shape {tuple(b.shape)}, deployment expects "
+                    f"{(self.rank, d_out)}")
+            if not (np.isfinite(a).all() and np.isfinite(b).all()):
+                raise AdapterError(
+                    f"adapter {adapter_id!r} layer {path!r}: non-finite "
+                    f"factor values")
+
+    # ------------------------------------------------------------ public
+    def add(self, adapter_id: str, factors, scale: float = 1.0,
+            replace: bool = False) -> int:
+        """Validate and intern one adapter.  ``factors`` maps layer
+        paths to ``(A [d_in, r], B [r, d_out])`` float arrays; layers
+        absent from the dict contribute a zero delta.  Returns the page
+        count consumed; raises ``MemoryError`` when the arena is full
+        (the caller decides whether to evict or reject the tenant)."""
+        self._validate(adapter_id, factors)
+        if adapter_id in self._adapters:
+            if not replace:
+                raise AdapterError(
+                    f"adapter {adapter_id!r} already registered "
+                    f"(pass replace=True to update)")
+            self.remove(adapter_id)
+        norm = {p: (np.asarray(a, np.float32), np.asarray(b, np.float32))
+                for p, (a, b) in factors.items()}
+        nbytes = self._adapter_nbytes(norm)
+        n_pages = max(1, -(-nbytes // self.page_bytes))
+        if n_pages > len(self._free):
+            raise MemoryError(
+                f"adapter store full: {adapter_id!r} needs {n_pages} "
+                f"pages, {len(self._free)} free of "
+                f"{self.capacity_pages}")
+        pages = [self._free.pop() for _ in range(n_pages)]
+        blob = np.concatenate(
+            [arr.reshape(-1).view(np.uint8)
+             for p in sorted(norm) for arr in norm[p]])
+        for j, pg in enumerate(pages):
+            chunk = blob[j * self.page_bytes:(j + 1) * self.page_bytes]
+            self._arena[pg, :chunk.size] = chunk
+        self._adapters[adapter_id] = {
+            "pages": pages,
+            "layout": [(p,) + tuple(self.spec[p]) for p in sorted(norm)],
+            "scale": float(scale), "nbytes": int(nbytes)}
+        return n_pages
+
+    def remove(self, adapter_id: str) -> None:
+        rec = self._adapters.pop(adapter_id, None)
+        if rec is None:
+            raise UnknownAdapterError(
+                f"unknown adapter_id {adapter_id!r}")
+        self._free.extend(rec["pages"])
+
+    def has(self, adapter_id: str) -> bool:
+        return adapter_id in self._adapters
+
+    def get(self, adapter_id: str):
+        """``(factors, scale)`` for one adapter, reconstructed from the
+        arena pages.  Raises :class:`UnknownAdapterError` for ids that
+        were never registered."""
+        rec = self._adapters.get(adapter_id)
+        if rec is None:
+            raise UnknownAdapterError(
+                f"unknown adapter_id {adapter_id!r}")
+        blob = self._arena[rec["pages"]].reshape(-1)[:rec["nbytes"]]
+        factors = {}
+        off = 0
+        r = self.rank
+        for path, d_in, d_out in rec["layout"]:
+            na = d_in * r * 4
+            nb = r * d_out * 4
+            a = blob[off:off + na].view(np.float32).reshape(d_in, r)
+            off += na
+            b = blob[off:off + nb].view(np.float32).reshape(r, d_out)
+            off += nb
+            factors[path] = (a, b)
+        return factors, rec["scale"]
+
+    def adapter_ids(self):
+        return sorted(self._adapters)
+
+    def stats(self) -> dict:
+        used = self.capacity_pages - len(self._free)
+        return {"adapters": len(self._adapters),
+                "rank": self.rank,
+                "page_bytes": self.page_bytes,
+                "pages_total": self.capacity_pages,
+                "pages_used": int(used),
+                "bytes_used": int(sum(r["nbytes"]
+                                      for r in self._adapters.values()))}

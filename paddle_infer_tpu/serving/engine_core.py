@@ -57,6 +57,7 @@ from ..inference.generation import (GenerationConfig, PagedGenerationEngine,
                                     _round_up)
 from ..observability import Tracer, get_compile_log
 from ..observability.steplog import StepCostModel, StepLog
+from .adapters import UnknownAdapterError
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .programs import (build_decode, build_mixed_step, build_page_copy,
@@ -105,7 +106,9 @@ class EngineCore:
                  serving_mesh=None,
                  sched_policy: str = "fifo",
                  slo_ttft_s: Optional[float] = None,
-                 slo_itl_s: Optional[float] = None):
+                 slo_itl_s: Optional[float] = None,
+                 adapter_store=None,
+                 adapter_slots: int = 8):
         # sharded serving plane (serving/sharded/): when a ServingMesh is
         # handed in, re-validate it against THIS core's feature flags so
         # incompatible combos (quantized wire + speculation/prefix cache)
@@ -142,6 +145,16 @@ class EngineCore:
                 "routing buffers are sized from the mixed step's fixed "
                 "token budget, and the legacy per-(plen|batch,chunk) "
                 "program zoo would need one capacity per shape")
+
+        # multi-LoRA adapter plane (serving/adapters/): per-row slot
+        # gathers only exist inside the mixed step — the legacy program
+        # zoo has no slot side-channel, so its executables would
+        # silently serve the BASE model under every adapter
+        if adapter_store is not None and not ragged:
+            raise ShardedConfigError(
+                "adapter serving requires ragged=True: per-row adapter "
+                "slots ride the mixed step's side-channel; the legacy "
+                "program families would silently drop the LoRA delta")
 
         engine_quant = getattr(engine, "_quant_allreduce", None)
         if serving_mesh is not None:
@@ -249,7 +262,32 @@ class EngineCore:
                 self._moe, capacity=int(cap),
                 ep=int(getattr(serving_mesh, "ep", 1) or 1))
 
+        self._lora = None
+        self._adapters = None
+        if adapter_store is not None:
+            # convert the target projections in place BEFORE the param
+            # snapshot, like the MoE plane: the stacked slot pools are
+            # registered buffers, so the engine snapshot carries them
+            # into the executable as arguments and the AdapterCache can
+            # swap slot contents without recompiling.  (slots, rank)
+            # are deployment constants — part of the executable's
+            # config key, never of the data.
+            from .adapters import (AdapterCache, AdapterError,
+                                   prepare_lora_serving)
+            n_lora = prepare_lora_serving(
+                engine._model, slots=int(adapter_slots),
+                rank=int(adapter_store.rank))
+            if n_lora == 0:
+                raise AdapterError(
+                    "adapter_store given but the model exposes no LoRA "
+                    "target projections (qkv_proj/out_proj/fc1/fc2)")
+
         engine.refresh_params()
+        if adapter_store is not None:
+            self._adapters = AdapterCache(engine, adapter_store)
+            self._lora = {"slots": self._adapters.slots,
+                          "rank": self._adapters.rank,
+                          "layers": n_lora}
         # prefix_cache_headroom_pages widens the pool BEYOND the
         # worst-case live reservations (slots x max_pages) without
         # widening any slot's page table: live rows can never reach the
@@ -576,6 +614,8 @@ class EngineCore:
             device_memory=memory_stats(),
             sharding=sharding_snapshot(self._engine),
             moe=self._moe,
+            adapters=(self._adapters.summary()
+                      if self._adapters is not None else None),
             sched=self._sched_snapshot())
 
     # ------------------------------------------------------- trace hooks
@@ -591,10 +631,29 @@ class EngineCore:
                              outcome=reason)
         self._trace_end(req, state)
 
+    def _validate_adapter_id(self, adapter_id: Optional[str]):
+        """Submit-time adapter validation: unknown or unconfigured
+        adapter bindings die HERE (RejectedError → HTTP 4xx), never
+        after burning a queue slot or a prefill."""
+        if adapter_id is None:
+            return
+        if self._adapters is None:
+            self._metrics.on_rejected()
+            raise RejectedError(
+                f"request binds adapter {adapter_id!r} but this engine "
+                "serves no adapters (construct EngineCore with "
+                "adapter_store=)")
+        if not self._adapters.has(adapter_id):
+            self._metrics.on_rejected()
+            raise UnknownAdapterError(
+                f"unknown adapter {adapter_id!r}: not registered in the "
+                "adapter store")
+
     def submit(self, input_ids, config: GenerationConfig = None,
                attention_mask=None,
                timeout_s: Optional[float] = None,
-               cache_salt: Optional[str] = None) -> List[Request]:
+               cache_salt: Optional[str] = None,
+               adapter_id: Optional[str] = None) -> List[Request]:
         """Enqueue one request per row of ``input_ids`` ([b, plen] or
         [plen]).  All-or-nothing: admission errors (too long, queue
         full, not batchable) reject the whole call.  Returns the per-row
@@ -605,6 +664,7 @@ class EngineCore:
             self._metrics.on_rejected()
             raise LoadShedError("serving engine is draining; retry "
                                 "against another replica")
+        self._validate_adapter_id(adapter_id)
         g = config or GenerationConfig()
         if not self.batchable(g):
             self._metrics.on_rejected()
@@ -628,7 +688,8 @@ class EngineCore:
                     f"exceeds max_model_len {self._max_model_len}")
             rows.append(row)
         timeout_s = self._default_timeout if timeout_s is None else timeout_s
-        reqs = [Request(row, g, timeout_s=timeout_s, cache_salt=cache_salt)
+        reqs = [Request(row, g, timeout_s=timeout_s, cache_salt=cache_salt,
+                        adapter_id=adapter_id)
                 for row in rows]
         try:
             self._queue.submit_many(reqs)
@@ -693,6 +754,7 @@ class EngineCore:
                 f"prompt {int(req.prompt.size)} + max_new "
                 f"{g.max_new_tokens} exceeds max_model_len "
                 f"{self._max_model_len}")
+        self._validate_adapter_id(req.adapter_id)
         req._requeue()
         self._queue.submit(req)
         self._metrics.on_submitted()
@@ -764,7 +826,12 @@ class EngineCore:
                 self._trace_queue_drop(req, RequestState.CANCELLED,
                                        "deadline-in-queue")
                 continue
-            self._admit(req, self._slots.index(None))
+            if self._admit(req, self._slots.index(None)) is False:
+                # adapter-slot backpressure parked the head request:
+                # admitting rows behind it would reorder tenants, and
+                # re-popping it this step would spin — the mixed step
+                # below is what frees a pin
+                break
             progressed = True
 
         if self.active_count:
@@ -818,7 +885,9 @@ class EngineCore:
         self._fault.fire("prefix.match", rid=req.rid)
         cache = self._prefix_cache
         length = int(tokens.size)
-        match = cache.match(tokens, salt=req.cache_salt)
+        # route_salt composes the tenant salt with the adapter binding:
+        # KV written under one fine-tune is never warm for another
+        match = cache.match(tokens, salt=req.route_salt())
         while (match.cached_tokens and
                match.cached_tokens +
                self._plen(length - match.cached_tokens) > self._plen_cap):
@@ -922,6 +991,14 @@ class EngineCore:
                 cache.release(match)
             cache.enforce_watermark()
 
+    def _release_adapter(self, s: dict):
+        """Drop the slot's adapter pin — the partner of the pin in
+        ``_admit``/``import_handoff``.  Every path a slot leaves the
+        batch (evict, replay, handoff export) goes through here; slot 0
+        (base model) is a no-op, so the call is unconditional."""
+        if self._adapters is not None:
+            self._adapters.unpin(int(s.get("adapter_slot", 0)))
+
     def _admit(self, req: Request, sid: int):
         admit_t = time.monotonic()
         queued_at = req.requeued_at if req.retries else req.arrival
@@ -947,6 +1024,40 @@ class EngineCore:
         budget = g.max_new_tokens - already
         cache = self._prefix_cache
         eng = self._engine
+        # adapter pinning precedes KV staging: the row must never enter
+        # the batch without its fine-tune resident.  ``pin`` makes the
+        # adapter resident (LRU-evicting an unpinned slot if it has to,
+        # uploading from the host store) and pins the slot for the
+        # row's lifetime.  MemoryError — every slot pinned by in-flight
+        # rows — is BACKPRESSURE, not a failure: a pin frees as soon as
+        # any active row exits, so the request parks at the queue head
+        # without burning a retry, and the degradation ladder is fed
+        # once per wait episode (shrink/shed) rather than once per
+        # parked step.
+        aslot = 0
+        if self._adapters is not None and req.adapter_id is not None:
+            try:
+                aslot = self._adapters.pin(req.adapter_id)
+                req._adapter_wait = False
+            except UnknownAdapterError as e:
+                # registered at submit time, dropped from the store
+                # since — reject cleanly, nothing to unwind
+                self._metrics.on_rejected()
+                req._finish(RequestState.REJECTED, e)
+                self._trace_queue_drop(req, RequestState.REJECTED,
+                                       "unknown-adapter")
+                return
+            except MemoryError:
+                if not getattr(req, "_adapter_wait", False):
+                    req._adapter_wait = True
+                    rec = self._recovery
+                    if rec is not None:
+                        rec.on_memory_pressure()
+                    self.tracer.add_span(
+                        req.rid, "adapter_wait", admit_t,
+                        time.monotonic(), cause="slots-pinned")
+                self._queue.push_front(req)
+                return False
         match = None
         try:
             self._fault.fire("kv.alloc", rid=req.rid)
@@ -966,6 +1077,8 @@ class EngineCore:
                 reserve = max(self._plen(length), length + budget)
                 self._pool.reserve(sid, reserve)
         except Exception as e:
+            if aslot:
+                self._adapters.unpin(aslot)
             self._release_slot_kv(sid, match)
             now = time.monotonic()
             self.tracer.add_span(req.rid, "prefill", admit_t, now,
@@ -1000,6 +1113,8 @@ class EngineCore:
             try:
                 self._fault.fire("prefill.run", rid=req.rid)
             except Exception as e:
+                if aslot:
+                    self._adapters.unpin(aslot)
                 self._release_slot_kv(sid, match)
                 now = time.monotonic()
                 self.tracer.add_span(req.rid, "prefill", admit_t, now,
@@ -1022,6 +1137,7 @@ class EngineCore:
                 "emitted": already, "steps_base": already,
                 "last_tok": 0, "last_emit": admit_t,
                 "table": table, "key": key, "match": match,
+                "adapter_slot": aslot,
                 "span_end": prefill_t, "full": full,
                 # host-side numpy slice of the staged prompt, no device sync
                 # tpulint: disable-next-line=host-sync -- host-side prompt/token-history assembly; req.tokens are already-emitted Python ints, not device arrays
@@ -1119,7 +1235,7 @@ class EngineCore:
                     # req.tokens is a host-side list — no readback
                     # tpulint: disable-next-line=host-sync -- host-side prompt/token-history assembly; req.tokens are already-emitted Python ints, not device arrays
                     [req.prompt, np.asarray(req.tokens[:-1], np.int32)]),
-                salt=req.cache_salt)
+                salt=req.route_salt())
             req._finish(RequestState.DONE)
             self._metrics.on_completed(time.monotonic() - req.arrival)
             self._trace_end(req, RequestState.DONE)
@@ -1221,6 +1337,10 @@ class EngineCore:
             return
         if rec is not None and rec.request_should_replay(req, err):
             self._slots[s["sid"]] = None
+            # unpin the adapter for the replay wait: re-admission
+            # re-pins (the adapter likely stays resident — only
+            # unpinned slots are LRU candidates)
+            self._release_adapter(s)
             retain = None
             pending = s.get("pending")
             mid_prefill = pending is not None and len(pending) > 0
@@ -1241,7 +1361,7 @@ class EngineCore:
                         [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
             self._release_slot_kv(s["sid"], s.get("match"),
                                   retain_tokens=retain,
-                                  salt=req.cache_salt)
+                                  salt=req.route_salt())
             req._requeue()
             self._metrics.on_retry()
             now = time.monotonic()
@@ -1280,6 +1400,10 @@ class EngineCore:
         ctx = np.zeros((b,), np.int32)
         steps0 = np.zeros((b,), np.int32)
         sample_now = np.zeros((b,), bool)
+        # per-row LoRA slot selection: slot 0 (all-zero identity) for
+        # base-model rows and every inactive lane — pure data, so a
+        # batch mixing 8 different fine-tunes runs the SAME executable
+        aslots = np.zeros((b,), np.int32)
         tables = np.full((b, self._max_pages), self._scratch, np.int32)
         keys = np.zeros((b,) + active[0]["key"].shape,
                         active[0]["key"].dtype)
@@ -1299,6 +1423,11 @@ class EngineCore:
             # the [E, C_cap] routing buffers are deployment config, so
             # they join the key — routing changes data, never shapes
             mkey = mkey + (moe["num_experts"], moe["capacity"])
+        if self._lora is not None:
+            # (slot count, rank) size the stacked pools — deployment
+            # constants in the key; which adapter a row decodes under
+            # stays per-row data and never recompiles
+            mkey = mkey + (self._lora["slots"], self._lora["rank"])
         # StepPlanner: this step's per-row prompt-chunk cap + predicted
         # wall.  Static plans (fifo policy, cold fit, or no ITL SLO)
         # return cap == self._prefill_chunk, keeping the packing below
@@ -1318,6 +1447,7 @@ class EngineCore:
             ctx[i] = s["length"] + s["emitted"] - 1
             steps0[i] = s["emitted"]
             sample_now[i] = True
+            aslots[i] = s.get("adapter_slot", 0)
             tables[i] = s["table"]
             keys[i] = s["key"]
             cfgs[i] = s["g"]
@@ -1334,6 +1464,7 @@ class EngineCore:
             # only the chunk holding the prompt's last token samples;
             # mid-prompt chunks return the pad id and emit nothing
             sample_now[i] = n == int(s["pending"].size)
+            aslots[i] = s.get("adapter_slot", 0)
             tables[i] = s["table"]
             keys[i] = s["key"]
             cfgs[i] = s["g"]
@@ -1365,8 +1496,11 @@ class EngineCore:
                 history = np.concatenate(
                     # tpulint: disable-next-line=host-sync -- host-side prompt/token-history assembly; req.tokens are already-emitted Python ints, not device arrays
                     [req.prompt, np.asarray(tok_hist, np.int32)])
+                # drafts come from the row's OWN isolation domain: the
+                # composed salt keeps one tenant's fine-tuned outputs
+                # from seeding another tenant's speculation
                 proposal = self._draft_source.propose(
-                    history, k_cap, salt=req.cache_salt,
+                    history, k_cap, salt=req.route_salt(),
                     deterministic_only=bool(s["g"].do_sample))
                 k_row = min(len(proposal), k_cap)
                 if k_row <= 0:
@@ -1382,6 +1516,9 @@ class EngineCore:
         draft_tokens_step = sum(drafted.values())
         prefill_tokens_step = sum(chunk_taken.values())
         n_decode = len(decode_rows)
+        # rows carrying a non-identity adapter this step: each one adds
+        # the 2*r*(d_in+d_out) LoRA factor walk the cost model prices
+        adapter_rows_step = int(np.count_nonzero(aslots[qlens > 0]))
         clog = get_compile_log()
         c0 = clog.count()
         t0 = time.monotonic()
@@ -1397,8 +1534,8 @@ class EngineCore:
                                                    spec_window=W,
                                                    moe_stats=moe
                                                    is not None),
-                    ids, qlens, ctx, steps0, sample_now, spec, tables,
-                    self._samp_arrays(cfgs), keys,
+                    ids, qlens, ctx, steps0, sample_now, aslots, spec,
+                    tables, self._samp_arrays(cfgs), keys,
                     # scratch page id is a host int, no device sync
                     # tpulint: disable-next-line=host-sync -- speculative scratch readback at the verification boundary; verification is a host decision
                     np.asarray(self._scratch, np.int32))
@@ -1412,7 +1549,7 @@ class EngineCore:
                                                    self._max_pages,
                                                    moe_stats=moe
                                                    is not None),
-                    ids, qlens, ctx, steps0, sample_now, tables,
+                    ids, qlens, ctx, steps0, sample_now, aslots, tables,
                     self._samp_arrays(cfgs), keys,
                     # scratch page id is a host int, no device sync
                     # tpulint: disable-next-line=host-sync -- speculative scratch readback at the verification boundary; verification is a host decision
@@ -1579,7 +1716,8 @@ class EngineCore:
         bts, fl, src_tag = self._cost_model.estimate(
             kind, mkey, rows=len(active), max_rows=b,
             pages_touched=resident, chunk=1,
-            tokens=n_decode + prefill_tokens_step + draft_tokens_step)
+            tokens=n_decode + prefill_tokens_step + draft_tokens_step,
+            adapter_rows=adapter_rows_step)
         ici, ici_saved = self._cost_model.interconnect(
             n_decode + prefill_tokens_step + draft_tokens_step)
         if drafted:
@@ -1605,6 +1743,7 @@ class EngineCore:
             draft_tokens=draft_tokens_step,
             draft_accepted=draft_accepted_step,
             spec_rows=len(drafted),
+            adapter_rows=adapter_rows_step,
             planned_tokens=plan.planned_tokens,
             planned_chunk_cap=plan.chunk_cap,
             # price the composition actually packed (drafts included),
@@ -1804,6 +1943,7 @@ class EngineCore:
                err: Optional[BaseException] = None):
         self._slots[slot["sid"]] = None
         req = slot["req"]
+        self._release_adapter(slot)
         # retain-on-finish: a DONE row's prompt + emitted tokens (minus
         # the last — its KV is never written) have valid KV in the
         # row's pages; donate them to the prefix cache instead of
@@ -1823,7 +1963,7 @@ class EngineCore:
         t0 = time.monotonic()
         self._release_slot_kv(slot["sid"], slot.get("match"),
                               retain_tokens=retain,
-                              salt=req.cache_salt)
+                              salt=req.route_salt())
         wall = time.monotonic() - t0
         bts, fl, src_tag = self._cost_model.estimate("evict",
                                                      pages_touched=pages)
@@ -1954,15 +2094,22 @@ class EngineCore:
                 "kv_len": kv_len, "kv_tokens": kv_tokens,
                 "k_host": k_host, "v_host": v_host, "page": page,
                 "salt": req.cache_salt,
+                # adapter binding travels WITH the KV: the importer must
+                # pin the same fine-tune before the row decodes there
+                "adapter_id": req.adapter_id,
             }
             self._slots[sid] = None
+            # unpin here, re-pin on the importer: the source keeps the
+            # adapter resident only as an LRU candidate once the row
+            # leaves
+            self._release_adapter(s)
             # retain the exported prefix here: the whole point of role
             # disaggregation is that the PREFILL replica's radix tree
             # accumulates the fleet's prompt prefixes
             self._release_slot_kv(
                 sid, s.get("match"),
                 retain_tokens=kv_tokens if kv_tokens.size else None,
-                salt=req.cache_salt)
+                salt=req.route_salt())
             wall = time.monotonic() - t0
             bts, fl, src_tag = self._cost_model.estimate(
                 "page_copy", pages_touched=n_pages)
@@ -2031,6 +2178,22 @@ class EngineCore:
                         if sl is None), None)
             if sid is None:
                 raise HandoffError("no free slot on target replica")
+            # pin the adapter binding BEFORE touching the pool: a
+            # target that can't make the fine-tune resident must refuse
+            # the whole handoff with the source slot still intact
+            aslot = 0
+            if req.adapter_id is not None:
+                if self._adapters is None:
+                    raise HandoffError(
+                        f"request {req.rid} is bound to adapter "
+                        f"{req.adapter_id!r} but the target replica "
+                        "serves no adapters")
+                try:
+                    aslot = self._adapters.pin(req.adapter_id)
+                except (MemoryError, UnknownAdapterError) as e:
+                    raise HandoffError(
+                        f"target replica cannot pin adapter "
+                        f"{req.adapter_id!r}: {e}") from e
             t0 = time.monotonic()
             reserve = max(self._plen(int(np.size(full))),
                           length + g.max_new_tokens)
@@ -2041,6 +2204,8 @@ class EngineCore:
                 self._pool.reserve(sid, reserve)
             except MemoryError as e:
                 self._pool.free(sid)
+                if aslot:
+                    self._adapters.unpin(aslot)
                 raise HandoffError(
                     "target pool has no pages for the handoff") from e
             table = np.full((self._max_pages,), self._scratch, np.int32)
@@ -2078,6 +2243,7 @@ class EngineCore:
                 "steps_base": int(packet["steps_base"]),
                 "last_tok": int(packet["last_tok"]), "last_emit": now,
                 "table": table, "key": key, "match": None,
+                "adapter_slot": aslot,
                 "span_end": now, "full": full,
                 "pending": packet["pending"], "ctx": int(packet["ctx"])}
             wall = now - t0

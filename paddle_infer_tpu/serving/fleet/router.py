@@ -40,7 +40,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ...inference.generation import GenerationConfig
-from ..request import LoadShedError, RejectedError, Request
+from ..request import (LoadShedError, RejectedError, Request,
+                       effective_salt)
 from .elastic import ElasticRolePolicy
 from .handoff import migrate, ready_for_handoff
 from .roles import ReplicaHandle, ReplicaRole
@@ -112,14 +113,18 @@ class FleetRouter:
     # --------------------------------------------------------- dispatch
     def submit(self, prompt, config: GenerationConfig = None,
                timeout_s: Optional[float] = None,
-               cache_salt: Optional[str] = None) -> Request:
+               cache_salt: Optional[str] = None,
+               adapter_id: Optional[str] = None) -> Request:
         """Route ONE prompt (1-D token array) to a replica and return
         its ``Request`` handle.  Raises ``LoadShedError`` (a
         ``RejectedError``, but retryable — a fully draining fleet is an
         availability condition, not a bad request, so serve.py maps it
         to 503 + Retry-After like single-core draining) when no replica
         is serving; replica-level admission errors (queue full, too
-        long) propagate from the chosen core."""
+        long, unknown adapter) propagate from the chosen core.
+        ``adapter_id`` joins the routing salt — affinity never steers an
+        adapter tenant onto another tenant's cached prefix — and rides
+        handoff packets so the binding survives migration."""
         ids = np.asarray(prompt, np.int32).reshape(-1)
         g = config or GenerationConfig()
         serving = self._serving()
@@ -131,15 +136,19 @@ class FleetRouter:
                 else ReplicaHandle.accepts_decode)
         candidates = [h for h in serving if want(h)] or serving
         t0 = time.monotonic()
-        handle, reason, match = self._pick(candidates, ids, cache_salt)
+        # the same composed salt the replicas key their radix trees on
+        # (Request.route_salt) — shadow, peek and tree must agree
+        salt = effective_salt(cache_salt, adapter_id)
+        handle, reason, match = self._pick(candidates, ids, salt)
         req = handle.core.submit(ids, g, timeout_s=timeout_s,
-                                 cache_salt=cache_salt)[0]
+                                 cache_salt=cache_salt,
+                                 adapter_id=adapter_id)[0]
         handle.dispatched += 1
         if reason == "affinity":
             handle.affinity_hits += 1
         # the finished sequence retains prompt + tokens[:-1]; the prompt
         # is the durable part worth shadowing now
-        self._shadow.observe(handle.name, ids, cache_salt)
+        self._shadow.observe(handle.name, ids, salt)
         handle.core.tracer.add_span(
             req.rid, "route", t0, time.monotonic(), replica=handle.name,
             role=handle.role.value, reason=reason, prefix_match=match)
@@ -154,8 +163,11 @@ class FleetRouter:
         return req
 
     def _pick(self, candidates: List[ReplicaHandle], ids,
-              salt: Optional[str]) -> Tuple[ReplicaHandle, str, int]:
-        """(handle, reason, confirmed_prefix_len) for one dispatch."""
+              salt) -> Tuple[ReplicaHandle, str, int]:
+        """(handle, reason, confirmed_prefix_len) for one dispatch.
+        ``salt`` is the COMPOSED routing salt (``effective_salt`` of
+        cache_salt and adapter_id) — the key the replicas' radix trees
+        and the shadow index both use."""
         by_load = sorted(candidates,
                          key=lambda h: h.predicted_load_bytes())
         if self._affinity and ids.size > 1:

@@ -265,6 +265,7 @@ class ServingMetrics:
                  device_memory: Optional[Dict] = None,
                  sharding: Optional[Dict] = None,
                  moe: Optional[Dict] = None,
+                 adapters: Optional[Dict] = None,
                  sched: Optional[Dict] = None) -> Dict:
         """Render everything to a plain dict (the ``GET /metrics`` JSON
         body).  Latency series carry lifetime ``count``/``mean`` plus
@@ -291,7 +292,10 @@ class ServingMetrics:
         balanced, dropped ratio over routed+dropped); ``sched`` is the
         core's SLO-scheduler section (policy, planner calibration,
         predictive sheds, predicted-vs-actual slack error), merged
-        with this registry's predictive-shed counter."""
+        with this registry's predictive-shed counter; ``adapters`` is
+        ``AdapterCache.summary()`` (slot residency/pins, hit rate,
+        upload/eviction counters, host store stats) when the core
+        serves multi-LoRA tenants."""
         tps = self.tokens_per_second()
         with self._lock:
             out = {
@@ -361,6 +365,8 @@ class ServingMetrics:
                                          if util and routed else 0.0),
                     "gate_aux_loss": self.moe_aux_loss_last,
                 })
+            if adapters is not None:
+                out["adapters"] = dict(adapters)
             if sched is not None:
                 # the core's scheduler section (policy, planner,
                 # predicted-vs-actual slack), plus this registry's

@@ -103,9 +103,17 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
     rows reuses it.
 
     ``run(params, ids[b, C], qlens[b], ctx[b], steps0[b],
-    sample_now[b], tables[b, max_pages], samp, keys[b, 2], scratch[],
-    k_pages, v_pages)`` → ``(tok[b], fin[b], k_pages, v_pages)``;
-    pools are donated.
+    sample_now[b], adapter_slots[b], tables[b, max_pages], samp,
+    keys[b, 2], scratch[], k_pages, v_pages)`` →
+    ``(tok[b], fin[b], k_pages, v_pages)``; pools are donated.
+
+    ``adapter_slots`` is the per-row LoRA binding (slot 0 = identity):
+    pure gather DATA over the stacked pools
+    (serving/adapters/layer.py), threaded to the converted projections
+    through the thread-local slot side-channel so the executable key
+    stays deployment constants only.  Unconverted models ignore it —
+    the engine always packs the array (zeros), so the signature is one
+    shape for every deployment.
 
     Sampling: each row's next-token logits sit at chunk position
     ``qlens - 1`` (for decode rows that is position 0 — exactly the
@@ -139,8 +147,9 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
     streams), so a non-spec row reproduces the plain step bit-for-bit.
 
     Spec signature: ``run(params, ids[b, C], qlens, ctx, steps0,
-    sample_now, spec[b] bool, tables, samp, keys, scratch, k_pages,
-    v_pages)`` → ``(out[b, W], n_emit[b], fin[b], k_pages, v_pages)``
+    sample_now, adapter_slots, spec[b] bool, tables, samp, keys,
+    scratch, k_pages, v_pages)`` →
+    ``(out[b, W], n_emit[b], fin[b], k_pages, v_pages)``
     — row ``i`` emits ``out[i, :n_emit[i]]`` (truncated at its first
     eos; 0 when ``sample_now`` is off).  Rejected-tail KV needs NO pool
     ops: stale entries at positions ``>= ctx + n_emit`` sit inside the
@@ -159,23 +168,31 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
     L = engine._num_layers
     C = token_budget
 
-    def _model_step_with_stats(params, ids, pos2d, caches, qlens, i2d):
-        """One model step, optionally collecting MoE routing stats
-        masked to the step's valid (non-pad) token slots."""
-        if not moe_stats:
-            logits, caches = engine._model_step(params, ids, pos2d,
-                                                None, caches)
-            return logits, caches, ()
-        from .moe import stats as moe_stats_mod
+    def _model_step_with_stats(params, ids, pos2d, caches, qlens, i2d,
+                               adapter_slots):
+        """One model step under the adapter-slot side-channel,
+        optionally collecting MoE routing stats masked to the step's
+        valid (non-pad) token slots.  The slot context is opened
+        unconditionally: unconverted models never read it, and a
+        converted model with an all-zero slot vector gathers the
+        identity rows — same executable either way."""
+        from .adapters import slots as lora_slots_mod
 
-        vmask = (i2d < qlens[:, None]).reshape(-1)
-        with moe_stats_mod.collect(vmask) as col:
-            logits, caches = engine._model_step(params, ids, pos2d,
-                                                None, caches)
-        return logits, caches, col.totals()
+        with lora_slots_mod.activate(adapter_slots):
+            if not moe_stats:
+                logits, caches = engine._model_step(params, ids, pos2d,
+                                                    None, caches)
+                return logits, caches, ()
+            from .moe import stats as moe_stats_mod
 
-    def run(params, ids, qlens, ctx, steps0, sample_now, tables, samp,
-            keys, scratch, k_pages, v_pages):
+            vmask = (i2d < qlens[:, None]).reshape(-1)
+            with moe_stats_mod.collect(vmask) as col:
+                logits, caches = engine._model_step(params, ids, pos2d,
+                                                    None, caches)
+            return logits, caches, col.totals()
+
+    def run(params, ids, qlens, ctx, steps0, sample_now, adapter_slots,
+            tables, samp, keys, scratch, k_pages, v_pages):
         b = ids.shape[0]
         caches = [(k_pages[i], v_pages[i], tables, ctx, qlens, scratch)
                   for i in range(L)]
@@ -189,7 +206,7 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
         # attended, so valid logits are bitwise unchanged.
         pos2d = jnp.where(i2d < qlens[:, None], ctx[:, None] + i2d, 0)
         logits, caches, moe_out = _model_step_with_stats(
-            params, ids, pos2d, caches, qlens, i2d)
+            params, ids, pos2d, caches, qlens, i2d, adapter_slots)
         last = jnp.take_along_axis(
             logits, jnp.maximum(qlens - 1, 0)[:, None, None], axis=1)[:, 0]
         proc = _process_rows(last, samp, steps0)
@@ -203,12 +220,13 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
 
     W = int(spec_window)
     if W <= 1:
-        return jax.jit(run, donate_argnums=(10, 11))
+        return jax.jit(run, donate_argnums=(11, 12))
 
     from ..inference import spec_accept
 
-    def run_spec(params, ids, qlens, ctx, steps0, sample_now, spec,
-                 tables, samp, keys, scratch, k_pages, v_pages):
+    def run_spec(params, ids, qlens, ctx, steps0, sample_now,
+                 adapter_slots, spec, tables, samp, keys, scratch,
+                 k_pages, v_pages):
         b = ids.shape[0]
         spec2d = jnp.broadcast_to(spec[:, None], (b, W))
         caches = [(k_pages[i], v_pages[i], tables, ctx, qlens, scratch,
@@ -217,7 +235,7 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
                                (b, C))
         pos2d = jnp.where(i2d < qlens[:, None], ctx[:, None] + i2d, 0)
         logits, caches, moe_out = _model_step_with_stats(
-            params, ids, pos2d, caches, qlens, i2d)
+            params, ids, pos2d, caches, qlens, i2d, adapter_slots)
 
         # per-window-position logits: spec rows read positions 0..W-1
         # (clamped to their qlen), plain rows replicate qlens-1 so
@@ -304,7 +322,7 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
         return (out, n_emit, fin, *moe_out,
                 [c[0] for c in caches], [c[1] for c in caches])
 
-    return jax.jit(run_spec, donate_argnums=(11, 12))
+    return jax.jit(run_spec, donate_argnums=(12, 13))
 
 
 # legacy ragged=False path: one executable per plen bucket is the

@@ -53,6 +53,18 @@ class HandoffError(RejectedError):
     released, import before the target reserves anything."""
 
 
+def effective_salt(cache_salt, adapter_id):
+    """Compose the prefix-cache / routing isolation key from a tenant
+    salt and an adapter binding.  Two tenants sharing a system prompt
+    but different adapters must NEVER cross-hit warm KV produced under
+    the other's fine-tune, so the adapter id joins the salt whenever one
+    is present.  Salts are opaque hashable keys to the radix trees, so
+    the composed tuple needs no tree-side support."""
+    if adapter_id is None:
+        return cache_salt
+    return ("adapter", adapter_id, cache_salt)
+
+
 class RequestState(Enum):
     QUEUED = "queued"
     ACTIVE = "active"
@@ -73,7 +85,8 @@ class Request:
     def __init__(self, prompt, config, timeout_s: Optional[float] = None,
                  kind: str = "batch",
                  exclusive_fn: Optional[Callable] = None,
-                 cache_salt: Optional[str] = None):
+                 cache_salt: Optional[str] = None,
+                 adapter_id: Optional[str] = None):
         self.rid = next(_rid_counter)
         self.prompt = (None if prompt is None
                        else np.asarray(prompt, np.int32).reshape(-1))
@@ -82,6 +95,10 @@ class Request:
         # prefix-cache isolation domain: requests only share cached KV
         # with requests carrying the same salt (multi-tenant isolation)
         self.cache_salt = cache_salt
+        # LoRA tenancy: which registered adapter this row decodes under
+        # (None = base model).  The adapter joins the row's cache salt —
+        # KV produced under a fine-tune is only warm for that fine-tune.
+        self.adapter_id = adapter_id
         self.exclusive_fn = exclusive_fn
         self.arrival = time.monotonic()
         self.deadline = (None if timeout_s is None
@@ -104,6 +121,11 @@ class Request:
         self.sched_predicted_slack: Optional[float] = None
         self._chunks: _queue.Queue = _queue.Queue()
         self._done = threading.Event()
+
+    def route_salt(self):
+        """The isolation key every prefix-cache/routing surface uses for
+        this request: ``cache_salt`` composed with the adapter binding."""
+        return effective_salt(self.cache_salt, self.adapter_id)
 
     # ------------------------------------------------- scheduler side
     def expired(self, now: Optional[float] = None) -> bool:

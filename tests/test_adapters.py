@@ -1,0 +1,760 @@
+"""Multi-LoRA adapter serving plane (paddle_infer_tpu/serving/adapters).
+
+Coverage mirrors the MoE serving suite's layers, plus the tenancy bar
+the adapter plane adds:
+
+* store — the host registry validates every tenant checkpoint against
+  the deployment's layer-shape contract and fixed rank, round-trips
+  factors bit-exactly through the paged arena, and surfaces arena
+  exhaustion as ``MemoryError``;
+* conversion — ``prepare_lora_serving`` wraps the four target
+  projections in place, idempotently, and ``lora_serving_info`` keys
+  ONE ``(slots, rank)`` per deployment;
+* parity — the acceptance bar: streams served through adapter slots
+  are BITWISE the streams of an engine whose weights were offline
+  merged (``W' = W + scale * A @ B``), across greedy, seeded sampling,
+  chunked prefill, mixed multi-tenant batches, speculation, prefix
+  cache and supervisor replay; slot-0 rows are bitwise the base model;
+* admission — unknown adapters die at submit (``UnknownAdapterError``,
+  a ``RejectedError``), slot-pool exhaustion routes through the
+  degradation ladder and every pinned slot is released on every exit
+  path;
+* fuzz — slot-granular LRU pin/unpin refcount fuzz over the cache
+  invariants, and a 200-step mixed churn fuzz over 256 registered
+  adapters with ZERO post-warmup compiles — residency churn is data,
+  never shapes.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_infer_tpu as pit
+from paddle_infer_tpu.inference.generation import (GenerationConfig,
+                                                   PagedGenerationEngine)
+from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+from paddle_infer_tpu.serving import (AdapterCache, AdapterError,
+                                      AdapterStore, EngineCore,
+                                      EngineSupervisor, FaultPlane,
+                                      FaultSpec, RejectedError,
+                                      RequestState, UnknownAdapterError,
+                                      adapter_layer_spec, effective_salt,
+                                      lora_serving_info,
+                                      make_random_adapter,
+                                      prepare_lora_serving)
+from paddle_infer_tpu.serving import request as request_mod
+from paddle_infer_tpu.serving.adapters.layer import (LoRAServingLinear,
+                                                     lora_layers)
+from paddle_infer_tpu.serving.fleet import ready_for_handoff
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _isolated_compile_log():
+    from paddle_infer_tpu.observability import get_compile_log
+    get_compile_log().reset()
+    yield
+    get_compile_log().reset()
+
+
+DIMS = dict(vocab_size=96, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0)
+
+CORE_SHAPE = dict(max_batch=4, max_model_len=48, token_budget=16,
+                  prefill_chunk=16)
+
+RANK = 4
+# factors this large flip greedy argmax at these tiny dims — parity
+# tests that assert the adapter CHANGES the stream (and then match it
+# bitwise against merged weights) need deltas the logits can see
+AMP = 0.6
+
+
+def _fresh_model():
+    pit.seed(0)
+    m = GPTForCausalLM(GPTConfig(**DIMS))
+    m.eval()
+    return m
+
+
+def _merged_model(factors, scale=1.0):
+    """Offline-merge reference: ``W' = W + scale * (A @ B)`` folded into
+    a fresh copy of the deterministic base weights."""
+    m = _fresh_model()
+    for path, (a, b) in factors.items():
+        obj = m
+        for part in path.split("."):
+            obj = getattr(obj, part)
+        w = obj.weight
+        w.set_value(np.asarray(
+            w.numpy() + float(scale) * (np.asarray(a) @ np.asarray(b)),
+            np.float32))
+    return m
+
+
+def _store_with(adapters, rank=RANK, **kw):
+    """AdapterStore over the deployment spec plus the factor dicts, so
+    tests can merge the same factors offline."""
+    spec = adapter_layer_spec(_fresh_model())
+    store = AdapterStore(spec, rank=rank, **kw)
+    made = {}
+    for aid, seed in adapters.items():
+        factors, scale = make_random_adapter(spec, rank, seed,
+                                             amplitude=AMP)
+        store.add(aid, factors, scale=scale)
+        made[aid] = (factors, scale)
+    return store, made
+
+
+def _drive(core, reqs, max_iters=600):
+    for _ in range(max_iters):
+        if all(r.done for r in reqs):
+            return
+        core.run_once()
+    raise AssertionError("requests did not finish")
+
+
+def _prompt(seed, n=8):
+    return np.random.RandomState(seed).randint(
+        0, 96, (n,)).astype(np.int32)
+
+
+def _serve(model, prompts, gens, rid_base, adapter_ids=None, **kw):
+    """One EngineCore run over a fresh engine; returns padded streams.
+    ``rid_base`` pins request ids so seeded sampling keys
+    (``fold_in(PRNGKey(seed), rid)``) match across runs."""
+    for k, v in CORE_SHAPE.items():
+        kw.setdefault(k, v)
+    request_mod._rid_counter = itertools.count(rid_base)
+    core = EngineCore(PagedGenerationEngine(model, page_size=8), **kw)
+    try:
+        aids = adapter_ids or [None] * len(prompts)
+        reqs = [core.submit(p, g, adapter_id=a)[0]
+                for p, g, a in zip(prompts, gens, aids)]
+        _drive(core, reqs)
+        assert all(r.state is RequestState.DONE for r in reqs)
+        return [np.asarray(r.padded_result()) for r in reqs]
+    finally:
+        core.close()
+
+
+# ---------------------------------------------------------------- store
+
+
+class TestAdapterStore:
+    def _spec(self):
+        return adapter_layer_spec(_fresh_model())
+
+    def test_spec_covers_all_target_projections(self):
+        spec = self._spec()
+        # 2 layers x (qkv_proj, out_proj, fc1, fc2)
+        assert len(spec) == 8
+        assert spec["gpt.layers.0.self_attn.qkv_proj"] == (32, 96)
+        assert spec["gpt.layers.1.mlp.fc2"] == (64, 32)
+
+    def test_roundtrip_bit_exact(self):
+        store, made = _store_with({"t0": 11})
+        factors, scale = store.get("t0")
+        want, wscale = made["t0"]
+        assert scale == wscale
+        for path, (a, b) in want.items():
+            np.testing.assert_array_equal(factors[path][0], a)
+            np.testing.assert_array_equal(factors[path][1], b)
+
+    def test_unknown_layer_path_rejected(self):
+        spec = self._spec()
+        store = AdapterStore(spec, rank=RANK)
+        factors, _ = make_random_adapter(spec, RANK, 0)
+        factors["gpt.layers.9.mlp.fc1"] = factors.pop(
+            "gpt.layers.1.mlp.fc1")
+        with pytest.raises(AdapterError, match="unknown target layer"):
+            store.add("bad", factors)
+
+    def test_wrong_shape_and_rank_rejected(self):
+        spec = self._spec()
+        store = AdapterStore(spec, rank=RANK)
+        factors, _ = make_random_adapter(spec, RANK, 0)
+        p = "gpt.layers.0.mlp.fc1"
+        a, b = factors[p]
+        factors[p] = (a.T.copy(), b)
+        with pytest.raises(AdapterError, match="A has shape"):
+            store.add("bad", factors)
+        wrong_rank, _ = make_random_adapter(spec, RANK + 1, 0)
+        with pytest.raises(AdapterError, match="deployment expects"):
+            store.add("bad", wrong_rank)
+
+    def test_non_finite_rejected(self):
+        spec = self._spec()
+        store = AdapterStore(spec, rank=RANK)
+        factors, _ = make_random_adapter(spec, RANK, 0)
+        p = next(iter(factors))
+        factors[p][0][0, 0] = np.nan
+        with pytest.raises(AdapterError, match="non-finite"):
+            store.add("bad", factors)
+
+    def test_duplicate_needs_replace(self):
+        store, _ = _store_with({"t0": 1})
+        spec = self._spec()
+        factors, _ = make_random_adapter(spec, RANK, 2)
+        with pytest.raises(AdapterError, match="already registered"):
+            store.add("t0", factors)
+        store.add("t0", factors, replace=True)
+        got, _ = store.get("t0")
+        np.testing.assert_array_equal(
+            got["gpt.layers.0.mlp.fc1"][0],
+            factors["gpt.layers.0.mlp.fc1"][0])
+
+    def test_remove_frees_pages_and_unknown_get(self):
+        store, _ = _store_with({"t0": 1, "t1": 2})
+        used = store.stats()["pages_used"]
+        store.remove("t0")
+        assert store.stats()["pages_used"] < used
+        assert not store.has("t0")
+        with pytest.raises(UnknownAdapterError):
+            store.get("t0")
+        with pytest.raises(UnknownAdapterError):
+            store.remove("t0")
+
+    def test_arena_exhaustion_is_memoryerror(self):
+        spec = self._spec()
+        store = AdapterStore(spec, rank=RANK, page_bytes=1024,
+                             capacity_pages=2)
+        factors, _ = make_random_adapter(spec, RANK, 0)
+        with pytest.raises(MemoryError, match="adapter store full"):
+            store.add("big", factors)
+        assert store.stats()["pages_used"] == 0   # nothing leaked
+
+    def test_unknown_adapter_is_rejected_error(self):
+        # serve.py maps RejectedError -> HTTP 400; the subclass contract
+        # is what keeps unknown tenants off the queue
+        assert issubclass(UnknownAdapterError, RejectedError)
+
+
+# ----------------------------------------------------------- conversion
+
+
+class TestConversion:
+    def test_prepare_counts_and_idempotent(self):
+        m = _fresh_model()
+        assert lora_serving_info(m) is None
+        spec_before = adapter_layer_spec(m)
+        assert prepare_lora_serving(m, slots=4, rank=RANK) == 8
+        info = lora_serving_info(m)
+        assert info["slots"] == 4 and info["rank"] == RANK
+        assert info["layers"] == 8 and info["pool_hbm_bytes"] > 0
+        # spec is the same contract pre/post conversion
+        assert adapter_layer_spec(m) == spec_before
+        # idempotent at equal dims: same wrapper objects survive
+        wrapped = dict(lora_layers(m))
+        assert prepare_lora_serving(m, slots=4, rank=RANK) == 8
+        assert dict(lora_layers(m)) == wrapped
+        # dim change rebinds instead of double-wrapping
+        assert prepare_lora_serving(m, slots=6, rank=2) == 8
+        assert lora_serving_info(m)["slots"] == 6
+        assert all(not isinstance(lay.inner, LoRAServingLinear)
+                   for _, lay in lora_layers(m))
+
+    def test_wrapper_rejects_bad_dims(self):
+        m = _fresh_model()
+        lin = m.gpt.layers[0].mlp.fc1
+        with pytest.raises(ValueError, match="slots must be >= 2"):
+            LoRAServingLinear(lin, slots=1, rank=RANK)
+        with pytest.raises(ValueError, match="rank must be >= 1"):
+            LoRAServingLinear(lin, slots=4, rank=0)
+        wrapped = LoRAServingLinear(lin, slots=4, rank=RANK)
+        with pytest.raises(TypeError, match="cannot wrap itself"):
+            LoRAServingLinear(wrapped, slots=4, rank=RANK)
+
+    def test_mixed_pool_dims_rejected(self):
+        from paddle_infer_tpu.serving import ShardedConfigError
+        m = _fresh_model()
+        prepare_lora_serving(m, slots=4, rank=RANK)
+        blk = m.gpt.layers[0].mlp
+        blk.fc1 = LoRAServingLinear(blk.fc1.inner, slots=4, rank=2)
+        with pytest.raises(ShardedConfigError, match="disagree"):
+            lora_serving_info(m)
+
+    def test_cache_rejects_rank_mismatch(self):
+        m = _fresh_model()
+        prepare_lora_serving(m, slots=4, rank=RANK)
+        store = AdapterStore(adapter_layer_spec(m), rank=2)
+        eng = PagedGenerationEngine(m, page_size=8)
+        with pytest.raises(AdapterError, match="rank"):
+            AdapterCache(eng, store)
+
+
+# --------------------------------------------------------------- parity
+
+
+class TestAdapterParity:
+    def test_greedy_stream_bitwise_merged_weights(self):
+        """The acceptance bar: the adapter-slot stream IS the stream of
+        the offline-merged model — and it differs from the base model,
+        so the equality is not vacuous."""
+        store, made = _store_with({"t0": 11})
+        prompts = [_prompt(30, 9)]
+        gens = [GenerationConfig(max_new_tokens=6)]
+        (base,) = _serve(_fresh_model(), prompts, gens, rid_base=9000)
+        (want,) = _serve(_merged_model(*made["t0"]), prompts, gens,
+                         rid_base=9000)
+        (got,) = _serve(_fresh_model(), prompts, gens, rid_base=9000,
+                        adapter_ids=["t0"], adapter_store=store,
+                        adapter_slots=4)
+        assert not np.array_equal(want, base), \
+            "amplitude too small: adapter delta never flipped a token"
+        np.testing.assert_array_equal(got, want)
+
+    def test_sampled_and_chunked_prefill_bitwise(self):
+        """Seeded sampling (rid-pinned fold_in keys) and a prompt long
+        enough for two prefill chunks both ride the same slot gather."""
+        store, made = _store_with({"t0": 12})
+        prompts = [_prompt(31, 30), _prompt(32, 7)]
+        gens = [GenerationConfig(max_new_tokens=6),
+                GenerationConfig(max_new_tokens=6, do_sample=True,
+                                 temperature=0.8, top_k=12, seed=7)]
+        want = _serve(_merged_model(*made["t0"]), prompts, gens,
+                      rid_base=9100)
+        got = _serve(_fresh_model(), prompts, gens, rid_base=9100,
+                     adapter_ids=["t0", "t0"], adapter_store=store,
+                     adapter_slots=4)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(g, w)
+
+    def test_mixed_batch_tenants_and_base_rows(self):
+        """One batch mixing two adapters and a slot-0 base row: each
+        stream equals its own single-tenant reference — per-row slot
+        data composes freely inside the one executable."""
+        store, made = _store_with({"t0": 13, "t1": 14})
+        prompts = [_prompt(33, 8), _prompt(34, 11), _prompt(35, 5)]
+        gens = [GenerationConfig(max_new_tokens=6)] * 3
+        (w0,) = _serve(_merged_model(*made["t0"]), [prompts[0]],
+                       [gens[0]], rid_base=9200)
+        (w1,) = _serve(_merged_model(*made["t1"]), [prompts[1]],
+                       [gens[1]], rid_base=9201)
+        (wb,) = _serve(_fresh_model(), [prompts[2]], [gens[2]],
+                       rid_base=9202)
+        got = _serve(_fresh_model(), prompts, gens, rid_base=9200,
+                     adapter_ids=["t0", "t1", None],
+                     adapter_store=store, adapter_slots=4)
+        np.testing.assert_array_equal(got[0], w0)
+        np.testing.assert_array_equal(got[1], w1)
+        np.testing.assert_array_equal(got[2], wb)
+
+    def test_slot0_rows_bitwise_base_engine(self):
+        """A converted engine serving only base rows is bitwise the
+        unconverted engine: slot 0's all-zero pools are a true
+        identity, not an approximation."""
+        store, _ = _store_with({"t0": 15})
+        prompts = [_prompt(36, 9), _prompt(37, 20)]
+        gens = [GenerationConfig(max_new_tokens=7),
+                GenerationConfig(max_new_tokens=5, do_sample=True,
+                                 temperature=0.9, seed=3)]
+        want = _serve(_fresh_model(), prompts, gens, rid_base=9300)
+        got = _serve(_fresh_model(), prompts, gens, rid_base=9300,
+                     adapter_store=store, adapter_slots=4)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(g, w)
+
+    def test_speculative_composition_bitwise(self):
+        """Draft/verify rows carry the same per-row slots: the greedy
+        adapter stream under speculation equals the plain one."""
+        store, made = _store_with({"t0": 16})
+        prompts = [_prompt(38, 12), _prompt(39, 9)]
+        gens = [GenerationConfig(max_new_tokens=8),
+                GenerationConfig(max_new_tokens=8)]
+        want = _serve(_fresh_model(), prompts, gens, rid_base=9400,
+                      adapter_ids=["t0", None], adapter_store=store,
+                      adapter_slots=4)
+        got = _serve(_fresh_model(), prompts, gens, rid_base=9400,
+                     adapter_ids=["t0", None], adapter_store=store,
+                     adapter_slots=4, speculate=True,
+                     num_draft_tokens=3)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(g, w)
+        (merged,) = _serve(_merged_model(*made["t0"]), [prompts[0]],
+                           [gens[0]], rid_base=9400)
+        np.testing.assert_array_equal(got[0], merged)
+
+    def test_supervisor_replay_keeps_binding(self):
+        """A mid-decode crash that loses the KV pools: the replayed
+        request re-pins its adapter and the stream equals the unfaulted
+        reference; every pin is released at the end."""
+        store, made = _store_with({"t0": 17})
+        ids = _prompt(40, 10)
+        g = GenerationConfig(max_new_tokens=12)
+        (want,) = _serve(_merged_model(*made["t0"]), [ids], [g],
+                         rid_base=9500)
+
+        request_mod._rid_counter = itertools.count(9500)
+        plane = FaultPlane([FaultSpec("decode.step", at=4, lose_kv=True)])
+        core = EngineCore(
+            PagedGenerationEngine(_fresh_model(), page_size=8),
+            fault_plane=plane, adapter_store=store, adapter_slots=4,
+            **CORE_SHAPE)
+        sup = EngineSupervisor(core)
+        try:
+            (req,) = core.submit(ids, g, adapter_id="t0")
+            for _ in range(400):
+                if req.done:
+                    break
+                sup.run_once()
+            assert req.state is RequestState.DONE
+            assert req.retries == 1
+            np.testing.assert_array_equal(req.padded_result(), want)
+            assert core._adapters.pinned_count == 0
+            core._adapters.check_invariants()
+        finally:
+            sup.close()
+
+
+# ---------------------------------------------- admission + degradation
+
+
+class TestAdmission:
+    def test_unknown_adapter_dies_at_submit(self):
+        store, _ = _store_with({"t0": 1})
+        core = EngineCore(
+            PagedGenerationEngine(_fresh_model(), page_size=8),
+            adapter_store=store, adapter_slots=4, **CORE_SHAPE)
+        try:
+            with pytest.raises(UnknownAdapterError, match="nope"):
+                core.submit(_prompt(41, 6),
+                            GenerationConfig(max_new_tokens=4),
+                            adapter_id="nope")
+            # the rejection burned no queue slot and pinned nothing
+            assert core.metrics_snapshot()["queue_depth"] == 0
+            assert core._adapters.pinned_count == 0
+            assert core._adapters.resident_count == 0
+        finally:
+            core.close()
+
+    def test_adapter_on_adapterless_engine_rejected(self):
+        core = EngineCore(
+            PagedGenerationEngine(_fresh_model(), page_size=8),
+            **CORE_SHAPE)
+        try:
+            with pytest.raises(RejectedError, match="serves no adapters"):
+                core.submit(_prompt(42, 6),
+                            GenerationConfig(max_new_tokens=4),
+                            adapter_id="t0")
+        finally:
+            core.close()
+
+    def test_slot_pressure_degrades_and_completes(self):
+        """Three tenants over ONE usable slot (slots=2): admission hits
+        the all-pinned MemoryError, rides the degradation ladder, and
+        every stream still equals its merged reference."""
+        store, made = _store_with({"t0": 21, "t1": 22, "t2": 23})
+        prompts = [_prompt(43 + i, 6 + i) for i in range(3)]
+        gens = [GenerationConfig(max_new_tokens=5)] * 3
+        wants = [_serve(_merged_model(*made[f"t{i}"]), [prompts[i]],
+                        [gens[i]], rid_base=9600 + i)[0]
+                 for i in range(3)]
+        request_mod._rid_counter = itertools.count(9600)
+        core = EngineCore(
+            PagedGenerationEngine(_fresh_model(), page_size=8),
+            adapter_store=store, adapter_slots=2, **CORE_SHAPE)
+        try:
+            reqs = [core.submit(p, g, adapter_id=f"t{i}")[0]
+                    for i, (p, g) in enumerate(zip(prompts, gens))]
+            _drive(core, reqs, max_iters=2000)
+            # rids are handed out at submit, so the pinned sampling keys
+            # match the references even though execution serialized
+            for i, r in enumerate(reqs):
+                np.testing.assert_array_equal(
+                    np.asarray(r.padded_result()), wants[i])
+            assert core._adapters.pinned_count == 0
+            assert core._adapters.evictions >= 2
+            core._adapters.check_invariants()
+        finally:
+            core.close()
+
+
+# ------------------------------------------------------ salt + prefix
+
+
+class TestSaltComposition:
+    def test_effective_salt(self):
+        assert effective_salt(None, None) is None
+        assert effective_salt("tenant", None) == "tenant"
+        assert effective_salt(None, "a1") == ("adapter", "a1", None)
+        assert effective_salt("tenant", "a1") == \
+            ("adapter", "a1", "tenant")
+
+    def test_route_salt_rides_request(self):
+        store, _ = _store_with({"t0": 1})
+        core = EngineCore(
+            PagedGenerationEngine(_fresh_model(), page_size=8),
+            adapter_store=store, adapter_slots=4, **CORE_SHAPE)
+        try:
+            (r,) = core.submit(_prompt(45, 6),
+                               GenerationConfig(max_new_tokens=2),
+                               cache_salt="s", adapter_id="t0")
+            assert r.route_salt() == ("adapter", "t0", "s")
+            _drive(core, [r])
+        finally:
+            core.close()
+
+    def test_prefix_cache_isolated_per_adapter(self):
+        """Two tenants sharing a prompt prefix never share warm KV: the
+        second tenant's stream equals its own merged reference even
+        after the first tenant warmed the tree, while a same-tenant
+        repeat does hit the cache."""
+        store, made = _store_with({"t0": 24, "t1": 25})
+        ids = _prompt(46, 24)
+        g = GenerationConfig(max_new_tokens=6)
+        (want0,) = _serve(_merged_model(*made["t0"]), [ids], [g],
+                          rid_base=9700)
+        (want1,) = _serve(_merged_model(*made["t1"]), [ids], [g],
+                          rid_base=9700)
+        request_mod._rid_counter = itertools.count(9700)
+        core = EngineCore(
+            PagedGenerationEngine(_fresh_model(), page_size=8),
+            adapter_store=store, adapter_slots=4,
+            enable_prefix_cache=True, **CORE_SHAPE)
+        try:
+            (a,) = core.submit(ids, g, adapter_id="t0")
+            _drive(core, [a])
+            hits0 = core.metrics_snapshot()["prefix_cache"]["hits"]
+            (a2,) = core.submit(ids, g, adapter_id="t0")
+            _drive(core, [a2])
+            hits1 = core.metrics_snapshot()["prefix_cache"]["hits"]
+            assert hits1 > hits0, "same-tenant repeat should hit"
+            (b,) = core.submit(ids, g, adapter_id="t1")
+            _drive(core, [b])
+            np.testing.assert_array_equal(a.padded_result(), want0)
+            np.testing.assert_array_equal(a2.padded_result(), want0)
+            np.testing.assert_array_equal(b.padded_result(), want1)
+        finally:
+            core.close()
+
+
+# ----------------------------------------------------- int8 composition
+
+
+class TestInt8Composition:
+    def _quantized_model(self):
+        from paddle_infer_tpu.quantization import PTQ
+        pit.seed(0)
+        fp = GPTForCausalLM(GPTConfig(**DIMS))
+        fp.eval()
+        ids = np.random.RandomState(3).randint(
+            1, 96, (2, 12)).astype(np.int32)
+        q = GPTForCausalLM(GPTConfig(**DIMS))
+        q.set_state_dict(fp.state_dict())
+        q = PTQ().quantize(q, [(ids,)])   # weight-only by default
+        q.eval()
+        return q
+
+    def test_weight_only_base_slot0_bitwise_and_adapter_diverges(self):
+        """The LoRA delta is fp on top of the dequantized base matmul:
+        slot-0 rows through the converted int8 engine are bitwise the
+        plain int8 engine, and an adapter row visibly moves the stream
+        — with zero post-warmup compiles across residency changes."""
+        from paddle_infer_tpu.observability import get_compile_log
+        store, _ = _store_with({"t0": 26})
+        prompts = [_prompt(47, 9), _prompt(48, 12)]
+        gens = [GenerationConfig(max_new_tokens=6)] * 2
+        want = _serve(self._quantized_model(), prompts, gens,
+                      rid_base=9800)
+        request_mod._rid_counter = itertools.count(9800)
+        core = EngineCore(
+            PagedGenerationEngine(self._quantized_model(), page_size=8),
+            adapter_store=store, adapter_slots=4, **CORE_SHAPE)
+        try:
+            base = [core.submit(p, g)[0]
+                    for p, g in zip(prompts, gens)]
+            _drive(core, base)
+            for w, r in zip(want, base):
+                np.testing.assert_array_equal(
+                    np.asarray(r.padded_result()), w)
+            log = get_compile_log()
+            before = log.summary()["post_warmup_decode_compiles"]
+            (ad,) = core.submit(prompts[0], gens[0], adapter_id="t0")
+            _drive(core, [ad])
+            assert not np.array_equal(
+                np.asarray(ad.padded_result()), want[0])
+            after = log.summary()["post_warmup_decode_compiles"]
+            assert after - before == 0
+        finally:
+            core.close()
+
+
+# -------------------------------------------------------------- handoff
+
+
+class TestHandoff:
+    def test_adapter_binding_migrates(self):
+        """The handoff packet carries the adapter binding: the importer
+        re-pins on its own cache, the stream matches the unmigrated
+        reference, and the exporter's pin is dropped."""
+        store, made = _store_with({"t0": 27})
+        ids = _prompt(49, 24)
+        g = GenerationConfig(max_new_tokens=10)
+        (want,) = _serve(_merged_model(*made["t0"]), [ids], [g],
+                         rid_base=9900)
+
+        request_mod._rid_counter = itertools.count(9900)
+        src = EngineCore(
+            PagedGenerationEngine(_fresh_model(), page_size=8),
+            adapter_store=store, adapter_slots=4, **CORE_SHAPE)
+        dst = EngineCore(
+            PagedGenerationEngine(_fresh_model(), page_size=8),
+            adapter_store=store, adapter_slots=4, **CORE_SHAPE)
+        try:
+            (req,) = src.submit(ids, g, adapter_id="t0")
+            for _ in range(400):
+                if ready_for_handoff(src, req):
+                    break
+                src.run_once()
+            else:
+                raise AssertionError("never handoff-ready")
+            packet = src.export_handoff(req)
+            assert packet["adapter_id"] == "t0"
+            assert src._adapters.pinned_count == 0
+            dst.import_handoff(packet)
+            assert dst._adapters.slot_of("t0") is not None
+            _drive(dst, [req])
+            np.testing.assert_array_equal(req.padded_result(), want)
+            assert dst._adapters.pinned_count == 0
+        finally:
+            src.close()
+            dst.close()
+
+
+# ----------------------------------------------- observability + fuzz
+
+
+class TestObservability:
+    def test_snapshot_and_prometheus_families(self):
+        from paddle_infer_tpu.observability.prometheus import (
+            render_prometheus, validate_exposition)
+        store, _ = _store_with({"t0": 28})
+        core = EngineCore(
+            PagedGenerationEngine(_fresh_model(), page_size=8),
+            adapter_store=store, adapter_slots=4, **CORE_SHAPE)
+        try:
+            (r,) = core.submit(_prompt(50, 8),
+                               GenerationConfig(max_new_tokens=5),
+                               adapter_id="t0")
+            _drive(core, [r])
+            snap = core.metrics_snapshot()
+            ad = snap["adapters"]
+            assert ad["slots"] == 4 and ad["rank"] == RANK
+            assert ad["resident"] == 1 and ad["uploads"] == 1
+            assert ad["store"]["adapters"] == 1
+            assert core.steplog.summary()["adapter_rows_total"] > 0
+            text = render_prometheus(snap)
+            assert validate_exposition(text) == []
+            for fam in ("adapter_info", "adapter_slots_resident",
+                        "adapter_cache_hits_total",
+                        "adapter_uploads_total",
+                        "steplog_adapter_rows_total"):
+                assert fam in text
+        finally:
+            core.close()
+
+
+class TestCacheFuzz:
+    def test_pin_unpin_refcount_fuzz(self):
+        """300 random pin/unpin ops against the cache invariants: pins
+        never go negative, owners stay consistent, MemoryError fires
+        exactly under all-slots-pinned, and a final drain unpins clean."""
+        slots, rank = 4, 2
+        m = _fresh_model()
+        spec = adapter_layer_spec(m)
+        store = AdapterStore(spec, rank=rank)
+        for j in range(10):
+            f, s = make_random_adapter(spec, rank, 100 + j)
+            store.add(f"f{j}", f, scale=s)
+        prepare_lora_serving(m, slots=slots, rank=rank)
+        cache = AdapterCache(PagedGenerationEngine(m, page_size=8),
+                             store)
+        rng = np.random.RandomState(0)
+        held = []                                   # (adapter_id, slot)
+        for step in range(300):
+            if rng.rand() < 0.6 or not held:
+                aid = f"f{int(rng.randint(10))}"
+                try:
+                    slot = cache.pin(aid)
+                    held.append((aid, slot))
+                    assert 0 < slot < slots
+                except MemoryError:
+                    assert cache.pinned_count == slots - 1
+            else:
+                aid, slot = held.pop(int(rng.randint(len(held))))
+                cache.unpin(slot)
+            cache.check_invariants()
+            assert cache.resident_count <= slots - 1
+        for _, slot in held:
+            cache.unpin(slot)
+        cache.check_invariants()
+        assert cache.pinned_count == 0
+        with pytest.raises(AdapterError, match="unpinned"):
+            cache.unpin(1)
+        assert cache.pin(None) == 0                 # identity fast path
+        cache.unpin(0)                              # and its no-op drop
+
+    def test_churn_fuzz_256_adapters_zero_compiles(self):
+        """The tenancy acceptance fuzz: >=200 mixed steps drawing from
+        256 registered adapters over 6 device slots — misses, uploads
+        and LRU evictions on nearly every admission — with ZERO
+        post-warmup decode compiles.  Residency churn is slot DATA; the
+        executable never follows it."""
+        from paddle_infer_tpu.observability import get_compile_log
+        m = _fresh_model()
+        spec = adapter_layer_spec(m)
+        store = AdapterStore(spec, rank=2)
+        for j in range(256):
+            f, s = make_random_adapter(spec, 2, 500 + j, amplitude=0.05)
+            store.add(f"c{j}", f, scale=s)
+        request_mod._rid_counter = itertools.count(9950)
+        core = EngineCore(PagedGenerationEngine(m, page_size=8),
+                          adapter_store=store, adapter_slots=6,
+                          **CORE_SHAPE)
+        rng = np.random.RandomState(0)
+        try:
+            warm = [core.submit(_prompt(60, 8),
+                                GenerationConfig(max_new_tokens=4),
+                                adapter_id="c0")[0],
+                    core.submit(_prompt(61, 30),
+                                GenerationConfig(max_new_tokens=4,
+                                                 do_sample=True,
+                                                 seed=1))[0]]
+            _drive(core, warm)
+            log = get_compile_log()
+            before = log.summary()["post_warmup_decode_compiles"]
+            steps0 = core.steplog.summary()["records"]
+
+            live, i = [], 0
+            for _ in range(6000):
+                done_steps = core.steplog.summary()["records"] - steps0
+                if done_steps >= 200 and not live:
+                    break
+                if done_steps < 200 and len(live) < 4:
+                    i += 1
+                    n = int(rng.randint(3, 36))
+                    aid = (None if rng.rand() < 0.25
+                           else f"c{int(rng.randint(256))}")
+                    if rng.rand() < 0.5:
+                        g = GenerationConfig(
+                            max_new_tokens=int(rng.randint(2, 8)))
+                    else:
+                        g = GenerationConfig(
+                            max_new_tokens=int(rng.randint(2, 8)),
+                            do_sample=True, temperature=0.9, seed=i)
+                    live.append(core.submit(_prompt(100 + i, n), g,
+                                            adapter_id=aid)[0])
+                core.run_once()
+                live = [r for r in live if not r.done]
+            total = core.steplog.summary()["records"] - steps0
+            assert total >= 200, f"fuzz only drove {total} steps"
+            after = log.summary()["post_warmup_decode_compiles"]
+            assert after - before == 0
+            summ = core._adapters.summary()
+            assert summ["evictions"] > 0, "fuzz never churned a slot"
+            assert core._adapters.pinned_count == 0
+            core._adapters.check_invariants()
+        finally:
+            core.close()

@@ -207,6 +207,33 @@ def test_recompile_hazard_moe_names_allowed_outside_serving(tmp_path):
                      rel="parallel/mod.py") == []
 
 
+ADAPTER_BUILDER = """
+    def build_lora_step(engine, rank, adapter_slots):
+        return engine.compile(rank, adapter_slots)
+"""
+
+
+def test_recompile_hazard_fires_on_adapter_keyed_serving_builder(
+        tmp_path):
+    # rank / slot count are deployment config in serving/ — a builder
+    # signature taking them compiles one executable per adapter shape,
+    # so residency churn would compile instead of riding as row data
+    fs = run_rules(tmp_path, ADAPTER_BUILDER, ["recompile-hazard"],
+                   rel="serving/adapters/mod.py")
+    assert len(fs) == 1
+    assert "build_lora_step(rank, adapter_slots)" in fs[0].message
+    assert "prepare_lora_serving" in fs[0].message
+    assert "per-row slot DATA" in fs[0].message
+
+
+def test_recompile_hazard_adapter_names_allowed_outside_serving(
+        tmp_path):
+    # training-side LoRA code legitimately parameterizes over rank; the
+    # adapter name set only binds under serving/
+    assert run_rules(tmp_path, ADAPTER_BUILDER, ["recompile-hazard"],
+                     rel="peft/mod.py") == []
+
+
 # ------------------------------------------------------ lock-discipline
 def test_lock_discipline_fires_on_unlocked_read(tmp_path):
     src = """

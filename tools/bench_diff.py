@@ -38,10 +38,18 @@ _HIGHER = ("per_s", "per_sec", "speedup", "mfu", "acceptance",
 # dispatch_bytes_saved lands under the bytes_saved rule above.
 # multi_tenant: attainment/goodput up (rules above); shed rate,
 # deadline misses and slack violations down — a scheduler round that
-# sheds or misses more at equal offered load regressed
+# sheds or misses more at equal offered load regressed.
+# adapter_tenancy: tok_per_s/hit_rate up and itl/compile down fall
+# under the rules above-and-below; uploads and evictions are also
+# lower-is-better because each config replays one recorded popularity
+# draw — more host->device factor traffic or slot churn at identical
+# offered load means the residency policy regressed, and any
+# post-warmup compile under adapter churn is exactly the program-
+# family leak the slot-data design exists to prevent
 _LOWER = ("_ms", "latency", "ttft", "itl", "err", "wall", "p50",
           "p99", "wasted", "ici_bytes", "compile", "skew", "dropped",
-          "dispatch_bytes", "shed", "misses", "violation", "_s")
+          "dispatch_bytes", "shed", "misses", "violation", "uploads",
+          "evictions", "_s")
 # harness bookkeeping, not workload performance
 _SKIP = ("vs_baseline", "child_wall_s", "bench_wall_s", "n", "rc")
 

@@ -77,7 +77,7 @@ def fabricated_exposition():
                    cost_source="xla+pages", decode_rows=3,
                    emitted_tokens=3, moe_tokens_routed=24,
                    moe_tokens_dropped=2, moe_aux_loss=1.02,
-                   kernel="ragged")
+                   adapter_rows=2, kernel="ragged")
     steplog.record("evict", pages_freed=3, bytes_est=3.0e5,
                    cost_source="analytic")
 
@@ -160,6 +160,17 @@ def fabricated_exposition():
                            "capacity": 8, "ep": 2,
                            "algo": "weight_only_int8", "layers": 2,
                            "expert_hbm_bytes": 3.2e6},
+                      # AdapterCache.summary() shape (multi-LoRA plane)
+                      adapters={"slots": 8, "rank": 8, "layers": 8,
+                                "pool_hbm_bytes": 1.6e6, "resident": 5,
+                                "pinned": 2, "hits": 21, "misses": 9,
+                                "hit_rate": 0.7, "uploads": 9,
+                                "upload_bytes": 7.3e5, "evictions": 3,
+                                "store": {"adapters": 12, "rank": 8,
+                                          "page_bytes": 65536,
+                                          "pages_total": 4096,
+                                          "pages_used": 24,
+                                          "bytes_used": 1.5e6}},
                       device_memory={"bytes_in_use": 1 << 20,
                                      "peak_bytes_in_use": 1 << 21,
                                      "bytes_limit": 1 << 30,

@@ -18,7 +18,10 @@ Trace model:
     ranges, and may carry a shared prompt prefix: all of a tenant's
     requests repeat the same leading tokens and the tenant's
     ``cache_salt``, so replays ride the prefix cache exactly like a
-    fleet of users sharing a system prompt.
+    fleet of users sharing a system prompt.  A tenant may also bind a
+    LoRA adapter: ``adapter_id`` pins every request to one adapter;
+    ``adapter_ids`` (a list) draws one per event — the residency-churn
+    regime the AdapterCache's slot LRU is sized against.
   * determinism — everything is drawn from one ``numpy`` RandomState
     seeded by the caller.  The same seed yields the same event list,
     and ``write_trace``/``read_trace`` round-trip it losslessly, so a
@@ -64,8 +67,8 @@ def generate_trace(seed: int, duration_s: float, rate_per_s: float,
                    do_sample: bool = False) -> List[Dict]:
     """Seeded bursty multi-tenant trace: a time-sorted list of event
     dicts ``{t, i, tenant, prompt, max_new, timeout_s, cache_salt,
-    seed, do_sample}``.  ``rate_per_s`` is the TOTAL offered rate,
-    split across tenants by weight."""
+    adapter_id, seed, do_sample}``.  ``rate_per_s`` is the TOTAL
+    offered rate, split across tenants by weight."""
     rng = np.random.RandomState(int(seed))
     burstiness = max(float(burstiness), 1e-6)
     total_w = sum(float(t["weight"]) for t in tenants)
@@ -95,6 +98,15 @@ def generate_trace(seed: int, duration_s: float, rate_per_s: float,
             tmo = t["timeout_s"]
             if tmo is not None:
                 tmo = float(rng.uniform(tmo[0], tmo[1]))
+            # adapter binding: fixed per tenant, or one draw per event
+            # from the tenant's pool (adapter-churn traces).  The draw
+            # only happens for pooled tenants, so adapter-free tenants
+            # keep their pre-adapter random streams bit-identical.
+            pool = t.get("adapter_ids")
+            if pool:
+                adapter_id = str(pool[int(rng.randint(0, len(pool)))])
+            else:
+                adapter_id = t.get("adapter_id")
             events.append({
                 "t": round(now, 6),
                 "tenant": t["name"],
@@ -104,6 +116,7 @@ def generate_trace(seed: int, duration_s: float, rate_per_s: float,
                 "timeout_s": (round(tmo, 6) if tmo is not None
                               else None),
                 "cache_salt": t.get("cache_salt"),
+                "adapter_id": adapter_id,
                 "seed": int(rng.randint(0, 2 ** 31 - 1)),
                 "do_sample": bool(do_sample),
             })
@@ -144,7 +157,8 @@ def request_from_event(event: Dict):
                          seed=int(event.get("seed", 0)))
     return Request(np.asarray(event["prompt"], np.int32), g,
                    timeout_s=event.get("timeout_s"),
-                   cache_salt=event.get("cache_salt"))
+                   cache_salt=event.get("cache_salt"),
+                   adapter_id=event.get("adapter_id"))
 
 
 def replay(core, events: List[Dict], time_scale: float = 1.0,
@@ -198,9 +212,20 @@ def main(argv=None) -> int:
     ap.add_argument("--burstiness", type=float, default=4.0,
                     help="interarrival Gamma burstiness (1 = Poisson)")
     ap.add_argument("--vocab_size", type=int, default=96)
+    ap.add_argument("--adapters", type=int, default=0,
+                    help="give every tenant a shared pool of N adapter "
+                         "ids ('adapter-0'..) with one draw per event — "
+                         "the adapter-churn regime that exercises the "
+                         "AdapterCache slot LRU")
     ap.add_argument("--out", required=True, help="output trace JSONL")
     args = ap.parse_args(argv)
+    tenants = DEFAULT_TENANTS
+    if args.adapters > 0:
+        pool = [f"adapter-{j}" for j in range(args.adapters)]
+        tenants = tuple(dict(t, adapter_ids=pool)
+                        for t in DEFAULT_TENANTS)
     events = generate_trace(args.seed, args.duration_s, args.rate_per_s,
+                            tenants=tenants,
                             vocab_size=args.vocab_size,
                             burstiness=args.burstiness)
     write_trace(args.out, events)

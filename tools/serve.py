@@ -53,9 +53,15 @@ prefix-affinity FleetRouter with cross-replica KV page handoff
 (docs/SERVING.md "Disaggregated serving"); admin endpoints then act
 fleet-wide and /metrics carries the ``router_*`` families.
 
+With ``--adapter_dir`` the process serves multi-LoRA tenants: every
+``<id>.npz`` checkpoint in the directory registers adapter ``<id>`` in
+a validated AdapterStore, and generation bodies may carry a per-request
+``"adapter_id"`` field (docs/SERVING.md "Multi-LoRA serving").  An
+unknown adapter_id is a client error -> 400, never a 500.
+
 Admission control maps to HTTP codes: queue full -> 429 + Retry-After,
 draining/load-shed -> 503 + Retry-After, deadline exceeded -> 504,
-unbatchable/oversized -> 400.  Retry-After is derived from queue depth
+unbatchable/oversized/unknown-adapter -> 400.  Retry-After is derived from queue depth
 x recent step time (health state overrides while DRAINING/DOWN).
 Requests the batch can't host (beams, repetition penalty) and
 speculative-eligible requests run exclusively on the scheduler thread
@@ -184,6 +190,8 @@ def _core():
                 sched_policy=_STATE.get("sched_policy", "fifo"),
                 slo_ttft_s=_STATE.get("slo_ttft_s"),
                 slo_itl_s=_STATE.get("slo_itl_s"),
+                adapter_store=_STATE.get("adapter_store"),
+                adapter_slots=_STATE.get("adapter_slots", 8),
                 speculate=_STATE.get("speculate", False),
                 num_draft_tokens=_STATE.get("num_draft_tokens", 4),
                 draft_source=_STATE.get("draft_source", "auto"),
@@ -285,26 +293,43 @@ def _error_code(e) -> int:
     return 500
 
 
-def _submit_batch(core, ids, g, timeout_s, cache_salt):
+def _submit_batch(core, ids, g, timeout_s, cache_salt, adapter_id=None):
     """Batchable admission: per-row through the fleet router when one
     is up (role/affinity/health-aware placement), else the single
     core's all-or-nothing submit."""
     router = _STATE.get("router")
     if router is None:
         return core.submit(ids, g, timeout_s=timeout_s,
-                           cache_salt=cache_salt)
+                           cache_salt=cache_salt, adapter_id=adapter_id)
     ids = np.asarray(ids, np.int32)
     if ids.ndim == 1:
         ids = ids[None, :]
     return [router.submit(row, g, timeout_s=timeout_s,
-                          cache_salt=cache_salt) for row in ids]
+                          cache_salt=cache_salt, adapter_id=adapter_id)
+            for row in ids]
 
 
-def _generate(ids, g, timeout_s, cache_salt=None):
+def _generate(ids, g, timeout_s, cache_salt=None, adapter_id=None):
     """Route one /generate body; returns (tokens [b, max_new], extra).
     ``extra["request_ids"]`` always carries the engine request ids so
     the client can fetch the span trace via ``GET /trace/<rid>``."""
     core = _core()
+    if adapter_id is not None:
+        # adapter deltas live only in the converted paged engine — the
+        # dense exclusive / separate-spec-engine bypasses would silently
+        # serve the BASE model, so adapter requests must be batchable
+        if not core.batchable(g):
+            from paddle_infer_tpu.serving import RejectedError
+
+            raise RejectedError(
+                "adapter_id requires a batchable request (no beams / "
+                "repetition penalty): the exclusive dense path serves "
+                "the base model only")
+        reqs = _submit_batch(core, ids, g, timeout_s, cache_salt,
+                             adapter_id=adapter_id)
+        return (np.stack([r.padded_result(timeout=None) for r in reqs]),
+                {"request_ids": [r.rid for r in reqs],
+                 "adapter_id": adapter_id})
     if _speculatable(ids, g):
         def call():
             eng = _spec_engine()
@@ -504,6 +529,11 @@ class Handler(BaseHTTPRequestHandler):
             cache_salt = body.get("cache_salt")
             if cache_salt is not None:
                 cache_salt = str(cache_salt)
+            # per-request LoRA tenant binding; validated at submit time
+            # against the adapter store (unknown -> 400)
+            adapter_id = body.get("adapter_id")
+            if adapter_id is not None:
+                adapter_id = str(adapter_id)
         except Exception as e:
             self._json(400, {"error": f"bad request: {e!r}"})
             return
@@ -517,7 +547,8 @@ class Handler(BaseHTTPRequestHandler):
         try:
             if self.path == "/generate":
                 toks, extra = _generate(ids, g, timeout_s,
-                                        cache_salt=cache_salt)
+                                        cache_salt=cache_salt,
+                                        adapter_id=adapter_id)
                 # detokenize/serialize span appended post-finish (the
                 # tracer ring keeps completed traces mutable for this);
                 # recorded BEFORE the response bytes go out so the trace
@@ -537,7 +568,7 @@ class Handler(BaseHTTPRequestHandler):
                 # submit BEFORE headers so admission errors (429/504/400)
                 # still map to status codes
                 reqs = _submit_batch(_core(), ids, g, timeout_s,
-                                     cache_salt)
+                                     cache_salt, adapter_id=adapter_id)
                 chunks = _stream_chunks(
                     reqs, g, chunk_size=int(body.get("chunk_size", 8)))
                 self.send_response(200)
@@ -740,6 +771,26 @@ def main(argv=None):
                          "(0, 1); required to combine kv_dtype=int4 "
                          "with --speculate (4-bit KV dequant error can "
                          "flip near-tie verify comparisons)")
+    ap.add_argument("--adapter_dir", default=None,
+                    help="multi-LoRA tenancy: directory of per-tenant "
+                         "adapter checkpoints, one <id>.npz each with "
+                         "arrays '<layer_path>.a' [d_in, r] / "
+                         "'<layer_path>.b' [r, d_out] and an optional "
+                         "scalar 'scale'; requests bind a tenant via a "
+                         "per-request \"adapter_id\" body field "
+                         "(docs/SERVING.md 'Multi-LoRA serving'); "
+                         "requires the ragged scheduler")
+    ap.add_argument("--adapter_rank", type=int, default=None,
+                    help="the deployment's fixed LoRA rank r (required "
+                         "with --adapter_dir): every adapter checkpoint "
+                         "must carry exactly this rank — rank is part "
+                         "of the mixed-step executable key, so it is a "
+                         "deploy constant, never per-adapter")
+    ap.add_argument("--adapter_slots", type=int, default=8,
+                    help="device-resident adapter slots (slot 0 is the "
+                         "reserved identity): bounds how many tenants "
+                         "share HBM concurrently; the slot-LRU evicts "
+                         "unpinned tenants beyond it")
     ap.add_argument("--fleet_roles", default=None,
                     help="disaggregated fleet: comma-separated replica "
                          "roles, e.g. 'prefill,decode,mixed' — one "
@@ -777,7 +828,10 @@ def main(argv=None):
             ("--quantized_allreduce", bool(args.quantized_allreduce)),
             ("--legacy_programs", args.legacy_programs),
             ("--speculate", args.speculate),
-            ("--fault_script", bool(args.fault_script))) if on]
+            ("--fault_script", bool(args.fault_script)),
+            # fleet replicas share one model object; per-replica
+            # AdapterCaches would fight over the same slot pools
+            ("--adapter_dir", bool(args.adapter_dir))) if on]
         if incompatible:
             print("error: --fleet_roles is incompatible with "
                   + ", ".join(incompatible)
@@ -811,6 +865,55 @@ def main(argv=None):
 
         quantize_model(_STATE["model"],
                        algo=f"weight_only_{args.weight_only}")
+
+    _STATE["adapter_store"] = None
+    _STATE["adapter_slots"] = args.adapter_slots
+    if args.adapter_dir:
+        import glob
+        import os
+
+        from paddle_infer_tpu.serving import (AdapterError, AdapterStore,
+                                              adapter_layer_spec)
+
+        if args.legacy_programs:
+            print("error: multi-LoRA serving requires the ragged mixed "
+                  "step; drop --legacy_programs",
+                  file=sys.stderr, flush=True)
+            return 2
+        if not args.adapter_rank:
+            print("error: --adapter_dir needs --adapter_rank (the "
+                  "deployment's fixed LoRA rank)",
+                  file=sys.stderr, flush=True)
+            return 2
+        spec = adapter_layer_spec(_STATE["model"])
+        try:
+            store = AdapterStore(spec, rank=args.adapter_rank)
+            paths = sorted(glob.glob(
+                os.path.join(args.adapter_dir, "*.npz")))
+            for ckpt in paths:
+                aid = os.path.splitext(os.path.basename(ckpt))[0]
+                data = np.load(ckpt)
+                factors = {}
+                for key in data.files:
+                    if key.endswith(".a"):
+                        lp = key[:-len(".a")]
+                        factors[lp] = (data[key], data[lp + ".b"])
+                scale = (float(data["scale"])
+                         if "scale" in data.files else 1.0)
+                store.add(aid, factors, scale=scale)
+        except (AdapterError, KeyError, MemoryError, ValueError) as e:
+            print(f"error: bad adapter checkpoint in "
+                  f"{args.adapter_dir}: {e}", file=sys.stderr, flush=True)
+            return 2
+        if not store.adapter_ids():
+            print(f"error: --adapter_dir {args.adapter_dir} holds no "
+                  "*.npz adapter checkpoints",
+                  file=sys.stderr, flush=True)
+            return 2
+        _STATE["adapter_store"] = store
+        print(f"adapters: {len(store.adapter_ids())} registered "
+              f"(rank {store.rank}, {args.adapter_slots} device slots)",
+              flush=True)
 
     from paddle_infer_tpu.serving import moe_serving_info
 
