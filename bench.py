@@ -670,6 +670,13 @@ def _child_main():
                                   lambda: _adapter_tenancy_bench(on_tpu),
                                   tpu_only=False)
 
+    # host-RAM KV tier: oversubscription replay without/with the tier —
+    # sheds become parks, deadline-less goodput holds at 1.0, streams
+    # stay bitwise identical, zero post-warmup compiles
+    kv_tier = run_section("kv_tier", 560,
+                          lambda: _kv_tier_bench(on_tpu),
+                          tpu_only=False)
+
     result = {
         **headline,
         "tokens_per_sec_single_block": round(tokens_per_sec_single, 1),
@@ -740,6 +747,8 @@ def _child_main():
         result["multi_tenant"] = multi_tenant
     if adapter_tenancy is not None:
         result["adapter_tenancy"] = adapter_tenancy
+    if kv_tier is not None:
+        result["kv_tier"] = kv_tier
     if skipped_sections:
         result["skipped_sections"] = skipped_sections
     result["child_wall_s"] = round(time.monotonic() - child_t0, 1)
@@ -1343,6 +1352,145 @@ def _multi_tenant_bench(on_tpu: bool):
         out["planner_pred_wall_max_abs_rel_err"] = round(
             planner["max_abs_rel_err"], 4)
     return out
+
+
+def _kv_tier_bench(on_tpu: bool):
+    """Host-RAM KV tier A/B: replay ONE recorded oversubscription trace
+    (tight-deadline chat bursts over sustained deadline-less batch work
+    at 2-4x the slot capacity) under ``slack`` admission, without and
+    with a host tier.  Without the tier the EDF policy predictively
+    SHEDS doomed requests; with it every shed decision becomes a PARK
+    of the deadline-richest victim — the doomed request admits into the
+    freed slot and the victim resumes bitwise later, so deadline-less
+    goodput holds at 1.0 with zero sheds while the token streams stay
+    bitwise identical and the decode executable never recompiles (park
+    and resume move page contents, never shapes)."""
+    import itertools
+
+    import paddle_infer_tpu as pit
+    from paddle_infer_tpu.inference import (GenerationConfig,
+                                            PagedGenerationEngine)
+    from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_infer_tpu.observability.compilelog import get_compile_log
+    from paddle_infer_tpu.serving import EngineCore, RequestState
+    from paddle_infer_tpu.serving import request as request_mod
+    from tools import loadgen
+
+    pit.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=128,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    intermediate_size=256, max_position_embeddings=256,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+
+    # offered load: the deadline-less oversubscription mix plus one
+    # tight-deadline interactive class whose bursts force the slack
+    # policy into shed-or-park decisions
+    tenants = loadgen.oversubscription_tenants(1.0) + (
+        {"name": "chat", "weight": 4.0, "prompt_len": (4, 12),
+         "max_new": (8, 16), "timeout_s": (0.5, 1.0),
+         "shared_prefix_len": 0, "cache_salt": None},
+    )
+    trace_path = "/tmp/pit_bench_kv_tier_trace.jsonl"
+    loadgen.write_trace(trace_path, loadgen.generate_trace(
+        1, duration_s=2.5, rate_per_s=40.0, tenants=tenants,
+        vocab_size=cfg.vocab_size, burstiness=8.0, do_sample=True))
+    events = loadgen.read_trace(trace_path)
+    max_plen = max(len(e["prompt"]) for e in events)
+    max_new = max(int(e["max_new"]) for e in events)
+    batch_events = [e for e in events if e["timeout_s"] is None]
+
+    def run(host_pages):
+        request_mod._rid_counter = itertools.count(60_000)
+        core = EngineCore(
+            PagedGenerationEngine(model, page_size=16, prompt_bucket=16),
+            max_batch=4, decode_chunk=8,
+            max_model_len=max_plen + max_new,
+            enable_prefix_cache=True,
+            sched_policy="slack", slo_ttft_s=0.5, slo_itl_s=0.25,
+            kv_host_pages=host_pages)
+        try:
+            g = GenerationConfig(max_new_tokens=16)
+            rngw = np.random.RandomState(123)
+            warm = [core.submit(rngw.randint(
+                0, cfg.vocab_size, (n,)).astype(np.int32), g)[0]
+                for n in (8, 16, 28)]
+            while not all(r.done for r in warm):
+                core.run_once(wait_s=0.0)
+            core.metrics.reset()
+            compiles0 = get_compile_log().summary()[
+                "post_warmup_decode_compiles"]
+            t0 = time.perf_counter()
+            handles = loadgen.replay(core, events, timeout_s=240.0)
+            wall = time.perf_counter() - t0
+            compiles = get_compile_log().summary()[
+                "post_warmup_decode_compiles"] - compiles0
+            snap = core.metrics_snapshot()
+        finally:
+            core.close()
+        done = {i: r for i, r in handles.items()
+                if r.state == RequestState.DONE}
+        tier = snap.get("kv_tier") or {}
+        sched = snap.get("sched") or {}
+        return {
+            "goodput_batch": (sum(1 for e in batch_events
+                                  if e["i"] in done)
+                              / max(len(batch_events), 1)),
+            "goodput_tok_per_s":
+                sum(r.emitted for r in done.values()) / wall,
+            "completed": len(done),
+            "sheds": int(snap["resilience"]["requests_shed"])
+            + int(sched.get("predictive_sheds", 0)),
+            "deadline_misses": int(
+                snap["counters"]["cancelled_deadline"]),
+            "parks": int(tier.get("parks_total", 0)),
+            "resumes": int(tier.get("resumes_total", 0)),
+            "swap_fails": int(tier.get("swap_fails_total", 0)),
+            "host_pages_peak": int(tier.get("host_pages_peak", 0)),
+            "compiles": int(compiles),
+            "streams": {i: np.asarray(r.tokens, np.int32)
+                        for i, r in handles.items()},
+        }
+
+    base = run(0)
+    tier = run(256)
+
+    # bitwise gate: whatever both runs delivered for the same trace
+    # event must agree on the common prefix — parked-and-resumed
+    # streams equal the never-parked ones
+    identical = True
+    for i in base["streams"]:
+        a, b = base["streams"][i], tier["streams"][i]
+        n = min(a.size, b.size)
+        if not np.array_equal(a[:n], b[:n]):
+            identical = False
+            break
+
+    return {
+        "trace_events": len(events),
+        "trace_batch_events": len(batch_events),
+        "trace_path": trace_path,
+        "goodput_batch_base": round(base["goodput_batch"], 3),
+        "goodput_batch_tier": round(tier["goodput_batch"], 3),
+        "goodput_tok_per_s_base": round(base["goodput_tok_per_s"], 1),
+        "goodput_tok_per_s_tier": round(tier["goodput_tok_per_s"], 1),
+        "sheds_base": base["sheds"],
+        "sheds_tier": tier["sheds"],
+        "deadline_misses_base": base["deadline_misses"],
+        "deadline_misses_tier": tier["deadline_misses"],
+        "parks": tier["parks"],
+        "resumes": tier["resumes"],
+        "swap_fails": tier["swap_fails"],
+        "host_pages_peak": tier["host_pages_peak"],
+        "park_dont_drop": bool(
+            tier["sheds"] == 0
+            and tier["goodput_batch"] >= base["goodput_batch"]),
+        "identical_streams": identical,
+        "post_warmup_decode_compiles": base["compiles"]
+        + tier["compiles"],
+    }
 
 
 def _adapter_tenancy_bench(on_tpu: bool):

@@ -301,6 +301,65 @@ def render_prometheus(snapshot: dict,
         w.sample("adapter_store_pages", st.get("pages_used"),
                  {"state": "used"})
 
+    kt = snapshot.get("kv_tier") or {}
+    if kt:
+        w.family("kv_tier_parked_requests", "gauge",
+                 "Active requests currently preemption-parked in the "
+                 "host-RAM KV tier")
+        w.sample("kv_tier_parked_requests", kt.get("parked_requests", 0))
+        w.family("kv_tier_host_pages", "gauge",
+                 "Host arena pages by state: capacity, resident "
+                 "(parked KV + demoted prefix blocks), lifetime peak")
+        w.sample("kv_tier_host_pages", kt.get("host_pages_total"),
+                 {"state": "total"})
+        w.sample("kv_tier_host_pages", kt.get("host_pages_resident"),
+                 {"state": "resident"})
+        w.sample("kv_tier_host_pages", kt.get("host_pages_peak"),
+                 {"state": "peak"})
+        w.family("kv_tier_demoted_blocks", "gauge",
+                 "Full prefix-cache pages currently demoted to the "
+                 "host tier (promote-on-hit candidates)")
+        w.sample("kv_tier_demoted_blocks", kt.get("demoted_blocks", 0))
+        w.family("kv_tier_parks_total", "counter",
+                 "Active rows preempted into the host tier (park, "
+                 "don't drop)")
+        w.sample("kv_tier_parks_total", kt.get("parks_total", 0))
+        w.family("kv_tier_predictive_parks_total", "counter",
+                 "Parks initiated by the predictive admission planner "
+                 "(subset of kv_tier_parks_total)")
+        w.sample("kv_tier_predictive_parks_total",
+                 kt.get("predictive_parks_total", 0))
+        w.family("kv_tier_resumes_total", "counter",
+                 "Parked rows resumed bitwise back into a device slot")
+        w.sample("kv_tier_resumes_total", kt.get("resumes_total", 0))
+        w.family("kv_tier_demotes_total", "counter",
+                 "Full prefix-cache pages demoted to host on LRU "
+                 "eviction")
+        w.sample("kv_tier_demotes_total", kt.get("demotes_total", 0))
+        w.family("kv_tier_promotes_total", "counter",
+                 "Demoted pages promoted back to fresh device blocks "
+                 "on a prefix re-hit")
+        w.sample("kv_tier_promotes_total", kt.get("promotes_total", 0))
+        w.family("kv_tier_swap_out_bytes_total", "counter",
+                 "KV bytes moved device -> host by parks and "
+                 "demotions (int8 KV pools halve this)")
+        w.sample("kv_tier_swap_out_bytes_total",
+                 kt.get("swap_out_bytes_total", 0))
+        w.family("kv_tier_swap_in_bytes_total", "counter",
+                 "KV bytes moved host -> device by resumes and "
+                 "promotions")
+        w.sample("kv_tier_swap_in_bytes_total",
+                 kt.get("swap_in_bytes_total", 0))
+        w.family("kv_tier_swap_retries_total", "counter",
+                 "Bounded retries across the kv.swap_out / kv.swap_in "
+                 "fault sites")
+        w.sample("kv_tier_swap_retries_total",
+                 kt.get("swap_retries_total", 0))
+        w.family("kv_tier_swap_fails_total", "counter",
+                 "Swaps abandoned after exhausting bounded retries "
+                 "(fell back to the shed/replay ladder)")
+        w.sample("kv_tier_swap_fails_total", kt.get("swap_fails_total", 0))
+
     px = snapshot.get("prefix_cache") or {}
     if px:
         w.family("prefix_cache_queries_total", "counter",

@@ -94,6 +94,10 @@ _SCHEMA = (
     ("planned_chunk_cap", 0),    # per-row prompt-chunk cap this step
     ("predicted_wall_s", 0.0),   # planner's predicted step wall (0.0
                                  # while the fit is cold)
+    ("parked_rows", 0),          # requests parked in the host KV tier
+                                 # at capture
+    ("host_pages", 0),           # host-tier pages resident at capture
+                                 # (parked KV + demoted prefix blocks)
 )
 SCHEMA_KEYS = tuple(k for k, _ in _SCHEMA)
 

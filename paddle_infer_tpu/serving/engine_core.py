@@ -58,6 +58,7 @@ from ..inference.generation import (GenerationConfig, PagedGenerationEngine,
 from ..observability import Tracer, get_compile_log
 from ..observability.steplog import StepCostModel, StepLog
 from .adapters import UnknownAdapterError
+from .kv_tier import HostKVTier
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .programs import (build_decode, build_mixed_step, build_page_copy,
@@ -108,7 +109,10 @@ class EngineCore:
                  slo_ttft_s: Optional[float] = None,
                  slo_itl_s: Optional[float] = None,
                  adapter_store=None,
-                 adapter_slots: int = 8):
+                 adapter_slots: int = 8,
+                 kv_host_pages: int = 0,
+                 kv_park_watermark: float = 0.95,
+                 kv_resume_watermark: float = 0.70):
         # sharded serving plane (serving/sharded/): when a ServingMesh is
         # handed in, re-validate it against THIS core's feature flags so
         # incompatible combos (quantized wire + speculation/prefix cache)
@@ -347,6 +351,32 @@ class EngineCore:
         self.steplog = steplog if steplog is not None else StepLog()
         self._cost_model = StepCostModel(engine, self._pool)
 
+        # host-RAM KV tier (serving/kv_tier/): a page-accounted host
+        # arena under the device pool.  Overload parks whole in-flight
+        # rows (the handoff serialization retargeted at a host buffer)
+        # instead of shedding them, and prefix-tree eviction demotes
+        # full blocks there instead of dropping them.  Constructed
+        # after the cost model: its calibrated per-page byte constant
+        # prices swap traffic (int8 pools halve host bytes for free).
+        self._kv_tier: Optional[HostKVTier] = None
+        if int(kv_host_pages) > 0:
+            if not self._ragged:
+                raise ValueError(
+                    "kv_host_pages requires ragged=True: park/resume "
+                    "serializes the mixed step's slot state")
+            self._kv_tier = HostKVTier(
+                int(kv_host_pages),
+                park_watermark=float(kv_park_watermark),
+                resume_watermark=float(kv_resume_watermark),
+                page_kv_bytes=self._cost_model.page_kv_bytes)
+            if self._prefix_cache is not None:
+                # direct assignment, not a setter: the static lock walk
+                # binds the tree's eviction-hook fire site to
+                # _demote_block through this form, so the
+                # PrefixCache._lock -> HostKVTier._lock edge lands in
+                # the committed lock graph
+                self._prefix_cache._tier_demote = self._demote_block
+
         # SLO-aware scheduling (serving/sched/): the admission policy
         # reorders/sheds the queue from predicted completion; the step
         # planner caps prompt chunking from predicted step wall.  Both
@@ -513,6 +543,15 @@ class EngineCore:
                   if r.sched_predicted_slack is not None]
         self._last_min_slack_s = min(slacks) if slacks else None
         for r in shed:
+            # predictive PARK before predictive shed: preempting a
+            # deadline-rich victim into the host tier frees its pages
+            # and slot, which usually flips the doomed forecast.  The
+            # would-be-shed request re-enters at the queue head; only
+            # when no victim can park does the shed go through.
+            if (self._kv_tier is not None
+                    and self._park_for_pressure(predictive=True)):
+                self._queue.push_front(r)
+                continue
             self._predictive_sheds += 1
             self._metrics.on_predictive_shed()
             miss = ((r.sched_predicted_done - r.deadline)
@@ -616,6 +655,8 @@ class EngineCore:
             moe=self._moe,
             adapters=(self._adapters.summary()
                       if self._adapters is not None else None),
+            kv_tier=(self._kv_tier.summary()
+                     if self._kv_tier is not None else None),
             sched=self._sched_snapshot())
 
     # ------------------------------------------------------- trace hooks
@@ -810,6 +851,12 @@ class EngineCore:
         if self._sched.reorders:
             progressed = bool(self._schedule_admission(now)) or progressed
 
+        # parked requests re-enter AHEAD of the queue (queue-head
+        # semantics): resume into freed slots under the watermark
+        # hysteresis before any new request is admitted
+        if self._kv_tier is not None:
+            progressed = self._resume_parked(now) or progressed
+
         # admission honors the degradation ladder: under memory pressure
         # the supervisor shrinks effective_max_batch below the physical
         # slot count and the surplus slots stay empty
@@ -888,6 +935,8 @@ class EngineCore:
         # route_salt composes the tenant salt with the adapter binding:
         # KV written under one fine-tune is never warm for another
         match = cache.match(tokens, salt=req.route_salt())
+        if self._kv_tier is not None and self._kv_tier.demoted_count:
+            self._promote_into_match(req, tokens, match)
         while (match.cached_tokens and
                match.cached_tokens +
                self._plen(length - match.cached_tokens) > self._plen_cap):
@@ -1254,10 +1303,23 @@ class EngineCore:
         slot's KV was released.  Route it through the recovery protocol:
         memory pressure feeds the degradation ladder, KV loss restarts
         the engine and replays every in-flight row, and the request
-        itself is requeued under its retry budget or failed."""
+        itself is requeued under its retry budget or failed.
+
+        Park-before-shed: a MemoryError first tries to preempt a victim
+        into the host KV tier (cheap and reversible — nothing is lost);
+        the degradation ladder only advances when the tier is exhausted
+        or disabled."""
         rec = self._recovery
         if getattr(err, "lose_kv", False):
             self._engine.drop_kv_state()
+        if (isinstance(err, MemoryError)
+                and not self._engine.kv_state_lost()
+                and self._park_for_pressure()):
+            # a victim's pages and slot are free now: the request
+            # re-enters at the queue head and retries this same pass,
+            # without burning its replay budget or advancing the ladder
+            self._queue.push_front(req)
+            return
         if rec is not None:
             if isinstance(err, MemoryError):
                 # its own ladder — not a crash-streak event
@@ -1291,6 +1353,15 @@ class EngineCore:
         # re-enter recovery (ragged admissions stage host-side state
         # only, so no dispatch clears it in between)
         self._engine.rebuild_kv_state()
+        if self._kv_tier is not None:
+            # parked packets are host-side and self-contained: they
+            # survive the restart verbatim and later resume against the
+            # rebuilt pools.  Reconciliation audits the tier's page
+            # accounting against the parked set it carried across.
+            n = self._kv_tier.reconcile_after_restart()
+            if n:
+                _log.info("engine restart: %d parked request(s) carried "
+                          "across in the host KV tier", n)
 
     def _replay_or_fail(self, req: Request, err: BaseException):
         """Requeue ``req`` for replay at the queue head if the recovery
@@ -1749,6 +1820,10 @@ class EngineCore:
             # price the composition actually packed (drafts included),
             # not the planner's pre-packing simulation
             predicted_wall_s=self._planner.predict_wall(bts),
+            parked_rows=(self._kv_tier.parked_count
+                         if self._kv_tier is not None else 0),
+            host_pages=(self._kv_tier.resident_pages
+                        if self._kv_tier is not None else 0),
             **moe_kw)
         if self._recovery is not None:
             self._recovery.on_step_ok()
@@ -2016,6 +2091,359 @@ class EngineCore:
             self.tracer.add_span(req.rid, "exclusive", start,
                                  time.monotonic(), outcome="failed")
             self._trace_end(req, RequestState.FAILED)
+
+    # ------------------------------------------------- host KV tier
+    # Park/resume preemption (serving/kv_tier/): the handoff
+    # serialization below, retargeted at a host buffer instead of a
+    # peer replica.  Parking releases a victim row's slot, pages and
+    # adapter pin while its KV bytes and scheduler state wait in host
+    # RAM; resuming reconstructs the slot bitwise, so sustained load
+    # beyond device-pool capacity time-slices instead of shedding.
+
+    _SWAP_ATTEMPTS = 3      # bounded retries per swap fault site
+
+    def _gather_blocks(self, blocks: np.ndarray):
+        """Device->host gather of ``blocks``'s page contents across
+        every layer's K/V pools.  Quantized pools gather (payload,
+        scale) pairs so the bytes round-trip bitwise — and at half the
+        host footprint of an fp pool."""
+        k_pages, v_pages = self._engine._ensure_pages()
+
+        def gather(pages):
+            if isinstance(pages, tuple):
+                payload, scales = pages
+                # tpulint: disable-next-line=host-sync -- KV tiering serializes pages to host RAM by design; the swap traffic IS the feature
+                hp = np.asarray(payload[blocks])
+                # tpulint: disable-next-line=host-sync -- KV tiering serializes pages to host RAM by design; the swap traffic IS the feature
+                hs = np.asarray(scales[blocks])
+                return (hp, hs)
+            # tpulint: disable-next-line=host-sync -- KV tiering serializes pages to host RAM by design; the swap traffic IS the feature
+            return np.asarray(pages[blocks])
+
+        return ([gather(kp) for kp in k_pages],
+                [gather(vp) for vp in v_pages])
+
+    def _scatter_blocks(self, dst, k_host, v_host):
+        """Host->device scatter into pages ``dst`` — the inverse of
+        ``_gather_blocks``.  ``.at[].set`` is out-of-place, so the
+        rebound arrays replace the engine's pools atomically."""
+        eng = self._engine
+        k_pages, v_pages = eng._ensure_pages()
+
+        def scatter(pages, h):
+            if isinstance(pages, tuple):
+                payload, scales = pages
+                hp, hs = h
+                return (payload.at[dst].set(hp), scales.at[dst].set(hs))
+            return pages.at[dst].set(h)
+
+        eng._k_pages = [scatter(kp, h) for kp, h in zip(k_pages, k_host)]
+        eng._v_pages = [scatter(vp, h) for vp, h in zip(v_pages, v_host)]
+
+    def park_for_pressure(self) -> bool:
+        """Public park-before-shed hook: preempt ONE victim row into
+        the host KV tier, freeing its pages, slot and adapter pin.  The
+        supervisor's degradation ladder calls this before shrinking the
+        batch or shedding; only a False return (tier disabled, full, or
+        no parkable victim) should advance the ladder."""
+        with self._step_lock:
+            return self._park_for_pressure()
+
+    def _park_for_pressure(self, predictive: bool = False) -> bool:
+        if self._kv_tier is None:
+            return False
+        from .sched.policy import park_victim_order
+        active = [s for s in self._slots if s is not None]
+        for s in park_victim_order(active, time.monotonic()):
+            if self._park_slot(s, reason=("predictive" if predictive
+                                          else "memory-pressure"),
+                               predictive=predictive):
+                return True
+        return False
+
+    def _park_slot(self, s: dict, reason: str,
+                   predictive: bool = False) -> bool:
+        """Preempt one active row into the host KV tier (the handoff
+        export retargeted at a host buffer).  On success the slot is
+        free, the adapter pin dropped, and the row's prefix pages stay
+        warm in the radix tree; the request remains ACTIVE and resumes
+        bitwise later.  Returns False — slot fully intact — when the
+        tier can't hold the row or the ``kv.swap_out`` fault site
+        exhausts its bounded retries (callers fall back to the existing
+        shed/replay ladder)."""
+        tier = self._kv_tier
+        req = s["req"]
+        t0 = time.monotonic()
+        sid = s["sid"]
+        page = self._page
+        if s["pending"].size:
+            # mid-prefill: KV covers the consumed prompt only
+            kv_len = int(s["ctx"])
+            # tpulint: disable-next-line=host-sync -- s["full"] is the host-side token staging buffer, never a device array
+            kv_tokens = np.asarray(s["full"][:kv_len], np.int32)
+        else:
+            # decode phase: prompt + emitted minus the last token (its
+            # KV is written by the NEXT step, wherever that runs)
+            kv_len = int(s["length"]) + int(s["emitted"]) - 1
+            kv_tokens = np.concatenate(
+                # req.tokens is a host-side list — no device readback
+                # tpulint: disable-next-line=host-sync -- host-side prompt/token-history assembly; req.tokens are already-emitted Python ints, not device arrays
+                [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
+        n_pages = -(-kv_len // page) if kv_len > 0 else 0
+        if not tier.can_park(n_pages):
+            return False
+        # bounded-retry swap-out: a transport fault here must leave the
+        # slot untouched — nothing has been gathered or released yet
+        err = None
+        for _ in range(self._SWAP_ATTEMPTS):
+            try:
+                self._fault.fire("kv.swap_out", rid=req.rid)
+                err = None
+                break
+            except (InjectedFault, InjectedMemoryError) as e:
+                err = e
+                tier.on_swap_retry()
+        if err is not None:
+            tier.on_swap_fail()
+            return False
+        # tpulint: disable-next-line=host-sync -- the pool's block table is host-side bookkeeping, not a device array
+        blocks = np.asarray(
+            self._pool.block_table(sid)[:n_pages], np.int32)
+        k_host, v_host = self._gather_blocks(blocks)
+        packet = {
+            "req": req, "g": s["g"], "full": s["full"],
+            "pending": s["pending"], "ctx": int(s["ctx"]),
+            "emitted": int(s["emitted"]),
+            "steps_base": int(s["steps_base"]),
+            "last_tok": int(s["last_tok"]), "plen": int(s["plen"]),
+            "kv_len": kv_len, "kv_tokens": kv_tokens,
+            "k_host": k_host, "v_host": v_host, "page": page,
+            "salt": req.cache_salt, "adapter_id": req.adapter_id,
+        }
+        try:
+            tier.park(req.rid, packet, n_pages, step=self._step_idx,
+                      predictive=predictive)
+        except MemoryError:     # raced capacity check; slot untouched
+            return False
+        # anti-starvation aging input: victims with prior parks sort
+        # last, so repeated pressure rotates across rows (time-slicing)
+        req.park_count += 1
+        self._slots[sid] = None
+        # unpin for the parked wait: resume re-pins (the adapter stays
+        # resident as an LRU candidate meanwhile)
+        self._release_adapter(s)
+        self._release_slot_kv(
+            sid, s.get("match"),
+            retain_tokens=kv_tokens if kv_tokens.size else None,
+            salt=req.route_salt())
+        wall = time.monotonic() - t0
+        bts, fl, src_tag = self._cost_model.estimate(
+            "page_copy", pages_touched=n_pages)
+        self.steplog.record(
+            "park", wall_s=wall, host_s=wall,
+            active_rows=self.active_count, pages_freed=n_pages,
+            resident_kv_pages=self._used_pages(),
+            parked_rows=tier.parked_count,
+            host_pages=tier.resident_pages,
+            bytes_est=bts, flops_est=fl, cost_source=src_tag,
+            retries=req.retries,
+            degraded=self._effective_max_batch < self._max_batch)
+        now = time.monotonic()
+        self.tracer.add_span(req.rid, "park", s.get("span_end", t0),
+                             now, pages=n_pages, kv_tokens=kv_len,
+                             cause=reason)
+        return True
+
+    def _resume_parked(self, now: float) -> bool:
+        """Re-enter parked requests ahead of queue admission.  Watermark
+        hysteresis: while other work keeps the engine busy, a parked row
+        resumes only once its reservation fits with the park/resume
+        watermark gap to spare, so park and resume can never thrash; a
+        row parked for ``aging_steps`` scheduler steps bypasses the gate
+        (anti-starvation — sustained oversubscription degrades into
+        round-robin time-slicing, not permanent preemption)."""
+        tier = self._kv_tier
+        progressed = False
+        while True:
+            entry = tier.peek_parked()
+            if entry is None:
+                break
+            rid, packet, n_pages, parked_step = entry
+            req = packet["req"]
+            if req.expired(now):
+                tier.drop(rid)
+                self._metrics.on_deadline()
+                req._finish(RequestState.CANCELLED, DeadlineExceededError(
+                    f"request {rid} deadline exceeded while parked"))
+                self._trace_end(req, RequestState.CANCELLED)
+                progressed = True
+                continue
+            if (None not in self._slots
+                    or self.active_count >= self._effective_max_batch):
+                break
+            g = packet["g"]
+            reserve = max(self._plen(int(np.size(packet["full"]))),
+                          int(req.prompt.size) + g.max_new_tokens)
+            need = -(-reserve // self._page)
+            busy = self.active_count > 0 or len(self._queue) > 0
+            aged = (self._step_idx - parked_step) >= tier.aging_steps
+            if (busy and not aged
+                    and self._pool.free_blocks < need
+                    + tier.hysteresis_pages(self._pool.num_blocks)):
+                break
+            if not self._resume_slot(rid, packet, n_pages,
+                                     self._slots.index(None)):
+                break
+            progressed = True
+        return progressed
+
+    def _resume_slot(self, rid: int, packet: dict, n_pages: int,
+                     sid: int) -> bool:
+        """Install one parked packet back into slot ``sid`` (the
+        handoff import retargeted at the host tier).  Returns True when
+        the tier entry was consumed — resumed into the slot, or dropped
+        to the replay ladder after ``kv.swap_in`` exhausted its bounded
+        retries — and False when the row must stay parked (adapter pin
+        or page reservation unavailable right now)."""
+        tier = self._kv_tier
+        req: Request = packet["req"]
+        g = packet["g"]
+        t0 = time.monotonic()
+        # re-pin the adapter BEFORE pool ops, exactly like admission:
+        # the row must never re-enter the batch without its fine-tune
+        aslot = 0
+        if req.adapter_id is not None and self._adapters is not None:
+            try:
+                aslot = self._adapters.pin(req.adapter_id)
+            except (MemoryError, UnknownAdapterError):
+                return False    # pins free as active rows exit
+        length = int(req.prompt.size)
+        full = packet["full"]
+        reserve = max(self._plen(int(np.size(full))),
+                      length + g.max_new_tokens)
+        self._pool.free(sid)
+        try:
+            if self._prefix_cache is not None:
+                self._prefix_cache.ensure_free(-(-reserve // self._page))
+            self._pool.reserve(sid, reserve)
+        except MemoryError:
+            self._pool.free(sid)
+            if aslot:
+                self._adapters.unpin(aslot)
+            return False
+        # bounded-retry swap-in: a fault that survives every retry
+        # unwinds the reservation and pin, then falls back to the
+        # existing shed/replay ladder — replay regenerates the stream
+        # exactly (per-request (seed, rid) sampling keys)
+        err = None
+        for _ in range(self._SWAP_ATTEMPTS):
+            try:
+                self._fault.fire("kv.swap_in", rid=req.rid)
+                err = None
+                break
+            except (InjectedFault, InjectedMemoryError) as e:
+                err = e
+                tier.on_swap_retry()
+        if err is not None:
+            tier.on_swap_fail()
+            self._pool.free(sid)
+            if aslot:
+                self._adapters.unpin(aslot)
+            tier.drop(rid)
+            self._replay_or_fail(req, err)
+            return True
+        table = np.full((self._max_pages,), self._scratch, np.int32)
+        t = self._pool.block_table(sid)[:self._max_pages]
+        # tpulint: disable-next-line=host-sync -- host-side page-table/cache-key staging buffer, built before dispatch
+        table[:len(t)] = np.asarray(t, np.int32)
+        if n_pages:
+            self._scatter_blocks(table[:n_pages], packet["k_host"],
+                                 packet["v_host"])
+        # tpulint: disable-next-line=host-sync -- host-side page-table/cache-key staging buffer, built before dispatch
+        key = np.asarray(
+            jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))
+        now = time.monotonic()
+        self._slots[sid] = {
+            "req": req, "sid": sid, "g": g, "length": length,
+            "plen": int(packet["plen"]),
+            "emitted": int(packet["emitted"]),
+            "steps_base": int(packet["steps_base"]),
+            "last_tok": int(packet["last_tok"]), "last_emit": now,
+            "table": table, "key": key, "match": None,
+            "adapter_slot": aslot, "span_end": now, "full": full,
+            "pending": packet["pending"], "ctx": int(packet["ctx"])}
+        tier.complete_resume(rid)
+        wall = now - t0
+        bts, fl, src_tag = self._cost_model.estimate(
+            "page_copy", pages_touched=n_pages)
+        self.steplog.record(
+            "resume", wall_s=wall, host_s=wall,
+            active_rows=self.active_count,
+            resident_kv_pages=self._used_pages(),
+            parked_rows=tier.parked_count,
+            host_pages=tier.resident_pages,
+            bytes_est=bts, flops_est=fl, cost_source=src_tag,
+            retries=req.retries,
+            degraded=self._effective_max_batch < self._max_batch)
+        self.tracer.add_span(req.rid, "resume", t0, now, pages=n_pages,
+                             kv_tokens=int(packet["kv_len"]))
+        return True
+
+    def _demote_block(self, salt, path, block) -> None:
+        """Prefix-tree eviction hook: gather the evicted full block's
+        pages to host BEFORE the tree drops its ref, so a later miss on
+        the same prefix promotes the bytes back instead of re-running
+        the prefill.  Skipped while the device pools are lost — their
+        contents are garbage and must not be preserved."""
+        tier = self._kv_tier
+        if tier is None or self._engine.kv_state_lost():
+            return
+        k_host, v_host = self._gather_blocks(
+            np.asarray([int(block)], np.int32))
+        tier.demote((salt, tuple(path)), {"k": k_host, "v": v_host})
+
+    def _promote_into_match(self, req: Request, tokens: np.ndarray,
+                            match) -> None:
+        """Promote-on-hit: extend a radix-tree match from the host tier.
+        Each demoted full page whose exact token path continues the
+        match is scattered into a freshly allocated device block and
+        grafted back into the tree (which takes ownership of the
+        allocation ref), making the tree's effective capacity
+        host-RAM-sized."""
+        cache = self._prefix_cache
+        tier = self._kv_tier
+        page = self._page
+        # same usable cap as the tree's own matcher: at least one
+        # suffix token must run through the model
+        usable = int(tokens.size) - 1
+        salt = req.route_salt()
+        # tpulint: disable-next-line=host-sync -- prompt tokens are host-side int32 (cache-key material), never a device array
+        toks = [int(t) for t in np.asarray(tokens)]
+        while (len(match.blocks) + 1) * page <= usable:
+            depth = len(match.blocks)
+            path = tuple(toks[:(depth + 1) * page])
+            payload = tier.promote((salt, path))
+            if payload is None:
+                return
+            try:
+                cache.ensure_free(1)
+                blk = self._pool.alloc_block()
+            except MemoryError:
+                tier.restore_demoted((salt, path), payload)
+                return
+            try:
+                self._scatter_blocks(np.asarray([blk], np.int32),
+                                     payload["k"], payload["v"])
+            except BaseException:
+                self._pool.unref_block(blk)
+                tier.restore_demoted((salt, path), payload)
+                raise
+            # a full promoted page supersedes any partial tail the
+            # original match carried
+            cache.trim(match, depth * page)
+            if not cache.graft(match, path[depth * page:], blk):
+                # tree already grew this child meanwhile; keep its copy
+                self._pool.unref_block(blk)
 
     # ---------------------------------------------- cross-replica handoff
     # Disaggregated serving (serving/fleet/): a prefill replica runs a
@@ -2338,6 +2766,17 @@ class EngineCore:
                             self._evict(s, RequestState.CANCELLED,
                                         RejectedError(
                                             "serving engine closed"))
+                    if self._kv_tier is not None:
+                        # parked requests hold no pool pages — their KV
+                        # lives in the tier — but their consumers still
+                        # block on result(); finish them like the queue
+                        for _, packet in self._kv_tier.drain_parked():
+                            packet["req"]._finish(
+                                RequestState.REJECTED,
+                                RejectedError("serving engine closed"))
+                            self._trace_end(packet["req"],
+                                            RequestState.REJECTED)
+                        self._kv_tier.clear_demoted()
                     if self._prefix_cache is not None:
                         self._prefix_cache.clear()
                     self._pool.free(self._max_batch)
@@ -2357,3 +2796,9 @@ class EngineCore:
                 s["req"]._finish(RequestState.FAILED, RejectedError(
                     "serving engine closed while a step was wedged"))
                 self._trace_end(s["req"], RequestState.FAILED)
+        if self._kv_tier is not None:
+            # host-only bookkeeping: safe even while a step is wedged
+            for _, packet in self._kv_tier.drain_parked():
+                packet["req"]._finish(RequestState.FAILED, RejectedError(
+                    "serving engine closed while a step was wedged"))
+                self._trace_end(packet["req"], RequestState.FAILED)

@@ -1,0 +1,13 @@
+"""Host-RAM KV tier — park, don't drop.
+
+When the device page pool fills, the engine's historical moves all LOSE
+work (backpressure, degradation-ladder shrinking, predictive shedding).
+This package adds the tier those moves escalate past: a page-accounted
+host arena that absorbs whole in-flight requests (``park``/``resume``,
+built on the bitwise handoff serialization) and demoted prefix-cache
+blocks, so sustained overload degrades into time-slicing instead of a
+goodput cliff.  See docs/SERVING.md "KV tiering and preemption".
+"""
+from .tier import HostKVTier
+
+__all__ = ["HostKVTier"]

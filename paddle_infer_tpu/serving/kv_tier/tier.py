@@ -1,0 +1,279 @@
+"""Page-accounted host arena backing park/resume and prefix demotion.
+
+The tier holds two kinds of state, both measured in KV *pages* (the same
+unit the device ``KVBlockPool`` allocates):
+
+  * **parked requests** — self-contained handoff packets (scheduler slot
+    state + the request's physical KV pages gathered to host numpy).  A
+    parked request owns ``n_pages`` of tier capacity until it resumes,
+    is dropped, or expires.  Parked packets are host-side and therefore
+    survive an engine restart verbatim (the supervisor reconciles the
+    set after recovery rather than invalidating it).
+  * **demoted prefix blocks** — single full pages evicted from the
+    radix prefix tree, keyed by ``(salt, token-path)`` so a later miss
+    on the same prefix can promote the bytes back to a fresh device
+    block instead of recomputing the prefill.
+
+Parked requests take priority: ``park`` may evict demoted blocks (LRU)
+to make room, never the reverse — losing a cache block costs a prefill;
+losing a parked packet costs a whole request.
+
+Watermark semantics (hysteresis so the tier cannot thrash):
+
+  * ``park_watermark`` — device-pool occupancy at or above which the
+    scheduler *preemptively* parks (predictive park, pressure park).
+    Actual allocation failures park regardless of occupancy.
+  * ``resume_watermark`` — while other work is active, a parked request
+    resumes only once the pool has drained enough that its reservation
+    fits with ``hysteresis_pages`` to spare (the page equivalent of the
+    watermark gap).  Anti-starvation aging lifts that gate after
+    ``aging_steps`` scheduler steps so sustained oversubscription
+    degrades into round-robin time-slicing rather than parking
+    low-priority work forever.
+
+Thread safety: one internal lock (``HostKVTier._lock``) guards all
+accounting; it is a leaf in the lock graph — the tier never calls back
+into engine, pool, or tree code while holding it.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = ["HostKVTier"]
+
+
+class HostKVTier:
+    """Host-RAM KV tier (see module docstring).
+
+    ``host_pages`` is the arena capacity in KV pages; ``page_kv_bytes``
+    the calibrated per-page byte cost (int8 KV halves it) used for the
+    ``kv_tier_swap_*_bytes_total`` accounting.
+    """
+
+    def __init__(self, host_pages: int, park_watermark: float = 0.95,
+                 resume_watermark: float = 0.70, page_kv_bytes: float = 0.0,
+                 aging_steps: int = 16):
+        host_pages = int(host_pages)
+        if host_pages < 1:
+            raise ValueError(f"host_pages must be >= 1, got {host_pages}")
+        if not 0.0 < float(resume_watermark) < float(park_watermark) <= 1.0:
+            raise ValueError(
+                "watermarks must satisfy 0 < resume_watermark < "
+                f"park_watermark <= 1, got resume={resume_watermark} "
+                f"park={park_watermark}")
+        self.host_pages = host_pages
+        self.park_watermark = float(park_watermark)
+        self.resume_watermark = float(resume_watermark)
+        self.page_kv_bytes = float(page_kv_bytes)
+        self.aging_steps = int(aging_steps)
+        self._lock = threading.Lock()
+        # rid -> (packet, n_pages, parked_at_step); FIFO = resume order
+        self._parked: "OrderedDict[int, Tuple[dict, int, int]]" = \
+            OrderedDict()
+        # (salt, token-path) -> payload; insertion order = LRU order
+        self._demoted: "OrderedDict[Any, dict]" = OrderedDict()
+        self._parked_pages = 0
+        self._peak_pages = 0
+        # counters (Prometheus kv_tier_* families)
+        self.parks_total = 0
+        self.resumes_total = 0
+        self.predictive_parks_total = 0
+        self.demotes_total = 0
+        self.promotes_total = 0
+        self.demoted_evicted_total = 0
+        self.swap_out_bytes_total = 0
+        self.swap_in_bytes_total = 0
+        self.swap_retries_total = 0
+        self.swap_fails_total = 0
+        self.restart_reconciles_total = 0
+
+    # ------------------------------------------------------------------
+    # accounting views
+    # ------------------------------------------------------------------
+    @property
+    def parked_count(self) -> int:
+        with self._lock:
+            return len(self._parked)
+
+    @property
+    def resident_pages(self) -> int:
+        """Host pages in use: parked KV plus demoted prefix blocks."""
+        with self._lock:
+            return self._parked_pages + len(self._demoted)
+
+    @property
+    def demoted_count(self) -> int:
+        with self._lock:
+            return len(self._demoted)
+
+    def hysteresis_pages(self, pool_blocks: int) -> int:
+        """The park/resume watermark gap expressed in device pages."""
+        gap = self.park_watermark - self.resume_watermark
+        return max(0, int(gap * int(pool_blocks)))
+
+    # ------------------------------------------------------------------
+    # parked requests
+    # ------------------------------------------------------------------
+    def can_park(self, n_pages: int) -> bool:
+        """True if ``n_pages`` fit, counting demoted blocks as evictable
+        (parked requests take priority over demoted prefix blocks)."""
+        with self._lock:
+            return self._parked_pages + int(n_pages) <= self.host_pages
+
+    def park(self, rid: int, packet: dict, n_pages: int, step: int = 0,
+             predictive: bool = False) -> None:
+        """Admit a parked packet, evicting demoted LRU blocks if the
+        arena is tight.  Raises ``MemoryError`` when even a demoted-free
+        arena cannot hold it (callers check ``can_park`` first)."""
+        n_pages = int(n_pages)
+        with self._lock:
+            if self._parked_pages + n_pages > self.host_pages:
+                raise MemoryError(
+                    f"host KV tier exhausted ({self.host_pages} pages)")
+            while (self._parked_pages + len(self._demoted) + n_pages
+                   > self.host_pages):
+                self._demoted.popitem(last=False)
+                self.demoted_evicted_total += 1
+            self._parked[int(rid)] = (packet, n_pages, int(step))
+            self._parked_pages += n_pages
+            self._peak_pages = max(
+                self._peak_pages, self._parked_pages + len(self._demoted))
+            self.parks_total += 1
+            if predictive:
+                self.predictive_parks_total += 1
+            self.swap_out_bytes_total += int(n_pages * self.page_kv_bytes)
+
+    def peek_parked(self) -> Optional[Tuple[int, dict, int, int]]:
+        """Oldest parked entry as ``(rid, packet, n_pages, parked_step)``
+        without removing it, or ``None``."""
+        with self._lock:
+            if not self._parked:
+                return None
+            rid, (packet, n_pages, step) = next(iter(self._parked.items()))
+            return rid, packet, n_pages, step
+
+    def complete_resume(self, rid: int) -> None:
+        """Remove ``rid`` after a successful device scatter and account
+        the swap-in traffic."""
+        with self._lock:
+            _, n_pages, _ = self._parked.pop(int(rid))
+            self._parked_pages -= n_pages
+            self.resumes_total += 1
+            self.swap_in_bytes_total += int(n_pages * self.page_kv_bytes)
+
+    def drop(self, rid: int) -> bool:
+        """Remove ``rid`` without a resume (expiry, swap-in failure,
+        engine close).  Returns False if it was not parked."""
+        with self._lock:
+            entry = self._parked.pop(int(rid), None)
+            if entry is None:
+                return False
+            self._parked_pages -= entry[1]
+            return True
+
+    def drain_parked(self):
+        """Remove and return every parked ``(rid, packet)`` (engine
+        close finishes them as rejected)."""
+        with self._lock:
+            out = [(rid, packet) for rid, (packet, _, _)
+                   in self._parked.items()]
+            self._parked.clear()
+            self._parked_pages = 0
+            return out
+
+    def reconcile_after_restart(self) -> int:
+        """Post-restart audit: parked packets are host-side and survive
+        an engine restart verbatim, so reconciliation verifies the page
+        accounting still matches the parked set and keeps it.  Returns
+        the number of parked requests carried across the restart."""
+        with self._lock:
+            assert self._parked_pages == sum(
+                n for _, n, _ in self._parked.values()), \
+                "host tier page accounting diverged from parked set"
+            self.restart_reconciles_total += 1
+            return len(self._parked)
+
+    # ------------------------------------------------------------------
+    # demoted prefix blocks (one full page each)
+    # ------------------------------------------------------------------
+    def demote(self, key: Any, payload: dict) -> bool:
+        """Store an evicted prefix block's pages; returns False (and
+        stores nothing) when no page is spare after parked state."""
+        with self._lock:
+            if self._parked_pages + len(self._demoted) + 1 > self.host_pages:
+                if not self._demoted:
+                    return False
+                self._demoted.popitem(last=False)
+                self.demoted_evicted_total += 1
+            self._demoted[key] = payload
+            self._demoted.move_to_end(key)
+            self.demotes_total += 1
+            self.swap_out_bytes_total += int(self.page_kv_bytes)
+            self._peak_pages = max(
+                self._peak_pages, self._parked_pages + len(self._demoted))
+            return True
+
+    def promote(self, key: Any) -> Optional[dict]:
+        """Remove and return a demoted block's payload on a prefix-tree
+        miss that the tier can serve, else ``None``."""
+        with self._lock:
+            payload = self._demoted.pop(key, None)
+            if payload is not None:
+                self.promotes_total += 1
+                self.swap_in_bytes_total += int(self.page_kv_bytes)
+            return payload
+
+    def restore_demoted(self, key: Any, payload: dict) -> None:
+        """Put a promoted payload back (device block allocation failed
+        after ``promote`` — the bytes must not be lost)."""
+        with self._lock:
+            self._demoted[key] = payload
+            self._demoted.move_to_end(key, last=False)
+            self.promotes_total -= 1
+            self.swap_in_bytes_total -= int(self.page_kv_bytes)
+
+    def clear_demoted(self) -> int:
+        with self._lock:
+            n = len(self._demoted)
+            self._demoted.clear()
+            return n
+
+    # ------------------------------------------------------------------
+    # swap-fault bookkeeping
+    # ------------------------------------------------------------------
+    def on_swap_retry(self) -> None:
+        with self._lock:
+            self.swap_retries_total += 1
+
+    def on_swap_fail(self) -> None:
+        with self._lock:
+            self.swap_fails_total += 1
+
+    # ------------------------------------------------------------------
+    # metrics
+    # ------------------------------------------------------------------
+    def summary(self) -> Dict[str, Any]:
+        """The ``kv_tier`` section of the engine metrics snapshot."""
+        with self._lock:
+            resident = self._parked_pages + len(self._demoted)
+            return {
+                "parked_requests": len(self._parked),
+                "host_pages_total": self.host_pages,
+                "host_pages_resident": resident,
+                "host_pages_peak": self._peak_pages,
+                "demoted_blocks": len(self._demoted),
+                "parks_total": self.parks_total,
+                "resumes_total": self.resumes_total,
+                "predictive_parks_total": self.predictive_parks_total,
+                "demotes_total": self.demotes_total,
+                "promotes_total": self.promotes_total,
+                "demoted_evicted_total": self.demoted_evicted_total,
+                "swap_out_bytes_total": self.swap_out_bytes_total,
+                "swap_in_bytes_total": self.swap_in_bytes_total,
+                "swap_retries_total": self.swap_retries_total,
+                "swap_fails_total": self.swap_fails_total,
+                "park_watermark": self.park_watermark,
+                "resume_watermark": self.resume_watermark,
+            }

@@ -266,7 +266,8 @@ class ServingMetrics:
                  sharding: Optional[Dict] = None,
                  moe: Optional[Dict] = None,
                  adapters: Optional[Dict] = None,
-                 sched: Optional[Dict] = None) -> Dict:
+                 sched: Optional[Dict] = None,
+                 kv_tier: Optional[Dict] = None) -> Dict:
         """Render everything to a plain dict (the ``GET /metrics`` JSON
         body).  Latency series carry lifetime ``count``/``mean`` plus
         reservoir-window ``p50_recent``/``p99_recent``/``max_recent``
@@ -295,7 +296,10 @@ class ServingMetrics:
         with this registry's predictive-shed counter; ``adapters`` is
         ``AdapterCache.summary()`` (slot residency/pins, hit rate,
         upload/eviction counters, host store stats) when the core
-        serves multi-LoRA tenants."""
+        serves multi-LoRA tenants; ``kv_tier`` is
+        ``HostKVTier.summary()`` (parked requests, host-page residency,
+        park/resume/demote/promote and swap-byte counters) when the
+        core runs with a host-RAM KV tier."""
         tps = self.tokens_per_second()
         with self._lock:
             out = {
@@ -367,6 +371,8 @@ class ServingMetrics:
                 })
             if adapters is not None:
                 out["adapters"] = dict(adapters)
+            if kv_tier is not None:
+                out["kv_tier"] = dict(kv_tier)
             if sched is not None:
                 # the core's scheduler section (policy, planner,
                 # predicted-vs-actual slack), plus this registry's

@@ -40,11 +40,21 @@ Eviction is leaf-first LRU over entries with ``pins == 0``: partial
 entries and childless nodes.  It runs on demand (``ensure_free``) when
 admission needs blocks, and after every release (``enforce_watermark``)
 to keep the cache under ``watermark × pool_blocks`` retained blocks.
+
+Host-tier demotion (serving/kv_tier/): when a demote hook is wired
+onto ``_tier_demote``, evicting a FULL node hands ``(salt, token path,
+block)`` to the engine before the tree's block reference drops, so the
+page's bytes move to host RAM instead of vanishing; a later miss on
+the same path promotes them back (``graft``).  The tree's effective
+capacity becomes host-RAM-sized.
 """
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Dict, List, Optional, Tuple
+
+_log = logging.getLogger(__name__)
 
 
 def _common(a, b) -> int:
@@ -114,6 +124,14 @@ class PrefixCache:
         self._roots: Dict[object, _Node] = {}
         self._clock = 0
         self._lock = threading.Lock()
+        # host-tier demotion hook, wired by the engine as a direct
+        # ``cache._tier_demote = core._demote_block`` assignment (the
+        # binding form the static lock analyzer follows); called as
+        # ``demote(salt, token_path, block)`` for every FULL node LRU
+        # eviction drops, before the block reference is released.
+        # ``clear()`` bypasses it — close/restart teardown must not
+        # snapshot pages.  None = demotion disabled.
+        self._tier_demote = None
         # counters (rendered under snapshot["prefix_cache"])
         self.queries = 0
         self.hits = 0
@@ -356,6 +374,51 @@ class PrefixCache:
         with self._lock:
             self.cow_copies += n
 
+    # ------------------------------------------------------ host KV tier
+    def _node_identity(self, node: _Node):
+        """``(salt, full token path)`` of ``node``: walk the parent
+        chain to its root and reverse-map the root to its salt."""
+        chunks = []
+        cur = node
+        while cur.parent is not None:
+            chunks.append(cur.chunk)
+            cur = cur.parent
+        path: List[int] = []
+        for chunk in reversed(chunks):
+            path.extend(chunk)
+        for salt, root in self._roots.items():
+            if root is cur:
+                return salt, tuple(path)
+        return None, tuple(path)
+
+    def graft(self, match: PrefixMatch, chunk, block: int) -> bool:
+        """Attach a promoted host-tier block as a new child extending
+        ``match``'s deepest node, and extend the match in place (pinned
+        and clocked exactly like a matched child).  The tree takes
+        ownership of the block's existing allocation reference — the
+        caller must NOT unref on success.  Returns False (the caller
+        keeps its ref) when an equal child already exists."""
+        chunk = tuple(int(t) for t in chunk)
+        with self._lock:
+            self._clock += 1
+            node = match.nodes[-1] if match.nodes else \
+                self._roots.get(match.salt)
+            if node is None:
+                node = self._roots[match.salt] = _Node((), None, None)
+            child = node.children.get(chunk)
+            grafted = child is None
+            if grafted:
+                child = _Node(chunk, int(block), node)
+                node.children[chunk] = child
+                self.cached_blocks += 1
+                self.node_count += 1
+                self.cached_tokens_total += len(chunk)
+            child.pins += 1
+            child.last_used = self._clock
+            match.nodes.append(child)
+            match.blocks.append(child.block)
+            return grafted
+
     # ---------------------------------------------------------- eviction
     def _candidates(self):
         """(last_used, kind, node, key) for every evictable entry:
@@ -374,7 +437,7 @@ class PrefixCache:
                 out.append((node.last_used, "node", node, node.chunk))
         return out
 
-    def _evict_one(self) -> bool:
+    def _evict_one(self, demote: bool = True) -> bool:
         cands = self._candidates()
         if not cands:
             return False
@@ -383,6 +446,17 @@ class PrefixCache:
             blk = node.partials.pop(key)[0]
         else:
             blk = node.block
+            if demote and self._tier_demote is not None:
+                # demote-before-drop: the block is still referenced
+                # (and its pages valid) until the unref below, so the
+                # hook can gather its bytes to host.  Best-effort — a
+                # failed demotion only loses the cache entry, exactly
+                # what eviction without a tier does.
+                salt, path = self._node_identity(node)
+                try:
+                    self._tier_demote(salt, path, blk)
+                except Exception:       # pragma: no cover - hook safety
+                    _log.exception("host-tier demote hook failed")
             if node.parent is not None:
                 node.parent.children.pop(key, None)
             self.node_count -= 1
@@ -409,9 +483,11 @@ class PrefixCache:
                     break
 
     def clear(self):
-        """Drop every unpinned entry (engine close)."""
+        """Drop every unpinned entry (engine close / restart).  Never
+        demotes: at close the snapshot would be wasted work, and after
+        a KV loss the pages are garbage."""
         with self._lock:
-            while self._evict_one():
+            while self._evict_one(demote=False):
                 pass
             self._roots = {r: n for r, n in self._roots.items()
                            if n.children or n.partials}

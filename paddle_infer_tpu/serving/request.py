@@ -114,6 +114,11 @@ class Request:
         # last requeued for replay
         self.retries = 0
         self.requeued_at: Optional[float] = None
+        # host KV tier (serving/kv_tier/): how many times this request
+        # has been preemption-parked.  Victim selection sorts ascending
+        # on it, so sustained pressure rotates across rows instead of
+        # re-parking the same low-priority request forever.
+        self.park_count = 0
         # SLO scheduler predictions (serving/sched/): stamped by the
         # slack admission policy when it last scored this request, read
         # back at completion for predicted-vs-actual slack error

@@ -13,6 +13,8 @@ prefill/decode/page-copy program dispatches:
   ``kv.alloc``       before each slot KV reservation
   ``page.copy``      before each CoW page-copy dispatch
   ``prefix.match``   before each radix-tree prefix lookup
+  ``kv.swap_out``    before each park's device->host KV page gather
+  ``kv.swap_in``     before each resume's host->device KV page scatter
 
 Each ``fire(site)`` call increments a per-site sequence number; a spec
 triggers either at an exact sequence number (``at`` — scripted schedules)
@@ -52,7 +54,8 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 # the registered sites — tests/test_ci_tools.py gates that every entry
 # is documented in docs/SERVING.md's fault-site catalog
 SITES: Tuple[str, ...] = ("decode.step", "prefill.run", "kv.alloc",
-                          "page.copy", "prefix.match")
+                          "page.copy", "prefix.match", "kv.swap_out",
+                          "kv.swap_in")
 
 _ACTIONS = ("raise", "latency", "hang", "nan_rows")
 

@@ -164,9 +164,21 @@ class EngineSupervisor:
         return True
 
     def on_memory_pressure(self):
-        """A (possibly injected) MemoryError reached admission: shrink
-        the effective batch; repeated pressure sheds queued load with
-        too little deadline headroom to survive the degraded engine."""
+        """A (possibly injected) MemoryError reached admission: park
+        before shedding, then shrink the effective batch; repeated
+        pressure sheds queued load with too little deadline headroom to
+        survive the degraded engine.
+
+        Park-before-shed: when the core runs a host KV tier, preempting
+        one active row into it releases device pages AND the row's
+        adapter pin — reversible, nothing lost — so the ladder only
+        advances (batch shrink, shedding) once the tier can absorb no
+        more.  The park call happens outside ``self._lock``: the
+        supervisor lock is never held across core calls."""
+        if self._core.park_for_pressure():
+            self.health.to_degraded("memory pressure: parked one row "
+                                    "into the host KV tier")
+            return
         with self._lock:
             self._mem_streak += 1
             streak = self._mem_streak

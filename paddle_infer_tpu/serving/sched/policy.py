@@ -106,6 +106,34 @@ class SlackPolicy(AdmissionPolicy):
         return kept, shed
 
 
+def park_victim_order(slots: List[dict], now: float) -> List[dict]:
+    """Preemption order for the host KV tier: which active rows to park
+    first when device pages run out.
+
+    EDF picks the deadline-RICHEST victims — parking costs a swap
+    round-trip, so it lands on the rows that can best absorb it:
+
+      1. fewest prior parks first (anti-starvation aging: a row that
+         was already preempted sorts behind rows that never were, so
+         sustained pressure time-slices instead of re-parking one
+         victim forever);
+      2. deadline-less (batch-class) rows before deadline-bearing ones;
+      3. among deadline-bearing rows, the largest remaining headroom
+         (latest deadline) first — inverse EDF.
+
+    Pure function over slot dicts; runs on the stepping thread under
+    the engine's step lock and holds no locks of its own."""
+
+    def key(s):
+        req = s["req"]
+        dl = req.deadline
+        return (int(getattr(req, "park_count", 0)),
+                0 if dl is None else 1,
+                -(float(dl) - now) if dl is not None else 0.0)
+
+    return sorted(slots, key=key)
+
+
 _POLICIES = {
     "fifo": FifoPolicy,
     "slack": SlackPolicy,

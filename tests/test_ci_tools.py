@@ -125,6 +125,40 @@ def test_check_metrics_covers_sched_families():
     assert problems == []
 
 
+def test_check_metrics_covers_kv_tier_families():
+    """The host-KV-tier families must be exercised by the fabricated
+    snapshot (3-way sync: renderer ↔ docs catalog ↔ check_metrics)."""
+    import check_metrics
+
+    _, _, text = check_metrics.fabricated_exposition()
+    for fam in ("kv_tier_parked_requests", "kv_tier_host_pages",
+                "kv_tier_demoted_blocks", "kv_tier_parks_total",
+                "kv_tier_predictive_parks_total",
+                "kv_tier_resumes_total", "kv_tier_demotes_total",
+                "kv_tier_promotes_total",
+                "kv_tier_swap_out_bytes_total",
+                "kv_tier_swap_in_bytes_total",
+                "kv_tier_swap_retries_total",
+                "kv_tier_swap_fails_total"):
+        assert f"# TYPE {fam} " in text, f"{fam} not rendered"
+    problems, _ = check_metrics.run_checks(
+        os.path.join(ROOT, "docs", "OBSERVABILITY.md"))
+    assert problems == []
+
+
+def test_bench_diff_kv_tier_directions():
+    """kv_tier keys carry a direction: goodput/parks/resumes up, sheds
+    and abandoned swaps down, peak residency neutral."""
+    import bench_diff
+
+    assert bench_diff._direction("goodput_batch_tier") == 1
+    assert bench_diff._direction("parks") == 1
+    assert bench_diff._direction("resumes") == 1
+    assert bench_diff._direction("sheds_tier") == -1
+    assert bench_diff._direction("swap_fails") == -1
+    assert bench_diff._direction("host_pages_peak") == 0
+
+
 def test_bench_diff_multi_tenant_directions():
     """multi_tenant keys carry a direction: attainment/goodput up,
     shed rate and deadline misses down, planner diagnostics neutral."""
@@ -306,7 +340,8 @@ def test_serving_suites_instrumented_clean():
          "-p", "no:cacheprovider",
          os.path.join(ROOT, "tests", "test_serving_engine.py"),
          os.path.join(ROOT, "tests", "test_resilience.py"),
-         os.path.join(ROOT, "tests", "test_fleet.py")],
+         os.path.join(ROOT, "tests", "test_fleet.py"),
+         os.path.join(ROOT, "tests", "test_kv_tier.py")],
         capture_output=True, text=True, env=env, cwd=ROOT,
         timeout=3000)
     assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-800:]

@@ -28,7 +28,8 @@ from typing import Dict, Tuple
 _HIGHER = ("per_s", "per_sec", "speedup", "mfu", "acceptance",
            "hit_rate", "tps", "tok_s", "throughput", "tokens_per",
            "pearson", "improvement", "spec_decode", "bytes_saved",
-           "resident_pages_ratio", "attainment", "goodput")
+           "resident_pages_ratio", "attainment", "goodput",
+           "parks", "resumes")
 # quality direction: the quantized_kv section's *_err_* keys fall under
 # the "err" rule below, so a round where int8 serving drifts further
 # from the fp logits (or past its analytic bound) fails the diff the
@@ -49,7 +50,11 @@ _HIGHER = ("per_s", "per_sec", "speedup", "mfu", "acceptance",
 _LOWER = ("_ms", "latency", "ttft", "itl", "err", "wall", "p50",
           "p99", "wasted", "ici_bytes", "compile", "skew", "dropped",
           "dispatch_bytes", "shed", "misses", "violation", "uploads",
-          "evictions", "_s")
+          "evictions", "swap_fail", "_s")
+# kv_tier: parks/resumes up (under identical oversubscribed offered
+# load, more preemption parked-not-dropped means less work was shed),
+# sheds/misses/swap_fails down — a tier round that sheds or abandons
+# swaps at equal load regressed.
 # harness bookkeeping, not workload performance
 _SKIP = ("vs_baseline", "child_wall_s", "bench_wall_s", "n", "rc")
 

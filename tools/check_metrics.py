@@ -171,6 +171,24 @@ def fabricated_exposition():
                                           "pages_total": 4096,
                                           "pages_used": 24,
                                           "bytes_used": 1.5e6}},
+                      # HostKVTier.summary() shape (park, don't drop)
+                      kv_tier={"parked_requests": 2,
+                               "host_pages_total": 256,
+                               "host_pages_resident": 18,
+                               "host_pages_peak": 40,
+                               "demoted_blocks": 6,
+                               "parks_total": 9,
+                               "resumes_total": 7,
+                               "predictive_parks_total": 3,
+                               "demotes_total": 11,
+                               "promotes_total": 5,
+                               "demoted_evicted_total": 1,
+                               "swap_out_bytes_total": 2.4e6,
+                               "swap_in_bytes_total": 1.9e6,
+                               "swap_retries_total": 2,
+                               "swap_fails_total": 1,
+                               "park_watermark": 0.95,
+                               "resume_watermark": 0.70},
                       device_memory={"bytes_in_use": 1 << 20,
                                      "peak_bytes_in_use": 1 << 21,
                                      "bytes_limit": 1 << 30,

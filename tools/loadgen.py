@@ -61,6 +61,30 @@ DEFAULT_TENANTS = (
 )
 
 
+def oversubscription_tenants(factor: float = 1.0):
+    """Tenant mix for the host-KV-tier oversubscription regime
+    (bench.py ``kv_tier`` section): sustained DEADLINE-LESS clients
+    whose aggregate working set exceeds the device pool by the caller's
+    chosen factor, so the engine must park — never shed — to keep
+    goodput at 1.0.  ``factor`` scales prompt/decode lengths, letting a
+    bench dial 2–4x the pool capacity without touching arrival rate.
+    No deadlines anywhere: every miss or drop under this mix is
+    scheduler-attributable, not workload-attributable."""
+    f = max(float(factor), 1.0)
+
+    def span(lo, hi):
+        return (int(lo * f), int(hi * f))
+
+    return (
+        {"name": "park-long", "weight": 2.0,
+         "prompt_len": span(16, 28), "max_new": span(12, 20),
+         "timeout_s": None, "shared_prefix_len": 0, "cache_salt": None},
+        {"name": "park-short", "weight": 3.0,
+         "prompt_len": span(6, 12), "max_new": span(8, 12),
+         "timeout_s": None, "shared_prefix_len": 0, "cache_salt": None},
+    )
+
+
 def generate_trace(seed: int, duration_s: float, rate_per_s: float,
                    tenants=DEFAULT_TENANTS, vocab_size: int = 96,
                    burstiness: float = 4.0,
@@ -217,13 +241,21 @@ def main(argv=None) -> int:
                          "ids ('adapter-0'..) with one draw per event — "
                          "the adapter-churn regime that exercises the "
                          "AdapterCache slot LRU")
+    ap.add_argument("--oversubscribe", type=float, default=0.0,
+                    help="emit the deadline-less oversubscription mix "
+                         "instead of the default tenants, scaled by "
+                         "this factor (>= 1): the host-KV-tier "
+                         "park/resume regime (docs/SERVING.md 'KV "
+                         "tiering and preemption')")
     ap.add_argument("--out", required=True, help="output trace JSONL")
     args = ap.parse_args(argv)
     tenants = DEFAULT_TENANTS
+    if args.oversubscribe:
+        tenants = oversubscription_tenants(args.oversubscribe)
     if args.adapters > 0:
         pool = [f"adapter-{j}" for j in range(args.adapters)]
         tenants = tuple(dict(t, adapter_ids=pool)
-                        for t in DEFAULT_TENANTS)
+                        for t in tenants)
     events = generate_trace(args.seed, args.duration_s, args.rate_per_s,
                             tenants=tenants,
                             vocab_size=args.vocab_size,

@@ -125,7 +125,10 @@ def _build_fleet(roles):
             token_budget=_STATE.get("token_budget"),
             sched_policy=_STATE.get("sched_policy", "fifo"),
             slo_ttft_s=_STATE.get("slo_ttft_s"),
-            slo_itl_s=_STATE.get("slo_itl_s"))
+            slo_itl_s=_STATE.get("slo_itl_s"),
+            kv_host_pages=_STATE.get("kv_host_pages", 0),
+            kv_park_watermark=_STATE.get("kv_park_watermark", 0.95),
+            kv_resume_watermark=_STATE.get("kv_resume_watermark", 0.70))
         sup = EngineSupervisor(
             core,
             watchdog_s=_STATE.get("watchdog_s", 5.0),
@@ -198,7 +201,11 @@ def _core():
                 spec_accept_threshold=_STATE.get("spec_accept_threshold"),
                 fault_plane=plane,
                 serving_mesh=(smesh if smesh.n_devices > 1
-                              or smesh.quantized_allreduce else None))
+                              or smesh.quantized_allreduce else None),
+                kv_host_pages=_STATE.get("kv_host_pages", 0),
+                kv_park_watermark=_STATE.get("kv_park_watermark", 0.95),
+                kv_resume_watermark=_STATE.get("kv_resume_watermark",
+                                               0.70))
             _STATE["sup"] = EngineSupervisor(
                 core,
                 watchdog_s=_STATE.get("watchdog_s", 5.0),
@@ -791,6 +798,26 @@ def main(argv=None):
                          "reserved identity): bounds how many tenants "
                          "share HBM concurrently; the slot-LRU evicts "
                          "unpinned tenants beyond it")
+    ap.add_argument("--kv_host_pages", type=int, default=0,
+                    help="host-RAM KV tier capacity in KV pages (0 = "
+                         "disabled): memory pressure PARKS victim rows "
+                         "— KV pages + scheduler state swap to host, "
+                         "resume bitwise later — instead of shedding, "
+                         "and prefix-cache evictions demote full pages "
+                         "for promote-on-hit (docs/SERVING.md 'KV "
+                         "tiering and preemption'); requires the "
+                         "ragged scheduler")
+    ap.add_argument("--kv_park_watermark", type=float, default=0.95,
+                    help="device-pool occupancy at or above which the "
+                         "scheduler preemptively parks (predictive "
+                         "park); actual allocation failures park "
+                         "regardless")
+    ap.add_argument("--kv_resume_watermark", type=float, default=0.70,
+                    help="parked rows resume once the pool drains so "
+                         "their reservation fits with the park/resume "
+                         "watermark gap to spare (hysteresis — must be "
+                         "< --kv_park_watermark; anti-starvation aging "
+                         "lifts the gate after 16 scheduler steps)")
     ap.add_argument("--fleet_roles", default=None,
                     help="disaggregated fleet: comma-separated replica "
                          "roles, e.g. 'prefill,decode,mixed' — one "
@@ -976,6 +1003,27 @@ def main(argv=None):
               file=sys.stderr, flush=True)
         return 2
     _STATE["kv_dtype"] = args.kv_dtype
+    if args.kv_host_pages < 0:
+        print(f"error: --kv_host_pages must be >= 0, got "
+              f"{args.kv_host_pages}", file=sys.stderr, flush=True)
+        return 2
+    if args.kv_host_pages and args.legacy_programs:
+        print("error: --kv_host_pages requires the ragged scheduler — "
+              "park/resume serializes the mixed step's slot state; "
+              "drop --legacy_programs", file=sys.stderr, flush=True)
+        return 2
+    if args.kv_host_pages and not (
+            0.0 < args.kv_resume_watermark
+            < args.kv_park_watermark <= 1.0):
+        print("error: watermarks must satisfy 0 < --kv_resume_watermark "
+              "< --kv_park_watermark <= 1 (hysteresis gap), got "
+              f"resume={args.kv_resume_watermark} "
+              f"park={args.kv_park_watermark}",
+              file=sys.stderr, flush=True)
+        return 2
+    _STATE["kv_host_pages"] = args.kv_host_pages
+    _STATE["kv_park_watermark"] = args.kv_park_watermark
+    _STATE["kv_resume_watermark"] = args.kv_resume_watermark
     _STATE["spec_accept_threshold"] = args.spec_accept_threshold
     _STATE["page_size"] = args.page_size
     _STATE["max_batch"] = args.max_batch
