@@ -1297,6 +1297,9 @@ def _multi_tenant_bench(on_tpu: bool):
         sched = snap.get("sched") or {}
         return {
             "attainment": attained / max(n_deadline, 1),
+            "tenant_attainment": loadgen.tenant_attainment(events,
+                                                           handles),
+            "tenants": snap.get("tenants") or {},
             "goodput_tok_per_s":
                 sum(r.emitted for r in done.values()) / wall,
             "completed": len(done),
@@ -1346,6 +1349,27 @@ def _multi_tenant_bench(on_tpu: bool):
         "planner_chunk_limited": slack["chunk_limited"],
         "planner_pred_n": planner.get("n", 0),
     }
+    # per-tenant SLO accounting (journey plane): attainment per tenant
+    # class under the slack policy, plus — for the tenant with the
+    # worst e2e p99 — where its wall time actually went (top-3
+    # latency-attribution buckets), so a fairness regression names its
+    # victim AND its cause in one bench line
+    for name, t in sorted(slack["tenant_attainment"].items()):
+        if t["attainment"] is not None:
+            out[f"tenant_{name}_attainment"] = round(t["attainment"], 3)
+    from paddle_infer_tpu.observability.histogram import quantile
+    worst, worst_p99 = None, -1.0
+    for name, t in slack["tenants"].items():
+        p99 = quantile(t.get("e2e"), 0.99)
+        if p99 is not None and p99 > worst_p99:
+            worst, worst_p99 = name, p99
+    if worst is not None:
+        buckets = slack["tenants"][worst].get("buckets") or {}
+        top3 = sorted(buckets.items(), key=lambda kv: -kv[1])[:3]
+        out["worst_p99_tenant"] = worst
+        out["worst_p99_tenant_e2e_p99_s"] = round(worst_p99, 4)
+        out["worst_p99_tenant_top_buckets"] = {
+            b: round(v, 4) for b, v in top3}
     if planner.get("mean_abs_rel_err") is not None:
         out["planner_pred_wall_mean_abs_rel_err"] = round(
             planner["mean_abs_rel_err"], 4)

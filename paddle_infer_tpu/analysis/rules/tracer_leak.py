@@ -16,6 +16,17 @@ The rule is deliberately narrow: only module-level names bound to a
 mutable literal (or ``dict()``/``list()``/``set()``/``defaultdict``/
 ``deque`` call) count as leaky state — modules, functions, and
 constants are fine to close over.
+
+A second, cross-replica check runs on EVERY file (no jit gate):
+recording spans against ANOTHER component's tracer —
+``handle.core.tracer.add_span(...)``, ``other._journeys.record_import``
+— races that component's stepping thread ending (and ring-rotating)
+the trace.  The span then lands on the 256-ring copy, or on nothing at
+all once the ring evicts, and the writer gets no error either way.
+``self.tracer`` / ``self._journeys`` receivers are exempt (a component
+sequences spans against its own lifecycle); sites that *intend* the
+ring-landing behaviour (the fleet router's post-handoff route span)
+suppress with a reason.
 """
 from __future__ import annotations
 
@@ -89,7 +100,14 @@ class TracerLeakRule(Rule):
                  "or impure host calls are frozen at trace time — the "
                  "compiled program silently ignores later changes")
 
+    # span-recording methods whose receiver must be the caller's OWN
+    # tracer/journey store; reaching through another object's attribute
+    # chain races that object's thread ending the trace
+    _CROSS_METHODS = ("add_span",)
+    _CROSS_OWNERS = (".tracer", "._journeys", ".journeys")
+
     def check_file(self, ctx: FileContext):
+        yield from self._check_cross_replica(ctx)
         jitted = jit_functions(ctx.tree)
         if not jitted:
             return
@@ -97,6 +115,43 @@ class TracerLeakRule(Rule):
         for name, fns in sorted(jitted.items()):
             for fn in fns:
                 yield from self._check_fn(ctx, fn, mutables)
+
+    def _check_cross_replica(self, ctx: FileContext):
+        """Flag span recording against a possibly-ended foreign trace:
+        ``<chain>.tracer.add_span(...)`` (or ``.record_import`` on a
+        foreign journey store) where ``<chain>`` is anything other than
+        ``self`` or a bare local name.  The foreign core's stepping
+        thread may have already ``end()``-ed the trace — the span lands
+        on the ring copy or silently nowhere."""
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if not d or "." not in d:
+                continue
+            owner, _, method = d.rpartition(".")
+            if method not in self._CROSS_METHODS \
+                    and method != "record_import":
+                continue
+            if not owner.endswith(self._CROSS_OWNERS):
+                continue
+            # strip the .tracer/._journeys hop to get the holder chain
+            holder = owner.rsplit(".", 1)[0]
+            if holder in ("self", ""):
+                continue        # own tracer: lifecycle-sequenced
+            if "." not in holder and holder != "self":
+                # bare local alias (tracer = core.tracer): too
+                # ambiguous to flag — the narrow rule only fires on
+                # explicit foreign attribute chains
+                continue
+            yield ctx.finding(
+                self.id, node,
+                f"{method}() against a foreign tracer "
+                f"('{owner}') can race that component ending the "
+                f"trace — the span lands on the 256-ring copy or is "
+                f"silently dropped once the ring evicts; record "
+                f"through the owner (or its journey store), or "
+                f"suppress with a reason if ring-landing is intended")
 
     def _check_fn(self, ctx: FileContext, fn: ast.FunctionDef,
                   mutables: Set[str]):

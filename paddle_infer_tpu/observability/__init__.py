@@ -24,6 +24,12 @@ The serving engine made latency the product; this package makes latency
   ``histogram``   log-bucketed lock-safe latency histograms rendered as
                   native Prometheus ``_bucket``/``_sum``/``_count``
                   families.
+  ``journey``     fleet-wide request journeys: a journey context rides
+                  handoff/park packets across replicas, each core's
+                  spans stitch into one cross-replica journey, and a
+                  latency attribution engine partitions every finished
+                  request's e2e wall into named buckets (coverage is a
+                  gauge, so attribution drift is a visible defect).
   ``evidence``    one-shot bundle capture (device probe incl. allocator
                   memory_stats, compile log, kernel summary, trace
                   sample, step ring, metrics snapshot) —
@@ -40,6 +46,8 @@ from .compilelog import (CompileLog, get_compile_log, instrument_jit,
                          signature_of)
 from .evidence import capture_bundle
 from .histogram import Histogram
+from .journey import BUCKETS as JOURNEY_BUCKETS
+from .journey import JourneyStore
 from .prometheus import (family_names, render_prometheus,
                          validate_exposition)
 from .steplog import StepCostModel, StepLog
@@ -53,6 +61,8 @@ __all__ = [
     "Span",
     "Trace",
     "Tracer",
+    "JourneyStore",
+    "JOURNEY_BUCKETS",
     "Histogram",
     "StepLog",
     "StepCostModel",

@@ -66,7 +66,8 @@ class _Writer:
         self.lines.append(f"# HELP {name} {help_text}")
         self.lines.append(f"# TYPE {name} {kind}")
 
-    def sample(self, name: str, value, labels: Optional[Dict] = None):
+    def sample(self, name: str, value, labels: Optional[Dict] = None,
+               exemplar: Optional[Dict] = None):
         if value is None:
             return
         if isinstance(value, bool):
@@ -85,22 +86,39 @@ class _Writer:
         if series in self._seen_series:
             raise ValueError(f"duplicate series {series}")
         self._seen_series.add(series)
-        self.lines.append(f"{series} {float(value):g}")
+        line = f"{series} {float(value):g}"
+        if exemplar:
+            # OpenMetrics exemplar suffix: ` # {labels} value` — the
+            # journey_id on a tail bucket links a p99 spike straight to
+            # the journeys that caused it (GET /journey/<id>)
+            exl = ",".join(
+                f'{k}="{exemplar[k]}"' for k in sorted(exemplar)
+                if k != "value")
+            line += f" # {{{exl}}} {float(exemplar.get('value', 0.0)):g}"
+        self.lines.append(line)
 
     def render(self) -> str:
         return "\n".join(self.lines) + "\n"
 
 
-def _hist_samples(w: _Writer, family: str, snap: dict):
+def _hist_samples(w: _Writer, family: str, snap: dict,
+                  labels: Optional[Dict] = None,
+                  exemplars: Optional[Dict] = None):
     """Emit one histogram snapshot (``observability.histogram``
     cumulative-bucket form) as ``_bucket``/``_sum``/``_count`` lines.
     The family's TYPE header must already be declared by the caller —
-    with a *literal* name, so the tpulint metric-sync rule sees it."""
+    with a *literal* name, so the tpulint metric-sync rule sees it.
+    ``labels`` (e.g. ``{"tenant": name}``) ride every line so one
+    family carries a bucket group per label-set; ``exemplars`` maps
+    ``str(le)`` to an exemplar dict attached to that bucket line."""
+    labels = dict(labels or {})
     for le, cum in snap.get("buckets") or []:
         lab = le if isinstance(le, str) else f"{float(le):g}"
-        w.sample(family + "_bucket", cum, {"le": lab})
-    w.sample(family + "_sum", snap.get("sum", 0.0))
-    w.sample(family + "_count", snap.get("count", 0))
+        ex = (exemplars or {}).get(le if isinstance(le, str) else str(le))
+        w.sample(family + "_bucket", cum, {**labels, "le": lab},
+                 exemplar=ex)
+    w.sample(family + "_sum", snap.get("sum", 0.0), labels or None)
+    w.sample(family + "_count", snap.get("count", 0), labels or None)
 
 
 def render_prometheus(snapshot: dict,
@@ -766,6 +784,126 @@ def render_prometheus(snapshot: dict,
         w.sample("router_prefill_fraction",
                  (rt.get("elastic") or {}).get("prefill_fraction"))
 
+    # fleet-wide request journeys (observability/journey.py): the
+    # snapshot section is JourneyStore.summary()
+    jn = snapshot.get("journeys") or {}
+    if jn:
+        w.family("journeys_total", "counter",
+                 "Finished request journeys (one per request, stitched "
+                 "across every replica it touched)")
+        w.sample("journeys_total", jn.get("count", 0))
+        w.family("journey_hops_total", "counter",
+                 "Cross-replica handoff hops recorded across all "
+                 "finished journeys")
+        w.sample("journey_hops_total", jn.get("hops_total", 0))
+        w.family("journey_live_requests", "gauge",
+                 "Journeys still in flight (not yet finalized)")
+        w.sample("journey_live_requests", jn.get("live", 0))
+        w.family("journey_attribution_coverage", "gauge",
+                 "Mean fraction of journey e2e wall attributed to a "
+                 "named bucket (1 - other/e2e); below 0.97 means the "
+                 "attribution engine is losing time")
+        w.sample("journey_attribution_coverage",
+                 jn.get("attribution_coverage", 0.0))
+        w.family("journey_attribution_seconds_total", "counter",
+                 "Aggregate journey wall seconds by attribution bucket "
+                 "(queue_wait/sched_reorder/adapter_wait/prefill_compute"
+                 "/handoff/parked/resume/decode_compute/detok/"
+                 "replay_retry/other)")
+        bs = jn.get("bucket_seconds") or {}
+        if bs:
+            for b in sorted(bs):
+                w.sample("journey_attribution_seconds_total", bs[b],
+                         {"bucket": b})
+        else:
+            w.sample("journey_attribution_seconds_total", 0.0,
+                     {"bucket": "none"})
+
+    # per-tenant SLO accounting (ServingMetrics.on_journey)
+    tn = snapshot.get("tenants") or {}
+    if tn:
+        w.family("tenant_requests_total", "counter",
+                 "Finished requests by accounting tenant")
+        for name in sorted(tn):
+            w.sample("tenant_requests_total",
+                     tn[name].get("requests", 0), {"tenant": name})
+        w.family("tenant_slo_attained_total", "counter",
+                 "Requests that finished DONE within their deadline, "
+                 "by tenant")
+        for name in sorted(tn):
+            w.sample("tenant_slo_attained_total",
+                     tn[name].get("attained", 0), {"tenant": name})
+        w.family("tenant_slo_attainment", "gauge",
+                 "attained / requests per tenant over the process "
+                 "lifetime")
+        for name in sorted(tn):
+            w.sample("tenant_slo_attainment",
+                     tn[name].get("attainment", 0.0), {"tenant": name})
+        w.family("tenant_tokens_total", "counter",
+                 "Tokens delivered by finished requests, by tenant")
+        for name in sorted(tn):
+            w.sample("tenant_tokens_total",
+                     tn[name].get("tokens", 0), {"tenant": name})
+        w.family("tenant_parked_seconds_total", "counter",
+                 "Wall seconds tenants' requests spent parked in the "
+                 "host KV tier")
+        for name in sorted(tn):
+            w.sample("tenant_parked_seconds_total",
+                     tn[name].get("parked_seconds", 0.0),
+                     {"tenant": name})
+        w.family("tenant_e2e_seconds", "histogram",
+                 "Request end-to-end latency by tenant in seconds; "
+                 "tail buckets carry journey_id exemplars")
+        for name in sorted(tn):
+            _hist_samples(w, "tenant_e2e_seconds",
+                          tn[name].get("e2e") or {},
+                          labels={"tenant": name},
+                          exemplars=tn[name].get("exemplars"))
+        w.family("tenant_attribution_seconds_total", "counter",
+                 "Journey wall seconds by tenant and attribution "
+                 "bucket")
+        for name in sorted(tn):
+            buckets = tn[name].get("buckets") or {}
+            for b in sorted(buckets):
+                w.sample("tenant_attribution_seconds_total",
+                         buckets[b], {"tenant": name, "bucket": b})
+
+    # fleet-mode /metrics: per-replica key stats with a replica label
+    # (tools/serve.py merges each handle's snapshot into this section)
+    fl = snapshot.get("fleet") or {}
+    if fl:
+        reps = fl.get("replicas") or []
+        w.family("fleet_replica_submitted_total", "counter",
+                 "Requests submitted, by replica")
+        for rep in reps:
+            w.sample("fleet_replica_submitted_total",
+                     rep.get("submitted", 0),
+                     {"replica": rep.get("replica", "?")})
+        w.family("fleet_replica_completed_total", "counter",
+                 "Requests completed, by replica")
+        for rep in reps:
+            w.sample("fleet_replica_completed_total",
+                     rep.get("completed", 0),
+                     {"replica": rep.get("replica", "?")})
+        w.family("fleet_replica_tokens_total", "counter",
+                 "Tokens generated, by replica")
+        for rep in reps:
+            w.sample("fleet_replica_tokens_total",
+                     rep.get("tokens_generated", 0),
+                     {"replica": rep.get("replica", "?")})
+        w.family("fleet_replica_queue_depth", "gauge",
+                 "Admission-queue depth at snapshot time, by replica")
+        for rep in reps:
+            w.sample("fleet_replica_queue_depth", rep.get("queued", 0),
+                     {"replica": rep.get("replica", "?")})
+        w.family("fleet_replica_active_requests", "gauge",
+                 "Requests occupying a KV slot at snapshot time, by "
+                 "replica")
+        for rep in reps:
+            w.sample("fleet_replica_active_requests",
+                     rep.get("active", 0),
+                     {"replica": rep.get("replica", "?")})
+
     for key, (family, help_text) in SERIES_FAMILIES.items():
         series = snapshot.get(key)
         if not isinstance(series, dict):
@@ -822,7 +960,14 @@ def validate_exposition(text: str) -> List[str]:
     ``le="+Inf"`` bucket, cumulative counts must be non-decreasing in
     ascending ``le`` order, a ``_count`` sample must equal the ``+Inf``
     bucket, bare base-named samples are rejected, and a family declared
-    ``TYPE histogram`` with no ``_bucket`` samples at all is invalid."""
+    ``TYPE histogram`` with no ``_bucket`` samples at all is invalid.
+
+    Labeled multi-series families are first-class: duplicate detection
+    normalizes the label set (sorted by label name), so two samples of
+    the same family whose labels differ only in ORDER are still flagged
+    as duplicates.  OpenMetrics exemplar suffixes
+    (``... # {journey_id="j42"} 1.25``) are accepted on any sample and
+    syntax-checked, then stripped before the sample itself is parsed."""
     problems = []
     seen_series = set()
     typed = set()
@@ -833,6 +978,7 @@ def validate_exposition(text: str) -> List[str]:
     sample_re = re.compile(
         r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s+(\S+)(\s+\d+)?$")
     label_re = re.compile(r'^([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"$')
+    exemplar_re = re.compile(r"^\{([^}]*)\}\s+(\S+)(\s+\S+)?$")
     for i, line in enumerate(text.splitlines(), 1):
         if not line.strip():
             continue
@@ -850,6 +996,23 @@ def validate_exposition(text: str) -> List[str]:
         if line.startswith("#"):
             problems.append(f"line {i}: unknown comment {line!r}")
             continue
+        if " # " in line:
+            # OpenMetrics exemplar: <sample> # {label="v",...} <value>
+            line, ex = line.split(" # ", 1)
+            em = exemplar_re.match(ex)
+            if em is None:
+                problems.append(f"line {i}: malformed exemplar {ex!r}")
+            else:
+                for pair in _split_labels(em.group(1)):
+                    if not label_re.match(pair):
+                        problems.append(
+                            f"line {i}: bad exemplar label {pair!r}")
+                try:
+                    float(em.group(2))
+                except ValueError:
+                    problems.append(
+                        f"line {i}: bad exemplar value "
+                        f"{em.group(2)!r}")
         m = sample_re.match(line)
         if m is None:
             problems.append(f"line {i}: unparseable sample {line!r}")
@@ -864,16 +1027,20 @@ def validate_exposition(text: str) -> List[str]:
             problems.append(f"line {i}: sample {name} has no TYPE")
         le_raw = None
         other_labels = []
+        all_labels = []
         if labels:
             for pair in _split_labels(labels):
                 lm = label_re.match(pair)
                 if not lm:
                     problems.append(f"line {i}: bad label {pair!r}")
-                elif lm.group(1) == "le":
+                    continue
+                all_labels.append(pair)
+                if lm.group(1) == "le":
                     le_raw = lm.group(2)
                 else:
                     other_labels.append(pair)
-        key = (name, labels or "")
+        # normalize the label-set so reordered duplicates still collide
+        key = (name, tuple(sorted(all_labels)))
         if key in seen_series:
             problems.append(f"line {i}: duplicate series {name}{{"
                             f"{labels or ''}}}")

@@ -56,6 +56,7 @@ import numpy as np
 from ..inference.generation import (GenerationConfig, PagedGenerationEngine,
                                     _round_up)
 from ..observability import Tracer, get_compile_log
+from ..observability.journey import JourneyStore
 from ..observability.steplog import StepCostModel, StepLog
 from .adapters import UnknownAdapterError
 from .kv_tier import HostKVTier
@@ -112,7 +113,9 @@ class EngineCore:
                  adapter_slots: int = 8,
                  kv_host_pages: int = 0,
                  kv_park_watermark: float = 0.95,
-                 kv_resume_watermark: float = 0.70):
+                 kv_resume_watermark: float = 0.70,
+                 journeys: Optional[JourneyStore] = None,
+                 replica_name: Optional[str] = None):
         # sharded serving plane (serving/sharded/): when a ServingMesh is
         # handed in, re-validate it against THIS core's feature flags so
         # incompatible combos (quantized wire + speculation/prefix cache)
@@ -215,6 +218,13 @@ class EngineCore:
         # → evict); completed traces live in the tracer's ring buffer
         # and serve.py exposes them as GET /trace/<rid>
         self.tracer = tracer or Tracer()
+        # fleet-wide journey plane (observability/journey.py): a fleet
+        # passes ONE shared store so a request migrating across replicas
+        # stitches into a single journey; standalone cores get a private
+        # store so attribution/tenant accounting work identically
+        self.replica_name = replica_name or "core0"
+        self._journeys = journeys if journeys is not None else JourneyStore()
+        self._journeys.register(self.replica_name, self.tracer)
         self._decode_warm = False
         self._queue = RequestQueue(max_depth=max_queue)
 
@@ -535,10 +545,19 @@ class EngineCore:
         def fn(batch):
             kept, shed = self._sched.schedule(batch, now, cal, backlog)
             captured["kept"] = kept
+            captured["batch"] = batch
             return kept, shed
 
         shed = self._queue.schedule(fn)
         kept = captured.get("kept", [])
+        if shed or kept != captured.get("batch", kept):
+            # latency attribution: this pass actually changed the queue,
+            # so waiting time from here on is scheduler-induced — _admit
+            # splits the queue_wait span at this stamp (sched_reorder
+            # bucket, observability/journey.py)
+            for r in kept:
+                if r.sched_reorder_at is None:
+                    r.sched_reorder_at = now
         slacks = [r.sched_predicted_slack for r in kept
                   if r.sched_predicted_slack is not None]
         self._last_min_slack_s = min(slacks) if slacks else None
@@ -657,11 +676,28 @@ class EngineCore:
                       if self._adapters is not None else None),
             kv_tier=(self._kv_tier.summary()
                      if self._kv_tier is not None else None),
-            sched=self._sched_snapshot())
+            sched=self._sched_snapshot(),
+            journeys=self._journeys.summary())
 
     # ------------------------------------------------------- trace hooks
     def _trace_end(self, req: Request, state: RequestState):
-        self.tracer.end(req.rid, _TRACE_STATE.get(state, state.value))
+        st = _TRACE_STATE.get(state, state.value)
+        self.tracer.end(req.rid, st)
+        # journey finalize: stitch this rid's spans across every replica
+        # that saw it and decompose the e2e wall into attribution
+        # buckets; the summary feeds the per-tenant SLO families
+        summary = self._journeys.finalize(req.rid, st)
+        if summary is not None:
+            attained = (state == RequestState.DONE
+                        and (req.deadline is None
+                             or (req.finished_at or req.arrival)
+                             <= req.deadline))
+            self._metrics.on_journey(
+                tenant=req.tenant, e2e_s=summary["e2e_s"],
+                tokens=len(req.tokens), attained=attained,
+                buckets=summary["buckets"],
+                coverage=summary["coverage"],
+                journey_id=summary["journey_id"])
 
     def _trace_queue_drop(self, req: Request, state: RequestState,
                           reason: str):
@@ -694,7 +730,8 @@ class EngineCore:
                attention_mask=None,
                timeout_s: Optional[float] = None,
                cache_salt: Optional[str] = None,
-               adapter_id: Optional[str] = None) -> List[Request]:
+               adapter_id: Optional[str] = None,
+               tenant: Optional[str] = None) -> List[Request]:
         """Enqueue one request per row of ``input_ids`` ([b, plen] or
         [plen]).  All-or-nothing: admission errors (too long, queue
         full, not batchable) reject the whole call.  Returns the per-row
@@ -730,7 +767,7 @@ class EngineCore:
             rows.append(row)
         timeout_s = self._default_timeout if timeout_s is None else timeout_s
         reqs = [Request(row, g, timeout_s=timeout_s, cache_salt=cache_salt,
-                        adapter_id=adapter_id)
+                        adapter_id=adapter_id, tenant=tenant)
                 for row in rows]
         try:
             self._queue.submit_many(reqs)
@@ -742,6 +779,8 @@ class EngineCore:
             self.tracer.begin(req.rid, kind="batch",
                               prompt_len=int(req.prompt.size),
                               max_new_tokens=g.max_new_tokens)
+            self._journeys.begin(req.rid, self.replica_name,
+                                 tenant=tenant)
         return reqs
 
     def submit_exclusive(self, fn,
@@ -765,6 +804,7 @@ class EngineCore:
             raise
         self._metrics.on_submitted()
         self.tracer.begin(req.rid, kind="exclusive")
+        self._journeys.begin(req.rid, self.replica_name)
         return req
 
     def enqueue(self, req: Request) -> Request:
@@ -803,6 +843,10 @@ class EngineCore:
             self.tracer.begin(req.rid, kind="batch",
                               prompt_len=int(req.prompt.size),
                               max_new_tokens=g.max_new_tokens)
+        # idempotent: a rerouted request keeps its original journey
+        # (origin replica, hop count) in a fleet-shared store
+        self._journeys.begin(req.rid, self.replica_name,
+                             tenant=req.tenant)
         return req
 
     # ------------------------------------------------------ the step loop
@@ -1051,7 +1095,17 @@ class EngineCore:
     def _admit(self, req: Request, sid: int):
         admit_t = time.monotonic()
         queued_at = req.requeued_at if req.retries else req.arrival
-        self.tracer.add_span(req.rid, "queue_wait", queued_at, admit_t)
+        mark = req.sched_reorder_at
+        if mark is not None and queued_at < mark < admit_t:
+            # an admission-policy pass reordered the queue while this
+            # request waited: split the wait so post-reorder time lands
+            # in the sched_reorder attribution bucket
+            self.tracer.add_span(req.rid, "queue_wait", queued_at, mark)
+            self.tracer.add_span(req.rid, "sched_reorder", mark, admit_t,
+                                 policy=self._sched.name)
+        else:
+            self.tracer.add_span(req.rid, "queue_wait", queued_at, admit_t)
+        req.sched_reorder_at = None
         self._metrics.on_queue_wait(admit_t - queued_at)
         clog = get_compile_log()
         c0 = clog.count()
@@ -2219,6 +2273,10 @@ class EngineCore:
             "kv_len": kv_len, "kv_tokens": kv_tokens,
             "k_host": k_host, "v_host": v_host, "page": page,
             "salt": req.cache_salt, "adapter_id": req.adapter_id,
+            # journey context rides the packet as plain data so a
+            # parked row keeps its cross-replica identity (the tier
+            # stores packets opaquely; drain/inspection tools see it)
+            "journey": self._journeys.context(req.rid, self.replica_name),
         }
         try:
             tier.park(req.rid, packet, n_pages, step=self._step_idx,
@@ -2553,6 +2611,10 @@ class EngineCore:
                                  s.get("span_end", t0), now,
                                  direction="export", pages=n_pages,
                                  kv_tokens=kv_len)
+            # journey context travels WITH the KV: the importer stitches
+            # this hop (export end -> import start) into one journey
+            packet["journey"] = self._journeys.context(
+                req.rid, self.replica_name, export_end=now)
             return packet
 
     def import_handoff(self, packet: dict) -> Request:
@@ -2684,9 +2746,22 @@ class EngineCore:
                 bytes_est=bts, flops_est=fl, cost_source=src_tag,
                 retries=req.retries,
                 degraded=self._effective_max_batch < self._max_batch)
+            # a fleet may give each replica its own Tracer: the imported
+            # rid has no trace here yet, and add_span on a missing rid
+            # silently drops the import span
+            if self.tracer.get(req.rid) is None:
+                self.tracer.begin(req.rid, kind="batch",
+                                  prompt_len=length,
+                                  max_new_tokens=g.max_new_tokens,
+                                  imported=True)
             self.tracer.add_span(req.rid, "handoff", t0, now,
                                  direction="import", pages=n_pages,
                                  kv_tokens=kv_len)
+            # hop edge: bump the journey's hop count and record the
+            # transfer interval (source export end -> this import start)
+            self._journeys.record_import(
+                req.rid, packet.get("journey"), self.replica_name,
+                t0, now, pages=n_pages, kv_tokens=kv_len)
             return req
 
     # ---------------------------------------------------- thread control

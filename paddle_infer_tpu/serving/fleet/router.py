@@ -114,7 +114,8 @@ class FleetRouter:
     def submit(self, prompt, config: GenerationConfig = None,
                timeout_s: Optional[float] = None,
                cache_salt: Optional[str] = None,
-               adapter_id: Optional[str] = None) -> Request:
+               adapter_id: Optional[str] = None,
+               tenant: Optional[str] = None) -> Request:
         """Route ONE prompt (1-D token array) to a replica and return
         its ``Request`` handle.  Raises ``LoadShedError`` (a
         ``RejectedError``, but retryable — a fully draining fleet is an
@@ -142,13 +143,18 @@ class FleetRouter:
         handle, reason, match = self._pick(candidates, ids, salt)
         req = handle.core.submit(ids, g, timeout_s=timeout_s,
                                  cache_salt=cache_salt,
-                                 adapter_id=adapter_id)[0]
+                                 adapter_id=adapter_id,
+                                 tenant=tenant)[0]
         handle.dispatched += 1
         if reason == "affinity":
             handle.affinity_hits += 1
         # the finished sequence retains prompt + tokens[:-1]; the prompt
         # is the durable part worth shadowing now
         self._shadow.observe(handle.name, ids, salt)
+        # the replica's stepping thread may finish (and end) this trace
+        # before the router stamps the route span; add_span lands on the
+        # 256-ring copy in that case, which is exactly what we want
+        # tpulint: disable-next-line=tracer-leak -- add_span is ring-safe after end() by design
         handle.core.tracer.add_span(
             req.rid, "route", t0, time.monotonic(), replica=handle.name,
             role=handle.role.value, reason=reason, prefix_match=match)

@@ -27,7 +27,10 @@ import time
 from collections import deque
 from typing import Dict, Optional
 
-from ..observability.histogram import Histogram
+from bisect import bisect_left
+
+from ..observability.histogram import DEFAULT_BOUNDS, Histogram
+from ..observability.journey import BUCKETS as _JOURNEY_BUCKETS
 
 _RESERVOIR = 2048        # samples kept per latency series
 _RATE_WINDOW_S = 30.0    # sliding window for tokens/s
@@ -131,6 +134,12 @@ class ServingMetrics:
             self.e2e_hist = Histogram()
             self.step_wall_hist = Histogram()
             self.queue_wait_hist = Histogram()
+            # per-tenant SLO accounting (observability/journey.py):
+            # tenant -> counters + a native e2e histogram + attribution
+            # bucket sums + exemplar journey_ids keyed by the histogram
+            # bucket each observation landed in, so a p99 spike links
+            # directly to the journeys that caused it
+            self._tenants: Dict[str, dict] = {}
 
     # ------------------------------------------------ recording hooks
     def on_submitted(self, n: int = 1):
@@ -214,6 +223,42 @@ class ServingMetrics:
                 self.e2e.add(e2e_s)
                 self.e2e_hist.observe(e2e_s)
 
+    def on_journey(self, tenant: Optional[str], e2e_s: float,
+                   tokens: int, attained: bool, buckets: Dict[str, float],
+                   coverage: float, journey_id: str):
+        """One request's journey finished: fold its attribution summary
+        into the per-tenant SLO families.  ``tenant`` is the accounting
+        label from ``submit(tenant=)`` (untenanted traffic lands under
+        ``"default"``); ``buckets`` is the journey's bucket-seconds
+        decomposition and ``journey_id`` becomes the exemplar on the
+        tenant e2e histogram bucket this observation lands in."""
+        key = "default" if tenant is None else str(tenant)
+        with self._lock:
+            t = self._tenants.get(key)
+            if t is None:
+                t = self._tenants[key] = {
+                    "requests": 0, "attained": 0, "tokens": 0,
+                    "parked_seconds": 0.0,
+                    "e2e_hist": Histogram(),
+                    "buckets": {b: 0.0 for b in _JOURNEY_BUCKETS},
+                    "exemplars": {},
+                }
+            t["requests"] += 1
+            if attained:
+                t["attained"] += 1
+            t["tokens"] += int(tokens)
+            t["parked_seconds"] += float(buckets.get("parked", 0.0))
+            t["e2e_hist"].observe(e2e_s)
+            for b, v in buckets.items():
+                if b in t["buckets"]:
+                    t["buckets"][b] += float(v)
+            # latest exemplar per landing bucket; +Inf for overflow
+            i = bisect_left(DEFAULT_BOUNDS, float(e2e_s))
+            le = ("+Inf" if i >= len(DEFAULT_BOUNDS)
+                  else str(DEFAULT_BOUNDS[i]))
+            t["exemplars"][le] = {"journey_id": journey_id,
+                                  "value": float(e2e_s)}
+
     # --------------------------------------------- resilience hooks
     def on_engine_restart(self, n: int = 1):
         with self._lock:
@@ -267,7 +312,8 @@ class ServingMetrics:
                  moe: Optional[Dict] = None,
                  adapters: Optional[Dict] = None,
                  sched: Optional[Dict] = None,
-                 kv_tier: Optional[Dict] = None) -> Dict:
+                 kv_tier: Optional[Dict] = None,
+                 journeys: Optional[Dict] = None) -> Dict:
         """Render everything to a plain dict (the ``GET /metrics`` JSON
         body).  Latency series carry lifetime ``count``/``mean`` plus
         reservoir-window ``p50_recent``/``p99_recent``/``max_recent``
@@ -299,7 +345,11 @@ class ServingMetrics:
         serves multi-LoRA tenants; ``kv_tier`` is
         ``HostKVTier.summary()`` (parked requests, host-page residency,
         park/resume/demote/promote and swap-byte counters) when the
-        core runs with a host-RAM KV tier."""
+        core runs with a host-RAM KV tier; ``journeys`` is
+        ``JourneyStore.summary()`` (finished-journey count, hop total,
+        mean attribution coverage, aggregate bucket seconds) — the
+        per-tenant SLO section is internal (fed by ``on_journey``) and
+        rides along whenever any tenant finished a request."""
         tps = self.tokens_per_second()
         with self._lock:
             out = {
@@ -373,6 +423,23 @@ class ServingMetrics:
                 out["adapters"] = dict(adapters)
             if kv_tier is not None:
                 out["kv_tier"] = dict(kv_tier)
+            if journeys is not None:
+                out["journeys"] = dict(journeys)
+            if self._tenants:
+                out["tenants"] = {
+                    name: {
+                        "requests": t["requests"],
+                        "attained": t["attained"],
+                        "attainment": (t["attained"] / t["requests"]
+                                       if t["requests"] else 0.0),
+                        "tokens": t["tokens"],
+                        "parked_seconds": t["parked_seconds"],
+                        "e2e": t["e2e_hist"].snapshot(),
+                        "buckets": dict(t["buckets"]),
+                        "exemplars": {le: dict(ex) for le, ex
+                                      in t["exemplars"].items()},
+                    }
+                    for name, t in sorted(self._tenants.items())}
             if sched is not None:
                 # the core's scheduler section (policy, planner,
                 # predicted-vs-actual slack), plus this registry's

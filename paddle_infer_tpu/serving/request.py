@@ -86,7 +86,8 @@ class Request:
                  kind: str = "batch",
                  exclusive_fn: Optional[Callable] = None,
                  cache_salt: Optional[str] = None,
-                 adapter_id: Optional[str] = None):
+                 adapter_id: Optional[str] = None,
+                 tenant: Optional[str] = None):
         self.rid = next(_rid_counter)
         self.prompt = (None if prompt is None
                        else np.asarray(prompt, np.int32).reshape(-1))
@@ -99,6 +100,10 @@ class Request:
         # (None = base model).  The adapter joins the row's cache salt —
         # KV produced under a fine-tune is only warm for that fine-tune.
         self.adapter_id = adapter_id
+        # accounting tenant (observability only): labels the per-tenant
+        # SLO families and journey summaries.  Deliberately NOT part of
+        # route_salt() — it must never perturb scheduling or caching.
+        self.tenant = tenant
         self.exclusive_fn = exclusive_fn
         self.arrival = time.monotonic()
         self.deadline = (None if timeout_s is None
@@ -124,6 +129,11 @@ class Request:
         # back at completion for predicted-vs-actual slack error
         self.sched_predicted_done: Optional[float] = None
         self.sched_predicted_slack: Optional[float] = None
+        # latency attribution: stamped when an admission-policy pass
+        # reorders the queue while this request waits; queue time after
+        # the stamp attributes to the sched_reorder bucket, before it to
+        # plain queue_wait (observability/journey.py)
+        self.sched_reorder_at: Optional[float] = None
         self._chunks: _queue.Queue = _queue.Queue()
         self._done = threading.Event()
 

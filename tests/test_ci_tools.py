@@ -146,6 +146,90 @@ def test_check_metrics_covers_kv_tier_families():
     assert problems == []
 
 
+def test_check_metrics_covers_journey_families():
+    """The journey/tenant/fleet families must be exercised by the
+    fabricated snapshot (3-way sync: renderer ↔ docs catalog ↔
+    check_metrics), including the labeled multi-series ones."""
+    import check_metrics
+
+    _, _, text = check_metrics.fabricated_exposition()
+    for fam in ("journeys_total", "journey_hops_total",
+                "journey_live_requests",
+                "journey_attribution_coverage",
+                "journey_attribution_seconds_total",
+                "tenant_requests_total", "tenant_slo_attained_total",
+                "tenant_slo_attainment", "tenant_tokens_total",
+                "tenant_parked_seconds_total", "tenant_e2e_seconds",
+                "tenant_attribution_seconds_total",
+                "fleet_replica_submitted_total",
+                "fleet_replica_completed_total",
+                "fleet_replica_tokens_total",
+                "fleet_replica_queue_depth",
+                "fleet_replica_active_requests"):
+        assert f"# TYPE {fam} " in text, f"{fam} not rendered"
+    # the fabricated snapshot carries a journey_id exemplar on the
+    # tenant e2e histogram; it must survive rendering
+    assert '# {journey_id="' in text
+    problems, _ = check_metrics.run_checks(
+        os.path.join(ROOT, "docs", "OBSERVABILITY.md"))
+    assert problems == []
+
+
+def test_validator_labeled_series_dedup():
+    """Duplicate label-sets on one family are rejected — including
+    when the duplicate permutes label ORDER — while genuinely distinct
+    label-sets pass."""
+    from paddle_infer_tpu.observability.prometheus import \
+        validate_exposition
+
+    ok = ('# TYPE tenant_requests_total counter\n'
+          'tenant_requests_total{tenant="gold"} 3\n'
+          'tenant_requests_total{tenant="free"} 9\n')
+    assert validate_exposition(ok) == []
+
+    dup = ('# TYPE tenant_requests_total counter\n'
+           'tenant_requests_total{tenant="gold"} 3\n'
+           'tenant_requests_total{tenant="gold"} 4\n')
+    assert any("duplicate series" in p for p in validate_exposition(dup))
+
+    reordered = (
+        '# TYPE j_seconds_total counter\n'
+        'j_seconds_total{tenant="gold",bucket="decode_compute"} 1.5\n'
+        'j_seconds_total{bucket="decode_compute",tenant="gold"} 2.5\n')
+    assert any("duplicate series" in p
+               for p in validate_exposition(reordered))
+
+
+def test_validator_exemplars():
+    """OpenMetrics exemplar suffixes are tolerated and syntax-checked:
+    a well-formed one passes, malformed labels or values fail."""
+    from paddle_infer_tpu.observability.prometheus import \
+        validate_exposition
+
+    good = ('# TYPE tenant_e2e_seconds histogram\n'
+            'tenant_e2e_seconds_bucket{le="1",tenant="gold"} 2'
+            ' # {journey_id="j42"} 0.73\n'
+            'tenant_e2e_seconds_bucket{le="+Inf",tenant="gold"} 2\n'
+            'tenant_e2e_seconds_sum{tenant="gold"} 1.4\n'
+            'tenant_e2e_seconds_count{tenant="gold"} 2\n')
+    assert validate_exposition(good) == []
+
+    bad_label = ('# TYPE x_total counter\n'
+                 'x_total 3 # {9bad="j42"} 0.73\n')
+    assert any("bad exemplar label" in p
+               for p in validate_exposition(bad_label))
+
+    bad_value = ('# TYPE x_total counter\n'
+                 'x_total 3 # {journey_id="j42"} notanumber\n')
+    assert any("bad exemplar value" in p
+               for p in validate_exposition(bad_value))
+
+    malformed = ('# TYPE x_total counter\n'
+                 'x_total 3 # journey_id="j42" 0.73\n')
+    assert any("malformed exemplar" in p
+               for p in validate_exposition(malformed))
+
+
 def test_bench_diff_kv_tier_directions():
     """kv_tier keys carry a direction: goodput/parks/resumes up, sheds
     and abandoned swaps down, peak residency neutral."""
@@ -169,6 +253,16 @@ def test_bench_diff_multi_tenant_directions():
     assert bench_diff._direction("shed_rate_slack") == -1
     assert bench_diff._direction("deadline_misses_fifo") == -1
     assert bench_diff._direction("planner_chunk_limited") == 0
+
+
+def test_bench_diff_journey_directions():
+    """journey-plane keys carry a direction: attribution coverage and
+    per-tenant attainment up, parked seconds down."""
+    import bench_diff
+
+    assert bench_diff._direction("attribution_coverage") == 1
+    assert bench_diff._direction("tenant_gold_attainment") == 1
+    assert bench_diff._direction("tenant_gold_parked_seconds") == -1
 
 
 @pytest.mark.slow

@@ -349,6 +349,57 @@ def test_tracer_leak_silent_on_constants_and_jax_random(tmp_path):
     assert run_rules(tmp_path, src, ["tracer-leak"]) == []
 
 
+def test_tracer_leak_fires_on_cross_replica_add_span(tmp_path):
+    """A router stamping spans onto another component's tracer races
+    that component ending the trace; the rule flags the foreign
+    dotted-owner call site."""
+    src = """
+        import time
+
+        class Router:
+            def route(self, handle, req):
+                t0 = time.monotonic()
+                handle.core.tracer.add_span(
+                    req.rid, "route", t0, time.monotonic())
+    """
+    fs = run_rules(tmp_path, src, ["tracer-leak"])
+    assert len(fs) == 1
+    assert "foreign tracer" in fs[0].message
+    assert "handle.core.tracer" in fs[0].message
+
+
+def test_tracer_leak_silent_on_own_tracer(tmp_path):
+    """self.tracer / a bare local tracer are the component's own:
+    no cross-replica race, no finding."""
+    src = """
+        import time
+
+        class Core:
+            def step(self, rid):
+                t0 = time.monotonic()
+                self.tracer.add_span(rid, "step", t0, time.monotonic())
+                tracer = self.tracer
+                tracer.add_span(rid, "again", t0, time.monotonic())
+    """
+    assert run_rules(tmp_path, src, ["tracer-leak"]) == []
+
+
+def test_tracer_leak_cross_replica_suppression(tmp_path):
+    """Ring-landing can be intended (e.g. post-finish route spans);
+    the standard disable-next-line comment with a reason silences it."""
+    src = """
+        import time
+
+        class Router:
+            def route(self, handle, req):
+                t0 = time.monotonic()
+                # tpulint: disable-next-line=tracer-leak -- ring-safe by design
+                handle.core.tracer.add_span(
+                    req.rid, "route", t0, time.monotonic())
+    """
+    assert run_rules(tmp_path, src, ["tracer-leak"]) == []
+
+
 # -------------------------------------------------------- traced-branch
 def test_traced_branch_fires_on_param_branch(tmp_path):
     src = """

@@ -29,7 +29,12 @@ _HIGHER = ("per_s", "per_sec", "speedup", "mfu", "acceptance",
            "hit_rate", "tps", "tok_s", "throughput", "tokens_per",
            "pearson", "improvement", "spec_decode", "bytes_saved",
            "resident_pages_ratio", "attainment", "goodput",
-           "parks", "resumes")
+           "parks", "resumes", "coverage")
+# journey plane: attribution_coverage up (more of each request's wall
+# attributed to a named bucket), per-tenant attainment up (the
+# "attainment" rule covers tenant_<name>_attainment keys), parked
+# seconds down — at equal offered load, more time parked in the host
+# tier is latency the tenant ate.
 # quality direction: the quantized_kv section's *_err_* keys fall under
 # the "err" rule below, so a round where int8 serving drifts further
 # from the fp logits (or past its analytic bound) fails the diff the
@@ -50,7 +55,7 @@ _HIGHER = ("per_s", "per_sec", "speedup", "mfu", "acceptance",
 _LOWER = ("_ms", "latency", "ttft", "itl", "err", "wall", "p50",
           "p99", "wasted", "ici_bytes", "compile", "skew", "dropped",
           "dispatch_bytes", "shed", "misses", "violation", "uploads",
-          "evictions", "swap_fail", "_s")
+          "evictions", "swap_fail", "parked_seconds", "_s")
 # kv_tier: parks/resumes up (under identical oversubscribed offered
 # load, more preemption parked-not-dropped means less work was shed),
 # sheds/misses/swap_fails down — a tier round that sheds or abandons

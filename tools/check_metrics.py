@@ -4,7 +4,9 @@
 Three sync points must agree or dashboards silently break:
 
   1. the Prometheus text the server renders must be syntactically valid
-     (metric/label name syntax, typed samples, no duplicate series);
+     (metric/label name syntax, typed samples, no duplicate series —
+     label-sets compare order-insensitively, and OpenMetrics exemplar
+     suffixes are syntax-checked too);
   2. the renderer source and the metric catalog in
      docs/OBSERVABILITY.md must agree — checked by tpulint's
      metric-sync rule (paddle_infer_tpu/analysis/rules/metric_sync.py)
@@ -19,9 +21,14 @@ Three sync points must agree or dashboards silently break:
      ``_sum``/``_count`` are samples of the one typed family, never
      families of their own.
 
-Runs on a FABRICATED snapshot (every counter/series/gauge populated,
-plus a compile-log summary with a recompile) so the exposition exercises
-every family the renderer can emit.  Exit 0 = all checks pass.
+Runs on a FABRICATED snapshot (every counter/series/gauge populated —
+including multi-tenant journey accounting, fleet per-replica stats and
+the router section, so every LABELED multi-series family renders with
+several label values — plus a compile-log summary with a recompile) so
+the exposition exercises every family the renderer can emit.  A labeled
+family still counts ONCE in the 3-way sync: one ``w.family`` call, one
+TYPE line, one catalog row, however many label-sets it carries.
+Exit 0 = all checks pass.
 
 Usage:
   env PYTHONPATH=. python tools/check_metrics.py [--docs PATH]
@@ -104,7 +111,41 @@ def fabricated_exposition():
     m.on_shed()
     m.on_predictive_shed(2)
     m.on_loop_exception()
+    # per-tenant SLO accounting (journey plane): two named tenants plus
+    # the None->"default" mapping so every tenant_* family renders as a
+    # labeled multi-series family with journey_id exemplars
+    m.on_journey(tenant="gold", e2e_s=0.42, tokens=64, attained=True,
+                 buckets={"queue_wait": 0.01, "sched_reorder": 0.005,
+                          "prefill_compute": 0.15,
+                          "decode_compute": 0.22, "parked": 0.03,
+                          "other": 0.005},
+                 coverage=0.988, journey_id="j101")
+    m.on_journey(tenant="gold", e2e_s=1.31, tokens=128, attained=False,
+                 buckets={"queue_wait": 0.2, "prefill_compute": 0.4,
+                          "decode_compute": 0.66, "handoff": 0.03,
+                          "other": 0.02},
+                 coverage=0.985, journey_id="j102")
+    m.on_journey(tenant=None, e2e_s=0.09, tokens=16, attained=True,
+                 buckets={"queue_wait": 0.01, "prefill_compute": 0.03,
+                          "decode_compute": 0.05},
+                 coverage=1.0, journey_id="j103")
     snap = m.snapshot(queue_depth=1, active=2, max_batch=4,
+                      # JourneyStore.summary() shape (fleet-wide
+                      # journey aggregates)
+                      journeys={"count": 3, "hops_total": 2,
+                                "attribution_coverage": 0.991,
+                                "bucket_seconds": {
+                                    "queue_wait": 0.22,
+                                    "sched_reorder": 0.005,
+                                    "adapter_wait": 0.0,
+                                    "prefill_compute": 0.58,
+                                    "handoff": 0.03, "parked": 0.03,
+                                    "resume": 0.0,
+                                    "decode_compute": 0.93,
+                                    "detok": 0.002,
+                                    "replay_retry": 0.0,
+                                    "other": 0.025},
+                                "live": 1},
                       # EngineCore._sched_snapshot() shape: policy +
                       # planner + predicted-vs-actual slack error
                       sched={"policy": "slack", "reorders": True,
@@ -239,6 +280,18 @@ def fabricated_exposition():
         "elastic": {"prefill_fraction": 0.41, "window": 12,
                     "high": 0.65, "low": 0.25},
     }
+
+    # fleet-mode per-replica key stats (tools/serve.py /metrics builds
+    # this in fleet mode): every fleet_replica_* family renders with
+    # two replica label values
+    snap["fleet"] = {"replicas": [
+        {"replica": "prefill0", "role": "prefill", "submitted": 9,
+         "completed": 7, "tokens_generated": 310, "queued": 2,
+         "active": 1},
+        {"replica": "decode1", "role": "decode", "submitted": 14,
+         "completed": 14, "tokens_generated": 702, "queued": 0,
+         "active": 2},
+    ]}
 
     # local CompileLog (not the process singleton): one prefill, one
     # warmed decode, one post-warmup recompile so the recompile/storm

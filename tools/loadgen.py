@@ -179,10 +179,14 @@ def request_from_event(event: Dict):
     g = GenerationConfig(max_new_tokens=int(event["max_new"]),
                          do_sample=bool(event.get("do_sample", False)),
                          seed=int(event.get("seed", 0)))
+    # the tenant class name rides as the request's accounting tenant,
+    # so replayed traces light up the per-tenant SLO families and the
+    # journey plane attributes latency per tenant class
     return Request(np.asarray(event["prompt"], np.int32), g,
                    timeout_s=event.get("timeout_s"),
                    cache_salt=event.get("cache_salt"),
-                   adapter_id=event.get("adapter_id"))
+                   adapter_id=event.get("adapter_id"),
+                   tenant=event.get("tenant"))
 
 
 def replay(core, events: List[Dict], time_scale: float = 1.0,
@@ -226,6 +230,34 @@ def replay(core, events: List[Dict], time_scale: float = 1.0,
         if not busy:
             _time.sleep(step_wait_s)
     return handles
+
+
+def tenant_attainment(events: List[Dict],
+                      handles: Dict[int, object]) -> Dict[str, Dict]:
+    """Per-tenant SLO accounting over one replay: for every tenant
+    class in ``events``, the deadline-bearing request count, how many
+    of those finished DONE (the same attainment definition the
+    engine's ``tenant_slo_attained_total`` family uses), and the
+    attainment ratio.  Deadline-less tenants report ``attainment``
+    None — an all-batch class has no SLO to attain."""
+    from paddle_infer_tpu.serving import RequestState
+
+    out: Dict[str, Dict] = {}
+    for e in events:
+        t = out.setdefault(e.get("tenant") or "default",
+                           {"requests": 0, "deadline_requests": 0,
+                            "attained": 0})
+        t["requests"] += 1
+        if e.get("timeout_s") is None:
+            continue
+        t["deadline_requests"] += 1
+        req = handles.get(e["i"])
+        if req is not None and req.state == RequestState.DONE:
+            t["attained"] += 1
+    for t in out.values():
+        t["attainment"] = (t["attained"] / t["deadline_requests"]
+                           if t["deadline_requests"] else None)
+    return out
 
 
 def main(argv=None) -> int:

@@ -24,7 +24,18 @@ decode steps instead of serializing behind a lock.
                           ``?format=chrome`` exports Chrome-trace JSON
                           mergeable with profiler captures
   GET  /traces            -> one-line summaries of the completed-trace
-                          ring (id, state, duration, span coverage)
+                          ring (id, state, duration, span coverage);
+                          fleet-wide (every replica's ring) in fleet
+                          mode
+  GET  /journeys          -> finished request-journey summaries (one
+                          per request, stitched across every replica
+                          it touched: hops, latency-attribution
+                          buckets, coverage) plus fleet aggregates
+  GET  /journey/<id>      -> one journey by journey id ("j<rid>") or
+                          raw request id: summary + per-replica span
+                          dumps + hop events; ``?format=chrome``
+                          renders the multi-replica journey as ONE
+                          Chrome trace with per-replica process lanes
   GET  /steps             -> recent StepLog flight-recorder ring (one
                           record per scheduler step: kind, batch
                           composition, resident KV pages, analytic
@@ -90,20 +101,24 @@ _STATE = {"lock": threading.Lock()}
 
 def _build_fleet(roles):
     """Disaggregated fleet (--fleet_roles): one EngineCore + supervisor
-    per role, each owning its OWN engine and KV pool (pools are strictly
-    per-engine), all sharing one tracer and one StepLog so /trace and
-    /steps stay fleet-wide, behind a FleetRouter.  The router thread
-    only routes — supervisors own the scheduler threads."""
+    per role, each owning its OWN engine, KV pool and span tracer
+    (pools are strictly per-engine; per-replica tracers keep one
+    replica's 256-ring from evicting another's traces), all sharing one
+    StepLog and ONE JourneyStore — the journey plane stitches the
+    per-replica traces back into fleet-wide request journeys
+    (``GET /journeys``), behind a FleetRouter.  The router thread only
+    routes — supervisors own the scheduler threads."""
     from paddle_infer_tpu.inference.generation import PagedGenerationEngine
-    from paddle_infer_tpu.observability import Tracer
+    from paddle_infer_tpu.observability import JourneyStore, Tracer
     from paddle_infer_tpu.observability.steplog import StepLog
     from paddle_infer_tpu.serving import (EngineCore, EngineSupervisor,
                                           FleetRouter, ReplicaHandle)
 
-    tracer = Tracer()
     steplog = StepLog()
+    journeys = JourneyStore()
     handles, sups = [], []
     for i, role in enumerate(roles):
+        name = f"{role.value}{i}"
         engine = PagedGenerationEngine(
             _STATE["model"], page_size=_STATE["page_size"],
             kv_dtype=_STATE.get("kv_dtype"))
@@ -114,7 +129,8 @@ def _build_fleet(roles):
             decode_chunk=_STATE["decode_chunk"],
             default_timeout_s=_STATE["request_timeout"],
             max_model_len=_STATE["max_model_len"],
-            tracer=tracer, steplog=steplog,
+            tracer=Tracer(), steplog=steplog,
+            journeys=journeys, replica_name=name,
             enable_prefix_cache=_STATE.get("enable_prefix_cache", False),
             prefix_cache_watermark=_STATE.get(
                 "prefix_cache_watermark", 0.5),
@@ -133,8 +149,7 @@ def _build_fleet(roles):
             core,
             watchdog_s=_STATE.get("watchdog_s", 5.0),
             max_retries=_STATE.get("max_retries", 2)).start()
-        handles.append(ReplicaHandle(f"{role.value}{i}", core, role,
-                                     supervisor=sup))
+        handles.append(ReplicaHandle(name, core, role, supervisor=sup))
         sups.append(sup)
     router = FleetRouter(
         handles,
@@ -145,6 +160,7 @@ def _build_fleet(roles):
     _STATE["sup"] = sups[0]
     _STATE["router"] = router
     _STATE["core"] = handles[0].core
+    _STATE["journeys"] = journeys
 
 
 def _core():
@@ -211,12 +227,31 @@ def _core():
                 watchdog_s=_STATE.get("watchdog_s", 5.0),
                 max_retries=_STATE.get("max_retries", 2)).start()
             _STATE["core"] = core
+            _STATE["journeys"] = core._journeys
         return _STATE["core"]
 
 
 def _sup():
     _core()
     return _STATE["sup"]
+
+
+def _journeys():
+    """The fleet-wide JourneyStore: shared across all replica cores in
+    fleet mode, the single core's own store otherwise."""
+    _core()
+    return _STATE["journeys"]
+
+
+def _tracers():
+    """Every live tracer, primary replica first.  Fleet replicas carry
+    per-replica tracers, so the /traces and /trace/<rid> surfaces (and
+    the post-finish detokenize span) scan all of them."""
+    _core()
+    handles = _STATE.get("handles")
+    if handles:
+        return [h.core.tracer for h in handles]
+    return [_STATE["core"].tracer]
 
 
 def _retry_after_s() -> int:
@@ -300,23 +335,27 @@ def _error_code(e) -> int:
     return 500
 
 
-def _submit_batch(core, ids, g, timeout_s, cache_salt, adapter_id=None):
+def _submit_batch(core, ids, g, timeout_s, cache_salt, adapter_id=None,
+                  tenant=None):
     """Batchable admission: per-row through the fleet router when one
     is up (role/affinity/health-aware placement), else the single
     core's all-or-nothing submit."""
     router = _STATE.get("router")
     if router is None:
         return core.submit(ids, g, timeout_s=timeout_s,
-                           cache_salt=cache_salt, adapter_id=adapter_id)
+                           cache_salt=cache_salt, adapter_id=adapter_id,
+                           tenant=tenant)
     ids = np.asarray(ids, np.int32)
     if ids.ndim == 1:
         ids = ids[None, :]
     return [router.submit(row, g, timeout_s=timeout_s,
-                          cache_salt=cache_salt, adapter_id=adapter_id)
+                          cache_salt=cache_salt, adapter_id=adapter_id,
+                          tenant=tenant)
             for row in ids]
 
 
-def _generate(ids, g, timeout_s, cache_salt=None, adapter_id=None):
+def _generate(ids, g, timeout_s, cache_salt=None, adapter_id=None,
+              tenant=None):
     """Route one /generate body; returns (tokens [b, max_new], extra).
     ``extra["request_ids"]`` always carries the engine request ids so
     the client can fetch the span trace via ``GET /trace/<rid>``."""
@@ -333,7 +372,7 @@ def _generate(ids, g, timeout_s, cache_salt=None, adapter_id=None):
                 "repetition penalty): the exclusive dense path serves "
                 "the base model only")
         reqs = _submit_batch(core, ids, g, timeout_s, cache_salt,
-                             adapter_id=adapter_id)
+                             adapter_id=adapter_id, tenant=tenant)
         return (np.stack([r.padded_result(timeout=None) for r in reqs]),
                 {"request_ids": [r.rid for r in reqs],
                  "adapter_id": adapter_id})
@@ -349,7 +388,8 @@ def _generate(ids, g, timeout_s, cache_salt=None, adapter_id=None):
         return toks, {"speculative": True, "acceptance": acceptance,
                       "request_ids": [req.rid]}
     if core.batchable(g):
-        reqs = _submit_batch(core, ids, g, timeout_s, cache_salt)
+        reqs = _submit_batch(core, ids, g, timeout_s, cache_salt,
+                             tenant=tenant)
         return (np.stack([r.padded_result(timeout=None) for r in reqs]),
                 {"request_ids": [r.rid for r in reqs]})
     # beams / repetition penalty: exclusive dense-engine call
@@ -357,6 +397,42 @@ def _generate(ids, g, timeout_s, cache_salt=None, adapter_id=None):
                                 timeout_s=timeout_s)
     req.result(timeout=None)
     return np.asarray(req.value), {"request_ids": [req.rid]}
+
+
+def _merge_tenants(a: dict, b: dict) -> dict:
+    """Merge two per-tenant accounting sections (metrics snapshot
+    shape) for the fleet-wide /metrics view.  Requests finish on — and
+    are accounted by — exactly one replica, so sections are disjoint
+    per request and counters simply add; histograms share DEFAULT_BOUNDS
+    so their cumulative bucket counts add position-wise."""
+    out = {name: json.loads(json.dumps(t)) for name, t in a.items()}
+    for name, t in b.items():
+        cur = out.get(name)
+        if cur is None:
+            out[name] = json.loads(json.dumps(t))
+            continue
+        for k in ("requests", "attained", "tokens"):
+            cur[k] = cur.get(k, 0) + t.get(k, 0)
+        cur["parked_seconds"] = (cur.get("parked_seconds", 0.0)
+                                 + t.get("parked_seconds", 0.0))
+        cur["attainment"] = (cur["attained"] / cur["requests"]
+                             if cur.get("requests") else 0.0)
+        for bk, v in (t.get("buckets") or {}).items():
+            cur.setdefault("buckets", {})
+            cur["buckets"][bk] = cur["buckets"].get(bk, 0.0) + v
+        eh, th = cur.get("e2e") or {}, t.get("e2e") or {}
+        if eh and th:
+            eh["sum"] = eh.get("sum", 0.0) + th.get("sum", 0.0)
+            eh["count"] = eh.get("count", 0) + th.get("count", 0)
+            tb = {str(le): c for le, c in th.get("buckets", [])}
+            eh["buckets"] = [[le, c + tb.get(str(le), 0)]
+                             for le, c in eh.get("buckets", [])]
+        elif th:
+            cur["e2e"] = json.loads(json.dumps(th))
+        ex = dict(t.get("exemplars") or {})
+        ex.update(cur.get("exemplars") or {})
+        cur["exemplars"] = ex
+    return out
 
 
 def _stream_chunks(reqs, g, chunk_size):
@@ -453,6 +529,34 @@ class Handler(BaseHTTPRequestHandler):
             router = _STATE.get("router")
             if router is not None:
                 snap["router"] = router.snapshot()
+            handles = _STATE.get("handles")
+            if handles:
+                # fleet aggregation: the shared JourneyStore already
+                # makes snap["journeys"] fleet-wide; tenants finish on
+                # whichever replica served them, so their per-replica
+                # metric sections merge here, and per-replica key stats
+                # ride a "fleet" section rendered with replica labels
+                reps = []
+                merged = dict(snap.get("tenants") or {})
+                for h in handles:
+                    hsnap = (snap if h.core is core
+                             else h.core.metrics_snapshot())
+                    c = hsnap.get("counters", {})
+                    reps.append({
+                        "replica": h.name,
+                        "role": h.role.value,
+                        "submitted": c.get("submitted", 0),
+                        "completed": c.get("completed", 0),
+                        "tokens_generated": c.get("tokens_generated", 0),
+                        "queued": hsnap.get("queue_depth", 0),
+                        "active": hsnap.get("active", 0),
+                    })
+                    if h.core is not core:
+                        merged = _merge_tenants(
+                            merged, hsnap.get("tenants") or {})
+                snap["fleet"] = {"replicas": reps}
+                if merged:
+                    snap["tenants"] = merged
             compile_summary = get_compile_log().summary()
             accept = self.headers.get("Accept", "")
             # content negotiation: Prometheus scrapers say text/plain
@@ -465,7 +569,24 @@ class Handler(BaseHTTPRequestHandler):
                 snap["compile"] = compile_summary
                 self._json(200, snap)
         elif url.path == "/traces":
-            self._json(200, {"traces": _core().tracer.summaries()})
+            out = []
+            for tracer in _tracers():
+                out.extend(tracer.summaries())
+            self._json(200, {"traces": out})
+        elif url.path == "/journeys":
+            self._json(200, {"journeys": _journeys().summaries(),
+                             "summary": _journeys().summary()})
+        elif url.path.startswith("/journey/"):
+            key = url.path[len("/journey/"):]
+            fmt = parse_qs(url.query).get("format", ["json"])[0]
+            store = _journeys()
+            out = (store.to_chrome(key) if fmt == "chrome"
+                   else store.get(key))
+            if out is None:
+                self._json(404, {"error": f"no journey {key!r} "
+                                          "(evicted or never submitted)"})
+            else:
+                self._json(200, out)
         elif url.path == "/steps":
             core = _core()
             q = parse_qs(url.query)
@@ -486,7 +607,11 @@ class Handler(BaseHTTPRequestHandler):
             except ValueError:
                 self._json(400, {"error": "trace id must be an integer"})
                 return
-            tr = _core().tracer.get(rid)
+            tr = None
+            for tracer in _tracers():
+                tr = tracer.get(rid)
+                if tr is not None:
+                    break
             if tr is None:
                 self._json(404, {"error": f"no trace for request {rid} "
                                           "(evicted or never submitted)"})
@@ -541,6 +666,12 @@ class Handler(BaseHTTPRequestHandler):
             adapter_id = body.get("adapter_id")
             if adapter_id is not None:
                 adapter_id = str(adapter_id)
+            # accounting tenant for the per-tenant SLO families and the
+            # journey plane; pure observability — never part of the
+            # cache/routing salt (use cache_salt for KV isolation)
+            tenant = body.get("tenant")
+            if tenant is not None:
+                tenant = str(tenant)
         except Exception as e:
             self._json(400, {"error": f"bad request: {e!r}"})
             return
@@ -555,17 +686,21 @@ class Handler(BaseHTTPRequestHandler):
             if self.path == "/generate":
                 toks, extra = _generate(ids, g, timeout_s,
                                         cache_salt=cache_salt,
-                                        adapter_id=adapter_id)
+                                        adapter_id=adapter_id,
+                                        tenant=tenant)
                 # detokenize/serialize span appended post-finish (the
                 # tracer ring keeps completed traces mutable for this);
                 # recorded BEFORE the response bytes go out so the trace
-                # is complete the moment the client can fetch it
+                # is complete the moment the client can fetch it.  Every
+                # tracer is offered the span — add_span no-ops on the
+                # replicas that never saw the rid.
                 t_ser = time.monotonic()
                 payload = {"tokens": np.asarray(toks).tolist(), **extra}
-                tracer = _core().tracer
+                tracers = _tracers()
                 now = time.monotonic()
                 for rid in extra.get("request_ids", []):
-                    tracer.add_span(rid, "detokenize", t_ser, now)
+                    for tracer in tracers:
+                        tracer.add_span(rid, "detokenize", t_ser, now)
                 self._json(200, payload)
             elif self.path == "/generate_stream":
                 if g.num_beams > 1:
@@ -575,7 +710,8 @@ class Handler(BaseHTTPRequestHandler):
                 # submit BEFORE headers so admission errors (429/504/400)
                 # still map to status codes
                 reqs = _submit_batch(_core(), ids, g, timeout_s,
-                                     cache_salt, adapter_id=adapter_id)
+                                     cache_salt, adapter_id=adapter_id,
+                                     tenant=tenant)
                 chunks = _stream_chunks(
                     reqs, g, chunk_size=int(body.get("chunk_size", 8)))
                 self.send_response(200)
