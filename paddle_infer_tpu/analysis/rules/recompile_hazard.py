@@ -61,6 +61,16 @@ _ADAPTER_SHAPE_VALUED = frozenset({"rank", "lora_rank", "adapter_rank",
                                    "adapter_slots", "num_adapters",
                                    "n_adapters", "slot_count"})
 
+# and for the constrained-decoding plane: the grammar mask is per-row
+# DATA (a [b, V] f32 gathered host-side from the compiled FSM), so a
+# serving build_* signature taking a grammar or vocab shape re-opens a
+# per-grammar program family — 32 distinct schemas would compile 32
+# executables instead of riding the one grammar-marked mixed step.
+_GRAMMAR_SHAPE_VALUED = frozenset({"vocab_size", "n_vocab", "vocab",
+                                   "num_states", "n_states",
+                                   "grammar_states", "fsm_states",
+                                   "num_grammars", "n_grammars"})
+
 
 def _element_label(el: ast.AST) -> str:
     if isinstance(el, ast.JoinedStr):
@@ -129,6 +139,18 @@ class RecompileHazardRule(Rule):
                     "count are deployment config: bake them into the "
                     "converted layers (prepare_lora_serving) and pass "
                     "which adapter each row runs as per-row slot DATA")
+            grammar_hazards = [n for n in names
+                               if n in _GRAMMAR_SHAPE_VALUED]
+            if grammar_hazards:
+                yield ctx.finding(
+                    self.id, node,
+                    f"grammar-shape-keyed serving builder {node.name}"
+                    f"({', '.join(grammar_hazards)}) re-opens a per-"
+                    "grammar program family — vocab and FSM sizes are "
+                    "host-side compile products: gather the per-state "
+                    "allow-mask on the host and pass it as per-row "
+                    "[b, V] mask DATA into the one grammar-marked "
+                    "executable")
 
     def _check_assign(self, ctx: FileContext, node: ast.Assign):
         key_target = any(isinstance(t, ast.Name)

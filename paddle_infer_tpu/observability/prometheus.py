@@ -378,6 +378,44 @@ def render_prometheus(snapshot: dict,
                  "(fell back to the shed/replay ladder)")
         w.sample("kv_tier_swap_fails_total", kt.get("swap_fails_total", 0))
 
+    # constrained decoding (serving/structured/): the snapshot section
+    # is EngineCore._structured_snapshot() — grammar cache stats plus
+    # the core's violation/incomplete/rejection tallies
+    st = snapshot.get("structured") or {}
+    if st:
+        w.family("grammar_active_rows", "gauge",
+                 "Batch rows currently decoding under a grammar FSM")
+        w.sample("grammar_active_rows", st.get("active_rows", 0))
+        w.family("grammar_cache_entries", "gauge",
+                 "Distinct compiled grammars resident in the FSM cache")
+        w.sample("grammar_cache_entries", st.get("entries", 0))
+        w.family("grammar_cache_hits_total", "counter",
+                 "Admissions that reused a cached compiled grammar")
+        w.sample("grammar_cache_hits_total", st.get("hits", 0))
+        w.family("grammar_cache_misses_total", "counter",
+                 "Admissions that compiled a new grammar FSM")
+        w.sample("grammar_cache_misses_total", st.get("misses", 0))
+        w.family("grammar_compile_seconds_total", "counter",
+                 "Host wall seconds spent compiling grammar FSMs "
+                 "(always at admission, never under the step lock)")
+        w.sample("grammar_compile_seconds_total",
+                 st.get("compile_seconds", 0.0))
+        w.family("grammar_violations_total", "counter",
+                 "Emitted tokens that violated their row's grammar "
+                 "(0 by construction — the mask bans them; nonzero "
+                 "means the mask path is broken)")
+        w.sample("grammar_violations_total", st.get("violations", 0))
+        w.family("grammar_incomplete_finishes_total", "counter",
+                 "Constrained rows that exhausted max_new_tokens in a "
+                 "non-accepting FSM state (finished FAILED with "
+                 "GrammarIncompleteError)")
+        w.sample("grammar_incomplete_finishes_total",
+                 st.get("incomplete", 0))
+        w.family("grammar_rejections_total", "counter",
+                 "Requests refused at admission for a malformed, "
+                 "unsupported or unsatisfiable grammar spec")
+        w.sample("grammar_rejections_total", st.get("rejected", 0))
+
     px = snapshot.get("prefix_cache") or {}
     if px:
         w.family("prefix_cache_queries_total", "counter",
@@ -592,6 +630,16 @@ def render_prometheus(snapshot: dict,
                  "slot across recorded mixed steps")
         w.sample("steplog_adapter_rows_total",
                  sl.get("adapter_rows_total", 0))
+        w.family("steplog_grammar_rows_total", "counter",
+                 "Batch rows that sampled through a grammar mask "
+                 "across recorded mixed steps")
+        w.sample("steplog_grammar_rows_total",
+                 sl.get("grammar_rows_total", 0))
+        w.family("steplog_masked_tokens_total", "counter",
+                 "Vocabulary entries banned by grammar masks across "
+                 "recorded mixed steps (summed over constrained rows)")
+        w.sample("steplog_masked_tokens_total",
+                 sl.get("masked_tokens_total", 0))
         model = sl.get("decode_model") or {}
         w.family("steplog_model_abs_rel_error", "gauge",
                  "Mean absolute relative error of the fitted step-cost "

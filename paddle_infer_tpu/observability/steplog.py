@@ -98,6 +98,10 @@ _SCHEMA = (
                                  # at capture
     ("host_pages", 0),           # host-tier pages resident at capture
                                  # (parked KV + demoted prefix blocks)
+    ("grammar_rows", 0),         # grammar-constrained rows that sampled
+                                 # through a mask this step
+    ("masked_tokens", 0),        # vocab entries the grammar masks banned
+                                 # across those rows this step
 )
 SCHEMA_KEYS = tuple(k for k, _ in _SCHEMA)
 
@@ -414,6 +418,8 @@ class StepLog:
         self._moe_routed_total = 0
         self._moe_dropped_total = 0
         self._adapter_rows_total = 0
+        self._grammar_rows_total = 0
+        self._masked_tokens_total = 0
         self._by_kernel: Dict[str, int] = {}
         # (bytes_est, wall_s) for clean decode chunks — the model fit
         self._model: deque = deque(maxlen=int(model_window))
@@ -453,6 +459,8 @@ class StepLog:
             self._moe_routed_total += int(rec["moe_tokens_routed"])
             self._moe_dropped_total += int(rec["moe_tokens_dropped"])
             self._adapter_rows_total += int(rec["adapter_rows"])
+            self._grammar_rows_total += int(rec["grammar_rows"])
+            self._masked_tokens_total += int(rec["masked_tokens"])
             if rec["kernel"]:
                 self._by_kernel[rec["kernel"]] = \
                     self._by_kernel.get(rec["kernel"], 0) + 1
@@ -509,6 +517,8 @@ class StepLog:
             self._moe_routed_total = 0
             self._moe_dropped_total = 0
             self._adapter_rows_total = 0
+            self._grammar_rows_total = 0
+            self._masked_tokens_total = 0
             self._by_kernel = {}
 
     def calibration(self) -> Dict:
@@ -557,6 +567,8 @@ class StepLog:
                 "moe_tokens_routed_total": self._moe_routed_total,
                 "moe_tokens_dropped_total": self._moe_dropped_total,
                 "adapter_rows_total": self._adapter_rows_total,
+                "grammar_rows_total": self._grammar_rows_total,
+                "masked_tokens_total": self._masked_tokens_total,
             }
         out["decode_model"] = _model_summary(pairs)
         # predicted-vs-measured step wall for planner-annotated steps

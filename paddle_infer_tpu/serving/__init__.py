@@ -54,6 +54,12 @@ Layer map:
                       (``prepare_lora_serving``) adding per-row ragged
                       LoRA gathers inside the one mixed-step executable
                       (docs/SERVING.md "Multi-LoRA serving").
+  ``structured``      constrained decoding: JSON-schema / regex / JSON
+                      grammars compiled host-side to token-level FSMs
+                      (``GrammarCache``) whose per-row states thread
+                      through the one mixed-step executable as DATA —
+                      a ``[batch, vocab]`` additive mask, never a shape
+                      (docs/SERVING.md "Constrained decoding").
 
 Requests with per-request sampling configs share one decode executable:
 temperature/top-k/top-p/eos ride as *per-row arrays* (serving/programs),
@@ -61,10 +67,14 @@ so admitting a new request never recompiles the hot loop.
 """
 
 from .metrics import ServingMetrics
-from .request import (DeadlineExceededError, HandoffError, LoadShedError,
+from .request import (DeadlineExceededError, GrammarError,
+                      GrammarIncompleteError, HandoffError, LoadShedError,
                       QuarantinedError, QueueFullError, RejectedError,
                       Request, RequestQueue, RequestState,
                       effective_salt)
+from .structured import (CompiledGrammar, GrammarCache, compile_grammar,
+                         conforms, decode_text, default_vocab,
+                         grammar_digest, validate_spec)
 from .adapters import (AdapterCache, AdapterError, AdapterStore,
                        LoRAServingLinear, UnknownAdapterError,
                        adapter_layer_spec, lora_serving_info,
@@ -115,6 +125,16 @@ __all__ = [
     "moe_serving_info",
     "prepare_moe_serving",
     "serving_capacity",
+    "CompiledGrammar",
+    "GrammarCache",
+    "GrammarError",
+    "GrammarIncompleteError",
+    "compile_grammar",
+    "conforms",
+    "decode_text",
+    "default_vocab",
+    "grammar_digest",
+    "validate_spec",
     "EngineCore",
     "Request",
     "RequestQueue",

@@ -64,11 +64,14 @@ from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
 from .programs import (build_decode, build_mixed_step, build_page_copy,
                        build_prefill, build_prefix_prefill)
-from .request import (DeadlineExceededError, HandoffError, LoadShedError,
+from .request import (DeadlineExceededError, GrammarError,
+                      GrammarIncompleteError, HandoffError, LoadShedError,
                       QuarantinedError, QueueFullError, RejectedError,
                       Request, RequestQueue, RequestState)
 from .resilience.faultplane import (InjectedFault, InjectedMemoryError,
                                     NULL_PLANE)
+from .structured import GrammarCache
+from .structured import runtime as grammar_rt
 
 _log = logging.getLogger(__name__)
 
@@ -115,7 +118,8 @@ class EngineCore:
                  kv_park_watermark: float = 0.95,
                  kv_resume_watermark: float = 0.70,
                  journeys: Optional[JourneyStore] = None,
-                 replica_name: Optional[str] = None):
+                 replica_name: Optional[str] = None,
+                 grammar_vocab=None):
         # sharded serving plane (serving/sharded/): when a ServingMesh is
         # handed in, re-validate it against THIS core's feature flags so
         # incompatible combos (quantized wire + speculation/prefix cache)
@@ -302,6 +306,35 @@ class EngineCore:
             self._lora = {"slots": self._adapters.slots,
                           "rank": self._adapters.rank,
                           "layers": n_lora}
+        # constrained decoding (serving/structured/): grammars compile
+        # host-side at ADMISSION into token-level FSMs cached by spec
+        # digest; per-row fsm_state is plain int DATA and the mixed
+        # step gains exactly one [max_batch, vocab] mask input.  The
+        # vocab (token id -> surface string) is a deployment constant,
+        # so the executable key only grows the static "grammar" marker
+        # — never a per-grammar shape (analysis/rules/recompile_hazard
+        # enforces this).
+        self._grammar: Optional[GrammarCache] = None
+        if grammar_vocab is not None:
+            if not self._ragged:
+                raise ShardedConfigError(
+                    "structured decoding requires ragged=True: the "
+                    "grammar mask rides the mixed step's data inputs; "
+                    "the legacy program families have no mask input")
+            vs = getattr(getattr(engine._model, "config", None),
+                         "vocab_size", None)
+            if vs is not None and len(grammar_vocab) != int(vs):
+                raise ValueError(
+                    f"grammar_vocab has {len(grammar_vocab)} entries but "
+                    f"the model's vocab_size is {int(vs)}")
+            self._grammar = GrammarCache(grammar_vocab)
+        # engine-lifetime structured counters: violations/incomplete
+        # mutate under the step lock; admission rejects are counted by
+        # the submitting thread (int += is GIL-coherent for gauges)
+        self._grammar_violations = 0
+        self._grammar_incomplete = 0
+        self._grammar_rejected = 0
+
         # prefix_cache_headroom_pages widens the pool BEYOND the
         # worst-case live reservations (slots x max_pages) without
         # widening any slot's page table: live rows can never reach the
@@ -677,7 +710,27 @@ class EngineCore:
             kv_tier=(self._kv_tier.summary()
                      if self._kv_tier is not None else None),
             sched=self._sched_snapshot(),
-            journeys=self._journeys.summary())
+            journeys=self._journeys.summary(),
+            structured=self._structured_snapshot())
+
+    def _structured_snapshot(self) -> Optional[dict]:
+        """The ``structured`` metrics section: engine counters under
+        the step lock, cache counters under the cache's own leaf lock
+        (taken strictly AFTER the step lock is released — the compile
+        cache must never nest inside the step path)."""
+        if self._grammar is None:
+            return None
+        with self._step_lock:
+            out = {
+                "active_rows": sum(
+                    1 for s in self._slots
+                    if s is not None and s.get("fsm") is not None),
+                "violations": int(self._grammar_violations),
+                "incomplete": int(self._grammar_incomplete),
+                "rejected": int(self._grammar_rejected),
+            }
+        out.update(self._grammar.summary())
+        return out
 
     # ------------------------------------------------------- trace hooks
     def _trace_end(self, req: Request, state: RequestState):
@@ -726,12 +779,36 @@ class EngineCore:
                 f"unknown adapter {adapter_id!r}: not registered in the "
                 "adapter store")
 
+    def _validate_grammar(self, grammar):
+        """Submit-time grammar validation + compile: malformed,
+        unsupported or unsatisfiable specs die HERE (GrammarError →
+        HTTP 400) before the request costs a queue slot, a KV page or
+        an adapter pin — nothing to unwind on rejection.  Returns the
+        cached ``CompiledGrammar`` (None for unconstrained requests).
+        The compile runs on the SUBMITTING thread, never under the
+        step lock."""
+        if grammar is None:
+            return None
+        if self._grammar is None:
+            self._grammar_rejected += 1
+            self._metrics.on_rejected()
+            raise GrammarError(
+                "request carries grammar= but this engine serves no "
+                "grammars (construct EngineCore with grammar_vocab=)")
+        try:
+            return self._grammar.get_or_compile(grammar)
+        except GrammarError:
+            self._grammar_rejected += 1
+            self._metrics.on_rejected()
+            raise
+
     def submit(self, input_ids, config: GenerationConfig = None,
                attention_mask=None,
                timeout_s: Optional[float] = None,
                cache_salt: Optional[str] = None,
                adapter_id: Optional[str] = None,
-               tenant: Optional[str] = None) -> List[Request]:
+               tenant: Optional[str] = None,
+               grammar: Optional[dict] = None) -> List[Request]:
         """Enqueue one request per row of ``input_ids`` ([b, plen] or
         [plen]).  All-or-nothing: admission errors (too long, queue
         full, not batchable) reject the whole call.  Returns the per-row
@@ -743,12 +820,23 @@ class EngineCore:
             raise LoadShedError("serving engine is draining; retry "
                                 "against another replica")
         self._validate_adapter_id(adapter_id)
+        grammar_fsm = self._validate_grammar(grammar)
         g = config or GenerationConfig()
         if not self.batchable(g):
             self._metrics.on_rejected()
             raise RejectedError(
                 "config not batchable (beams/repetition_penalty); route "
                 "through submit_exclusive")
+        if grammar is not None and g.min_length > 0:
+            # the min-length EOS ban and the FSM's EOS-only-in-accept
+            # rule can contradict (a complete grammar with a banned EOS
+            # has no legal token) — refuse the combination up front
+            self._grammar_rejected += 1
+            self._metrics.on_rejected()
+            raise GrammarError(
+                "grammar= with min_length > 0 is unsupported: the "
+                "min-length EOS ban can contradict the grammar's "
+                "accept-state EOS rule")
         ids = np.asarray(input_ids, np.int32)
         if ids.ndim == 1:
             ids = ids[None, :]
@@ -767,8 +855,11 @@ class EngineCore:
             rows.append(row)
         timeout_s = self._default_timeout if timeout_s is None else timeout_s
         reqs = [Request(row, g, timeout_s=timeout_s, cache_salt=cache_salt,
-                        adapter_id=adapter_id, tenant=tenant)
+                        adapter_id=adapter_id, tenant=tenant,
+                        grammar=grammar)
                 for row in rows]
+        for req in reqs:
+            req.grammar_fsm = grammar_fsm
         try:
             self._queue.submit_many(reqs)
         except QueueFullError:
@@ -836,6 +927,9 @@ class EngineCore:
                 f"{g.max_new_tokens} exceeds max_model_len "
                 f"{self._max_model_len}")
         self._validate_adapter_id(req.adapter_id)
+        # re-validate + re-compile on THIS replica's cache: the fleet
+        # ships the grammar spec as plain data, never FSM objects
+        req.grammar_fsm = self._validate_grammar(req.grammar)
         req._requeue()
         self._queue.submit(req)
         self._metrics.on_submitted()
@@ -1234,13 +1328,22 @@ class EngineCore:
                 self._admit_failure(req, e)
                 return
             req._mark_active()
+            # per-row FSM state is a pure function of the emitted
+            # stream: advance from start through req.tokens (skipping
+            # EOS).  Fresh admissions start at the start state; replays
+            # recompute the exact state the lost slot held.
+            fsm_state = None
+            gfsm = getattr(req, "grammar_fsm", None)
+            if gfsm is not None:
+                fsm_state, _ = grammar_rt.advance_many(
+                    gfsm, gfsm.start, req.tokens, g.eos_token_id)
             self._slots[sid] = {
                 "req": req, "sid": sid, "g": g,
                 "length": int(req.prompt.size), "plen": suffix,
                 "emitted": already, "steps_base": already,
                 "last_tok": 0, "last_emit": admit_t,
                 "table": table, "key": key, "match": match,
-                "adapter_slot": aslot,
+                "adapter_slot": aslot, "fsm": fsm_state,
                 "span_end": prefill_t, "full": full,
                 # host-side numpy slice of the staged prompt, no device sync
                 # tpulint: disable-next-line=host-sync -- host-side prompt/token-history assembly; req.tokens are already-emitted Python ints, not device arrays
@@ -1553,6 +1656,14 @@ class EngineCore:
             # constants in the key; which adapter a row decodes under
             # stays per-row data and never recompiles
             mkey = mkey + (self._lora["slots"], self._lora["rank"])
+        grammar_on = self._grammar is not None
+        if grammar_on:
+            # grammars are per-row DATA: the key records only the
+            # static fact that this deployment threads a mask input.
+            # Which grammar (if any) each row decodes under never
+            # touches the key — 32 distinct grammars churn through one
+            # executable (the churn fuzz proves it)
+            mkey = mkey + ("grammar",)
         # StepPlanner: this step's per-row prompt-chunk cap + predicted
         # wall.  Static plans (fifo policy, cold fit, or no ITL SLO)
         # return cap == self._prefill_chunk, keeping the packing below
@@ -1627,6 +1738,17 @@ class EngineCore:
                 proposal = self._draft_source.propose(
                     history, k_cap, salt=req.route_salt(),
                     deterministic_only=bool(s["g"].do_sample))
+                if s.get("fsm") is not None:
+                    # constrained row: truncate the proposal at the
+                    # first FSM-invalid token (and before any draft
+                    # that EXHAUSTS the grammar — the finishing token
+                    # must be the verified cut token so no lane ever
+                    # samples from a no-continuation state).  The
+                    # verify-side per-lane masks reject violations
+                    # anyway; filtering just stops wasting budget.
+                    proposal = grammar_rt.filter_drafts(
+                        req.grammar_fsm, s["fsm"], proposal,
+                        s["g"].eos_token_id)
                 k_row = min(len(proposal), k_cap)
                 if k_row <= 0:
                     continue
@@ -1638,6 +1760,42 @@ class EngineCore:
                 spec[i] = True
                 budget -= k_row
                 drafted[i] = k_row
+        # grammar masks: one [b, V] ([b, W, V] speculative) additive
+        # f32 buffer gathered host-side from each constrained row's FSM
+        # state — lane j masked by the state advanced through drafts
+        # 0..j-1; plain rows replicate their current-state mask across
+        # lanes; unconstrained rows ride all-zero rows.  Shape depends
+        # only on deployment constants, so the executable never sees
+        # which grammars are in the batch.
+        gmask = None
+        grammar_rows_step = 0
+        masked_tokens_step = 0
+        if grammar_on:
+            V = len(self._grammar.vocab)
+            gmask = np.zeros((b, V) if W <= 1 else (b, W, V), np.float32)
+            for s in active:
+                i = s["sid"]
+                if s.get("fsm") is None or qlens[i] == 0:
+                    continue
+                gf = s["req"].grammar_fsm
+                eos_id = s["g"].eos_token_id
+                if W > 1:
+                    if spec[i]:
+                        lanes = grammar_rt.lane_masks(
+                            gf, s["fsm"],
+                            [int(t) for t in ids[i, 1:qlens[i]]],
+                            W, eos_id)
+                    else:
+                        lanes = np.broadcast_to(
+                            grammar_rt.mask_row(gf, s["fsm"], eos_id),
+                            (W, V))
+                    gmask[i] = lanes
+                else:
+                    gmask[i] = grammar_rt.mask_row(gf, s["fsm"], eos_id)
+                if sample_now[i]:
+                    grammar_rows_step += 1
+                    masked_tokens_step += grammar_rt.masked_count(
+                        gf, s["fsm"], eos_id)
         draft_tokens_step = sum(drafted.values())
         prefill_tokens_step = sum(chunk_taken.values())
         n_decode = len(decode_rows)
@@ -1652,15 +1810,20 @@ class EngineCore:
             fault = self._fault.fire(
                 "decode.step", rids=[s["req"].rid for s in active])
             moe_out = ()
+            # the optional mask input sits between keys and scratch —
+            # absent entirely on non-grammar deployments, so their
+            # executable signatures are byte-identical to before
+            gextra = (gmask,) if grammar_on else ()
             if W > 1:
                 res = eng.run_paged_program(
                     mkey, lambda: build_mixed_step(eng, b, C,
                                                    self._max_pages,
                                                    spec_window=W,
                                                    moe_stats=moe
-                                                   is not None),
+                                                   is not None,
+                                                   grammar=grammar_on),
                     ids, qlens, ctx, steps0, sample_now, aslots, spec,
-                    tables, self._samp_arrays(cfgs), keys,
+                    tables, self._samp_arrays(cfgs), keys, *gextra,
                     # scratch page id is a host int, no device sync
                     # tpulint: disable-next-line=host-sync -- speculative scratch readback at the verification boundary; verification is a host decision
                     np.asarray(self._scratch, np.int32))
@@ -1673,9 +1836,10 @@ class EngineCore:
                     mkey, lambda: build_mixed_step(eng, b, C,
                                                    self._max_pages,
                                                    moe_stats=moe
-                                                   is not None),
+                                                   is not None,
+                                                   grammar=grammar_on),
                     ids, qlens, ctx, steps0, sample_now, aslots, tables,
-                    self._samp_arrays(cfgs), keys,
+                    self._samp_arrays(cfgs), keys, *gextra,
                     # scratch page id is a host int, no device sync
                     # tpulint: disable-next-line=host-sync -- speculative scratch readback at the verification boundary; verification is a host decision
                     np.asarray(self._scratch, np.int32))
@@ -1823,8 +1987,35 @@ class EngineCore:
                                      step=self._step_idx, chunk_steps=1,
                                      tokens=int(t_row.size))
                 s["span_end"] = now
-            if sampled and (bool(fin_out[i])
-                            or s["emitted"] >= s["g"].max_new_tokens):
+            if sampled and s.get("fsm") is not None:
+                gf = req.grammar_fsm
+                if t_row.size:
+                    # FSM state stays a pure function of emitted tokens:
+                    # re-fold the accepted row output (masking makes
+                    # violations impossible; count defensively anyway)
+                    s["fsm"], viol = grammar_rt.advance_many(
+                        gf, s["fsm"], t_row, s["g"].eos_token_id)
+                    self._grammar_violations += viol
+                if bool(fin_out[i]) or gf.complete(s["fsm"]):
+                    # EOS (mask-legal only in accept states) or the
+                    # grammar has no continuation: stream is complete
+                    self._evict(s, RequestState.DONE)
+                    evicted.append(req.rid)
+                elif s["emitted"] >= s["g"].max_new_tokens:
+                    if gf.accepting(s["fsm"]):
+                        self._evict(s, RequestState.DONE)
+                    else:
+                        self._grammar_incomplete += 1
+                        self._evict(s, RequestState.FAILED,
+                                    GrammarIncompleteError(
+                                        f"request {req.rid} exhausted "
+                                        f"max_new_tokens="
+                                        f"{s['g'].max_new_tokens} in "
+                                        f"non-accepting FSM state "
+                                        f"{int(s['fsm'])}"))
+                    evicted.append(req.rid)
+            elif sampled and (bool(fin_out[i])
+                              or s["emitted"] >= s["g"].max_new_tokens):
                 self._evict(s, RequestState.DONE)
                 evicted.append(req.rid)
         if emitted_decode:
@@ -1878,6 +2069,8 @@ class EngineCore:
                          if self._kv_tier is not None else 0),
             host_pages=(self._kv_tier.resident_pages
                         if self._kv_tier is not None else 0),
+            grammar_rows=grammar_rows_step,
+            masked_tokens=masked_tokens_step,
             **moe_kw)
         if self._recovery is not None:
             self._recovery.on_step_ok()
@@ -2273,6 +2466,11 @@ class EngineCore:
             "kv_len": kv_len, "kv_tokens": kv_tokens,
             "k_host": k_host, "v_host": v_host, "page": page,
             "salt": req.cache_salt, "adapter_id": req.adapter_id,
+            # FSM state is a plain int riding the packet as data —
+            # resume re-attaches it without recompiling the grammar
+            "grammar": req.grammar,
+            "fsm_state": (int(s["fsm"]) if s.get("fsm") is not None
+                          else None),
             # journey context rides the packet as plain data so a
             # parked row keeps its cross-replica identity (the tier
             # stores packets opaquely; drain/inspection tools see it)
@@ -2429,7 +2627,8 @@ class EngineCore:
             "last_tok": int(packet["last_tok"]), "last_emit": now,
             "table": table, "key": key, "match": None,
             "adapter_slot": aslot, "span_end": now, "full": full,
-            "pending": packet["pending"], "ctx": int(packet["ctx"])}
+            "pending": packet["pending"], "ctx": int(packet["ctx"]),
+            "fsm": packet.get("fsm_state")}
         tier.complete_resume(rid)
         wall = now - t0
         bts, fl, src_tag = self._cost_model.estimate(
@@ -2583,6 +2782,12 @@ class EngineCore:
                 # adapter binding travels WITH the KV: the importer must
                 # pin the same fine-tune before the row decodes there
                 "adapter_id": req.adapter_id,
+                # grammar + FSM state travel as plain data; the importer
+                # recompiles (or cache-hits) the grammar and re-attaches
+                # the int state — the stream stays bitwise-identical
+                "grammar": req.grammar,
+                "fsm_state": (int(s["fsm"]) if s.get("fsm") is not None
+                              else None),
             }
             self._slots[sid] = None
             # unpin here, re-pin on the importer: the source keeps the
@@ -2626,6 +2831,23 @@ class EngineCore:
         available or the pool geometry differs."""
         req: Request = packet["req"]
         g = packet["g"]
+        # re-attach (cache hit) or recompile the grammar binding BEFORE
+        # taking the step lock: FSM compilation is host work that must
+        # never stall the decode loop, and a target that can't serve
+        # the grammar refuses the whole handoff with the source slot
+        # still intact
+        if packet.get("grammar") is not None:
+            if self._grammar is None:
+                raise HandoffError(
+                    f"request {req.rid} is grammar-constrained but "
+                    "the target replica serves no grammars")
+            try:
+                req.grammar_fsm = self._grammar.get_or_compile(
+                    packet["grammar"])
+            except GrammarError as e:
+                raise HandoffError(
+                    f"target replica cannot compile grammar for "
+                    f"request {req.rid}: {e}") from e
         with self._step_lock:
             if self._closed:
                 raise HandoffError("serving engine is closed")
@@ -2735,7 +2957,8 @@ class EngineCore:
                 "table": table, "key": key, "match": None,
                 "adapter_slot": aslot,
                 "span_end": now, "full": full,
-                "pending": packet["pending"], "ctx": int(packet["ctx"])}
+                "pending": packet["pending"], "ctx": int(packet["ctx"]),
+                "fsm": packet.get("fsm_state")}
             wall = now - t0
             bts, fl, src_tag = self._cost_model.estimate(
                 "page_copy", pages_touched=n_pages)

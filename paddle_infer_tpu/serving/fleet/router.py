@@ -115,7 +115,8 @@ class FleetRouter:
                timeout_s: Optional[float] = None,
                cache_salt: Optional[str] = None,
                adapter_id: Optional[str] = None,
-               tenant: Optional[str] = None) -> Request:
+               tenant: Optional[str] = None,
+               grammar: Optional[dict] = None) -> Request:
         """Route ONE prompt (1-D token array) to a replica and return
         its ``Request`` handle.  Raises ``LoadShedError`` (a
         ``RejectedError``, but retryable — a fully draining fleet is an
@@ -125,7 +126,9 @@ class FleetRouter:
         long, unknown adapter) propagate from the chosen core.
         ``adapter_id`` joins the routing salt — affinity never steers an
         adapter tenant onto another tenant's cached prefix — and rides
-        handoff packets so the binding survives migration."""
+        handoff packets so the binding survives migration.  ``grammar``
+        compiles (or cache-hits) on the chosen replica at admission and
+        its per-row FSM state rides handoff packets as plain data."""
         ids = np.asarray(prompt, np.int32).reshape(-1)
         g = config or GenerationConfig()
         serving = self._serving()
@@ -144,7 +147,7 @@ class FleetRouter:
         req = handle.core.submit(ids, g, timeout_s=timeout_s,
                                  cache_salt=cache_salt,
                                  adapter_id=adapter_id,
-                                 tenant=tenant)[0]
+                                 tenant=tenant, grammar=grammar)[0]
         handle.dispatched += 1
         if reason == "affinity":
             handle.affinity_hits += 1

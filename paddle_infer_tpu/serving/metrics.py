@@ -313,7 +313,8 @@ class ServingMetrics:
                  adapters: Optional[Dict] = None,
                  sched: Optional[Dict] = None,
                  kv_tier: Optional[Dict] = None,
-                 journeys: Optional[Dict] = None) -> Dict:
+                 journeys: Optional[Dict] = None,
+                 structured: Optional[Dict] = None) -> Dict:
         """Render everything to a plain dict (the ``GET /metrics`` JSON
         body).  Latency series carry lifetime ``count``/``mean`` plus
         reservoir-window ``p50_recent``/``p99_recent``/``max_recent``
@@ -349,7 +350,11 @@ class ServingMetrics:
         ``JourneyStore.summary()`` (finished-journey count, hop total,
         mean attribution coverage, aggregate bucket seconds) — the
         per-tenant SLO section is internal (fed by ``on_journey``) and
-        rides along whenever any tenant finished a request."""
+        rides along whenever any tenant finished a request;
+        ``structured`` is the core's constrained-decoding section
+        (grammar cache entries/hits/misses/compile seconds, active
+        constrained rows, violation/incomplete/rejected tallies) when
+        the core serves grammars."""
         tps = self.tokens_per_second()
         with self._lock:
             out = {
@@ -425,6 +430,8 @@ class ServingMetrics:
                 out["kv_tier"] = dict(kv_tier)
             if journeys is not None:
                 out["journeys"] = dict(journeys)
+            if structured is not None:
+                out["structured"] = dict(structured)
             if self._tenants:
                 out["tenants"] = {
                     name: {

@@ -90,7 +90,7 @@ def _pick_rows(proc, samp, steps, keys):
 
 
 def build_mixed_step(engine, max_batch, token_budget, max_pages,
-                     spec_window=1, moe_stats=False):
+                     spec_window=1, moe_stats=False, grammar=False):
     """THE ragged serving executable: one launch per scheduler step,
     whatever the batch composition.  Row ``b`` carries ``qlens[b]``
     query tokens starting at absolute position ``ctx[b]`` — 1 for a
@@ -164,7 +164,26 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
     pools — ``(…, moe_routed[E] i32, moe_dropped i32, moe_aux f32, …)``
     — so capacity-overflow drops are surfaced per step, never silent.
     The stats ride the same trace (data outputs, no shape impact), so
-    the one-executable invariant is untouched."""
+    the one-executable invariant is untouched.
+
+    ``grammar = True`` (EngineCore sets it when constructed with a
+    ``grammar_vocab``) threads ONE extra input between ``keys`` and
+    ``scratch``: an additive logit mask — ``gmask[b, V]`` here,
+    ``gmask[b, W, V]`` for the speculative variant, always f32 with 0
+    for allowed and ``sampling.NEG_INF`` for banned entries.  The mask
+    is pure per-row DATA gathered host-side from each row's FSM state
+    (serving/structured/), applied to the last-position logits BEFORE
+    the processor chain, so constrained greedy stays masked-argmax
+    exact and constrained sampling draws from the renormalized masked
+    distribution under the unchanged ``fold_in`` streams.  Speculative
+    lane ``j`` is masked by its OWN advanced FSM state (the engine
+    builds lane masks by advancing through drafts ``0..j-1``), which —
+    together with accept/resample operating on the masked logits — is
+    what makes constrained spec-vs-plain bitwise identical and keeps
+    lanes from ever emitting a violating token.  Unconstrained rows
+    carry all-zero mask rows.  Deployments without a grammar vocab get
+    the ``grammar=False`` signatures below VERBATIM — same arity, same
+    donation indices, same executable key."""
     L = engine._num_layers
     C = token_budget
 
@@ -192,7 +211,7 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
             return logits, caches, col.totals()
 
     def run(params, ids, qlens, ctx, steps0, sample_now, adapter_slots,
-            tables, samp, keys, scratch, k_pages, v_pages):
+            tables, samp, keys, gmask, scratch, k_pages, v_pages):
         b = ids.shape[0]
         caches = [(k_pages[i], v_pages[i], tables, ctx, qlens, scratch)
                   for i in range(L)]
@@ -209,6 +228,8 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
             params, ids, pos2d, caches, qlens, i2d, adapter_slots)
         last = jnp.take_along_axis(
             logits, jnp.maximum(qlens - 1, 0)[:, None, None], axis=1)[:, 0]
+        if grammar:
+            last = last + gmask
         proc = _process_rows(last, samp, steps0)
         tok = _pick_rows(proc, samp, steps0, keys)
         tok = jnp.where(sample_now, tok, samp["pad"])
@@ -220,13 +241,23 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
 
     W = int(spec_window)
     if W <= 1:
-        return jax.jit(run, donate_argnums=(11, 12))
+        if grammar:
+            return jax.jit(run, donate_argnums=(12, 13))
+
+        def run_plain(params, ids, qlens, ctx, steps0, sample_now,
+                      adapter_slots, tables, samp, keys, scratch,
+                      k_pages, v_pages):
+            return run(params, ids, qlens, ctx, steps0, sample_now,
+                       adapter_slots, tables, samp, keys, None,
+                       scratch, k_pages, v_pages)
+
+        return jax.jit(run_plain, donate_argnums=(11, 12))
 
     from ..inference import spec_accept
 
     def run_spec(params, ids, qlens, ctx, steps0, sample_now,
-                 adapter_slots, spec, tables, samp, keys, scratch,
-                 k_pages, v_pages):
+                 adapter_slots, spec, tables, samp, keys, gmask,
+                 scratch, k_pages, v_pages):
         b = ids.shape[0]
         spec2d = jnp.broadcast_to(spec[:, None], (b, W))
         caches = [(k_pages[i], v_pages[i], tables, ctx, qlens, scratch,
@@ -245,6 +276,8 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
         gidx = jnp.where(spec[:, None], jnp.minimum(j, base[:, None]),
                          base[:, None])                        # [b, W]
         lg_w = jnp.take_along_axis(logits, gidx[:, :, None], axis=1)
+        if grammar:
+            lg_w = lg_w + gmask
         steps_w = steps0[:, None] + jnp.where(spec[:, None], j, 0)
         proc_w = jax.vmap(_process_rows, in_axes=(1, None, 1),
                           out_axes=1)(lg_w, samp, steps_w)     # [b, W, V]
@@ -322,7 +355,17 @@ def build_mixed_step(engine, max_batch, token_budget, max_pages,
         return (out, n_emit, fin, *moe_out,
                 [c[0] for c in caches], [c[1] for c in caches])
 
-    return jax.jit(run_spec, donate_argnums=(12, 13))
+    if grammar:
+        return jax.jit(run_spec, donate_argnums=(13, 14))
+
+    def run_spec_plain(params, ids, qlens, ctx, steps0, sample_now,
+                       adapter_slots, spec, tables, samp, keys,
+                       scratch, k_pages, v_pages):
+        return run_spec(params, ids, qlens, ctx, steps0, sample_now,
+                        adapter_slots, spec, tables, samp, keys, None,
+                        scratch, k_pages, v_pages)
+
+    return jax.jit(run_spec_plain, donate_argnums=(12, 13))
 
 
 # legacy ragged=False path: one executable per plen bucket is the

@@ -53,6 +53,20 @@ class HandoffError(RejectedError):
     released, import before the target reserves anything."""
 
 
+class GrammarError(RejectedError):
+    """Malformed, unsupported or unsatisfiable ``grammar=`` spec,
+    refused at ADMISSION (HTTP 400) — before any KV page is reserved
+    or adapter pinned, so bad structured-output input never leaks a
+    resource (serving/structured/)."""
+
+
+class GrammarIncompleteError(RuntimeError):
+    """A grammar-constrained row exhausted ``max_new_tokens`` while its
+    FSM was NOT in an accept state: the stream is a valid prefix but
+    not a complete instance of the grammar.  The row finishes FAILED
+    with this error instead of silently delivering invalid output."""
+
+
 def effective_salt(cache_salt, adapter_id):
     """Compose the prefix-cache / routing isolation key from a tenant
     salt and an adapter binding.  Two tenants sharing a system prompt
@@ -87,7 +101,8 @@ class Request:
                  exclusive_fn: Optional[Callable] = None,
                  cache_salt: Optional[str] = None,
                  adapter_id: Optional[str] = None,
-                 tenant: Optional[str] = None):
+                 tenant: Optional[str] = None,
+                 grammar: Optional[dict] = None):
         self.rid = next(_rid_counter)
         self.prompt = (None if prompt is None
                        else np.asarray(prompt, np.int32).reshape(-1))
@@ -104,6 +119,12 @@ class Request:
         # SLO families and journey summaries.  Deliberately NOT part of
         # route_salt() — it must never perturb scheduling or caching.
         self.tenant = tenant
+        # constrained decoding (serving/structured/): the grammar SPEC
+        # (a plain dict — rides park/handoff packets as data); the
+        # compiled FSM is attached at admission by the serving engine
+        # and re-attached after a cross-replica move.
+        self.grammar = grammar
+        self.grammar_fsm = None
         self.exclusive_fn = exclusive_fn
         self.arrival = time.monotonic()
         self.deadline = (None if timeout_s is None

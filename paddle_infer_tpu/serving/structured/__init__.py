@@ -1,0 +1,50 @@
+"""Constrained decoding: grammars as data-only token masks.
+
+Layer map::
+
+    grammar.py   spec validation + JSON-schema/JSON-mode -> regex
+    fsm.py       regex -> char DFA -> token FSM (numpy, host-side)
+    cache.py     GrammarCache keyed by spec digest (leaf lock)
+    runtime.py   per-row advance / mask / draft-filter / conformance
+
+The serving engine compiles grammars at ADMISSION via GrammarCache,
+threads per-row ``fsm_state`` ints through slots, park packets and
+handoff packets, and applies ``[batch, vocab]`` masks inside the one
+mixed-step executable — constraints never touch an executable shape.
+"""
+
+from .cache import GrammarCache
+from .fsm import (CompiledGrammar, TokenFSM, compile_char_dfa,
+                  compile_grammar, lift_token_fsm)
+from .grammar import (GRAMMAR_TYPES, MAX_SCHEMA_BYTES, canonical_json,
+                      grammar_digest, grammar_regex, validate_spec)
+from .runtime import (advance, advance_many, conforms, decode_text,
+                      default_vocab, filter_drafts, lane_masks,
+                      lane_states, mask_row, masked_count,
+                      validate_instance)
+
+__all__ = [
+    "GRAMMAR_TYPES",
+    "MAX_SCHEMA_BYTES",
+    "CompiledGrammar",
+    "GrammarCache",
+    "TokenFSM",
+    "advance",
+    "advance_many",
+    "canonical_json",
+    "compile_char_dfa",
+    "compile_grammar",
+    "conforms",
+    "decode_text",
+    "default_vocab",
+    "filter_drafts",
+    "grammar_digest",
+    "grammar_regex",
+    "lane_masks",
+    "lane_states",
+    "lift_token_fsm",
+    "mask_row",
+    "masked_count",
+    "validate_instance",
+    "validate_spec",
+]

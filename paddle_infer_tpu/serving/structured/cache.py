@@ -1,0 +1,80 @@
+"""Grammar compile cache: one CompiledGrammar per distinct spec.
+
+The cache is keyed by ``grammar_digest`` (sha256 of the canonical spec
+JSON) so 32 distinct grammars churning through one deployment compile
+exactly 32 times and the mixed-step executable never recompiles — the
+FSM is data, the cache only saves host CPU.
+
+Lock discipline (see tools/lock_graph_baseline.json): ``_lock`` is a
+LEAF.  Compilation runs OUTSIDE the lock with a double-checked insert,
+so the lock only ever guards dict/counter updates and can never nest
+another lock inside it.  All lookups happen at ADMISSION (submit /
+enqueue / the top of ``import_handoff``), never under the owning
+core's ``_step_lock`` — the one committed ``_step_lock -> _lock``
+edge is cross-instance: a SOURCE replica's stepping thread migrating
+a row calls the destination's ``import_handoff``, which hits the
+destination's cache before the destination lock is taken.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from .fsm import compile_grammar
+from .grammar import grammar_digest, validate_spec
+
+
+class GrammarCache:
+    def __init__(self, vocab, max_entries=128):
+        self._vocab = list(vocab)
+        self._max_entries = int(max_entries)
+        self._lock = threading.Lock()
+        self._entries = OrderedDict()  # digest -> CompiledGrammar
+        self._hits = 0
+        self._misses = 0
+        self._compile_seconds = 0.0
+
+    @property
+    def vocab(self):
+        return self._vocab
+
+    def get_or_compile(self, spec):
+        """Return the CompiledGrammar for ``spec``, compiling on miss.
+
+        Raises GrammarError (from validate_spec / compile_grammar) on
+        malformed or unsatisfiable input — callers surface that as an
+        admission rejection before any resource is reserved.
+        """
+        spec = validate_spec(spec)
+        digest = grammar_digest(spec)
+        with self._lock:
+            hit = self._entries.get(digest)
+            if hit is not None:
+                self._entries.move_to_end(digest)
+                self._hits += 1
+                return hit
+        compiled = compile_grammar(spec, self._vocab)
+        with self._lock:
+            raced = self._entries.get(digest)
+            if raced is not None:
+                # Lost a compile race: keep the first insert so every
+                # row sharing the grammar shares one FSM object.
+                self._hits += 1
+                return raced
+            self._misses += 1
+            self._compile_seconds += compiled.compile_seconds
+            self._entries[digest] = compiled
+            while len(self._entries) > self._max_entries:
+                self._entries.popitem(last=False)
+        return compiled
+
+    def summary(self):
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "hits": self._hits,
+                "misses": self._misses,
+                "compile_seconds": self._compile_seconds,
+                "vocab_size": len(self._vocab),
+            }

@@ -1,0 +1,431 @@
+"""Regex -> char DFA -> token-level FSM compiler (host-side, numpy).
+
+The pipeline is compiled ONCE per distinct grammar (cached by hash in
+cache.py) and produces pure DATA:
+
+  transitions : int32  [S, V]   next state per (state, token), -1 banned
+  allow       : bool   [S, V]   transitions >= 0
+  accept      : bool   [S]      char-DFA accept states
+  neg_mask    : float32 [S, V]  0 where allowed, NEG_INF where banned
+
+The serving step gathers ``neg_mask[state]`` per row and adds it to the
+last-position logits inside the one mixed-step executable — the mask is
+always ``[batch, vocab]`` shaped, so the executable key never sees the
+grammar (zero post-warmup recompiles; see analysis/rules/recompile_hazard).
+
+Regex subset: literals, escapes (\\d \\w \\s and escaped specials),
+``.``, classes ``[...]`` with ranges/negation, ``* + ?`` and bounded
+``{m}``/``{m,n}``/``{m,}`` repetition, alternation ``|`` and groups
+``(...)``.  The alphabet is printable ASCII (0x20..0x7E); multi-char
+vocab tokens are lifted by simulating their byte sequence through the
+char DFA, so the FSM is exact for any tokenizer.
+
+After the lift a co-accessibility trim bans every transition into a
+state that cannot reach accept under THIS deployment's vocab; the
+invariant handed to the runtime is therefore: every reachable state is
+accepting or has >= 1 allowed token.  A start state that fails the trim
+means the grammar is unsatisfiable under the vocab and is refused at
+admission (GrammarError), never discovered mid-generation.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ...inference.sampling import NEG_INF
+from ..request import GrammarError
+from .grammar import grammar_digest, grammar_regex, validate_spec
+
+ALPHABET = tuple(chr(c) for c in range(32, 127))
+_ALPHASET = frozenset(ALPHABET)
+MAX_DFA_STATES = 4096
+MAX_REP = 64
+
+_DIGITS = frozenset("0123456789")
+_WORD = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_")
+_SPACE = frozenset(" \t")
+
+
+# ---------------------------------------------------------------- parser
+
+class _Parser:
+    """Recursive-descent parser for the regex subset -> AST tuples:
+    ("lit", frozenset) | ("seq", [..]) | ("alt", [..]) | ("star", node)
+    | ("eps",).  + ? {m,n} are expanded structurally at parse time."""
+
+    def __init__(self, pattern):
+        self.p = pattern
+        self.i = 0
+
+    def _err(self, msg):
+        raise GrammarError(
+            f"bad regex at offset {self.i}: {msg} (pattern {self.p!r})")
+
+    def _peek(self):
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def _take(self):
+        c = self._peek()
+        if c is None:
+            self._err("unexpected end of pattern")
+        self.i += 1
+        return c
+
+    def parse(self):
+        node = self._alt()
+        if self.i != len(self.p):
+            self._err("unbalanced ')'")
+        return node
+
+    def _alt(self):
+        branches = [self._seq()]
+        while self._peek() == "|":
+            self._take()
+            branches.append(self._seq())
+        return ("alt", branches) if len(branches) > 1 else branches[0]
+
+    def _seq(self):
+        items = []
+        while self._peek() is not None and self._peek() not in "|)":
+            items.append(self._rep())
+        if not items:
+            return ("eps",)
+        return ("seq", items) if len(items) > 1 else items[0]
+
+    def _rep(self):
+        node = self._atom()
+        while True:
+            c = self._peek()
+            if c == "*":
+                self._take()
+                node = ("star", node)
+            elif c == "+":
+                self._take()
+                node = ("seq", [node, ("star", node)])
+            elif c == "?":
+                self._take()
+                node = ("alt", [node, ("eps",)])
+            elif c == "{":
+                save = self.i
+                rng = self._try_bounds()
+                if rng is None:
+                    self.i = save
+                    break
+                node = self._expand(node, *rng)
+            else:
+                break
+        return node
+
+    def _try_bounds(self):
+        """At '{': parse {m}, {m,n} or {m,}; None if not a quantifier
+        (a bare '{' then stays a literal, as in generated JSON)."""
+        self._take()
+        lo = ""
+        while self._peek() is not None and self._peek().isdigit():
+            lo += self._take()
+        if not lo:
+            return None
+        m = int(lo)
+        n = m
+        if self._peek() == ",":
+            self._take()
+            hi = ""
+            while self._peek() is not None and self._peek().isdigit():
+                hi += self._take()
+            n = int(hi) if hi else None
+        if self._peek() != "}":
+            return None
+        self._take()
+        if m > MAX_REP or (n is not None and (n < m or n > MAX_REP)):
+            self._err(f"repetition bounds outside [0, {MAX_REP}]")
+        return (m, n)
+
+    def _expand(self, node, m, n):
+        items = [node] * m
+        if n is None:
+            items.append(("star", node))
+        else:
+            items.extend([("alt", [node, ("eps",)])] * (n - m))
+        if not items:
+            return ("eps",)
+        return ("seq", items) if len(items) > 1 else items[0]
+
+    def _atom(self):
+        c = self._take()
+        if c == "(":
+            node = self._alt()
+            if self._peek() != ")":
+                self._err("unclosed group")
+            self._take()
+            return node
+        if c == "[":
+            return ("lit", self._cls())
+        if c == ".":
+            return ("lit", _ALPHASET)
+        if c == "\\":
+            return ("lit", self._escape(self._take()))
+        if c in "*+?|":
+            self._err(f"dangling quantifier {c!r}")
+        if c == ")":
+            self._err("unbalanced ')'")
+        return ("lit", frozenset((c,)))
+
+    def _escape(self, c):
+        if c == "d":
+            return _DIGITS
+        if c == "w":
+            return _WORD
+        if c == "s":
+            return _SPACE
+        if c in _ALPHASET:
+            return frozenset((c,))
+        self._err(f"unsupported escape \\{c}")
+
+    def _cls(self):
+        neg = False
+        if self._peek() == "^":
+            self._take()
+            neg = True
+        out = set()
+        first = True
+        while True:
+            c = self._peek()
+            if c is None:
+                self._err("unclosed character class")
+            if c == "]" and not first:
+                self._take()
+                break
+            first = False
+            c = self._take()
+            if c == "\\":
+                out |= self._escape(self._take())
+                continue
+            nxt = self.p[self.i + 1:self.i + 2]
+            if self._peek() == "-" and nxt and nxt != "]":
+                self._take()
+                hi = self._take()
+                if hi == "\\":
+                    hi = self._take()
+                if ord(hi) < ord(c):
+                    self._err(f"reversed range {c}-{hi}")
+                out.update(chr(o) for o in range(ord(c), ord(hi) + 1))
+                continue
+            out.add(c)
+        if neg:
+            return frozenset(_ALPHASET - out)
+        return frozenset(out & _ALPHASET)
+
+
+# --------------------------------------------------- NFA / DFA pipeline
+
+class _NFA:
+    def __init__(self):
+        self.eps = []    # per state: epsilon targets
+        self.edges = []  # per state: [(charset, target)]
+
+    def state(self):
+        self.eps.append([])
+        self.edges.append([])
+        return len(self.eps) - 1
+
+
+def _build_nfa(nfa, node):
+    kind = node[0]
+    if kind == "eps":
+        s, e = nfa.state(), nfa.state()
+        nfa.eps[s].append(e)
+        return s, e
+    if kind == "lit":
+        s, e = nfa.state(), nfa.state()
+        nfa.edges[s].append((node[1], e))
+        return s, e
+    if kind == "seq":
+        s, e = _build_nfa(nfa, node[1][0])
+        for item in node[1][1:]:
+            s2, e2 = _build_nfa(nfa, item)
+            nfa.eps[e].append(s2)
+            e = e2
+        return s, e
+    if kind == "alt":
+        s, e = nfa.state(), nfa.state()
+        for item in node[1]:
+            si, ei = _build_nfa(nfa, item)
+            nfa.eps[s].append(si)
+            nfa.eps[ei].append(e)
+        return s, e
+    # star
+    s, e = nfa.state(), nfa.state()
+    si, ei = _build_nfa(nfa, node[1])
+    nfa.eps[s] += [si, e]
+    nfa.eps[ei] += [si, e]
+    return s, e
+
+
+def _closure(nfa, states):
+    seen = set(states)
+    stack = list(states)
+    while stack:
+        st = stack.pop()
+        for t in nfa.eps[st]:
+            if t not in seen:
+                seen.add(t)
+                stack.append(t)
+    return frozenset(seen)
+
+
+def compile_char_dfa(pattern):
+    """Pattern -> (transitions, accept): per-state {char: next} dicts
+    plus accept flags; state 0 is the start."""
+    ast = _Parser(pattern).parse()
+    nfa = _NFA()
+    s, e = _build_nfa(nfa, ast)
+    start = _closure(nfa, (s,))
+    index = {start: 0}
+    trans = [dict()]
+    accept = [e in start]
+    work = [start]
+    while work:
+        cur = work.pop()
+        ci = index[cur]
+        by_char = {}
+        for st in cur:
+            for chars, dst in nfa.edges[st]:
+                for ch in chars:
+                    by_char.setdefault(ch, set()).add(dst)
+        for ch in sorted(by_char):
+            nxt = _closure(nfa, by_char[ch])
+            ni = index.get(nxt)
+            if ni is None:
+                if len(index) >= MAX_DFA_STATES:
+                    raise GrammarError(
+                        f"grammar DFA exceeds {MAX_DFA_STATES} states")
+                ni = index[nxt] = len(trans)
+                trans.append(dict())
+                accept.append(e in nxt)
+                work.append(nxt)
+            trans[ci][ch] = ni
+    return trans, accept
+
+
+class TokenFSM:
+    """The data-only artifact the serving plane consumes."""
+
+    __slots__ = ("transitions", "allow", "accept", "neg_mask",
+                 "allowed_counts", "n_states", "vocab_size")
+
+    def __init__(self, transitions, accept):
+        self.transitions = transitions
+        self.allow = transitions >= 0
+        self.accept = accept
+        self.neg_mask = np.where(
+            self.allow, np.float32(0.0), np.float32(NEG_INF))
+        self.allowed_counts = self.allow.sum(axis=1).astype(np.int32)
+        self.n_states, self.vocab_size = transitions.shape
+
+
+def lift_token_fsm(char_trans, char_accept, vocab):
+    """Lift the char DFA over a token vocabulary and trim dead ends."""
+    S = len(char_trans)
+    V = len(vocab)
+    # Per-char successor vectors make the lift a fold of [S] gathers
+    # instead of a python loop over S x V.
+    cmap = {}
+    for si, row in enumerate(char_trans):
+        for ch, dst in row.items():
+            col = cmap.get(ch)
+            if col is None:
+                col = cmap[ch] = np.full(S, -1, np.int32)
+            col[si] = dst
+    dead = np.full(S, -1, np.int32)
+    identity = np.arange(S, dtype=np.int32)
+    tt = np.full((S, V), -1, np.int32)
+    for ti, text in enumerate(vocab):
+        if not text:
+            continue  # empty tokens never advance the FSM: banned
+        cur = identity
+        for ch in text:
+            col = cmap.get(ch, dead)
+            nxt = np.where(cur >= 0, col[np.maximum(cur, 0)], -1)
+            cur = nxt.astype(np.int32)
+            if not (cur >= 0).any():
+                break
+        tt[:, ti] = cur
+
+    accept = np.asarray(char_accept, bool)
+    # Co-accessibility: iterate "can reach accept via allowed tokens"
+    # to a fixed point, then ban transitions into non-co-accessible
+    # states so no reachable state is a dead end.
+    co = accept.copy()
+    while True:
+        valid = tt >= 0
+        into_co = np.zeros_like(valid)
+        into_co[valid] = co[tt[valid]]
+        new_co = co | into_co.any(axis=1)
+        if (new_co == co).all():
+            break
+        co = new_co
+    if not co[0]:
+        raise GrammarError(
+            "grammar unsatisfiable: no accepting token path exists under "
+            "this deployment's vocabulary")
+    valid = tt >= 0
+    into_dead = np.zeros_like(valid)
+    into_dead[valid] = ~co[tt[valid]]
+    tt[into_dead] = -1
+    return TokenFSM(tt, accept)
+
+
+class CompiledGrammar:
+    """One cached compile: spec + digest + TokenFSM + compile wall time.
+
+    Per-row state is a plain int; every accessor here is host-side
+    numpy — nothing in this class is ever traced."""
+
+    __slots__ = ("spec", "digest", "fsm", "compile_seconds")
+
+    def __init__(self, spec, digest, fsm, compile_seconds):
+        self.spec = spec
+        self.digest = digest
+        self.fsm = fsm
+        self.compile_seconds = compile_seconds
+
+    @property
+    def start(self):
+        return 0
+
+    def accepting(self, state):
+        return bool(self.fsm.accept[state])
+
+    def complete(self, state):
+        """Accepting with no outgoing tokens: the grammar is exhausted
+        and the row must finish even if the config has no EOS id."""
+        return (bool(self.fsm.accept[state])
+                and int(self.fsm.allowed_counts[state]) == 0)
+
+    def advance(self, state, token):
+        """(next_state, ok).  A banned token leaves the state clamped
+        (violation accounting happens in the engine)."""
+        nxt = int(self.fsm.transitions[state, int(token)])
+        if nxt < 0:
+            return state, False
+        return nxt, True
+
+
+def compile_grammar(spec, vocab):
+    """spec dict + vocab (list of token strings) -> CompiledGrammar."""
+    t0 = time.perf_counter()
+    spec = validate_spec(spec)
+    pattern = grammar_regex(spec)
+    char_trans, char_accept = compile_char_dfa(pattern)
+    fsm = lift_token_fsm(char_trans, char_accept, vocab)
+    if bool(fsm.accept[0]) and int(fsm.allowed_counts[0]) == 0:
+        # only the empty string matches: the row would have to finish
+        # before emitting anything — refuse at admission, not mid-step
+        raise GrammarError(
+            "grammar matches only the empty string under this "
+            "deployment's vocabulary")
+    return CompiledGrammar(
+        spec, grammar_digest(spec), fsm, time.perf_counter() - t0)

@@ -1,0 +1,227 @@
+"""Grammar specs and their lowering to a character-level regex.
+
+A grammar spec is a plain JSON-able dict with a ``type`` key:
+
+  {"type": "regex",       "pattern": "<subset regex>"}
+  {"type": "json_schema", "schema": {...}}
+  {"type": "json",        "max_depth": 2}
+
+``validate_spec`` is the ADMISSION gate: anything malformed, unknown or
+oversized raises :class:`GrammarError` (HTTP 400 at serve.py) before a
+single KV page is reserved.  ``grammar_regex`` lowers every spec type to
+one regex string in the subset understood by :mod:`fsm`; the token-level
+FSM is compiled from that regex once per distinct grammar and cached by
+``grammar_digest`` (sha256 of the canonical JSON encoding).
+
+Design constraints (see docs/SERVING.md):
+
+* Every repetition the lowering emits is BOUNDED, so the compiled FSM
+  has a finite maximum path length — a constrained row always reaches
+  an accept state within a known token budget, which is what makes the
+  bench's conformance=1.0 target achievable with any model.
+* JSON output is canonical/compact (no inter-token whitespace, object
+  properties in declaration order), which keeps the DFA small and makes
+  conformance checkable with ``json.loads`` alone.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from ..request import GrammarError
+
+GRAMMAR_TYPES = ("regex", "json_schema", "json")
+
+# Admission-time resource bounds: an adversarial schema must be refused
+# before compile, not discovered as an OOM inside the FSM builder.
+MAX_SCHEMA_BYTES = 65536
+MAX_SCHEMA_DEPTH = 6
+MAX_OBJECT_PROPS = 16
+MAX_ARRAY_ITEMS = 8
+MAX_STRING_LEN = 64
+MAX_ENUM_VALS = 32
+MAX_JSON_DEPTH = 3
+
+# Characters with a meaning in the fsm.py regex subset; everything a
+# literal JSON encoding can contain must round-trip through _escape_lit.
+_REGEX_SPECIALS = set("\\.[](){}*+?|")
+
+# Bounded scalar sub-regexes.  '-' sits last in classes so it parses as
+# a literal; string bodies exclude '"' and '\\' so no JSON escaping is
+# ever needed when checking conformance with json.loads.
+_STR_BODY = "[A-Za-z0-9_ -]"
+_INT = "(0|-?[1-9][0-9]{0,5})"
+_NUM = _INT + "(\\.[0-9]{1,4})?"
+_BOOL = "(true|false)"
+_NULL = "null"
+
+
+def canonical_json(spec):
+    """Canonical encoding used for both hashing and size accounting."""
+    try:
+        return json.dumps(spec, sort_keys=True, separators=(",", ":"))
+    except (TypeError, ValueError) as e:
+        raise GrammarError(f"grammar spec is not JSON-able: {e}") from e
+
+
+def grammar_digest(spec):
+    """Stable cache key: sha256 of the canonical JSON encoding."""
+    return hashlib.sha256(canonical_json(spec).encode("utf-8")).hexdigest()
+
+
+def validate_spec(spec):
+    """Validate a grammar spec dict; returns it unchanged.
+
+    Raises :class:`GrammarError` on anything malformed — this runs at
+    admission, before queueing, KV staging or adapter pinning.
+    """
+    if not isinstance(spec, dict):
+        raise GrammarError(
+            f"grammar must be a dict, got {type(spec).__name__}")
+    gtype = spec.get("type")
+    if gtype not in GRAMMAR_TYPES:
+        raise GrammarError(
+            f"unknown grammar type {gtype!r}; supported: {GRAMMAR_TYPES}")
+    encoded = canonical_json(spec)
+    if len(encoded.encode("utf-8")) > MAX_SCHEMA_BYTES:
+        raise GrammarError(
+            f"grammar spec exceeds {MAX_SCHEMA_BYTES} canonical bytes")
+    if gtype == "regex":
+        pattern = spec.get("pattern")
+        if not isinstance(pattern, str) or not pattern:
+            raise GrammarError("regex grammar needs a non-empty 'pattern'")
+    elif gtype == "json_schema":
+        schema = spec.get("schema")
+        if not isinstance(schema, dict):
+            raise GrammarError("json_schema grammar needs a 'schema' dict")
+        _check_schema(schema, depth=0)
+    else:  # json mode
+        depth = spec.get("max_depth", 2)
+        if not isinstance(depth, int) or not 0 <= depth <= MAX_JSON_DEPTH:
+            raise GrammarError(
+                f"json grammar max_depth must be an int in [0, {MAX_JSON_DEPTH}]")
+    return spec
+
+
+def grammar_regex(spec):
+    """Lower a validated spec to one regex in the fsm.py subset."""
+    gtype = spec["type"]
+    if gtype == "regex":
+        return spec["pattern"]
+    if gtype == "json_schema":
+        return _schema_regex(spec["schema"], depth=0)
+    return _json_value_regex(int(spec.get("max_depth", 2)))
+
+
+def _escape_lit(text):
+    out = []
+    for ch in text:
+        if ch in _REGEX_SPECIALS:
+            out.append("\\" + ch)
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _check_schema(schema, depth):
+    """Structural admission checks mirroring _schema_regex exactly."""
+    if depth > MAX_SCHEMA_DEPTH:
+        raise GrammarError(f"schema nesting exceeds {MAX_SCHEMA_DEPTH}")
+    if not isinstance(schema, dict):
+        raise GrammarError("schema nodes must be dicts")
+    if "enum" in schema:
+        vals = schema["enum"]
+        if not isinstance(vals, list) or not vals:
+            raise GrammarError("enum must be a non-empty list")
+        if len(vals) > MAX_ENUM_VALS:
+            raise GrammarError(f"enum exceeds {MAX_ENUM_VALS} values")
+        for v in vals:
+            if not isinstance(v, (str, int, bool)) and v is not None:
+                raise GrammarError("enum values must be scalars")
+        return
+    stype = schema.get("type")
+    if stype in ("string", "integer", "number", "boolean", "null"):
+        if stype == "string":
+            ml = schema.get("maxLength", 16)
+            if not isinstance(ml, int) or not 0 <= ml <= MAX_STRING_LEN:
+                raise GrammarError(
+                    f"string maxLength must be in [0, {MAX_STRING_LEN}]")
+        return
+    if stype == "array":
+        mn = schema.get("minItems", 0)
+        mx = schema.get("maxItems", 3)
+        if (not isinstance(mn, int) or not isinstance(mx, int)
+                or not 0 <= mn <= mx <= MAX_ARRAY_ITEMS):
+            raise GrammarError(
+                f"array bounds must satisfy 0 <= minItems <= maxItems"
+                f" <= {MAX_ARRAY_ITEMS}")
+        _check_schema(schema.get("items", {"type": "string"}), depth + 1)
+        return
+    if stype == "object":
+        props = schema.get("properties")
+        if not isinstance(props, dict) or not props:
+            raise GrammarError("object schema needs non-empty 'properties'")
+        if len(props) > MAX_OBJECT_PROPS:
+            raise GrammarError(
+                f"object exceeds {MAX_OBJECT_PROPS} properties")
+        for key, sub in props.items():
+            if not isinstance(key, str) or not key:
+                raise GrammarError("property names must be non-empty strings")
+            _check_schema(sub, depth + 1)
+        return
+    raise GrammarError(f"unsupported schema type {stype!r}")
+
+
+def _schema_regex(schema, depth):
+    """Schema -> regex.  Objects emit ALL declared properties in
+    declaration order (canonical constrained form; 'required' is
+    implied), which is what keeps the lowering a pure regex."""
+    if "enum" in schema:
+        alts = "|".join(
+            _escape_lit(json.dumps(v, separators=(",", ":")))
+            for v in schema["enum"])
+        return "(" + alts + ")"
+    stype = schema.get("type")
+    if stype == "string":
+        ml = int(schema.get("maxLength", 16))
+        return '"' + _STR_BODY + "{0,%d}" % ml + '"'
+    if stype == "integer":
+        return _INT
+    if stype == "number":
+        return _NUM
+    if stype == "boolean":
+        return _BOOL
+    if stype == "null":
+        return _NULL
+    if stype == "array":
+        items = _schema_regex(
+            schema.get("items", {"type": "string"}), depth + 1)
+        mn = int(schema.get("minItems", 0))
+        mx = int(schema.get("maxItems", 3))
+        if mx == 0:
+            return "\\[\\]"
+        body = items + "(,%s){%d,%d}" % (items, max(mn - 1, 0), mx - 1)
+        if mn == 0:
+            return "\\[(" + body + ")?\\]"
+        return "\\[" + body + "\\]"
+    # object (validated above)
+    parts = [
+        _escape_lit(json.dumps(key)) + ":" + _schema_regex(sub, depth + 1)
+        for key, sub in schema["properties"].items()
+    ]
+    return "\\{" + ",".join(parts) + "\\}"
+
+
+def _json_value_regex(depth):
+    """JSON mode: any canonical JSON value, nesting bounded by depth and
+    widths bounded everywhere so the DFA stays small and finite-path."""
+    scalar = "(%s|%s|%s|%s)" % ('"' + _STR_BODY + "{0,8}" + '"',
+                                _NUM, _BOOL, _NULL)
+    if depth <= 0:
+        return scalar
+    inner = _json_value_regex(depth - 1)
+    pair = '"[A-Za-z0-9_]{1,8}":' + inner
+    obj = "\\{(" + pair + "(," + pair + "){0,2})?\\}"
+    arr = "\\[(" + inner + "(," + inner + "){0,2})?\\]"
+    return "(%s|%s|%s)" % (scalar, obj, arr)

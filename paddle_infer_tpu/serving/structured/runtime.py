@@ -1,0 +1,181 @@
+"""Per-row FSM runtime helpers used by the engine's host loop.
+
+Everything here is host-side numpy on plain ints — FSM state is DATA
+that rides park/handoff packets and is recomputable from the emitted
+token stream, so replay/migration/parity all fall out of one rule:
+
+    state = advance(start, emitted_tokens, skipping EOS)
+
+Masks are always ``[vocab]`` float32 rows (0 allowed / NEG_INF banned);
+the engine stacks them to ``[batch, vocab]`` (or ``[batch, W, vocab]``
+for speculative lanes) before handing them to the one executable.
+
+EOS policy: the EOS column is 0 only in FSM accept states (the stream
+so far is a complete instance) and NEG_INF otherwise — "EOS only in
+accept states" is enforced by the mask itself, not by a check after
+sampling.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+
+from ...inference.sampling import NEG_INF
+
+
+def default_vocab(vocab_size, specials=()):
+    """Deterministic test/demo vocabulary: id i -> printable ASCII
+    chr(32+i) while it lasts; ids in ``specials`` (eos/pad) and the
+    overflow tail get unmatchable texts so no grammar can select them.
+    Real deployments pass their tokenizer's token strings instead."""
+    specials = frozenset(int(s) for s in specials if s is not None and s >= 0)
+    out = []
+    for i in range(int(vocab_size)):
+        if i in specials:
+            out.append("")
+        elif 32 + i <= 126:
+            out.append(chr(32 + i))
+        else:
+            out.append("\x00%d" % i)
+    return out
+
+
+def mask_row(compiled, state, eos_id=None):
+    """[V] float32 additive mask for one row at ``state``."""
+    row = compiled.fsm.neg_mask[state].copy()
+    if eos_id is not None and 0 <= int(eos_id) < row.shape[0]:
+        row[int(eos_id)] = (np.float32(0.0) if compiled.accepting(state)
+                            else np.float32(NEG_INF))
+    return row
+
+
+def masked_count(compiled, state, eos_id=None):
+    """How many vocab entries the mask bans at ``state`` (steplog)."""
+    banned = compiled.fsm.vocab_size - int(compiled.fsm.allowed_counts[state])
+    if eos_id is not None and 0 <= int(eos_id) < compiled.fsm.vocab_size:
+        # neg_mask never allows EOS (no char transition), so correct
+        # for the accept-state carve-out mask_row applies.
+        if compiled.accepting(state):
+            banned -= 1
+    return banned
+
+
+def advance(compiled, state, token, eos_id=None):
+    """(next_state, ok): EOS is a no-op transition, legal only in an
+    accept state; banned tokens clamp (violation counted by caller)."""
+    if eos_id is not None and int(token) == int(eos_id):
+        return state, compiled.accepting(state)
+    return compiled.advance(state, int(token))
+
+
+def advance_many(compiled, state, tokens, eos_id=None):
+    """Fold ``advance`` over a token stream -> (state, violations)."""
+    violations = 0
+    for tok in np.asarray(tokens).reshape(-1):
+        state, ok = advance(compiled, state, int(tok), eos_id)
+        if not ok:
+            violations += 1
+    return state, violations
+
+
+def filter_drafts(compiled, state, drafts, eos_id=None):
+    """Truncate a speculative proposal at the first FSM-invalid token,
+    at EOS, and before any draft that EXHAUSTS the grammar (enters a
+    complete state): the host must see the completing token to finish
+    the row, and a lane past it would face an all-banned mask."""
+    kept = []
+    for tok in np.asarray(drafts).reshape(-1):
+        tok = int(tok)
+        if eos_id is not None and tok == int(eos_id):
+            break
+        nxt, ok = compiled.advance(state, tok)
+        if not ok or compiled.complete(nxt):
+            break
+        kept.append(tok)
+        state = nxt
+    return kept
+
+
+def lane_states(compiled, state, drafts, window):
+    """[window] int32: lane j's FSM state after accepting drafts[:j].
+    Drafts are pre-filtered, but a defensively-invalid draft clamps."""
+    states = np.empty(int(window), np.int32)
+    cur = int(state)
+    for j in range(int(window)):
+        states[j] = cur
+        if j < len(drafts):
+            cur, _ = compiled.advance(cur, int(drafts[j]))
+    return states
+
+
+def lane_masks(compiled, state, drafts, window, eos_id=None):
+    """[window, V] float32 per-lane masks for one speculative row."""
+    return np.stack([
+        mask_row(compiled, int(s), eos_id)
+        for s in lane_states(compiled, state, drafts, window)
+    ])
+
+
+# ----------------------------------------------------- conformance side
+
+def decode_text(vocab, tokens, eos_id=None):
+    """Emitted token ids -> surface text under ``vocab``."""
+    return "".join(
+        vocab[int(t)] for t in np.asarray(tokens).reshape(-1)
+        if eos_id is None or int(t) != int(eos_id))
+
+
+def validate_instance(schema, value):
+    """Check a parsed JSON value against the supported schema subset
+    (mirrors grammar._schema_regex; used by bench conformance)."""
+    if "enum" in schema:
+        return any(value == v and type(value) is type(v)
+                   for v in schema["enum"])
+    stype = schema.get("type")
+    if stype == "string":
+        return isinstance(value, str)
+    if stype == "integer":
+        return isinstance(value, int) and not isinstance(value, bool)
+    if stype == "number":
+        return (isinstance(value, (int, float))
+                and not isinstance(value, bool))
+    if stype == "boolean":
+        return isinstance(value, bool)
+    if stype == "null":
+        return value is None
+    if stype == "array":
+        if not isinstance(value, list):
+            return False
+        mn = int(schema.get("minItems", 0))
+        mx = int(schema.get("maxItems", 3))
+        if not mn <= len(value) <= mx:
+            return False
+        items = schema.get("items", {"type": "string"})
+        return all(validate_instance(items, v) for v in value)
+    if stype == "object":
+        if not isinstance(value, dict):
+            return False
+        props = schema["properties"]
+        if set(value) != set(props):
+            return False
+        return all(validate_instance(sub, value[k])
+                   for k, sub in props.items())
+    return False
+
+
+def conforms(spec, text):
+    """Does a finished stream's text satisfy its grammar spec?"""
+    gtype = spec.get("type")
+    if gtype == "regex":
+        # The fsm.py subset is python-re compatible by construction.
+        return re.fullmatch(spec["pattern"], text) is not None
+    try:
+        value = json.loads(text)
+    except ValueError:
+        return False
+    if gtype == "json_schema":
+        return validate_instance(spec["schema"], value)
+    return True  # json mode: any parse is conformant

@@ -435,7 +435,8 @@ def test_serving_suites_instrumented_clean():
          os.path.join(ROOT, "tests", "test_serving_engine.py"),
          os.path.join(ROOT, "tests", "test_resilience.py"),
          os.path.join(ROOT, "tests", "test_fleet.py"),
-         os.path.join(ROOT, "tests", "test_kv_tier.py")],
+         os.path.join(ROOT, "tests", "test_kv_tier.py"),
+         os.path.join(ROOT, "tests", "test_structured.py")],
         capture_output=True, text=True, env=env, cwd=ROOT,
         timeout=3000)
     assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-800:]

@@ -417,3 +417,52 @@ def test_engine_core_instrumented_end_to_end():
     gaps = chk.gap_report(static)
     assert gaps == [], \
         f"dynamic lock edges missing from the static graph: {gaps}"
+
+
+def test_structured_instrumented_end_to_end():
+    """The constrained-decoding plane under full instrumentation: a
+    grammar-compiling admission, masked decode steps and the
+    structured metrics snapshot (engine counters under the step lock,
+    cache counters on the GrammarCache leaf strictly after it) report
+    zero violations, and every observed edge — including any touching
+    ``GrammarCache._lock`` — is in the committed static graph."""
+    import paddle_infer_tpu as pit
+    from paddle_infer_tpu.inference.generation import (
+        GenerationConfig, PagedGenerationEngine)
+    from paddle_infer_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_infer_tpu.serving import EngineCore, default_vocab
+
+    pit.seed(0)
+    with instrument_locks() as chk:
+        model = GPTForCausalLM(GPTConfig(
+            vocab_size=96, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64, hidden_dropout_prob=0.0,
+            attention_probs_dropout_prob=0.0))
+        model.eval()
+        engine = PagedGenerationEngine(model, page_size=8)
+        core = EngineCore(engine, max_batch=2, max_model_len=48,
+                          token_budget=16, prefill_chunk=16,
+                          decode_chunk=4, ragged=True,
+                          grammar_vocab=default_vocab(96))
+        prompt = np.random.RandomState(7).randint(
+            0, 96, (8,)).astype(np.int32)
+        (req,) = core.submit(
+            prompt, GenerationConfig(max_new_tokens=12),
+            grammar={"type": "regex", "pattern": "(yes|no|maybe)!"})
+        for _ in range(200):
+            if req.done:
+                break
+            core.run_once()
+        snap = core.metrics_snapshot()
+        core.close()
+    assert req.done
+    assert snap["structured"]["entries"] >= 1
+    assert chk.violations == [], chk.violations
+    g = chk.graph()
+    assert "GrammarCache._lock" in g["nodes"]      # really observed
+    with open(BASELINE) as f:
+        static = json.load(f)
+    gaps = chk.gap_report(static)
+    assert gaps == [], \
+        f"dynamic lock edges missing from the static graph: {gaps}"

@@ -234,6 +234,34 @@ def test_recompile_hazard_adapter_names_allowed_outside_serving(
                      rel="peft/mod.py") == []
 
 
+GRAMMAR_BUILDER = """
+    def build_masked_step(engine, vocab_size, num_states):
+        return engine.compile(vocab_size, num_states)
+"""
+
+
+def test_recompile_hazard_fires_on_grammar_keyed_serving_builder(
+        tmp_path):
+    # vocab / FSM sizes are host-side compile products in serving/ — a
+    # builder signature taking them compiles one executable per
+    # grammar, so grammar churn would compile instead of riding as a
+    # per-row [b, V] mask through the one grammar-marked executable
+    fs = run_rules(tmp_path, GRAMMAR_BUILDER, ["recompile-hazard"],
+                   rel="serving/structured/mod.py")
+    assert len(fs) == 1
+    assert "build_masked_step(vocab_size, num_states)" in fs[0].message
+    assert "per-row" in fs[0].message
+    assert "mask DATA" in fs[0].message
+
+
+def test_recompile_hazard_grammar_names_allowed_outside_serving(
+        tmp_path):
+    # model/tokenizer code legitimately parameterizes over vocab_size;
+    # the grammar name set only binds under serving/
+    assert run_rules(tmp_path, GRAMMAR_BUILDER, ["recompile-hazard"],
+                     rel="models/mod.py") == []
+
+
 # ------------------------------------------------------ lock-discipline
 def test_lock_discipline_fires_on_unlocked_read(tmp_path):
     src = """

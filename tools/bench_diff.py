@@ -29,7 +29,7 @@ _HIGHER = ("per_s", "per_sec", "speedup", "mfu", "acceptance",
            "hit_rate", "tps", "tok_s", "throughput", "tokens_per",
            "pearson", "improvement", "spec_decode", "bytes_saved",
            "resident_pages_ratio", "attainment", "goodput",
-           "parks", "resumes", "coverage")
+           "parks", "resumes", "coverage", "conformance")
 # journey plane: attribution_coverage up (more of each request's wall
 # attributed to a named bucket), per-tenant attainment up (the
 # "attainment" rule covers tenant_<name>_attainment keys), parked
@@ -60,6 +60,10 @@ _LOWER = ("_ms", "latency", "ttft", "itl", "err", "wall", "p50",
 # load, more preemption parked-not-dropped means less work was shed),
 # sheds/misses/swap_fails down — a tier round that sheds or abandons
 # swaps at equal load regressed.
+# structured_output: conformance up (every constrained stream must
+# fullmatch its grammar), violations/incomplete and the constrained
+# ITL overhead down — the mask is per-row data through the one
+# executable, so any added latency is pure gather/add overhead.
 # harness bookkeeping, not workload performance
 _SKIP = ("vs_baseline", "child_wall_s", "bench_wall_s", "n", "rc")
 

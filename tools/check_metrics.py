@@ -84,7 +84,8 @@ def fabricated_exposition():
                    cost_source="xla+pages", decode_rows=3,
                    emitted_tokens=3, moe_tokens_routed=24,
                    moe_tokens_dropped=2, moe_aux_loss=1.02,
-                   adapter_rows=2, kernel="ragged")
+                   adapter_rows=2, grammar_rows=2, masked_tokens=150,
+                   kernel="ragged")
     steplog.record("evict", pages_freed=3, bytes_est=3.0e5,
                    cost_source="analytic")
 
@@ -212,6 +213,13 @@ def fabricated_exposition():
                                           "pages_total": 4096,
                                           "pages_used": 24,
                                           "bytes_used": 1.5e6}},
+                      # EngineCore._structured_snapshot() shape
+                      # (constrained decoding: grammar cache + tallies)
+                      structured={"active_rows": 2, "entries": 3,
+                                  "hits": 11, "misses": 3,
+                                  "compile_seconds": 0.021,
+                                  "vocab_size": 96, "violations": 0,
+                                  "incomplete": 1, "rejected": 2},
                       # HostKVTier.summary() shape (park, don't drop)
                       kv_tier={"parked_requests": 2,
                                "host_pages_total": 256,

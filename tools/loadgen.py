@@ -21,7 +21,10 @@ Trace model:
     fleet of users sharing a system prompt.  A tenant may also bind a
     LoRA adapter: ``adapter_id`` pins every request to one adapter;
     ``adapter_ids`` (a list) draws one per event — the residency-churn
-    regime the AdapterCache's slot LRU is sized against.
+    regime the AdapterCache's slot LRU is sized against.  A tenant may
+    also carry a ``grammar`` spec: every request it emits is
+    grammar-constrained (see ``structured_tenants``), riding the trace
+    as plain JSON so replays stay byte-stable.
   * determinism — everything is drawn from one ``numpy`` RandomState
     seeded by the caller.  The same seed yields the same event list,
     and ``write_trace``/``read_trace`` round-trip it losslessly, so a
@@ -61,6 +64,39 @@ DEFAULT_TENANTS = (
 )
 
 
+# canonical tool-call shape for the structured tenant class: an object
+# with an enum'd tool name, a short string argument and an integer
+# limit — the constrained-decoding regime bench.py's structured_output
+# section measures (docs/SERVING.md "Constrained decoding").  Kept
+# well inside grammar.py's admission bounds so every replayed event
+# compiles to one small cached FSM.
+TOOL_CALL_GRAMMAR = {
+    "type": "json_schema",
+    "schema": {
+        "type": "object",
+        "properties": {
+            "tool": {"enum": ["search", "lookup", "calc"]},
+            "arg": {"type": "string", "maxLength": 8},
+            "limit": {"type": "integer"},
+        },
+    },
+}
+
+
+def structured_tenants():
+    """Tenant mix for the constrained-decoding regime: the default
+    interactive classes plus a ``structured`` class whose every request
+    carries the tool-call JSON-schema grammar.  Decode budgets are
+    sized so a conforming row can always reach an FSM accept state
+    (the tool-call shape needs at most ~45 emitted characters)."""
+    return DEFAULT_TENANTS[:2] + (
+        {"name": "structured", "weight": 2.0, "prompt_len": (4, 12),
+         "max_new": (48, 64), "timeout_s": (1.5, 3.0),
+         "shared_prefix_len": 0, "cache_salt": None,
+         "grammar": TOOL_CALL_GRAMMAR},
+    )
+
+
 def oversubscription_tenants(factor: float = 1.0):
     """Tenant mix for the host-KV-tier oversubscription regime
     (bench.py ``kv_tier`` section): sustained DEADLINE-LESS clients
@@ -91,7 +127,7 @@ def generate_trace(seed: int, duration_s: float, rate_per_s: float,
                    do_sample: bool = False) -> List[Dict]:
     """Seeded bursty multi-tenant trace: a time-sorted list of event
     dicts ``{t, i, tenant, prompt, max_new, timeout_s, cache_salt,
-    adapter_id, seed, do_sample}``.  ``rate_per_s`` is the TOTAL
+    adapter_id, grammar, seed, do_sample}``.  ``rate_per_s`` is the TOTAL
     offered rate, split across tenants by weight."""
     rng = np.random.RandomState(int(seed))
     burstiness = max(float(burstiness), 1e-6)
@@ -141,6 +177,7 @@ def generate_trace(seed: int, duration_s: float, rate_per_s: float,
                               else None),
                 "cache_salt": t.get("cache_salt"),
                 "adapter_id": adapter_id,
+                "grammar": t.get("grammar"),
                 "seed": int(rng.randint(0, 2 ** 31 - 1)),
                 "do_sample": bool(do_sample),
             })
@@ -186,7 +223,8 @@ def request_from_event(event: Dict):
                    timeout_s=event.get("timeout_s"),
                    cache_salt=event.get("cache_salt"),
                    adapter_id=event.get("adapter_id"),
-                   tenant=event.get("tenant"))
+                   tenant=event.get("tenant"),
+                   grammar=event.get("grammar"))
 
 
 def replay(core, events: List[Dict], time_scale: float = 1.0,
@@ -273,6 +311,12 @@ def main(argv=None) -> int:
                          "ids ('adapter-0'..) with one draw per event — "
                          "the adapter-churn regime that exercises the "
                          "AdapterCache slot LRU")
+    ap.add_argument("--structured", action="store_true",
+                    help="emit the constrained-decoding mix: the "
+                         "interactive tenants plus a 'structured' "
+                         "class whose every request carries the "
+                         "tool-call JSON-schema grammar (docs/"
+                         "SERVING.md 'Constrained decoding')")
     ap.add_argument("--oversubscribe", type=float, default=0.0,
                     help="emit the deadline-less oversubscription mix "
                          "instead of the default tenants, scaled by "
@@ -282,6 +326,8 @@ def main(argv=None) -> int:
     ap.add_argument("--out", required=True, help="output trace JSONL")
     args = ap.parse_args(argv)
     tenants = DEFAULT_TENANTS
+    if args.structured:
+        tenants = structured_tenants()
     if args.oversubscribe:
         tenants = oversubscription_tenants(args.oversubscribe)
     if args.adapters > 0:

@@ -70,9 +70,18 @@ a validated AdapterStore, and generation bodies may carry a per-request
 ``"adapter_id"`` field (docs/SERVING.md "Multi-LoRA serving").  An
 unknown adapter_id is a client error -> 400, never a 500.
 
+With ``--structured`` the process serves grammar-constrained requests:
+generation bodies may carry a per-request ``"grammar"`` spec
+(json_schema / regex / json), compiled to a token-level FSM at
+admission and applied as a per-row logit mask inside the one mixed-step
+executable (docs/SERVING.md "Constrained decoding").  A malformed,
+unsupported or unsatisfiable grammar is a client error -> 400 with a
+structured error body ({"error", "error_type"}), rejected BEFORE any
+KV page is reserved or adapter pinned.
+
 Admission control maps to HTTP codes: queue full -> 429 + Retry-After,
 draining/load-shed -> 503 + Retry-After, deadline exceeded -> 504,
-unbatchable/oversized/unknown-adapter -> 400.  Retry-After is derived from queue depth
+unbatchable/oversized/unknown-adapter/bad-grammar -> 400.  Retry-After is derived from queue depth
 x recent step time (health state overrides while DRAINING/DOWN).
 Requests the batch can't host (beams, repetition penalty) and
 speculative-eligible requests run exclusively on the scheduler thread
@@ -144,7 +153,8 @@ def _build_fleet(roles):
             slo_itl_s=_STATE.get("slo_itl_s"),
             kv_host_pages=_STATE.get("kv_host_pages", 0),
             kv_park_watermark=_STATE.get("kv_park_watermark", 0.95),
-            kv_resume_watermark=_STATE.get("kv_resume_watermark", 0.70))
+            kv_resume_watermark=_STATE.get("kv_resume_watermark", 0.70),
+            grammar_vocab=_STATE.get("grammar_vocab"))
         sup = EngineSupervisor(
             core,
             watchdog_s=_STATE.get("watchdog_s", 5.0),
@@ -221,7 +231,8 @@ def _core():
                 kv_host_pages=_STATE.get("kv_host_pages", 0),
                 kv_park_watermark=_STATE.get("kv_park_watermark", 0.95),
                 kv_resume_watermark=_STATE.get("kv_resume_watermark",
-                                               0.70))
+                                               0.70),
+                grammar_vocab=_STATE.get("grammar_vocab"))
             _STATE["sup"] = EngineSupervisor(
                 core,
                 watchdog_s=_STATE.get("watchdog_s", 5.0),
@@ -336,7 +347,7 @@ def _error_code(e) -> int:
 
 
 def _submit_batch(core, ids, g, timeout_s, cache_salt, adapter_id=None,
-                  tenant=None):
+                  tenant=None, grammar=None):
     """Batchable admission: per-row through the fleet router when one
     is up (role/affinity/health-aware placement), else the single
     core's all-or-nothing submit."""
@@ -344,38 +355,42 @@ def _submit_batch(core, ids, g, timeout_s, cache_salt, adapter_id=None,
     if router is None:
         return core.submit(ids, g, timeout_s=timeout_s,
                            cache_salt=cache_salt, adapter_id=adapter_id,
-                           tenant=tenant)
+                           tenant=tenant, grammar=grammar)
     ids = np.asarray(ids, np.int32)
     if ids.ndim == 1:
         ids = ids[None, :]
     return [router.submit(row, g, timeout_s=timeout_s,
                           cache_salt=cache_salt, adapter_id=adapter_id,
-                          tenant=tenant)
+                          tenant=tenant, grammar=grammar)
             for row in ids]
 
 
 def _generate(ids, g, timeout_s, cache_salt=None, adapter_id=None,
-              tenant=None):
+              tenant=None, grammar=None):
     """Route one /generate body; returns (tokens [b, max_new], extra).
     ``extra["request_ids"]`` always carries the engine request ids so
     the client can fetch the span trace via ``GET /trace/<rid>``."""
     core = _core()
-    if adapter_id is not None:
-        # adapter deltas live only in the converted paged engine — the
-        # dense exclusive / separate-spec-engine bypasses would silently
-        # serve the BASE model, so adapter requests must be batchable
+    if adapter_id is not None or grammar is not None:
+        # adapter deltas and grammar masks live only in the serving
+        # core's mixed step — the dense exclusive /
+        # separate-spec-engine bypasses would silently serve the BASE
+        # model / an unconstrained stream, so these must be batchable
         if not core.batchable(g):
             from paddle_infer_tpu.serving import RejectedError
 
             raise RejectedError(
-                "adapter_id requires a batchable request (no beams / "
-                "repetition penalty): the exclusive dense path serves "
-                "the base model only")
+                "adapter_id/grammar requires a batchable request (no "
+                "beams / repetition penalty): the exclusive dense path "
+                "serves the base model only, unconstrained")
         reqs = _submit_batch(core, ids, g, timeout_s, cache_salt,
-                             adapter_id=adapter_id, tenant=tenant)
+                             adapter_id=adapter_id, tenant=tenant,
+                             grammar=grammar)
+        extra = {"request_ids": [r.rid for r in reqs]}
+        if adapter_id is not None:
+            extra["adapter_id"] = adapter_id
         return (np.stack([r.padded_result(timeout=None) for r in reqs]),
-                {"request_ids": [r.rid for r in reqs],
-                 "adapter_id": adapter_id})
+                extra)
     if _speculatable(ids, g):
         def call():
             eng = _spec_engine()
@@ -672,8 +687,17 @@ class Handler(BaseHTTPRequestHandler):
             tenant = body.get("tenant")
             if tenant is not None:
                 tenant = str(tenant)
+            # constrained decoding: a grammar SPEC dict ({"type":
+            # "json_schema"|"regex"|"json", ...}).  Structural/size
+            # validation and FSM compilation happen at engine
+            # admission — BEFORE any KV page is reserved or adapter
+            # pinned — and reject with 400 + a structured error body.
+            grammar = body.get("grammar")
+            if grammar is not None and not isinstance(grammar, dict):
+                raise TypeError("grammar must be a JSON object")
         except Exception as e:
-            self._json(400, {"error": f"bad request: {e!r}"})
+            self._json(400, {"error": f"bad request: {e!r}",
+                             "error_type": type(e).__name__})
             return
         headers_sent = False
 
@@ -687,7 +711,7 @@ class Handler(BaseHTTPRequestHandler):
                 toks, extra = _generate(ids, g, timeout_s,
                                         cache_salt=cache_salt,
                                         adapter_id=adapter_id,
-                                        tenant=tenant)
+                                        tenant=tenant, grammar=grammar)
                 # detokenize/serialize span appended post-finish (the
                 # tracer ring keeps completed traces mutable for this);
                 # recorded BEFORE the response bytes go out so the trace
@@ -711,7 +735,7 @@ class Handler(BaseHTTPRequestHandler):
                 # still map to status codes
                 reqs = _submit_batch(_core(), ids, g, timeout_s,
                                      cache_salt, adapter_id=adapter_id,
-                                     tenant=tenant)
+                                     tenant=tenant, grammar=grammar)
                 chunks = _stream_chunks(
                     reqs, g, chunk_size=int(body.get("chunk_size", 8)))
                 self.send_response(200)
@@ -739,7 +763,12 @@ class Handler(BaseHTTPRequestHandler):
                     # back instead of letting it hammer a loaded server
                     hdrs = ({"Retry-After": _retry_after_s()}
                             if code in (429, 503) else None)
-                    self._json(code, {"error": repr(e)[:400]},
+                    # structured error body: the exception class names
+                    # the admission failure (GrammarError,
+                    # UnknownAdapterError, QueueFullError, ...) so
+                    # clients can branch without parsing repr text
+                    self._json(code, {"error": repr(e)[:400],
+                                      "error_type": type(e).__name__},
                                headers=hdrs)
             except Exception:
                 pass
@@ -954,6 +983,18 @@ def main(argv=None):
                          "watermark gap to spare (hysteresis — must be "
                          "< --kv_park_watermark; anti-starvation aging "
                          "lifts the gate after 16 scheduler steps)")
+    ap.add_argument("--structured", action="store_true",
+                    help="serve grammar-constrained requests: bodies "
+                         "may carry grammar={'type': 'json_schema'|"
+                         "'regex'|'json', ...}; specs compile to "
+                         "token-level FSMs at admission (cached by "
+                         "spec digest) and apply as per-row logit "
+                         "masks inside the one mixed-step executable "
+                         "(docs/SERVING.md 'Constrained decoding'); "
+                         "requires the ragged scheduler.  The demo "
+                         "token vocabulary is printable ASCII "
+                         "(serving.default_vocab) — real deployments "
+                         "wire their tokenizer's token strings here")
     ap.add_argument("--fleet_roles", default=None,
                     help="disaggregated fleet: comma-separated replica "
                          "roles, e.g. 'prefill,decode,mixed' — one "
@@ -1176,6 +1217,22 @@ def main(argv=None):
               "--token_budget instead of padding them to buckets",
               file=sys.stderr, flush=True)
     _STATE["ragged"] = not args.legacy_programs
+    _STATE["grammar_vocab"] = None
+    if args.structured:
+        if args.legacy_programs:
+            print("error: --structured requires the ragged mixed step "
+                  "(the grammar mask is a per-row data input); drop "
+                  "--legacy_programs", file=sys.stderr, flush=True)
+            return 2
+        from paddle_infer_tpu.serving import default_vocab
+
+        mcfg = _STATE["model"].config
+        specials = tuple(
+            s for s in (getattr(mcfg, "eos_token_id", None),
+                        getattr(mcfg, "pad_token_id", None))
+            if s is not None)
+        _STATE["grammar_vocab"] = default_vocab(
+            int(mcfg.vocab_size), specials=specials)
     _STATE["token_budget"] = args.token_budget
     _STATE["prefill_chunk"] = args.prefill_chunk
     _STATE["sched_policy"] = args.sched_policy
