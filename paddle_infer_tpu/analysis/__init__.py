@@ -21,12 +21,13 @@ from __future__ import annotations
 
 from .core import (Analyzer, FileContext, Finding, ProjectContext,
                    Rule, apply_baseline, load_baseline, write_baseline)
+from .dataflow import DataflowEngine, FlowGraph, build_engine
 from .interproc import LockGraph, ProjectIndex, build_lock_graph
 from .lockcheck import LockChecker, instrument_locks
 from .rules import RULE_CLASSES, all_rules
 
-__all__ = ["Analyzer", "FileContext", "Finding", "LockChecker",
-           "LockGraph", "ProjectContext", "ProjectIndex", "Rule",
-           "RULE_CLASSES", "all_rules", "apply_baseline",
-           "build_lock_graph", "instrument_locks", "load_baseline",
-           "write_baseline"]
+__all__ = ["Analyzer", "DataflowEngine", "FileContext", "Finding",
+           "FlowGraph", "LockChecker", "LockGraph", "ProjectContext",
+           "ProjectIndex", "Rule", "RULE_CLASSES", "all_rules",
+           "apply_baseline", "build_engine", "build_lock_graph",
+           "instrument_locks", "load_baseline", "write_baseline"]

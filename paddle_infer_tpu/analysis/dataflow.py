@@ -1,0 +1,1298 @@
+"""Forward taint / provenance dataflow over the interprocedural index.
+
+``DataflowEngine`` lowers every function body in a ``ProjectIndex``
+into one whole-program *flow graph*: nodes are value slots (locals,
+parameters, returns, class attributes, module globals) and edges are
+"value of X flows into Y" facts recorded with the source location that
+created them.  Receiver resolution, alias rules, and type hints are the
+exact same machinery ``LockWalk`` uses — each function is scanned with
+an ``interproc._Scan`` as the typing oracle, so ``rec = self._recovery``
+and the ``lock_order.type_hints`` config behave identically here.
+
+Calls are handled with **function summaries** rather than shared
+return/parameter nodes: a pre-pass computes, per function, which of its
+parameters and which external slots (class attributes, module globals,
+taint sources) flow into its return value — iterated to fixpoint so
+summaries compose through call chains — and every call site then maps
+its actual arguments through the callee's summary.  This keeps the
+analysis context-sensitive where it matters: a pure helper like
+``_round_up(x, m)`` called from both ``__init__`` (config math) and the
+admission path (prompt-length bucketing) does not smear request taint
+into the config results, which a merged ``ret:_round_up`` node would.
+Argument-to-parameter edges are still created so taint entering a call
+reaches sinks *inside* the callee body.
+
+Node id scheme (plain strings, stable across runs):
+
+  * ``var:{funckey}:{name}``   — a local / parameter of a function
+  * ``ret:{funckey}``          — a function's return value
+  * ``attr:{Class}.{attr}``    — a class attribute (instance-merged)
+  * ``global:{relpath}:{name}``— a module-level global
+  * ``src:{label}:{path}:{line}``  — a registered taint source
+  * ``sink:{label}:{path}:{line}`` — a registered taint sink
+  * ``san:{path}:{line}:{name}``   — a sanitizer call (kills labels)
+
+Two query modes sit on top:
+
+  * **forward taint** (``taint_findings``): BFS from every source of a
+    label to every sink that accepts it, skipping edges whose sanitizer
+    kills the label, reconstructing a witness path in the lock-order
+    rule's ``[source at file:line] -> file:line in qualname`` format.
+  * **backward provenance** (``classify_nodes``): reverse-reachability
+    from a value slot, classifying every dead-end ("frontier") node the
+    slice touches — ``ctor-config`` (an ``__init__`` parameter),
+    ``model-dim`` (a configured deployment-attribute class), ``const``
+    (module constant / literal), ``nondeterministic`` (a taint source),
+    or ``derived`` (anything the index cannot see past).  Any visited
+    node matching a *request-data* pattern makes the slice per-request.
+
+The analysis is field-sensitive for attributes (``attr:Request.prompt``
+is distinct from the ``Request`` object itself: passing a request
+around does not smear its field taint) and container-coarse for
+subscripts (reading ``s["ctx"]`` taints from the whole dict ``s``).
+Dict/set iteration order is detected *syntactically* — direct
+``for k in d.items()`` / ``for x in set(...)`` style iteration — so an
+order-dependent value that first detours through ``list(d.items())``
+is out of scope (documented limitation; ``sorted(...)`` is the
+sanctioned fix either way and kills the label).
+
+Executable-key provenance rides the same scan: every call configured in
+``dataflow.key_calls`` (default ``run_paged_program``) records a
+*key site*; the first argument is flattened through local tuple
+def-use chains (``mkey = (...)``, ``mkey = mkey + (...)``) into ordered
+key components, each classified by backward provenance.
+"""
+from __future__ import annotations
+
+import ast
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import FileContext, dotted
+from .interproc import (ProjectIndex, _Scan, _elem, _parse_ann,
+                        extract_bindings)
+
+__all__ = [
+    "DataflowEngine", "FlowGraph", "KeyComponent", "KeySite",
+    "TaintFinding", "build_engine", "project_engine",
+]
+
+# --------------------------------------------------- default source sets
+DEFAULT_TIME_CALLS = (
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.process_time", "time.time_ns", "time.monotonic_ns",
+    "time.perf_counter_ns", "datetime.now", "datetime.utcnow",
+)
+DEFAULT_RNG_PREFIXES = ("random.", "np.random.", "numpy.random.")
+# seeded / explicit-state constructors are NOT nondeterminism sources:
+# ``random.Random(seed)`` etc. hand the caller a reproducible stream.
+DEFAULT_RNG_SEEDED_TAILS = ("Random", "RandomState", "default_rng",
+                            "seed", "PRNGKey")
+DEFAULT_SANITIZERS: Dict[str, Tuple[str, ...]] = {
+    "sorted": ("iteration-order",),
+}
+# dict views on these attributes are insertion-ordered by construction
+# (framework registries populated in a deterministic build order), so
+# iterating them is not an iteration-order hazard.
+DEFAULT_ORDERED_ITER_ATTRS = ("_sub_layers", "_parameters", "_buffers")
+# ------------------------------------------------------ default sink sets
+DEFAULT_EMIT_CALLS = ("_emit",)
+DEFAULT_RNG_KEY_CALLS = ("PRNGKey", "fold_in")
+DEFAULT_PACKET_FUNCS = ("export_handoff",)
+DEFAULT_PACKET_CALL_TAILS = ("park",)
+# ------------------------------------------------- key provenance config
+DEFAULT_KEY_CALLS = ("run_paged_program",)
+DEFAULT_REQUEST_SOURCES = (
+    "attr:Request.",
+    "attr:CompiledGrammar.",
+    "var-param:EngineCore.submit:",
+    "var-param:Request.__init__:",
+)
+DEFAULT_DEPLOYMENT_ATTRS = (
+    "PagedGenerationEngine.", "GenerationEngine.", "KVBlockPool.",
+    "QuantizedKVPool.", "ServingMesh.", "ModelConfig.", "ServeConfig.",
+)
+
+_WITNESS_LIMIT = 8
+_EXTERN_PREFIXES = ("attr:", "global:", "src:")
+
+
+def _var(fk: str, name: str) -> str:
+    return f"var:{fk}:{name}"
+
+
+def _ret(fk: str) -> str:
+    return f"ret:{fk}"
+
+
+def _attr(cls: str, attr: str) -> str:
+    return f"attr:{cls}.{attr}"
+
+
+def _glob(relpath: str, name: str) -> str:
+    return f"global:{relpath}:{name}"
+
+
+def _unparse(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on 3.9+
+        return "<expr>"
+
+
+# ---------------------------------------------------------------- graph
+class Edge:
+    __slots__ = ("src", "dst", "path", "line", "qual", "kills")
+
+    def __init__(self, src: str, dst: str, path: str, line: int,
+                 qual: str, kills: Tuple[str, ...] = ()):
+        self.src, self.dst = src, dst
+        self.path, self.line, self.qual = path, line, qual
+        self.kills = kills
+
+
+class FlowGraph:
+    """Adjacency (forward and reverse) with location-stamped edges."""
+
+    def __init__(self):
+        self.fwd: Dict[str, List[Edge]] = {}
+        self.back: Dict[str, List[Edge]] = {}
+        self._seen: Set[Tuple[str, str, str, int]] = set()
+
+    def add(self, src: str, dst: str, path: str, line: int, qual: str,
+            kills: Tuple[str, ...] = ()):
+        if src == dst:
+            return
+        key = (src, dst, path, line)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        e = Edge(src, dst, path, line, qual, kills)
+        self.fwd.setdefault(src, []).append(e)
+        self.back.setdefault(dst, []).append(e)
+
+    def n_edges(self) -> int:
+        return len(self._seen)
+
+    def backward_slice(self, roots: Iterable[str]
+                       ) -> Tuple[Set[str], Dict[str, Edge]]:
+        """(visited nodes, parent edges) reverse-reachable from roots.
+        ``parent[n]`` is the edge whose ``src`` is ``n`` on the path
+        back toward a root."""
+        start = sorted(set(roots))
+        visited: Set[str] = set(start)
+        parent: Dict[str, Edge] = {}
+        queue = deque(start)
+        while queue:
+            n = queue.popleft()
+            for e in sorted(self.back.get(n, ()),
+                            key=lambda e: (e.src, e.path, e.line)):
+                if e.src in visited:
+                    continue
+                visited.add(e.src)
+                parent[e.src] = e
+                queue.append(e.src)
+        return visited, parent
+
+
+class Source:
+    __slots__ = ("node", "label", "path", "line", "qual", "desc")
+
+    def __init__(self, label: str, path: str, line: int, qual: str,
+                 desc: str):
+        self.node = f"src:{label}:{path}:{line}"
+        self.label, self.path, self.line = label, path, line
+        self.qual, self.desc = qual, desc
+
+
+class Sink:
+    __slots__ = ("node", "label", "path", "line", "qual", "desc",
+                 "only")
+
+    def __init__(self, label: str, path: str, line: int, qual: str,
+                 desc: str, only: Optional[Tuple[str, ...]] = None):
+        self.node = f"sink:{label}:{path}:{line}"
+        self.label, self.path, self.line = label, path, line
+        self.qual, self.desc = qual, desc
+        self.only = only            # accepted taint labels (None = all)
+
+
+class TaintFinding:
+    """A nondeterminism source reaching a sink, with a witness path."""
+    __slots__ = ("label", "source", "sink", "witness")
+
+    def __init__(self, label: str, source: Source, sink: Sink,
+                 witness: List[str]):
+        self.label, self.source, self.sink = label, source, sink
+        self.witness = witness
+
+    def witness_text(self, limit: int = _WITNESS_LIMIT) -> str:
+        head = f"[{self.label} source at {self.source.path}:" \
+               f"{self.source.line}]"
+        frames = self.witness[-limit:]
+        return " -> ".join([head] + frames) if frames else head
+
+
+class KeyComponent:
+    __slots__ = ("expr", "line", "nodes", "classes", "witness")
+
+    def __init__(self, expr: str, line: int,
+                 nodes: Tuple[str, ...]):
+        self.expr = expr
+        self.line = line
+        self.nodes = nodes
+        self.classes: Tuple[str, ...] = ()
+        self.witness: Optional[str] = None   # request-data path, if any
+
+
+class KeySite:
+    """One executable-key construction feeding the compile cache."""
+    __slots__ = ("path", "line", "qual", "label", "components")
+
+    def __init__(self, path: str, line: int, qual: str, label: str,
+                 components: List[KeyComponent]):
+        self.path, self.line, self.qual = path, line, qual
+        self.label = label
+        self.components = components
+
+    def site_id(self) -> str:
+        return f"{self.path}::{self.qual}"
+
+
+# --------------------------------------------------------------- engine
+class DataflowEngine:
+    """Whole-program flow graph + taint / provenance queries."""
+
+    def __init__(self, index: ProjectIndex,
+                 config: Optional[dict] = None):
+        cfg = config or {}
+        self.index = index
+        self.graph = FlowGraph()
+        self.sources: List[Source] = []
+        self.sinks: List[Sink] = []
+        self.key_sites: List[KeySite] = []
+        self.param_nodes: Dict[str, Tuple[str, str]] = {}
+        self.module_globals: Dict[str, Set[str]] = {}
+        self.const_globals: Set[str] = set()
+        self.mutated_globals: Set[str] = set()
+        # fk -> (param names flowing to return, extern nodes flowing
+        # to return); computed to fixpoint before the global scan
+        self.summaries: Dict[str, Tuple[frozenset, frozenset]] = {}
+        self._source_by_node: Dict[str, Source] = {}
+        self._source_index: Dict[Tuple[str, str, int], Source] = {}
+        self._sink_index: Dict[Tuple[str, str, int], Sink] = {}
+        self.time_calls = set(cfg.get("dataflow.time_calls",
+                                      DEFAULT_TIME_CALLS))
+        self.rng_prefixes = tuple(cfg.get("dataflow.rng_prefixes",
+                                          DEFAULT_RNG_PREFIXES))
+        self.sanitizers = dict(cfg.get("dataflow.sanitizers",
+                                       DEFAULT_SANITIZERS))
+        self.emit_calls = set(cfg.get("dataflow.emit_calls",
+                                      DEFAULT_EMIT_CALLS))
+        self.rng_key_calls = set(cfg.get("dataflow.rng_key_calls",
+                                         DEFAULT_RNG_KEY_CALLS))
+        self.packet_funcs = set(cfg.get("dataflow.packet_funcs",
+                                        DEFAULT_PACKET_FUNCS))
+        self.packet_call_tails = set(cfg.get(
+            "dataflow.packet_call_tails", DEFAULT_PACKET_CALL_TAILS))
+        self.key_calls = set(cfg.get("dataflow.key_calls",
+                                     DEFAULT_KEY_CALLS))
+        self.ordered_iter_attrs = set(cfg.get(
+            "dataflow.ordered_iter_attrs", DEFAULT_ORDERED_ITER_ATTRS))
+        self.request_sources = tuple(cfg.get(
+            "dataflow.request_sources", DEFAULT_REQUEST_SOURCES))
+        self.deployment_attrs = tuple(cfg.get(
+            "dataflow.deployment_attrs", DEFAULT_DEPLOYMENT_ATTRS))
+
+    # ------------------------------------------------------- building
+    def build(self) -> "DataflowEngine":
+        extract_bindings(self.index)
+        for ctx in self.index._files:
+            self._scan_module(ctx)
+        self._compute_summaries()
+        for key in sorted(self.index.functions):
+            _FlowScan(self, self.index.functions[key]).run()
+        for g in sorted(self.mutated_globals):
+            # a module global mutated from function scope is shared
+            # mutable state: its reads are a nondeterminism source
+            # (writer/reader interleaving is scheduling-dependent).
+            relpath, name = g[len("global:"):].rsplit(":", 1)
+            src = self.source("shared-mutable", relpath, 0, "<module>",
+                              f"mutable module global {name}")
+            self.graph.add(src.node, g, relpath, 0, "<module>")
+        return self
+
+    def _compute_summaries(self):
+        """Local scan per function (calls become placeholder nodes),
+        then iterate call-placeholder expansion + return-slice to
+        fixpoint so summaries compose through call chains."""
+        local: Dict[str, _FlowScan] = {}
+        for key in sorted(self.index.functions):
+            fs = _FlowScan(self, self.index.functions[key],
+                           summary_mode=True)
+            fs.run()
+            local[key] = fs
+        for fk in local:
+            self.summaries[fk] = (frozenset(), frozenset())
+        changed = True
+        rounds = 0
+        while changed and rounds < 50:
+            changed = False
+            rounds += 1
+            for fk in sorted(local):
+                fs = local[fk]
+                for cn, callee, argmap in fs.call_records:
+                    ps, ex = self.summaries.get(
+                        callee, (frozenset(), frozenset()))
+                    for p in ps:
+                        for n in sorted(argmap.get(p, ())):
+                            fs.g.add(n, cn, fs.path, 0, fs.qual)
+                    for e in sorted(ex):
+                        fs.g.add(e, cn, fs.path, 0, fs.qual)
+                visited, _ = fs.g.backward_slice([_ret(fk)])
+                new_p, new_e = set(), set()
+                for n in visited:
+                    pn = self.param_nodes.get(n)
+                    if pn is not None and pn[0] == fk:
+                        new_p.add(pn[1])
+                    elif n.startswith(_EXTERN_PREFIXES):
+                        new_e.add(n)
+                summ = (frozenset(new_p), frozenset(new_e))
+                if summ != self.summaries[fk]:
+                    self.summaries[fk] = summ
+                    changed = True
+
+    def _scan_module(self, ctx: FileContext):
+        names = self.module_globals.setdefault(ctx.relpath, set())
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+                        if _is_const_expr(node.value):
+                            self.const_globals.add(
+                                _glob(ctx.relpath, tgt.id))
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                names.add(node.target.id)
+                if node.value is not None and \
+                        _is_const_expr(node.value):
+                    self.const_globals.add(
+                        _glob(ctx.relpath, node.target.id))
+
+    # ------------------------------------------------ source/sink regs
+    def source(self, label: str, path: str, line: int, qual: str,
+               desc: str) -> Source:
+        key = (label, path, line)
+        s = self._source_index.get(key)
+        if s is None:
+            s = Source(label, path, line, qual, desc)
+            self._source_index[key] = s
+            self._source_by_node[s.node] = s
+            self.sources.append(s)
+        return s
+
+    def sink(self, label: str, path: str, line: int, qual: str,
+             desc: str, only: Optional[Tuple[str, ...]] = None) -> Sink:
+        key = (label, path, line)
+        s = self._sink_index.get(key)
+        if s is None:
+            s = Sink(label, path, line, qual, desc, only)
+            self._sink_index[key] = s
+            self.sinks.append(s)
+        return s
+
+    # -------------------------------------------------- forward taint
+    def taint_findings(self) -> List[TaintFinding]:
+        out: List[TaintFinding] = []
+        labels = sorted({s.label for s in self.sources})
+        for label in labels:
+            seeds = sorted((s for s in self.sources
+                            if s.label == label),
+                           key=lambda s: (s.path, s.line))
+            parent: Dict[str, Edge] = {}
+            seen: Set[str] = {s.node for s in seeds}
+            queue = deque(sorted(seen))
+            while queue:
+                n = queue.popleft()
+                for e in sorted(self.graph.fwd.get(n, ()),
+                                key=lambda e: (e.dst, e.path, e.line)):
+                    if label in e.kills or e.dst in seen:
+                        continue
+                    seen.add(e.dst)
+                    parent[e.dst] = e
+                    queue.append(e.dst)
+            for sink in sorted(self.sinks,
+                               key=lambda s: (s.path, s.line, s.label)):
+                if sink.only is not None and label not in sink.only:
+                    continue
+                if sink.node not in seen:
+                    continue
+                frames, src_node = self._trace(sink.node, parent)
+                src = self._source_by_node.get(src_node)
+                if src is None:
+                    continue
+                out.append(TaintFinding(label, src, sink, frames))
+        return out
+
+    def _trace(self, node: str, parent: Dict[str, Edge]
+               ) -> Tuple[List[str], str]:
+        frames: List[str] = []
+        guard = 0
+        while node in parent and guard < 10000:
+            e = parent[node]
+            frames.append(f"{e.path}:{e.line} in {e.qual}")
+            node = e.src
+            guard += 1
+        frames.reverse()
+        dedup: List[str] = []
+        for f in frames:
+            if not dedup or dedup[-1] != f:
+                dedup.append(f)
+        return dedup, node
+
+    # -------------------------------------------- backward provenance
+    def classify_nodes(self, nodes: Iterable[str]
+                       ) -> Tuple[Tuple[str, ...], Optional[str]]:
+        """(sorted classes, request-data witness or None) for the
+        backward slice from ``nodes``."""
+        roots = sorted(set(nodes))
+        if not roots:
+            return (("const",), None)
+        visited, parent = self.graph.backward_slice(roots)
+        classes: Set[str] = set()
+        witness: Optional[str] = None
+        for n in sorted(visited):
+            if self._is_request_node(n):
+                classes.add("request-data")
+                if witness is None:
+                    witness = self._request_witness(n, parent)
+        for n in sorted(visited):
+            if not self.graph.back.get(n):
+                c = self._frontier_class(n)
+                if c:
+                    classes.add(c)
+        return (tuple(sorted(classes)) or ("derived",), witness)
+
+    def _request_witness(self, node: str, parent: Dict[str, Edge]
+                         ) -> str:
+        frames: List[str] = []
+        head = f"[request-data {node}]"
+        n = node
+        guard = 0
+        while n in parent and guard < 10000:
+            e = parent[n]
+            frames.append(f"{e.path}:{e.line} in {e.qual}")
+            n = e.dst
+            guard += 1
+        dedup: List[str] = []
+        for f in frames:
+            if not dedup or dedup[-1] != f:
+                dedup.append(f)
+        return " -> ".join([head] + dedup[:_WITNESS_LIMIT])
+
+    def _is_request_node(self, node: str) -> bool:
+        probe = node
+        if node.startswith("var:") and node in self.param_nodes:
+            fk, pname = self.param_nodes[node]
+            qual = fk.split("::", 1)[1] if "::" in fk else fk
+            probe = f"var-param:{qual}:{pname}"
+            if pname in ("self", "cls"):
+                return False
+        for pat in self.request_sources:
+            if probe.startswith(pat):
+                return True
+        return False
+
+    def _frontier_class(self, node: str) -> Optional[str]:
+        if node.startswith("src:"):
+            return "nondeterministic"
+        if node in self.param_nodes:
+            fk, pname = self.param_nodes[node]
+            if pname in ("self", "cls"):
+                return None
+            qual = fk.split("::", 1)[1] if "::" in fk else fk
+            if qual.endswith("__init__"):
+                return "ctor-config"
+            return "derived"
+        if node.startswith("attr:"):
+            body = node[len("attr:"):]
+            for pat in self.deployment_attrs:
+                if body.startswith(pat):
+                    return "model-dim"
+            return "derived"
+        if node.startswith("global:"):
+            return "derived"
+        return "derived"
+
+    # ----------------------------------------------- key provenance
+    def key_table(self) -> dict:
+        """Classify every key site; line-number-free stable dict (the
+        ``tools/key_provenance_baseline.json`` payload)."""
+        sites = []
+        seen = set()
+        for ks in self.key_sites:
+            for c in ks.components:
+                if not c.classes:
+                    c.classes, c.witness = self.classify_nodes(c.nodes)
+            fp = (ks.site_id(), ks.label,
+                  tuple((c.expr, c.classes) for c in ks.components))
+            if fp in seen:
+                continue
+            seen.add(fp)
+            sites.append({
+                "site": ks.site_id(),
+                "key": ks.label,
+                "components": [{"expr": c.expr,
+                                "classes": sorted(c.classes)}
+                               for c in ks.components],
+            })
+        sites.sort(key=lambda s: (s["site"], s["key"]))
+        return {"version": 1, "sites": sites}
+
+    def key_findings(self) -> List[Tuple[KeySite, KeyComponent]]:
+        """Key components whose backward slice reaches request data."""
+        self.key_table()        # ensure classification ran
+        out = []
+        for ks in self.key_sites:
+            for c in ks.components:
+                if "request-data" in c.classes:
+                    out.append((ks, c))
+        return out
+
+    def to_dot(self) -> str:
+        """Key-provenance DOT: one node per key site, one per
+        provenance class it draws from."""
+        table = self.key_table()
+        lines = ["digraph key_provenance {", "  rankdir=LR;"]
+        classes: Set[str] = set()
+        for s in table["sites"]:
+            sid = f'{s["site"]} [{s["key"]}]'
+            lines.append(f'  "{sid}" [shape=box];')
+            for c in s["components"]:
+                for cls in c["classes"]:
+                    classes.add(cls)
+                    lines.append(f'  "{cls}" -> "{sid}";')
+        for cls in sorted(classes):
+            shape = ("octagon" if cls == "request-data"
+                     else "ellipse")
+            lines.append(f'  "{cls}" [shape={shape}];')
+        # stable output: header, then sorted unique body lines
+        body = sorted(set(lines[2:]))
+        return "\n".join(lines[:2] + body + ["}"]) + "\n"
+
+
+def _is_const_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return all(_is_const_expr(e) for e in node.elts)
+    if isinstance(node, ast.Dict):
+        return all(k is not None and _is_const_expr(k)
+                   for k in node.keys) and \
+            all(_is_const_expr(v) for v in node.values)
+    if isinstance(node, ast.UnaryOp):
+        return _is_const_expr(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _is_const_expr(node.left) and _is_const_expr(node.right)
+    return False
+
+
+# ------------------------------------------------------- per-function
+class _FlowScan:
+    """Lower one function body into flow-graph edges.
+
+    Mirrors ``interproc._Scan``'s statement walk (same closure
+    inlining, same comprehension scoping) while maintaining a live
+    ``_Scan`` as the typing oracle — its ``env``/``env_expr`` are
+    updated with exactly the assignments ``_Scan._stmt`` tracks, so
+    receiver resolution agrees with the lock walk.
+
+    Two modes: the *summary* pre-pass lowers into a private graph with
+    resolved calls as placeholder nodes (recorded in ``call_records``
+    for fixpoint expansion, no source/sink registration); the *global*
+    pass lowers into the engine graph, applying the computed summaries
+    at every resolved call site."""
+
+    def __init__(self, eng: DataflowEngine, fi,
+                 summary_mode: bool = False):
+        self.eng = eng
+        self.ix = eng.index
+        self.fi = fi
+        self.fk = fi.key
+        self.path = fi.ctx.relpath
+        self.qual = fi.qualname
+        self.summary_mode = summary_mode
+        self.g = FlowGraph() if summary_mode else eng.graph
+        # (placeholder node, callee key, callee-param -> arg nodes)
+        self.call_records: List[
+            Tuple[str, str, Dict[str, Set[str]]]] = []
+        self._n_calls = 0
+        self.scan = _Scan(eng.index, fi)
+        a = fi.node.args
+        params = [p.arg for p in
+                  (a.posonlyargs + a.args + a.kwonlyargs)]
+        if a.vararg:
+            params.append(a.vararg.arg)
+        if a.kwarg:
+            params.append(a.kwarg.arg)
+        self.locals: Set[str] = set(params)
+        self.param_set: Set[str] = set(params)
+        for p in params:
+            self.eng.param_nodes[_var(self.fk, p)] = (self.fk, p)
+        # light SSA: each plain assignment mints a fresh node version
+        # (``var:fk:name@line.k``); branch joins and loop headers get
+        # phi merges.  The unversioned base node is the parameter /
+        # read-before-write slot (what callers wire arguments into).
+        self.cur: Dict[str, str] = {}
+        self._vcount: Dict[str, int] = {}
+        self.global_decls: Set[str] = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Store):
+                self.locals.add(node.id)
+            elif isinstance(node, ast.Global):
+                self.global_decls.update(node.names)
+
+    def run(self):
+        self._body(self.fi.node.body)
+
+    # --------------------------------------------------------- edges
+    def _edge(self, srcs: Iterable[str], dst: str, line: int,
+              kills: Tuple[str, ...] = ()):
+        for s in sorted(srcs):
+            self.g.add(s, dst, self.path, line, self.qual, kills)
+
+    def _source_node(self, label: str, line: int, desc: str) -> str:
+        if self.summary_mode:
+            return f"src:{label}:{self.path}:{line}"
+        return self.eng.source(label, self.path, line, self.qual,
+                               desc).node
+
+    # ------------------------------------------------- SSA versions
+    def _read_node(self, name: str) -> str:
+        return self.cur.get(name, _var(self.fk, name))
+
+    def _new_ver(self, name: str, line: int) -> str:
+        k = self._vcount.get(name, 0) + 1
+        self._vcount[name] = k
+        nid = f"var:{self.fk}:{name}@{line}.{k}"
+        self.cur[name] = nid
+        return nid
+
+    def _merge(self, snap: Dict[str, str],
+               branches: List[Dict[str, str]], line: int
+               ) -> Dict[str, str]:
+        """Join versions after exclusive branches: any name whose
+        version differs across paths gets a phi node fed by every
+        reaching version (falling back to the pre-branch version, or
+        the base/parameter node, when a branch did not assign)."""
+        out = dict(snap)
+        names: Set[str] = set()
+        for b in branches:
+            names.update(n for n in b if b[n] != snap.get(n))
+        for name in sorted(names):
+            srcs: Set[str] = set()
+            for b in branches:
+                v = b.get(name) or snap.get(name)
+                if v is None and name in self.param_set:
+                    v = _var(self.fk, name)
+                if v is not None:
+                    srcs.add(v)
+            if len(srcs) == 1:
+                out[name] = next(iter(srcs))
+                continue
+            nid = self._new_ver(name, line)
+            for s in sorted(srcs):
+                self.g.add(s, nid, self.path, line, self.qual)
+            out[name] = nid
+        return out
+
+    def _loop_phi(self, assigned: Iterable[str], line: int
+                  ) -> Dict[str, str]:
+        """Loop-header phi: body reads of loop-carried names must see
+        both the pre-loop version and the end-of-body version (wired
+        back by ``_loop_close``)."""
+        phi: Dict[str, str] = {}
+        for name in sorted(set(assigned)):
+            prev = self.cur.get(name)
+            if prev is None and name in self.param_set:
+                prev = _var(self.fk, name)
+            nid = self._new_ver(name, line)
+            if prev is not None:
+                self.g.add(prev, nid, self.path, line, self.qual)
+            phi[name] = nid
+        return phi
+
+    def _loop_close(self, phi: Dict[str, str], line: int):
+        for name, nid in sorted(phi.items()):
+            end = self.cur.get(name)
+            if end is not None and end != nid:
+                self.g.add(end, nid, self.path, line, self.qual)
+            self.cur[name] = nid
+
+    @staticmethod
+    def _stored_names(node: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and \
+                    isinstance(n.ctx, ast.Store):
+                out.add(n.id)
+        return out
+
+    # ---------------------------------------------------- statements
+    def _body(self, stmts):
+        for st in stmts:
+            self._stmt(st)
+
+    def _stmt(self, st):
+        if isinstance(st, ast.Assign):
+            vals = self._value(st.value)
+            for tgt in st.targets:
+                self._assign_to(tgt, vals, st.lineno)
+            self._update_env(st)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                vals = self._value(st.value)
+                self._assign_to(st.target, vals, st.lineno)
+            if isinstance(st.target, ast.Name):
+                t = _parse_ann(st.annotation)
+                if t:
+                    self.scan.env[st.target.id] = t
+        elif isinstance(st, ast.AugAssign):
+            # x += v reads the old version and writes a new one
+            # (_value ignores expression ctx, so the Store-ctx target
+            # reads fine)
+            vals = self._value(st.value) | self._value(st.target)
+            self._assign_to(st.target, vals, st.lineno)
+        elif isinstance(st, ast.Return):
+            if st.value is not None:
+                vals = self._value(st.value)
+                self._edge(vals, _ret(self.fk), st.lineno)
+                if not self.summary_mode and \
+                        self.fi.node.name in self.eng.packet_funcs:
+                    sk = self.eng.sink(
+                        "packet", self.path, st.lineno, self.qual,
+                        f"return of {self.qual}")
+                    self._edge(vals, sk.node, st.lineno)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            it_vals = self._value(st.iter)
+            if _is_unordered_iter(st.iter,
+                                  self.eng.ordered_iter_attrs):
+                src = self._source_node(
+                    "iteration-order", st.iter.lineno,
+                    _unparse(st.iter))
+                it_vals = set(it_vals) | {src}
+            phi = self._loop_phi(self._stored_names(st), st.lineno)
+            self._assign_to(st.target, it_vals, st.lineno)
+            et = _elem(self.scan._type_of(st.iter))
+            if isinstance(st.target, ast.Name) and et:
+                self.scan.env[st.target.id] = et
+            self._body(st.body)
+            self._loop_close(phi, st.lineno)
+            self._body(st.orelse)
+        elif isinstance(st, ast.While):
+            phi = self._loop_phi(self._stored_names(st), st.lineno)
+            self._value(st.test)
+            self._body(st.body)
+            self._loop_close(phi, st.lineno)
+            self._body(st.orelse)
+        elif isinstance(st, ast.If):
+            self._value(st.test)
+            snap = dict(self.cur)
+            self._body(st.body)
+            after_body = dict(self.cur)
+            self.cur = dict(snap)
+            self._body(st.orelse)
+            after_else = dict(self.cur)
+            self.cur = self._merge(snap, [after_body, after_else],
+                                   st.lineno)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                v = self._value(item.context_expr)
+                if item.optional_vars is not None:
+                    self._assign_to(item.optional_vars, v, st.lineno)
+            self._body(st.body)
+        elif isinstance(st, ast.Try):
+            snap = dict(self.cur)
+            self._body(st.body)
+            self._body(st.orelse)
+            outs = [dict(self.cur)]
+            for h in st.handlers:
+                self.cur = dict(snap)
+                self._body(h.body)
+                outs.append(dict(self.cur))
+            self.cur = self._merge(snap, outs, st.lineno)
+            self._body(st.finalbody)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # closures run inline in this codebase (matches _Scan)
+            self._body(st.body)
+        elif isinstance(st, ast.ClassDef):
+            pass
+        elif isinstance(st, ast.Expr):
+            self._value(st.value)
+        elif isinstance(st, ast.Raise):
+            if st.exc is not None:
+                self._value(st.exc)
+        elif isinstance(st, ast.Global):
+            for name in st.names:
+                self.eng.mutated_globals.add(_glob(self.path, name))
+        elif isinstance(st, (ast.Assert, ast.Delete, ast.Pass,
+                             ast.Break, ast.Continue, ast.Import,
+                             ast.ImportFrom, ast.Nonlocal)):
+            pass
+        else:
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._value(child)
+                elif isinstance(child, ast.stmt):
+                    self._stmt(child)
+
+    def _update_env(self, st: ast.Assign):
+        # identical typing updates to _Scan._stmt's Assign branch
+        if len(st.targets) == 1 and isinstance(st.targets[0], ast.Name):
+            name = st.targets[0].id
+            t = self.scan._type_of(st.value)
+            if t:
+                self.scan.env[name] = t
+            chain = dotted(st.value)
+            if chain and "." in chain:
+                self.scan.env_expr[name] = self.scan._chain(st.value)
+            else:
+                self.scan.env_expr.pop(name, None)
+
+    def _assign_to(self, tgt, vals: Set[str], line: int):
+        if isinstance(tgt, ast.Name):
+            if tgt.id in self.global_decls:
+                self._edge(vals, _glob(self.path, tgt.id), line)
+                return
+            self.locals.add(tgt.id)
+            self._edge(vals, self._new_ver(tgt.id, line), line)
+        elif isinstance(tgt, ast.Attribute):
+            base_t = self.scan._type_of(tgt.value)
+            if base_t:
+                self._edge(vals, _attr(base_t, tgt.attr), line)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._assign_to(e, vals, line)
+        elif isinstance(tgt, ast.Starred):
+            self._assign_to(tgt.value, vals, line)
+        elif isinstance(tgt, ast.Subscript):
+            self._value(tgt.slice)
+            for n in self._container_nodes(tgt.value):
+                self._edge(vals, n, line)
+
+    def _container_nodes(self, node) -> Set[str]:
+        """L-value container slots for a subscript store."""
+        if isinstance(node, ast.Name):
+            if node.id in self.locals:
+                return {self._read_node(node.id)}
+            return set()
+        if isinstance(node, ast.Attribute):
+            base_t = self.scan._type_of(node.value)
+            if base_t:
+                return {_attr(base_t, node.attr)}
+            return self._container_nodes(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._container_nodes(node.value)
+        return set()
+
+    # --------------------------------------------------- expressions
+    def _value(self, node) -> Set[str]:
+        if node is None or isinstance(node, ast.Constant):
+            return set()
+        if isinstance(node, ast.Name):
+            if node.id in self.global_decls:
+                return {_glob(self.path, node.id)}
+            if node.id in self.locals:
+                return {self._read_node(node.id)}
+            if node.id in self.eng.module_globals.get(self.path, ()):
+                g = _glob(self.path, node.id)
+                if g in self.eng.const_globals:
+                    return set()
+                return {g}
+            return set()
+        if isinstance(node, ast.Attribute):
+            base_vals = self._value(node.value)
+            base_t = self.scan._type_of(node.value)
+            if base_t:
+                r = self.ix.find_method(base_t, node.attr)
+                if r is not None and r[2]:      # property read
+                    return self._apply_summary(
+                        r[1], [], [], base_vals, node.lineno)
+                return {_attr(base_t, node.attr)}
+            return base_vals
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.BinOp):
+            return self._value(node.left) | self._value(node.right)
+        if isinstance(node, ast.BoolOp):
+            out: Set[str] = set()
+            for v in node.values:
+                out |= self._value(v)
+            return out
+        if isinstance(node, ast.UnaryOp):
+            return self._value(node.operand)
+        if isinstance(node, ast.Compare):
+            out = self._value(node.left)
+            for c in node.comparators:
+                out |= self._value(c)
+            return out
+        if isinstance(node, ast.IfExp):
+            self._value(node.test)
+            return self._value(node.body) | self._value(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            out = set()
+            for e in node.elts:
+                out |= self._value(e)
+            return out
+        if isinstance(node, ast.Dict):
+            out = set()
+            for k in node.keys:
+                if k is not None:
+                    out |= self._value(k)
+            for v in node.values:
+                out |= self._value(v)
+            return out
+        if isinstance(node, ast.Subscript):
+            self._value(node.slice)
+            return self._value(node.value)
+        if isinstance(node, ast.Slice):
+            out = set()
+            for part in (node.lower, node.upper, node.step):
+                if part is not None:
+                    out |= self._value(part)
+            return out
+        if isinstance(node, ast.JoinedStr):
+            out = set()
+            for v in node.values:
+                out |= self._value(v)
+            return out
+        if isinstance(node, ast.FormattedValue):
+            return self._value(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp,
+                             ast.GeneratorExp, ast.DictComp)):
+            return self._comp(node)
+        if isinstance(node, ast.Lambda):
+            return set()
+        if isinstance(node, ast.Starred):
+            return self._value(node.value)
+        if isinstance(node, ast.Await):
+            return self._value(node.value)
+        if isinstance(node, (ast.Yield, ast.YieldFrom)):
+            # a generator's "return value" is what it yields
+            if node.value is not None:
+                self._edge(self._value(node.value), _ret(self.fk),
+                           node.lineno)
+            return set()
+        if isinstance(node, ast.NamedExpr):
+            vals = self._value(node.value)
+            self._assign_to(node.target, vals, node.lineno)
+            return vals
+        out = set()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                out |= self._value(child)
+        return out
+
+    def _comp(self, node) -> Set[str]:
+        saved = dict(self.scan.env)
+        saved_cur = dict(self.cur)
+        for g in node.generators:
+            it_vals = self._value(g.iter)
+            if _is_unordered_iter(g.iter,
+                                  self.eng.ordered_iter_attrs):
+                src = self._source_node(
+                    "iteration-order", g.iter.lineno, _unparse(g.iter))
+                it_vals = set(it_vals) | {src}
+            self._assign_to(g.target, it_vals, node.lineno)
+            et = _elem(self.scan._type_of(g.iter))
+            if isinstance(g.target, ast.Name) and et:
+                self.scan.env[g.target.id] = et
+            for cond in g.ifs:
+                self._value(cond)
+        if isinstance(node, ast.DictComp):
+            out = self._value(node.key) | self._value(node.value)
+        else:
+            out = self._value(node.elt)
+        self.scan.env = saved
+        self.cur = saved_cur
+        return out
+
+    # --------------------------------------------------------- calls
+    def _call(self, call: ast.Call) -> Set[str]:
+        d = dotted(call.func) or ""
+        tail = d.split(".")[-1] if d else ""
+        line = call.lineno
+        argvals = [self._value(a) for a in call.args]
+        kwvals = [(kw.arg, self._value(kw.value))
+                  for kw in call.keywords]
+        allvals: Set[str] = set()
+        for v in argvals:
+            allvals |= v
+        for _, v in kwvals:
+            allvals |= v
+        if not d:
+            allvals |= self._value(call.func)
+
+        if not self.summary_mode and tail in self.eng.key_calls \
+                and call.args:
+            self._key_site(call)
+
+        label = self._source_label(d, tail)
+        if label is not None:
+            src = self._source_node(label, line, f"{d}()")
+            return allvals | {src}
+
+        if tail in self.eng.sanitizers and call.args:
+            kills = tuple(self.eng.sanitizers[tail])
+            san = f"san:{self.path}:{line}:{tail}"
+            self._edge(argvals[0], san, line, kills=kills)
+            return {san}
+
+        if not self.summary_mode:
+            self._check_sink_call(d, tail, call, allvals, line)
+
+        target = self.scan._resolve_call_target(call)
+        if target is not None:
+            key = target[0]
+            if isinstance(key, tuple):          # ("cb", cls, attr)
+                b = self.ix.bindings.get((key[1], key[2]))
+                key = b.target if b is not None else None
+            if key is not None:
+                fi = self.ix.functions.get(key)
+                if fi is not None:
+                    recv_vals: Set[str] = set()
+                    if isinstance(call.func, ast.Attribute):
+                        recv_vals = self._value(call.func.value)
+                    if self.summary_mode:
+                        return self._record_call(
+                            key, fi, argvals, kwvals, recv_vals, call)
+                    self._wire_args(call, fi, argvals, kwvals, line)
+                    pos, kwmap = self._map_args(fi, argvals, kwvals)
+                    return self._apply_summary(
+                        key, pos, kwmap, recv_vals, line)
+
+        if tail in self.ix.classes:
+            init = self.ix.find_method(tail, "__init__")
+            if init is not None and not init[2] and \
+                    not self.summary_mode:
+                fi = self.ix.functions.get(init[1])
+                if fi is not None:
+                    self._wire_args(call, fi, argvals, kwvals, line)
+            # the object itself carries no field taint (fields are
+            # tracked as attr: nodes by the ctor's own scan)
+            return set()
+
+        # unresolved call: conservative pass-through of the arguments
+        # and, for method calls, the receiver (``d.pop()`` / ``d.get(k)``
+        # style container reads return container contents)
+        if isinstance(call.func, ast.Attribute):
+            allvals |= self._value(call.func.value)
+        return allvals
+
+    def _map_args(self, fi, argvals, kwvals):
+        """Positional/keyword argument node-sets keyed by the callee's
+        parameter names."""
+        a = fi.node.args
+        params = [p.arg for p in (a.posonlyargs + a.args)]
+        if fi.cls is not None and params:
+            params = params[1:]
+        pos = list(zip(params, argvals))
+        kwmap = [(kwname, vals) for kwname, vals in kwvals if kwname]
+        return pos, kwmap
+
+    def _apply_summary(self, key: str, pos, kwmap,
+                       recv_vals: Set[str], line: int) -> Set[str]:
+        """Call-site value via the callee's return summary: actual
+        argument nodes for summary parameters, plus the callee's
+        extern (attr/global/source) return dependencies."""
+        ps, ex = self.eng.summaries.get(key, (frozenset(), frozenset()))
+        out: Set[str] = set(ex)
+        for pname, vals in pos:
+            if pname in ps:
+                out |= vals
+        for kwname, vals in kwmap:
+            if kwname in ps:
+                out |= vals
+        if "self" in ps:
+            out |= recv_vals
+        return out
+
+    def _record_call(self, key: str, fi, argvals, kwvals,
+                     recv_vals: Set[str], call: ast.Call) -> Set[str]:
+        """Summary-mode: a placeholder node whose inputs are expanded
+        from the callee's summary during the fixpoint."""
+        self._n_calls += 1
+        cn = f"call:{self.path}:{call.lineno}:{self._n_calls}"
+        argmap: Dict[str, Set[str]] = {}
+        pos, kwmap = self._map_args(fi, argvals, kwvals)
+        for pname, vals in pos:
+            argmap.setdefault(pname, set()).update(vals)
+        for kwname, vals in kwmap:
+            argmap.setdefault(kwname, set()).update(vals)
+        if recv_vals:
+            argmap["self"] = set(recv_vals)
+        self.call_records.append((cn, key, argmap))
+        return {cn}
+
+    def _source_label(self, d: str, tail: str) -> Optional[str]:
+        if d in self.eng.time_calls:
+            return "time"
+        for pre in self.eng.rng_prefixes:
+            if d.startswith(pre) and \
+                    tail not in DEFAULT_RNG_SEEDED_TAILS:
+                return "unseeded-rng"
+        if d == "id":
+            return "id"
+        return None
+
+    def _check_sink_call(self, d: str, tail: str, call: ast.Call,
+                         allvals: Set[str], line: int):
+        if tail in self.eng.rng_key_calls and allvals:
+            sk = self.eng.sink("rng-key", self.path, line, self.qual,
+                               f"{d}()")
+            self._edge(allvals, sk.node, line)
+        if tail in self.eng.emit_calls and allvals:
+            sk = self.eng.sink("token-emit", self.path, line,
+                               self.qual, f"{d}()")
+            self._edge(allvals, sk.node, line)
+        if tail in self.eng.packet_call_tails and allvals and \
+                isinstance(call.func, ast.Attribute):
+            sk = self.eng.sink("packet", self.path, line, self.qual,
+                               f"{d}()")
+            self._edge(allvals, sk.node, line)
+        if d in ("json.dumps", "json.dump") and allvals:
+            sorts = any(kw.arg == "sort_keys"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                        for kw in call.keywords)
+            if not sorts:
+                sk = self.eng.sink(
+                    "serialized-json", self.path, line, self.qual,
+                    f"{d}() without sort_keys=True",
+                    only=("iteration-order",))
+                self._edge(allvals, sk.node, line)
+
+    def _wire_args(self, call: ast.Call, fi, argvals, kwvals,
+                   line: int):
+        """Argument-to-parameter edges so taint reaches sinks inside
+        the callee body (return flow goes through the summary)."""
+        a = fi.node.args
+        params = [p.arg for p in (a.posonlyargs + a.args)]
+        if fi.cls is not None and params:
+            params = params[1:]
+        for pname, vals in zip(params, argvals):
+            self._edge(vals, _var(fi.key, pname), line)
+        for kwname, vals in kwvals:
+            if kwname:
+                self._edge(vals, _var(fi.key, kwname), line)
+            else:                   # **kwargs expansion: smear
+                for pname in params:
+                    self._edge(vals, _var(fi.key, pname), line)
+
+    # ----------------------------------------------------- key sites
+    def _key_site(self, call: ast.Call):
+        comps = self._flatten_key(call.args[0], call.lineno)
+        label = self.qual
+        for c in comps:
+            if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                label = c.value
+                break
+        items: List[KeyComponent] = []
+        for c in comps:
+            cl = getattr(c, "lineno", call.lineno)
+            if isinstance(c, ast.Constant):
+                items.append(KeyComponent(_unparse(c), cl, ()))
+            else:
+                nodes = tuple(sorted(self._value(c)))
+                items.append(KeyComponent(_unparse(c), cl, nodes))
+        self.eng.key_sites.append(
+            KeySite(self.path, call.lineno, self.qual, label, items))
+
+    def _flatten_key(self, arg, upto_line: int) -> List[ast.expr]:
+        if isinstance(arg, ast.Tuple):
+            return self._flatten_elts(arg.elts)
+        if not isinstance(arg, ast.Name):
+            return [arg]
+        name = arg.id
+        comps: List[ast.expr] = []
+        assigns = [st for st in ast.walk(self.fi.node)
+                   if isinstance(st, ast.Assign)
+                   and st.lineno < upto_line
+                   and len(st.targets) == 1
+                   and isinstance(st.targets[0], ast.Name)
+                   and st.targets[0].id == name]
+        for st in sorted(assigns, key=lambda s: s.lineno):
+            v = st.value
+            ext = self._key_extension(v, name)
+            if ext is not None:
+                comps.extend(ext)
+            elif isinstance(v, ast.Tuple):
+                comps = self._flatten_elts(v.elts)
+            else:
+                comps = [v]
+        return comps or [arg]
+
+    def _key_extension(self, v, name: str
+                       ) -> Optional[List[ast.expr]]:
+        """``name + (...)`` concatenation -> the new elements."""
+        if isinstance(v, ast.BinOp) and isinstance(v.op, ast.Add) \
+                and isinstance(v.left, ast.Name) \
+                and v.left.id == name:
+            if isinstance(v.right, ast.Tuple):
+                return self._flatten_elts(v.right.elts)
+            return [v.right]
+        return None
+
+    @staticmethod
+    def _flatten_elts(elts) -> List[ast.expr]:
+        out: List[ast.expr] = []
+        for e in elts:
+            if isinstance(e, ast.Starred):
+                out.append(e.value)
+            else:
+                out.append(e)
+        return out
+
+
+def _is_unordered_iter(node, ordered_attrs=()) -> bool:
+    """Syntactic: iterating a dict view or a set expression.  Views on
+    ``ordered_attrs`` receivers (framework registries with
+    deterministic insertion order) are exempt."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and \
+                f.attr in ("keys", "values", "items"):
+            if isinstance(f.value, ast.Attribute) and \
+                    f.value.attr in ordered_attrs:
+                return False
+            if isinstance(f.value, ast.Name) and \
+                    f.value.id in ordered_attrs:
+                return False
+            return True
+        if isinstance(f, ast.Name) and f.id in ("set", "frozenset"):
+            return True
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return False
+
+
+# ------------------------------------------------------------ assembly
+def build_engine(files: Iterable[FileContext],
+                 config: Optional[dict] = None) -> DataflowEngine:
+    index = ProjectIndex(files, config)
+    return DataflowEngine(index, config).build()
+
+
+_CACHE_ATTR = "_dataflow_engine"
+
+
+def project_engine(project) -> DataflowEngine:
+    """Engine shared across rules within one Analyzer run (building
+    the flow graph twice per lint run would double CI cost)."""
+    eng = getattr(project, _CACHE_ATTR, None)
+    if eng is None:
+        eng = build_engine(project.files, project.config)
+        setattr(project, _CACHE_ATTR, eng)
+    return eng

@@ -9,8 +9,10 @@ from __future__ import annotations
 from typing import List
 
 from ..core import Rule
+from .determinism import DeterminismRule
 from .donation import DonationRule
 from .host_sync import HostSyncRule
+from .key_provenance import KeyProvenanceRule
 from .lock_discipline import LockDisciplineRule
 from .lock_order import LockOrderRule
 from .metric_sync import MetricSyncRule
@@ -29,6 +31,8 @@ RULE_CLASSES = [
     MetricSyncRule,
     PallasGridRule,
     LockOrderRule,
+    KeyProvenanceRule,
+    DeterminismRule,
 ]
 
 
@@ -48,7 +52,8 @@ def all_rules(only=None) -> List[Rule]:
     return [known[r]() for r in wanted]
 
 
-__all__ = ["RULE_CLASSES", "all_rules", "DonationRule", "HostSyncRule",
+__all__ = ["RULE_CLASSES", "all_rules", "DeterminismRule",
+           "DonationRule", "HostSyncRule", "KeyProvenanceRule",
            "LockDisciplineRule", "LockOrderRule", "MetricSyncRule",
            "PallasGridRule", "RecompileHazardRule", "TracedBranchRule",
            "TracerLeakRule"]

@@ -1,0 +1,81 @@
+"""determinism: nondeterminism sources must not reach replay state.
+
+The bitwise-replay invariant says a request's token stream is a pure
+function of (seed, rid, step) plus deployment config — a parked or
+handed-off request resumes with identical bits on any replica.  This
+rule taints the value-level nondeterminism sources and reports any
+flow into the state that must replay:
+
+  sources                         label
+  ------------------------------- ----------------
+  ``time.time``/``monotonic``/…   ``time``
+  unseeded ``random.*`` /         ``unseeded-rng``
+  ``np.random.*`` module calls
+  ``dict``/``set`` iteration      ``iteration-order``
+  (direct ``for k in d.items()``
+  / ``for x in set(...)`` forms)
+  ``id()``                        ``id``
+  module globals mutated from     ``shared-mutable``
+  function scope
+
+  sinks
+  --------------------------------------------------
+  token emission (``Request._emit`` arguments)
+  handoff / park packet serialization
+  (``export_handoff`` returns, ``tier.park(...)`` arguments)
+  RNG-key construction (``PRNGKey`` / ``fold_in`` arguments)
+  unsorted JSON serialization (``json.dumps`` without
+  ``sort_keys=True``; ``iteration-order`` label only)
+
+``sorted(...)`` sanitizes the ``iteration-order`` label — a dict walk
+whose order is immediately canonicalized is deterministic.  Witnesses
+use the lock-order rule's frame format: ``[<label> source at
+file:line] -> file:line in qualname -> ...``.
+
+Thread-shared *object* state under missing locks is the lock-order
+rule's domain (its instrumented-lock walk); this rule covers the
+value-level sources listed above.  Scope: findings are emitted for
+``serving/`` and ``observability/`` files (the replay-critical
+planes); the flow graph itself spans every analyzed file.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from ..core import Finding, ProjectContext, Rule
+from ..dataflow import DataflowEngine, project_engine
+
+_SCOPE = ("serving/", "observability/")
+
+
+class DeterminismRule(Rule):
+    id = "determinism"
+    name = "determinism taint"
+    rationale = (
+        "Bitwise-replayable token streams require that wall-clock "
+        "time, unseeded RNG, container iteration order, object "
+        "identity, and shared mutable globals never flow into token "
+        "emission, handoff/park packets, or RNG-key construction.")
+    # finalize-only rule; scope filtering happens on finding paths.
+    path_scope = ()
+
+    def __init__(self):
+        self.engine: Optional[DataflowEngine] = None
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        self.engine = project_engine(project)
+        out: List[Finding] = []
+        seen = set()
+        for tf in self.engine.taint_findings():
+            if not any(seg in tf.sink.path for seg in _SCOPE):
+                continue
+            key = (tf.label, tf.sink.path, tf.sink.line)
+            if key in seen:
+                continue
+            seen.add(key)
+            msg = (f"nondeterminism ({tf.label}) reaches "
+                   f"{tf.sink.label} sink {tf.sink.desc} "
+                   f"(witness: {tf.witness_text()})")
+            out.append(Finding(self.id, tf.sink.path, tf.sink.line, 1,
+                               msg, tf.sink.qual))
+        return out

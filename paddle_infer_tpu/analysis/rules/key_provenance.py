@@ -1,0 +1,75 @@
+"""key-provenance: executable keys must derive from deployment
+constants only.
+
+The serving plane's "one executable, zero post-warmup compiles" claim
+is exactly a provenance property: every component of every program key
+handed to ``run_paged_program`` (the compile-cache lookup) must trace
+back to deployment-time constants — serve CLI flags (which enter as
+engine-ctor parameters), ``ServingMesh``/engine configuration, vocab
+and model dimensions — and never to per-request data (``Request``
+fields, queue payloads, grammar specs, adapter ids).  A request-shaped
+key component means the compile cache keys on traffic and the steady
+state recompiles.
+
+Built on ``analysis.dataflow``: each key site's components are
+flattened through the local tuple def-use chain
+(``mkey = (...)``; ``mkey = mkey + (W,)``) and classified by backward
+reachability over the whole-program flow graph.  Components whose
+slice reaches a request-data node are findings; the full classified
+key table is exported via ``tools/tpulint.py --key-provenance`` and
+committed as ``tools/key_provenance_baseline.json`` so CI fails on
+drift (a new key component, a changed provenance class) even when the
+new component is benign — key-shape changes must be reviewed.
+
+Config keys (``ProjectContext.config``): the ``dataflow.*`` family —
+``dataflow.key_calls`` (call names whose first argument is a program
+key), ``dataflow.request_sources`` (node-id prefixes counted as
+per-request data), ``dataflow.deployment_attrs`` (class-attribute
+prefixes classified as model dimensions).
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from ..core import Finding, ProjectContext, Rule
+from ..dataflow import DataflowEngine, project_engine
+
+_SCOPE = ("serving/",)
+
+
+class KeyProvenanceRule(Rule):
+    id = "key-provenance"
+    name = "executable-key provenance"
+    rationale = (
+        "Program keys feeding the compile cache must be pure functions "
+        "of deployment configuration; any per-request value in a key "
+        "component makes the cache key on traffic and recompile after "
+        "warmup, breaking the zero-recompile invariant.")
+    # finalize-only rule; scope filtering happens on finding paths.
+    path_scope = ()
+
+    def __init__(self):
+        self.engine: Optional[DataflowEngine] = None
+
+    def finalize(self, project: ProjectContext) -> Iterable[Finding]:
+        self.engine = project_engine(project)
+        out: List[Finding] = []
+        for ks, comp in self.engine.key_findings():
+            if not any(seg in ks.path for seg in _SCOPE):
+                continue
+            witness = comp.witness or "[request-data]"
+            msg = (f"key component {comp.expr!r} of {ks.label!r} "
+                   f"derives from per-request data "
+                   f"(witness: {witness})")
+            out.append(Finding(self.id, ks.path, comp.line, 1, msg,
+                               ks.qual))
+        return out
+
+    # ------------------------------------------------ CLI mode hooks
+    def table(self) -> dict:
+        assert self.engine is not None, "finalize() has not run"
+        return self.engine.key_table()
+
+    def to_dot(self) -> str:
+        assert self.engine is not None, "finalize() has not run"
+        return self.engine.to_dot()

@@ -50,6 +50,7 @@ from .journey import BUCKETS as JOURNEY_BUCKETS
 from .journey import JourneyStore
 from .prometheus import (family_names, render_prometheus,
                          validate_exposition)
+from .stable import sorted_tree
 from .steplog import StepCostModel, StepLog
 from .tracing import Span, Trace, Tracer
 
@@ -70,4 +71,5 @@ __all__ = [
     "validate_exposition",
     "family_names",
     "capture_bundle",
+    "sorted_tree",
 ]

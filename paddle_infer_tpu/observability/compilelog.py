@@ -33,6 +33,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from .stable import sorted_tree
+
 logger = logging.getLogger("paddle_infer_tpu.observability")
 
 _RING = 512             # compile events kept for inspection/evidence
@@ -159,7 +161,7 @@ class CompileLog:
     def summary(self) -> dict:
         """Gauge block for ``/metrics`` and the evidence bundle."""
         with self._lock:
-            return {
+            return sorted_tree({
                 "compile_count": self.compile_count,
                 "compile_count_by_site": dict(self._count_by_site),
                 "recompile_count": self.recompile_count,
@@ -169,7 +171,7 @@ class CompileLog:
                     self.post_warmup_decode_compiles,
                 "compile_wall_s_total": round(
                     sum(e.wall_s for e in self._events), 6),
-            }
+            })
 
     def reset(self):
         with self._lock:

@@ -24,6 +24,8 @@ import threading
 from bisect import bisect_left
 from typing import Dict, List, Optional, Sequence
 
+from .stable import sorted_tree
+
 
 def log_bounds(lo: float = 1e-4, hi: float = 100.0) -> tuple:
     """1-2-5 log-series bucket bounds covering [lo, hi] inclusive."""
@@ -90,7 +92,8 @@ class Histogram:
             cum += c
             buckets.append([le, cum])
         buckets.append(["+Inf", total])
-        return {"buckets": buckets, "sum": acc, "count": total}
+        return sorted_tree(
+            {"buckets": buckets, "sum": acc, "count": total})
 
     def quantile(self, q: float) -> Optional[float]:
         return quantile(self.snapshot(), q)

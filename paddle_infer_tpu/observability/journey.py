@@ -47,6 +47,7 @@ import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
+from .stable import sorted_tree
 from .tracing import Trace, Tracer
 
 # The closed bucket vocabulary.  Order matters: it is the presentation
@@ -433,9 +434,10 @@ class JourneyStore:
         with self._lock:
             cov = (self._coverage_sum / self._count
                    if self._count else 0.0)
-            return {"count": self._count,
-                    "hops_total": self._hops_total,
-                    "attribution_coverage": round(cov, 4),
-                    "bucket_seconds": {b: round(v, 6) for b, v in
-                                       self._bucket_sums.items()},
-                    "live": len(self._live)}
+            return sorted_tree(
+                {"count": self._count,
+                 "hops_total": self._hops_total,
+                 "attribution_coverage": round(cov, 4),
+                 "bucket_seconds": {b: round(v, 6) for b, v in
+                                    self._bucket_sums.items()},
+                 "live": len(self._live)})

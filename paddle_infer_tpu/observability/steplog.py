@@ -46,6 +46,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from .stable import sorted_tree
+
 # one entry per record field: (key, default).  Every record carries
 # every key — consumers (JSONL, /steps, bench) never need .get chains.
 _SCHEMA = (
@@ -578,4 +580,4 @@ class StepLog:
             "mean_abs_rel_err": (sum(errs) / len(errs)) if errs else None,
             "max_abs_rel_err": max(errs) if errs else None,
         }
-        return out
+        return sorted_tree(out)

@@ -1299,7 +1299,7 @@ class EngineCore:
         table[:len(t)] = np.asarray(t, np.int32)
         # tpulint: disable-next-line=host-sync -- host-side page-table/cache-key staging buffer, built before dispatch
         key = np.asarray(
-            jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))
+            jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))  # tpulint: disable=determinism -- the rng key derives from (seed, rid) only; the time taint is a container-coarse read of the packet dict whose journey metadata carries wall-clocks
         if self._ragged:
             # ragged admission stages KV only: the uncached suffix waits
             # in ``pending`` and enters the NEXT mixed steps as
@@ -1362,6 +1362,7 @@ class EngineCore:
                 # windowed family: cold (offset 0) and warm (offset c)
                 # share one executable per plen bucket, so a hit never
                 # compiles anything new
+                # tpulint: disable-next-line=key-provenance -- legacy per-plen program family: plen is bucket-rounded by _plen (deployment-capped bucket set), so the key space is bounded; the ragged mixed step is the zero-recompile path
                 pkey = ("serve-prefill-px", plen, self._max_pages,
                         self._pool.num_blocks)
                 tok, fin = eng.run_paged_program(
@@ -1372,6 +1373,7 @@ class EngineCore:
                     np.asarray([cached], np.int32), steps0, table[None],
                     self._samp_arrays([g]), key[None])
             else:
+                # tpulint: disable-next-line=key-provenance -- legacy per-plen program family: plen is bucket-rounded by _plen (deployment-capped bucket set), so the key space is bounded; the ragged mixed step is the zero-recompile path
                 pkey = ("serve-prefill", plen, self._max_pages,
                         self._pool.num_blocks)
                 tok, fin = eng.run_paged_program(
@@ -1407,6 +1409,7 @@ class EngineCore:
             # TTFT is a first-admission metric; a replayed request's
             # first token was delivered long ago
             self._metrics.on_prefill(time.monotonic() - req.arrival)
+        # tpulint: disable-next-line=determinism -- container-coarse packet read: the emitted token comes from the device prefill output; the handoff packet's journey wall-clocks are sibling metadata in the same dict
         req._emit(np.asarray([tok], np.int32))
         self._metrics.on_tokens(1)
         # the prefill span runs edge-to-edge (admission bookkeeping +
@@ -1967,6 +1970,7 @@ class EngineCore:
                     # last token and sampled the row's next token
                     if s["steps_base"] == 0:
                         self._metrics.on_prefill(now - req.arrival)
+                    # tpulint: disable-next-line=determinism -- container-coarse slot read: t_row is the device step output; the slot dict's wall-clock bookkeeping (last_emit, span ends) is sibling metadata
                     req._emit(t_row)
                     self._metrics.on_tokens(int(t_row.size))
                     s["emitted"] += int(t_row.size)
@@ -1975,6 +1979,7 @@ class EngineCore:
                     emitted_prefill += int(t_row.size)
                     prefill_done.append(req)
             else:
+                # tpulint: disable-next-line=determinism -- container-coarse slot read: t_row is the device step output; the slot dict's wall-clock bookkeeping (last_emit, span ends) is sibling metadata
                 req._emit(t_row)
                 s["emitted"] += int(t_row.size)
                 s["last_tok"] = int(t_row[-1])
@@ -2477,6 +2482,7 @@ class EngineCore:
             "journey": self._journeys.context(req.rid, self.replica_name),
         }
         try:
+            # tpulint: disable-next-line=determinism -- the park packet carries journey wall-clock metadata by design (latency attribution across the park); the replay fields (salt, tokens, fsm_state) are time-free
             tier.park(req.rid, packet, n_pages, step=self._step_idx,
                       predictive=predictive)
         except MemoryError:     # raced capacity check; slot untouched
@@ -2617,7 +2623,7 @@ class EngineCore:
                                  packet["v_host"])
         # tpulint: disable-next-line=host-sync -- host-side page-table/cache-key staging buffer, built before dispatch
         key = np.asarray(
-            jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))
+            jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))  # tpulint: disable=determinism -- the rng key derives from (seed, rid) only; the time taint is a container-coarse read of the packet dict whose journey metadata carries wall-clocks
         now = time.monotonic()
         self._slots[sid] = {
             "req": req, "sid": sid, "g": g, "length": length,
@@ -2820,6 +2826,7 @@ class EngineCore:
             # this hop (export end -> import start) into one journey
             packet["journey"] = self._journeys.context(
                 req.rid, self.replica_name, export_end=now)
+            # tpulint: disable-next-line=determinism -- the handoff packet carries journey wall-clock metadata by design (export_end stitches the cross-replica hop); the replay fields are time-free
             return packet
 
     def import_handoff(self, packet: dict) -> Request:
@@ -2946,7 +2953,7 @@ class EngineCore:
                                 in zip(v_pages, packet["v_host"])]
             # tpulint: disable-next-line=host-sync -- host-side page-table/cache-key staging buffer, built before dispatch
             key = np.asarray(
-                jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))
+                jax.random.fold_in(jax.random.PRNGKey(g.seed), req.rid))  # tpulint: disable=determinism -- the rng key derives from (seed, rid) only; the time taint is a container-coarse read of the packet dict whose journey metadata carries wall-clocks
             now = time.monotonic()
             self._slots[sid] = {
                 "req": req, "sid": sid, "g": g, "length": length,

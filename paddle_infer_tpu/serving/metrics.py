@@ -30,6 +30,7 @@ from typing import Dict, Optional
 from bisect import bisect_left
 
 from ..observability.histogram import DEFAULT_BOUNDS, Histogram
+from ..observability.stable import sorted_tree
 from ..observability.journey import BUCKETS as _JOURNEY_BUCKETS
 
 _RESERVOIR = 2048        # samples kept per latency series
@@ -483,7 +484,9 @@ class ServingMetrics:
                 "loop_exceptions": self.loop_exceptions,
             })
             out["resilience"] = res
-            return out
+            # canonical key order at every level: the /metrics JSON
+            # body is byte-stable across replicas and restarts
+            return sorted_tree(out)
 
     def to_prometheus(self, snapshot: Optional[Dict] = None,
                       compile_summary: Optional[Dict] = None) -> str:

@@ -179,7 +179,7 @@ def _schema_regex(schema, depth):
     implied), which is what keeps the lowering a pure regex."""
     if "enum" in schema:
         alts = "|".join(
-            _escape_lit(json.dumps(v, separators=(",", ":")))
+            _escape_lit(json.dumps(v, separators=(",", ":")))  # tpulint: disable=determinism -- enum literals serialize scalars; the iteration-order taint is the canonical declared-property walk below
             for v in schema["enum"])
         return "(" + alts + ")"
     stype = schema.get("type")
@@ -207,7 +207,7 @@ def _schema_regex(schema, depth):
         return "\\[" + body + "\\]"
     # object (validated above)
     parts = [
-        _escape_lit(json.dumps(key)) + ":" + _schema_regex(sub, depth + 1)
+        _escape_lit(json.dumps(key)) + ":" + _schema_regex(sub, depth + 1)  # tpulint: disable=determinism -- declared-property order is canonical: parsed JSON dicts preserve the spec text's key order, so one spec text lowers to one regex
         for key, sub in schema["properties"].items()
     ]
     return "\\{" + ",".join(parts) + "\\}"

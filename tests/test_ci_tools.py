@@ -346,7 +346,7 @@ def test_tpulint_repo_clean():
     rep = json.loads(r.stdout)
     assert rep["new"] == []
     assert rep["files"] > 100          # really walked the package
-    assert len(rep["rules"]) == 9
+    assert len(rep["rules"]) == 11
 
 
 def test_faultplane_sites_documented():
@@ -417,6 +417,109 @@ def test_tpulint_lock_graph_dot():
     assert r.returncode == 0, r.stdout + r.stderr[-800:]
     assert r.stdout.startswith("digraph")
     assert "EngineCore._step_lock" in r.stdout
+
+
+def test_tpulint_key_provenance_gate():
+    """The zero-recompile gate: every component of every executable
+    key must classify as deployment provenance (no request-data), and
+    the classified table must be byte-identical to the committed
+    baseline — a new key component or a changed provenance class must
+    be reviewed even when benign."""
+    def run():
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+             "--key-provenance"], capture_output=True, text=True,
+            env=_env(), timeout=600)
+        return r, json.loads(r.stdout)
+
+    r, rep = run()
+    assert r.returncode == 0, r.stdout + r.stderr[-800:]
+    assert rep["exit"] == 0 and rep["drift"] == []
+    assert rep["findings"] == []
+    table = rep["table"]
+    assert table["version"] == 1
+    # the table is real: the ragged mixed-step site keys the grammar
+    # family on a literal and draws nothing request-shaped
+    mixed = [s for s in table["sites"]
+             if s["site"].endswith("::EngineCore._mixed_step")]
+    assert len(mixed) == 1
+    comps = {c["expr"]: c["classes"] for c in mixed[0]["components"]}
+    assert comps["'grammar'"] == ["const"]
+    assert all("request-data" not in cl for cl in comps.values())
+    # the ONLY request-shaped components are the bucket-rounded plen
+    # of the legacy per-plen prefill family (reason-suppressed at the
+    # site; the table still records the truth)
+    reqs = [(s["site"], c["expr"]) for s in table["sites"]
+            for c in s["components"] if "request-data" in c["classes"]]
+    assert reqs == [
+        ("paddle_infer_tpu/serving/engine_core.py::EngineCore._admit",
+         "plen")] * 2
+    # deterministic: two runs, identical table JSON
+    _, rep2 = run()
+    assert json.dumps(rep2["table"], sort_keys=True) \
+        == json.dumps(table, sort_keys=True)
+
+
+def test_tpulint_key_provenance_dot():
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+         "--key-provenance", "--dot"], capture_output=True, text=True,
+        env=_env(), timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr[-800:]
+    assert r.stdout.startswith("digraph key_provenance")
+    assert '"request-data" [shape=octagon];' in r.stdout
+    assert '"const"' in r.stdout and "serve-step" in r.stdout
+
+
+def test_tpulint_key_provenance_update_deterministic(tmp_path):
+    """--key-provenance-update must reproduce the committed baseline
+    byte-for-byte (the gate's drift check depends on it)."""
+    out = tmp_path / "key_provenance_baseline.json"
+
+    def update():
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+             "--key-provenance-update",
+             "--key-provenance-baseline", str(out)],
+            capture_output=True, text=True, env=_env(), timeout=600)
+        assert r.returncode == 0, r.stdout + r.stderr[-800:]
+        return out.read_bytes()
+
+    first, second = update(), update()
+    assert first == second
+    committed = os.path.join(ROOT, "tools",
+                             "key_provenance_baseline.json")
+    with open(committed, "rb") as f:
+        assert f.read() == first
+
+
+def test_tpulint_determinism_clean():
+    """The bitwise-replay gate: no nondeterminism source reaches token
+    emission, handoff/park packets, or RNG-key construction anywhere
+    in serving/ or observability/ — fixed or reason-suppressed at the
+    sink, never baselined."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+         "--determinism"], capture_output=True, text=True, env=_env(),
+        timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr[-800:]
+    rep = json.loads(r.stdout)
+    assert rep["exit"] == 0 and rep["findings"] == []
+    assert rep["files"] > 100          # whole-package flow graph
+
+
+def test_tpulint_help_contract():
+    """CI scripts drive tpulint by flag name: --help must exit 0 and
+    advertise every gate mode."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+         "--help"], capture_output=True, text=True, env=_env(),
+        timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr[-800:]
+    for flag in ("--lock-graph", "--key-provenance",
+                 "--key-provenance-update", "--determinism", "--dot",
+                 "--baseline-update", "--list-rules"):
+        assert flag in r.stdout, f"--help lost {flag}"
 
 
 @pytest.mark.slow

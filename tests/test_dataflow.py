@@ -1,0 +1,506 @@
+"""Interprocedural dataflow engine (analysis/dataflow.py) and the two
+rules built on it: ``key-provenance`` (executable keys derive only
+from deployment constants — the static zero-recompile proof) and
+``determinism`` (nondeterminism sources never reach token emission,
+handoff/park packets, or RNG-key construction — the static
+bitwise-replay proof).
+
+Synthetic fixtures drive both directions for every behavior: each
+hazard fires with a witness path, and the matching safe idiom stays
+silent.  The precision features that make the rules usable on the real
+serving plane get their own regression fixtures — context-sensitive
+function summaries (a shared pure helper must not smear one caller's
+request data into another caller's key), light SSA (reusing a local
+variable name must not merge both definitions' provenance),
+``sorted()`` sanitization, ordered-registry iteration exemption, and
+generator ``yield`` return flow.  Callback-binding extraction
+(interproc.extract_bindings) is covered for the direct-assignment
+attach form the tier-demote path uses.
+"""
+import ast
+import json
+import os
+import textwrap
+
+from paddle_infer_tpu.analysis import Analyzer, all_rules
+from paddle_infer_tpu.analysis.core import FileContext
+from paddle_infer_tpu.analysis.dataflow import build_engine
+from paddle_infer_tpu.analysis.interproc import (ProjectIndex,
+                                                 extract_bindings)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_dataflow(tmp_path, sources, rules=("key-provenance",
+                                           "determinism"),
+                 config=None):
+    """sources: {relpath: code}.  Returns (findings, rule_objects) —
+    the rules keep the built DataflowEngine for structural
+    assertions."""
+    paths = []
+    for rel, src in sources.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        paths.append(str(p))
+    rule_objs = all_rules(list(rules))
+    analyzer = Analyzer(rule_objs, root=str(tmp_path), config=config)
+    findings, _ = analyzer.run(sorted(paths))
+    return findings, rule_objs
+
+
+def key_table_of(rules):
+    for r in rules:
+        if r.id == "key-provenance":
+            return r.table()
+    raise AssertionError("key-provenance rule not in run")
+
+
+def site(table, key):
+    for s in table["sites"]:
+        if s["key"] == key:
+            return s
+    raise AssertionError(f"no site with key {key!r} in {table}")
+
+
+def comp(s, expr):
+    for c in s["components"]:
+        if c["expr"] == expr:
+            return c
+    raise AssertionError(f"no component {expr!r} in {s}")
+
+
+# ------------------------------------------------ key provenance
+REQUEST_KEY = """
+    class Request:
+        def __init__(self, prompt):
+            self.prompt = prompt
+
+    class Engine:
+        def __init__(self, width: int):
+            self._w = width
+
+        def step(self, req: "Request"):
+            n = len(req.prompt)
+            key = ("serve-step", self._w, n)
+            run_paged_program(key, n)
+"""
+
+
+def test_key_request_data_fires(tmp_path):
+    fs, _ = run_dataflow(tmp_path, {"serving/mod.py": REQUEST_KEY},
+                         rules=("key-provenance",))
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.rule == "key-provenance"
+    assert "key component 'n'" in f.message
+    assert "derives from per-request data" in f.message
+    # the witness names the request-data node the slice reached
+    assert "[request-data attr:Request.prompt]" in f.message
+
+
+DEPLOY_KEY = """
+    class Engine:
+        def __init__(self, width: int):
+            self._w = width
+
+        def step(self):
+            key = ("serve-step", self._w)
+            key = key + ("grammar",)
+            run_paged_program(key, 0)
+"""
+
+
+def test_key_deployment_constants_silent(tmp_path):
+    fs, rules = run_dataflow(tmp_path, {"serving/mod.py": DEPLOY_KEY},
+                             rules=("key-provenance",))
+    assert fs == []
+    table = key_table_of(rules)
+    s = site(table, "serve-step")
+    # the ``key = key + (...)`` extension is flattened into components
+    exprs = [c["expr"] for c in s["components"]]
+    assert exprs == ["'serve-step'", "self._w", "'grammar'"]
+    assert comp(s, "'grammar'")["classes"] == ["const"]
+    assert comp(s, "'serve-step'")["classes"] == ["const"]
+    w = comp(s, "self._w")["classes"]
+    assert "ctor-config" in w and "request-data" not in w
+
+
+SHARED_HELPER = """
+    class Request:
+        def __init__(self, prompt):
+            self.prompt = prompt
+
+    def _round_up(x):
+        return x + 7
+
+    class Engine:
+        def __init__(self, width: int):
+            self._w = width
+
+        def pack(self, req: "Request"):
+            return _round_up(len(req.prompt))
+
+        def step(self):
+            w = _round_up(self._w)
+            key = ("serve-step", w)
+            run_paged_program(key, 0)
+"""
+
+
+def test_summaries_keep_callers_apart(tmp_path):
+    # context-insensitive analysis would merge both callers of
+    # _round_up through its shared return node, smearing pack()'s
+    # request data into step()'s key.  Function summaries map the
+    # key's slice through the ACTUAL argument (self._w) only.
+    fs, rules = run_dataflow(tmp_path, {"serving/mod.py": SHARED_HELPER},
+                             rules=("key-provenance",))
+    assert fs == []
+    cls = comp(site(key_table_of(rules), "serve-step"), "w")["classes"]
+    assert "request-data" not in cls
+    assert "ctor-config" in cls
+
+
+SSA_REUSE = """
+    import time
+
+    class Engine:
+        def __init__(self, width: int):
+            self._w = width
+
+        def step(self):
+            x = time.time()
+            self._last = x
+            x = self._w
+            key = ("serve-step", x)
+            run_paged_program(key, 0)
+"""
+
+
+def test_ssa_variable_reuse_is_flow_sensitive(tmp_path):
+    # the key reads the SECOND definition of x; a flow-insensitive
+    # var node would drag the wall-clock read into the key's slice.
+    _, rules = run_dataflow(tmp_path, {"serving/mod.py": SSA_REUSE},
+                            rules=("key-provenance",))
+    cls = comp(site(key_table_of(rules), "serve-step"), "x")["classes"]
+    assert "nondeterministic" not in cls
+    assert "ctor-config" in cls
+
+
+def test_key_table_deterministic(tmp_path):
+    srcs = {"serving/mod.py": SHARED_HELPER,
+            "serving/oth.py": DEPLOY_KEY}
+    _, r1 = run_dataflow(tmp_path, srcs, rules=("key-provenance",))
+    one = json.dumps(key_table_of(r1), sort_keys=True)
+    _, r2 = run_dataflow(tmp_path, srcs, rules=("key-provenance",))
+    two = json.dumps(key_table_of(r2), sort_keys=True)
+    assert one == two
+
+
+def test_key_provenance_dot_shape(tmp_path):
+    _, rules = run_dataflow(tmp_path, {"serving/mod.py": REQUEST_KEY},
+                            rules=("key-provenance",))
+    dot = [r for r in rules if r.id == "key-provenance"][0].to_dot()
+    assert dot.startswith("digraph key_provenance {")
+    assert '"request-data" [shape=octagon];' in dot
+    assert '"const"' in dot
+
+
+# -------------------------------------------------- determinism
+RNG_EMIT = """
+    import numpy as np
+
+    class Sampler:
+        def step(self, req):
+            tok = np.random.randint(0, 50)
+            req._emit(tok)
+"""
+
+
+def test_unseeded_rng_into_emit_fires(tmp_path):
+    fs, _ = run_dataflow(tmp_path, {"serving/mod.py": RNG_EMIT},
+                         rules=("determinism",))
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.rule == "determinism"
+    assert "nondeterminism (unseeded-rng)" in f.message
+    assert "token-emit sink" in f.message
+    # witness format: [<label> source at file:line] -> frames
+    assert "[unseeded-rng source at serving/mod.py:" in f.message
+    assert " -> " in f.message
+
+
+SEEDED_EMIT = """
+    import numpy as np
+
+    class Sampler:
+        def __init__(self):
+            self._rng = np.random.default_rng(0)
+
+        def step(self, req):
+            tok = self._rng.integers(0, 50)
+            req._emit(tok)
+"""
+
+
+def test_seeded_rng_silent(tmp_path):
+    fs, _ = run_dataflow(tmp_path, {"serving/mod.py": SEEDED_EMIT},
+                         rules=("determinism",))
+    assert fs == []
+
+
+DICT_ORDER_PACKET = """
+    class Mover:
+        def __init__(self):
+            self._slots = {}
+
+        def export_handoff(self):
+            order = [k for k in self._slots.keys()]
+            packet = {"order": order}
+            return packet
+"""
+
+
+def test_dict_order_into_handoff_packet_fires(tmp_path):
+    fs, _ = run_dataflow(tmp_path,
+                         {"serving/mod.py": DICT_ORDER_PACKET},
+                         rules=("determinism",))
+    assert len(fs) == 1
+    f = fs[0]
+    assert "nondeterminism (iteration-order)" in f.message
+    assert "packet sink" in f.message
+    assert "[iteration-order source at serving/mod.py:" in f.message
+
+
+SORTED_PACKET = DICT_ORDER_PACKET.replace(
+    "[k for k in self._slots.keys()]",
+    "sorted(self._slots.keys())")
+
+
+def test_sorted_sanitizes_iteration_order(tmp_path):
+    fs, _ = run_dataflow(tmp_path, {"serving/mod.py": SORTED_PACKET},
+                         rules=("determinism",))
+    assert fs == []
+
+
+TIME_INTO_RNG_KEY = """
+    import time
+    import jax
+
+    class Sampler:
+        def key_for(self, rid):
+            salt = int(time.time())
+            return jax.random.fold_in(jax.random.PRNGKey(salt), rid)
+"""
+
+
+def test_time_into_rng_key_fires(tmp_path):
+    fs, _ = run_dataflow(tmp_path,
+                         {"serving/mod.py": TIME_INTO_RNG_KEY},
+                         rules=("determinism",))
+    assert fs and all(f.rule == "determinism" for f in fs)
+    assert any("rng-key sink" in f.message
+               and "nondeterminism (time)" in f.message for f in fs)
+
+
+UNSORTED_JSON = """
+    import json
+
+    class Log:
+        def render(self, d):
+            body = {k: v for k, v in d.items()}
+            return json.dumps(body)
+"""
+
+
+def test_unsorted_json_dump_fires_iteration_order_only(tmp_path):
+    fs, _ = run_dataflow(tmp_path, {"serving/mod.py": UNSORTED_JSON},
+                         rules=("determinism",))
+    assert len(fs) == 1
+    assert "serialized-json sink" in fs[0].message
+    assert "without sort_keys=True" in fs[0].message
+    # sort_keys=True is the fix, not a suppression
+    fixed = UNSORTED_JSON.replace("json.dumps(body)",
+                                  "json.dumps(body, sort_keys=True)")
+    fs2, _ = run_dataflow(tmp_path, {"serving/mod.py": fixed},
+                          rules=("determinism",))
+    assert fs2 == []
+
+
+ORDERED_REGISTRY = """
+    class Layer:
+        def __init__(self):
+            self._sub_layers = {}
+
+        def export_handoff(self):
+            names = [k for k in self._sub_layers.items()]
+            return {"names": names}
+"""
+
+
+def test_ordered_registry_iteration_exempt(tmp_path):
+    # framework sublayer registries are insertion-ordered by
+    # construction; iterating them is not an iteration-order hazard
+    fs, _ = run_dataflow(tmp_path,
+                         {"serving/mod.py": ORDERED_REGISTRY},
+                         rules=("determinism",))
+    assert fs == []
+
+
+GENERATOR_FLOW = """
+    import time
+
+    def ticks():
+        yield time.time()
+
+    class Mover:
+        def export_handoff(self):
+            stamps = [t for t in ticks()]
+            return {"stamps": stamps}
+"""
+
+
+def test_generator_yield_flows_to_return(tmp_path):
+    # a generator's return value is what it yields: the summary must
+    # carry the time source out through the yield
+    fs, _ = run_dataflow(tmp_path, {"serving/mod.py": GENERATOR_FLOW},
+                         rules=("determinism",))
+    assert len(fs) == 1
+    assert "nondeterminism (time)" in fs[0].message
+    assert "packet sink" in fs[0].message
+
+
+SHARED_GLOBAL = """
+    _counter = 0
+
+    def bump():
+        global _counter
+        _counter += 1
+        return _counter
+
+    class Sampler:
+        def step(self, req):
+            req._emit(bump())
+"""
+
+
+def test_shared_mutable_global_into_emit_fires(tmp_path):
+    fs, _ = run_dataflow(tmp_path, {"serving/mod.py": SHARED_GLOBAL},
+                         rules=("determinism",))
+    assert any("nondeterminism (shared-mutable)" in f.message
+               and "token-emit sink" in f.message for f in fs)
+
+
+def test_scope_excludes_non_serving_sinks(tmp_path):
+    # same hazard under kernels/ — the rule only reports for the
+    # replay-critical planes (serving/, observability/)
+    fs, _ = run_dataflow(tmp_path, {"kernels/mod.py": RNG_EMIT},
+                         rules=("determinism",))
+    assert fs == []
+
+
+def test_suppression_with_reason_is_honored(tmp_path):
+    src = RNG_EMIT.replace(
+        "req._emit(tok)",
+        "# tpulint: disable-next-line=determinism -- test fixture\n"
+        "        req._emit(tok)")
+    fs, _ = run_dataflow(tmp_path, {"serving/mod.py": src},
+                         rules=("determinism",))
+    assert [f.rule for f in fs] == []
+
+
+# --------------------------------------- callback binding extraction
+def _index(tmp_path, sources):
+    files = []
+    for rel, src in sources.items():
+        code = textwrap.dedent(src)
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(code)
+        files.append(FileContext(str(p), rel, code, ast.parse(code)))
+    ix = ProjectIndex(files, {})
+    extract_bindings(ix)
+    return ix, files
+
+
+DIRECT_BINDING = """
+    class Core:
+        def _demote_block(self, bid):
+            return bid
+
+    class Cache:
+        def flush(self):
+            self._tier_demote(0)
+
+    def wire(core: "Core", cache: "Cache"):
+        cache._tier_demote = core._demote_block
+"""
+
+
+def test_extract_bindings_direct_assignment(tmp_path):
+    # the tier-demote attach form: a bound method assigned directly
+    # (no lambda wrapper) onto another object's attribute
+    ix, _ = _index(tmp_path, {"serving/wire.py": DIRECT_BINDING})
+    b = ix.bindings.get(("Cache", "_tier_demote"))
+    assert b is not None
+    assert b.target == "serving/wire.py::Core._demote_block"
+    assert b.param_suffix == {}
+
+
+def test_extract_bindings_direct_assignment_cross_file(tmp_path):
+    ix, _ = _index(tmp_path, {
+        "serving/core.py": """
+            class Core:
+                def _demote_block(self, bid):
+                    return bid
+        """,
+        "serving/cache.py": """
+            class Cache:
+                pass
+        """,
+        "serving/wire.py": """
+            def wire(core: "Core", cache: "Cache"):
+                cache._tier_demote = core._demote_block
+        """,
+    })
+    b = ix.bindings.get(("Cache", "_tier_demote"))
+    assert b is not None
+    assert b.target == "serving/core.py::Core._demote_block"
+
+
+CALLBACK_TAINT = """
+    import numpy as np
+
+    class Core:
+        def pick(self):
+            return np.random.randint(0, 4)
+
+    class Cache:
+        def run(self, req):
+            req._emit(self._pick())
+
+    def wire(core: "Core", cache: "Cache"):
+        cache._pick = core.pick
+"""
+
+
+def test_dataflow_follows_direct_binding(tmp_path):
+    # the flow engine resolves calls THROUGH the binding: the rng
+    # source inside Core.pick reaches the emit sink in Cache.run
+    fs, _ = run_dataflow(tmp_path, {"serving/mod.py": CALLBACK_TAINT},
+                         rules=("determinism",))
+    assert any("nondeterminism (unseeded-rng)" in f.message
+               and "token-emit sink" in f.message for f in fs)
+
+
+# -------------------------------------------------- engine internals
+def test_engine_summary_of_pure_helper(tmp_path):
+    code = textwrap.dedent(SHARED_HELPER)
+    p = tmp_path / "serving" / "mod.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(code)
+    fc = FileContext(str(p), "serving/mod.py", code, ast.parse(code))
+    eng = build_engine([fc])
+    ps, ex = eng.summaries["serving/mod.py::_round_up"]
+    assert ps == frozenset({"x"})       # return depends on the arg...
+    assert ex == frozenset()            # ...and nothing else

@@ -312,3 +312,53 @@ def test_metrics_reset_uses_instance_lock():
     assert probe.entered == 1
     assert m.snapshot(queue_depth=0, active=0,
                       max_batch=1)["counters"]["submitted"] == 0
+
+
+# --------------------------------------------------- byte stability
+def _assert_sorted_everywhere(obj, path="$"):
+    """Every dict at every level carries its keys in canonical order —
+    the property that makes /metrics and /steps bodies byte-stable."""
+    if isinstance(obj, dict):
+        keys = list(obj)
+        want = sorted(keys, key=lambda x: (str(type(x)), str(x)))
+        assert keys == want, f"unsorted keys at {path}: {keys}"
+        for k, v in obj.items():
+            _assert_sorted_everywhere(v, f"{path}.{k}")
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            _assert_sorted_everywhere(v, f"{path}[{i}]")
+
+
+def test_sorted_tree_canonicalizes():
+    from paddle_infer_tpu.observability import sorted_tree
+
+    a = sorted_tree({"b": 1, "a": {"z": (1, 2), "y": [{"q": 0, "p": 1}]}})
+    b = sorted_tree({"a": {"y": [{"p": 1, "q": 0}], "z": [1, 2]}, "b": 1})
+    assert json.dumps(a) == json.dumps(b)       # insertion-order-free
+    _assert_sorted_everywhere(a)
+    # mixed-type keys (int site ids next to str names) still order
+    # deterministically where json.dumps(sort_keys=True) would raise
+    m = sorted_tree({3: "x", "a": "y", 1: "z"})
+    assert list(m) == [1, 3, "a"]
+    assert sorted_tree(a) == a                  # idempotent
+
+
+def test_metrics_snapshot_byte_stable():
+    snap = _fabricated_snapshot()
+    _assert_sorted_everywhere(snap)
+    # two identically-driven instances render the same key structure
+    # (values carry wall-clock rates; the SHAPE is what must be stable)
+    assert list(_fabricated_snapshot()) == list(snap)
+
+
+def test_steplog_and_compilelog_summaries_byte_stable():
+    from paddle_infer_tpu.observability import StepLog
+
+    log = StepLog()
+    log.record("decode", wall_s=0.0015, decode_rows=2)
+    log.record("prefill", wall_s=0.009, prefill_tokens=64)
+    _assert_sorted_everywhere(log.summary())
+
+    clog = CompileLog()
+    clog.record("serving-decode", ("serve-step", 4), "sig", 0.5)
+    _assert_sorted_everywhere(clog.summary())

@@ -15,6 +15,14 @@ Usage:
                                                 # tools/lock_graph_baseline.json
     python tools/tpulint.py --lock-graph --dot  # Graphviz view
     python tools/tpulint.py --lock-graph-update # rewrite that baseline
+    python tools/tpulint.py --key-provenance    # executable-key
+                                                # provenance table,
+                                                # diffed against
+                                                # tools/key_provenance_baseline.json
+    python tools/tpulint.py --key-provenance --dot
+    python tools/tpulint.py --key-provenance-update
+    python tools/tpulint.py --determinism       # determinism-taint
+                                                # findings (JSON)
 
 The analysis package is loaded straight from its files rather than
 through ``import paddle_infer_tpu`` — the parent package pulls in
@@ -86,9 +94,24 @@ def main(argv=None) -> int:
     ap.add_argument("--lock-graph-update", action="store_true",
                     help="rewrite the lock-graph baseline from the "
                     "current graph")
+    ap.add_argument("--key-provenance", action="store_true",
+                    help="emit the executable-key provenance table "
+                    "(stable JSON) and diff it against the committed "
+                    "key-provenance baseline")
+    ap.add_argument("--key-provenance-baseline",
+                    default=os.path.join(ROOT, "tools",
+                                         "key_provenance_baseline.json"),
+                    help="key-provenance baseline file (default: "
+                    "tools/key_provenance_baseline.json)")
+    ap.add_argument("--key-provenance-update", action="store_true",
+                    help="rewrite the key-provenance baseline from "
+                    "the current key table")
+    ap.add_argument("--determinism", action="store_true",
+                    help="run only the determinism-taint rule and "
+                    "emit its findings as JSON (exit 1 on any)")
     ap.add_argument("--dot", action="store_true",
-                    help="with --lock-graph: emit Graphviz DOT "
-                    "instead of JSON (no baseline diff)")
+                    help="with --lock-graph / --key-provenance: emit "
+                    "Graphviz DOT instead of JSON (no baseline diff)")
     args = ap.parse_args(argv)
 
     an = _load_analysis()
@@ -101,6 +124,12 @@ def main(argv=None) -> int:
 
     if args.lock_graph or args.lock_graph_update:
         return _lock_graph_mode(an, args)
+
+    if args.key_provenance or args.key_provenance_update:
+        return _key_provenance_mode(an, args)
+
+    if args.determinism:
+        return _determinism_mode(an, args)
 
     only = ([r.strip() for r in args.rules.split(",") if r.strip()]
             if args.rules else None)
@@ -195,6 +224,79 @@ def _lock_graph_mode(an, args) -> int:
         "findings": [f.to_dict() for f in findings],
         "drift": drift,
         "exit": 1 if (findings or drift) else 0,
+    }
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return report["exit"]
+
+
+def _key_provenance_mode(an, args) -> int:
+    """Run only the key-provenance rule, export the classified key
+    table, and (unless updating or emitting DOT) diff the stable JSON
+    against the committed baseline.  Exit 1 on unsuppressed findings
+    OR drift — a new key component or a changed provenance class must
+    be reviewed even when benign."""
+    rules = an.all_rules(["key-provenance"])
+    analyzer = an.Analyzer(rules, root=ROOT, config={})
+    findings, n_files = analyzer.run(args.paths)
+    findings = [f for f in findings if f.rule == "key-provenance"]
+    rule = rules[0]
+    # json round-trip normalizes tuples to lists so the comparison
+    # against the loaded baseline is exact
+    stable = json.loads(json.dumps(rule.table(), sort_keys=True))
+
+    if args.dot:
+        print(rule.to_dot())
+        return 0
+
+    if args.key_provenance_update:
+        with open(args.key_provenance_baseline, "w",
+                  encoding="utf-8") as f:
+            json.dump(stable, f, indent=2, sort_keys=True)
+            f.write("\n")
+        rel = os.path.relpath(args.key_provenance_baseline, ROOT)
+        n_comp = sum(len(s["components"]) for s in stable["sites"])
+        print(f"tpulint: wrote key-provenance table "
+              f"({len(stable['sites'])} sites, {n_comp} components) "
+              f"to {rel}")
+        return 0
+
+    drift = []
+    if os.path.exists(args.key_provenance_baseline):
+        with open(args.key_provenance_baseline, encoding="utf-8") as f:
+            committed = json.load(f)
+        if committed != stable:
+            drift.append("key-provenance table drifted from committed "
+                         "baseline (run --key-provenance-update and "
+                         "review)")
+    else:
+        drift.append(
+            f"missing baseline "
+            f"{os.path.relpath(args.key_provenance_baseline, ROOT)}"
+            f" (run --key-provenance-update)")
+
+    report = {
+        "files": n_files,
+        "table": stable,
+        "findings": [f.to_dict() for f in findings],
+        "drift": drift,
+        "exit": 1 if (findings or drift) else 0,
+    }
+    print(json.dumps(report, indent=2, sort_keys=True))
+    return report["exit"]
+
+
+def _determinism_mode(an, args) -> int:
+    """Run only the determinism-taint rule and report its findings as
+    JSON.  No baseline: nondeterminism reaching replay state is either
+    fixed or reason-suppressed at the sink line."""
+    rules = an.all_rules(["determinism"])
+    analyzer = an.Analyzer(rules, root=ROOT, config={})
+    findings, n_files = analyzer.run(args.paths)
+    findings = [f for f in findings if f.rule == "determinism"]
+    report = {
+        "files": n_files,
+        "findings": [f.to_dict() for f in findings],
+        "exit": 1 if findings else 0,
     }
     print(json.dumps(report, indent=2, sort_keys=True))
     return report["exit"]
